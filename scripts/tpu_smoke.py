@@ -1,0 +1,80 @@
+"""Smoke-run the kernel surface on the real TPU chip (run WITHOUT the test
+conftest so the default platform applies). Exercises the ops the CPU test
+mesh can't validate for TPU-compile legality (f64 emulation, x64 rewrites).
+
+Usage: python scripts/tpu_smoke.py
+"""
+import time
+
+import numpy as np
+
+import spark_rapids_tpu  # noqa: F401  (x64 on)
+import jax
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+from spark_rapids_tpu.ops import concat, filter as filt, groupby, hashing, \
+    join, partition, sort
+from spark_rapids_tpu.ops.groupby import AggSpec
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+
+def main():
+    print("devices:", jax.devices())
+    rng = np.random.default_rng(0)
+    n = 100_000
+    keys = rng.integers(0, 1000, n)
+    vals = rng.normal(size=n)
+    vv = rng.random(n) > 0.1
+    batch = ColumnarBatch([
+        Column.from_numpy(keys.astype(np.int64)),
+        Column.from_numpy(vals, validity=vv),
+        StringColumn.from_strings(
+            [f"s{i % 257}" for i in range(n)]),
+    ], n)
+    types = [dt.INT64, dt.FLOAT64, dt.STRING]
+
+    t0 = time.time()
+    out = sort.sort_batch(batch, [SortKeySpec.spark_default(1, False)], types)
+    out.columns[0].data.block_until_ready()
+    print(f"sort f64 desc: {time.time()-t0:.2f}s")
+
+    t0 = time.time()
+    g, _ = groupby.groupby_aggregate(
+        batch, [0], [AggSpec("sum", 1), AggSpec("count", 1),
+                     AggSpec("min", 1), AggSpec("max", 1)], types)
+    print(f"groupby: {time.time()-t0:.2f}s groups={g.realized_num_rows()}")
+
+    t0 = time.time()
+    h = hashing.hash_columns(batch, [0, 1, 2], types)
+    h.block_until_ready()
+    print(f"hash 3 cols (incl f64+str): {time.time()-t0:.2f}s")
+
+    t0 = time.time()
+    p, counts = partition.hash_partition(batch, [0], types, 16)
+    print(f"hash_partition: {time.time()-t0:.2f}s counts_sum={counts.sum()}")
+
+    t0 = time.time()
+    keep = batch.columns[1].data > 0
+    f = filt.compact_batch(batch, keep, batch.columns[1].validity)
+    print(f"filter: {time.time()-t0:.2f}s rows={f.realized_num_rows()}")
+
+    small = ColumnarBatch([
+        Column.from_numpy(rng.integers(0, 1000, 500).astype(np.int64)),
+        Column.from_numpy(rng.normal(size=500)),
+    ], 500)
+    t0 = time.time()
+    j, _ = join.equi_join(batch.select([0, 1]), small, [0], [0],
+                          [dt.INT64, dt.FLOAT64], [dt.INT64, dt.FLOAT64],
+                          "inner")
+    print(f"join: {time.time()-t0:.2f}s rows={j.realized_num_rows()}")
+
+    t0 = time.time()
+    c = concat.concat_batches([f.select([0, 1]), j.select([0, 1])])
+    print(f"concat: {time.time()-t0:.2f}s rows={c.realized_num_rows()}")
+    print("TPU SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from spark_rapids_tpu.columnar import dtypes  # noqa: F401
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema  # noqa: F401
+from spark_rapids_tpu.columnar.column import (  # noqa: F401
+    Column,
+    Scalar,
+    StringColumn,
+    unify_dictionaries,
+)

@@ -1,0 +1,147 @@
+"""Logical SQL type system and its mapping onto JAX array dtypes.
+
+The reference supports (v0.3): bool, byte/short/int/long, float/double, date,
+timestamp (UTC only) and string — no decimal/arrays/maps/structs
+(reference: sql-plugin/.../GpuOverrides.scala:442-454). We mirror that type
+matrix. Physical encodings are chosen for the TPU:
+
+- DATE       -> int32 days since unix epoch (Spark's internal encoding)
+- TIMESTAMP  -> int64 microseconds since epoch, UTC only (GpuOverrides.scala:341)
+- STRING     -> dictionary encoding: int32 codes into a *sorted* host-side
+  dictionary, so ordering/equality on codes equals ordering/equality on the
+  strings (see columnar/column.py). cuDF's native string columns
+  (offsets+bytes) have no XLA analogue; sorted-dictionary codes keep every
+  relational kernel (sort/join/groupby/comparisons) purely numeric on device.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DType:
+    """A logical SQL data type.
+
+    ``kernel_dtype`` is the physical jnp dtype used on device.
+    """
+
+    name: str
+    kernel_dtype: Any  # np/jnp dtype
+    byte_width: int
+    is_numeric: bool = False
+    is_floating: bool = False
+    is_integral: bool = False
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return self.name
+
+    @property
+    def np_dtype(self):
+        return np.dtype(self.kernel_dtype)
+
+
+BOOLEAN = DType("boolean", jnp.bool_, 1)
+INT8 = DType("tinyint", jnp.int8, 1, is_numeric=True, is_integral=True)
+INT16 = DType("smallint", jnp.int16, 2, is_numeric=True, is_integral=True)
+INT32 = DType("int", jnp.int32, 4, is_numeric=True, is_integral=True)
+INT64 = DType("bigint", jnp.int64, 8, is_numeric=True, is_integral=True)
+FLOAT32 = DType("float", jnp.float32, 4, is_numeric=True, is_floating=True)
+FLOAT64 = DType("double", jnp.float64, 8, is_numeric=True, is_floating=True)
+# Physical: int32 days since epoch.
+DATE = DType("date", jnp.int32, 4)
+# Physical: int64 microseconds since epoch (UTC).
+TIMESTAMP = DType("timestamp", jnp.int64, 8)
+# Physical: int32 dictionary codes (the dictionary itself lives host-side).
+STRING = DType("string", jnp.int32, 4)
+
+ALL_TYPES = [BOOLEAN, INT8, INT16, INT32, INT64, FLOAT32, FLOAT64, DATE,
+             TIMESTAMP, STRING]
+_BY_NAME = {t.name: t for t in ALL_TYPES}
+
+INTEGRAL_TYPES = [INT8, INT16, INT32, INT64]
+FRACTIONAL_TYPES = [FLOAT32, FLOAT64]
+NUMERIC_TYPES = INTEGRAL_TYPES + FRACTIONAL_TYPES
+
+
+def by_name(name: str) -> DType:
+    return _BY_NAME[name]
+
+
+def is_supported(dt: DType) -> bool:
+    """Type-support gate, mirrors GpuOverrides.isSupportedType
+    (reference GpuOverrides.scala:440-454)."""
+    return dt in ALL_TYPES
+
+
+_ARROW_MAP = {
+    "bool": BOOLEAN,
+    "int8": INT8,
+    "int16": INT16,
+    "int32": INT32,
+    "int64": INT64,
+    "float": FLOAT32,
+    "float32": FLOAT32,
+    "double": FLOAT64,
+    "float64": FLOAT64,
+    "date32[day]": DATE,
+    "string": STRING,
+    "large_string": STRING,
+}
+
+
+def from_arrow(arrow_type) -> DType:
+    """Map a pyarrow DataType to a logical DType."""
+    s = str(arrow_type)
+    if s in _ARROW_MAP:
+        return _ARROW_MAP[s]
+    if s.startswith("timestamp"):
+        return TIMESTAMP
+    if s.startswith("dictionary"):
+        return STRING
+    raise TypeError(f"unsupported arrow type: {arrow_type}")
+
+
+def to_arrow(dt: DType):
+    import pyarrow as pa
+
+    return {
+        "boolean": pa.bool_(),
+        "tinyint": pa.int8(),
+        "smallint": pa.int16(),
+        "int": pa.int32(),
+        "bigint": pa.int64(),
+        "float": pa.float32(),
+        "double": pa.float64(),
+        "date": pa.date32(),
+        "timestamp": pa.timestamp("us", tz="UTC"),
+        "string": pa.string(),
+    }[dt.name]
+
+
+def common_type(a: DType, b: DType) -> DType:
+    """Numeric type promotion for binary expressions (Spark's findTightestCommonType
+    subset for our supported matrix)."""
+    if a is b:
+        return a
+    order = {INT8: 0, INT16: 1, INT32: 2, INT64: 3, FLOAT32: 4, FLOAT64: 5}
+    if a in order and b in order:
+        # int64 + float32 -> float64 to avoid precision loss (Spark behavior)
+        if {a, b} == {INT64, FLOAT32}:
+            return FLOAT64
+        return a if order[a] >= order[b] else b
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+def null_sentinel(dt: DType):
+    """Value stored in data slots whose validity bit is false. Any value is
+    semantically fine (kernels must consult validity); we pick ones that make
+    min/max aggregations and sorts easy to mask."""
+    if dt.is_floating:
+        return np.nan
+    if dt is BOOLEAN:
+        return False
+    return 0  # STRING null slots hold code 0 so gathers stay in-bounds

@@ -1,0 +1,131 @@
+"""Host <-> device columnar conversion.
+
+Covers the reference's transition surface in one place:
+- ``GpuRowToColumnarExec`` row->columnar converters (GpuRowToColumnarExec.scala:45-134)
+- ``GpuColumnarToRowExec`` device->host row iteration (GpuColumnarToRowExec.scala:111)
+- ``HostColumnarToGpu`` arrow/cached-batch upload (HostColumnarToGpu.scala:31)
+
+Host decode rides pyarrow (the CPU half of the reference's scan path reads
+and assembles host buffers before the device decode, GpuParquetScan.scala:228-265);
+the upload is a single ``jnp.asarray`` per column into a bucketed buffer.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+
+def from_arrow_table(table, capacity: Optional[int] = None
+                     ) -> Tuple[ColumnarBatch, Schema]:
+    """Upload a pyarrow Table/RecordBatch to a device ColumnarBatch."""
+    import pyarrow as pa
+
+    if isinstance(table, pa.RecordBatch):
+        table = pa.Table.from_batches([table])
+    n = table.num_rows
+    cap = capacity or bucket_capacity(n)
+    names, types, cols = [], [], []
+    for field, chunked in zip(table.schema, table.columns):
+        dtype = dt.from_arrow(field.type)
+        arr = chunked.combine_chunks() if chunked.num_chunks != 1 \
+            else chunked.chunk(0)
+        names.append(field.name)
+        types.append(dtype)
+        cols.append(_arrow_array_to_column(arr, dtype, cap))
+    return ColumnarBatch(cols, n), Schema(names, types)
+
+
+def _arrow_array_to_column(arr, dtype: dt.DType, cap: int) -> Column:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(pc.is_valid(arr))
+    if dtype is dt.STRING:
+        if pa.types.is_dictionary(arr.type):
+            arr = pc.cast(arr, pa.string())
+        pylist = arr.to_pylist()
+        return StringColumn.from_strings(pylist, capacity=cap)
+    if dtype is dt.TIMESTAMP:
+        np_vals = np.asarray(pc.cast(arr, pa.int64()).fill_null(0))
+    elif dtype is dt.DATE:
+        np_vals = np.asarray(pc.cast(arr, pa.int32()).fill_null(0))
+    else:
+        np_vals = np.asarray(arr.fill_null(dt.null_sentinel(dtype))
+                             if arr.null_count else arr)
+    return Column.from_numpy(np_vals, dtype=dtype, validity=validity,
+                             capacity=cap)
+
+
+def to_arrow_table(batch: ColumnarBatch, schema: Schema):
+    """Download a device batch into a pyarrow Table (write path)."""
+    import pyarrow as pa
+
+    n = batch.realized_num_rows()
+    arrays = []
+    for c, t in zip(batch.columns, schema.types):
+        values, validity = c.to_numpy(n)
+        pa_type = dt.to_arrow(t)
+        if isinstance(c, StringColumn):
+            arrays.append(pa.array(list(values), type=pa_type))
+        else:
+            mask = None if validity is None else ~validity
+            arrays.append(pa.array(values, type=pa_type, mask=mask))
+    return pa.table(dict(zip(schema.names, arrays)))
+
+
+def from_pandas(df, capacity: Optional[int] = None
+                ) -> Tuple[ColumnarBatch, Schema]:
+    import pyarrow as pa
+
+    return from_arrow_table(pa.Table.from_pandas(df, preserve_index=False),
+                            capacity=capacity)
+
+
+def rows_to_columnar(rows: Sequence[Sequence], schema: Schema,
+                     capacity: Optional[int] = None) -> ColumnarBatch:
+    """Row->columnar conversion (GpuRowToColumnarExec analogue). Per-column
+    host builders then one upload each."""
+    n = len(rows)
+    cap = capacity or bucket_capacity(n)
+    cols: List[Column] = []
+    for j, t in enumerate(schema.types):
+        vals = [r[j] for r in rows]
+        if t is dt.STRING:
+            cols.append(StringColumn.from_strings(vals, capacity=cap))
+            continue
+        validity = np.array([v is not None for v in vals], dtype=bool)
+        filled = np.array(
+            [v if v is not None else dt.null_sentinel(t) for v in vals],
+            dtype=t.np_dtype)
+        cols.append(Column.from_numpy(
+            filled, dtype=t,
+            validity=None if validity.all() else validity, capacity=cap))
+    return ColumnarBatch(cols, n)
+
+
+def columnar_to_rows(batch: ColumnarBatch) -> List[tuple]:
+    """Device->host row materialization (GpuColumnarToRowExec analogue)."""
+    n = batch.realized_num_rows()
+    mats = []
+    for c in batch.columns:
+        values, validity = c.to_numpy(n)
+        mats.append((values, validity))
+    rows = []
+    for i in range(n):
+        row = []
+        for values, validity in mats:
+            if validity is not None and not validity[i]:
+                row.append(None)
+            else:
+                v = values[i]
+                row.append(v.item() if isinstance(v, np.generic) else v)
+        rows.append(tuple(row))
+    return rows

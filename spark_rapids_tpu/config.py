@@ -1,0 +1,343 @@
+"""Typed configuration system.
+
+Mirrors the reference's RapidsConf builder DSL and registry
+(sql-plugin/.../RapidsConf.scala:171-260: ``conf("key").doc(...)
+.booleanConf.createWithDefault``), including:
+
+- typed entries with docs and defaults, byte-size parsing,
+- a global registry used to generate documentation (RapidsConf.help,
+  RapidsConf.scala:133-168 -> docs/configs.md),
+- auto-generated per-operator enable flags added by the planning layer
+  (ReplacementRule.confKey, GpuOverrides.scala:129-137) checked during
+  tagging, with incompat / disabled-by-default levels
+  (GpuOverrides.scala:84-97).
+
+Keys use the ``rapids.tpu.*`` namespace (the reference uses
+``spark.rapids.*``).
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: "Dict[str, ConfEntry]" = {}
+_REGISTRY_LOCK = threading.Lock()
+
+_BYTE_SUFFIXES = {
+    "b": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40,
+}
+
+
+def parse_bytes(v) -> int:
+    """Parse '512m', '2g', '1024' into bytes (ConfHelper.byteFromString
+    analogue, RapidsConf.scala)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([bkmgt]?)b?\s*", str(v).lower())
+    if not m:
+        raise ValueError(f"cannot parse byte size: {v!r}")
+    num, suf = float(m.group(1)), m.group(2) or "b"
+    return int(num * _BYTE_SUFFIXES[suf])
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+
+class ConfEntry(Generic[T]):
+    def __init__(self, key: str, default: T, doc: str,
+                 converter: Callable[[Any], T], internal: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.converter = converter
+        self.internal = internal
+
+    def get(self, conf: "RapidsConf") -> T:
+        return conf.get(self)
+
+    def help(self) -> str:
+        return f"{self.key}|{self.doc}|{self.default}"
+
+
+class _Builder:
+    """``conf("key").doc(...).boolean_conf.create_with_default(x)``"""
+
+    def __init__(self, key: str):
+        self._key = key
+        self._doc = ""
+        self._internal = False
+        self._converter: Callable = lambda v: v
+
+    def doc(self, d: str) -> "_Builder":
+        self._doc = d
+        return self
+
+    def internal(self) -> "_Builder":
+        self._internal = True
+        return self
+
+    @property
+    def boolean_conf(self) -> "_Builder":
+        self._converter = _parse_bool
+        return self
+
+    @property
+    def int_conf(self) -> "_Builder":
+        self._converter = int
+        return self
+
+    @property
+    def double_conf(self) -> "_Builder":
+        self._converter = float
+        return self
+
+    @property
+    def string_conf(self) -> "_Builder":
+        self._converter = str
+        return self
+
+    @property
+    def bytes_conf(self) -> "_Builder":
+        self._converter = parse_bytes
+        return self
+
+    def create_with_default(self, default) -> ConfEntry:
+        entry = ConfEntry(self._key, default, self._doc, self._converter,
+                          self._internal)
+        with _REGISTRY_LOCK:
+            _REGISTRY[self._key] = entry
+        return entry
+
+
+def conf(key: str) -> _Builder:
+    return _Builder(key)
+
+
+def registered_entries() -> List[ConfEntry]:
+    with _REGISTRY_LOCK:
+        return list(_REGISTRY.values())
+
+
+def register_op_flag(kind: str, name: str, desc: str,
+                     default_enabled: bool = True,
+                     incompat: Optional[str] = None) -> ConfEntry:
+    """Auto-generated per-op enable flag: rapids.tpu.sql.<kind>.<Name>
+    (ReplacementRule.confKey analogue, GpuOverrides.scala:129-137)."""
+    key = f"rapids.tpu.sql.{kind}.{name}"
+    with _REGISTRY_LOCK:
+        if key in _REGISTRY:
+            return _REGISTRY[key]
+    doc = desc + (f" (incompatible: {incompat})" if incompat else "")
+    return conf(key).doc(doc).boolean_conf.create_with_default(
+        default_enabled and incompat is None)
+
+
+# ---------------------------------------------------------------------------
+# Core entries (subset of RapidsConf.scala:271-707 that applies TPU-side).
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf("rapids.tpu.sql.enabled").doc(
+    "Enable (true) or disable (false) TPU acceleration of queries."
+).boolean_conf.create_with_default(True)
+
+EXPLAIN = conf("rapids.tpu.sql.explain").doc(
+    "Explain why parts of a query were or were not placed on the TPU: "
+    "NONE, ALL, NOT_ON_TPU."
+).string_conf.create_with_default("NONE")
+
+INCOMPATIBLE_OPS = conf("rapids.tpu.sql.incompatibleOps.enabled").doc(
+    "Enable operators that produce results that differ in corner cases "
+    "from Spark CPU semantics."
+).boolean_conf.create_with_default(False)
+
+HAS_NANS = conf("rapids.tpu.sql.hasNans").doc(
+    "Assume floating point data may contain NaNs (affects agg/join planning)."
+).boolean_conf.create_with_default(True)
+
+VARIABLE_FLOAT_AGG = conf("rapids.tpu.sql.variableFloatAgg.enabled").doc(
+    "Allow float aggregations whose result may vary with evaluation order."
+).boolean_conf.create_with_default(False)
+
+CONCURRENT_TPU_TASKS = conf("rapids.tpu.sql.concurrentTpuTasks").doc(
+    "Number of tasks that can execute concurrently per TPU chip "
+    "(admission control; GpuSemaphore analogue, RapidsConf.scala:340)."
+).int_conf.create_with_default(2)
+
+BATCH_SIZE_BYTES = conf("rapids.tpu.sql.batchSizeBytes").doc(
+    "Target coalesced batch size in bytes (RapidsConf.scala:353-358; the "
+    "reference defaults to 2GiB, we default lower: XLA prefers bounded "
+    "shapes and HBM/chip is smaller than a V100's 32GB)."
+).bytes_conf.create_with_default(512 << 20)
+
+MAX_READER_BATCH_SIZE_ROWS = conf(
+    "rapids.tpu.sql.reader.batchSizeRows").doc(
+    "Soft cap on rows per reader batch."
+).int_conf.create_with_default(1 << 21)
+
+MAX_READER_BATCH_SIZE_BYTES = conf(
+    "rapids.tpu.sql.reader.batchSizeBytes").doc(
+    "Soft cap on bytes per reader batch."
+).bytes_conf.create_with_default(256 << 20)
+
+HBM_POOL_FRACTION = conf("rapids.tpu.memory.hbm.allocFraction").doc(
+    "Fraction of HBM the framework may fill before spilling "
+    "(RMM pool fraction analogue, RapidsConf.scala)."
+).double_conf.create_with_default(0.9)
+
+HBM_RESERVE = conf("rapids.tpu.memory.hbm.reserve").doc(
+    "Bytes of HBM reserved for XLA scratch/fusion temporaries."
+).bytes_conf.create_with_default(1 << 30)
+
+HOST_SPILL_STORAGE_SIZE = conf("rapids.tpu.memory.host.spillStorageSize").doc(
+    "Bounded host-memory spill target before falling to disk "
+    "(RapidsConf.scala:319)."
+).bytes_conf.create_with_default(8 << 30)
+
+SPILL_DIR = conf("rapids.tpu.memory.spillDir").doc(
+    "Directory for disk-tier spill files."
+).string_conf.create_with_default("/tmp/rapids_tpu_spill")
+
+MEMORY_DEBUG = conf("rapids.tpu.memory.debug").doc(
+    "Log every allocation/free (RMM debug-mode analogue, RapidsConf.scala:277)."
+).boolean_conf.create_with_default(False)
+
+SHUFFLE_PARTITIONS = conf("rapids.tpu.sql.shuffle.partitions").doc(
+    "Default number of shuffle partitions."
+).int_conf.create_with_default(16)
+
+SHUFFLE_COMPRESSION_CODEC = conf("rapids.tpu.shuffle.compression.codec").doc(
+    "Compression for shuffle payloads: none or zlib "
+    "(nvcomp-LZ4 analogue, RapidsConf.scala:685)."
+).string_conf.create_with_default("none")
+
+SHUFFLE_MAX_INFLIGHT = conf(
+    "rapids.tpu.shuffle.transport.maxReceiveInflightBytes").doc(
+    "Inflight-bytes throttle for shuffle fetches (RapidsConf.scala:603-685)."
+).bytes_conf.create_with_default(1 << 30)
+
+TEST_ENABLED = conf("rapids.tpu.sql.test.enabled").doc(
+    "Test mode: assert the whole plan is on the TPU "
+    "(GpuTransitionOverrides.scala:270-326)."
+).internal().boolean_conf.create_with_default(False)
+
+TEST_ALLOWED_NON_TPU = conf("rapids.tpu.sql.test.allowedNonTpu").doc(
+    "Comma-separated exec/expr class names allowed to fall back in test mode."
+).internal().string_conf.create_with_default("")
+
+CAST_FLOAT_TO_STRING = conf(
+    "rapids.tpu.sql.castFloatToString.enabled").doc(
+    "Enable float->string cast (formatting differs from Java in corner "
+    "cases; GpuCast gate analogue, RapidsConf.scala:450-482)."
+).boolean_conf.create_with_default(False)
+
+CAST_STRING_TO_FLOAT = conf(
+    "rapids.tpu.sql.castStringToFloat.enabled").doc(
+    "Enable string->float cast."
+).boolean_conf.create_with_default(False)
+
+CAST_STRING_TO_TIMESTAMP = conf(
+    "rapids.tpu.sql.castStringToTimestamp.enabled").doc(
+    "Enable string->timestamp cast."
+).boolean_conf.create_with_default(False)
+
+ENABLE_REPLACE_SORT_MERGE_JOIN = conf(
+    "rapids.tpu.sql.replaceSortMergeJoin.enabled").doc(
+    "Replace sort-merge joins with TPU hash joins (RapidsConf.scala:439). "
+    "On TPU the join itself is sort-based, so this controls removing the "
+    "upstream CPU sorts."
+).boolean_conf.create_with_default(True)
+
+IMPROVED_FLOAT_OPS = conf("rapids.tpu.sql.improvedFloatOps.enabled").doc(
+    "Enable float ops that use TPU transcendental approximations."
+).boolean_conf.create_with_default(False)
+
+MAX_CAPACITY_BUCKETS = conf("rapids.tpu.sql.shape.bucketWaste").doc(
+    "Capacity bucketing growth factor numerator/denominator packed as "
+    "percent waste allowed; buckets bound XLA recompilation (TPU-specific; "
+    "the reference never needed this because cuDF allocates dynamically)."
+).int_conf.create_with_default(100)
+
+MULTIFILE_READ_THREADS = conf("rapids.tpu.sql.multiFile.numThreads").doc(
+    "Thread pool size for multi-file reads "
+    "(MultiFileThreadPoolFactory analogue, GpuParquetScan.scala:647)."
+).int_conf.create_with_default(8)
+
+UDF_COMPILER_ENABLED = conf("rapids.tpu.sql.udfCompiler.enabled").doc(
+    "Trace Python UDFs into jittable jax expressions "
+    "(udf-compiler analogue)."
+).boolean_conf.create_with_default(True)
+
+
+class RapidsConf:
+    """Immutable snapshot of configuration values.
+
+    Values resolve: explicit dict > environment (dots->underscores,
+    uppercased) > registered default.
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+
+    def with_overrides(self, extra: Dict[str, Any]) -> "RapidsConf":
+        s = dict(self._settings)
+        s.update(extra)
+        return RapidsConf(s)
+
+    def get(self, entry: ConfEntry) -> Any:
+        if entry.key in self._settings:
+            return entry.converter(self._settings[entry.key])
+        env_key = entry.key.upper().replace(".", "_")
+        if env_key in os.environ:
+            return entry.converter(os.environ[env_key])
+        return entry.default
+
+    def get_key(self, key: str, default=None):
+        with _REGISTRY_LOCK:
+            entry = _REGISTRY.get(key)
+        if entry is not None:
+            return self.get(entry)
+        return self._settings.get(key, default)
+
+    def is_op_enabled(self, kind: str, name: str, default: bool = True) -> bool:
+        key = f"rapids.tpu.sql.{kind}.{name}"
+        with _REGISTRY_LOCK:
+            entry = _REGISTRY.get(key)
+        if entry is None:
+            return default
+        return self.get(entry)
+
+    # Convenience accessors used widely.
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return self.get(CONCURRENT_TPU_TASKS)
+
+    @staticmethod
+    def help() -> str:
+        """Generate config docs (docs/configs.md analogue)."""
+        lines = ["Name|Description|Default", "---|---|---"]
+        for e in sorted(registered_entries(), key=lambda e: e.key):
+            if not e.internal:
+                lines.append(e.help())
+        return "\n".join(lines)
+
+
+DEFAULT_CONF = RapidsConf()

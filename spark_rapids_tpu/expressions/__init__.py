@@ -1,0 +1,62 @@
+"""Expression layer: ~80 expression classes mirroring the reference's GPU
+expression inventory (SURVEY.md §2.5), evaluated either fused-in-jit or
+eagerly with dictionary transforms (see compiler.py)."""
+from spark_rapids_tpu.expressions.base import (  # noqa: F401
+    Alias,
+    BoundReference,
+    ColV,
+    EvalContext,
+    Expression,
+    Literal,
+)
+from spark_rapids_tpu.expressions.arithmetic import (  # noqa: F401
+    Abs,
+    Add,
+    Divide,
+    IntegralDivide,
+    Multiply,
+    Pmod,
+    Remainder,
+    Signum,
+    Subtract,
+    UnaryMinus,
+    UnaryPositive,
+)
+from spark_rapids_tpu.expressions.predicates import (  # noqa: F401
+    And,
+    AtLeastNNonNulls,
+    EqualNullSafe,
+    EqualTo,
+    GreaterThan,
+    GreaterThanOrEqual,
+    In,
+    IsNaN,
+    IsNotNull,
+    IsNull,
+    LessThan,
+    LessThanOrEqual,
+    Not,
+    Or,
+)
+from spark_rapids_tpu.expressions.conditional import (  # noqa: F401
+    CaseWhen,
+    Coalesce,
+    If,
+    NaNvl,
+    Nvl,
+)
+from spark_rapids_tpu.expressions.cast import Cast  # noqa: F401
+from spark_rapids_tpu.expressions.compiler import (  # noqa: F401
+    CompiledFilter,
+    CompiledProjection,
+)
+from spark_rapids_tpu.expressions.aggregates import (  # noqa: F401
+    AggregateFunction,
+    Average,
+    Count,
+    First,
+    Last,
+    Max,
+    Min,
+    Sum,
+)

@@ -1,0 +1,202 @@
+"""Declarative aggregate functions (reference
+org/apache/spark/sql/rapids/AggregateFunctions.scala): each function declares
+its *update* half (raw rows -> partial) and *merge* half (partials ->
+partials) as lists of kernel ops, plus a final-evaluation expression over its
+partial columns — exactly the CudfAggregate update/merge split (e.g. Average
+= sum + count, evaluated as sum/count). The aggregate exec drives these for
+partial/final/complete modes (execs/aggregate.py)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.column import Scalar
+from spark_rapids_tpu.expressions.base import BoundReference, Expression
+
+
+class AggregateFunction(Expression):
+    """Base: children[0] (if any) is the input expression."""
+
+    distinct = False
+
+    @property
+    def input(self) -> Optional[Expression]:
+        return self.children[0] if self.children else None
+
+    # ---- declarative halves ---------------------------------------------
+
+    def partial_types(self) -> List[dt.DType]:
+        """Types of this function's partial (intermediate) columns."""
+        raise NotImplementedError
+
+    def update_ops(self) -> List[str]:
+        """Kernel ops (ops/groupby.AGG_OPS) applied to the input projection,
+        one per partial column."""
+        raise NotImplementedError
+
+    def merge_ops(self) -> List[str]:
+        """Kernel ops merging partial columns (same arity)."""
+        raise NotImplementedError
+
+    def evaluate(self, partials: List[Expression]) -> Expression:
+        """Final expression over the partial columns."""
+        return partials[0]
+
+    def default_result(self) -> Scalar:
+        """Result on empty input (reduction with no rows,
+        aggregate.scala:488-501)."""
+        return Scalar(self.dtype, None)
+
+
+class Min(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def partial_types(self):
+        return [self.dtype]
+
+    def update_ops(self):
+        return ["min"]
+
+    def merge_ops(self):
+        return ["min"]
+
+
+class Max(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def partial_types(self):
+        return [self.dtype]
+
+    def update_ops(self):
+        return ["max"]
+
+    def merge_ops(self):
+        return ["max"]
+
+
+class Sum(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        return dt.INT64 if (t.is_integral or t is dt.BOOLEAN) else dt.FLOAT64
+
+    def partial_types(self):
+        return [self.dtype]
+
+    def update_ops(self):
+        return ["sum"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+
+class Count(AggregateFunction):
+    """count(expr); count(*) when child is None."""
+
+    def __init__(self, child: Optional[Expression] = None):
+        super().__init__([child] if child is not None else [])
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def partial_types(self):
+        return [dt.INT64]
+
+    def update_ops(self):
+        return ["count" if self.children else "count_star"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def default_result(self) -> Scalar:
+        return Scalar(dt.INT64, 0)
+
+
+class Average(AggregateFunction):
+    """avg = sum + count partials, final sum/count
+    (AggregateFunctions.scala GpuAverage)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def partial_types(self):
+        return [dt.FLOAT64, dt.INT64]
+
+    def update_ops(self):
+        return ["sum", "count"]
+
+    def merge_ops(self):
+        return ["sum", "sum"]
+
+    def evaluate(self, partials: List[Expression]) -> Expression:
+        from spark_rapids_tpu.expressions.arithmetic import Divide
+
+        return Divide(partials[0], partials[1])
+
+
+class First(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__([child])
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def deterministic(self):
+        return False
+
+    def partial_types(self):
+        return [self.dtype]
+
+    def update_ops(self):
+        return ["any_valid" if self.ignore_nulls else "first"]
+
+    def merge_ops(self):
+        return ["any_valid" if self.ignore_nulls else "first"]
+
+
+class Last(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__([child])
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def deterministic(self):
+        return False
+
+    def partial_types(self):
+        return [self.dtype]
+
+    def update_ops(self):
+        return ["last"]
+
+    def merge_ops(self):
+        return ["last"]

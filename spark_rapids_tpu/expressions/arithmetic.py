@@ -1,0 +1,215 @@
+"""Arithmetic expressions (reference org/apache/spark/sql/rapids/
+arithmetic.scala): add/sub/mul/div/integral-div/remainder/pmod/abs/sign/
+unary +-. Spark (non-ANSI) semantics: division/remainder by zero -> NULL;
+integer overflow wraps (java semantics == two's-complement jnp)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.column import Scalar
+from spark_rapids_tpu.expressions.base import (
+    ColV,
+    EvalContext,
+    EvalValue,
+    Expression,
+    and_validity,
+    eval_binary,
+    eval_unary,
+    scalar_data,
+    value_validity,
+)
+
+
+class BinaryArithmetic(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.common_type(self.children[0].dtype, self.children[1].dtype)
+
+    def _common(self):
+        return self.dtype.kernel_dtype
+
+
+class Add(BinaryArithmetic):
+    def eval(self, ctx):
+        kt = self._common()
+        return eval_binary(self, ctx,
+                           lambda a, b: a.astype(kt) + b.astype(kt),
+                           self.dtype)
+
+
+class Subtract(BinaryArithmetic):
+    def eval(self, ctx):
+        kt = self._common()
+        return eval_binary(self, ctx,
+                           lambda a, b: a.astype(kt) - b.astype(kt),
+                           self.dtype)
+
+
+class Multiply(BinaryArithmetic):
+    def eval(self, ctx):
+        kt = self._common()
+        return eval_binary(self, ctx,
+                           lambda a, b: a.astype(kt) * b.astype(kt),
+                           self.dtype)
+
+
+class _DivLike(Expression):
+    """Shared null-on-zero-divisor machinery (GpuDivModLike analogue,
+    arithmetic.scala)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _apply(self, ctx: EvalContext, fn, out_dtype: dt.DType) -> EvalValue:
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        if isinstance(a, Scalar) and isinstance(b, Scalar):
+            if a.is_null or b.is_null or b.value == 0:
+                return Scalar(out_dtype, None)
+            import jax
+
+            r = fn(jnp.asarray(a.value, a.dtype.kernel_dtype),
+                   jnp.asarray(b.value, b.dtype.kernel_dtype))
+            v = jax.device_get(r)
+            return Scalar(out_dtype,
+                          float(v) if out_dtype.is_floating else int(v))
+        if (isinstance(a, Scalar) and a.is_null) or \
+                (isinstance(b, Scalar) and b.is_null):
+            return Scalar(out_dtype, None)
+        ad, bd = scalar_data(a), scalar_data(b)
+        nonzero = bd != 0
+        safe_b = jnp.where(nonzero, bd, jnp.ones((), bd.dtype))
+        data = fn(ad, safe_b)
+        validity = and_validity(value_validity(a), value_validity(b))
+        validity = nonzero if validity is None else (validity & nonzero)
+        return ColV(out_dtype, data.astype(out_dtype.kernel_dtype), validity)
+
+
+class Divide(_DivLike):
+    """Spark Divide: always fractional output; x/0 -> NULL."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, ctx):
+        return self._apply(
+            ctx, lambda a, b: a.astype(jnp.float64) / b.astype(jnp.float64),
+            dt.FLOAT64)
+
+
+class IntegralDivide(_DivLike):
+    """div operator: long result, truncation toward zero (java semantics —
+    jnp // floors, so adjust)."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.INT64
+
+    def eval(self, ctx):
+        def f(a, b):
+            a = a.astype(jnp.int64)
+            b = b.astype(jnp.int64)
+            q = a // b
+            r = a - q * b
+            # floor->trunc correction when signs differ and remainder nonzero
+            return q + ((r != 0) & ((a < 0) != (b < 0))).astype(jnp.int64)
+
+        return self._apply(ctx, f, dt.INT64)
+
+
+class Remainder(_DivLike):
+    """% : java semantics (sign follows dividend); x%0 -> NULL."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.common_type(self.children[0].dtype, self.children[1].dtype)
+
+    def eval(self, ctx):
+        out = self.dtype
+        kt = out.kernel_dtype
+
+        def f(a, b):
+            a = a.astype(kt)
+            b = b.astype(kt)
+            m = jnp.remainder(a, b)  # python semantics: sign of divisor
+            # java: sign of dividend -> subtract b where signs mismatch
+            fix = (m != 0) & ((m < 0) != (a < 0))
+            return jnp.where(fix, m - b, m)
+
+        return self._apply(ctx, f, out)
+
+
+class Pmod(_DivLike):
+    """pmod(a, b): non-negative remainder."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.common_type(self.children[0].dtype, self.children[1].dtype)
+
+    def eval(self, ctx):
+        out = self.dtype
+        kt = out.kernel_dtype
+
+        def f(a, b):
+            m = jnp.remainder(a.astype(kt), b.astype(kt))
+            return jnp.where(m < 0, m + jnp.abs(b).astype(kt), m)
+
+        return self._apply(ctx, f, out)
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        return eval_unary(self, ctx, lambda x: -x, self.dtype)
+
+
+class UnaryPositive(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+
+class Abs(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        return eval_unary(self, ctx, jnp.abs, self.dtype)
+
+
+class Signum(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, ctx):
+        return eval_unary(
+            self, ctx, lambda x: jnp.sign(x.astype(jnp.float64)), dt.FLOAT64)

@@ -1,0 +1,331 @@
+"""Expression layer core.
+
+TPU-native analogue of ``GpuExpression.columnarEval`` (reference
+sql-plugin/.../GpuExpressions.scala): expressions evaluate over columnar
+batches producing a column or a scalar. The crucial TPU twist: evaluation is
+split into
+
+- a **fused device path**: any subtree whose nodes are ``device_only``
+  evaluates inside ONE jitted function over raw ``(data, validity)`` arrays —
+  an entire project/filter pipeline becomes a single XLA executable (the
+  reference instead launches one cuDF kernel per operator node);
+- an **eager path** for nodes needing host-side metadata (string dictionary
+  transforms): still device compute (gathers/remaps), dispatched op-by-op.
+
+``expressions/compiler.py`` picks the path per tree.
+
+Null semantics follow Spark SQL three-valued logic: unless a node overrides,
+output validity = AND of input validities.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, Scalar, StringColumn
+
+
+@dataclasses.dataclass
+class ColV:
+    """A column value during evaluation: raw arrays plus (eager mode only)
+    the source StringColumn for dictionary access."""
+
+    dtype: dt.DType
+    data: jax.Array
+    validity: Optional[jax.Array]
+    scol: Optional[StringColumn] = None  # dictionary carrier (eager mode)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def to_column(self) -> Column:
+        if self.dtype is dt.STRING and self.scol is not None:
+            return StringColumn(self.data, self.scol.dictionary,
+                                self.validity)
+        return Column(self.dtype, self.data, self.validity)
+
+
+EvalValue = Union[ColV, Scalar]
+
+
+class EvalContext:
+    """What an expression sees during evaluation."""
+
+    def __init__(self, columns: List[ColV], capacity: int, num_rows,
+                 conf=None, in_jit: bool = False, task_info=None):
+        self.columns = columns
+        self.capacity = capacity
+        self.num_rows = num_rows
+        self.conf = conf
+        self.in_jit = in_jit
+        self.task_info = task_info  # partition id etc (nondeterministic exprs)
+
+    @staticmethod
+    def from_batch(batch: ColumnarBatch, conf=None,
+                   task_info=None) -> "EvalContext":
+        cols = []
+        for c in batch.columns:
+            scol = c if isinstance(c, StringColumn) else None
+            cols.append(ColV(c.dtype, c.data, c.validity, scol))
+        return EvalContext(cols, batch.capacity, batch.num_rows_device(),
+                           conf=conf, task_info=task_info)
+
+
+class Expression:
+    """Base expression node."""
+
+    def __init__(self, children: Sequence["Expression"] = ()):
+        self.children = list(children)
+
+    # -- static properties -------------------------------------------------
+
+    @property
+    def dtype(self) -> dt.DType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children)
+
+    @property
+    def device_only(self) -> bool:
+        """True if this node evaluates purely on (data, validity) arrays —
+        i.e. is legal inside jit. String-dictionary ops return False."""
+        return all(c.device_only for c in self.children)
+
+    @property
+    def deterministic(self) -> bool:
+        return all(c.deterministic for c in self.children)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    # -- evaluation --------------------------------------------------------
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        raise NotImplementedError
+
+    # -- tree utilities ----------------------------------------------------
+
+    def transform(self, fn: Callable[["Expression"], "Expression"]
+                  ) -> "Expression":
+        new_children = [c.transform(fn) for c in self.children]
+        node = self
+        if new_children != self.children:
+            node = self._with_children(new_children)
+        return fn(node)
+
+    def _with_children(self, children: List["Expression"]) -> "Expression":
+        import copy
+
+        node = copy.copy(self)
+        node.children = children
+        return node
+
+    def collect(self, pred: Callable[["Expression"], bool]
+                ) -> List["Expression"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    def references(self) -> List[int]:
+        """Ordinals of all bound references under this node."""
+        return sorted({e.ordinal for e in self.collect(
+            lambda n: isinstance(n, BoundReference))})
+
+    def __repr__(self) -> str:  # pragma: no cover
+        if self.children:
+            return f"{self.name}({', '.join(map(repr, self.children))})"
+        return self.name
+
+
+class LeafExpression(Expression):
+    def __init__(self):
+        super().__init__(())
+
+
+class BoundReference(LeafExpression):
+    """Ordinal-bound input column (GpuBoundReference analogue,
+    GpuBoundAttribute.scala)."""
+
+    def __init__(self, ordinal: int, dtype: dt.DType, nullable: bool = True):
+        super().__init__()
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def device_only(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        return ctx.columns[self.ordinal]
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"input[{self.ordinal}:{self._dtype}]"
+
+
+class Literal(LeafExpression):
+    """Typed literal (GpuLiteral analogue, literals.scala)."""
+
+    def __init__(self, value, dtype: Optional[dt.DType] = None):
+        super().__init__()
+        if dtype is None:
+            dtype = _infer_literal_type(value)
+        self._dtype = dtype
+        self.value = value
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    @property
+    def device_only(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        return Scalar(self._dtype, self.value)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"lit({self.value!r})"
+
+
+class Alias(Expression):
+    """Named projection output (GpuAlias analogue)."""
+
+    def __init__(self, child: Expression, alias: str):
+        super().__init__([child])
+        self.alias = alias
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        return self.children[0].eval(ctx)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation helpers shared by all expression modules.
+# ---------------------------------------------------------------------------
+
+def broadcast(v: EvalValue, ctx: EvalContext) -> ColV:
+    """Materialize a scalar into a column value (full capacity)."""
+    if isinstance(v, ColV):
+        return v
+    if v.is_null:
+        if v.dtype is dt.STRING:
+            import numpy as np
+
+            codes = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+            sc = StringColumn(codes, np.array([], dtype=object),
+                              jnp.zeros(ctx.capacity, dtype=bool))
+            return ColV(dt.STRING, codes, sc.validity, sc)
+        return ColV(v.dtype, jnp.zeros(ctx.capacity,
+                                       dtype=v.dtype.kernel_dtype),
+                    jnp.zeros(ctx.capacity, dtype=bool))
+    if v.dtype is dt.STRING:
+        sc = StringColumn.from_strings([v.value] * 1, capacity=ctx.capacity)
+        data = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+        return ColV(dt.STRING, data, None, StringColumn(
+            data, sc.dictionary, None))
+    return ColV(v.dtype, jnp.full(ctx.capacity, v.value,
+                                  dtype=v.dtype.kernel_dtype), None)
+
+
+def and_validity(*vs: Optional[jax.Array]) -> Optional[jax.Array]:
+    out = None
+    for v in vs:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+def scalar_data(v: EvalValue):
+    """jnp-compatible raw operand: scalar -> python value, ColV -> array."""
+    if isinstance(v, Scalar):
+        return jnp.asarray(v.value, dtype=v.dtype.kernel_dtype)
+    return v.data
+
+
+def value_validity(v: EvalValue) -> Optional[jax.Array]:
+    if isinstance(v, Scalar):
+        return None  # null scalars are special-cased by callers
+    return v.validity
+
+
+def eval_unary(expr: Expression, ctx: EvalContext, fn,
+               out_dtype: dt.DType, null_out=None) -> EvalValue:
+    """Standard unary: null in -> null out (GpuUnaryExpression analogue)."""
+    v = expr.children[0].eval(ctx)
+    if isinstance(v, Scalar):
+        if v.is_null:
+            return Scalar(out_dtype, None)
+        r = fn(jnp.asarray(v.value, dtype=v.dtype.kernel_dtype))
+        return Scalar(out_dtype, _to_py(r, out_dtype))
+    return ColV(out_dtype, fn(v.data).astype(out_dtype.kernel_dtype),
+                v.validity)
+
+
+def eval_binary(expr: Expression, ctx: EvalContext, fn,
+                out_dtype: dt.DType) -> EvalValue:
+    """Standard binary: null if either side null
+    (GpuBinaryExpression analogue)."""
+    a = expr.children[0].eval(ctx)
+    b = expr.children[1].eval(ctx)
+    if isinstance(a, Scalar) and isinstance(b, Scalar):
+        if a.is_null or b.is_null:
+            return Scalar(out_dtype, None)
+        r = fn(jnp.asarray(a.value, a.dtype.kernel_dtype),
+               jnp.asarray(b.value, b.dtype.kernel_dtype))
+        return Scalar(out_dtype, _to_py(r, out_dtype))
+    if (isinstance(a, Scalar) and a.is_null) or \
+            (isinstance(b, Scalar) and b.is_null):
+        return Scalar(out_dtype, None)
+    data = fn(scalar_data(a), scalar_data(b))
+    validity = and_validity(value_validity(a), value_validity(b))
+    return ColV(out_dtype, data.astype(out_dtype.kernel_dtype), validity)
+
+
+def _to_py(x, out_dtype: dt.DType):
+    v = jax.device_get(x)
+    if out_dtype is dt.BOOLEAN:
+        return bool(v)
+    if out_dtype.is_floating:
+        return float(v)
+    return int(v)
+
+
+def _infer_literal_type(value) -> dt.DType:
+    if value is None:
+        raise ValueError("untyped null literal; pass dtype explicitly")
+    if isinstance(value, bool):
+        return dt.BOOLEAN
+    if isinstance(value, int):
+        return dt.INT64 if not (-2**31 <= value < 2**31) else dt.INT32
+    if isinstance(value, float):
+        return dt.FLOAT64
+    if isinstance(value, str):
+        return dt.STRING
+    raise TypeError(f"cannot infer literal type for {value!r}")

@@ -1,0 +1,144 @@
+"""Expression tree -> XLA fusion compiler.
+
+The reference launches one cuDF kernel per expression node
+(GpuExpressions.scala columnarEval chains). On TPU that would be a dispatch
+per node; instead, any projection/filter whose nodes are all ``device_only``
+compiles into ONE jitted function over the batch's raw arrays — XLA fuses
+the whole tree into a single executable (usually a single fused loop over
+HBM). Trees containing dictionary-dependent string ops fall back to eager
+per-node evaluation (still device compute, host dictionary transforms).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, Scalar, StringColumn
+from spark_rapids_tpu.expressions.base import (
+    Alias,
+    BoundReference,
+    ColV,
+    EvalContext,
+    Expression,
+    broadcast,
+)
+
+
+def _unwrap_alias(e: Expression) -> Expression:
+    while isinstance(e, Alias):
+        e = e.children[0]
+    return e
+
+
+def _passthrough_ref(e: Expression) -> Optional[int]:
+    e = _unwrap_alias(e)
+    if isinstance(e, BoundReference):
+        return e.ordinal
+    return None
+
+
+class CompiledProjection:
+    """Callable batch->batch for a fixed projection list."""
+
+    def __init__(self, exprs: Sequence[Expression], conf=None):
+        self.exprs = list(exprs)
+        self.conf = conf
+        self.fused = all(e.device_only for e in self.exprs)
+        if self.fused:
+            self._jit = self._build_fused()
+
+    def _build_fused(self):
+        exprs = self.exprs
+
+        @partial(jax.jit, static_argnames=("types",))
+        def run(datas, validities, num_rows, types):
+            capacity = datas[0].shape[0] if datas else 128
+            cols = [ColV(t, d, v) for (t, d, v) in
+                    zip(types, datas, validities)]
+            ctx = EvalContext(cols, capacity, num_rows, in_jit=True)
+            outs = []
+            for e in exprs:
+                v = e.eval(ctx)
+                o = broadcast(v, ctx)
+                outs.append((o.data, o.validity))
+            return outs
+
+        return run
+
+    def __call__(self, batch: ColumnarBatch,
+                 task_info=None) -> ColumnarBatch:
+        if self.fused:
+            datas = [c.data for c in batch.columns]
+            validities = [c.validity for c in batch.columns]
+            types = tuple(c.dtype for c in batch.columns)
+            outs = self._jit(datas, validities, batch.num_rows_device(),
+                             types)
+            cols = []
+            for e, (data, validity) in zip(self.exprs, outs):
+                if e.dtype is dt.STRING:
+                    ref = _passthrough_ref(e)
+                    assert ref is not None, \
+                        "device_only string expr must be a passthrough ref"
+                    src = batch.columns[ref]
+                    assert isinstance(src, StringColumn)
+                    cols.append(StringColumn(data, src.dictionary, validity))
+                else:
+                    cols.append(Column(e.dtype, data, validity))
+            return ColumnarBatch(cols, batch.num_rows)
+        # eager path
+        ctx = EvalContext.from_batch(batch, conf=self.conf,
+                                     task_info=task_info)
+        cols = []
+        for e in self.exprs:
+            v = broadcast(e.eval(ctx), ctx)
+            cols.append(v.to_column())
+        return ColumnarBatch(cols, batch.num_rows)
+
+
+class CompiledFilter:
+    """Callable batch->batch applying a boolean condition then compacting
+    (GpuFilterExec's columnarEval + tbl.filter,
+    basicPhysicalOperators.scala:100-130 — here mask + compaction are two
+    XLA executables; the mask fuses with any arithmetic above it)."""
+
+    def __init__(self, condition: Expression, conf=None):
+        self.condition = condition
+        self.conf = conf
+        self.fused = condition.device_only
+        if self.fused:
+            cond = condition
+
+            @partial(jax.jit, static_argnames=("types",))
+            def run_mask(datas, validities, num_rows, types):
+                capacity = datas[0].shape[0] if datas else 128
+                cols = [ColV(t, d, v) for (t, d, v) in
+                        zip(types, datas, validities)]
+                ctx = EvalContext(cols, capacity, num_rows, in_jit=True)
+                v = broadcast(cond.eval(ctx), ctx)
+                keep = v.data
+                if v.validity is not None:
+                    keep = keep & v.validity
+                return keep
+
+            self._mask = run_mask
+
+    def __call__(self, batch: ColumnarBatch,
+                 task_info=None) -> ColumnarBatch:
+        from spark_rapids_tpu.ops.filter import compact_batch
+
+        if self.fused:
+            datas = [c.data for c in batch.columns]
+            validities = [c.validity for c in batch.columns]
+            types = tuple(c.dtype for c in batch.columns)
+            keep = self._mask(datas, validities, batch.num_rows_device(),
+                              types)
+            return compact_batch(batch, keep)
+        ctx = EvalContext.from_batch(batch, conf=self.conf,
+                                     task_info=task_info)
+        v = broadcast(self.condition.eval(ctx), ctx)
+        return compact_batch(batch, v.data, v.validity)

@@ -1,0 +1,179 @@
+"""Conditional & null-handling expressions (reference
+.../conditionalExpressions.scala + nullExpressions.scala): If, CaseWhen,
+Coalesce, Nvl/IfNull, NaNvl.
+
+Unlike the reference's lazy per-branch evaluation (both branches are cheap
+under XLA fusion and select is free), branches evaluate unconditionally and
+combine with ``where`` — the idiomatic compiler-friendly form.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.column import Scalar
+from spark_rapids_tpu.expressions.base import (
+    ColV,
+    EvalContext,
+    EvalValue,
+    Expression,
+    broadcast,
+)
+
+
+def _string_safe(children: List[Expression]) -> bool:
+    return all(c.dtype is not dt.STRING for c in children)
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, then: Expression, other: Expression):
+        super().__init__([pred, then, other])
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+    @property
+    def device_only(self) -> bool:
+        # string branches need dictionary merge -> eager
+        return super().device_only and self.dtype is not dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        pred = self.children[0].eval(ctx)
+        if isinstance(pred, Scalar):
+            pick = self.children[1] if (not pred.is_null and pred.value) \
+                else self.children[2]
+            return pick.eval(ctx)
+        t = self.children[1].eval(ctx)
+        e = self.children[2].eval(ctx)
+        return _select(ctx, pred, t, e, self.dtype)
+
+
+class CaseWhen(Expression):
+    def __init__(self, branches: List[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        flat: List[Expression] = []
+        for c, v in branches:
+            flat.extend([c, v])
+        if else_value is not None:
+            flat.append(else_value)
+        super().__init__(flat)
+        self.n_branches = len(branches)
+        self.has_else = else_value is not None
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+    @property
+    def device_only(self) -> bool:
+        return super().device_only and self.dtype is not dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        out_t = self.dtype
+        if self.has_else:
+            result = self.children[-1].eval(ctx)
+        else:
+            result = Scalar(out_t, None)
+        # fold right-to-left so earlier branches win
+        for i in reversed(range(self.n_branches)):
+            pred = self.children[2 * i].eval(ctx)
+            val = self.children[2 * i + 1].eval(ctx)
+            if isinstance(pred, Scalar):
+                if not pred.is_null and pred.value:
+                    result = val
+                continue
+            result = _select(ctx, pred, val, result, out_t)
+        return result
+
+
+class Coalesce(Expression):
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def device_only(self) -> bool:
+        return super().device_only and self.dtype is not dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        result: EvalValue = Scalar(self.dtype, None)
+        for c in reversed(self.children):
+            v = c.eval(ctx)
+            if isinstance(v, Scalar):
+                if not v.is_null:
+                    result = v
+                continue
+            if v.validity is None:
+                result = v
+                continue
+            pred = ColV(dt.BOOLEAN, v.validity, None)
+            result = _select(ctx, pred, v, result, self.dtype)
+        return result
+
+
+class Nvl(Coalesce):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): a unless a is NaN."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        av = broadcast(a, ctx)
+        # pick the replacement ONLY for valid NaN inputs; NULL left -> NULL
+        # (null slots hold a NaN sentinel, so mask with validity)
+        a_valid = av.validity if av.validity is not None else \
+            jnp.ones(ctx.capacity, bool)
+        pick_a = (~jnp.isnan(av.data)) | (~a_valid)
+        pred = ColV(dt.BOOLEAN, pick_a, None)
+        return _select(ctx, pred, av, b, self.dtype)
+
+
+def _select(ctx: EvalContext, pred: ColV, t: EvalValue, e: EvalValue,
+            out_t: dt.DType) -> ColV:
+    """where(pred is true, t, e) with Spark null semantics: null predicate
+    selects the else branch; result validity follows the chosen side."""
+    if out_t is dt.STRING:
+        tb, eb = broadcast(t, ctx), broadcast(e, ctx)
+        from spark_rapids_tpu.columnar.column import StringColumn, \
+            unify_dictionaries
+
+        st = tb.scol if tb.scol is not None else None
+        se = eb.scol if eb.scol is not None else None
+        assert st is not None and se is not None
+        ut, ue = unify_dictionaries([
+            StringColumn(tb.data, st.dictionary, tb.validity),
+            StringColumn(eb.data, se.dictionary, eb.validity)])
+        tb = ColV(dt.STRING, ut.data, ut.validity, ut)
+        eb = ColV(dt.STRING, ue.data, ue.validity, ue)
+    else:
+        tb, eb = broadcast(t, ctx), broadcast(e, ctx)
+    cond = pred.data
+    if pred.validity is not None:
+        cond = cond & pred.validity
+    data = jnp.where(cond, tb.data, eb.data)
+    tvalid = tb.validity if tb.validity is not None else \
+        jnp.ones(ctx.capacity, bool)
+    evalid = eb.validity if eb.validity is not None else \
+        jnp.ones(ctx.capacity, bool)
+    validity = jnp.where(cond, tvalid, evalid)
+    if tb.validity is None and eb.validity is None:
+        validity = None
+    scol = tb.scol if out_t is dt.STRING else None
+    return ColV(out_t, data, validity, scol)

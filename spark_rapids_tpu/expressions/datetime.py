@@ -1,0 +1,277 @@
+"""Date/time expressions (reference .../datetimeExpressions.scala, 560 LoC):
+year/month/day/dayofweek/hour/minute/second, date +- interval, datediff,
+unix_timestamp/from_unixtime, last_day. Timestamps are UTC-only int64
+microseconds, dates int32 days — same internal encodings as Spark, so all
+extraction is pure integer math that runs in-jit (no host calendar calls):
+the civil-from-days algorithm below is the classic Howard Hinnant
+public-domain integer routine.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions.base import Expression, eval_binary, \
+    eval_unary
+
+_US_PER_DAY = 86_400_000_000
+_US_PER_HOUR = 3_600_000_000
+_US_PER_MIN = 60_000_000
+_US_PER_SEC = 1_000_000
+
+
+def _civil_from_days(z):
+    """days since 1970-01-01 -> (year, month [1-12], day [1-31])."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+class _DateField(Expression):
+    """Extract from DATE (or TIMESTAMP via day conversion)."""
+
+    part = None  # 'year' | 'month' | 'day'
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def _days(self, x):
+        if self.children[0].dtype is dt.TIMESTAMP:
+            return jnp.floor_divide(x, _US_PER_DAY)
+        return x
+
+    def eval(self, ctx):
+        part = type(self).part
+
+        def f(x):
+            y, m, d = _civil_from_days(self._days(x))
+            v = {"year": y, "month": m, "day": d}[part]
+            return v.astype(jnp.int32)
+
+        return eval_unary(self, ctx, f, dt.INT32)
+
+
+class Year(_DateField):
+    part = "year"
+
+
+class Month(_DateField):
+    part = "month"
+
+
+class DayOfMonth(_DateField):
+    part = "day"
+
+
+class _TimeField(Expression):
+    divisor = None
+    modulus = None
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval(self, ctx):
+        div, mod = type(self).divisor, type(self).modulus
+
+        def f(x):
+            tod = jnp.mod(x, _US_PER_DAY)
+            return jnp.mod(tod // div, mod).astype(jnp.int32)
+
+        return eval_unary(self, ctx, f, dt.INT32)
+
+
+class Hour(_TimeField):
+    divisor = _US_PER_HOUR
+    modulus = 24
+
+
+class Minute(_TimeField):
+    divisor = _US_PER_MIN
+    modulus = 60
+
+
+class Second(_TimeField):
+    divisor = _US_PER_SEC
+    modulus = 60
+
+
+class DayOfWeek(Expression):
+    """1 = Sunday ... 7 = Saturday (Spark semantics)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval(self, ctx):
+        def f(days):
+            # 1970-01-01 was a Thursday (=5 in Spark numbering)
+            return (jnp.mod(days.astype(jnp.int64) + 4, 7) + 1) \
+                .astype(jnp.int32)
+
+        return eval_unary(self, ctx, f, dt.INT32)
+
+
+class DateAdd(Expression):
+    def __init__(self, start, days):
+        super().__init__([start, days])
+
+    @property
+    def dtype(self):
+        return dt.DATE
+
+    def eval(self, ctx):
+        return eval_binary(
+            self, ctx,
+            lambda a, b: (a.astype(jnp.int64) +
+                          b.astype(jnp.int64)).astype(jnp.int32), dt.DATE)
+
+
+class DateSub(Expression):
+    def __init__(self, start, days):
+        super().__init__([start, days])
+
+    @property
+    def dtype(self):
+        return dt.DATE
+
+    def eval(self, ctx):
+        return eval_binary(
+            self, ctx,
+            lambda a, b: (a.astype(jnp.int64) -
+                          b.astype(jnp.int64)).astype(jnp.int32), dt.DATE)
+
+
+class DateDiff(Expression):
+    def __init__(self, end, start):
+        super().__init__([end, start])
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval(self, ctx):
+        return eval_binary(
+            self, ctx,
+            lambda a, b: (a.astype(jnp.int64) -
+                          b.astype(jnp.int64)).astype(jnp.int32), dt.INT32)
+
+
+class UnixTimestamp(Expression):
+    """timestamp -> epoch seconds (UTC only, the reference's constraint:
+    GpuOverrides.scala:341,451)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def eval(self, ctx):
+        src = self.children[0].dtype
+
+        def f(x):
+            if src is dt.DATE:
+                return x.astype(jnp.int64) * 86400
+            return jnp.floor_divide(x, _US_PER_SEC)
+
+        return eval_unary(self, ctx, f, dt.INT64)
+
+
+class FromUnixTime(Expression):
+    """epoch seconds -> timestamp (then format via Cast to string if asked)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.TIMESTAMP
+
+    def eval(self, ctx):
+        return eval_unary(
+            self, ctx, lambda x: x.astype(jnp.int64) * _US_PER_SEC,
+            dt.TIMESTAMP)
+
+
+class LastDay(Expression):
+    """Last day of the month of a date."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.DATE
+
+    def eval(self, ctx):
+        def f(days):
+            y, m, _ = _civil_from_days(days)
+            ny = jnp.where(m == 12, y + 1, y)
+            nm = jnp.where(m == 12, 1, m + 1)
+            first_next = _days_from_civil(ny, nm, jnp.ones_like(nm))
+            return (first_next - 1).astype(jnp.int32)
+
+        return eval_unary(self, ctx, f, dt.DATE)
+
+
+class DayOfYear(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval(self, ctx):
+        def f(days):
+            y, _, _ = _civil_from_days(days)
+            jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+            return (days.astype(jnp.int64) - jan1 + 1).astype(jnp.int32)
+
+        return eval_unary(self, ctx, f, dt.INT32)
+
+
+class Quarter(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval(self, ctx):
+        def f(days):
+            _, m, _ = _civil_from_days(days)
+            return ((m - 1) // 3 + 1).astype(jnp.int32)
+
+        return eval_unary(self, ctx, f, dt.INT32)

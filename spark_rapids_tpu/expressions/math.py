@@ -1,0 +1,190 @@
+"""Math expressions (reference .../mathExpressions.scala, registry at
+GpuOverrides.scala:702-957): trig/log/exp/sqrt/cbrt/rint/floor/ceil/pow/...
+
+All lower to single jnp ops -> fuse into the surrounding XLA computation.
+Transcendentals whose TPU approximations differ from java.lang.Math in ulps
+are flagged ``incompat`` at the planner (GpuOverrides incompat analogue).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions.base import Expression, eval_binary, \
+    eval_unary
+
+
+class _UnaryMathF64(Expression):
+    fn = None
+    incompat = False
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def eval(self, ctx):
+        f = type(self).fn
+        return eval_unary(self, ctx,
+                          lambda x: f(x.astype(jnp.float64)), dt.FLOAT64)
+
+
+class Sqrt(_UnaryMathF64):
+    fn = staticmethod(jnp.sqrt)
+
+
+class Cbrt(_UnaryMathF64):
+    fn = staticmethod(jnp.cbrt)
+
+
+class Exp(_UnaryMathF64):
+    fn = staticmethod(jnp.exp)
+    incompat = True
+
+
+class Expm1(_UnaryMathF64):
+    fn = staticmethod(jnp.expm1)
+    incompat = True
+
+
+class Log(_UnaryMathF64):
+    fn = staticmethod(jnp.log)
+    incompat = True
+
+
+class Log1p(_UnaryMathF64):
+    fn = staticmethod(jnp.log1p)
+    incompat = True
+
+
+class Log2(_UnaryMathF64):
+    fn = staticmethod(jnp.log2)
+    incompat = True
+
+
+class Log10(_UnaryMathF64):
+    fn = staticmethod(jnp.log10)
+    incompat = True
+
+
+class Sin(_UnaryMathF64):
+    fn = staticmethod(jnp.sin)
+    incompat = True
+
+
+class Cos(_UnaryMathF64):
+    fn = staticmethod(jnp.cos)
+    incompat = True
+
+
+class Tan(_UnaryMathF64):
+    fn = staticmethod(jnp.tan)
+    incompat = True
+
+
+class Asin(_UnaryMathF64):
+    fn = staticmethod(jnp.arcsin)
+    incompat = True
+
+
+class Acos(_UnaryMathF64):
+    fn = staticmethod(jnp.arccos)
+    incompat = True
+
+
+class Atan(_UnaryMathF64):
+    fn = staticmethod(jnp.arctan)
+    incompat = True
+
+
+class Sinh(_UnaryMathF64):
+    fn = staticmethod(jnp.sinh)
+    incompat = True
+
+
+class Cosh(_UnaryMathF64):
+    fn = staticmethod(jnp.cosh)
+    incompat = True
+
+
+class Tanh(_UnaryMathF64):
+    fn = staticmethod(jnp.tanh)
+    incompat = True
+
+
+class ToDegrees(_UnaryMathF64):
+    fn = staticmethod(jnp.degrees)
+
+
+class ToRadians(_UnaryMathF64):
+    fn = staticmethod(jnp.radians)
+
+
+class Rint(_UnaryMathF64):
+    fn = staticmethod(jnp.rint)
+
+
+class Floor(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def eval(self, ctx):
+        return eval_unary(
+            self, ctx,
+            lambda x: jnp.floor(x.astype(jnp.float64)).astype(jnp.int64),
+            dt.INT64)
+
+
+class Ceil(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def eval(self, ctx):
+        return eval_unary(
+            self, ctx,
+            lambda x: jnp.ceil(x.astype(jnp.float64)).astype(jnp.int64),
+            dt.INT64)
+
+
+class Pow(Expression):
+    incompat = True
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def eval(self, ctx):
+        return eval_binary(
+            self, ctx,
+            lambda a, b: jnp.power(a.astype(jnp.float64),
+                                   b.astype(jnp.float64)), dt.FLOAT64)
+
+
+class Atan2(Expression):
+    incompat = True
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def eval(self, ctx):
+        return eval_binary(
+            self, ctx,
+            lambda a, b: jnp.arctan2(a.astype(jnp.float64),
+                                     b.astype(jnp.float64)), dt.FLOAT64)

@@ -1,0 +1,383 @@
+"""Predicates & boolean logic (reference .../predicates.scala, 631 LoC):
+comparisons, AND/OR with Spark's three-valued-logic short circuits,
+IsNull/IsNotNull/IsNaN, EqualNullSafe, In, AtLeastNNonNulls, Not.
+
+Comparisons implement Spark ordering semantics for floats: NaN == NaN is
+false under ``=``, but NaN > everything for ``<``/``>`` (we match cuDF/Spark:
+IEEE comparisons except where Spark normalizes — the reference relies on
+cuDF's IEEE behavior too). String comparisons need unified dictionaries, so
+they are NOT device_only unless both sides share one dictionary carrier.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.column import Scalar, StringColumn, \
+    unify_dictionaries
+from spark_rapids_tpu.expressions.base import (
+    ColV,
+    EvalContext,
+    EvalValue,
+    Expression,
+    and_validity,
+    broadcast,
+    eval_binary,
+    scalar_data,
+    value_validity,
+)
+
+
+class _Comparison(Expression):
+    op = None  # staticmethod on subclass
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.BOOLEAN
+
+    @property
+    def device_only(self) -> bool:
+        # string comparisons require dictionary unification (host)
+        if self.children[0].dtype is dt.STRING:
+            return False
+        return super().device_only
+
+    def _prep_strings(self, a: EvalValue, b: EvalValue):
+        """Convert string operands onto one dictionary so code comparison is
+        string comparison."""
+        from spark_rapids_tpu.columnar.column import StringColumn
+
+        def as_scol(v):
+            if isinstance(v, Scalar):
+                return None
+            return v.scol
+
+        sa, sb = as_scol(a), as_scol(b)
+        if isinstance(a, Scalar) and isinstance(b, Scalar):
+            return a, b
+        if isinstance(a, Scalar) or isinstance(b, Scalar):
+            scalar, colv = (a, b) if isinstance(a, Scalar) else (b, a)
+            scol = colv.scol
+            assert scol is not None, "string ColV missing dictionary"
+            import numpy as np
+
+            # place the scalar into code space of this dictionary: exact
+            # match -> its code; otherwise use a half-code trick via two
+            # comparisons handled by caller through searchsorted position.
+            pos = int(np.searchsorted(
+                scol.dictionary.astype(str) if len(scol.dictionary)
+                else np.array([], dtype=str), str(scalar.value)))
+            exact = pos < len(scol.dictionary) and \
+                str(scol.dictionary[pos]) == str(scalar.value)
+            # encode as code*2 (+1 if between codes) on a doubled axis
+            code2 = pos * 2 + (0 if exact else -1)
+            a2 = ColV(dt.STRING, colv.data.astype(jnp.int64) * 2,
+                      colv.validity, scol)
+            s2 = Scalar(dt.INT64, code2)
+            return (s2, a2) if isinstance(a, Scalar) else (a2, s2)
+        if sa is not None and sb is not None:
+            ua, ub = unify_dictionaries([
+                StringColumn(a.data, sa.dictionary, a.validity),
+                StringColumn(b.data, sb.dictionary, b.validity)])
+            return (ColV(dt.STRING, ua.data, ua.validity, ua),
+                    ColV(dt.STRING, ub.data, ub.validity, ub))
+        raise AssertionError("string ColV missing dictionary")
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        # null scalars before any string prep: cmp vs NULL is NULL
+        if (isinstance(a, Scalar) and a.is_null) or \
+                (isinstance(b, Scalar) and b.is_null):
+            return Scalar(dt.BOOLEAN, None)
+        if self.children[0].dtype is dt.STRING:
+            a, b = self._prep_strings(a, b)
+        if isinstance(a, Scalar) and isinstance(b, Scalar):
+            return Scalar(dt.BOOLEAN, bool(self.op(
+                jnp.asarray(a.value, a.dtype.kernel_dtype),
+                jnp.asarray(b.value, b.dtype.kernel_dtype))))
+        if (isinstance(a, Scalar) and a.is_null) or \
+                (isinstance(b, Scalar) and b.is_null):
+            return Scalar(dt.BOOLEAN, None)
+        data = self.op(scalar_data(a), scalar_data(b))
+        return ColV(dt.BOOLEAN, data,
+                    and_validity(value_validity(a), value_validity(b)))
+
+
+class EqualTo(_Comparison):
+    op = staticmethod(lambda a, b: a == b)
+
+
+class LessThan(_Comparison):
+    op = staticmethod(lambda a, b: a < b)
+
+
+class LessThanOrEqual(_Comparison):
+    op = staticmethod(lambda a, b: a <= b)
+
+
+class GreaterThan(_Comparison):
+    op = staticmethod(lambda a, b: a > b)
+
+
+class GreaterThanOrEqual(_Comparison):
+    op = staticmethod(lambda a, b: a >= b)
+
+
+class EqualNullSafe(_Comparison):
+    """<=>: null <=> null is true; never returns null."""
+
+    op = staticmethod(lambda a, b: a == b)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        a_null_s = isinstance(a, Scalar) and a.is_null
+        b_null_s = isinstance(b, Scalar) and b.is_null
+        if self.children[0].dtype is dt.STRING and not (a_null_s or b_null_s):
+            a, b = self._prep_strings(a, b)
+        if isinstance(a, Scalar) and isinstance(b, Scalar):
+            if a_null_s or b_null_s:
+                return Scalar(dt.BOOLEAN, a_null_s and b_null_s)
+            return Scalar(dt.BOOLEAN, bool(self.op(
+                jnp.asarray(a.value), jnp.asarray(b.value))))
+        av = value_validity(a)
+        bv = value_validity(b)
+        a_valid = jnp.zeros(ctx.capacity, bool) if a_null_s else \
+            (av if av is not None else jnp.ones(ctx.capacity, bool))
+        b_valid = jnp.zeros(ctx.capacity, bool) if b_null_s else \
+            (bv if bv is not None else jnp.ones(ctx.capacity, bool))
+        if a_null_s or b_null_s:
+            eq = jnp.zeros(ctx.capacity, dtype=bool)
+        else:
+            eq = self.op(scalar_data(a), scalar_data(b))
+        both_null = (~a_valid) & (~b_valid)
+        data = jnp.where(a_valid & b_valid, eq, both_null)
+        return ColV(dt.BOOLEAN, data, None)
+
+
+class And(Expression):
+    """Spark 3VL: false AND null = false."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        a = broadcast(self.children[0].eval(ctx), ctx)
+        b = broadcast(self.children[1].eval(ctx), ctx)
+        av = a.validity if a.validity is not None else \
+            jnp.ones(ctx.capacity, bool)
+        bv = b.validity if b.validity is not None else \
+            jnp.ones(ctx.capacity, bool)
+        a_false = av & ~a.data
+        b_false = bv & ~b.data
+        data = a.data & b.data
+        validity = (av & bv) | a_false | b_false
+        if a.validity is None and b.validity is None:
+            validity = None
+        return ColV(dt.BOOLEAN, data, validity)
+
+
+class Or(Expression):
+    """Spark 3VL: true OR null = true."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        a = broadcast(self.children[0].eval(ctx), ctx)
+        b = broadcast(self.children[1].eval(ctx), ctx)
+        av = a.validity if a.validity is not None else \
+            jnp.ones(ctx.capacity, bool)
+        bv = b.validity if b.validity is not None else \
+            jnp.ones(ctx.capacity, bool)
+        a_true = av & a.data
+        b_true = bv & b.data
+        data = a.data | b.data
+        validity = (av & bv) | a_true | b_true
+        if a.validity is None and b.validity is None:
+            validity = None
+        return ColV(dt.BOOLEAN, data, validity)
+
+
+class Not(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx):
+        v = self.children[0].eval(ctx)
+        if isinstance(v, Scalar):
+            return Scalar(dt.BOOLEAN,
+                          None if v.is_null else (not v.value))
+        return ColV(dt.BOOLEAN, ~v.data, v.validity)
+
+
+class IsNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        v = self.children[0].eval(ctx)
+        if isinstance(v, Scalar):
+            return Scalar(dt.BOOLEAN, v.is_null)
+        if v.validity is None:
+            return Scalar(dt.BOOLEAN, False)
+        return ColV(dt.BOOLEAN, ~v.validity, None)
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        v = self.children[0].eval(ctx)
+        if isinstance(v, Scalar):
+            return Scalar(dt.BOOLEAN, not v.is_null)
+        if v.validity is None:
+            return Scalar(dt.BOOLEAN, True)
+        return ColV(dt.BOOLEAN, v.validity, None)
+
+
+class IsNaN(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        v = self.children[0].eval(ctx)
+        if isinstance(v, Scalar):
+            import math
+
+            return Scalar(dt.BOOLEAN,
+                          False if v.is_null else math.isnan(v.value))
+        data = jnp.isnan(v.data)
+        if v.validity is not None:
+            data = data & v.validity
+        return ColV(dt.BOOLEAN, data, None)
+
+
+class In(Expression):
+    """IN (literal list). Null semantics: x IN (...) is null if x is null,
+    or if no match and the list contains null."""
+
+    def __init__(self, child: Expression, values: List):
+        super().__init__([child])
+        self.values = values
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    @property
+    def device_only(self) -> bool:
+        return super().device_only and self.children[0].dtype is not dt.STRING
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expressions.base import LeafExpression, Literal
+
+        child = self.children[0]
+        child_value = child.eval(ctx)  # evaluate the subtree ONCE
+
+        class _Precomputed(LeafExpression):
+            dtype = child.dtype
+            nullable = child.nullable
+            device_only = True
+
+            def eval(self, _ctx):
+                return child_value
+
+        pre = _Precomputed()
+        result: Optional[Expression] = None
+        has_null = any(v is None for v in self.values)
+        for v in self.values:
+            if v is None:
+                continue
+            term = EqualTo(pre, Literal(v, child.dtype))
+            result = term if result is None else Or(result, term)
+        if result is None:
+            out = Scalar(dt.BOOLEAN, None if has_null else False)
+            return out
+        r = result.eval(ctx)
+        if has_null:
+            # no-match becomes null: validity &= data
+            if isinstance(r, Scalar):
+                if not r.is_null and not r.value:
+                    return Scalar(dt.BOOLEAN, None)
+                return r
+            valid = r.validity if r.validity is not None else \
+                jnp.ones(ctx.capacity, bool)
+            return ColV(dt.BOOLEAN, r.data, valid & r.data)
+        return r
+
+
+class AtLeastNNonNulls(Expression):
+    def __init__(self, n: int, children: List[Expression]):
+        super().__init__(children)
+        self.n = n
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        count = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+        for c in self.children:
+            v = c.eval(ctx)
+            if isinstance(v, Scalar):
+                if not v.is_null:
+                    count = count + 1
+                continue
+            nn = v.validity if v.validity is not None else None
+            if v.dtype.is_floating:
+                not_nan = ~jnp.isnan(v.data)
+                nn = not_nan if nn is None else (nn & not_nan)
+            count = count + (nn.astype(jnp.int32) if nn is not None else 1)
+        return ColV(dt.BOOLEAN, count >= self.n, None)

@@ -1,0 +1,7 @@
+"""Kernel surface: the TPU-native replacement for the cuDF JNI surface the
+reference consumes (SURVEY.md §2.9). Every relational kernel is a
+jit-compiled XLA computation over bucketed-capacity columns.
+
+Import submodules directly (spark_rapids_tpu.ops.groupby etc.) — this
+package init stays empty to avoid columnar<->ops import cycles.
+"""

@@ -1,0 +1,28 @@
+"""Capacity bucketing: the TPU-specific shape discipline.
+
+Everything under ``jax.jit`` is traced once per distinct input shape. cuDF
+allocates exact dynamically-sized buffers per kernel call (the reference
+leans on that everywhere); replaying that on XLA would recompile per batch
+size. Instead every device column is padded to a *bucketed capacity* — a
+small, fixed menu of sizes — and kernels carry the true row count as a
+device scalar, masking padding lanes. This bounds compilation to
+O(log(max_rows)) variants per kernel and keeps the last-dim/lane layout
+friendly (multiples of 128).
+
+Reference contrast: SURVEY.md §7 "Dynamic shapes vs XLA".
+"""
+from __future__ import annotations
+
+# TPU lane width; also keeps tiny arrays out of degenerate layouts.
+MIN_CAPACITY = 128
+
+
+def bucket_capacity(n: int) -> int:
+    """Smallest power-of-two capacity >= n (>= MIN_CAPACITY)."""
+    if n <= MIN_CAPACITY:
+        return MIN_CAPACITY
+    return 1 << (int(n - 1).bit_length())
+
+
+def is_bucketed(capacity: int) -> bool:
+    return capacity >= MIN_CAPACITY and (capacity & (capacity - 1)) == 0

@@ -1,0 +1,70 @@
+"""Batch concatenation (cuDF ``Table.concatenate`` analogue).
+
+Feeds the coalescing engine (GpuCoalesceBatches.scala:129-490). Row counts
+are realized host-side here — concatenation IS the batch boundary where the
+reference also materializes sizes. Output capacity is the bucket of the total
+row count; each input's live prefix is placed with ``dynamic_update_slice``.
+String columns are first re-encoded onto a unified dictionary.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, StringColumn, unify_dictionaries
+from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+
+def concat_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
+    batches = [b for b in batches if b is not None]
+    assert batches, "concat of zero batches"
+    if len(batches) == 1:
+        return batches[0]
+    ncols = batches[0].num_columns
+    counts = [b.realized_num_rows() for b in batches]
+    total = sum(counts)
+    out_cap = bucket_capacity(total)
+
+    out_cols: List[Column] = []
+    for ci in range(ncols):
+        cols = [b.columns[ci] for b in batches]
+        if isinstance(cols[0], StringColumn):
+            cols = unify_dictionaries(cols)  # type: ignore[arg-type]
+            dictionary = cols[0].dictionary
+        else:
+            dictionary = None
+        any_validity = any(c.validity is not None for c in cols)
+        data = jnp.zeros(out_cap, dtype=cols[0].data.dtype)
+        validity = jnp.zeros(out_cap, dtype=bool) if any_validity else None
+        off = 0
+        for c, n in zip(cols, counts):
+            if n == 0:
+                continue
+            src = c.with_capacity(out_cap)
+            data = _place(data, src.data, off, n)
+            if any_validity:
+                v = src.validity if src.validity is not None else \
+                    jnp.ones(out_cap, dtype=bool)
+                validity = _place(validity, v, off, n)
+            off += n
+        if dictionary is not None:
+            out_cols.append(StringColumn(data, dictionary, validity))
+        else:
+            out_cols.append(Column(cols[0].dtype, data, validity))
+    return ColumnarBatch(out_cols, total)
+
+
+@jax.jit
+def _place(dst: jax.Array, src: jax.Array, offset, n):
+    """Write src[0:n] into dst[offset:offset+n]. ``offset``/``n`` are traced
+    scalars, so one compilation serves every placement at a given capacity
+    (a single shifted gather + select — no dynamic shapes)."""
+    cap = dst.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int64) - offset
+    vals = jnp.take(src, jnp.clip(idx, 0, cap - 1))
+    mask = (idx >= 0) & (idx < n)
+    return jnp.where(mask, vals, dst)

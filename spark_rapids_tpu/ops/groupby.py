@@ -1,0 +1,253 @@
+"""Group-by aggregation: sort-based segmented reduction.
+
+cuDF gives the reference a hash-based ``groupBy.aggregate``
+(aggregate.scala:810-890). TPUs have no device hash tables, but XLA's sort is
+fast, so the TPU-native plan is:
+
+  1. stable lexsort rows by group keys (nulls group together; NaN==NaN and
+     -0.0==0.0 per Spark grouping semantics — sortkeys.equality_normalize),
+  2. mark segment boundaries where any key differs from the previous row,
+  3. ``segment_id = cumsum(boundary)-1``; padding rows park in a reserved
+     segment that is never emitted,
+  4. every aggregate becomes one ``jax.ops.segment_{sum,min,max}`` — XLA
+     fuses all of them over a single pass,
+  5. group keys gather from each segment's first row; the group count is a
+     device scalar (no host sync until the consumer needs it).
+
+Both halves of the reference's CudfAggregate split (update-from-raw and
+merge-of-partials, AggregateFunctions.scala) map onto the same kernel with
+different op lists — partial results are just another batch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+from spark_rapids_tpu.ops import sortkeys
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+# Aggregate op names understood by the kernel.
+AGG_OPS = ("sum", "min", "max", "count", "count_star", "first", "last",
+           "any_valid", "sum_of_squares")
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregation: op name + input ordinal (ignored for count_star).
+    ``count`` counts valid rows of the input; ``first``/``last`` take the
+    boundary row of each run (Spark first/last with ignoreNulls=False)."""
+
+    op: str
+    ordinal: int = -1
+
+
+def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
+                      aggs: List[AggSpec], dtypes: List[dt.DType]
+                      ) -> Tuple[ColumnarBatch, List[dt.DType]]:
+    """Returns (result batch [keys..., agg results...], result dtypes)."""
+    cols = [(c.data, c.validity) for c in batch.columns]
+    out = _groupby(cols, tuple(dtypes), tuple(key_ordinals), tuple(aggs),
+                   batch.num_rows_device())
+    (key_d, key_v), (agg_d, agg_v), num_groups = out
+    out_cols: List[Column] = []
+    out_types: List[dt.DType] = []
+    for i, ord_ in enumerate(key_ordinals):
+        src = batch.columns[ord_]
+        out_cols.append(src._like(key_d[i], key_v[i]))
+        out_types.append(dtypes[ord_])
+    for i, spec in enumerate(aggs):
+        rtype = agg_result_dtype(spec, dtypes)
+        if rtype is dt.STRING and spec.ordinal >= 0 and \
+                isinstance(batch.columns[spec.ordinal], StringColumn):
+            # preserve the dictionary: codes order == string order, so
+            # min/max/first/last on codes are min/max/first/last on strings
+            out_cols.append(
+                batch.columns[spec.ordinal]._like(agg_d[i], agg_v[i]))
+        else:
+            out_cols.append(Column(rtype, agg_d[i], agg_v[i]))
+        out_types.append(rtype)
+    return ColumnarBatch(out_cols, num_groups), out_types
+
+
+def agg_result_dtype(spec: AggSpec, dtypes: List[dt.DType]) -> dt.DType:
+    if spec.op in ("count", "count_star"):
+        return dt.INT64
+    in_t = dtypes[spec.ordinal]
+    if spec.op == "sum":
+        # Spark: sum over integrals -> bigint, over fractionals -> double
+        return dt.INT64 if in_t.is_integral or in_t is dt.BOOLEAN \
+            else dt.FLOAT64
+    if spec.op == "sum_of_squares":
+        return dt.FLOAT64
+    return in_t  # min/max/first/last/any_valid preserve type
+
+
+@partial(jax.jit, static_argnames=("dtypes", "key_ordinals", "aggs"))
+def _groupby(cols, dtypes, key_ordinals, aggs, num_rows):
+    capacity = cols[0][0].shape[0]
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+
+    # 1. sort by keys (ascending, nulls first — any consistent order works)
+    specs = [SortKeySpec(o, True, True) for o in key_ordinals]
+    order = sortkeys.lexsort_indices(list(cols), list(dtypes), specs,
+                                     num_rows)
+    sorted_cols = [(jnp.take(d, order),
+                    None if v is None else jnp.take(v, order))
+                   for d, v in cols]
+    live_sorted = live  # live rows are a prefix after the pad-last sort
+
+    # 2. boundaries: any normalized key differs from previous row
+    boundary = jnp.zeros(capacity, dtype=bool).at[0].set(True)
+    for o in key_ordinals:
+        d, v = sorted_cols[o]
+        comps, valid = sortkeys.equality_parts(d, v, dtypes[o])
+        for comp in comps:
+            boundary = boundary | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), comp[1:] != comp[:-1]])
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones(1, dtype=bool), valid[1:] != valid[:-1]])
+    boundary = boundary & live_sorted
+
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    num_groups = jnp.sum(boundary).astype(jnp.int32)
+    # park padding rows in the last segment slot; since num_groups <=
+    # num_rows < capacity whenever padding exists, slot capacity-1 is free
+    seg = jnp.where(live_sorted, seg, capacity - 1)
+
+    # boundary row index of each segment (for keys / first), and segment
+    # end row (for last)
+    first_idx = jnp.nonzero(boundary, size=capacity, fill_value=0)[0]
+    seg_sizes = jax.ops.segment_sum(live_sorted.astype(jnp.int32), seg,
+                                    num_segments=capacity)
+    last_idx = first_idx + jnp.maximum(seg_sizes, 1) - 1
+
+    # 3. keys: gather first row of each segment
+    key_d, key_v = [], []
+    group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+    for o in key_ordinals:
+        d, v = sorted_cols[o]
+        key_d.append(jnp.take(d, first_idx))
+        if v is None:
+            key_v.append(None)
+        else:
+            key_v.append(jnp.take(v, first_idx) & group_live)
+
+    # 4. aggregates
+    agg_d, agg_v = [], []
+    for spec in aggs:
+        d_out, v_out = _one_agg(spec, sorted_cols, dtypes, seg, live_sorted,
+                                first_idx, last_idx, seg_sizes, capacity)
+        agg_d.append(d_out)
+        agg_v.append(None if v_out is None else v_out & group_live)
+    return (key_d, key_v), (agg_d, agg_v), num_groups
+
+
+def _one_agg(spec: AggSpec, sorted_cols, dtypes, seg, live, first_idx,
+             last_idx, seg_sizes, capacity):
+    if spec.op == "count_star":
+        return seg_sizes.astype(jnp.int64), None
+
+    d, v = sorted_cols[spec.ordinal]
+    valid = v if v is not None else jnp.ones(capacity, dtype=bool)
+    contrib = valid & live
+    n_valid = jax.ops.segment_sum(contrib.astype(jnp.int64), seg,
+                                  num_segments=capacity)
+
+    if spec.op == "count":
+        return n_valid, None
+    if spec.op == "first":
+        out = jnp.take(d, first_idx)
+        ov = jnp.take(valid, first_idx) if v is not None else None
+        return out, ov
+    if spec.op == "last":
+        out = jnp.take(d, last_idx)
+        ov = jnp.take(valid, last_idx) if v is not None else None
+        return out, ov
+
+    out_valid = n_valid > 0
+    in_t = dtypes[spec.ordinal]
+    if spec.op == "sum":
+        acc_t = jnp.int64 if (in_t.is_integral or in_t is dt.BOOLEAN) \
+            else jnp.float64
+        x = jnp.where(contrib, d.astype(acc_t), jnp.zeros((), acc_t))
+        return jax.ops.segment_sum(x, seg, num_segments=capacity), out_valid
+    if spec.op == "sum_of_squares":
+        x = d.astype(jnp.float64)
+        x = jnp.where(contrib, x * x, 0.0)
+        return jax.ops.segment_sum(x, seg, num_segments=capacity), out_valid
+    if spec.op in ("min", "max"):
+        kd = d.dtype
+        if in_t.is_floating:
+            big = jnp.asarray(jnp.inf, kd)
+        elif in_t is dt.BOOLEAN:
+            d = d.astype(jnp.int8)
+            kd = jnp.int8
+            big = jnp.asarray(1, kd)
+        else:
+            big = jnp.asarray(jnp.iinfo(kd).max, kd)
+        if spec.op == "min":
+            x = jnp.where(contrib, d, big)
+            r = jax.ops.segment_min(x, seg, num_segments=capacity)
+        else:
+            small = -big if in_t.is_floating else \
+                jnp.asarray(0, kd) if in_t is dt.BOOLEAN else \
+                jnp.asarray(jnp.iinfo(kd).min, kd)
+            x = jnp.where(contrib, d, small)
+            r = jax.ops.segment_max(x, seg, num_segments=capacity)
+        if in_t is dt.BOOLEAN:
+            r = r.astype(jnp.bool_)
+        return r, out_valid
+    if spec.op == "any_valid":
+        out = jnp.take(d, first_idx)
+        return out, out_valid
+    raise ValueError(f"unknown aggregate op {spec.op}")
+
+
+def reduce_aggregate(batch: ColumnarBatch, aggs: List[AggSpec],
+                     dtypes: List[dt.DType]) -> Tuple[ColumnarBatch, List[dt.DType]]:
+    """Whole-batch reduction (no keys): grand aggregates
+    (aggregate.scala:488-501 reduction path). Returns a 1-row batch."""
+    if not batch.columns:
+        # rows-only batch: only count(*) is expressible
+        n = batch.realized_num_rows()
+        out_cols = [Column(dt.INT64,
+                           jnp.full(128, n, dtype=jnp.int64))
+                    for spec in aggs]
+        return ColumnarBatch(out_cols, 1), [dt.INT64] * len(aggs)
+    cols = [(c.data, c.validity) for c in batch.columns]
+    agg_d, agg_v = _reduce(cols, tuple(dtypes), tuple(aggs),
+                           batch.num_rows_device())
+    out_cols, out_types = [], []
+    for i, spec in enumerate(aggs):
+        rtype = agg_result_dtype(spec, dtypes)
+        out_cols.append(Column(rtype, agg_d[i], agg_v[i]))
+        out_types.append(rtype)
+    return ColumnarBatch(out_cols, 1), out_types
+
+
+@partial(jax.jit, static_argnames=("dtypes", "aggs"))
+def _reduce(cols, dtypes, aggs, num_rows):
+    capacity = cols[0][0].shape[0] if cols else 128
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    seg = jnp.where(live, 0, 1).astype(jnp.int32)
+    # reuse the segmented kernel with a single segment
+    boundary_first = jnp.zeros(capacity, dtype=jnp.int32)
+    n_live = jnp.sum(live.astype(jnp.int32)).astype(jnp.int32)
+    first_idx = boundary_first  # all zeros: segment 0 starts at row 0
+    last_idx = jnp.maximum(n_live - 1, 0) * jnp.ones(capacity, jnp.int32)
+    seg_sizes = jnp.zeros(capacity, jnp.int32).at[0].set(n_live)
+    agg_d, agg_v = [], []
+    for spec in aggs:
+        d_out, v_out = _one_agg(spec, list(cols), dtypes, seg, live,
+                                first_idx, last_idx, seg_sizes, capacity)
+        # only slot 0 is meaningful; broadcast capacity stays bucketed
+        agg_d.append(d_out)
+        agg_v.append(v_out)
+    return agg_d, agg_v

@@ -1,0 +1,112 @@
+"""64-bit hashing of key columns.
+
+Used by hash partitioning (GpuHashPartitioning analogue) and the
+sort-of-hashes equi-join. Requirements:
+
+- deterministic across processes and batches (shuffle routes rows of the
+  same key to the same partition regardless of which host hashed them),
+- dictionary-independent for strings: we hash string *content* host-side
+  once per dictionary entry (dictionaries are tiny vs rows) and gather by
+  code on device — the device never touches variable-length bytes,
+- NaN == NaN and -0.0 == 0.0 hash equal (grouping semantics).
+
+Mixing is splitmix64, a well-known public-domain finalizer.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import StringColumn
+from spark_rapids_tpu.ops import sortkeys
+
+_NULL_HASH = np.int64(42)  # Spark HashPartitioning leaves the seed for nulls
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def fnv1a64(s: str) -> int:
+    """Deterministic string hash (host-side, per dictionary entry)."""
+    h = _FNV_OFFSET
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h - (1 << 64) if h >= (1 << 63) else h
+
+
+def dict_hashes(col: StringColumn) -> np.ndarray:
+    """int64 content-hash per dictionary entry (cached on the column)."""
+    cached = getattr(col, "_dict_hashes", None)
+    if cached is not None and len(cached) == len(col.dictionary):
+        return cached
+    h = np.array([fnv1a64(str(s)) for s in col.dictionary], dtype=np.int64) \
+        if len(col.dictionary) else np.zeros(1, dtype=np.int64)
+    try:
+        object.__setattr__(col, "_dict_hashes", h)
+    except (AttributeError, TypeError):
+        pass
+    return h
+
+
+def _splitmix64(x: jax.Array) -> jax.Array:
+    # logical shifts require unsigned; int ops wrap two's-complement either way
+    z = x.astype(jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> jnp.uint64(31))
+    return z.astype(jnp.int64)
+
+
+def _numeric_to_int64(data: jax.Array, dtype: dt.DType) -> jax.Array:
+    """Deterministic int64 image of a value with NaN==NaN, -0.0==0.0.
+
+    f64 cannot be bitcast on TPU (X64 rewrite limitation); instead split it
+    into (f32 head, f32 residual) — an exact, deterministic decomposition —
+    and bitcast each half as 32-bit."""
+    if dtype is dt.FLOAT64:
+        x = sortkeys.canonicalize_floats(data)
+        hi = x.astype(jnp.float32)
+        lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+        lo = sortkeys.canonicalize_floats(lo)  # NaN residue canonical too
+        hi_i = jax.lax.bitcast_convert_type(hi, jnp.int32).astype(jnp.int64)
+        lo_i = jax.lax.bitcast_convert_type(lo, jnp.int32).astype(jnp.int64)
+        return (hi_i << 32) | (lo_i & jnp.int64(0xFFFFFFFF))
+    if dtype is dt.FLOAT32:
+        x = sortkeys.canonicalize_floats(data)
+        return jax.lax.bitcast_convert_type(x, jnp.int32).astype(jnp.int64)
+    return data.astype(jnp.int64)
+
+
+def hash_columns(batch: ColumnarBatch, key_ordinals: List[int],
+                 dtypes: List[dt.DType]) -> jax.Array:
+    """int64 combined hash of the key columns for every row."""
+    normalized: List[Tuple[jax.Array, jax.Array]] = []
+    for o in key_ordinals:
+        c = batch.columns[o]
+        if isinstance(c, StringColumn):
+            h_tab = jnp.asarray(dict_hashes(c))
+            val = jnp.take(h_tab, c.data, mode="clip")
+        else:
+            val = _numeric_to_int64(c.data, dtypes[o])
+        valid = c.validity
+        if valid is None:
+            valid = jnp.ones(c.capacity, dtype=bool)
+        normalized.append((jnp.where(valid, val, jnp.int64(_NULL_HASH)),
+                           valid))
+    vals = tuple(v for v, _ in normalized)
+    return _combine(vals)
+
+
+@jax.jit
+def _combine(vals: Tuple[jax.Array, ...]) -> jax.Array:
+    h = jnp.full(vals[0].shape, jnp.int64(0x2545F491), dtype=jnp.int64) \
+        if vals else None
+    for v in vals:
+        h = _splitmix64(h ^ v)
+    return h

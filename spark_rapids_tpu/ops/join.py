@@ -1,0 +1,251 @@
+"""Equi-joins: sorted-hash probe with exact verification.
+
+The reference drives cuDF's hash joins (GpuHashJoin.scala:302-318:
+inner/left/leftSemi/leftAnti/full). TPUs have no device hash tables; the
+TPU-native design uses *sorted hashes + searchsorted*:
+
+  build:  h_b = hash64(keys);  sort build rows by h_b           (one sort)
+  probe:  h_p = hash64(keys);  lo/hi = searchsorted(h_b, h_p)   (binary search)
+  expand: pair k -> (probe_row i, build_row lo[i] + k-offset[i]) via one
+          searchsorted over the match-count prefix sum
+  verify: exact key equality per pair kills hash collisions; compaction
+          drops dead pairs.
+
+The expansion capacity is data-dependent: the only host sync in the kernel
+realizes the total match count, mirroring where cuDF also sizes its output.
+Null join keys never match (SQL equi-join semantics); the reference filters
+them too (GpuHashJoin.scala:134-193) — here build/probe nulls get disjoint
+hash sentinels so they cannot collide with anything.
+
+Join conditions beyond the equi-keys are applied by the exec layer as a
+post-join filter, same as the reference (GpuHashJoin.scala:285-291).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, StringColumn, unify_dictionaries
+from spark_rapids_tpu.ops import hashing, sortkeys
+from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+_BUILD_NULL = jnp.int64(-0x6789ABCDEF01)
+_PROBE_NULL = jnp.int64(0x13579BDF2468)
+
+JOIN_TYPES = ("inner", "left", "right", "leftsemi", "leftanti", "full",
+              "cross")
+
+
+def _key_hashes(batch: ColumnarBatch, ordinals: List[int],
+                dtypes: List[dt.DType], null_sentinel) -> jax.Array:
+    h = hashing.hash_columns(batch, ordinals, dtypes)
+    any_null = None
+    for o in ordinals:
+        v = batch.columns[o].validity
+        if v is not None:
+            nn = ~v
+            any_null = nn if any_null is None else (any_null | nn)
+    if any_null is not None:
+        h = jnp.where(any_null, null_sentinel, h)
+    return h
+
+
+def unify_join_strings(left: ColumnarBatch, right: ColumnarBatch,
+                       left_keys: List[int], right_keys: List[int]
+                       ) -> Tuple[ColumnarBatch, ColumnarBatch]:
+    """String key columns must share dictionaries so code equality means
+    string equality."""
+    lcols, rcols = list(left.columns), list(right.columns)
+    for lo, ro in zip(left_keys, right_keys):
+        lc, rc = lcols[lo], rcols[ro]
+        if isinstance(lc, StringColumn) and isinstance(rc, StringColumn):
+            u = unify_dictionaries([lc, rc])
+            lcols[lo], rcols[ro] = u[0], u[1]
+    return (ColumnarBatch(lcols, left.num_rows),
+            ColumnarBatch(rcols, right.num_rows))
+
+
+def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
+              stream_keys: List[int], build_keys: List[int],
+              stream_types: List[dt.DType], build_types: List[dt.DType],
+              join_type: str = "inner"
+              ) -> Tuple[ColumnarBatch, List[dt.DType]]:
+    """Join ``stream`` (probe/left) against ``build`` (right). Output columns:
+    stream columns then build columns (semi/anti: stream only). ``right``
+    joins are planned as flipped ``left`` by the exec layer."""
+    assert join_type in ("inner", "left", "leftsemi", "leftanti", "full")
+    stream, build = unify_join_strings(stream, build, stream_keys, build_keys)
+
+    h_b = _key_hashes(build, build_keys, build_types, _BUILD_NULL)
+    h_p = _key_hashes(stream, stream_keys, stream_types, _PROBE_NULL)
+
+    # ---- phase 1 (device): sort build, bound-search, count matches
+    b_datas = [c.data for c in build.columns]
+    b_vals = [c.validity for c in build.columns]
+    (sb_h, sb_datas, sb_vals, lo, hi, counts, total) = _probe_counts(
+        b_datas, b_vals, h_b, build.num_rows_device(),
+        [c.data for c in stream.columns], h_p, stream.num_rows_device())
+
+    # ---- the one host sync: candidate-pair count -> output capacity
+    total_i = int(jax.device_get(total))
+    out_cap = bucket_capacity(max(total_i, 1))
+
+    sorted_build_cols = [c._like(d, v) for c, d, v in
+                         zip(build.columns, sb_datas, sb_vals)]
+    sorted_build = ColumnarBatch(sorted_build_cols, build.num_rows)
+
+    # ---- phase 2 (device): expand pairs, verify exact equality
+    key_pairs = tuple(
+        (stream.columns[so].data,
+         stream.columns[so].validity,
+         sorted_build.columns[bo].data,
+         sorted_build.columns[bo].validity)
+        for so, bo in zip(stream_keys, build_keys))
+    key_types = tuple(stream_types[so] for so in stream_keys)
+    pi, bi, match = _expand_verify(lo, hi, counts, total, key_pairs,
+                                   key_types, out_cap)
+
+    return _emit(stream, sorted_build, stream_types, build_types,
+                 pi, bi, match, total, join_type, out_cap)
+
+
+@jax.jit
+def _probe_counts(b_datas, b_vals, h_b, b_rows, s_datas, h_p, s_rows):
+    b_cap = h_b.shape[0]
+    live_b = jnp.arange(b_cap, dtype=jnp.int32) < b_rows
+    # push padding rows to the top of the sort with the max key
+    h_b_l = jnp.where(live_b, h_b, jnp.int64(2 ** 62))
+    order = jnp.argsort(h_b_l, stable=True)
+    sb_h = jnp.take(h_b_l, order)
+    sb_datas = [jnp.take(d, order) for d in b_datas]
+    sb_vals = [None if v is None else jnp.take(v, order) for v in b_vals]
+
+    s_cap = h_p.shape[0]
+    live_p = jnp.arange(s_cap, dtype=jnp.int32) < s_rows
+    lo = jnp.searchsorted(sb_h, h_p, side="left")
+    hi = jnp.searchsorted(sb_h, h_p, side="right")
+    # clamp hi to live build rows (padding key 2**62 never matches a real
+    # hash, but belt-and-braces if a hash equals the sentinel)
+    counts = jnp.where(live_p, hi - lo, 0).astype(jnp.int64)
+    total = jnp.sum(counts)
+    return sb_h, sb_datas, sb_vals, lo, hi, counts, total
+
+
+@partial(jax.jit, static_argnames=("key_types", "out_cap"))
+def _expand_verify(lo, hi, counts, total, key_pairs, key_types,
+                   out_cap: int):
+    """pair k in [0,out_cap): probe row pi[k], build row bi[k], and whether
+    the pair is live and exactly key-equal."""
+    offsets = jnp.cumsum(counts)  # inclusive
+    k = jnp.arange(out_cap, dtype=jnp.int64)
+    pi = jnp.searchsorted(offsets, k, side="right").astype(jnp.int32)
+    pi_c = jnp.clip(pi, 0, lo.shape[0] - 1)
+    excl = offsets - counts  # exclusive prefix
+    bi = (jnp.take(lo, pi_c) + (k - jnp.take(excl, pi_c))).astype(jnp.int32)
+    live_pair = k < total
+    match = live_pair
+    for (sd, sv, bd, bv), t in zip(key_pairs, key_types):
+        s_comps, s_valid = sortkeys.equality_parts(sd, sv, t)
+        b_comps, b_valid = sortkeys.equality_parts(bd, bv, t)
+        bi_c = jnp.clip(bi, 0, bd.shape[0] - 1)
+        match = match & jnp.take(s_valid, pi_c) & jnp.take(b_valid, bi_c)
+        for sc, bc in zip(s_comps, b_comps):
+            match = match & (jnp.take(sc, pi_c) == jnp.take(bc, bi_c))
+    return pi_c, bi, match
+
+
+def _emit(stream: ColumnarBatch, build: ColumnarBatch,
+          stream_types, build_types, pi, bi, match, total, join_type: str,
+          out_cap: int) -> Tuple[ColumnarBatch, List[dt.DType]]:
+    s_rows = stream.num_rows_device()
+    s_cap = stream.capacity
+
+    if join_type in ("leftsemi", "leftanti"):
+        matched = _probe_matched(pi, match, s_cap)
+        live_s = jnp.arange(s_cap, dtype=jnp.int32) < s_rows
+        keep = (matched if join_type == "leftsemi" else ~matched) & live_s
+        from spark_rapids_tpu.ops.filter import compact_batch
+        out = compact_batch(stream, keep)
+        return out, list(stream_types)
+
+    # matched pairs, compacted
+    order = jnp.argsort(~match, stable=True)
+    n_match = jnp.sum(match).astype(jnp.int32)
+    pi_s = jnp.take(pi, order)
+    bi_s = jnp.take(bi, order)
+    pair_live = jnp.arange(out_cap, dtype=jnp.int32) < n_match
+
+    cols: List[Column] = []
+    for c in stream.columns:
+        cols.append(c.gather(pi_s, in_bounds_mask=None))
+    for c in build.columns:
+        cols.append(c.gather(bi_s, in_bounds_mask=None))
+    inner = ColumnarBatch(cols, n_match)
+    out_types = list(stream_types) + list(build_types)
+
+    if join_type == "inner":
+        return inner, out_types
+
+    # left/full: append unmatched stream rows with null build side
+    matched = _probe_matched(pi, match, s_cap)
+    live_s = jnp.arange(s_cap, dtype=jnp.int32) < s_rows
+    from spark_rapids_tpu.ops.concat import concat_batches
+    from spark_rapids_tpu.ops.filter import compact_batch
+
+    unmatched_keep = (~matched) & live_s
+    un_stream = compact_batch(stream, unmatched_keep)
+    null_build = [Column.all_null(t, un_stream.capacity)
+                  for t in build_types]
+    left_extra = ColumnarBatch(list(un_stream.columns) + null_build,
+                               un_stream.num_rows)
+    pieces = [inner, left_extra]
+
+    if join_type == "full":
+        b_rows = build.num_rows_device()
+        b_cap = build.capacity
+        bmatched = _build_matched(bi, match, b_cap)
+        live_b = jnp.arange(b_cap, dtype=jnp.int32) < b_rows
+        un_build = compact_batch(build, (~bmatched) & live_b)
+        null_stream = [Column.all_null(t, un_build.capacity)
+                       for t in stream_types]
+        pieces.append(ColumnarBatch(null_stream + list(un_build.columns),
+                                    un_build.num_rows))
+    return concat_batches(pieces), out_types
+
+
+@partial(jax.jit, static_argnames=("s_cap",))
+def _probe_matched(pi, match, s_cap: int):
+    return jax.ops.segment_max(match.astype(jnp.int32),
+                               pi, num_segments=s_cap) > 0
+
+
+@partial(jax.jit, static_argnames=("b_cap",))
+def _build_matched(bi, match, b_cap: int):
+    bi_c = jnp.where(match, bi, b_cap)  # dead pairs park out of range
+    return jax.ops.segment_max(
+        match.astype(jnp.int32), jnp.clip(bi_c, 0, b_cap),
+        num_segments=b_cap + 1)[:b_cap] > 0
+
+
+def cross_join(stream: ColumnarBatch, build: ColumnarBatch,
+               stream_types, build_types
+               ) -> Tuple[ColumnarBatch, List[dt.DType]]:
+    """Brute-force cartesian product (GpuCartesianProductExec analogue —
+    disabled by default at the planner, GpuOverrides.scala:1841-1856)."""
+    n_s = stream.realized_num_rows()
+    n_b = build.realized_num_rows()
+    total = n_s * n_b
+    out_cap = bucket_capacity(max(total, 1))
+    k = jnp.arange(out_cap, dtype=jnp.int64)
+    pi = (k // max(n_b, 1)).astype(jnp.int32)
+    bi = (k % max(n_b, 1)).astype(jnp.int32)
+    cols = [c.gather(pi) for c in stream.columns] + \
+           [c.gather(bi) for c in build.columns]
+    return (ColumnarBatch(cols, total),
+            list(stream_types) + list(build_types))

@@ -1,0 +1,158 @@
+"""Device-side partitioning + contiguous split.
+
+Replaces the cuDF ``Table.partition``/``contiguousSplit`` pair driven by the
+reference's partitioners (GpuPartitioning.scala:44-70, GpuHashPartitioning,
+GpuRoundRobinPartitioning, GpuRangePartitioning, GpuSinglePartitioning).
+
+The kernel: compute a partition id per row, stable-sort rows by it (one XLA
+sort), and compute per-partition counts with one segment_sum. The sorted
+batch plus host-realized offsets is the analogue of a contiguous split —
+each partition is a contiguous row range ready for slicing/serialization.
+Range partitioning samples bounds host-side exactly like the reference
+(GpuRangePartitioner.scala:42-95: CPU-sampled bounds, then device slice).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.ops import hashing, sortkeys
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+
+def hash_partition(batch: ColumnarBatch, key_ordinals: List[int],
+                   dtypes: List[dt.DType], num_partitions: int
+                   ) -> Tuple[ColumnarBatch, np.ndarray]:
+    """Returns (rows sorted by partition id, int64 counts[num_partitions])."""
+    h = hashing.hash_columns(batch, key_ordinals, dtypes)
+    pid = _pmod(h, num_partitions)
+    return _split_by_pid(batch, pid, num_partitions)
+
+
+def round_robin_partition(batch: ColumnarBatch, num_partitions: int,
+                          start: int = 0) -> Tuple[ColumnarBatch, np.ndarray]:
+    pid = (jnp.arange(batch.capacity, dtype=jnp.int32) + start) \
+        % num_partitions
+    return _split_by_pid(batch, pid, num_partitions)
+
+
+def single_partition(batch: ColumnarBatch) -> Tuple[ColumnarBatch, np.ndarray]:
+    return batch, np.array([batch.realized_num_rows()], dtype=np.int64)
+
+
+def range_partition(batch: ColumnarBatch, specs: List[SortKeySpec],
+                    dtypes: List[dt.DType], bounds_values: np.ndarray,
+                    num_partitions: int) -> Tuple[ColumnarBatch, np.ndarray]:
+    """``bounds_values``: (num_partitions-1,) boundary *values* in the key's
+    own domain (strings as str), sampled host-side once per exchange —
+    exactly the reference's CPU-sampled-bounds design
+    (GpuRangePartitioner.scala:42-95). Single-key ranges; the planner falls
+    back for multi-key range partitioning."""
+    from spark_rapids_tpu.columnar.column import StringColumn
+
+    spec = specs[0]
+    col = batch.columns[spec.ordinal]
+    t = dtypes[spec.ordinal]
+    last = num_partitions - 1
+    if isinstance(col, StringColumn):
+        # map string bounds into this batch's code space
+        code_bounds = np.searchsorted(
+            col.dictionary.astype(str) if len(col.dictionary)
+            else np.array([], dtype=str),
+            np.asarray(bounds_values, dtype=str), side="left")
+        key = col.data
+        bounds = jnp.asarray(code_bounds.astype(np.int32))
+        if not spec.ascending:
+            key = -key
+            bounds = -jnp.asarray(code_bounds[::-1].astype(np.int32))
+        pid = jnp.searchsorted(bounds, key, side="right").astype(jnp.int32)
+    else:
+        vals = np.asarray(bounds_values, dtype=t.np_dtype)
+        key = col.data
+        if t.is_floating:
+            key = sortkeys.canonicalize_floats(key)
+        if not spec.ascending:
+            key = -key if (t.is_floating or t.is_numeric) else ~key
+            vals = -vals[::-1] if (t.is_floating or t.is_numeric) \
+                else np.bitwise_not(vals[::-1])
+        pid = jnp.searchsorted(jnp.asarray(vals), key,
+                               side="right").astype(jnp.int32)
+        if t.is_floating:
+            # NaN compares false everywhere; route it like "greatest"
+            nan_pid = last if spec.ascending else 0
+            pid = jnp.where(jnp.isnan(key), nan_pid, pid)
+    if col.validity is not None:
+        null_pid = 0 if spec.nulls_first else last
+        pid = jnp.where(col.validity, pid, null_pid)
+    return _split_by_pid(batch, pid, num_partitions)
+
+
+def sample_range_bounds(batch: ColumnarBatch, spec: SortKeySpec,
+                        dtypes: List[dt.DType], num_partitions: int
+                        ) -> np.ndarray:
+    """Host-side bounds sampling (GpuRangePartitioner analogue). Returns
+    boundary values in the key's own domain."""
+    col = batch.columns[spec.ordinal]
+    n = batch.realized_num_rows()
+    values, validity = col.to_numpy(n)
+    if validity is not None:
+        values = values[validity]
+    values = np.sort(values)
+    if len(values) == 0 or num_partitions <= 1:
+        return np.array([], dtype=object)
+    qs = [int(len(values) * (i + 1) / num_partitions)
+          for i in range(num_partitions - 1)]
+    picks = values[np.clip(qs, 0, len(values) - 1)]
+    return picks if spec.ascending else picks[::-1]
+
+
+def _pmod(h: jax.Array, n: int) -> jax.Array:
+    m = h % jnp.int64(n)
+    return jnp.where(m < 0, m + n, m).astype(jnp.int32)
+
+
+def _split_by_pid(batch: ColumnarBatch, pid: jax.Array, num_partitions: int
+                  ) -> Tuple[ColumnarBatch, np.ndarray]:
+    datas = [c.data for c in batch.columns]
+    validities = [c.validity for c in batch.columns]
+    out_d, out_v, counts = _partition_kernel(
+        datas, validities, pid, batch.num_rows_device(), num_partitions)
+    cols = [c._like(d, v) for c, d, v in zip(batch.columns, out_d, out_v)]
+    out = ColumnarBatch(cols, batch.num_rows)
+    return out, np.asarray(jax.device_get(counts))
+
+
+@partial(jax.jit, static_argnames=("num_partitions",))
+def _partition_kernel(datas, validities, pid, num_rows, num_partitions: int):
+    capacity = pid.shape[0]
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    # padding rows to a virtual partition that sorts last
+    pid_l = jnp.where(live, pid, num_partitions)
+    order = jnp.argsort(pid_l, stable=True)
+    counts = jax.ops.segment_sum(live.astype(jnp.int64), pid_l,
+                                 num_segments=num_partitions + 1)[:-1]
+    out_d = [jnp.take(d, order) for d in datas]
+    out_v = [None if v is None else jnp.take(v, order) for v in validities]
+    return out_d, out_v, counts
+
+
+def slice_partitions(batch: ColumnarBatch, counts: np.ndarray
+                     ) -> List[Optional[ColumnarBatch]]:
+    """Materialize each contiguous partition as its own (re-bucketed) batch;
+    empty partitions yield None (the caching writer skips them,
+    RapidsShuffleInternalManager.scala:120)."""
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    out: List[Optional[ColumnarBatch]] = []
+    for p in range(len(counts)):
+        n = int(counts[p])
+        if n == 0:
+            out.append(None)
+            continue
+        out.append(batch.slice(int(offsets[p]), n))
+    return out

@@ -1,0 +1,51 @@
+"""Sort kernels (cuDF ``Table.orderBy`` analogue, GpuSortExec.scala:104).
+
+One stable lexsort over int64 total-order keys (ops/sortkeys.py), then a
+gather of every payload column. XLA lowers to the TPU-native variadic sort.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.ops import sortkeys
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+
+@jax.jit
+def _gather_all(datas, validities, order):
+    out_d = [jnp.take(d, order) for d in datas]
+    out_v = [None if v is None else jnp.take(v, order) for v in validities]
+    return out_d, out_v
+
+
+def sort_batch(batch: ColumnarBatch, specs: List[SortKeySpec],
+               dtypes) -> ColumnarBatch:
+    cols = [(c.data, c.validity) for c in batch.columns]
+    order = _sort_indices(cols, tuple(dtypes), tuple(specs),
+                          batch.num_rows_device())
+    datas = [c.data for c in batch.columns]
+    validities = [c.validity for c in batch.columns]
+    out_d, out_v = _gather_all(datas, validities, order)
+    out_cols = [c._like(d, v)
+                for c, d, v in zip(batch.columns, out_d, out_v)]
+    return ColumnarBatch(out_cols, batch.num_rows)
+
+
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("dtypes", "specs"))
+def _sort_indices(cols, dtypes, specs, num_rows):
+    return sortkeys.lexsort_indices(list(cols), list(dtypes), list(specs),
+                                    num_rows)
+
+
+def sort_indices(batch: ColumnarBatch, specs: List[SortKeySpec],
+                 dtypes) -> jax.Array:
+    cols = [(c.data, c.validity) for c in batch.columns]
+    return _sort_indices(cols, tuple(dtypes), tuple(specs),
+                         batch.num_rows_device())

@@ -1,0 +1,124 @@
+"""Sort/equality key machinery shared by sort, groupby, join and partition.
+
+cuDF's ``Table.orderBy``/``groupBy`` handle null ordering, NaN and descending
+natively (reference: SortUtils.scala, GpuSortExec.scala:104). On TPU we reduce
+every key column to a small list of arrays fed to one stable ``lexsort`` —
+XLA lowers that to the native variadic sort HLO.
+
+TPU constraint worth recording: ``bitcast_convert`` on f64 is not supported
+by XLA's X64-rewriting pass on TPU (f64 is emulated as a float pair), so the
+classic "bitcast float to int, twist sign" total-order key is *not* used on
+device. Instead:
+
+- floats stay floats in the sort (jnp sort order places NaN last, which is
+  exactly Spark's "NaN greatest" for ascending); descending negates the
+  value and adds a small NaN-rank key (Spark: DESC puts NaN first);
+  -0.0 is normalized to +0.0 and NaNs canonicalized first,
+- equality (grouping/join) uses *component lists*: two rows are equal iff
+  all components compare equal — floats contribute (value-with-NaN-zeroed,
+  isnan) so NaN==NaN without any bitcast,
+- strings are dictionary codes (sorted dicts => order-isomorphic),
+- nulls get a leading rank key implementing NULLS FIRST/LAST,
+- padding rows (index >= num_rows) always sort last.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKeySpec:
+    """One ORDER BY term: column ordinal + direction + null ordering."""
+
+    ordinal: int
+    ascending: bool = True
+    nulls_first: bool = True  # Spark default: NULLS FIRST for ASC
+
+    @staticmethod
+    def spark_default(ordinal: int, ascending: bool = True) -> "SortKeySpec":
+        # Spark: ASC -> NULLS FIRST, DESC -> NULLS LAST
+        return SortKeySpec(ordinal, ascending, nulls_first=ascending)
+
+
+def canonicalize_floats(x: jax.Array) -> jax.Array:
+    """-0.0 -> +0.0 and all NaNs -> one canonical quiet NaN
+    (NormalizeFloatingNumbers analogue, reference
+    sql-plugin/.../NormalizeFloatingNumbers.scala)."""
+    x = x + jnp.zeros((), dtype=x.dtype)  # -0.0 + 0.0 == +0.0
+    return jnp.where(jnp.isnan(x), jnp.asarray(jnp.nan, dtype=x.dtype), x)
+
+
+def sort_key_arrays(data: jax.Array, validity: Optional[jax.Array],
+                    dtype: dt.DType, spec: SortKeySpec) -> List[jax.Array]:
+    """Key arrays for one ORDER BY term, most significant first."""
+    keys: List[jax.Array] = []
+    if validity is not None:
+        # valid rows rank 1 when nulls first, rank 0 when nulls last
+        rank = validity.astype(jnp.int32) if spec.nulls_first \
+            else (~validity).astype(jnp.int32)
+        keys.append(rank)
+    if dtype.is_floating:
+        x = canonicalize_floats(data)
+        if validity is not None:
+            x = jnp.where(validity, x, jnp.zeros((), x.dtype))
+        if spec.ascending:
+            # jnp/np sort order: NaN greatest — matches Spark ASC
+            keys.append(x)
+        else:
+            # DESC: NaN first => NaN-rank key ahead of the negated value
+            isn = jnp.isnan(x)
+            keys.append((~isn).astype(jnp.int32))
+            keys.append(jnp.where(isn, jnp.zeros((), x.dtype), -x))
+        return keys
+    if dtype is dt.BOOLEAN:
+        k = data.astype(jnp.int8)
+    else:
+        k = data
+    if validity is not None:
+        k = jnp.where(validity, k, jnp.zeros((), k.dtype))
+    if not spec.ascending:
+        k = ~k if k.dtype != jnp.int8 else -k.astype(jnp.int32)
+    keys.append(k)
+    return keys
+
+
+def lexsort_indices(cols: List[Tuple[jax.Array, Optional[jax.Array]]],
+                    dtypes: List[dt.DType],
+                    specs: List[SortKeySpec],
+                    num_rows: jax.Array) -> jax.Array:
+    """Stable permutation ordering live rows by ``specs``; padding rows sort
+    last. ``cols`` indexed by spec.ordinal."""
+    capacity = cols[0][0].shape[0]
+    pad_rank = (jnp.arange(capacity, dtype=jnp.int32) >=
+                num_rows).astype(jnp.int32)
+    # jnp.lexsort: LAST key is primary.
+    arrays: List[jax.Array] = []
+    for spec in reversed(specs):
+        data, validity = cols[spec.ordinal]
+        ks = sort_key_arrays(data, validity, dtypes[spec.ordinal], spec)
+        arrays.extend(reversed(ks))
+    arrays.append(pad_rank)
+    return jnp.lexsort(arrays)
+
+
+def equality_parts(data: jax.Array, validity: Optional[jax.Array],
+                   dtype: dt.DType) -> Tuple[List[jax.Array], jax.Array]:
+    """(components, valid): rows are grouping/join-equal iff their validity
+    matches and, when valid, every component compares equal. Implements
+    NaN == NaN and -0.0 == 0.0 (Spark grouping semantics) without f64
+    bitcasts."""
+    valid = validity if validity is not None else \
+        jnp.ones(data.shape[0], dtype=bool)
+    if dtype.is_floating:
+        x = canonicalize_floats(data)
+        isn = jnp.isnan(x)
+        xz = jnp.where(isn | ~valid, jnp.zeros((), x.dtype), x)
+        return [xz, isn & valid], valid
+    z = jnp.where(valid, data, jnp.zeros((), data.dtype))
+    return [z], valid

@@ -1,0 +1,62 @@
+"""Resource lifetime idioms.
+
+The reference threads every buffer through ``Arm.withResource`` /
+``closeOnExcept`` try-finally helpers (sql-plugin/.../Arm.scala:23-75) and
+``safeClose`` on collections (implicits.scala). Python has ``with``, but our
+catalog-managed buffers and batches are ref-counted and often owned across
+scopes, so we keep the same explicit idiom for anything exposing ``close()``.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Iterable, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def with_resource(resource: T, body: Callable[[T], R]) -> R:
+    """Run ``body(resource)`` and always close the resource (Arm.scala:26)."""
+    try:
+        return body(resource)
+    finally:
+        _close(resource)
+
+
+def close_on_except(resource: T, body: Callable[[T], R]) -> R:
+    """Close the resource only if ``body`` raises (Arm.scala:55)."""
+    try:
+        return body(resource)
+    except BaseException:
+        _close(resource)
+        raise
+
+
+def safe_close(resources: Iterable) -> None:
+    """Close every resource, raising the first error after closing all
+    (RapidsPluginImplicits.safeClose analogue)."""
+    first_err = None
+    for r in resources:
+        try:
+            _close(r)
+        except BaseException as e:  # noqa: BLE001
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
+
+
+def _close(resource) -> None:
+    if resource is None:
+        return
+    closer = getattr(resource, "close", None)
+    if closer is not None:
+        closer()
+
+
+@contextlib.contextmanager
+def closing(resource: T):
+    try:
+        yield resource
+    finally:
+        _close(resource)

@@ -1,0 +1,62 @@
+"""Tracing and metrics fusion.
+
+The reference wraps every operator and transport step in NVTX ranges and
+fuses a range with a SQLMetric timer (`NvtxWithMetrics`,
+sql-plugin/.../NvtxWithMetrics.scala:44). The TPU equivalents are
+``jax.profiler.TraceAnnotation`` spans (visible in xprof/tensorboard traces)
+fused with our operator metrics.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+try:
+    import jax.profiler as _jprof
+
+    _HAVE_PROFILER = True
+except Exception:  # pragma: no cover
+    _HAVE_PROFILER = False
+
+
+class Metric:
+    """A single operator metric (SQLMetric analogue, GpuExec.scala:90-96)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, v) -> None:
+        self.value += v
+
+    def set(self, v) -> None:
+        self.value = v
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Metric({self.name}={self.value})"
+
+
+@contextlib.contextmanager
+def TraceRange(name: str):
+    """Named profiler span (NvtxRange analogue)."""
+    if _HAVE_PROFILER:
+        with _jprof.TraceAnnotation(name):
+            yield
+    else:  # pragma: no cover
+        yield
+
+
+@contextlib.contextmanager
+def trace_with_metrics(name: str, metric: Optional[Metric] = None):
+    """Profiler span + nanosecond timer accumulated into ``metric``
+    (NvtxWithMetrics analogue)."""
+    start = time.perf_counter_ns()
+    try:
+        with TraceRange(name):
+            yield
+    finally:
+        if metric is not None:
+            metric.add(time.perf_counter_ns() - start)

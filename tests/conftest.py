@@ -1,0 +1,24 @@
+"""Test bootstrap: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective paths are
+validated on XLA's host platform with 8 virtual devices (the driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+import spark_rapids_tpu  # noqa: E402,F401  (enables x64 before jax use)
+
+
+@pytest.fixture(scope="session")
+def n_virtual_devices():
+    import jax
+
+    return len(jax.devices())

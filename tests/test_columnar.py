@@ -1,0 +1,94 @@
+"""Columnar core tests: Column/StringColumn/ColumnarBatch + host interop."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column, Scalar, StringColumn, \
+    unify_dictionaries
+from spark_rapids_tpu.columnar import hostcol
+from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 128
+    assert bucket_capacity(128) == 128
+    assert bucket_capacity(129) == 256
+    assert bucket_capacity(1000) == 1024
+
+
+def test_column_roundtrip_numeric():
+    vals = np.arange(10, dtype=np.int64) * 3
+    c = Column.from_numpy(vals)
+    assert c.dtype is dt.INT64
+    assert c.capacity == 128
+    out, validity = c.to_numpy(10)
+    np.testing.assert_array_equal(out, vals)
+    assert validity is None
+
+
+def test_column_nulls():
+    vals = np.array([1.5, 2.5, 3.5])
+    validity = np.array([True, False, True])
+    c = Column.from_numpy(vals, validity=validity)
+    out, v = c.to_numpy(3)
+    assert v is not None
+    np.testing.assert_array_equal(v, validity)
+    assert out[0] == 1.5 and out[2] == 3.5
+
+
+def test_string_column_sorted_dictionary():
+    c = StringColumn.from_strings(["banana", "apple", None, "cherry", "apple"])
+    # dictionary sorted => code order is lexicographic order
+    assert list(c.dictionary) == ["apple", "banana", "cherry"]
+    out, v = c.to_numpy(5)
+    assert list(out) == ["banana", "apple", None, "cherry", "apple"]
+
+
+def test_unify_dictionaries():
+    a = StringColumn.from_strings(["x", "z"])
+    b = StringColumn.from_strings(["y", "z"])
+    ua, ub = unify_dictionaries([a, b])
+    assert list(ua.dictionary) == ["x", "y", "z"] == list(ub.dictionary)
+    assert list(ua.to_numpy(2)[0]) == ["x", "z"]
+    assert list(ub.to_numpy(2)[0]) == ["y", "z"]
+
+
+def test_arrow_roundtrip():
+    table = pa.table({
+        "i": pa.array([1, 2, None], type=pa.int32()),
+        "d": pa.array([1.0, None, 3.0], type=pa.float64()),
+        "s": pa.array(["a", None, "c"]),
+        "b": pa.array([True, False, None]),
+    })
+    batch, schema = hostcol.from_arrow_table(table)
+    assert schema.names == ["i", "d", "s", "b"]
+    assert batch.realized_num_rows() == 3
+    back = hostcol.to_arrow_table(batch, schema)
+    assert back.to_pydict() == table.to_pydict()
+
+
+def test_rows_roundtrip():
+    schema = Schema(["a", "b"], [dt.INT64, dt.STRING])
+    rows = [(1, "x"), (None, "y"), (3, None)]
+    batch = hostcol.rows_to_columnar(rows, schema)
+    assert hostcol.columnar_to_rows(batch) == rows
+
+
+def test_batch_slice():
+    vals = np.arange(300, dtype=np.int64)
+    b = ColumnarBatch([Column.from_numpy(vals)], 300)
+    s = b.slice(100, 50)
+    assert s.realized_num_rows() == 50
+    out, _ = s.columns[0].to_numpy(50)
+    np.testing.assert_array_equal(out, np.arange(100, 150))
+
+
+def test_scalar_column():
+    c = Column.from_scalar(Scalar(dt.INT32, 7), 128)
+    out, _ = c.to_numpy(5)
+    np.testing.assert_array_equal(out, [7] * 5)
+    n = Column.from_scalar(Scalar(dt.INT32, None), 128)
+    _, v = n.to_numpy(5)
+    assert not v.any()

@@ -1,0 +1,326 @@
+"""Kernel-surface tests against numpy/pandas oracles (the reference's
+CPU-as-oracle methodology, SURVEY.md §4, applied per kernel)."""
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+from spark_rapids_tpu.ops import concat, filter as filt, groupby, hashing, \
+    join, partition, sort
+from spark_rapids_tpu.ops.groupby import AggSpec
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+
+def make_batch(*arrays, validities=None, n=None):
+    cols = []
+    for i, a in enumerate(arrays):
+        v = validities[i] if validities else None
+        if isinstance(a[0] if len(a) else "", str) or (
+                len(a) and a[0] is None and isinstance(a, list)):
+            cols.append(StringColumn.from_strings(list(a)))
+        else:
+            cols.append(Column.from_numpy(np.asarray(a), validity=v))
+    nn = n if n is not None else len(arrays[0])
+    return ColumnarBatch(cols, nn)
+
+
+# ---------------------------------------------------------------- filter
+
+def test_filter_compact():
+    b = make_batch(np.arange(10, dtype=np.int64))
+    keep = jnp.asarray(np.pad(np.arange(10) % 3 == 0, (0, 118)))
+    out = filt.compact_batch(b, keep)
+    assert out.realized_num_rows() == 4
+    vals, _ = out.columns[0].to_numpy(4)
+    np.testing.assert_array_equal(vals, [0, 3, 6, 9])
+
+
+def test_filter_null_predicate_drops():
+    b = make_batch(np.arange(4, dtype=np.int64))
+    keep = jnp.asarray(np.pad([True, True, False, True], (0, 124)))
+    keep_valid = jnp.asarray(np.pad([True, False, True, True], (0, 124)))
+    out = filt.compact_batch(b, keep, keep_valid)
+    vals, _ = out.columns[0].to_numpy(out.realized_num_rows())
+    np.testing.assert_array_equal(vals, [0, 3])
+
+
+# ---------------------------------------------------------------- sort
+
+def test_sort_two_keys_desc_nulls():
+    a = np.array([3, 1, 2, 1, 3], dtype=np.int64)
+    b = np.array([1.0, 2.0, np.nan, 1.0, -0.0])
+    bv = np.array([True, True, True, False, True])
+    batch = make_batch(a, b, validities=[None, bv])
+    specs = [SortKeySpec.spark_default(0, True),
+             SortKeySpec.spark_default(1, False)]  # b DESC -> nulls last
+    out = sort.sort_batch(batch, specs, [dt.INT64, dt.FLOAT64])
+    n = out.realized_num_rows()
+    av, _ = out.columns[0].to_numpy(n)
+    bvals, bval_v = out.columns[1].to_numpy(n)
+    np.testing.assert_array_equal(av, [1, 1, 2, 3, 3])
+    # a=1: b desc -> 2.0 then NULL(last); a=2: NaN; a=3: 1.0 then -0.0
+    assert bvals[0] == 2.0
+    assert bval_v is not None and not bval_v[1]
+    assert np.isnan(bvals[2])
+    assert bvals[3] == 1.0
+
+
+def test_sort_nan_sorts_greatest_asc():
+    x = np.array([np.nan, 1.0, -np.inf, np.inf, -1.0])
+    batch = make_batch(x)
+    out = sort.sort_batch(batch, [SortKeySpec.spark_default(0, True)],
+                          [dt.FLOAT64])
+    vals, _ = out.columns[0].to_numpy(5)
+    assert vals[0] == -np.inf and vals[3] == np.inf and np.isnan(vals[4])
+
+
+def test_sort_strings():
+    s = ["pear", "apple", None, "fig"]
+    batch = make_batch(s)
+    out = sort.sort_batch(batch, [SortKeySpec.spark_default(0, True)],
+                          [dt.STRING])
+    vals, _ = out.columns[0].to_numpy(4)
+    assert list(vals) == [None, "apple", "fig", "pear"]  # ASC nulls first
+
+
+# ---------------------------------------------------------------- groupby
+
+def test_groupby_sum_count_min_max():
+    keys = np.array([2, 1, 2, 1, 3, 2], dtype=np.int64)
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    vv = np.array([True, True, False, True, True, True])
+    batch = make_batch(keys, vals, validities=[None, vv])
+    out, out_types = groupby.groupby_aggregate(
+        batch, [0],
+        [AggSpec("sum", 1), AggSpec("count", 1), AggSpec("min", 1),
+         AggSpec("max", 1), AggSpec("count_star")],
+        [dt.INT64, dt.FLOAT64])
+    n = out.realized_num_rows()
+    assert n == 3
+    df = out.to_pandas()
+    df.columns = ["k", "sum", "cnt", "mn", "mx", "cs"]
+    df = df.sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(df["k"], [1, 2, 3])
+    np.testing.assert_array_equal(df["sum"], [6.0, 7.0, 5.0])
+    np.testing.assert_array_equal(df["cnt"], [2, 2, 1])
+    np.testing.assert_array_equal(df["mn"], [2.0, 1.0, 5.0])
+    np.testing.assert_array_equal(df["mx"], [4.0, 6.0, 5.0])
+    np.testing.assert_array_equal(df["cs"], [2, 3, 1])
+
+
+def test_groupby_null_keys_group_together():
+    keys = np.array([1, 0, 1, 0], dtype=np.int64)
+    kv = np.array([True, False, True, False])
+    vals = np.array([1, 2, 3, 4], dtype=np.int64)
+    batch = make_batch(keys, vals, validities=[kv, None])
+    out, _ = groupby.groupby_aggregate(batch, [0], [AggSpec("sum", 1)],
+                                       [dt.INT64, dt.INT64])
+    assert out.realized_num_rows() == 2
+    kvals, kvalid = out.columns[0].to_numpy(2)
+    sums, _ = out.columns[1].to_numpy(2)
+    # nulls-first grouping: first group is the null key
+    assert kvalid is not None and not kvalid[0]
+    assert sums[0] == 6 and sums[1] == 4
+
+
+def test_groupby_all_null_sum_is_null():
+    keys = np.array([1, 1], dtype=np.int64)
+    vals = np.array([0.0, 0.0])
+    vv = np.array([False, False])
+    batch = make_batch(keys, vals, validities=[None, vv])
+    out, _ = groupby.groupby_aggregate(batch, [0], [AggSpec("sum", 1)],
+                                       [dt.INT64, dt.FLOAT64])
+    _, sv = out.columns[1].to_numpy(1)
+    assert sv is not None and not sv[0]
+
+
+def test_groupby_string_keys():
+    s = ["b", "a", "b", None, "a", None]
+    vals = np.arange(6, dtype=np.int64)
+    batch = make_batch(s, vals)
+    out, _ = groupby.groupby_aggregate(batch, [0], [AggSpec("sum", 1)],
+                                       [dt.STRING, dt.INT64])
+    assert out.realized_num_rows() == 3
+    kvals, _ = out.columns[0].to_numpy(3)
+    sums, _ = out.columns[1].to_numpy(3)
+    m = dict(zip(kvals, sums))
+    assert m["a"] == 5 and m["b"] == 2 and m[None] == 8
+
+
+def test_reduce_grand_aggregate():
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    batch = make_batch(vals)
+    out, _ = groupby.reduce_aggregate(
+        batch, [AggSpec("sum", 0), AggSpec("count_star"),
+                AggSpec("min", 0)], [dt.FLOAT64])
+    assert out.realized_num_rows() == 1
+    assert out.columns[0].to_numpy(1)[0][0] == 10.0
+    assert out.columns[1].to_numpy(1)[0][0] == 4
+    assert out.columns[2].to_numpy(1)[0][0] == 1.0
+
+
+def test_groupby_nan_and_negzero_group():
+    keys = np.array([np.nan, np.nan, -0.0, 0.0])
+    vals = np.ones(4, dtype=np.int64)
+    batch = make_batch(keys, vals)
+    out, _ = groupby.groupby_aggregate(batch, [0], [AggSpec("count", 1)],
+                                       [dt.FLOAT64, dt.INT64])
+    assert out.realized_num_rows() == 2  # NaN==NaN, -0.0==0.0
+
+
+# ---------------------------------------------------------------- hashing
+
+def test_hash_deterministic_across_batches():
+    a1 = make_batch(np.array([1, 2, 3], dtype=np.int64))
+    a2 = make_batch(np.array([3, 2, 1], dtype=np.int64))
+    h1 = np.asarray(hashing.hash_columns(a1, [0], [dt.INT64]))[:3]
+    h2 = np.asarray(hashing.hash_columns(a2, [0], [dt.INT64]))[:3]
+    np.testing.assert_array_equal(h1, h2[::-1])
+
+
+def test_hash_strings_dictionary_independent():
+    s1 = make_batch(["apple", "kiwi"])
+    s2 = make_batch(["kiwi", "zebra", "apple"])
+    h1 = np.asarray(hashing.hash_columns(s1, [0], [dt.STRING]))
+    h2 = np.asarray(hashing.hash_columns(s2, [0], [dt.STRING]))
+    assert h1[1] == h2[0]  # kiwi hashes equal despite different dicts
+    assert h1[0] == h2[2]
+
+
+# ---------------------------------------------------------------- partition
+
+def test_hash_partition_routes_consistently():
+    k = np.array([5, 6, 5, 7, 6, 5], dtype=np.int64)
+    b = make_batch(k)
+    out, counts = partition.hash_partition(b, [0], [dt.INT64], 4)
+    assert counts.sum() == 6
+    parts = partition.slice_partitions(out, counts)
+    seen = {}
+    for p, pb in enumerate(parts):
+        if pb is None:
+            continue
+        vals, _ = pb.columns[0].to_numpy(pb.realized_num_rows())
+        for v in vals:
+            assert seen.setdefault(v, p) == p  # same key -> same partition
+    assert sum(counts) == 6
+
+
+def test_round_robin_partition():
+    b = make_batch(np.arange(10, dtype=np.int64))
+    out, counts = partition.round_robin_partition(b, 3)
+    assert counts.sum() == 10
+    assert sorted(counts.tolist(), reverse=True)[0] == 4
+
+
+# ---------------------------------------------------------------- concat
+
+def test_concat_batches():
+    b1 = make_batch(np.arange(5, dtype=np.int64))
+    b2 = make_batch(np.arange(5, 8, dtype=np.int64))
+    out = concat.concat_batches([b1, b2])
+    assert out.realized_num_rows() == 8
+    vals, _ = out.columns[0].to_numpy(8)
+    np.testing.assert_array_equal(vals, np.arange(8))
+
+
+def test_concat_strings_and_nulls():
+    b1 = make_batch(["a", "c"], np.array([1.0, 2.0]))
+    b2 = make_batch(["b", None], np.array([3.0, np.nan]),
+                    validities=[None, np.array([True, False])])
+    out = concat.concat_batches([b1, b2])
+    svals, _ = out.columns[0].to_numpy(4)
+    dvals, dv = out.columns[1].to_numpy(4)
+    assert list(svals) == ["a", "c", "b", None]
+    assert dv is not None and list(dv) == [True, True, True, False]
+
+
+# ---------------------------------------------------------------- join
+
+def _join_oracle(left, right, how):
+    l = pd.DataFrame({"k": left[0], "lv": left[1]})
+    r = pd.DataFrame({"k": right[0], "rv": right[1]})
+    return l.merge(r, on="k", how=how)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_equi_join_vs_pandas(how):
+    lk = np.array([1, 2, 3, 4, 2], dtype=np.int64)
+    lv = np.arange(5, dtype=np.int64)
+    rk = np.array([2, 2, 4, 5], dtype=np.int64)
+    rv = np.arange(10, 14, dtype=np.int64)
+    lb = make_batch(lk, lv)
+    rb = make_batch(rk, rv)
+    out, types = join.equi_join(lb, rb, [0], [0],
+                                [dt.INT64, dt.INT64], [dt.INT64, dt.INT64],
+                                how)
+    n = out.realized_num_rows()
+    got = out.to_pandas()
+    got.columns = ["k", "lv", "k2", "rv"]
+    got = got[["k", "lv", "rv"]].sort_values(["k", "lv", "rv"],
+                                             na_position="last"
+                                             ).reset_index(drop=True)
+    exp = _join_oracle((lk, lv), (rk, rv), how)[["k", "lv", "rv"]] \
+        .sort_values(["k", "lv", "rv"], na_position="last") \
+        .reset_index(drop=True)
+    assert len(got) == len(exp)
+    np.testing.assert_array_equal(got["k"].to_numpy(np.int64),
+                                  exp["k"].to_numpy(np.int64))
+    np.testing.assert_array_equal(
+        got["rv"].astype("float64").fillna(-1).to_numpy(),
+        exp["rv"].astype("float64").fillna(-1).to_numpy())
+
+
+def test_semi_anti_join():
+    lk = np.array([1, 2, 3, 4], dtype=np.int64)
+    lv = np.arange(4, dtype=np.int64)
+    rk = np.array([2, 4, 4], dtype=np.int64)
+    lb = make_batch(lk, lv)
+    rb = make_batch(rk, np.zeros(3, dtype=np.int64))
+    semi, _ = join.equi_join(lb, rb, [0], [0],
+                             [dt.INT64, dt.INT64], [dt.INT64, dt.INT64],
+                             "leftsemi")
+    vals, _ = semi.columns[0].to_numpy(semi.realized_num_rows())
+    assert sorted(vals.tolist()) == [2, 4]
+    anti, _ = join.equi_join(lb, rb, [0], [0],
+                             [dt.INT64, dt.INT64], [dt.INT64, dt.INT64],
+                             "leftanti")
+    vals, _ = anti.columns[0].to_numpy(anti.realized_num_rows())
+    assert sorted(vals.tolist()) == [1, 3]
+
+
+def test_join_null_keys_never_match():
+    lk = np.array([1, 0], dtype=np.int64)
+    lkv = np.array([True, False])
+    rk = np.array([1, 0], dtype=np.int64)
+    rkv = np.array([True, False])
+    lb = make_batch(lk, np.arange(2, dtype=np.int64), validities=[lkv, None])
+    rb = make_batch(rk, np.arange(2, dtype=np.int64), validities=[rkv, None])
+    out, _ = join.equi_join(lb, rb, [0], [0],
+                            [dt.INT64, dt.INT64], [dt.INT64, dt.INT64],
+                            "inner")
+    assert out.realized_num_rows() == 1
+
+
+def test_full_outer_join():
+    lk = np.array([1, 2], dtype=np.int64)
+    rk = np.array([2, 3], dtype=np.int64)
+    lb = make_batch(lk, np.array([10, 20], dtype=np.int64))
+    rb = make_batch(rk, np.array([200, 300], dtype=np.int64))
+    out, _ = join.equi_join(lb, rb, [0], [0],
+                            [dt.INT64, dt.INT64], [dt.INT64, dt.INT64],
+                            "full")
+    assert out.realized_num_rows() == 3
+
+
+def test_string_key_join_across_dictionaries():
+    lb = make_batch(["apple", "fig"], np.array([1, 2], dtype=np.int64))
+    rb = make_batch(["fig", "zebra"], np.array([30, 40], dtype=np.int64))
+    out, _ = join.equi_join(lb, rb, [0], [0],
+                            [dt.STRING, dt.INT64], [dt.STRING, dt.INT64],
+                            "inner")
+    assert out.realized_num_rows() == 1
+    svals, _ = out.columns[0].to_numpy(1)
+    assert svals[0] == "fig"
