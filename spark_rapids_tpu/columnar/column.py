@@ -190,12 +190,16 @@ class StringColumn(Column):
     TPU stand-in for cuDF native string columns (SURVEY.md §7 "Strings").
     """
 
-    __slots__ = ("dictionary",)
+    # _dict_hashes: per-dictionary-entry content hashes, lazily filled by
+    # ops.hashing.dict_hashes (without the slot the cache write silently
+    # failed and every join/partition re-hashed the dictionary)
+    __slots__ = ("dictionary", "_dict_hashes")
 
     def __init__(self, codes: jax.Array, dictionary: np.ndarray,
                  validity: Optional[jax.Array] = None):
         super().__init__(dt.STRING, codes, validity)
         self.dictionary = dictionary
+        self._dict_hashes = None
 
     @staticmethod
     def from_strings(values: Sequence[Optional[str]],

@@ -1,0 +1,187 @@
+"""Host batch representation + columnar wire format.
+
+The TPU-native replacement for the pair of mechanisms the reference uses to
+move batches off-device:
+
+- ``TableMeta`` flatbuffers describing a serialized table (sql-plugin/src/
+  main/java/.../format/TableMeta.java:59; built by MetaUtils.scala:144), and
+- ``JCudfSerialization`` host write/read of columnar buffers
+  (GpuColumnarBatchSerializer.scala:80-91,148).
+
+One format serves three consumers — the host/disk spill tiers (§2.3), the
+host-path shuffle serializer, and broadcast exchange — exactly like the
+reference reuses TableMeta across spill and shuffle.
+
+Layout of the serialized stream::
+
+    MAGIC(4) | header_len(4, LE) | header(JSON, utf-8) | buffers...
+
+The JSON header carries schema dtypes, row count, capacity, per-column
+buffer sizes, validity presence and string dictionaries; buffers follow
+contiguously in column order (data then validity per column). Buffers are
+raw little-endian numpy bytes so the read side can ``np.frombuffer``
+zero-copy off a memoryview.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import struct
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+
+MAGIC = b"SRT0"
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """One column's host mirror (RapidsHostColumnVector.java analogue)."""
+
+    dtype: dt.DType
+    data: np.ndarray                       # (capacity,) kernel-dtype values
+    validity: Optional[np.ndarray]         # (capacity,) bool, or None
+    dictionary: Optional[np.ndarray] = None  # object[str] for STRING
+
+    def nbytes(self) -> int:
+        n = self.data.nbytes
+        if self.validity is not None:
+            n += self.validity.nbytes
+        if self.dictionary is not None:
+            n += sum(len(s.encode("utf-8")) + 4 for s in self.dictionary)
+        return n
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """A ColumnarBatch materialized to host memory. ``num_rows`` is always a
+    realized Python int here (host code needs real sizes)."""
+
+    columns: List[HostColumn]
+    num_rows: int
+
+    @property
+    def capacity(self) -> int:
+        return len(self.columns[0].data) if self.columns else 0
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+
+def to_host_batch(batch: ColumnarBatch) -> HostBatch:
+    """Device→host copy (the D2H half of GpuColumnarBatchSerializer's write,
+    GpuColumnarBatchSerializer.scala:80-91)."""
+    n = batch.realized_num_rows()
+    arrays = []
+    for c in batch.columns:
+        arrays.append(c.data)
+        if c.validity is not None:
+            arrays.append(c.validity)
+    host = jax.device_get(arrays)  # one transfer round
+    it = iter(host)
+    cols: List[HostColumn] = []
+    for c in batch.columns:
+        data = np.asarray(next(it))
+        validity = np.asarray(next(it)) if c.validity is not None else None
+        dictionary = c.dictionary if isinstance(c, StringColumn) else None
+        cols.append(HostColumn(c.dtype, data, validity, dictionary))
+    return HostBatch(cols, n)
+
+
+def to_device_batch(hb: HostBatch) -> ColumnarBatch:
+    """Host→device upload (HostColumnarToGpu.scala:31 analogue)."""
+    cols: List[Column] = []
+    for hc in hb.columns:
+        data = jnp.asarray(hc.data)
+        validity = jnp.asarray(hc.validity) if hc.validity is not None \
+            else None
+        if hc.dtype is dt.STRING:
+            cols.append(StringColumn(
+                data,
+                hc.dictionary if hc.dictionary is not None
+                else np.array([], dtype=object),
+                validity))
+        else:
+            cols.append(Column(hc.dtype, data, validity))
+    return ColumnarBatch(cols, hb.num_rows)
+
+
+def _np_wire(arr: np.ndarray) -> np.ndarray:
+    """Ensure little-endian contiguous for raw-bytes wire format."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.byteorder == ">":
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    return arr
+
+
+def serialize_host_batch(hb: HostBatch, out: Optional[io.RawIOBase] = None
+                         ) -> Optional[bytes]:
+    """Write the wire format; returns bytes if ``out`` is None."""
+    buffers: List[bytes] = []
+    col_headers = []
+    for hc in hb.columns:
+        data = _np_wire(hc.data)
+        hdr = {
+            "dtype": hc.dtype.name,
+            "np": data.dtype.str,
+            "len": int(data.shape[0]),
+            "has_validity": hc.validity is not None,
+        }
+        buffers.append(data.tobytes())
+        if hc.validity is not None:
+            buffers.append(_np_wire(hc.validity.astype(np.bool_)).tobytes())
+        if hc.dictionary is not None:
+            hdr["dictionary"] = [str(s) for s in hc.dictionary]
+        col_headers.append(hdr)
+    header = json.dumps({
+        "num_rows": hb.num_rows,
+        "columns": col_headers,
+    }).encode("utf-8")
+    stream = out or io.BytesIO()
+    stream.write(MAGIC)
+    stream.write(struct.pack("<I", len(header)))
+    stream.write(header)
+    for b in buffers:
+        stream.write(b)
+    if out is None:
+        return stream.getvalue()
+    return None
+
+
+def deserialize_host_batch(data: bytes) -> HostBatch:
+    mv = memoryview(data)
+    if bytes(mv[:4]) != MAGIC:
+        raise ValueError("bad magic in serialized batch")
+    (hlen,) = struct.unpack("<I", mv[4:8])
+    header = json.loads(bytes(mv[8:8 + hlen]).decode("utf-8"))
+    off = 8 + hlen
+    cols: List[HostColumn] = []
+    for ch in header["columns"]:
+        dtype = dt.by_name(ch["dtype"])
+        np_dt = np.dtype(ch["np"])
+        n = ch["len"]
+        nbytes = np_dt.itemsize * n
+        arr = np.frombuffer(mv[off:off + nbytes], dtype=np_dt)
+        off += nbytes
+        validity = None
+        if ch["has_validity"]:
+            validity = np.frombuffer(mv[off:off + n], dtype=np.bool_)
+            off += n
+        dictionary = None
+        if "dictionary" in ch:
+            dictionary = np.array(ch["dictionary"], dtype=object)
+        cols.append(HostColumn(dtype, arr, validity, dictionary))
+    return HostBatch(cols, header["num_rows"])
+
+
+def schema_of(hb: HostBatch, names: Optional[Sequence[str]] = None) -> Schema:
+    names = list(names) if names is not None \
+        else [f"c{i}" for i in range(len(hb.columns))]
+    return Schema(names, [c.dtype for c in hb.columns])

@@ -96,6 +96,10 @@ class _Comparison(Expression):
                 (isinstance(b, Scalar) and b.is_null):
             return Scalar(dt.BOOLEAN, None)
         if self.children[0].dtype is dt.STRING:
+            if isinstance(a, Scalar) and isinstance(b, Scalar):
+                # two non-null string scalars: plain host comparison
+                return Scalar(dt.BOOLEAN,
+                              bool(self.op(str(a.value), str(b.value))))
             a, b = self._prep_strings(a, b)
         if isinstance(a, Scalar) and isinstance(b, Scalar):
             return Scalar(dt.BOOLEAN, bool(self.op(
@@ -144,6 +148,9 @@ class EqualNullSafe(_Comparison):
         a_null_s = isinstance(a, Scalar) and a.is_null
         b_null_s = isinstance(b, Scalar) and b.is_null
         if self.children[0].dtype is dt.STRING and not (a_null_s or b_null_s):
+            if isinstance(a, Scalar) and isinstance(b, Scalar):
+                return Scalar(dt.BOOLEAN,
+                              bool(self.op(str(a.value), str(b.value))))
             a, b = self._prep_strings(a, b)
         if isinstance(a, Scalar) and isinstance(b, Scalar):
             if a_null_s or b_null_s:

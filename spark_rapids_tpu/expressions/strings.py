@@ -159,11 +159,13 @@ class Substring(Expression):
         if pos > 0:
             start = pos - 1
         elif pos < 0:
-            start = max(len(s) + pos, 0)
+            start = len(s) + pos
         else:
             start = 0
+        # clamp only after end is derived from the unclamped start, so
+        # substring('abc', -5, 2) = '' (Spark UTF8String.substringSQL), not 'ab'
         end = len(s) if ln is None else start + ln
-        return s[start:end]
+        return s[max(start, 0):max(end, 0)]
 
     def eval(self, ctx):
         return _eval_str_unary(self, ctx, self.fn, dt.STRING)

@@ -1,0 +1,33 @@
+"""Tiered memory management: catalog, spill stores, admission control.
+
+TPU-native re-design of the reference's device/host/disk spill framework
+(SURVEY.md §2.3): RapidsBufferCatalog (RapidsBufferCatalog.scala:109),
+RapidsBufferStore chain (RapidsBufferStore.scala:39), SpillPriorities
+(SpillPriorities.scala:32-60), SpillableColumnarBatch
+(SpillableColumnarBatch.scala), GpuSemaphore (GpuSemaphore.scala:27) and the
+RMM OOM event handler (DeviceMemoryEventHandler.scala:42-69).
+
+XLA owns the actual HBM allocator, so unlike RMM there is no alloc callback
+to intercept; instead the catalog enforces a *logical* device budget over all
+registered (spillable) buffers and the OOM hook catches XLA
+RESOURCE_EXHAUSTED errors, spills, and retries the computation.
+"""
+from spark_rapids_tpu.memory.priorities import (  # noqa: F401
+    ACTIVE_BATCHING_PRIORITY,
+    ACTIVE_ON_DECK_PRIORITY,
+    COALESCE_PRIORITY,
+    INPUT_FROM_SHUFFLE_PRIORITY,
+    OUTPUT_FOR_SHUFFLE_PRIORITY,
+)
+from spark_rapids_tpu.memory.catalog import (  # noqa: F401
+    BufferCatalog,
+    StorageTier,
+    get_catalog,
+    reset_catalog,
+)
+from spark_rapids_tpu.memory.spillable import SpillableBatch  # noqa: F401
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore  # noqa: F401
+from spark_rapids_tpu.memory.oom import (  # noqa: F401
+    is_oom_error,
+    with_oom_retry,
+)

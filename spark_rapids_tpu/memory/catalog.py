@@ -1,0 +1,312 @@
+"""Buffer catalog + tiered device→host→disk spill stores.
+
+Re-design of RapidsBufferCatalog (RapidsBufferCatalog.scala:109: global
+id→buffer map with acquire/ref-count), the RapidsBufferStore chain
+(RapidsBufferStore.scala:39-88: per-store priority-ordered spill to the next
+tier, wired device→host→disk at RapidsBufferCatalog.scala:132-137), the
+bounded host store (RapidsHostMemoryStore.scala;
+rapids.tpu.memory.host.spillStorageSize) and the disk store
+(RapidsDiskStore.scala).
+
+TPU adaptations:
+- Buffers are whole ``ColumnarBatch``es (JAX arrays); XLA owns physical HBM,
+  so the device "store" tracks logical bytes against a configurable budget
+  rather than owning allocations.
+- Device→host spill is ``jax.device_get`` into a ``HostBatch``; host→disk
+  writes the serde wire format (serde.py) — the same bytes shuffle and
+  broadcast use, like the reference reuses TableMeta/JCudfSerialization.
+- Unspill on acquire copies back up the chain (RapidsBufferStore.scala's
+  ``getColumnarBatch`` from a spilled tier).
+
+Thread-safe: one lock guards the maps (the reference uses a ConcurrentHashMap
+plus per-store synchronization; our operations are coarse enough for one
+lock — spill IO happens outside it only for disk writes).
+"""
+from __future__ import annotations
+
+import enum
+import heapq
+import itertools
+import os
+import tempfile
+import threading
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar import serde
+
+
+class StorageTier(enum.IntEnum):
+    """Where a buffer currently lives (StorageTier analogue)."""
+
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class _Entry:
+    __slots__ = ("buffer_id", "priority", "tier", "device_batch",
+                 "host_batch", "disk_path", "size", "refcount", "seq")
+
+    def __init__(self, buffer_id: int, priority: int, batch: ColumnarBatch,
+                 size: int, seq: int):
+        self.buffer_id = buffer_id
+        self.priority = priority
+        self.tier = StorageTier.DEVICE
+        self.device_batch: Optional[ColumnarBatch] = batch
+        self.host_batch: Optional[serde.HostBatch] = None
+        self.disk_path: Optional[str] = None
+        self.size = size
+        self.refcount = 0
+        self.seq = seq
+
+
+class BufferCatalog:
+    """id→buffer map + spill orchestration across the three tiers."""
+
+    def __init__(self, device_budget: Optional[int] = None,
+                 host_budget: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._entries: Dict[int, _Entry] = {}
+        self._ids = itertools.count(1)
+        self._seq = itertools.count()
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self._spill_dir = spill_dir
+        self._device_bytes = 0
+        self._host_bytes = 0
+        self.spilled_device_bytes = 0  # task-metric accounting
+        self.spilled_host_bytes = 0
+
+    # -- registration / lifecycle ----------------------------------------
+
+    def register(self, batch: ColumnarBatch, priority: int) -> int:
+        """Add a device batch under catalog management; returns its id.
+        (RapidsDeviceMemoryStore.addTable analogue.)"""
+        size = batch.device_memory_size()
+        with self._lock:
+            bid = next(self._ids)
+            self._entries[bid] = _Entry(bid, priority, batch, size,
+                                        next(self._seq))
+            self._device_bytes += size
+        self._maybe_spill_async()
+        return bid
+
+    def acquire(self, buffer_id: int) -> ColumnarBatch:
+        """Ref-count acquire; unspills to device if needed
+        (RapidsBufferCatalog.acquireBuffer, RapidsBufferCatalog.scala:44-55).
+        The buffer cannot spill while refcount > 0."""
+        with self._lock:
+            e = self._entries.get(buffer_id)
+            if e is None:
+                raise KeyError(f"buffer {buffer_id} not in catalog")
+            e.refcount += 1
+        try:
+            return self._ensure_device(e)
+        except BaseException:
+            with self._lock:
+                e.refcount -= 1
+            raise
+
+    def release(self, buffer_id: int) -> None:
+        with self._lock:
+            e = self._entries.get(buffer_id)
+            if e is None:
+                return
+            e.refcount -= 1
+            assert e.refcount >= 0
+
+    def remove(self, buffer_id: int) -> None:
+        """Drop the buffer from all tiers (RapidsBufferCatalog.removeBuffer)."""
+        with self._lock:
+            e = self._entries.pop(buffer_id, None)
+            if e is None:
+                return
+            self._drop_tier_bytes(e)
+            path = e.disk_path
+        if path and os.path.exists(path):
+            os.unlink(path)
+
+    def update_priority(self, buffer_id: int, priority: int) -> None:
+        with self._lock:
+            e = self._entries.get(buffer_id)
+            if e is not None:
+                e.priority = priority
+
+    # -- introspection ----------------------------------------------------
+
+    def tier_of(self, buffer_id: int) -> StorageTier:
+        with self._lock:
+            return self._entries[buffer_id].tier
+
+    def size_of(self, buffer_id: int) -> int:
+        with self._lock:
+            return self._entries[buffer_id].size
+
+    @property
+    def device_bytes(self) -> int:
+        return self._device_bytes
+
+    @property
+    def host_bytes(self) -> int:
+        return self._host_bytes
+
+    def __contains__(self, buffer_id: int) -> bool:
+        with self._lock:
+            return buffer_id in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- spill machinery --------------------------------------------------
+
+    def synchronous_spill(self, target_device_bytes: int) -> int:
+        """Spill device buffers (lowest priority first, FIFO within equal
+        priority) until tracked device bytes <= target. Returns bytes
+        spilled. (RapidsBufferStore.synchronousSpill analogue.)"""
+        spilled = 0
+        while True:
+            with self._lock:
+                if self._device_bytes <= target_device_bytes:
+                    return spilled
+                victim = self._pick_spill_victim(StorageTier.DEVICE)
+                if victim is None:
+                    return spilled  # everything pinned
+            spilled += self._spill_device_entry(victim)
+
+    def spill_host_to_disk(self, target_host_bytes: int) -> int:
+        spilled = 0
+        while True:
+            with self._lock:
+                if self._host_bytes <= target_host_bytes:
+                    return spilled
+                victim = self._pick_spill_victim(StorageTier.HOST)
+                if victim is None:
+                    return spilled
+            spilled += self._spill_host_entry(victim)
+
+    def spill_all_device(self) -> int:
+        return self.synchronous_spill(0)
+
+    def _pick_spill_victim(self, tier: StorageTier) -> Optional[_Entry]:
+        """Called under lock. Min (priority, seq) unpinned entry in tier."""
+        best = None
+        for e in self._entries.values():
+            if e.tier is not tier or e.refcount > 0:
+                continue
+            if best is None or (e.priority, e.seq) < (best.priority, best.seq):
+                best = e
+        return best
+
+    def _spill_device_entry(self, e: _Entry) -> int:
+        batch = e.device_batch
+        if batch is None:
+            return 0
+        hb = serde.to_host_batch(batch)  # D2H outside lock
+        with self._lock:
+            if e.buffer_id not in self._entries or \
+                    e.tier is not StorageTier.DEVICE or e.refcount > 0:
+                return 0  # raced with remove/acquire
+            e.host_batch = hb
+            e.device_batch = None
+            e.tier = StorageTier.HOST
+            self._device_bytes -= e.size
+            self._host_bytes += e.size
+            self.spilled_device_bytes += e.size
+        # host store may itself now exceed budget → cascade to disk
+        if self.host_budget is not None:
+            self.spill_host_to_disk(self.host_budget)
+        return e.size
+
+    def _spill_host_entry(self, e: _Entry) -> int:
+        with self._lock:
+            hb = e.host_batch
+            if e.buffer_id not in self._entries or \
+                    e.tier is not StorageTier.HOST or hb is None or \
+                    e.refcount > 0:
+                return 0
+        data = serde.serialize_host_batch(hb)
+        path = os.path.join(self._ensure_spill_dir(),
+                            f"spill-{e.buffer_id}.srt")
+        with open(path, "wb") as f:
+            f.write(data)
+        with self._lock:
+            if e.buffer_id not in self._entries or \
+                    e.tier is not StorageTier.HOST or e.refcount > 0:
+                os.unlink(path)
+                return 0
+            e.disk_path = path
+            e.host_batch = None
+            e.tier = StorageTier.DISK
+            self._host_bytes -= e.size
+            self.spilled_host_bytes += e.size
+        return e.size
+
+    def _ensure_device(self, e: _Entry) -> ColumnarBatch:
+        """Unspill up the chain if needed; caller holds a refcount."""
+        with self._lock:
+            if e.tier is StorageTier.DEVICE:
+                return e.device_batch
+            hb = e.host_batch
+            path = e.disk_path
+            tier = e.tier
+        if tier is StorageTier.DISK:
+            with open(path, "rb") as f:
+                hb = serde.deserialize_host_batch(f.read())
+        batch = serde.to_device_batch(hb)
+        with self._lock:
+            if e.buffer_id not in self._entries:
+                return batch  # removed mid-unspill: hand back untracked
+            if e.tier is not StorageTier.DEVICE:
+                if e.tier is StorageTier.HOST:
+                    self._host_bytes -= e.size
+                e.device_batch = batch
+                e.host_batch = None
+                e.tier = StorageTier.DEVICE
+                self._device_bytes += e.size
+            return e.device_batch
+
+    def _drop_tier_bytes(self, e: _Entry) -> None:
+        if e.tier is StorageTier.DEVICE:
+            self._device_bytes -= e.size
+        elif e.tier is StorageTier.HOST:
+            self._host_bytes -= e.size
+
+    def _maybe_spill_async(self) -> None:
+        """Budget enforcement on register: spill synchronously if over.
+        (The reference spills from the RMM alloc-failed callback; we spill
+        eagerly at the logical budget since XLA gives no callback.)"""
+        if self.device_budget is not None and \
+                self._device_bytes > self.device_budget:
+            self.synchronous_spill(self.device_budget)
+
+    def _ensure_spill_dir(self) -> str:
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="srt-spill-")
+        else:
+            os.makedirs(self._spill_dir, exist_ok=True)
+        return self._spill_dir
+
+
+_global_catalog: Optional[BufferCatalog] = None
+_global_lock = threading.Lock()
+
+
+def get_catalog() -> BufferCatalog:
+    """Singleton catalog (RapidsBufferCatalog.init semantics,
+    RapidsBufferCatalog.scala:128-142); configured lazily from RapidsConf
+    at first use by the engine session."""
+    global _global_catalog
+    with _global_lock:
+        if _global_catalog is None:
+            _global_catalog = BufferCatalog()
+        return _global_catalog
+
+
+def reset_catalog(catalog: Optional[BufferCatalog] = None) -> BufferCatalog:
+    global _global_catalog
+    with _global_lock:
+        _global_catalog = catalog if catalog is not None else BufferCatalog()
+        return _global_catalog

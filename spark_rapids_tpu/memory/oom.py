@@ -1,0 +1,56 @@
+"""OOM detection + spill-and-retry.
+
+The reference installs an RMM event handler whose alloc-failure callback
+spills the device store and asks RMM to retry
+(DeviceMemoryEventHandler.onAllocFailure, DeviceMemoryEventHandler.scala:
+42-69). XLA exposes no alloc callback, so the TPU design inverts control:
+wrap device computations in ``with_oom_retry`` — on RESOURCE_EXHAUSTED we
+synchronously spill catalog-managed buffers and re-run, escalating from
+"spill to budget" to "spill everything" before giving up.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, TypeVar
+
+from spark_rapids_tpu.memory.catalog import BufferCatalog, get_catalog
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+                "Resource exhausted")
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def with_oom_retry(fn: Callable[[], T],
+                   catalog: Optional[BufferCatalog] = None,
+                   max_retries: int = 2) -> T:
+    """Run ``fn``; on device OOM spill and retry (escalating), then re-raise.
+
+    Retry ladder mirrors DeviceMemoryEventHandler's store-exhausted logic:
+    first spill down to half the tracked bytes, then spill everything.
+    """
+    cat = catalog or get_catalog()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:  # jaxlib raises XlaRuntimeError(RuntimeError)
+            if not is_oom_error(exc) or attempt >= max_retries:
+                raise
+            if attempt == 0:
+                target = cat.device_bytes // 2
+                log.warning("device OOM: spilling to %d tracked bytes and "
+                            "retrying", target)
+                cat.synchronous_spill(target)
+            else:
+                log.warning("device OOM persists: spilling all tracked "
+                            "device buffers")
+                cat.spill_all_device()
+            attempt += 1

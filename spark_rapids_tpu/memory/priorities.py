@@ -1,0 +1,22 @@
+"""Spill priorities: lower value spills first.
+
+Mirrors the reference's ordering contract (SpillPriorities.scala:32-60):
+shuffle output buffers spill first (they are re-fetchable / persisted), then
+shuffle input being read, then batches being coalesced, and active per-task
+working batches spill last.
+"""
+
+# Shuffle output awaiting fetch: cheapest to lose from device (= 0 in the
+# reference, SpillPriorities.scala:35).
+OUTPUT_FOR_SHUFFLE_PRIORITY = 0
+
+# Buffers received from a remote shuffle, not yet handed to a task.
+INPUT_FROM_SHUFFLE_PRIORITY = 1 << 20
+
+# Batches buffered by the coalesce iterator while accumulating to its goal.
+COALESCE_PRIORITY = 1 << 40
+
+# A task's on-deck / actively-processed batch: spill only as a last resort
+# (Long.MaxValue - 1000 in the reference, SpillPriorities.scala:52-59).
+ACTIVE_ON_DECK_PRIORITY = (1 << 62) - 1000
+ACTIVE_BATCHING_PRIORITY = (1 << 62) - 2000

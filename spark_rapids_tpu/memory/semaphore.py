@@ -1,0 +1,81 @@
+"""TpuSemaphore: per-chip task admission control.
+
+Analogue of GpuSemaphore (GpuSemaphore.scala:27-161): a counting semaphore
+bounding how many concurrent tasks may hold device memory on one chip
+(rapids.tpu.sql.concurrentTpuTasks; the reference defaults to 2 to
+oversubscribe and hide host I/O, RapidsConf.scala:340-347). Reentrant per
+task: a task that already holds a permit doesn't double-acquire
+(GpuSemaphore.scala:106-130), and completion releases it.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+
+class TpuSemaphore:
+    def __init__(self, max_concurrent: int = 2):
+        if max_concurrent <= 0:
+            raise ValueError("max_concurrent must be positive")
+        self._max = max_concurrent
+        self._permits = max_concurrent
+        # membership check and permit decrement happen atomically under one
+        # condition variable, so racing threads of the same task consume one
+        # permit total (the reference keeps per-task TaskInfo for the same
+        # reason, GpuSemaphore.scala:106-130)
+        self._holders: Set[int] = set()
+        self._cv = threading.Condition()
+
+    def acquire_if_necessary(self, task_id: Optional[int] = None) -> None:
+        """Blocking acquire unless this task already holds a permit
+        (GpuSemaphore.acquireIfNecessary)."""
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._cv:
+            while True:
+                if tid in self._holders:
+                    return
+                if self._permits > 0:
+                    self._permits -= 1
+                    self._holders.add(tid)
+                    return
+                self._cv.wait()
+
+    def release_if_necessary(self, task_id: Optional[int] = None) -> None:
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._cv:
+            if tid in self._holders:
+                self._holders.discard(tid)
+                self._permits += 1
+                self._cv.notify_all()
+
+    def holds(self, task_id: Optional[int] = None) -> bool:
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._cv:
+            return tid in self._holders
+
+    def __enter__(self) -> "TpuSemaphore":
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release_if_necessary()
+
+
+_instance: Optional[TpuSemaphore] = None
+_instance_lock = threading.Lock()
+
+
+def initialize(max_concurrent: int) -> TpuSemaphore:
+    """Executor-init-time setup (Plugin.scala:138)."""
+    global _instance
+    with _instance_lock:
+        _instance = TpuSemaphore(max_concurrent)
+        return _instance
+
+
+def get() -> TpuSemaphore:
+    global _instance
+    with _instance_lock:
+        if _instance is None:
+            _instance = TpuSemaphore()
+        return _instance

@@ -1,0 +1,73 @@
+"""SpillableBatch: hold a batch logically while letting it spill physically.
+
+Analogue of SpillableColumnarBatch (SpillableColumnarBatch.scala:165): an
+operator registers a batch it is not actively computing on, keeps a handle,
+and re-acquires (possibly unspilling) when needed. Used by the coalesce
+iterator's accumulation list, join build sides, and the shuffle write cache.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory.catalog import BufferCatalog, get_catalog
+
+
+class SpillableBatch:
+    """Context-manager-friendly handle over a catalog-registered batch."""
+
+    def __init__(self, batch: ColumnarBatch, priority: int,
+                 catalog: Optional[BufferCatalog] = None):
+        self._catalog = catalog or get_catalog()
+        # realize the row count before the batch can spill: host metadata
+        # must survive tier changes (the reference stores it in TableMeta)
+        batch.realized_num_rows()
+        self._size = batch.device_memory_size()
+        self._id = self._catalog.register(batch, priority)
+        self._closed = False
+
+    @property
+    def buffer_id(self) -> int:
+        return self._id
+
+    def device_memory_size(self) -> int:
+        return self._size
+
+    def get_batch(self) -> ColumnarBatch:
+        """Acquire the batch on device. Caller must call ``release()`` (or
+        use ``with spillable.acquired() as b:``) when done computing."""
+        return self._catalog.acquire(self._id)
+
+    def release(self) -> None:
+        self._catalog.release(self._id)
+
+    def acquired(self):
+        return _Acquired(self)
+
+    def update_priority(self, priority: int) -> None:
+        self._catalog.update_priority(self._id, priority)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._catalog.remove(self._id)
+            self._closed = True
+
+    def __enter__(self) -> "SpillableBatch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _Acquired:
+    __slots__ = ("_sb", "_batch")
+
+    def __init__(self, sb: SpillableBatch):
+        self._sb = sb
+
+    def __enter__(self) -> ColumnarBatch:
+        self._batch = self._sb.get_batch()
+        return self._batch
+
+    def __exit__(self, *exc) -> None:
+        self._sb.release()

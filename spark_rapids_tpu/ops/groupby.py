@@ -162,14 +162,18 @@ def _one_agg(spec: AggSpec, sorted_cols, dtypes, seg, live, first_idx,
 
     if spec.op == "count":
         return n_valid, None
+    # first/last over an empty segment (reduction over 0 rows) must be NULL,
+    # so validity is always materialized and ANDed with segment non-emptiness
     if spec.op == "first":
         out = jnp.take(d, first_idx)
-        ov = jnp.take(valid, first_idx) if v is not None else None
-        return out, ov
+        ov = jnp.take(valid, first_idx) if v is not None \
+            else jnp.ones(capacity, dtype=bool)
+        return out, ov & (seg_sizes > 0)
     if spec.op == "last":
         out = jnp.take(d, last_idx)
-        ov = jnp.take(valid, last_idx) if v is not None else None
-        return out, ov
+        ov = jnp.take(valid, last_idx) if v is not None \
+            else jnp.ones(capacity, dtype=bool)
+        return out, ov & (seg_sizes > 0)
 
     out_valid = n_valid > 0
     in_t = dtypes[spec.ordinal]

@@ -47,10 +47,7 @@ def dict_hashes(col: StringColumn) -> np.ndarray:
         return cached
     h = np.array([fnv1a64(str(s)) for s in col.dictionary], dtype=np.int64) \
         if len(col.dictionary) else np.zeros(1, dtype=np.int64)
-    try:
-        object.__setattr__(col, "_dict_hashes", h)
-    except (AttributeError, TypeError):
-        pass
+    col._dict_hashes = h
     return h
 
 

@@ -308,3 +308,28 @@ def test_if_with_strings():
     out = run_project([e], b)
     vals, _ = col_out(out)
     assert list(vals) == ["yes", "no2"]
+
+
+def test_substring_negative_pos_past_start():
+    # Spark: substring('abc', -5, 2) = '' (start+len still left of string)
+    b = make_batch(["abc", "abcdef"])
+    out = run_project([sexpr.Substring(ref(0, dt.STRING), -5, 2),
+                       sexpr.Substring(ref(0, dt.STRING), -2, 5)], b)
+    v0, _ = col_out(out, 0)
+    v1, _ = col_out(out, 1)
+    assert list(v0) == ["", "bc"]
+    assert list(v1) == ["bc", "ef"]
+
+
+def test_string_scalar_scalar_comparison():
+    from spark_rapids_tpu.expressions import predicates as pexpr
+    from spark_rapids_tpu.expressions.base import Literal
+    b = make_batch(["x"])
+    out = run_project([
+        pexpr.EqualTo(Literal("a", dt.STRING), Literal("a", dt.STRING)),
+        pexpr.LessThan(Literal("a", dt.STRING), Literal("b", dt.STRING)),
+        pexpr.EqualNullSafe(Literal("a", dt.STRING), Literal("b", dt.STRING)),
+    ], b)
+    assert col_out(out, 0)[0][0]
+    assert col_out(out, 1)[0][0]
+    assert not col_out(out, 2)[0][0]

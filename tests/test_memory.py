@@ -1,0 +1,227 @@
+"""Memory subsystem tests, standalone against the allocator like the
+reference's RapidsDeviceMemoryStoreSuite / RapidsHostMemoryStoreSuite /
+RapidsDiskStoreSuite / RapidsBufferCatalogSuite (SURVEY.md §4)."""
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+from spark_rapids_tpu.columnar import serde
+from spark_rapids_tpu.memory import (
+    ACTIVE_ON_DECK_PRIORITY,
+    OUTPUT_FOR_SHUFFLE_PRIORITY,
+    BufferCatalog,
+    SpillableBatch,
+    StorageTier,
+    TpuSemaphore,
+    with_oom_retry,
+)
+
+
+def make_batch(n=100, with_nulls=True, with_strings=False, seed=0):
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    validity = (rng.random(n) > 0.2) if with_nulls else None
+    cols = [Column.from_numpy(vals, dt.INT64, validity=validity),
+            Column.from_numpy(rng.random(n), dt.FLOAT64)]
+    if with_strings:
+        strs = [None if rng.random() < 0.1 else f"s{rng.integers(0, 50)}"
+                for _ in range(n)]
+        cols.append(StringColumn.from_strings(strs))
+    cap = cols[0].capacity
+    cols = [c.with_capacity(cap) for c in cols]
+    return ColumnarBatch(cols, n)
+
+
+def batch_equal(a: ColumnarBatch, b: ColumnarBatch):
+    assert a.realized_num_rows() == b.realized_num_rows()
+    n = a.realized_num_rows()
+    for ca, cb in zip(a.columns, b.columns):
+        va, ma = ca.to_numpy(n)
+        vb, mb = cb.to_numpy(n)
+        if ma is None:
+            assert mb is None
+            np.testing.assert_array_equal(va, vb)
+        else:
+            np.testing.assert_array_equal(ma, mb)
+            np.testing.assert_array_equal(va[ma], vb[mb])
+
+
+class TestSerde:
+    def test_roundtrip_host(self):
+        b = make_batch(with_strings=True)
+        hb = serde.to_host_batch(b)
+        back = serde.to_device_batch(hb)
+        batch_equal(b, back)
+
+    def test_roundtrip_bytes(self):
+        b = make_batch(with_strings=True, seed=3)
+        data = serde.serialize_host_batch(serde.to_host_batch(b))
+        back = serde.to_device_batch(serde.deserialize_host_batch(data))
+        batch_equal(b, back)
+
+    def test_empty_columns_batch(self):
+        b = ColumnarBatch([], 42)  # rows-only degenerate batch
+        data = serde.serialize_host_batch(serde.to_host_batch(b))
+        back = serde.deserialize_host_batch(data)
+        assert back.num_rows == 42 and back.columns == []
+
+
+class TestCatalog:
+    def test_register_acquire_release(self):
+        cat = BufferCatalog()
+        b = make_batch()
+        bid = cat.register(b, ACTIVE_ON_DECK_PRIORITY)
+        assert cat.tier_of(bid) is StorageTier.DEVICE
+        got = cat.acquire(bid)
+        batch_equal(b, got)
+        cat.release(bid)
+        cat.remove(bid)
+        assert bid not in cat
+
+    def test_spill_to_host_and_back(self):
+        cat = BufferCatalog()
+        b = make_batch(with_strings=True)
+        bid = cat.register(b, OUTPUT_FOR_SHUFFLE_PRIORITY)
+        spilled = cat.synchronous_spill(0)
+        assert spilled > 0
+        assert cat.tier_of(bid) is StorageTier.HOST
+        assert cat.device_bytes == 0
+        got = cat.acquire(bid)
+        assert cat.tier_of(bid) is StorageTier.DEVICE
+        batch_equal(b, got)
+        cat.release(bid)
+
+    def test_spill_cascade_to_disk(self, tmp_path):
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path))
+        b = make_batch(with_strings=True, seed=7)
+        bid = cat.register(b, OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.synchronous_spill(0)  # device→host then cascades host→disk
+        assert cat.tier_of(bid) is StorageTier.DISK
+        assert cat.host_bytes == 0
+        got = cat.acquire(bid)
+        batch_equal(b, got)
+        cat.release(bid)
+        cat.remove(bid)
+
+    def test_spill_priority_order(self):
+        cat = BufferCatalog()
+        lo = cat.register(make_batch(seed=1), OUTPUT_FOR_SHUFFLE_PRIORITY)
+        hi = cat.register(make_batch(seed=2), ACTIVE_ON_DECK_PRIORITY)
+        # spill just enough for one buffer: shuffle output goes first
+        cat.synchronous_spill(cat.device_bytes - 1)
+        assert cat.tier_of(lo) is StorageTier.HOST
+        assert cat.tier_of(hi) is StorageTier.DEVICE
+
+    def test_acquired_buffer_cannot_spill(self):
+        cat = BufferCatalog()
+        bid = cat.register(make_batch(), OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.acquire(bid)
+        assert cat.synchronous_spill(0) == 0  # pinned
+        assert cat.tier_of(bid) is StorageTier.DEVICE
+        cat.release(bid)
+        assert cat.synchronous_spill(0) > 0
+
+    def test_device_budget_spills_on_register(self):
+        one = make_batch(seed=1)
+        size = one.device_memory_size()
+        cat = BufferCatalog(device_budget=size)
+        a = cat.register(one, OUTPUT_FOR_SHUFFLE_PRIORITY)
+        b = cat.register(make_batch(seed=2), OUTPUT_FOR_SHUFFLE_PRIORITY)
+        assert cat.device_bytes <= size
+        assert StorageTier.HOST in (cat.tier_of(a), cat.tier_of(b))
+
+    def test_concurrent_register_spill(self):
+        cat = BufferCatalog()
+        ids = []
+        lock = threading.Lock()
+
+        def worker(seed):
+            bid = cat.register(make_batch(seed=seed),
+                               OUTPUT_FOR_SHUFFLE_PRIORITY)
+            with lock:
+                ids.append(bid)
+            got = cat.acquire(bid)
+            assert got is not None
+            cat.release(bid)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        spill = threading.Thread(target=lambda: cat.synchronous_spill(0))
+        spill.start()
+        for t in ts + [spill]:
+            t.join()
+        for bid in ids:
+            batch = cat.acquire(bid)
+            assert batch.realized_num_rows() == 100
+            cat.release(bid)
+
+
+class TestSpillableBatch:
+    def test_lifecycle(self):
+        cat = BufferCatalog()
+        b = make_batch()
+        with SpillableBatch(b, ACTIVE_ON_DECK_PRIORITY, catalog=cat) as sb:
+            cat.synchronous_spill(0)
+            with sb.acquired() as got:
+                batch_equal(b, got)
+        assert len(cat) == 0
+
+
+class TestSemaphore:
+    def test_reentrant_per_task(self):
+        sem = TpuSemaphore(1)
+        sem.acquire_if_necessary(task_id=1)
+        sem.acquire_if_necessary(task_id=1)  # no deadlock
+        assert sem.holds(task_id=1)
+        sem.release_if_necessary(task_id=1)
+        assert not sem.holds(task_id=1)
+        sem.acquire_if_necessary(task_id=2)
+        sem.release_if_necessary(task_id=2)
+
+    def test_limits_concurrency(self):
+        sem = TpuSemaphore(2)
+        running = []
+        peak = []
+        lock = threading.Lock()
+
+        def task(tid):
+            sem.acquire_if_necessary(task_id=tid)
+            with lock:
+                running.append(tid)
+                peak.append(len(running))
+            with lock:
+                running.remove(tid)
+            sem.release_if_necessary(task_id=tid)
+
+        ts = [threading.Thread(target=task, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert max(peak) <= 2
+
+
+class TestOomRetry:
+    def test_spills_and_retries(self):
+        cat = BufferCatalog()
+        cat.register(make_batch(), OUTPUT_FOR_SHUFFLE_PRIORITY)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory "
+                                   "allocating 123 bytes")
+            return "ok"
+
+        assert with_oom_retry(fn, catalog=cat) == "ok"
+        assert cat.device_bytes < make_batch().device_memory_size() + 1
+
+    def test_non_oom_reraises(self):
+        with pytest.raises(ValueError):
+            with_oom_retry(lambda: (_ for _ in ()).throw(ValueError("x")))

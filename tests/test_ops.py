@@ -324,3 +324,17 @@ def test_string_key_join_across_dictionaries():
     assert out.realized_num_rows() == 1
     svals, _ = out.columns[0].to_numpy(1)
     assert svals[0] == "fig"
+
+
+def test_reduce_first_last_empty_batch_is_null():
+    # first/last over zero rows must be NULL, not padding garbage
+    batch = make_batch(np.array([], dtype=np.float64))
+    out, _ = groupby.reduce_aggregate(
+        batch, [AggSpec("first", 0), AggSpec("last", 0),
+                AggSpec("count", 0)], [dt.FLOAT64])
+    assert out.realized_num_rows() == 1
+    fv, fm = out.columns[0].to_numpy(1)
+    lv, lm = out.columns[1].to_numpy(1)
+    assert fm is not None and not fm[0]
+    assert lm is not None and not lm[0]
+    assert out.columns[2].to_numpy(1)[0][0] == 0
