@@ -1,0 +1,110 @@
+"""Benchmark: q5-like scan→filter→groupby-aggregate throughput, TPU vs CPU.
+
+The driver runs this on real TPU hardware at the end of every round and
+records the JSON line. Models BASELINE.md config #1 (the reference's
+integration-test q5-like: parquet-scan + filter + hash aggregate,
+integration_tests/.../TpchLikeSpark.scala methodology): identical relational
+work is timed on the TPU pipeline and on a pandas CPU baseline, and the
+ratio is reported (the reference's own headline metric is this CPU-vs-GPU
+speedup shape, docs/FAQ.md:60-67).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+N_ROWS = 4_000_000
+N_KEYS = 65_536
+WARMUP = 2
+ITERS = 5
+
+
+def gen_data(n=N_ROWS, seed=7):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, N_KEYS, n).astype(np.int64)
+    key_valid = rng.random(n) > 0.02
+    vals = rng.random(n)
+    return keys, key_valid, vals
+
+
+def bench_tpu(keys, key_valid, vals):
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import entry  # the same fused pipeline
+
+    step, _ = entry()
+    jstep = jax.jit(step)
+    from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+    n = len(keys)
+    cap = bucket_capacity(n)
+    kd = jnp.asarray(np.concatenate(
+        [keys, np.zeros(cap - n, dtype=np.int64)]))
+    kv = jnp.asarray(np.concatenate([key_valid, np.zeros(cap - n, bool)]))
+    vd = jnp.asarray(np.concatenate([vals, np.zeros(cap - n)]))
+    nr = jnp.int32(n)
+    # force with a scalar device_get: under the remote-relay backend
+    # block_until_ready can return before execution finishes, which would
+    # fake the timing
+    for _ in range(WARMUP):
+        out = jstep(kd, kv, vd, nr)
+        jax.device_get(out[4])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = jstep(kd, kv, vd, nr)
+        jax.device_get(out[4])
+    dt = (time.perf_counter() - t0) / ITERS
+    return dt, out
+
+
+def bench_cpu(keys, key_valid, vals):
+    import pandas as pd
+
+    df = pd.DataFrame({"k": keys, "valid": key_valid, "v": vals})
+
+    def run():
+        f = df[(df["v"] > 0.5) & df["valid"]]
+        return f.groupby("k").agg(s=("v", "sum"), c=("v", "count"),
+                                  n=("v", "size"))
+
+    run()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(max(ITERS // 2, 1)):
+        out = run()
+    dt = (time.perf_counter() - t0) / max(ITERS // 2, 1)
+    return dt, out
+
+
+def main():
+    keys, key_valid, vals = gen_data()
+    tpu_dt, tpu_out = bench_tpu(keys, key_valid, vals)
+    cpu_dt, cpu_out = bench_cpu(keys, key_valid, vals)
+
+    # cross-check: group count and total sum must agree
+    import jax
+
+    ng = int(jax.device_get(tpu_out[4]))
+    tpu_sum = float(np.asarray(jax.device_get(tpu_out[1]))[:ng].sum())
+    cpu_sum = float(cpu_out["s"].sum())
+    assert ng == len(cpu_out), (ng, len(cpu_out))
+    assert abs(tpu_sum - cpu_sum) / max(abs(cpu_sum), 1) < 1e-9
+
+    rows_per_sec = N_ROWS / tpu_dt
+    speedup = cpu_dt / tpu_dt
+    print(json.dumps({
+        "metric": "q5lite_filter_groupby_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(speedup, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

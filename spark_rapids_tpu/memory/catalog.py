@@ -25,12 +25,11 @@ lock — spill IO happens outside it only for disk writes).
 from __future__ import annotations
 
 import enum
-import heapq
 import itertools
 import os
 import tempfile
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Dict, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar import serde
@@ -46,7 +45,8 @@ class StorageTier(enum.IntEnum):
 
 class _Entry:
     __slots__ = ("buffer_id", "priority", "tier", "device_batch",
-                 "host_batch", "disk_path", "size", "refcount", "seq")
+                 "host_batch", "disk_path", "size", "refcount", "seq",
+                 "pending_remove")
 
     def __init__(self, buffer_id: int, priority: int, batch: ColumnarBatch,
                  size: int, seq: int):
@@ -59,6 +59,7 @@ class _Entry:
         self.size = size
         self.refcount = 0
         self.seq = seq
+        self.pending_remove = False
 
 
 class BufferCatalog:
@@ -110,19 +111,33 @@ class BufferCatalog:
             raise
 
     def release(self, buffer_id: int) -> None:
+        path = None
         with self._lock:
             e = self._entries.get(buffer_id)
             if e is None:
                 return
             e.refcount -= 1
             assert e.refcount >= 0
+            if e.pending_remove and e.refcount == 0:
+                self._entries.pop(buffer_id, None)
+                self._drop_tier_bytes(e)
+                path = e.disk_path
+        if path and os.path.exists(path):
+            os.unlink(path)
 
     def remove(self, buffer_id: int) -> None:
-        """Drop the buffer from all tiers (RapidsBufferCatalog.removeBuffer)."""
+        """Drop the buffer from all tiers (RapidsBufferCatalog.removeBuffer).
+        If the buffer is currently acquired (e.g. mid-unspill), removal is
+        deferred until the last release so concurrent acquirers don't lose
+        the backing file under them."""
         with self._lock:
-            e = self._entries.pop(buffer_id, None)
+            e = self._entries.get(buffer_id)
             if e is None:
                 return
+            if e.refcount > 0:
+                e.pending_remove = True
+                return
+            self._entries.pop(buffer_id, None)
             self._drop_tier_bytes(e)
             path = e.disk_path
         if path and os.path.exists(path):
@@ -235,7 +250,9 @@ class BufferCatalog:
         with self._lock:
             if e.buffer_id not in self._entries or \
                     e.tier is not StorageTier.HOST or e.refcount > 0:
-                os.unlink(path)
+                # lost the race; never unlink a path another spill committed
+                if e.disk_path != path:
+                    os.unlink(path)
                 return 0
             e.disk_path = path
             e.host_batch = None

@@ -25,19 +25,21 @@ class TpuSemaphore:
         # reason, GpuSemaphore.scala:106-130)
         self._holders: Set[int] = set()
         self._cv = threading.Condition()
+        self._tls = threading.local()
 
-    def acquire_if_necessary(self, task_id: Optional[int] = None) -> None:
+    def acquire_if_necessary(self, task_id: Optional[int] = None) -> bool:
         """Blocking acquire unless this task already holds a permit
-        (GpuSemaphore.acquireIfNecessary)."""
+        (GpuSemaphore.acquireIfNecessary). Returns True iff THIS call took
+        the permit (the caller that gets True owns the matching release)."""
         tid = task_id if task_id is not None else threading.get_ident()
         with self._cv:
             while True:
                 if tid in self._holders:
-                    return
+                    return False
                 if self._permits > 0:
                     self._permits -= 1
                     self._holders.add(tid)
-                    return
+                    return True
                 self._cv.wait()
 
     def release_if_necessary(self, task_id: Optional[int] = None) -> None:
@@ -54,11 +56,19 @@ class TpuSemaphore:
             return tid in self._holders
 
     def __enter__(self) -> "TpuSemaphore":
-        self.acquire_if_necessary()
+        # nested `with sem:` on the same task must not release the permit
+        # the outer scope still relies on — remember per-thread whether this
+        # particular enter acquired
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        self._tls.stack.append(self.acquire_if_necessary())
         return self
 
     def __exit__(self, *exc) -> None:
-        self.release_if_necessary()
+        acquired = self._tls.stack.pop() if getattr(self._tls, "stack", None) \
+            else True
+        if acquired:
+            self.release_if_necessary()
 
 
 _instance: Optional[TpuSemaphore] = None

@@ -1,0 +1,19 @@
+"""Multi-chip parallelism: device meshes and ICI collective shuffles.
+
+TPU-native replacement for the reference's distributed backend (SURVEY.md
+§2.8, §5.8): where the reference moves shuffle partitions between executor
+GPUs over UCX/RDMA with a tag protocol (shuffle-plugin/.../ucx/UCX.scala),
+the TPU design keeps data resident across a ``jax.sharding.Mesh`` and
+exchanges rows with ``jax.lax.all_to_all`` under ``shard_map`` — the
+collective rides ICI within a slice and DCN across slices, scheduled by XLA
+rather than a hand-written progress thread.
+"""
+from spark_rapids_tpu.parallel.mesh import (  # noqa: F401
+    data_mesh,
+    mesh_axis_size,
+)
+from spark_rapids_tpu.parallel.shuffle import (  # noqa: F401
+    DistributedGroupByStep,
+    distributed_batch_from_host,
+    gather_distributed_result,
+)

@@ -1,0 +1,32 @@
+"""Device mesh construction.
+
+The reference pins one GPU per executor process and scales by adding
+executors (GpuDeviceManager.scala:72-118). The TPU analogue is a single
+process owning an N-chip mesh: data parallelism is an axis of a
+``jax.sharding.Mesh``, and the shuffle's "executors" are mesh positions.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def data_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over ``n_devices`` chips with a single data axis — the
+    shuffle/partition axis (the reference's executor set)."""
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    return mesh.shape[axis]

@@ -1,0 +1,232 @@
+"""Distributed row exchange + aggregation over a device mesh.
+
+The TPU-native re-imagining of the reference's GPU shuffle (SURVEY.md §2.8):
+GpuShuffleExchangeExec partitions batches on device and hands the pieces to
+a UCX transport that tag-routes them between executor GPUs
+(GpuShuffleExchangeExec.scala:146-248; shuffle-plugin/.../UCX.scala). Here
+every chip is a position on a ``jax.sharding.Mesh``; the whole exchange is
+ONE compiled program per chip:
+
+  1. per-device: hash the key columns → destination device per row,
+  2. sort rows by destination (the contiguous-split trick the reference
+     does with ``Table.partition``, GpuPartitioning.scala:44-70),
+  3. scatter into fixed (n_dev, capacity) send blocks,
+  4. ``jax.lax.all_to_all`` the blocks + per-destination counts — XLA lowers
+     this onto ICI links directly (no bounce buffers, no progress thread),
+  5. compact received rows to a live prefix and run the local sort-based
+     groupby kernel (ops/groupby.py) on them.
+
+Because keys are hash-routed, each device ends up owning a disjoint key
+space — the distributed aggregate is exact with no final merge step (the
+reference needs a second shuffle stage for the same guarantee).
+
+Dynamic-size note: counts ride as data through the same all_to_all, so the
+entire step stays statically shaped; only materialization realizes counts.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+from spark_rapids_tpu.ops import groupby as gb
+from spark_rapids_tpu.ops import hashing
+from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+
+
+def _key_image(data: jax.Array, validity: jax.Array,
+               dtype: dt.DType) -> jax.Array:
+    """int64 hashable image per row; nulls collapse to one image.
+    STRING columns must already be on a mesh-wide unified dictionary, so
+    their codes are a faithful content image."""
+    if dtype is dt.STRING:
+        img = data.astype(jnp.int64)
+    else:
+        img = hashing._numeric_to_int64(data, dtype)
+    return jnp.where(validity, img, jnp.int64(-0x61C8864680B583EB))
+
+
+def _exchange(datas: List[jax.Array], valids: List[jax.Array],
+              dest: jax.Array, live: jax.Array, n_dev: int, axis: str
+              ) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+    """All-to-all rows by per-row destination device. Returns compacted
+    (datas, valids, total_rows) with capacity n_dev * local_capacity."""
+    cap = dest.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    dest_l = jnp.where(live, dest, n_dev)  # padding → sentinel bucket
+    order = jnp.argsort(dest_l, stable=True)
+    dest_s = jnp.take(dest_l, order)
+    counts = jax.ops.segment_sum(live.astype(jnp.int32), dest_l,
+                                 num_segments=n_dev + 1)[:n_dev]
+    start = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                             jnp.cumsum(counts)[:-1].astype(jnp.int32),
+                             jnp.zeros(1, jnp.int32)])  # [n_dev] for sentinel
+    rank = iota - jnp.take(start, dest_s)
+    slot = jnp.where(dest_s < n_dev, dest_s * cap + rank, n_dev * cap)
+    slot = jnp.clip(slot, 0, n_dev * cap)
+
+    def to_blocks(x):
+        buf = jnp.zeros(n_dev * cap + 1, dtype=x.dtype)
+        buf = buf.at[slot].set(jnp.take(x, order))
+        return buf[:n_dev * cap].reshape(n_dev, cap)
+
+    recv_d = [jax.lax.all_to_all(to_blocks(d), axis, 0, 0) for d in datas]
+    recv_v = [jax.lax.all_to_all(to_blocks(v), axis, 0, 0) for v in valids]
+    counts_recv = jax.lax.all_to_all(
+        counts.reshape(n_dev, 1), axis, 0, 0).reshape(n_dev)
+
+    rcap = n_dev * cap
+    riota = jnp.arange(rcap, dtype=jnp.int32)
+    live_r = (riota % cap) < jnp.take(counts_recv, riota // cap)
+    order2 = jnp.argsort(~live_r, stable=True)  # live rows to the prefix
+    total = jnp.sum(counts_recv).astype(jnp.int32)
+    out_d = [jnp.take(r.reshape(rcap), order2) for r in recv_d]
+    out_v = [jnp.take(r.reshape(rcap), order2) & (riota < total)
+             for r in recv_v]
+    return out_d, out_v, total
+
+
+class DistributedGroupByStep:
+    """Compiled multi-chip groupby-aggregate: shard rows → hash-route →
+    all_to_all → per-device sort-based aggregation. The flagship distributed
+    pipeline (shuffle exchange + hash aggregate fused into one program)."""
+
+    def __init__(self, mesh: Mesh, dtypes: Sequence[dt.DType],
+                 key_ordinals: Sequence[int], aggs: Sequence[gb.AggSpec],
+                 axis: str = DATA_AXIS):
+        self.mesh = mesh
+        self.dtypes = tuple(dtypes)
+        self.key_ordinals = tuple(key_ordinals)
+        self.aggs = tuple(aggs)
+        self.axis = axis
+        self.n_dev = mesh.shape[axis]
+        self._fn = self._build()
+
+    def _build(self):
+        n_dev = self.n_dev
+        dtypes = self.dtypes
+        key_ordinals = self.key_ordinals
+        aggs = self.aggs
+        axis = self.axis
+
+        def device_step(datas, valids, n_rows):
+            # block shapes: datas[i] (cap,), n_rows (1,)
+            cap = datas[0].shape[0]
+            live = jnp.arange(cap, dtype=jnp.int32) < n_rows[0]
+            imgs = tuple(
+                _key_image(datas[o], valids[o], dtypes[o])
+                for o in key_ordinals)
+            h = hashing._combine(imgs)
+            dest = (jax.lax.rem(h, jnp.int64(n_dev)) +
+                    jnp.int64(n_dev)) % jnp.int64(n_dev)
+            dest = dest.astype(jnp.int32)
+            ex_d, ex_v, total = _exchange(list(datas), list(valids), dest,
+                                          live, n_dev, axis)
+            cols = [(d, v) for d, v in zip(ex_d, ex_v)]
+            (key_d, key_v), (agg_d, agg_v), ng = gb._groupby(
+                cols, dtypes, key_ordinals, aggs, total)
+            rcap = n_dev * cap
+            ones = jnp.ones(rcap, dtype=bool)
+            out_d = list(key_d) + list(agg_d)
+            out_v = [ones if v is None else v for v in key_v] + \
+                    [ones if v is None else v for v in agg_v]
+            return out_d, out_v, ng.reshape(1)
+
+        n_cols = len(self.dtypes)
+        n_out = len(self.key_ordinals) + len(self.aggs)
+        in_specs = ([P(self.axis)] * n_cols, [P(self.axis)] * n_cols,
+                    P(self.axis))
+        out_specs = ([P(self.axis)] * n_out, [P(self.axis)] * n_out,
+                     P(self.axis))
+        fn = shard_map(device_step, mesh=self.mesh,
+                       in_specs=in_specs, out_specs=out_specs)
+        return jax.jit(fn)
+
+    def __call__(self, datas: List[jax.Array], valids: List[jax.Array],
+                 counts: jax.Array):
+        """datas[i]: (n_dev*cap,) row-sharded; counts: (n_dev,) per-shard
+        live row counts. Returns (out_datas, out_valids, group_counts)."""
+        return self._fn(datas, valids, counts)
+
+    # -- result typing ----------------------------------------------------
+
+    def output_dtypes(self) -> List[dt.DType]:
+        out = [self.dtypes[o] for o in self.key_ordinals]
+        out += [gb.agg_result_dtype(s, list(self.dtypes)) for s in self.aggs]
+        return out
+
+
+def distributed_batch_from_host(mesh: Mesh, arrays: List[np.ndarray],
+                                dtypes: List[dt.DType],
+                                validities: Optional[List[Optional[np.ndarray]]] = None,
+                                axis: str = DATA_AXIS):
+    """Shard host rows round-robin-contiguously over the mesh: returns
+    (datas, valids, counts) global device arrays with every column
+    row-sharded ``P(axis)`` (the reference's RDD partitioning step)."""
+    from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+    n_dev = mesh.shape[axis]
+    n = len(arrays[0])
+    per = -(-n // n_dev)
+    cap = bucket_capacity(max(per, 1))
+    sharding = NamedSharding(mesh, P(axis))
+    datas, valids = [], []
+    counts = np.zeros(n_dev, dtype=np.int32)
+    for d in range(n_dev):
+        lo = min(d * per, n)
+        counts[d] = min(per, n - lo) if lo < n else 0
+    for a, t in zip(arrays, dtypes):
+        buf = np.zeros(n_dev * cap, dtype=t.np_dtype)
+        for d in range(n_dev):
+            lo = d * per
+            seg = a[lo:lo + counts[d]]
+            buf[d * cap:d * cap + len(seg)] = seg
+        datas.append(jax.device_put(jnp.asarray(buf), sharding))
+    vin = validities or [None] * len(arrays)
+    for a, v in zip(arrays, vin):
+        buf = np.zeros(n_dev * cap, dtype=bool)
+        for d in range(n_dev):
+            lo = d * per
+            c = counts[d]
+            buf[d * cap:d * cap + c] = True if v is None else v[lo:lo + c]
+        valids.append(jax.device_put(jnp.asarray(buf), sharding))
+    counts_dev = jax.device_put(jnp.asarray(counts),
+                                NamedSharding(mesh, P(axis)))
+    return datas, valids, counts_dev, cap
+
+
+def gather_distributed_result(out_datas, out_valids, group_counts,
+                              dtypes: List[dt.DType], n_dev: int
+                              ) -> ColumnarBatch:
+    """Collect each device's group prefix to one host-side batch (only for
+    result materialization / tests — production consumers keep it sharded)."""
+    host_d = [np.asarray(jax.device_get(d)) for d in out_datas]
+    host_v = [np.asarray(jax.device_get(v)) for v in out_valids]
+    ng = np.asarray(jax.device_get(group_counts))
+    rcap = len(host_d[0]) // n_dev
+    parts_d = [[] for _ in host_d]
+    parts_v = [[] for _ in host_d]
+    for dev in range(n_dev):
+        k = int(ng[dev])
+        for i in range(len(host_d)):
+            parts_d[i].append(host_d[i][dev * rcap:dev * rcap + k])
+            parts_v[i].append(host_v[i][dev * rcap:dev * rcap + k])
+    total = int(ng.sum())
+    from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+    cap = bucket_capacity(max(total, 1))
+    cols = []
+    for i, t in enumerate(dtypes):
+        vals = np.concatenate(parts_d[i]) if total else \
+            np.zeros(0, dtype=t.np_dtype)
+        mask = np.concatenate(parts_v[i]) if total else np.zeros(0, bool)
+        cols.append(Column.from_numpy(vals, t, validity=mask, capacity=cap))
+    return ColumnarBatch(cols, total)

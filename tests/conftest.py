@@ -16,9 +16,18 @@ import pytest  # noqa: E402
 
 import spark_rapids_tpu  # noqa: E402,F401  (enables x64 before jax use)
 
+# The axon TPU bootstrap (sitecustomize) overrides jax_platforms via
+# jax.config.update at interpreter start, so the env var alone is not
+# enough — force the CPU backend explicitly before any backend init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", \
+    "tests must run on the virtual CPU mesh, not the real TPU"
+assert len(jax.devices()) >= 8, \
+    "xla_force_host_platform_device_count=8 did not take effect"
+
 
 @pytest.fixture(scope="session")
 def n_virtual_devices():
-    import jax
-
     return len(jax.devices())

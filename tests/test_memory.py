@@ -225,3 +225,25 @@ class TestOomRetry:
     def test_non_oom_reraises(self):
         with pytest.raises(ValueError):
             with_oom_retry(lambda: (_ for _ in ()).throw(ValueError("x")))
+
+
+class TestCatalogRaces:
+    def test_remove_while_acquired_defers(self, tmp_path):
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path))
+        b = make_batch(seed=11)
+        bid = cat.register(b, OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.synchronous_spill(0)  # to disk
+        got = cat.acquire(bid)
+        cat.remove(bid)  # must defer: still acquired
+        batch_equal(b, got)
+        assert bid in cat
+        cat.release(bid)  # completes the deferred removal
+        assert bid not in cat
+
+    def test_nested_with_does_not_drop_outer_permit(self):
+        sem = TpuSemaphore(1)
+        with sem:
+            with sem:  # reentrant inner scope
+                pass
+            assert sem.holds()  # outer still holds after inner exit
+        assert not sem.holds()
