@@ -1,0 +1,6 @@
+"""CPU engine: an independent pandas/numpy interpreter of the plan-node
+vocabulary. Plays the role vanilla Spark plays in the reference — the
+fallback target for nodes the planner can't put on TPU, and the golden
+oracle for the CPU-vs-TPU comparison test harness
+(SparkQueryCompareTestSuite.scala:153-161, integration_tests asserts.py)."""
+from spark_rapids_tpu.cpu.engine import execute_cpu  # noqa: F401

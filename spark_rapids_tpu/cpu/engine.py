@@ -1,0 +1,643 @@
+"""CPU plan interpreter: executes plan nodes over numpy/pandas frames.
+
+Independent of the TPU exec layer (no jax): this is the "vanilla Spark" of
+the framework — the engine the planner falls back to per-node and the oracle
+the comparison harness checks TPU results against (SURVEY.md §4).
+Materializes whole frames per node; batch streaming is a device-side concern.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.cpu.evaluator import (CV, CpuEvalContext, cv_null,
+                                            eval_expr)
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan import nodes as pn
+
+
+class CpuFrame:
+    """Schema + full-length CV columns."""
+
+    def __init__(self, schema: Schema, cols: List[CV], num_rows: int):
+        self.schema = schema
+        self.cols = cols
+        self.num_rows = num_rows
+
+    def take(self, idx: np.ndarray,
+             null_mask: Optional[np.ndarray] = None) -> "CpuFrame":
+        """Gather rows; where null_mask is set the output row is all-null
+        (outer-join padding)."""
+        out = []
+        safe = np.clip(idx, 0, max(self.num_rows - 1, 0))
+        for c in self.cols:
+            if self.num_rows == 0:
+                out.append(cv_null(c.dtype, len(idx)))
+                continue
+            data = c.data[safe]
+            valid = c.valid_mask()[safe]
+            if null_mask is not None:
+                valid = valid & ~null_mask
+            out.append(CV(c.dtype, data, valid))
+        return CpuFrame(self.schema, out, len(idx))
+
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {}
+        for name, c in zip(self.schema.names, self.cols):
+            valid = c.valid_mask()
+            if c.dtype is dt.STRING:
+                vals = [c.data[i] if valid[i] else None
+                        for i in range(self.num_rows)]
+                data[name] = pd.array(vals, dtype="object")
+            elif c.dtype is dt.BOOLEAN:
+                data[name] = pd.array(
+                    [bool(c.data[i]) if valid[i] else None
+                     for i in range(self.num_rows)], dtype="boolean")
+            elif c.dtype.is_integral or c.dtype in (dt.DATE, dt.TIMESTAMP):
+                data[name] = pd.array(
+                    [int(c.data[i]) if valid[i] else None
+                     for i in range(self.num_rows)], dtype="Int64")
+            else:
+                vals = c.data.astype(np.float64).copy()
+                vals[~valid] = np.nan
+                data[name] = vals
+        return pd.DataFrame(data)
+
+
+def execute_cpu(plan: pn.PlanNode) -> CpuFrame:
+    fn = _NODES.get(type(plan))
+    if fn is None:
+        raise NotImplementedError(
+            f"CPU engine: unsupported node {plan.name}")
+    return fn(plan)
+
+
+# ---------------------------------------------------------------------------
+# leaves
+
+
+def _scan(node: pn.ScanNode) -> CpuFrame:
+    schema = node.output_schema()
+    data, validity = node.source.read_host()
+    cols = []
+    n = None
+    for name, typ in zip(schema.names, schema.types):
+        arr = np.asarray(data[name])
+        if typ is dt.STRING:
+            arr = arr.astype(object)
+            auto_null = np.array([x is not None for x in arr], dtype=bool)
+        else:
+            if arr.dtype.kind == "M":
+                unit = np.datetime_data(arr.dtype)[0]
+                arr = (arr.astype("datetime64[D]").astype(np.int32)
+                       if typ is dt.DATE else
+                       arr.astype("datetime64[us]").astype(np.int64))
+            arr = arr.astype(typ.np_dtype)
+            auto_null = None
+        v = validity.get(name)
+        if v is not None:
+            v = np.asarray(v, dtype=bool)
+        if auto_null is not None and not auto_null.all():
+            v = auto_null if v is None else (v & auto_null)
+        cols.append(CV(typ, arr, v))
+        n = len(arr)
+    return CpuFrame(schema, cols, n or 0)
+
+
+def _range(node: pn.RangeNode) -> CpuFrame:
+    data = np.arange(node.start, node.end, node.step, dtype=np.int64)
+    return CpuFrame(node.output_schema(),
+                    [CV(dt.INT64, data, None)], len(data))
+
+
+# ---------------------------------------------------------------------------
+# row ops
+
+
+def _project(node: pn.ProjectNode) -> CpuFrame:
+    child = execute_cpu(node.children[0])
+    ctx = CpuEvalContext(child.cols, child.num_rows)
+    cols = [eval_expr(e, ctx) for e in node.exprs]
+    return CpuFrame(node.output_schema(), cols, child.num_rows)
+
+
+def _filter(node: pn.FilterNode) -> CpuFrame:
+    child = execute_cpu(node.children[0])
+    ctx = CpuEvalContext(child.cols, child.num_rows)
+    cond = eval_expr(node.condition, ctx)
+    keep = cond.data.astype(bool) & cond.valid_mask()
+    idx = np.nonzero(keep)[0]
+    return child.take(idx)
+
+
+def _limit(node: pn.LimitNode) -> CpuFrame:
+    child = execute_cpu(node.children[0])
+    n = min(node.n, child.num_rows)
+    return child.take(np.arange(n))
+
+
+def _union(node: pn.UnionNode) -> CpuFrame:
+    frames = [execute_cpu(c) for c in node.children]
+    schema = node.output_schema()
+    cols = []
+    total = sum(f.num_rows for f in frames)
+    for j, typ in enumerate(schema.types):
+        if typ is dt.STRING:
+            data = np.concatenate([f.cols[j].data.astype(object)
+                                   for f in frames]) if total else \
+                np.array([], dtype=object)
+        else:
+            data = np.concatenate([f.cols[j].data.astype(typ.np_dtype)
+                                   for f in frames]) if total else \
+                np.array([], dtype=typ.np_dtype)
+        valid = np.concatenate([f.cols[j].valid_mask() for f in frames]) \
+            if total else np.array([], dtype=bool)
+        cols.append(CV(typ, data, valid))
+    return CpuFrame(schema, cols, total)
+
+
+def _expand(node: pn.ExpandNode) -> CpuFrame:
+    child = execute_cpu(node.children[0])
+    ctx = CpuEvalContext(child.cols, child.num_rows)
+    per_proj = [[eval_expr(e, ctx) for e in p] for p in node.projections]
+    schema = node.output_schema()
+    nproj = len(per_proj)
+    n = child.num_rows
+    cols = []
+    for j, typ in enumerate(schema.types):
+        parts_d = [pp[j].data for pp in per_proj]
+        parts_v = [pp[j].valid_mask() for pp in per_proj]
+        if typ is dt.STRING:
+            data = np.empty(n * nproj, dtype=object)
+        else:
+            data = np.empty(n * nproj, dtype=typ.np_dtype)
+        valid = np.empty(n * nproj, dtype=bool)
+        for k in range(nproj):
+            data[k::nproj] = parts_d[k]
+            valid[k::nproj] = parts_v[k]
+        cols.append(CV(typ, data, valid))
+    return CpuFrame(schema, cols, n * nproj)
+
+
+# ---------------------------------------------------------------------------
+# grouping machinery
+
+
+def _group_key(c: CV, i: int):
+    """Hashable per-row key with Spark grouping semantics: nulls group
+    together, NaN==NaN, -0.0==0.0."""
+    if not c.valid_mask()[i]:
+        return None
+    v = c.data[i]
+    if c.dtype is dt.STRING:
+        return v
+    if c.dtype.is_floating:
+        f = float(v)
+        if f != f:
+            return "__nan__"
+        return f + 0.0  # -0.0 -> 0.0
+    if c.dtype is dt.BOOLEAN:
+        return bool(v)
+    return int(v)
+
+
+def _group_ids(cols: List[CV], n: int) -> Tuple[np.ndarray, int, np.ndarray]:
+    """Returns (gid per row, n_groups, representative row per group)."""
+    seen: Dict[tuple, int] = {}
+    gid = np.empty(n, dtype=np.int64)
+    reps: List[int] = []
+    for i in range(n):
+        key = tuple(_group_key(c, i) for c in cols)
+        g = seen.get(key)
+        if g is None:
+            g = len(seen)
+            seen[key] = g
+            reps.append(i)
+        gid[i] = g
+    return gid, len(seen), np.array(reps, dtype=np.int64)
+
+
+def _agg_op(op: str, cv: Optional[CV], gid: np.ndarray, ng: int,
+            n: int) -> CV:
+    """One kernel-level aggregate op over groups (ops/groupby.AGG_OPS)."""
+    if op == "count_star":
+        data = np.bincount(gid, minlength=ng).astype(np.int64)
+        return CV(dt.INT64, data, None)
+    valid = cv.valid_mask()
+    if op == "count":
+        data = np.bincount(gid[valid], minlength=ng).astype(np.int64)
+        return CV(dt.INT64, data, None)
+    if op in ("sum", "sum_of_squares"):
+        odt = dt.INT64 if (cv.dtype.is_integral or cv.dtype is dt.BOOLEAN) \
+            else dt.FLOAT64
+        acc = np.zeros(ng, dtype=odt.np_dtype)
+        vals = cv.data.astype(odt.np_dtype)
+        if op == "sum_of_squares":
+            vals = vals * vals
+        np.add.at(acc, gid[valid], vals[valid])
+        has = np.zeros(ng, dtype=bool)
+        has[gid[valid]] = True
+        return CV(odt, acc, has)
+    if op in ("min", "max"):
+        return _min_max(op, cv, gid, ng)
+    if op in ("first", "last", "any_valid"):
+        big = n + 1
+        pos = np.full(ng, big if op != "last" else -1, dtype=np.int64)
+        rows = np.arange(n)
+        src = rows if op != "any_valid" else rows[valid]
+        g = gid if op != "any_valid" else gid[valid]
+        if op == "last":
+            np.maximum.at(pos, g, src)
+            chosen = pos
+            ok = pos >= 0
+        else:
+            np.minimum.at(pos, g, src)
+            chosen = np.where(pos < big, pos, 0)
+            ok = pos < big
+        data = cv.data[np.clip(chosen, 0, max(n - 1, 0))] if n else \
+            np.zeros(ng, dtype=cv.data.dtype)
+        v = valid[np.clip(chosen, 0, max(n - 1, 0))] & ok if n else \
+            np.zeros(ng, dtype=bool)
+        return CV(cv.dtype, data, v)
+    raise NotImplementedError(f"agg op {op}")
+
+
+def _min_max(op: str, cv: CV, gid: np.ndarray, ng: int) -> CV:
+    valid = cv.valid_mask()
+    n = len(cv.data)
+    if cv.dtype is dt.STRING:
+        filler = "" if op == "min" else None
+        best: List = [None] * ng
+        for i in range(n):
+            if not valid[i] or cv.data[i] is None:
+                continue
+            g = gid[i]
+            if best[g] is None or \
+                    (cv.data[i] < best[g] if op == "min"
+                     else cv.data[i] > best[g]):
+                best[g] = cv.data[i]
+        data = np.array(best, dtype=object)
+        return CV(dt.STRING, data,
+                  np.array([b is not None for b in best], dtype=bool))
+    # numeric: rank rows by ascending Spark total order (NaN greatest),
+    # then min/max over valid rows' ranks per group — no negation, so
+    # int64 extremes stay exact.
+    vals = cv.data
+    isnan = np.isnan(vals.astype(np.float64)) if cv.dtype.is_floating \
+        else np.zeros(n, dtype=bool)
+    clean = np.where(isnan, 0, vals)
+    order = np.lexsort((clean, isnan))
+    rank_of = np.empty(n, dtype=np.int64)
+    rank_of[order] = np.arange(n)
+    if op == "min":
+        pos = np.full(ng, n + 1, dtype=np.int64)
+        np.minimum.at(pos, gid[valid], rank_of[valid])
+        ok = pos < n + 1
+    else:
+        pos = np.full(ng, -1, dtype=np.int64)
+        np.maximum.at(pos, gid[valid], rank_of[valid])
+        ok = pos >= 0
+    chosen = order[np.clip(np.where(ok, pos, 0), 0, max(n - 1, 0))] if n \
+        else np.zeros(ng, dtype=np.int64)
+    data = vals[chosen] if n else np.zeros(ng, dtype=vals.dtype)
+    return CV(cv.dtype, data, ok)
+
+
+def _aggregate(node: pn.AggregateNode) -> CpuFrame:
+    from spark_rapids_tpu.expressions.base import BoundReference
+
+    child = execute_cpu(node.children[0])
+    n = child.num_rows
+    ctx = CpuEvalContext(child.cols, n)
+    key_cvs = [eval_expr(e, ctx) for e in node.grouping]
+
+    ops_mode = "update" if node.mode in ("complete", "partial") else "merge"
+
+    # input columns per agg: for update mode evaluate fn.input; for merge
+    # mode partial columns follow grouping in the child schema.
+    partial_cvs: List[CV] = []
+    if n == 0 and not node.grouping:
+        ng = 1
+        gid = np.array([], dtype=np.int64)
+        reps = np.array([0], dtype=np.int64)
+        empty_global = True
+    else:
+        gid, ng, reps = _group_ids(key_cvs, n)
+        if not node.grouping and ng == 0:
+            ng, reps = 1, np.array([0], dtype=np.int64)
+            empty_global = True
+        else:
+            empty_global = False
+
+    pcol = len(node.grouping)  # merge mode: next partial ordinal to consume
+    for call in node.aggs:
+        fn = call.fn
+        if ops_mode == "update":
+            inp = eval_expr(fn.input, ctx) if fn.input is not None else None
+            ops = fn.update_ops()
+            for op in ops:
+                partial_cvs.append(_agg_op(op, inp, gid, ng, n))
+        else:
+            ops = fn.merge_ops()
+            for op in ops:
+                inp = child.cols[pcol]
+                pcol += 1
+                partial_cvs.append(_agg_op(op, inp, gid, ng, n))
+
+    if empty_global:
+        # global aggregate over empty input: one row of defaults
+        # (aggregate.scala:488-501)
+        out_partials = []
+        for call in node.aggs:
+            for ptype, pop in zip(call.fn.partial_types(),
+                                  call.fn.update_ops()):
+                if pop in ("count", "count_star"):
+                    out_partials.append(
+                        CV(dt.INT64, np.zeros(1, dtype=np.int64), None))
+                else:
+                    out_partials.append(cv_null(ptype, 1))
+        partial_cvs = out_partials
+
+    key_out = []
+    for c in key_cvs:
+        if n:
+            key_out.append(CV(c.dtype, c.data[reps],
+                              c.valid_mask()[reps]))
+        else:
+            key_out.append(cv_null(c.dtype, ng))
+
+    if node.mode == "partial":
+        return CpuFrame(node.output_schema(), key_out + partial_cvs, ng)
+
+    # final/complete: evaluate each fn's result expression over partials
+    ctx2 = CpuEvalContext(key_out + partial_cvs, ng)
+    out_cols = list(key_out)
+    base = len(key_out)
+    for call in node.aggs:
+        nparts = len(call.fn.partial_types())
+        refs = [BoundReference(base + j, t)
+                for j, t in enumerate(call.fn.partial_types())]
+        final_expr = call.fn.evaluate(refs)
+        out_cols.append(eval_expr(final_expr, ctx2))
+        base += nparts
+    return CpuFrame(node.output_schema(), out_cols, ng)
+
+
+# ---------------------------------------------------------------------------
+# sort
+
+
+def _rank_arrays(c: CV, spec: SortKeySpec, n: int) -> List[np.ndarray]:
+    """lexsort key levels for one ORDER BY term, least significant LAST
+    (np.lexsort order). Levels: [value, nan_rank, null_rank] reversed."""
+    valid = c.valid_mask()
+    null_rank = np.where(valid, 1, 0) if spec.nulls_first else \
+        np.where(valid, 0, 1)
+    if c.dtype is dt.STRING:
+        # factorize via sorted uniques -> order-isomorphic codes
+        filled = np.array([x if x is not None else "" for x in c.data],
+                          dtype=object)
+        uniq, codes = np.unique(filled, return_inverse=True)
+        vals = codes.astype(np.int64)
+        nan_rank = np.zeros(n, dtype=np.int8)
+    elif c.dtype.is_floating:
+        f = c.data.astype(np.float64)
+        isnan = np.isnan(f)
+        nan_rank = isnan.astype(np.int8)  # NaN greatest
+        vals = np.where(isnan, 0.0, f + 0.0)
+    else:
+        vals = c.data
+        nan_rank = np.zeros(n, dtype=np.int8)
+    if not spec.ascending:
+        vals = -vals.astype(np.float64) if c.dtype.is_floating else -vals
+        nan_rank = -nan_rank
+    return [vals, nan_rank, null_rank]
+
+
+def _sort_perm(frame: CpuFrame, specs: List[SortKeySpec]) -> np.ndarray:
+    keys: List[np.ndarray] = [np.arange(frame.num_rows)]  # stable tiebreak
+    for spec in reversed(specs):
+        keys.extend(_rank_arrays(frame.cols[spec.ordinal], spec,
+                                 frame.num_rows))
+    return np.lexsort(keys)
+
+
+def _sort(node: pn.SortNode) -> CpuFrame:
+    child = execute_cpu(node.children[0])
+    return child.take(_sort_perm(child, node.specs))
+
+
+# ---------------------------------------------------------------------------
+# join
+
+
+def _join(node: pn.JoinNode) -> CpuFrame:
+    left = execute_cpu(node.children[0])
+    right = execute_cpu(node.children[1])
+    nl, nr = left.num_rows, right.num_rows
+
+    if node.kind == "cross":
+        li = np.repeat(np.arange(nl), nr)
+        ri = np.tile(np.arange(nr), nl)
+    else:
+        table: Dict[tuple, List[int]] = {}
+        rkeys = [right.cols[k] for k in node.right_keys]
+        for i in range(nr):
+            key = tuple(_group_key(c, i) for c in rkeys)
+            if None in key:
+                continue  # null keys never match
+            table.setdefault(key, []).append(i)
+        lkeys = [left.cols[k] for k in node.left_keys]
+        lis, ris = [], []
+        for i in range(nl):
+            key = tuple(_group_key(c, i) for c in lkeys)
+            if None in key:
+                continue
+            for j in table.get(key, ()):
+                lis.append(i)
+                ris.append(j)
+        li = np.array(lis, dtype=np.int64)
+        ri = np.array(ris, dtype=np.int64)
+
+    # residual condition filters candidate pairs (GpuHashJoin.scala:285-291)
+    if node.condition is not None and len(li):
+        lf = left.take(li)
+        rf = right.take(ri)
+        ctx = CpuEvalContext(lf.cols + rf.cols, len(li))
+        c = eval_expr(node.condition, ctx)
+        keep = c.data.astype(bool) & c.valid_mask()
+        li, ri = li[keep], ri[keep]
+
+    matched_l = np.zeros(nl, dtype=bool)
+    matched_r = np.zeros(nr, dtype=bool)
+    if len(li):
+        matched_l[li] = True
+        matched_r[ri] = True
+
+    if node.kind == "left_semi":
+        return left.take(np.nonzero(matched_l)[0])
+    if node.kind == "left_anti":
+        return left.take(np.nonzero(~matched_l)[0])
+
+    pad_l = np.zeros(len(li), dtype=bool)
+    if node.kind in ("left", "full"):
+        extra = np.nonzero(~matched_l)[0]
+        li = np.concatenate([li, extra])
+        ri = np.concatenate([ri, np.zeros(len(extra), dtype=np.int64)])
+        pad_l = np.concatenate([pad_l, np.ones(len(extra), dtype=bool)])
+    pad_r = pad_l  # pad flags for the right side of l-outer rows
+    if node.kind in ("right", "full"):
+        extra = np.nonzero(~matched_r)[0]
+        li = np.concatenate([li, np.zeros(len(extra), dtype=np.int64)])
+        ri = np.concatenate([ri, extra])
+        pad_left_rows = np.concatenate(
+            [np.zeros(len(pad_r), dtype=bool),
+             np.ones(len(extra), dtype=bool)])
+        pad_r = np.concatenate([pad_r, np.zeros(len(extra), dtype=bool)])
+    else:
+        pad_left_rows = np.zeros(len(li), dtype=bool)
+
+    lf = left.take(li, null_mask=pad_left_rows)
+    rf = right.take(ri, null_mask=pad_r)
+    return CpuFrame(node.output_schema(), lf.cols + rf.cols, len(li))
+
+
+# ---------------------------------------------------------------------------
+# window
+
+
+def _window(node: pn.WindowNode) -> CpuFrame:
+    from spark_rapids_tpu.expressions.aggregates import AggregateFunction
+
+    child = execute_cpu(node.children[0])
+    n = child.num_rows
+    part_cols = [child.cols[i] for i in node.partition_ordinals]
+    gid, ng, _ = _group_ids(part_cols, n)
+    specs = node.order_specs
+    # order rows by (partition, order keys) — stable
+    keys: List[np.ndarray] = [np.arange(n)]
+    for spec in reversed(specs):
+        keys.extend(_rank_arrays(child.cols[spec.ordinal], spec, n))
+    keys.append(gid)
+    perm = np.lexsort(keys)
+
+    out_cols = list(child.cols)
+    schema = node.output_schema()
+
+    # per-partition row lists in sorted order
+    rows_by_part: List[List[int]] = [[] for _ in range(ng)]
+    for r in perm:
+        rows_by_part[gid[r]].append(r)
+
+    # tie detection for rank/dense_rank: order-key equality
+    def same_order_keys(a: int, b: int) -> bool:
+        for spec in specs:
+            c = child.cols[spec.ordinal]
+            ka, kb = _group_key(c, a), _group_key(c, b)
+            if ka != kb:
+                return False
+        return True
+
+    for call_idx, call in enumerate(node.calls):
+        typ = schema.types[len(child.cols) + call_idx]
+        if typ is dt.STRING:
+            data = np.full(n, None, dtype=object)
+        else:
+            data = np.zeros(n, dtype=typ.np_dtype)
+        valid = np.ones(n, dtype=bool)
+
+        for rows in rows_by_part:
+            if isinstance(call.fn, AggregateFunction):
+                _window_agg(call, child, rows, data, valid)
+            elif call.fn == "row_number":
+                for k, r in enumerate(rows):
+                    data[r] = k + 1
+            elif call.fn in ("rank", "dense_rank"):
+                rank = 0
+                dense = 0
+                for k, r in enumerate(rows):
+                    if k == 0 or not same_order_keys(rows[k - 1], r):
+                        rank = k + 1
+                        dense += 1
+                    data[r] = rank if call.fn == "rank" else dense
+            elif isinstance(call.fn, tuple) and call.fn[0] in ("lead",
+                                                               "lag"):
+                _window_shift(call, child, rows, data, valid)
+            else:
+                raise NotImplementedError(f"window fn {call.fn}")
+        out_cols.append(CV(typ, data, valid))
+    return CpuFrame(schema, out_cols, n)
+
+
+def _window_agg(call: pn.WindowCall, child: CpuFrame, rows: List[int],
+                data: np.ndarray, valid: np.ndarray) -> None:
+    from spark_rapids_tpu.expressions.base import BoundReference
+
+    fn = call.fn
+    ctx = CpuEvalContext(child.cols, child.num_rows)
+    inp = eval_expr(fn.input, ctx) if fn.input is not None else None
+    lo, hi = call.frame.lower, call.frame.upper
+    for k, r in enumerate(rows):
+        s = 0 if lo is None else max(k + lo, 0)
+        t = len(rows) if hi is None else min(k + hi + 1, len(rows))
+        frame_rows = np.array(rows[s:t], dtype=np.int64)
+        sub_gid = np.zeros(len(frame_rows), dtype=np.int64)
+        if inp is not None:
+            sub = CV(inp.dtype, inp.data[frame_rows],
+                     inp.valid_mask()[frame_rows])
+        else:
+            sub = None
+        partials = [_agg_op(op, sub, sub_gid, 1, len(frame_rows))
+                    for op in fn.update_ops()]
+        refs = [BoundReference(j, t2)
+                for j, t2 in enumerate(fn.partial_types())]
+        res = eval_expr(fn.evaluate(refs),
+                        CpuEvalContext(partials, 1))
+        data[r] = res.data[0]
+        valid[r] = res.valid_mask()[0]
+
+
+def _window_shift(call: pn.WindowCall, child: CpuFrame, rows: List[int],
+                  data: np.ndarray, valid: np.ndarray) -> None:
+    kind, expr = call.fn
+    ctx = CpuEvalContext(child.cols, child.num_rows)
+    inp = eval_expr(expr, ctx)
+    off = call.offset if kind == "lead" else -call.offset
+    for k, r in enumerate(rows):
+        j = k + off
+        if 0 <= j < len(rows):
+            src = rows[j]
+            data[r] = inp.data[src]
+            valid[r] = inp.valid_mask()[src]
+        elif call.default is not None:
+            data[r] = call.default
+        else:
+            valid[r] = False
+
+
+# ---------------------------------------------------------------------------
+
+def _passthrough(node) -> CpuFrame:
+    return execute_cpu(node.children[0])
+
+
+_NODES = {
+    pn.ScanNode: _scan,
+    pn.RangeNode: _range,
+    pn.ProjectNode: _project,
+    pn.FilterNode: _filter,
+    pn.LimitNode: _limit,
+    pn.UnionNode: _union,
+    pn.ExpandNode: _expand,
+    pn.AggregateNode: _aggregate,
+    pn.SortNode: _sort,
+    pn.JoinNode: _join,
+    pn.WindowNode: _window,
+    pn.ShuffleExchangeNode: _passthrough,
+    pn.BroadcastExchangeNode: _passthrough,
+}

@@ -1,0 +1,784 @@
+"""Numpy expression evaluator with Spark SQL semantics.
+
+Deliberately implemented WITHOUT jax so it is an independent oracle for the
+device expression layer (the reference's oracle is vanilla Spark itself —
+its CPU implementations of every expression; SURVEY.md §4). Columns are
+(data ndarray, validity bool ndarray|None); strings are object arrays.
+Dates are int32 days since epoch, timestamps int64 UTC microseconds —
+the same logical encoding the device layer uses, so results compare 1:1.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import conditional as cond
+from spark_rapids_tpu.expressions import datetime as dte
+from spark_rapids_tpu.expressions import math as mth
+from spark_rapids_tpu.expressions import predicates as pr
+from spark_rapids_tpu.expressions import strings as st
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression, Literal)
+from spark_rapids_tpu.expressions.cast import (Cast, _format_one, _parse_one)
+
+
+class CV:
+    """A CPU column value: data + optional validity mask."""
+
+    __slots__ = ("dtype", "data", "validity")
+
+    def __init__(self, dtype: dt.DType, data: np.ndarray,
+                 validity: Optional[np.ndarray] = None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+
+    def valid_mask(self) -> np.ndarray:
+        if self.validity is None:
+            return np.ones(len(self.data), dtype=bool)
+        return self.validity
+
+    def __len__(self):
+        return len(self.data)
+
+
+def cv_null(dtype: dt.DType, n: int) -> CV:
+    if dtype is dt.STRING:
+        data = np.full(n, None, dtype=object)
+    else:
+        data = np.zeros(n, dtype=dtype.np_dtype)
+    return CV(dtype, data, np.zeros(n, dtype=bool))
+
+
+def cv_const(dtype: dt.DType, value, n: int) -> CV:
+    if value is None:
+        return cv_null(dtype, n)
+    if dtype is dt.STRING:
+        data = np.full(n, value, dtype=object)
+    else:
+        data = np.full(n, value, dtype=dtype.np_dtype)
+    return CV(dtype, data, None)
+
+
+def and_valid(*vs: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    out = None
+    for v in vs:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+class CpuEvalContext:
+    def __init__(self, columns: List[CV], num_rows: int):
+        self.columns = columns
+        self.num_rows = num_rows
+
+
+def eval_expr(e: Expression, ctx: CpuEvalContext) -> CV:
+    """Evaluate to a full-length CV (literals broadcast)."""
+    fn = _DISPATCH.get(type(e))
+    if fn is None:
+        for klass, f in _DISPATCH.items():
+            if isinstance(e, klass):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"CPU evaluator: unsupported expression {type(e).__name__}")
+    return fn(e, ctx)
+
+
+# ---------------------------------------------------------------------------
+# leaves
+
+def _bound(e: BoundReference, ctx):
+    return ctx.columns[e.ordinal]
+
+
+def _literal(e: Literal, ctx):
+    return cv_const(e.dtype, e.value, ctx.num_rows)
+
+
+def _alias(e: Alias, ctx):
+    return eval_expr(e.children[0], ctx)
+
+
+# ---------------------------------------------------------------------------
+# arithmetic (Java/Spark non-ANSI semantics: int ops wrap, x/0 -> null)
+
+def _binary_num(e, ctx, op, out_dtype=None):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    odt = out_dtype or e.dtype
+    with np.errstate(all="ignore"):
+        data = op(l.data.astype(odt.np_dtype), r.data.astype(odt.np_dtype))
+    return CV(odt, data.astype(odt.np_dtype),
+              and_valid(l.validity, r.validity))
+
+
+def _add(e, ctx):
+    return _binary_num(e, ctx, np.add)
+
+
+def _sub(e, ctx):
+    return _binary_num(e, ctx, np.subtract)
+
+
+def _mul(e, ctx):
+    return _binary_num(e, ctx, np.multiply)
+
+
+def _divide(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    rd = r.data.astype(np.float64)
+    with np.errstate(all="ignore"):
+        data = l.data.astype(np.float64) / np.where(rd == 0, 1.0, rd)
+    validity = and_valid(l.validity, r.validity, rd != 0)
+    return CV(dt.FLOAT64, data, validity)
+
+
+def _int_div(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    ld = l.data.astype(np.int64)
+    rd = r.data.astype(np.int64)
+    safe = np.where(rd == 0, 1, rd)
+    with np.errstate(all="ignore"):
+        # Java integer division truncates toward zero
+        q = (np.abs(ld) // np.abs(safe)) * (np.sign(ld) * np.sign(safe))
+    validity = and_valid(l.validity, r.validity, rd != 0)
+    return CV(dt.INT64, q.astype(np.int64), validity)
+
+
+def _remainder(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    odt = e.dtype
+    ld = l.data.astype(odt.np_dtype)
+    rd = r.data.astype(odt.np_dtype)
+    zero = (rd == 0)
+    safe = np.where(zero, 1, rd)
+    with np.errstate(all="ignore"):
+        data = np.fmod(ld, safe)  # sign of dividend (Java %)
+    return CV(odt, data.astype(odt.np_dtype),
+              and_valid(l.validity, r.validity, ~zero))
+
+
+def _pmod(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    odt = e.dtype
+    ld = l.data.astype(odt.np_dtype)
+    rd = r.data.astype(odt.np_dtype)
+    zero = (rd == 0)
+    safe = np.where(zero, 1, rd)
+    with np.errstate(all="ignore"):
+        m = np.fmod(ld, safe)
+        data = np.where(m != 0, np.fmod(m + safe, safe), m)
+    return CV(odt, data.astype(odt.np_dtype),
+              and_valid(l.validity, r.validity, ~zero))
+
+
+def _unary_minus(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    with np.errstate(all="ignore"):
+        return CV(e.dtype, (-v.data).astype(e.dtype.np_dtype), v.validity)
+
+
+def _unary_pos(e, ctx):
+    return eval_expr(e.children[0], ctx)
+
+
+def _abs(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    with np.errstate(all="ignore"):
+        return CV(e.dtype, np.abs(v.data).astype(e.dtype.np_dtype),
+                  v.validity)
+
+
+def _signum(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    return CV(dt.FLOAT64, np.sign(v.data.astype(np.float64)), v.validity)
+
+
+# ---------------------------------------------------------------------------
+# predicates
+
+def _cmp(op):
+    def run(e, ctx):
+        l = eval_expr(e.children[0], ctx)
+        r = eval_expr(e.children[1], ctx)
+        if l.dtype is dt.STRING or r.dtype is dt.STRING:
+            ld = l.data
+            rd = r.data
+            n = len(ld)
+            out = np.zeros(n, dtype=bool)
+            for i in range(n):
+                a, b = ld[i], rd[i]
+                if a is None or b is None:
+                    continue
+                out[i] = op(a, b)
+            data = out
+        else:
+            ct = dt.common_type(l.dtype, r.dtype)
+            with np.errstate(all="ignore"):
+                data = op(l.data.astype(ct.np_dtype),
+                          r.data.astype(ct.np_dtype))
+        return CV(dt.BOOLEAN, np.asarray(data, dtype=bool),
+                  and_valid(l.validity, r.validity))
+    return run
+
+
+def _eq_null_safe(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    lv, rv = l.valid_mask(), r.valid_mask()
+    if l.dtype is dt.STRING:
+        eq = np.array([a == b for a, b in zip(l.data, r.data)], dtype=bool)
+    else:
+        ct = dt.common_type(l.dtype, r.dtype)
+        with np.errstate(all="ignore"):
+            eq = l.data.astype(ct.np_dtype) == r.data.astype(ct.np_dtype)
+    data = np.where(lv & rv, eq, ~lv & ~rv)
+    return CV(dt.BOOLEAN, data, None)
+
+
+def _and(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    lv, rv = l.valid_mask(), r.valid_mask()
+    ld = l.data.astype(bool) & lv  # treat null as "not definitely true"
+    rd = r.data.astype(bool) & rv
+    false_l = lv & ~l.data.astype(bool)
+    false_r = rv & ~r.data.astype(bool)
+    data = ld & rd
+    validity = (lv & rv) | false_l | false_r  # 3VL: false dominates null
+    return CV(dt.BOOLEAN, data, validity)
+
+
+def _or(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    lv, rv = l.valid_mask(), r.valid_mask()
+    true_l = lv & l.data.astype(bool)
+    true_r = rv & r.data.astype(bool)
+    data = true_l | true_r
+    validity = (lv & rv) | true_l | true_r  # 3VL: true dominates null
+    return CV(dt.BOOLEAN, data, validity)
+
+
+def _not(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    return CV(dt.BOOLEAN, ~v.data.astype(bool), v.validity)
+
+
+def _is_null(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    return CV(dt.BOOLEAN, ~v.valid_mask(), None)
+
+
+def _is_not_null(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    return CV(dt.BOOLEAN, v.valid_mask().copy(), None)
+
+
+def _is_nan(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    data = np.isnan(v.data.astype(np.float64)) & v.valid_mask()
+    return CV(dt.BOOLEAN, data, None)
+
+
+def _in(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    non_null = [x for x in e.values if x is not None]
+    has_null_item = any(x is None for x in e.values)
+    if v.dtype is dt.STRING:
+        data = np.array([x in non_null for x in v.data], dtype=bool)
+    else:
+        arr = (np.array(non_null, dtype=v.dtype.np_dtype) if non_null
+               else np.array([], dtype=v.dtype.np_dtype))
+        data = np.isin(v.data, arr)
+    validity = v.valid_mask().copy()
+    if has_null_item:
+        validity &= data  # non-match with null in list -> unknown (3VL)
+    return CV(dt.BOOLEAN, data,
+              validity if (has_null_item or v.validity is not None) else None)
+
+
+def _at_least_n(e, ctx):
+    vs = [eval_expr(c, ctx) for c in e.children]
+    cnt = np.zeros(ctx.num_rows, dtype=np.int64)
+    for v in vs:
+        ok = v.valid_mask().copy()
+        if v.dtype.is_floating:
+            ok &= ~np.isnan(v.data)
+        cnt += ok
+    return CV(dt.BOOLEAN, cnt >= e.n, None)
+
+
+# ---------------------------------------------------------------------------
+# conditional
+
+def _if(e, ctx):
+    p = eval_expr(e.children[0], ctx)
+    t = eval_expr(e.children[1], ctx)
+    o = eval_expr(e.children[2], ctx)
+    take_then = p.data.astype(bool) & p.valid_mask()
+    return _select(take_then, t, o, e.dtype)
+
+
+def _select(mask: np.ndarray, a: CV, b: CV, odt: dt.DType) -> CV:
+    if odt is dt.STRING:
+        data = np.where(mask, a.data, b.data)
+    else:
+        data = np.where(mask, a.data.astype(odt.np_dtype),
+                        b.data.astype(odt.np_dtype))
+    validity = np.where(mask, a.valid_mask(), b.valid_mask())
+    return CV(odt, data, validity)
+
+
+def _case_when(e, ctx):
+    odt = e.dtype
+    if e.has_else:
+        out = eval_expr(e.children[-1], ctx)
+    else:
+        out = cv_null(odt, ctx.num_rows)
+    # fold right-to-left so earlier branches win (mirrors device eval)
+    for i in reversed(range(e.n_branches)):
+        p = eval_expr(e.children[2 * i], ctx)
+        v = eval_expr(e.children[2 * i + 1], ctx)
+        take = p.data.astype(bool) & p.valid_mask()
+        out = _select(take, v, out, odt)
+    return out
+
+
+def _coalesce(e, ctx):
+    out = eval_expr(e.children[0], ctx)
+    odt = e.dtype
+    for c in e.children[1:]:
+        nxt = eval_expr(c, ctx)
+        out = _select(out.valid_mask(), out, nxt, odt)
+    return out
+
+
+def _nanvl(e, ctx):
+    l = eval_expr(e.children[0], ctx)
+    r = eval_expr(e.children[1], ctx)
+    ld = l.data.astype(np.float64)
+    # a unless a is a valid NaN; NULL left stays NULL (device NaNvl)
+    take_l = ~np.isnan(ld) | ~l.valid_mask()
+    return _select(take_l, l, r, e.dtype)
+
+
+# ---------------------------------------------------------------------------
+# math
+
+_MATH_FNS = {
+    mth.Sqrt: np.sqrt, mth.Cbrt: np.cbrt, mth.Exp: np.exp,
+    mth.Expm1: np.expm1, mth.Log: np.log, mth.Log1p: np.log1p,
+    mth.Log2: np.log2, mth.Log10: np.log10, mth.Sin: np.sin,
+    mth.Cos: np.cos, mth.Tan: np.tan, mth.Asin: np.arcsin,
+    mth.Acos: np.arccos, mth.Atan: np.arctan, mth.Sinh: np.sinh,
+    mth.Cosh: np.cosh, mth.Tanh: np.tanh, mth.ToDegrees: np.degrees,
+    mth.ToRadians: np.radians, mth.Rint: np.rint,
+}
+
+
+def _unary_math(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    with np.errstate(all="ignore"):
+        data = _MATH_FNS[type(e)](v.data.astype(np.float64))
+    return CV(dt.FLOAT64, data, v.validity)
+
+
+def _floor(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    data = np.floor(v.data.astype(np.float64)).astype(np.int64)
+    return CV(dt.INT64, data, v.validity)
+
+
+def _ceil(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    data = np.ceil(v.data.astype(np.float64)).astype(np.int64)
+    return CV(dt.INT64, data, v.validity)
+
+
+def _pow(e, ctx):
+    return _binary_num(e, ctx, np.power, dt.FLOAT64)
+
+
+def _atan2(e, ctx):
+    return _binary_num(e, ctx, np.arctan2, dt.FLOAT64)
+
+
+# ---------------------------------------------------------------------------
+# cast (reuses the scalar parse/format helpers from the device layer — they
+# are host-side python already; the device layer's *vector* paths are jax)
+
+def _cast(e: Cast, ctx):
+    src = e.children[0].dtype
+    v = eval_expr(e.children[0], ctx)
+    to = e.to
+    n = ctx.num_rows
+    if src is to:
+        return v
+    valid = v.valid_mask()
+    if src is dt.STRING:
+        data = np.zeros(n, dtype=to.np_dtype) if to is not dt.STRING else \
+            np.full(n, None, dtype=object)
+        ok = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if not valid[i] or v.data[i] is None:
+                continue
+            val, good = _parse_one(str(v.data[i]), to)
+            if good:
+                try:
+                    data[i] = val
+                    ok[i] = True
+                except (OverflowError, ValueError):
+                    pass
+        return CV(to, data, ok)
+    if to is dt.STRING:
+        data = np.full(n, None, dtype=object)
+        for i in range(n):
+            if valid[i]:
+                data[i] = _format_one(v.data[i], src)
+        return CV(to, data, v.validity)
+    if src is dt.BOOLEAN:
+        return CV(to, v.data.astype(to.np_dtype), v.validity)
+    if to is dt.BOOLEAN:
+        return CV(to, v.data != 0, v.validity)
+    if src is dt.DATE and to is dt.TIMESTAMP:
+        return CV(to, v.data.astype(np.int64) * 86_400_000_000, v.validity)
+    if src is dt.TIMESTAMP and to is dt.DATE:
+        return CV(to, np.floor_divide(v.data, 86_400_000_000)
+                  .astype(np.int32), v.validity)
+    if src.is_floating and (to.is_integral or to in (dt.DATE, dt.TIMESTAMP)):
+        info = np.iinfo(to.np_dtype)
+        x = np.trunc(np.nan_to_num(v.data.astype(np.float64), nan=0.0))
+        big = x >= float(info.max)
+        small = x <= float(info.min)
+        out = np.where(big, info.max,
+                       np.where(small, info.min,
+                                np.where(big | small, 0, x)
+                                .astype(to.np_dtype)))
+        return CV(to, out.astype(to.np_dtype), v.validity)
+    with np.errstate(all="ignore"):
+        return CV(to, v.data.astype(to.np_dtype), v.validity)
+
+
+# ---------------------------------------------------------------------------
+# datetime (dates = int32 days, timestamps = int64 micros UTC)
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days_to_np(days: np.ndarray) -> np.ndarray:
+    return days.astype("datetime64[D]")
+
+
+def _date_field(field):
+    def run(e, ctx):
+        v = eval_expr(e.children[0], ctx)
+        d = _days_to_np(v.data)
+        y = d.astype("datetime64[Y]").astype(np.int64) + 1970
+        m = (d.astype("datetime64[M]").astype(np.int64) % 12) + 1
+        day = (d - d.astype("datetime64[M]")).astype(np.int64) + 1
+        vals = {"year": y, "month": m, "day": day}
+        return CV(dt.INT32, vals[field].astype(np.int32), v.validity)
+    return run
+
+
+def _day_of_week(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    # Spark: 1 = Sunday ... 7 = Saturday; epoch (1970-01-01) was a Thursday
+    dow = ((v.data.astype(np.int64) + 4) % 7 + 7) % 7 + 1
+    return CV(dt.INT32, dow.astype(np.int32), v.validity)
+
+
+def _day_of_year(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    d = _days_to_np(v.data)
+    doy = (d - d.astype("datetime64[Y]")).astype(np.int64) + 1
+    return CV(dt.INT32, doy.astype(np.int32), v.validity)
+
+
+def _quarter(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    d = _days_to_np(v.data)
+    m = (d.astype("datetime64[M]").astype(np.int64) % 12)
+    return CV(dt.INT32, (m // 3 + 1).astype(np.int32), v.validity)
+
+
+def _time_field(field):
+    def run(e, ctx):
+        v = eval_expr(e.children[0], ctx)
+        us = v.data.astype(np.int64)
+        sec = np.floor_divide(us, 1_000_000)
+        vals = {
+            "hour": np.floor_divide(sec, 3600) % 24,
+            "minute": np.floor_divide(sec, 60) % 60,
+            "second": sec % 60,
+        }
+        return CV(dt.INT32, vals[field].astype(np.int32), v.validity)
+    return run
+
+
+def _date_add(e, ctx):
+    s = eval_expr(e.children[0], ctx)
+    d = eval_expr(e.children[1], ctx)
+    data = (s.data.astype(np.int64) + d.data.astype(np.int64))
+    return CV(dt.DATE, data.astype(np.int32),
+              and_valid(s.validity, d.validity))
+
+
+def _date_sub(e, ctx):
+    s = eval_expr(e.children[0], ctx)
+    d = eval_expr(e.children[1], ctx)
+    data = (s.data.astype(np.int64) - d.data.astype(np.int64))
+    return CV(dt.DATE, data.astype(np.int32),
+              and_valid(s.validity, d.validity))
+
+
+def _date_diff(e, ctx):
+    end = eval_expr(e.children[0], ctx)
+    start = eval_expr(e.children[1], ctx)
+    data = end.data.astype(np.int64) - start.data.astype(np.int64)
+    return CV(dt.INT32, data.astype(np.int32),
+              and_valid(end.validity, start.validity))
+
+
+def _unix_timestamp(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    if v.dtype is dt.TIMESTAMP:
+        data = np.floor_divide(v.data, 1_000_000)
+    elif v.dtype is dt.DATE:
+        data = v.data.astype(np.int64) * 86400
+    else:
+        raise NotImplementedError("unix_timestamp on strings: cast first")
+    return CV(dt.INT64, data.astype(np.int64), v.validity)
+
+
+def _from_unixtime(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    n = ctx.num_rows
+    valid = v.valid_mask()
+    data = np.full(n, None, dtype=object)
+    for i in range(n):
+        if valid[i]:
+            x = datetime.datetime.fromtimestamp(
+                int(v.data[i]), tz=datetime.timezone.utc)
+            data[i] = x.strftime("%Y-%m-%d %H:%M:%S")
+    return CV(dt.STRING, data, v.validity)
+
+
+def _last_day(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    d = _days_to_np(v.data)
+    nxt = d.astype("datetime64[M]") + np.timedelta64(1, "M")
+    last = nxt.astype("datetime64[D]") - np.timedelta64(1, "D")
+    return CV(dt.DATE, last.astype(np.int64).astype(np.int32), v.validity)
+
+
+# ---------------------------------------------------------------------------
+# strings (object-array python loops: oracle clarity over speed)
+
+def _str_unary(fn):
+    def run(e, ctx):
+        v = eval_expr(e.children[0], ctx)
+        valid = v.valid_mask()
+        data = np.full(ctx.num_rows, None, dtype=object)
+        for i in range(ctx.num_rows):
+            if valid[i] and v.data[i] is not None:
+                data[i] = fn(e, v.data[i])
+        return CV(dt.STRING, data, v.validity)
+    return run
+
+
+def _length(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    valid = v.valid_mask()
+    data = np.zeros(ctx.num_rows, dtype=np.int32)
+    for i in range(ctx.num_rows):
+        if valid[i] and v.data[i] is not None:
+            data[i] = len(v.data[i])
+    return CV(dt.INT32, data, v.validity)
+
+
+def _substring(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    valid = v.valid_mask()
+    data = np.full(ctx.num_rows, None, dtype=object)
+    pos, ln = e.pos, e.length
+    for i in range(ctx.num_rows):
+        if not (valid[i] and v.data[i] is not None):
+            continue
+        s = v.data[i]
+        # Spark substring: 1-based; 0 behaves like 1; negative from end
+        if pos > 0:
+            start = pos - 1
+        elif pos == 0:
+            start = 0
+        else:
+            start = max(len(s) + pos, 0)
+        end = len(s) if ln is None else start + max(ln, 0)
+        data[i] = s[start:end]
+    return CV(dt.STRING, data, v.validity)
+
+
+def _str_predicate(fn):
+    def run(e, ctx):
+        v = eval_expr(e.children[0], ctx)
+        valid = v.valid_mask()
+        data = np.zeros(ctx.num_rows, dtype=bool)
+        for i in range(ctx.num_rows):
+            if valid[i] and v.data[i] is not None:
+                data[i] = fn(e, v.data[i])
+        return CV(dt.BOOLEAN, data, v.validity)
+    return run
+
+
+def _like_to_regex(pattern: str, escape: str) -> str:
+    import re
+
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def _like(e, ctx):
+    import re
+
+    rx = re.compile(_like_to_regex(e.pattern, e.escape), flags=re.DOTALL)
+    return _str_predicate(lambda _, s: rx.match(s) is not None)(e, ctx)
+
+
+def _locate(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    valid = v.valid_mask()
+    data = np.zeros(ctx.num_rows, dtype=np.int32)
+    for i in range(ctx.num_rows):
+        if valid[i] and v.data[i] is not None:
+            if e.start < 1:
+                data[i] = 0
+            else:
+                data[i] = v.data[i].find(e.needle, e.start - 1) + 1
+    return CV(dt.INT32, data, v.validity)
+
+
+def _concat(e, ctx):
+    vs = [eval_expr(c, ctx) for c in e.children]
+    validity = and_valid(*[v.validity for v in vs])
+    data = np.full(ctx.num_rows, None, dtype=object)
+    ok = np.ones(ctx.num_rows, dtype=bool) if validity is None else validity
+    for i in range(ctx.num_rows):
+        if ok[i]:
+            data[i] = "".join(str(v.data[i]) for v in vs)
+    return CV(dt.STRING, data, validity)
+
+
+# ---------------------------------------------------------------------------
+
+_DISPATCH = {
+    BoundReference: _bound,
+    Literal: _literal,
+    Alias: _alias,
+    ar.Add: _add,
+    ar.Subtract: _sub,
+    ar.Multiply: _mul,
+    ar.Divide: _divide,
+    ar.IntegralDivide: _int_div,
+    ar.Remainder: _remainder,
+    ar.Pmod: _pmod,
+    ar.UnaryMinus: _unary_minus,
+    ar.UnaryPositive: _unary_pos,
+    ar.Abs: _abs,
+    ar.Signum: _signum,
+    pr.EqualTo: _cmp(lambda a, b: a == b),
+    pr.LessThan: _cmp(lambda a, b: a < b),
+    pr.LessThanOrEqual: _cmp(lambda a, b: a <= b),
+    pr.GreaterThan: _cmp(lambda a, b: a > b),
+    pr.GreaterThanOrEqual: _cmp(lambda a, b: a >= b),
+    pr.EqualNullSafe: _eq_null_safe,
+    pr.And: _and,
+    pr.Or: _or,
+    pr.Not: _not,
+    pr.IsNull: _is_null,
+    pr.IsNotNull: _is_not_null,
+    pr.IsNaN: _is_nan,
+    pr.In: _in,
+    pr.AtLeastNNonNulls: _at_least_n,
+    cond.If: _if,
+    cond.CaseWhen: _case_when,
+    cond.Coalesce: _coalesce,
+    cond.Nvl: _coalesce,
+    cond.NaNvl: _nanvl,
+    Cast: _cast,
+    mth.Floor: _floor,
+    mth.Ceil: _ceil,
+    mth.Pow: _pow,
+    mth.Atan2: _atan2,
+    dte.Year: _date_field("year"),
+    dte.Month: _date_field("month"),
+    dte.DayOfMonth: _date_field("day"),
+    dte.DayOfWeek: _day_of_week,
+    dte.DayOfYear: _day_of_year,
+    dte.Quarter: _quarter,
+    dte.Hour: _time_field("hour"),
+    dte.Minute: _time_field("minute"),
+    dte.Second: _time_field("second"),
+    dte.DateAdd: _date_add,
+    dte.DateSub: _date_sub,
+    dte.DateDiff: _date_diff,
+    dte.UnixTimestamp: _unix_timestamp,
+    dte.FromUnixTime: _from_unixtime,
+    dte.LastDay: _last_day,
+    st.Upper: _str_unary(lambda e, s: s.upper()),
+    st.Lower: _str_unary(lambda e, s: s.lower()),
+    st.Length: _length,
+    st.StringTrim: _str_unary(lambda e, s: s.strip()),
+    st.StringTrimLeft: _str_unary(lambda e, s: s.lstrip()),
+    st.StringTrimRight: _str_unary(lambda e, s: s.rstrip()),
+    st.InitCap: _str_unary(
+        lambda e, s: " ".join(w[:1].upper() + w[1:].lower()
+                              for w in s.split(" "))),
+    st.Reverse: _str_unary(lambda e, s: s[::-1]),
+    st.Substring: _substring,
+    st.StringReplace: _str_unary(
+        lambda e, s: s.replace(e.search, e.replace)),
+    st.StringRepeat: _str_unary(lambda e, s: s * max(e.times, 0)),
+    st.StringLPad: _str_unary(
+        lambda e, s: (e.pad * e.width + s)[-e.width:]
+        if len(s) < e.width else s[:e.width]),
+    st.StringRPad: _str_unary(
+        lambda e, s: (s + e.pad * e.width)[:e.width]
+        if len(s) < e.width else s[:e.width]),
+    st.StartsWith: _str_predicate(lambda e, s: s.startswith(e.needle)),
+    st.EndsWith: _str_predicate(lambda e, s: s.endswith(e.needle)),
+    st.Contains: _str_predicate(lambda e, s: e.needle in s),
+    st.Like: _like,
+    st.StringLocate: _locate,
+    st.ConcatStrings: _concat,
+}
+
+for k in _MATH_FNS:
+    _DISPATCH[k] = _unary_math

@@ -1,0 +1,5 @@
+"""Planning layer: declarative plan nodes, the meta-wrapper override tree
+(tagging with reasons, per-op config gates, explain), and transition/coalesce
+insertion — the TPU-native analogue of the reference's L5
+(GpuOverrides.scala, RapidsMeta.scala, GpuTransitionOverrides.scala)."""
+from spark_rapids_tpu.plan import nodes  # noqa: F401

@@ -1,0 +1,452 @@
+"""Engine-neutral physical plan nodes.
+
+The reference rewrites Spark's physical plans (SparkPlan). This framework is
+standalone, so it defines its own plan-node vocabulary, which two engines
+consume:
+
+- the CPU engine (``spark_rapids_tpu.cpu.engine``) interprets nodes with
+  pandas/numpy — it is both the fallback path for unsupported nodes and the
+  golden-comparison oracle (the role vanilla Spark plays in the reference's
+  test strategy, SparkQueryCompareTestSuite.scala:153-161),
+- the TPU exec layer (``spark_rapids_tpu.execs``) — the accelerated path the
+  planner (plan/overrides.py) converts replaceable subtrees into, exactly the
+  GpuOverrides convertIfNeeded flow (RapidsMeta.scala:600-615).
+
+Expressions inside nodes are **bound**: ``BoundReference`` ordinals into the
+child's output schema (the reference binds with GpuBindReferences,
+GpuBoundAttribute.scala:97). Output column names live in each node's
+``output_schema``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions.aggregates import AggregateFunction
+from spark_rapids_tpu.expressions.base import Alias, Expression
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
+              "cross")
+
+
+def expr_name(e: Expression, i: int) -> str:
+    if isinstance(e, Alias):
+        return e.alias
+    return f"col{i}"
+
+
+class PlanNode:
+    """Base physical plan node. Immutable tree; children in ``children``."""
+
+    def __init__(self, children: Sequence["PlanNode"]):
+        self.children = list(children)
+
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def with_children(self, children: List["PlanNode"]) -> "PlanNode":
+        import copy
+
+        c = copy.copy(self)
+        c.children = list(children)
+        return c
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return self.tree_string()
+
+
+# --------------------------------------------------------------------------
+# Sources
+
+
+class DataSource:
+    """Leaf data provider. ``read_host()`` returns host-side columns —
+    the CPU engine consumes them directly; the TPU scan exec uploads them
+    (the reference's host read + device decode split,
+    GpuParquetScan.scala:228-265)."""
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def read_host(self):
+        """-> (data: dict name->ndarray, validity: dict name->bool ndarray).
+        String columns are object arrays (None = null)."""
+        raise NotImplementedError
+
+
+class InMemorySource(DataSource):
+    """Host-resident columns (dict name -> numpy array / list), the analogue
+    of a cached relation. ``validity`` maps name -> bool mask."""
+
+    def __init__(self, data: dict, schema: Optional[Schema] = None,
+                 validity: Optional[dict] = None):
+        self.data = data
+        self.validity = validity or {}
+        self._schema = schema or _infer_schema(data)
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def read_host(self):
+        return self.data, self.validity
+
+
+def _infer_schema(data: dict) -> Schema:
+    import numpy as np
+
+    from spark_rapids_tpu.columnar.column import _infer_dtype
+
+    names, types = [], []
+    for k, v in data.items():
+        arr = np.asarray(v)
+        names.append(k)
+        if arr.dtype == object or arr.dtype.kind in "US":
+            types.append(dt.STRING)
+        elif arr.dtype.kind == "M":
+            unit = np.datetime_data(arr.dtype)[0]
+            types.append(dt.DATE if unit == "D" else dt.TIMESTAMP)
+        else:
+            types.append(_infer_dtype(arr.dtype))
+    return Schema(names, types)
+
+
+class ScanNode(PlanNode):
+    """Leaf scan over a DataSource (file sources live in io/ and subclass
+    DataSource; the reference's GpuFileSourceScanExec / GpuBatchScanExec)."""
+
+    def __init__(self, source: DataSource):
+        super().__init__([])
+        self.source = source
+
+    def output_schema(self) -> Schema:
+        return self.source.schema()
+
+    def describe(self) -> str:
+        return f"Scan[{type(self.source).__name__}]"
+
+
+class RangeNode(PlanNode):
+    """spark.range() analogue (GpuRangeExec, basicPhysicalOperators.scala)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 name: str = "id"):
+        super().__init__([])
+        assert step != 0
+        self.start, self.end, self.step = start, end, step
+        self.col_name = name
+
+    def output_schema(self) -> Schema:
+        return Schema([self.col_name], [dt.INT64])
+
+    def describe(self) -> str:
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+# --------------------------------------------------------------------------
+# Row-level ops
+
+
+class ProjectNode(PlanNode):
+    def __init__(self, exprs: List[Expression], child: PlanNode,
+                 names: Optional[List[str]] = None):
+        super().__init__([child])
+        self.exprs = list(exprs)
+        self.names = names or [expr_name(e, i) for i, e in enumerate(exprs)]
+
+    def output_schema(self) -> Schema:
+        return Schema(self.names, [e.dtype for e in self.exprs])
+
+    def describe(self) -> str:
+        return f"Project[{', '.join(self.names)}]"
+
+
+class FilterNode(PlanNode):
+    def __init__(self, condition: Expression, child: PlanNode):
+        super().__init__([child])
+        self.condition = condition
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"Filter[{self.condition!r}]"
+
+
+# --------------------------------------------------------------------------
+# Aggregation
+
+
+@dataclasses.dataclass
+class AggCall:
+    """One named aggregate output: function over bound input expression(s)."""
+
+    fn: AggregateFunction
+    name: str
+
+
+class AggregateNode(PlanNode):
+    """Group-by aggregate. ``grouping`` are bound expressions (usually plain
+    references) into the child; output schema = grouping names then agg
+    names. ``mode`` follows the reference's partial/final split
+    (aggregate.scala:298):
+
+    - "complete": raw input -> final results (single-stage)
+    - "partial":  raw input -> partial columns (update halves)
+    - "final":    partial columns -> final results (merge halves + evaluate)
+    """
+
+    def __init__(self, grouping: List[Expression],
+                 aggs: List[AggCall], child: PlanNode,
+                 mode: str = "complete",
+                 grouping_names: Optional[List[str]] = None):
+        super().__init__([child])
+        assert mode in ("complete", "partial", "final")
+        self.grouping = list(grouping)
+        self.aggs = list(aggs)
+        self.mode = mode
+        self.grouping_names = grouping_names or [
+            expr_name(e, i) for i, e in enumerate(grouping)]
+
+    def output_schema(self) -> Schema:
+        names = list(self.grouping_names)
+        types = [e.dtype for e in self.grouping]
+        if self.mode == "partial":
+            for a in self.aggs:
+                for j, pt in enumerate(a.fn.partial_types()):
+                    names.append(f"{a.name}#p{j}")
+                    types.append(pt)
+        else:
+            for a in self.aggs:
+                names.append(a.name)
+                types.append(a.fn.dtype)
+        return Schema(names, types)
+
+    def describe(self) -> str:
+        return (f"Aggregate[{self.mode}, keys={self.grouping_names}, "
+                f"aggs={[a.name for a in self.aggs]}]")
+
+
+# --------------------------------------------------------------------------
+# Sort / limit / set ops
+
+
+class SortNode(PlanNode):
+    """``specs`` reference child ordinals. ``global_sort`` requires a total
+    order across all partitions (the reference's RequireSingleBatch cliff,
+    GpuSortExec.scala:50 — our exec chunks instead, SURVEY.md §5.7)."""
+
+    def __init__(self, specs: List[SortKeySpec], child: PlanNode,
+                 global_sort: bool = True):
+        super().__init__([child])
+        self.specs = list(specs)
+        self.global_sort = global_sort
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"Sort[{self.specs}, global={self.global_sort}]"
+
+
+class LimitNode(PlanNode):
+    def __init__(self, n: int, child: PlanNode, global_limit: bool = True):
+        super().__init__([child])
+        self.n = n
+        self.global_limit = global_limit
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"Limit[{self.n}]"
+
+
+class UnionNode(PlanNode):
+    """UNION ALL: children must be schema-compatible."""
+
+    def __init__(self, children: List[PlanNode]):
+        super().__init__(children)
+        s0 = children[0].output_schema()
+        for c in children[1:]:
+            assert [t for t in c.output_schema().types] == list(s0.types), \
+                "union children must share types"
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+
+class ExpandNode(PlanNode):
+    """Emits one output row per (input row, projection) — GROUPING SETS /
+    rollup support (GpuExpandExec.scala)."""
+
+    def __init__(self, projections: List[List[Expression]],
+                 child: PlanNode, names: List[str]):
+        super().__init__([child])
+        assert projections
+        self.projections = [list(p) for p in projections]
+        self.names = names
+
+    def output_schema(self) -> Schema:
+        return Schema(self.names, [e.dtype for e in self.projections[0]])
+
+    def describe(self) -> str:
+        return f"Expand[{len(self.projections)} projections]"
+
+
+# --------------------------------------------------------------------------
+# Joins
+
+
+class JoinNode(PlanNode):
+    """Equi-join on key ordinals plus optional residual condition evaluated
+    over the joined row (left columns then right columns — the reference
+    applies conditions as a post-join filter, GpuHashJoin.scala:285-291)."""
+
+    def __init__(self, kind: str, left: PlanNode, right: PlanNode,
+                 left_keys: List[int], right_keys: List[int],
+                 condition: Optional[Expression] = None):
+        super().__init__([left, right])
+        assert kind in JOIN_TYPES, kind
+        assert len(left_keys) == len(right_keys)
+        if kind != "cross":
+            assert left_keys, "equi-join requires keys; use kind='cross'"
+        self.kind = kind
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+
+    def output_schema(self) -> Schema:
+        ls, rs = (c.output_schema() for c in self.children)
+        if self.kind in ("left_semi", "left_anti"):
+            return ls
+        names = list(ls.names) + list(rs.names)
+        ltypes = list(ls.types)
+        rtypes = list(rs.types)
+        return Schema(names, ltypes + rtypes)
+
+    def describe(self) -> str:
+        return (f"Join[{self.kind}, l={self.left_keys}, r={self.right_keys}"
+                + (", cond" if self.condition is not None else "") + "]")
+
+
+# --------------------------------------------------------------------------
+# Window
+
+
+@dataclasses.dataclass
+class WindowFrame:
+    """Row-based frame. Bounds are offsets relative to the current row;
+    None = unbounded. Spark default for aggregates with an order spec is
+    (None, 0) = unboundedPreceding..currentRow
+    (GpuWindowExpression.scala:208-263 frame validation)."""
+
+    lower: Optional[int] = None
+    upper: Optional[int] = 0
+
+
+@dataclasses.dataclass
+class WindowCall:
+    """One window-function output column.
+
+    ``fn`` is 'row_number' | 'rank' | 'dense_rank', a tuple
+    ('lead'|'lag', input_expression), or an AggregateFunction instance
+    (sum/min/max/count/avg evaluated over ``frame``)."""
+
+    fn: object
+    name: str
+    frame: WindowFrame = dataclasses.field(default_factory=WindowFrame)
+    offset: int = 1          # lead/lag
+    default: object = None   # lead/lag fill
+
+
+class WindowNode(PlanNode):
+    """Appends window-function columns. Partitions by ordinals, orders
+    within partitions by specs (GpuWindowExec.scala:92)."""
+
+    def __init__(self, partition_ordinals: List[int],
+                 order_specs: List[SortKeySpec],
+                 calls: List[WindowCall], child: PlanNode):
+        super().__init__([child])
+        self.partition_ordinals = list(partition_ordinals)
+        self.order_specs = list(order_specs)
+        self.calls = list(calls)
+
+    def output_schema(self) -> Schema:
+        s = self.children[0].output_schema()
+        names = list(s.names)
+        types = list(s.types)
+        for c in self.calls:
+            names.append(c.name)
+            if isinstance(c.fn, AggregateFunction):
+                types.append(c.fn.dtype)
+            elif c.fn in ("row_number", "rank", "dense_rank"):
+                types.append(dt.INT32)
+            elif isinstance(c.fn, tuple) and c.fn[0] in ("lead", "lag"):
+                types.append(c.fn[1].dtype)
+            else:
+                raise ValueError(f"unknown window function {c.fn}")
+        return Schema(names, types)
+
+    def describe(self) -> str:
+        return (f"Window[part={self.partition_ordinals}, "
+                f"calls={[c.name for c in self.calls]}]")
+
+
+# --------------------------------------------------------------------------
+# Exchange markers (planner-inserted; single-process engines treat these as
+# repartition points, the distributed runtime maps them onto ICI all_to_all)
+
+
+class ShuffleExchangeNode(PlanNode):
+    """partitioning: ('hash', ordinals) | ('range', specs) |
+    ('round_robin',) | ('single',) — GpuShuffleExchangeExec.scala:146-248."""
+
+    def __init__(self, partitioning: Tuple, num_partitions: int,
+                 child: PlanNode):
+        super().__init__([child])
+        self.partitioning = partitioning
+        self.num_partitions = num_partitions
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return (f"ShuffleExchange[{self.partitioning[0]}, "
+                f"n={self.num_partitions}]")
+
+
+class BroadcastExchangeNode(PlanNode):
+    """Marks the build side of a broadcast join
+    (GpuBroadcastExchangeExec.scala:237)."""
+
+    def __init__(self, child: PlanNode):
+        super().__init__([child])
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+
+# --------------------------------------------------------------------------
+# Helpers
+
+
+def walk(node: PlanNode):
+    yield node
+    for c in node.children:
+        yield from walk(c)

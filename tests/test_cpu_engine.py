@@ -1,0 +1,244 @@
+"""CPU engine tests: the oracle must itself match hand-computed Spark
+semantics before it can judge the TPU path (reference: vanilla Spark is
+trusted implicitly; our pandas/numpy engine needs its own checks)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.cpu.engine import execute_cpu
+from spark_rapids_tpu.expressions import (Add, Alias, Average, BoundReference,
+                                          Cast, Count, Divide, GreaterThan,
+                                          Literal, Max, Min, Multiply, Sum)
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan import nodes as pn
+
+
+def ref(i, t, nullable=True):
+    return BoundReference(i, t, nullable)
+
+
+def scan(data, validity=None):
+    return pn.ScanNode(pn.InMemorySource(data, validity=validity))
+
+
+def test_scan_project_filter():
+    plan = scan({"a": np.array([1, 2, 3, 4], dtype=np.int64),
+                 "b": np.array([10.0, 20.0, 30.0, 40.0])})
+    plan = pn.FilterNode(GreaterThan(ref(0, dt.INT64), Literal(1)), plan)
+    plan = pn.ProjectNode(
+        [Alias(Add(ref(0, dt.INT64), Literal(100)), "x"),
+         Alias(Multiply(ref(1, dt.FLOAT64), Literal(2.0)), "y")], plan)
+    out = execute_cpu(plan)
+    df = out.to_pandas()
+    assert list(df["x"]) == [102, 103, 104]
+    assert list(df["y"]) == [40.0, 60.0, 80.0]
+
+
+def test_filter_null_is_dropped():
+    plan = scan({"a": np.array([1, 2, 3], dtype=np.int64)},
+                validity={"a": np.array([True, False, True])})
+    plan = pn.FilterNode(GreaterThan(ref(0, dt.INT64), Literal(0)), plan)
+    out = execute_cpu(plan)
+    assert out.num_rows == 2
+
+
+def test_groupby_agg():
+    plan = scan({"k": np.array([1, 2, 1, 2, 1], dtype=np.int64),
+                 "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0])})
+    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64)), "s"),
+            pn.AggCall(Count(ref(1, dt.FLOAT64)), "c"),
+            pn.AggCall(Average(ref(1, dt.FLOAT64)), "a")]
+    plan = pn.AggregateNode([ref(0, dt.INT64)], aggs, plan,
+                            grouping_names=["k"])
+    df = execute_cpu(plan).to_pandas().sort_values("k").reset_index(
+        drop=True)
+    assert list(df["k"]) == [1, 2]
+    assert list(df["s"]) == [9.0, 6.0]
+    assert list(df["c"]) == [3, 2]
+    assert list(df["a"]) == [3.0, 3.0]
+
+
+def test_groupby_null_keys_group_together():
+    plan = scan({"k": np.array([1, 1, 2], dtype=np.int64),
+                 "v": np.array([5.0, 6.0, 7.0])},
+                validity={"k": np.array([False, False, True])})
+    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64)), "s")]
+    plan = pn.AggregateNode([ref(0, dt.INT64)], aggs, plan)
+    df = execute_cpu(plan).to_pandas()
+    assert len(df) == 2
+    assert set(df["s"]) == {11.0, 7.0}
+
+
+def test_partial_final_split_matches_complete():
+    data = {"k": np.array([1, 2, 1, 3, 2, 1], dtype=np.int64),
+            "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, np.nan])}
+    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64)), "s"),
+            pn.AggCall(Average(ref(1, dt.FLOAT64)), "a"),
+            pn.AggCall(Count(), "n")]
+    complete = pn.AggregateNode([ref(0, dt.INT64)], aggs, scan(data))
+    partial = pn.AggregateNode([ref(0, dt.INT64)], aggs, scan(data),
+                               mode="partial")
+    final = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        aggs, partial, mode="final")
+    a = execute_cpu(complete).to_pandas().sort_values("col0")
+    b = execute_cpu(final).to_pandas().sort_values("col0")
+    np.testing.assert_array_equal(a["s"].to_numpy(np.float64),
+                                  b["s"].to_numpy(np.float64))
+    np.testing.assert_array_equal(a["n"].to_numpy(), b["n"].to_numpy())
+
+
+def test_global_agg_empty_input():
+    plan = scan({"v": np.array([], dtype=np.float64)})
+    aggs = [pn.AggCall(Count(), "n"), pn.AggCall(Sum(ref(0, dt.FLOAT64)),
+                                                 "s")]
+    plan = pn.AggregateNode([], aggs, plan)
+    df = execute_cpu(plan).to_pandas()
+    assert len(df) == 1
+    assert df["n"][0] == 0
+    assert np.isnan(df["s"][0])
+
+
+def test_sort_nulls_and_nan():
+    plan = scan({"v": np.array([3.0, np.nan, 1.0, 2.0])},
+                validity={"v": np.array([True, True, True, False])})
+    plan = pn.SortNode([SortKeySpec.spark_default(0, ascending=True)], plan)
+    out = execute_cpu(plan)
+    v = out.cols[0]
+    # ASC NULLS FIRST, NaN greatest
+    assert not v.valid_mask()[0]
+    assert v.data[1] == 1.0
+    assert v.data[2] == 3.0
+    assert np.isnan(v.data[3])
+
+
+def test_sort_desc():
+    plan = scan({"v": np.array([3, 1, 2], dtype=np.int64)})
+    plan = pn.SortNode([SortKeySpec.spark_default(0, ascending=False)],
+                       plan)
+    out = execute_cpu(plan)
+    assert list(out.cols[0].data) == [3, 2, 1]
+
+
+@pytest.mark.parametrize("kind,expected", [
+    ("inner", {(1, 10.0, 1, "a"), (2, 20.0, 2, "b")}),
+    ("left_semi", {(1, 10.0), (2, 20.0)}),
+    ("left_anti", {(3, 30.0), (4, None)}),
+])
+def test_joins(kind, expected):
+    left = scan({"k": np.array([1, 2, 3, 4], dtype=np.int64),
+                 "v": np.array([10.0, 20.0, 30.0, 40.0])},
+                validity={"k": np.array([True, True, True, False])})
+    right = scan({"k2": np.array([1, 2, 5], dtype=np.int64),
+                  "s": np.array(["a", "b", "c"], dtype=object)})
+    plan = pn.JoinNode(kind, left, right, [0], [0])
+    df = execute_cpu(plan).to_pandas()
+    got = set()
+    for _, row in df.iterrows():
+        vals = tuple(None if row.isna()[c] else row[c] for c in df.columns)
+        got.add(vals)
+    if kind == "left_anti":
+        # row 4's key is null -> never matches -> kept with its null key
+        assert got == {(3, 30.0), (None, 40.0)}
+    elif kind == "left_semi":
+        assert got == {(1, 10.0), (2, 20.0)}
+    else:
+        assert got == expected
+
+
+def test_left_join_pads_nulls():
+    left = scan({"k": np.array([1, 9], dtype=np.int64)})
+    right = scan({"k2": np.array([1], dtype=np.int64),
+                  "w": np.array([100], dtype=np.int64)})
+    plan = pn.JoinNode("left", left, right, [0], [0])
+    df = execute_cpu(plan).to_pandas().sort_values("k")
+    assert df["w"].tolist()[0] == 100
+    assert df["w"].isna().tolist() == [False, True]
+
+
+def test_join_condition():
+    left = scan({"k": np.array([1, 1], dtype=np.int64),
+                 "v": np.array([5, 50], dtype=np.int64)})
+    right = scan({"k2": np.array([1], dtype=np.int64),
+                  "w": np.array([10], dtype=np.int64)})
+    cond = GreaterThan(ref(3, dt.INT64), ref(1, dt.INT64))  # w > v
+    plan = pn.JoinNode("inner", left, right, [0], [0], condition=cond)
+    df = execute_cpu(plan).to_pandas()
+    assert len(df) == 1
+    assert df["v"][0] == 5
+
+
+def test_union_limit():
+    a = scan({"x": np.array([1, 2], dtype=np.int64)})
+    b = scan({"x": np.array([3, 4], dtype=np.int64)})
+    plan = pn.LimitNode(3, pn.UnionNode([a, b]))
+    df = execute_cpu(plan).to_pandas()
+    assert df["x"].tolist() == [1, 2, 3]
+
+
+def test_window_row_number_and_running_sum():
+    plan = scan({"p": np.array([1, 1, 1, 2, 2], dtype=np.int64),
+                 "o": np.array([3, 1, 2, 2, 1], dtype=np.int64),
+                 "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0])})
+    calls = [pn.WindowCall("row_number", "rn"),
+             pn.WindowCall(Sum(ref(2, dt.FLOAT64)), "rs",
+                           frame=pn.WindowFrame(None, 0)),
+             pn.WindowCall(("lag", ref(1, dt.INT64)), "lg")]
+    plan = pn.WindowNode([0], [SortKeySpec.spark_default(1)], calls, plan)
+    df = execute_cpu(plan).to_pandas()
+    # partition 1 ordered by o: rows with o=1,2,3 -> v=2,3,1
+    p1 = df[df["p"] == 1].sort_values("o")
+    assert p1["rn"].tolist() == [1, 2, 3]
+    assert p1["rs"].tolist() == [2.0, 5.0, 6.0]
+    assert p1["lg"].isna().tolist() == [True, False, False]
+    assert p1["lg"].tolist()[1:] == [1, 2]
+
+
+def test_expand():
+    plan = scan({"a": np.array([1, 2], dtype=np.int64)})
+    projections = [[ref(0, dt.INT64), Literal(0)],
+                   [ref(0, dt.INT64), Literal(1)]]
+    plan = pn.ExpandNode(projections, plan, ["a", "tag"])
+    df = execute_cpu(plan).to_pandas()
+    assert len(df) == 4
+    assert set(zip(df["a"], df["tag"])) == {(1, 0), (1, 1), (2, 0), (2, 1)}
+
+
+def test_range():
+    df = execute_cpu(pn.RangeNode(0, 10, 3)).to_pandas()
+    assert df["id"].tolist() == [0, 3, 6, 9]
+
+
+def test_min_max_nan_semantics():
+    plan = scan({"k": np.array([1, 1, 2], dtype=np.int64),
+                 "v": np.array([np.nan, 1.0, np.nan])})
+    aggs = [pn.AggCall(Min(ref(1, dt.FLOAT64)), "lo"),
+            pn.AggCall(Max(ref(1, dt.FLOAT64)), "hi")]
+    plan = pn.AggregateNode([ref(0, dt.INT64)], aggs, plan,
+                            grouping_names=["k"])
+    df = execute_cpu(plan).to_pandas().sort_values("k").reset_index(
+        drop=True)
+    # Spark: NaN is greatest -> min avoids NaN, max picks it
+    assert df["lo"][0] == 1.0
+    assert np.isnan(df["hi"][0])
+    assert np.isnan(df["lo"][1]) and np.isnan(df["hi"][1])
+
+
+def test_divide_by_zero_null():
+    plan = scan({"a": np.array([1.0, 2.0]),
+                 "b": np.array([0.0, 2.0])})
+    plan = pn.ProjectNode(
+        [Alias(Divide(ref(0, dt.FLOAT64), ref(1, dt.FLOAT64)), "q")], plan)
+    df = execute_cpu(plan).to_pandas()
+    assert np.isnan(df["q"][0])  # null -> NaN in pandas float
+    assert df["q"][1] == 1.0
+
+
+def test_cast_string_roundtrip():
+    plan = scan({"s": np.array(["12", "x", "7"], dtype=object)})
+    plan = pn.ProjectNode(
+        [Alias(Cast(ref(0, dt.STRING), dt.INT64), "i")], plan)
+    out = execute_cpu(plan)
+    c = out.cols[0]
+    assert c.data[0] == 12 and c.data[2] == 7
+    assert not c.valid_mask()[1]
