@@ -1,0 +1,7 @@
+"""TPU exec layer (reference L4, GpuExec.scala): physical operators that
+stream ColumnarBatches through jit-compiled kernels. Each exec declares its
+batching contract via CoalesceGoal (GpuExec.scala:71-86) and reports simple
+metrics (GpuMetricNames analogue)."""
+from spark_rapids_tpu.execs.base import TpuExec, collect  # noqa: F401
+from spark_rapids_tpu.execs.batching import (CoalesceBatchesExec,  # noqa
+                                             RequireSingleBatch, TargetSize)

@@ -1,0 +1,180 @@
+"""Hash-aggregate exec: streaming per-batch aggregation with a running
+merge loop.
+
+Reference flow (aggregate.scala:380-478): input-project each batch ->
+per-batch aggregation -> concat with the running aggregate -> merge-
+aggregate; after the last batch, final projection (:503-545) and the
+empty-input default-values path (:488-501). On TPU the per-batch aggregate
+is the sort-based segmented kernel (ops/groupby.py) and all halves run as
+jit-compiled XLA programs.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression)
+from spark_rapids_tpu.expressions.compiler import CompiledProjection
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.filter import rebucket
+from spark_rapids_tpu.ops.groupby import AggSpec, groupby_aggregate, \
+    reduce_aggregate
+from spark_rapids_tpu.plan.nodes import AggCall
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+
+class HashAggregateExec(TpuExec):
+    """Modes (GpuHashAggregateExec / partial-final split):
+
+    - complete: raw -> results in one exec
+    - partial:  raw -> partial columns (update halves), feeds an exchange
+    - final:    partials -> merged + evaluated results
+    """
+
+    def __init__(self, grouping: List[Expression], aggs: List[AggCall],
+                 child: TpuExec, schema: Schema, mode: str = "complete",
+                 conf=None):
+        super().__init__([child], schema)
+        assert mode in ("complete", "partial", "final")
+        self.grouping = grouping
+        self.aggs = aggs
+        self.mode = mode
+        self.conf = conf
+        self._build()
+
+    def _build(self):
+        nkeys = len(self.grouping)
+        if self.mode in ("complete", "partial"):
+            # input projection: keys then each agg's input once per update op
+            proj_exprs: List[Expression] = list(self.grouping)
+            specs: List[AggSpec] = []
+            for call in self.aggs:
+                fn = call.fn
+                if fn.input is not None:
+                    ordinal = len(proj_exprs)
+                    proj_exprs.append(fn.input)
+                else:
+                    ordinal = -1
+                for op in fn.update_ops():
+                    specs.append(AggSpec(op, ordinal
+                                         if op != "count_star" else -1))
+            self.input_proj: Optional[CompiledProjection] = \
+                CompiledProjection(proj_exprs, self.conf)
+            self.input_types = [e.dtype for e in proj_exprs]
+            self.first_specs = specs
+        else:
+            # final mode: child emits keys then partial columns
+            self.input_proj = None
+            self.input_types = list(self.children[0].schema.types)
+            specs = []
+            p = nkeys
+            for call in self.aggs:
+                for op in call.fn.merge_ops():
+                    specs.append(AggSpec(op, p))
+                    p += 1
+            self.first_specs = specs
+
+        # merge specs re-aggregate this exec's own partial output (running
+        # concat+merge loop): partial column i sits at nkeys+i.
+        self.merge_specs: List[AggSpec] = []
+        p = nkeys
+        for call in self.aggs:
+            for op in call.fn.merge_ops():
+                self.merge_specs.append(AggSpec(op, p))
+                p += 1
+        self.partial_types: List[dt.DType] = []
+        for call in self.aggs:
+            self.partial_types.extend(call.fn.partial_types())
+
+        # final projection over (keys..., partials...)
+        if self.mode in ("complete", "final"):
+            exprs: List[Expression] = [
+                BoundReference(i, e.dtype) for i, e in
+                enumerate(self.grouping)]
+            base = nkeys
+            for call in self.aggs:
+                nparts = len(call.fn.partial_types())
+                refs = [BoundReference(base + j, t) for j, t in
+                        enumerate(call.fn.partial_types())]
+                exprs.append(Alias(call.fn.evaluate(refs), call.name))
+                base += nparts
+            self.final_proj: Optional[CompiledProjection] = \
+                CompiledProjection(exprs, self.conf)
+        else:
+            self.final_proj = None
+
+    @property
+    def coalesce_after(self):
+        from spark_rapids_tpu.execs.batching import TargetSize
+
+        return TargetSize(1 << 30)
+
+    # ------------------------------------------------------------------
+
+    def _agg_batch(self, batch: ColumnarBatch, specs: List[AggSpec],
+                   types: List[dt.DType]) -> ColumnarBatch:
+        nkeys = len(self.grouping)
+        if nkeys == 0:
+            out, _ = reduce_aggregate(batch, specs, types)
+            return out
+        out, _ = groupby_aggregate(batch, list(range(nkeys)), specs, types)
+        return out
+
+    def _merge_types(self) -> List[dt.DType]:
+        return [e.dtype for e in self.grouping] + self.partial_types
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            running: Optional[ColumnarBatch] = None
+            saw_input = False
+            for b in self.children[0].execute(partition):
+                if b.realized_num_rows() == 0:
+                    continue
+                saw_input = True
+                if self.input_proj is not None:
+                    b = self.input_proj(b)
+                with TraceRange("HashAggregateExec.updateAgg"):
+                    part = self._agg_batch(b, self.first_specs,
+                                           self.input_types)
+                if running is None:
+                    running = part
+                else:
+                    with TraceRange("HashAggregateExec.mergeAgg"):
+                        merged_in = concat_batches([running, part])
+                        running = self._agg_batch(merged_in,
+                                                  self.merge_specs,
+                                                  self._merge_types())
+            if running is None:
+                if self.grouping or (self.mode == "final" and not saw_input):
+                    # grouped agg over empty input -> no rows
+                    yield ColumnarBatch.empty(self.schema)
+                    return
+                running = self._empty_global_partials()
+            if self.final_proj is not None:
+                with TraceRange("HashAggregateExec.finalProject"):
+                    running = self.final_proj(running)
+            yield rebucket(running)
+        return timed(self.metrics, it())
+
+    def _empty_global_partials(self) -> ColumnarBatch:
+        """Default partials for a global aggregate over zero rows: count=0,
+        everything else null (aggregate.scala:488-501)."""
+        import numpy as np
+
+        from spark_rapids_tpu.ops.buckets import bucket_capacity
+
+        cap = bucket_capacity(1)
+        cols = []
+        for call in self.aggs:
+            for ptype, pop in zip(call.fn.partial_types(),
+                                  call.fn.update_ops()):
+                if pop in ("count", "count_star"):
+                    cols.append(Column.from_numpy(
+                        np.zeros(cap, dtype=np.int64), dtype=dt.INT64))
+                else:
+                    cols.append(Column.all_null(ptype, cap))
+        return ColumnarBatch(cols, 1)

@@ -1,0 +1,106 @@
+"""Exec base: streaming columnar operators.
+
+The reference's GpuExec contract (GpuExec.scala:65-137):
+``doExecuteColumnar(): RDD[ColumnarBatch]`` + metrics + batching goals.
+Here: ``execute(partition) -> Iterator[ColumnarBatch]`` over
+``num_partitions`` logical partitions (the single-process analogue of
+Spark's task partitions; the distributed runtime maps partitions onto mesh
+devices).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+
+
+class Metrics:
+    """num_output_rows / num_output_batches / op_time_ns per exec
+    (GpuMetricNames, GpuExec.scala:27-55)."""
+
+    def __init__(self):
+        self.num_output_rows = 0
+        self.num_output_batches = 0
+        self.op_time_ns = 0
+
+    def record(self, batch: ColumnarBatch, elapsed_ns: int = 0):
+        self.num_output_batches += 1
+        self.num_output_rows += batch.realized_num_rows()
+        self.op_time_ns += elapsed_ns
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    def __init__(self, children: List["TpuExec"], schema: Schema):
+        self.children = children
+        self.schema = schema
+        self.metrics = Metrics()
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    @property
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions
+        return 1
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    # -- batching contract (GpuExec.scala:71-86) --------------------------
+
+    @property
+    def coalesce_after(self) -> Optional[object]:
+        """Goal describing batches this exec OUTPUTS (None = don't care)."""
+        return None
+
+    @property
+    def children_coalesce_goal(self) -> List[Optional[object]]:
+        """Goal each child's input must satisfy."""
+        return [None] * len(self.children)
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.name]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def all_metrics(self) -> Dict[str, Metrics]:
+        out = {self.name: self.metrics}
+        for c in self.children:
+            out.update(c.all_metrics())
+        return out
+
+
+def timed(metrics: Metrics, it: Iterator[ColumnarBatch]
+          ) -> Iterator[ColumnarBatch]:
+    while True:
+        t0 = time.perf_counter_ns()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        metrics.record(batch, time.perf_counter_ns() - t0)
+        yield batch
+
+
+def collect(exec_: TpuExec):
+    """Run all partitions and return one pandas DataFrame — the
+    GpuColumnarToRowExec boundary (GpuColumnarToRowExec.scala:111)."""
+    import pandas as pd
+
+    frames = []
+    for p in range(exec_.num_partitions):
+        for batch in exec_.execute(p):
+            if batch.realized_num_rows() == 0:
+                continue
+            frames.append(batch.to_pandas(exec_.schema))
+    if not frames:
+        cols = {n: pd.Series([], dtype=object)
+                for n in exec_.schema.names}
+        return pd.DataFrame(cols)
+    return pd.concat(frames, ignore_index=True)

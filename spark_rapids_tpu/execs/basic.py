@@ -1,0 +1,208 @@
+"""Basic execs: scan, project, filter, range, limit, union, expand, and the
+CPU-fallback bridge (reference basicPhysicalOperators.scala,
+GpuExpandExec.scala, and the transition execs)."""
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.execs import interop
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.expressions.base import Expression
+from spark_rapids_tpu.expressions.compiler import (CompiledFilter,
+                                                   CompiledProjection)
+from spark_rapids_tpu.memory import semaphore
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.plan.nodes import DataSource
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+
+class ScanExec(TpuExec):
+    """Host read -> sliced device uploads (GpuFileSourceScanExec +
+    the semaphore acquire before first device touch, GpuSemaphore.scala:106).
+    Rows per upload slice come from the batch-size config."""
+
+    def __init__(self, source: DataSource, schema: Schema,
+                 batch_rows: int = 1 << 20):
+        super().__init__([], schema)
+        self.source = source
+        self.batch_rows = batch_rows
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            data, validity = self.source.read_host()
+            first = self.schema.names[0] if len(self.schema) else None
+            n = len(np.asarray(data[first])) if first else 0
+            if n == 0:
+                yield ColumnarBatch.empty(self.schema)
+                return
+            with semaphore.get():
+                for start in range(0, n, self.batch_rows):
+                    end = min(start + self.batch_rows, n)
+                    with TraceRange("ScanExec.upload"):
+                        yield interop.host_to_batch(data, validity,
+                                                    self.schema, start, end)
+        return timed(self.metrics, it())
+
+
+class ProjectExec(TpuExec):
+    """One fused XLA computation per batch (GpuProjectExec,
+    basicPhysicalOperators.scala:35-95)."""
+
+    def __init__(self, exprs: List[Expression], child: TpuExec,
+                 schema: Schema, conf=None):
+        super().__init__([child], schema)
+        self.projection = CompiledProjection(exprs, conf)
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            for b in self.children[0].execute(partition):
+                with TraceRange("ProjectExec"):
+                    yield self.projection(b)
+        return timed(self.metrics, it())
+
+
+class FilterExec(TpuExec):
+    """Mask + compact in one jitted kernel (GpuFilterExec,
+    basicPhysicalOperators.scala:100-130)."""
+
+    def __init__(self, condition: Expression, child: TpuExec, conf=None):
+        super().__init__([child], child.schema)
+        self.filter = CompiledFilter(condition, conf)
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            for b in self.children[0].execute(partition):
+                with TraceRange("FilterExec"):
+                    yield self.filter(b)
+        return timed(self.metrics, it())
+
+
+class RangeExec(TpuExec):
+    """Generates batches on device (GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int, schema: Schema,
+                 batch_rows: int = 1 << 20):
+        super().__init__([], schema)
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            total = max(0, math.ceil((self.end - self.start) / self.step))
+            if total == 0:
+                yield ColumnarBatch.empty(self.schema)
+                return
+            for off in range(0, total, self.batch_rows):
+                cnt = min(self.batch_rows, total - off)
+                lo = self.start + off * self.step
+                vals = np.arange(
+                    lo, lo + cnt * self.step, self.step, dtype=np.int64)
+                yield ColumnarBatch(
+                    [Column.from_numpy(vals, dtype=dt.INT64)], cnt)
+        return timed(self.metrics, it())
+
+
+class LocalLimitExec(TpuExec):
+    """Slices batches until n rows have been emitted (per partition)."""
+
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__([child], child.schema)
+        self.n = n
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            remaining = self.n
+            for b in self.children[0].execute(partition):
+                if remaining <= 0:
+                    break
+                rows = b.realized_num_rows()
+                if rows <= remaining:
+                    remaining -= rows
+                    yield b
+                else:
+                    yield b.slice(0, remaining)
+                    remaining = 0
+        return timed(self.metrics, it())
+
+
+class UnionExec(TpuExec):
+    """Concatenates children lazily (GpuOverrides.scala:1777-1833 union).
+    Child partition counts may differ; partitions are concatenated
+    child-major."""
+
+    def __init__(self, children: List[TpuExec], schema: Schema):
+        super().__init__(children, schema)
+
+    @property
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions for c in self.children)
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            p = partition
+            for c in self.children:
+                if p < c.num_partitions:
+                    yield from c.execute(p)
+                    return
+                p -= c.num_partitions
+            raise IndexError(partition)
+        return timed(self.metrics, it())
+
+
+class ExpandExec(TpuExec):
+    """Per input batch, evaluate each projection then concatenate
+    (GpuExpandExec.scala)."""
+
+    def __init__(self, projections: List[List[Expression]], child: TpuExec,
+                 schema: Schema, conf=None):
+        super().__init__([child], schema)
+        self.projections = [CompiledProjection(p, conf)
+                            for p in projections]
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            for b in self.children[0].execute(partition):
+                parts = [proj(b) for proj in self.projections]
+                with TraceRange("ExpandExec.concat"):
+                    yield concat_batches(parts)
+        return timed(self.metrics, it())
+
+
+class CpuFallbackExec(TpuExec):
+    """Executes a plan subtree on the CPU engine and uploads the result —
+    the planner inserts this around nodes that can't go on TPU, with the
+    tag reasons recorded (the reference's convertIfNeeded keeps such
+    subtrees as CPU Spark plans, RapidsMeta.scala:600-615)."""
+
+    def __init__(self, plan_node, schema: Schema, reasons: List[str],
+                 tpu_children: Optional[List[TpuExec]] = None,
+                 batch_rows: int = 1 << 20):
+        super().__init__(tpu_children or [], schema)
+        self.plan_node = plan_node
+        self.reasons = reasons
+        self.batch_rows = batch_rows
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.cpu.engine import execute_cpu
+
+        def it():
+            frame = execute_cpu(self.plan_node)
+            n = frame.num_rows
+            if n == 0:
+                yield interop.frame_to_batch(frame)
+                return
+            for start in range(0, n, self.batch_rows):
+                end = min(start + self.batch_rows, n)
+                idx = np.arange(start, end)
+                yield interop.frame_to_batch(frame.take(idx))
+        return timed(self.metrics, it())

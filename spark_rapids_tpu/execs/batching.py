@@ -1,0 +1,99 @@
+"""Batch coalescing: the CoalesceGoal lattice and the coalesce exec.
+
+Reference: GpuCoalesceBatches.scala — ``RequireSingleBatch`` vs
+``TargetSize`` with max/satisfies lattice ops (:91-127) and an iterator that
+concatenates input batches up to the goal (:129-490). TPU-specific twist:
+concatenation lands on *bucketed* capacities (ops/buckets.py) so XLA
+recompiles O(log n) distinct shapes, not one per batch size.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.ops.concat import concat_batches
+
+
+class CoalesceGoal:
+    def satisfies(self, other: "CoalesceGoal") -> bool:
+        raise NotImplementedError
+
+
+class _RequireSingleBatch(CoalesceGoal):
+    """The whole partition must arrive as one batch (global sort, build
+    side of a hash join...). GpuCoalesceBatches.scala:91-103."""
+
+    def satisfies(self, other: CoalesceGoal) -> bool:
+        return True  # single batch satisfies any size target
+
+    def __repr__(self):
+        return "RequireSingleBatch"
+
+
+RequireSingleBatch = _RequireSingleBatch()
+
+
+class TargetSize(CoalesceGoal):
+    def __init__(self, target_bytes: int):
+        self.target_bytes = target_bytes
+
+    def satisfies(self, other: CoalesceGoal) -> bool:
+        if other is RequireSingleBatch or isinstance(other,
+                                                     _RequireSingleBatch):
+            return False
+        return self.target_bytes >= other.target_bytes  # type: ignore
+
+    def __repr__(self):
+        return f"TargetSize({self.target_bytes})"
+
+
+def max_goal(a: Optional[CoalesceGoal],
+             b: Optional[CoalesceGoal]) -> Optional[CoalesceGoal]:
+    """Least upper bound (GpuCoalesceBatches.scala:105-127)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, _RequireSingleBatch) or isinstance(b,
+                                                        _RequireSingleBatch):
+        return RequireSingleBatch
+    return a if a.target_bytes >= b.target_bytes else b
+
+
+def coalesce_iterator(it: Iterator[ColumnarBatch], goal: CoalesceGoal
+                      ) -> Iterator[ColumnarBatch]:
+    """Concatenate incoming batches until the goal is met
+    (AbstractGpuCoalesceIterator, GpuCoalesceBatches.scala:129)."""
+    if isinstance(goal, _RequireSingleBatch):
+        batches = [b for b in it]
+        if batches:
+            yield concat_batches(batches)
+        return
+    assert isinstance(goal, TargetSize)
+    pending: List[ColumnarBatch] = []
+    pending_bytes = 0
+    for b in it:
+        sz = b.device_memory_size()
+        if pending and pending_bytes + sz > goal.target_bytes:
+            yield concat_batches(pending)
+            pending, pending_bytes = [], 0
+        pending.append(b)
+        pending_bytes += sz
+    if pending:
+        yield concat_batches(pending)
+
+
+class CoalesceBatchesExec(TpuExec):
+    def __init__(self, child: TpuExec, goal: CoalesceGoal):
+        super().__init__([child], child.schema)
+        self.goal = goal
+
+    @property
+    def coalesce_after(self):
+        return self.goal
+
+    def execute(self, partition: int = 0):
+        return timed(self.metrics,
+                     coalesce_iterator(self.children[0].execute(partition),
+                                       self.goal))

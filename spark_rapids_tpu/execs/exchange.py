@@ -1,0 +1,129 @@
+"""Exchange execs: shuffle repartitioning and broadcast.
+
+Reference: GpuShuffleExchangeExecBase partitions batches on device then
+registers (partId, subBatch) pairs with the caching shuffle writer
+(GpuShuffleExchangeExec.scala:146-248, RapidsShuffleInternalManager.scala:
+90-155) — sub-batches are catalog-registered and spillable at priority 0;
+readers take local device hits zero-copy (RapidsCachingReader.scala:59-145).
+
+Single-process version: the shuffle "transport" is a per-exec block store of
+SpillableBatch handles (the local-catalog-hit path). The multi-host bulk
+path rides the mesh all_to_all in parallel/shuffle.py.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.memory import priorities
+from spark_rapids_tpu.memory.spillable import SpillableBatch
+from spark_rapids_tpu.ops import partition as part_ops
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+
+class ShuffleExchangeExec(TpuExec):
+    """partitioning: ('hash', key_ordinals) | ('range', specs) |
+    ('round_robin',) | ('single',)."""
+
+    def __init__(self, partitioning: Tuple, num_out_partitions: int,
+                 child: TpuExec):
+        super().__init__([child], child.schema)
+        self.partitioning = partitioning
+        self.num_out_partitions = num_out_partitions
+        # block store: output partition -> spillable sub-batches
+        self._blocks: Optional[Dict[int, List[SpillableBatch]]] = None
+
+    @property
+    def num_partitions(self) -> int:
+        return self.num_out_partitions
+
+    def _partition_batch(self, b: ColumnarBatch
+                         ) -> Tuple[ColumnarBatch, np.ndarray]:
+        kind = self.partitioning[0]
+        types = list(self.schema.types)
+        if kind == "hash":
+            return part_ops.hash_partition(b, list(self.partitioning[1]),
+                                           types, self.num_out_partitions)
+        if kind == "round_robin":
+            return part_ops.round_robin_partition(b,
+                                                  self.num_out_partitions)
+        if kind == "range":
+            specs: List[SortKeySpec] = list(self.partitioning[1])
+            bounds = self.partitioning[2]
+            return part_ops.range_partition(b, specs, types, bounds,
+                                            self.num_out_partitions)
+        if kind == "single":
+            return part_ops.single_partition(b)
+        raise ValueError(kind)
+
+    def _materialize(self) -> None:
+        """Map-side write: run the child once, cache partitioned blocks
+        (RapidsCachingWriter.write)."""
+        if self._blocks is not None:
+            return
+        blocks: Dict[int, List[SpillableBatch]] = {
+            p: [] for p in range(self.num_out_partitions)}
+        for in_p in range(self.children[0].num_partitions):
+            for b in self.children[0].execute(in_p):
+                if b.realized_num_rows() == 0:
+                    continue
+                with TraceRange("ShuffleExchangeExec.partition"):
+                    sorted_b, counts = self._partition_batch(b)
+                    subs = part_ops.slice_partitions(sorted_b, counts)
+                for p, sub in enumerate(subs):
+                    if sub is None:
+                        continue
+                    blocks[p].append(SpillableBatch(
+                        sub, priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
+        self._blocks = blocks
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            self._materialize()
+            handles = self._blocks[partition]
+            if not handles:
+                yield ColumnarBatch.empty(self.schema)
+                return
+            for h in handles:
+                with h.acquired() as batch:
+                    yield batch
+        return timed(self.metrics, it())
+
+
+class BroadcastExchangeExec(TpuExec):
+    """Materializes the whole child once as a single batch, replayed to
+    every consumer partition (GpuBroadcastExchangeExec.scala:237-380; the
+    cached batch is spillable like the reference's host-serialized form)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__([child], child.schema)
+        self._cached: Optional[SpillableBatch] = None
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def _materialize(self) -> SpillableBatch:
+        if self._cached is None:
+            batches = []
+            for p in range(self.children[0].num_partitions):
+                batches.extend(b for b in self.children[0].execute(p)
+                               if b.realized_num_rows() > 0)
+            if batches:
+                merged = concat_batches(batches)
+            else:
+                merged = ColumnarBatch.empty(self.schema)
+            self._cached = SpillableBatch(
+                merged, priorities.INPUT_FROM_SHUFFLE_PRIORITY)
+        return self._cached
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            with self._materialize().acquired() as batch:
+                yield batch
+        return timed(self.metrics, it())

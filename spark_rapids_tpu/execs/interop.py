@@ -1,0 +1,80 @@
+"""Host <-> device columnar conversions: the transition layer
+(GpuRowToColumnarExec.scala / GpuColumnarToRowExec.scala /
+HostColumnarToGpu.scala analogues). Host-side data is numpy (+validity);
+device side is the bucketed ColumnarBatch."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+
+
+def host_to_batch(data: Dict[str, np.ndarray],
+                  validity: Dict[str, Optional[np.ndarray]],
+                  schema: Schema, start: int = 0,
+                  end: Optional[int] = None) -> ColumnarBatch:
+    """Upload a row range of host columns (the device-upload half of the
+    reference's scan path, GpuParquetScan.scala host buffer -> readParquet)."""
+    cols = []
+    n = None
+    for name, typ in zip(schema.names, schema.types):
+        arr = np.asarray(data[name])
+        v = validity.get(name)
+        sl = slice(start, end)
+        arr = arr[sl]
+        v = None if v is None else np.asarray(v, dtype=bool)[sl]
+        n = len(arr)
+        if typ is dt.STRING:
+            vals = [None if (v is not None and not v[i]) or arr[i] is None
+                    else str(arr[i]) for i in range(n)]
+            cols.append(StringColumn.from_strings(vals))
+        else:
+            if arr.dtype.kind == "M":
+                unit = np.datetime_data(arr.dtype)[0]
+                arr = (arr.astype("datetime64[D]").astype(np.int32)
+                       if typ is dt.DATE else
+                       arr.astype("datetime64[us]").astype(np.int64))
+            cols.append(Column.from_numpy(arr.astype(typ.np_dtype),
+                                          dtype=typ, validity=v))
+    return ColumnarBatch(cols, n or 0)
+
+
+def frame_to_batch(frame) -> ColumnarBatch:
+    """CpuFrame (cpu/engine.py) -> device batch: the HostColumnarToGpu
+    boundary when a CPU-fallback subtree feeds a TPU subtree."""
+    cols = []
+    for c in frame.cols:
+        valid = c.valid_mask()
+        if c.dtype is dt.STRING:
+            vals = [c.data[i] if valid[i] else None
+                    for i in range(frame.num_rows)]
+            cols.append(StringColumn.from_strings(vals))
+        else:
+            v = None if c.validity is None else valid
+            cols.append(Column.from_numpy(
+                np.asarray(c.data, dtype=c.dtype.np_dtype),
+                dtype=c.dtype, validity=v))
+    return ColumnarBatch(cols, frame.num_rows)
+
+
+def batch_to_frame(batch: ColumnarBatch, schema: Schema):
+    """Device batch -> CpuFrame: the GpuBringBackToHost boundary when a TPU
+    subtree feeds a CPU-fallback operator."""
+    from spark_rapids_tpu.cpu.engine import CpuFrame
+    from spark_rapids_tpu.cpu.evaluator import CV
+
+    n = batch.realized_num_rows()
+    cols = []
+    for c, typ in zip(batch.columns, schema.types):
+        data, validity = c.to_numpy(n)
+        if typ is dt.STRING:
+            data = np.asarray(data, dtype=object)
+            if validity is None:
+                validity = np.array([x is not None for x in data],
+                                    dtype=bool)
+        cols.append(CV(typ, data, validity))
+    return CpuFrame(schema, cols, n)

@@ -1,0 +1,116 @@
+"""Join execs.
+
+Reference: GpuHashJoin (shims/spark300/.../GpuHashJoin.scala:302-318) builds
+one side, streams the other through cuDF join kernels; conditions are
+post-join filters (:285-291); SMJ is replaced by shuffled hash join
+(GpuSortMergeJoinExec.scala). TPU equivalents use the sort-probe equi-join
+kernel (ops/join.py) — no device hash tables, XLA sorts instead.
+
+- BroadcastHashJoinExec: build side fully materialized (whole child), probe
+  side streamed per batch. Safe for inner/left/semi/anti with a right
+  build; full joins need both sides whole.
+- ShuffledHashJoinExec: same kernel after both sides were hash-partitioned
+  by an exchange, per-partition build.
+- Conditioned outer joins fall back at the planner (the kernel applies
+  conditions post-join, valid only for inner/cross).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.execs.batching import RequireSingleBatch
+from spark_rapids_tpu.expressions.base import Expression
+from spark_rapids_tpu.expressions.compiler import CompiledFilter
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.join import cross_join, equi_join
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+_KIND_MAP = {"inner": "inner", "left": "left", "left_semi": "leftsemi",
+             "left_anti": "leftanti", "full": "full"}
+
+
+class HashJoinExec(TpuExec):
+    """Build-side = children[1] (right); streams children[0] (left).
+    ``right`` joins are planned as flipped ``left`` joins by the planner
+    (Spark310-style buildSide handling lives there too)."""
+
+    def __init__(self, kind: str, left: TpuExec, right: TpuExec,
+                 left_keys: List[int], right_keys: List[int],
+                 schema: Schema, condition: Optional[Expression] = None,
+                 conf=None):
+        super().__init__([left, right], schema)
+        assert kind in _KIND_MAP or kind == "cross", kind
+        if condition is not None:
+            assert kind in ("inner", "cross"), \
+                "conditioned outer joins must fall back (planner bug)"
+        self.kind = kind
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.condition = CompiledFilter(condition, conf) \
+            if condition is not None else None
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    @property
+    def children_coalesce_goal(self):
+        # build side must arrive whole; full joins also need the stream
+        # side whole (unmatched-build emission happens once)
+        stream_goal = RequireSingleBatch if self.kind == "full" else None
+        return [stream_goal, RequireSingleBatch]
+
+    def _build_side(self, partition: int) -> ColumnarBatch:
+        batches = [b for b in self.children[1].execute(partition)
+                   if b.realized_num_rows() > 0]
+        if not batches:
+            return ColumnarBatch.empty(self.children[1].schema)
+        return concat_batches(batches) if len(batches) > 1 else batches[0]
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        left_types = list(self.children[0].schema.types)
+        right_types = list(self.children[1].schema.types)
+
+        def it():
+            build = self._build_side(partition)
+            if self.kind == "full":
+                # unmatched-build rows are emitted exactly once, so the
+                # stream side must arrive as one batch
+                batches = [b for b in self.children[0].execute(partition)]
+                stream_batches = [concat_batches(batches) if batches else
+                                  ColumnarBatch.empty(
+                                      self.children[0].schema)]
+            else:
+                stream_batches = self.children[0].execute(partition)
+            saw = False
+            for b in stream_batches:
+                if b.realized_num_rows() == 0 and saw:
+                    continue
+                saw = True
+                with TraceRange(f"HashJoinExec.{self.kind}"):
+                    if self.kind == "cross":
+                        out, _ = cross_join(b, build, left_types,
+                                            right_types)
+                    else:
+                        out, _ = equi_join(
+                            b, build, self.left_keys, self.right_keys,
+                            left_types, right_types,
+                            join_type=_KIND_MAP[self.kind])
+                if self.condition is not None:
+                    out = self.condition(out)
+                yield out
+        return timed(self.metrics, it())
+
+
+class BroadcastHashJoinExec(HashJoinExec):
+    """Identical kernel; the build child is a BroadcastExchangeExec that
+    materializes once and replays per partition
+    (GpuBroadcastHashJoinExec)."""
+
+
+class ShuffledHashJoinExec(HashJoinExec):
+    """Both children sit below hash ShuffleExchangeExecs on the same keys,
+    so partition p of each side holds co-partitioned rows
+    (GpuShuffledHashJoinExec)."""

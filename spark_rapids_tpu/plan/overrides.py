@@ -1,0 +1,626 @@
+"""TpuOverrides: the plan-rewrite layer.
+
+Reference: GpuOverrides.scala (rule registry, :536-1932), RapidsMeta.scala
+(wrapper tree with tagging reasons, :66-832), GpuTransitionOverrides.scala
+(transition/coalesce insertion). Flow (GpuOverrides.scala:1946-1964):
+
+    wrap(plan) -> tag_for_tpu() (children first, with per-op config gates
+    and type checks) -> explain -> convert_if_needed() -> coalesce/transition
+    insertion.
+
+Subtrees that cannot run on TPU execute on the CPU engine via
+CpuFallbackExec; TPU-able children beneath a CPU node still accelerate —
+their results cross the device boundary through a precomputed-frame source
+(GpuBringBackToHost / HostColumnarToGpu analogues).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs import aggregate as agg_exec
+from spark_rapids_tpu.execs import basic, batching, exchange, joins, sort, \
+    window
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.expressions import aggregates as aggfn
+from spark_rapids_tpu.expressions import arithmetic, cast, conditional, \
+    datetime as dtexpr, math as mathexpr, predicates, strings
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression, Literal)
+from spark_rapids_tpu.plan import nodes as pn
+
+# ---------------------------------------------------------------------------
+# Expression rule registry (ExprRule analogue, GpuOverrides.scala:536-1621)
+# ---------------------------------------------------------------------------
+
+
+class ExprRule:
+    def __init__(self, klass: Type[Expression], incompat: bool = False,
+                 desc: str = ""):
+        self.klass = klass
+        self.incompat = incompat
+        self.flag = cfg.register_op_flag(
+            "expression", klass.__name__,
+            desc or f"TPU replacement of {klass.__name__}",
+            incompat="TPU approximation differs in ulps from java.lang.Math"
+            if incompat else None)
+
+    def tag(self, e: Expression, meta: "NodeMeta", conf: RapidsConf):
+        if not conf.get(self.flag) and not (
+                self.incompat and conf.get(cfg.INCOMPATIBLE_OPS)):
+            if self.incompat:
+                meta.will_not_work(
+                    f"expression {self.klass.__name__} is incompatible "
+                    f"(enable {self.flag.key} or "
+                    f"{cfg.INCOMPATIBLE_OPS.key})")
+            else:
+                meta.will_not_work(
+                    f"expression {self.klass.__name__} disabled by "
+                    f"{self.flag.key}")
+        if isinstance(e, cast.Cast):
+            self._tag_cast(e, meta, conf)
+
+    @staticmethod
+    def _tag_cast(e: cast.Cast, meta: "NodeMeta", conf: RapidsConf):
+        src = e.children[0].dtype
+        if src.is_floating and e.to is dt.STRING and \
+                not conf.get(cfg.CAST_FLOAT_TO_STRING):
+            meta.will_not_work(
+                f"cast float->string needs {cfg.CAST_FLOAT_TO_STRING.key}")
+        if src is dt.STRING and e.to.is_floating and \
+                not conf.get(cfg.CAST_STRING_TO_FLOAT):
+            meta.will_not_work(
+                f"cast string->float needs {cfg.CAST_STRING_TO_FLOAT.key}")
+        if src is dt.STRING and e.to is dt.TIMESTAMP and \
+                not conf.get(cfg.CAST_STRING_TO_TIMESTAMP):
+            meta.will_not_work(
+                f"cast string->timestamp needs "
+                f"{cfg.CAST_STRING_TO_TIMESTAMP.key}")
+
+
+_EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
+
+
+def _register_exprs():
+    import inspect
+
+    for mod in (arithmetic, predicates, conditional, mathexpr, dtexpr,
+                strings, cast, aggfn):
+        for _, klass in inspect.getmembers(mod, inspect.isclass):
+            if not issubclass(klass, Expression):
+                continue
+            if klass.__module__ != mod.__name__:
+                continue
+            if klass.__name__.startswith("_"):
+                continue
+            incompat = bool(getattr(klass, "incompat", False))
+            _EXPR_RULES[klass] = ExprRule(klass, incompat)
+    for klass in (BoundReference, Literal, Alias):
+        _EXPR_RULES[klass] = ExprRule(klass)
+
+
+_register_exprs()
+
+
+def tag_expression(e: Expression, meta: "NodeMeta", conf: RapidsConf):
+    rule = _EXPR_RULES.get(type(e))
+    if rule is None:
+        meta.will_not_work(
+            f"expression {type(e).__name__} has no TPU implementation")
+        return
+    rule.tag(e, meta, conf)
+    for c in e.children:
+        if c is not None:
+            tag_expression(c, meta, conf)
+
+
+# ---------------------------------------------------------------------------
+# Node metas
+# ---------------------------------------------------------------------------
+
+
+class NodeMeta:
+    """SparkPlanMeta analogue (RapidsMeta.scala:418): per-node tag state."""
+
+    def __init__(self, node: pn.PlanNode, conf: RapidsConf):
+        self.node = node
+        self.conf = conf
+        self.children = [NodeMeta(c, conf) for c in node.children]
+        self.reasons: List[str] = []
+        self.rule = _NODE_RULES.get(type(node))
+
+    def will_not_work(self, reason: str):
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_run(self) -> bool:
+        return not self.reasons
+
+    def tag_for_tpu(self):
+        for c in self.children:
+            c.tag_for_tpu()
+        if not self.conf.get(cfg.SQL_ENABLED):
+            self.will_not_work(f"{cfg.SQL_ENABLED.key} is false")
+            return
+        if self.rule is None:
+            self.will_not_work(
+                f"node {self.node.name} has no TPU implementation")
+            return
+        flag = cfg.register_op_flag("exec", type(self.node).__name__,
+                                    f"TPU replacement of {self.node.name}")
+        if not self.conf.get(flag):
+            self.will_not_work(f"exec disabled by {flag.key}")
+            return
+        self.rule.tag(self)
+
+    def explain(self, indent: int = 0, only_not_on_tpu: bool = False
+                ) -> str:
+        mark = "*" if self.can_run else "!"
+        line = "  " * indent + f"{mark} {self.node.describe()}"
+        if self.reasons:
+            line += "  <-- " + "; ".join(self.reasons)
+        lines = [] if (only_not_on_tpu and self.can_run) else [line]
+        for c in self.children:
+            sub = c.explain(indent + 1, only_not_on_tpu)
+            if sub:
+                lines.append(sub)
+        return "\n".join(lines)
+
+    # -- conversion ----------------------------------------------------
+
+    def convert(self) -> TpuExec:
+        if self.can_run:
+            tpu_children = [c.convert() for c in self.children]
+            return self.rule.convert(self, tpu_children)
+        return self._convert_fallback()
+
+    def _convert_fallback(self) -> TpuExec:
+        """Run this node on the CPU engine. TPU-able children still
+        accelerate: their device output crosses back through a
+        precomputed-frame source."""
+        tpu_subtrees: List[TpuExec] = []
+        new_children: List[pn.PlanNode] = []
+        for c in self.children:
+            if c.can_run:
+                child_exec = insert_coalesce(c.convert())
+                tpu_subtrees.append(child_exec)
+                new_children.append(pn.ScanNode(_DeferredTpuSource(
+                    child_exec, c.node.output_schema())))
+            else:
+                new_children.append(c._fallback_plan())
+        node = self.node.with_children(new_children) if self.children \
+            else self.node
+        return basic.CpuFallbackExec(node, self.node.output_schema(),
+                                     self.reasons, tpu_subtrees)
+
+    def _fallback_plan(self) -> pn.PlanNode:
+        """Plan node for CPU execution with TPU-able descendants swapped
+        for deferred device sources."""
+        if self.can_run:
+            child_exec = insert_coalesce(self.convert())
+            return pn.ScanNode(_DeferredTpuSource(
+                child_exec, self.node.output_schema()))
+        if not self.children:
+            return self.node
+        return self.node.with_children(
+            [c._fallback_plan() for c in self.children])
+
+
+class _DeferredTpuSource(pn.DataSource):
+    """DataSource over a TPU exec's (lazily collected) output — the
+    GpuBringBackToHost boundary."""
+
+    def __init__(self, exec_: TpuExec, schema: Schema):
+        self.exec = exec_
+        self._schema = schema
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def read_host(self):
+        import numpy as np
+
+        from spark_rapids_tpu.execs.interop import batch_to_frame
+
+        frames = []
+        for p in range(self.exec.num_partitions):
+            for b in self.exec.execute(p):
+                if b.realized_num_rows() == 0:
+                    continue
+                frames.append(batch_to_frame(b, self._schema))
+        data: Dict[str, np.ndarray] = {}
+        validity: Dict[str, np.ndarray] = {}
+        for i, name in enumerate(self._schema.names):
+            typ = self._schema.types[i]
+            if frames:
+                data[name] = np.concatenate(
+                    [f.cols[i].data for f in frames])
+                validity[name] = np.concatenate(
+                    [f.cols[i].valid_mask() for f in frames])
+            else:
+                data[name] = np.array(
+                    [], dtype=object if typ is dt.STRING else typ.np_dtype)
+                validity[name] = np.array([], dtype=bool)
+        return data, validity
+
+
+# ---------------------------------------------------------------------------
+# Node rules (ExecRule analogue)
+# ---------------------------------------------------------------------------
+
+
+class NodeRule:
+    def tag(self, meta: NodeMeta):
+        pass
+
+    def convert(self, meta: NodeMeta, children: List[TpuExec]) -> TpuExec:
+        raise NotImplementedError
+
+
+def _check_types(meta: NodeMeta, types, what: str):
+    for t in types:
+        if not dt.is_supported(t):
+            meta.will_not_work(f"{what}: type {t} not supported")
+
+
+class _ScanRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        _check_types(meta, meta.node.output_schema().types, "scan")
+
+    def convert(self, meta, children):
+        node: pn.ScanNode = meta.node
+        rows = meta.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)
+        return basic.ScanExec(node.source, node.output_schema(),
+                              batch_rows=rows)
+
+
+class _RangeRule(NodeRule):
+    def convert(self, meta, children):
+        node: pn.RangeNode = meta.node
+        return basic.RangeExec(node.start, node.end, node.step,
+                               node.output_schema())
+
+
+class _ProjectRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        for e in meta.node.exprs:
+            tag_expression(e, meta, meta.conf)
+
+    def convert(self, meta, children):
+        node: pn.ProjectNode = meta.node
+        return basic.ProjectExec(node.exprs, children[0],
+                                 node.output_schema(), meta.conf)
+
+
+class _FilterRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        tag_expression(meta.node.condition, meta, meta.conf)
+
+    def convert(self, meta, children):
+        return basic.FilterExec(meta.node.condition, children[0], meta.conf)
+
+
+_SUPPORTED_AGGS = (aggfn.Min, aggfn.Max, aggfn.Sum, aggfn.Count,
+                   aggfn.Average, aggfn.First, aggfn.Last)
+
+
+class _AggregateRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        node: pn.AggregateNode = meta.node
+        for e in node.grouping:
+            tag_expression(e, meta, meta.conf)
+        for call in node.aggs:
+            if not isinstance(call.fn, _SUPPORTED_AGGS):
+                meta.will_not_work(
+                    f"aggregate {type(call.fn).__name__} not implemented")
+                continue
+            if call.fn.distinct:
+                meta.will_not_work("distinct aggregates fall back")
+            if call.fn.input is not None:
+                tag_expression(call.fn.input, meta, meta.conf)
+
+    def convert(self, meta, children):
+        node: pn.AggregateNode = meta.node
+        child = children[0]
+        out_schema = node.output_schema()
+        if node.mode != "complete":
+            return agg_exec.HashAggregateExec(
+                node.grouping, node.aggs, child, out_schema,
+                mode=node.mode, conf=meta.conf)
+        if child.num_partitions == 1:
+            return agg_exec.HashAggregateExec(
+                node.grouping, node.aggs, child, out_schema,
+                mode="complete", conf=meta.conf)
+        # distributed: partial -> exchange -> final (the physical split
+        # Spark's planner produces, aggregate.scala partial/final modes)
+        pnames = list(node.grouping_names)
+        ptypes = [e.dtype for e in node.grouping]
+        for a in node.aggs:
+            for j, pt in enumerate(a.fn.partial_types()):
+                pnames.append(f"{a.name}#p{j}")
+                ptypes.append(pt)
+        partial_schema = Schema(pnames, ptypes)
+        partial = agg_exec.HashAggregateExec(
+            node.grouping, node.aggs, child, partial_schema,
+            mode="partial", conf=meta.conf)
+        nkeys = len(node.grouping)
+        if nkeys:
+            ex = exchange.ShuffleExchangeExec(
+                ("hash", list(range(nkeys))),
+                min(meta.conf.get(cfg.SHUFFLE_PARTITIONS),
+                    max(child.num_partitions, 1)),
+                partial)
+        else:
+            ex = exchange.ShuffleExchangeExec(("single",), 1, partial)
+        final_grouping = [BoundReference(i, e.dtype)
+                          for i, e in enumerate(node.grouping)]
+        return agg_exec.HashAggregateExec(
+            final_grouping, node.aggs, ex, out_schema, mode="final",
+            conf=meta.conf)
+
+
+class _SortRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        _check_types(meta, meta.node.output_schema().types, "sort")
+
+    def convert(self, meta, children):
+        node: pn.SortNode = meta.node
+        child = children[0]
+        if node.global_sort and child.num_partitions > 1:
+            child = exchange.ShuffleExchangeExec(("single",), 1, child)
+        return sort.SortExec(node.specs, child,
+                             global_sort=node.global_sort)
+
+
+class _LimitRule(NodeRule):
+    def convert(self, meta, children):
+        node: pn.LimitNode = meta.node
+        child = children[0]
+        limited = basic.LocalLimitExec(node.n, child)
+        if node.global_limit and child.num_partitions > 1:
+            ex = exchange.ShuffleExchangeExec(("single",), 1, limited)
+            return basic.LocalLimitExec(node.n, ex)
+        return limited
+
+
+class _UnionRule(NodeRule):
+    def convert(self, meta, children):
+        return basic.UnionExec(children, meta.node.output_schema())
+
+
+class _ExpandRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        for p in meta.node.projections:
+            for e in p:
+                tag_expression(e, meta, meta.conf)
+
+    def convert(self, meta, children):
+        node: pn.ExpandNode = meta.node
+        return basic.ExpandExec(node.projections, children[0],
+                                node.output_schema(), meta.conf)
+
+
+class _JoinRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        node: pn.JoinNode = meta.node
+        if node.condition is not None and node.kind not in ("inner",
+                                                            "cross"):
+            meta.will_not_work(
+                "conditioned outer joins are post-join-filter unsafe "
+                "(GpuHashJoin.scala:285-291 applies the same restriction)")
+        if node.condition is not None:
+            tag_expression(node.condition, meta, meta.conf)
+        ls = node.children[0].output_schema()
+        rs = node.children[1].output_schema()
+        _check_types(meta, ls.types, "join left")
+        _check_types(meta, rs.types, "join right")
+
+    def convert(self, meta, children):
+        node: pn.JoinNode = meta.node
+        left, right = children
+        out_schema = node.output_schema()
+        kind = node.kind
+        lk, rk = node.left_keys, node.right_keys
+        cond = node.condition
+        if kind == "right":
+            # flip: stream the (former) right side, build the left, then
+            # reorder output columns (Spark310 buildSide-flip analogue).
+            # Conditioned right joins were rejected at tag time.
+            inner_schema = _concat_schema(right.schema, left.schema)
+            flipped = self._plan(meta, "left", right, left, rk, lk, None,
+                                 inner_schema)
+            nr = len(right.schema)
+            reorder = [BoundReference(nr + i, t)
+                       for i, t in enumerate(left.schema.types)] + \
+                      [BoundReference(i, t)
+                       for i, t in enumerate(right.schema.types)]
+            reorder = [Alias(e, n)
+                       for e, n in zip(reorder, out_schema.names)]
+            return basic.ProjectExec(reorder, flipped, out_schema,
+                                     meta.conf)
+        return self._plan(meta, kind, left, right, lk, rk, cond,
+                          out_schema)
+
+    @staticmethod
+    def _plan(meta, kind, left, right, lk, rk, cond, out_schema):
+        multi = left.num_partitions > 1 or right.num_partitions > 1
+        if kind != "cross" and multi:
+            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            left = exchange.ShuffleExchangeExec(("hash", lk), parts, left)
+            right = exchange.ShuffleExchangeExec(("hash", rk), parts,
+                                                 right)
+            return joins.ShuffledHashJoinExec(
+                kind, left, right, lk, rk, out_schema, cond, meta.conf)
+        if kind == "cross" and multi:
+            left = exchange.ShuffleExchangeExec(("single",), 1, left)
+            right = exchange.ShuffleExchangeExec(("single",), 1, right)
+        build = exchange.BroadcastExchangeExec(right)
+        # broadcast replays its single partition to every stream partition
+        return joins.BroadcastHashJoinExec(
+            kind, left, _ReplayExec(build, left.num_partitions), lk, rk,
+            out_schema, cond, meta.conf)
+
+
+class _ReplayExec(TpuExec):
+    """Presents a 1-partition child (broadcast) as n identical partitions."""
+
+    def __init__(self, child: TpuExec, n: int):
+        super().__init__([child], child.schema)
+        self._n = max(n, 1)
+
+    @property
+    def num_partitions(self) -> int:
+        return self._n
+
+    def execute(self, partition: int = 0):
+        return self.children[0].execute(0)
+
+
+def _concat_schema(a: Schema, b: Schema) -> Schema:
+    return Schema(list(a.names) + list(b.names),
+                  list(a.types) + list(b.types))
+
+
+class _WindowRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        node: pn.WindowNode = meta.node
+        for c in node.calls:
+            if isinstance(c.fn, aggfn.AggregateFunction):
+                if not isinstance(c.fn, (aggfn.Sum, aggfn.Count,
+                                         aggfn.Average, aggfn.Min,
+                                         aggfn.Max)):
+                    meta.will_not_work(
+                        f"window aggregate {type(c.fn).__name__} "
+                        "not implemented")
+                if isinstance(c.fn, (aggfn.Min, aggfn.Max)) and \
+                        not (c.frame.lower is None and
+                             c.frame.upper in (0, None)):
+                    meta.will_not_work(
+                        "bounded min/max window frames fall back "
+                        "(GpuWindowExpression.scala frame checks analogue)")
+                if c.fn.input is not None:
+                    tag_expression(c.fn.input, meta, meta.conf)
+                if c.fn.input is not None and \
+                        c.fn.input.dtype is dt.STRING:
+                    meta.will_not_work("string window aggregates fall back")
+            elif isinstance(c.fn, tuple):
+                tag_expression(c.fn[1], meta, meta.conf)
+            elif c.fn not in ("row_number", "rank", "dense_rank"):
+                meta.will_not_work(f"window function {c.fn} unknown")
+
+    def convert(self, meta, children):
+        node: pn.WindowNode = meta.node
+        child = children[0]
+        if child.num_partitions > 1:
+            if node.partition_ordinals:
+                parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+                child = exchange.ShuffleExchangeExec(
+                    ("hash", node.partition_ordinals), parts, child)
+            else:
+                child = exchange.ShuffleExchangeExec(("single",), 1, child)
+        return window.WindowExec(node.partition_ordinals, node.order_specs,
+                                 node.calls, child, node.output_schema(),
+                                 meta.conf)
+
+
+class _ExchangeRule(NodeRule):
+    def convert(self, meta, children):
+        node: pn.ShuffleExchangeNode = meta.node
+        return exchange.ShuffleExchangeExec(node.partitioning,
+                                            node.num_partitions,
+                                            children[0])
+
+
+class _BroadcastRule(NodeRule):
+    def convert(self, meta, children):
+        return exchange.BroadcastExchangeExec(children[0])
+
+
+_NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {
+    pn.ScanNode: _ScanRule(),
+    pn.RangeNode: _RangeRule(),
+    pn.ProjectNode: _ProjectRule(),
+    pn.FilterNode: _FilterRule(),
+    pn.AggregateNode: _AggregateRule(),
+    pn.SortNode: _SortRule(),
+    pn.LimitNode: _LimitRule(),
+    pn.UnionNode: _UnionRule(),
+    pn.ExpandNode: _ExpandRule(),
+    pn.JoinNode: _JoinRule(),
+    pn.WindowNode: _WindowRule(),
+    pn.ShuffleExchangeNode: _ExchangeRule(),
+    pn.BroadcastExchangeNode: _BroadcastRule(),
+}
+
+
+# ---------------------------------------------------------------------------
+# Transition / coalesce insertion (GpuTransitionOverrides.scala)
+# ---------------------------------------------------------------------------
+
+
+def insert_coalesce(root: TpuExec) -> TpuExec:
+    """Insert CoalesceBatchesExec where a child's output doesn't satisfy
+    the parent's goal (GpuTransitionOverrides.scala:118-203)."""
+    new_children = [insert_coalesce(c) for c in root.children]
+    goals = root.children_coalesce_goal
+    for i, (child, goal) in enumerate(zip(new_children, goals)):
+        if goal is None:
+            continue
+        produced = child.coalesce_after
+        if produced is not None and produced.satisfies(goal):
+            continue
+        if isinstance(child, (sort.SortExec, agg_exec.HashAggregateExec,
+                              exchange.BroadcastExchangeExec, _ReplayExec)):
+            continue  # already single-batch producers
+        new_children[i] = batching.CoalesceBatchesExec(child, goal)
+    root.children = new_children
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+class PlanOnCpuError(AssertionError):
+    """Raised in test mode when part of the plan fell back
+    (GpuTransitionOverrides.scala:270-326 assertIsOnTheGpu)."""
+
+
+def apply_overrides(plan: pn.PlanNode,
+                    conf: Optional[RapidsConf] = None) -> TpuExec:
+    conf = conf or RapidsConf()
+    meta = NodeMeta(plan, conf)
+    meta.tag_for_tpu()
+    explain_mode = conf.get(cfg.EXPLAIN).upper()
+    if explain_mode in ("ALL", "NOT_ON_TPU"):
+        print(meta.explain(only_not_on_tpu=explain_mode == "NOT_ON_TPU"))
+    exec_ = meta.convert()
+    exec_ = insert_coalesce(exec_)
+    if conf.get(cfg.TEST_ENABLED):
+        allowed = {s.strip() for s in
+                   conf.get(cfg.TEST_ALLOWED_NON_TPU).split(",")
+                   if s.strip()}
+        _assert_on_tpu(exec_, allowed)
+    return exec_
+
+
+def _assert_on_tpu(exec_: TpuExec, allowed: set):
+    if isinstance(exec_, basic.CpuFallbackExec):
+        name = type(exec_.plan_node).__name__
+        if name not in allowed:
+            raise PlanOnCpuError(
+                f"{name} fell back to CPU: {exec_.reasons}")
+    for c in exec_.children:
+        _assert_on_tpu(c, allowed)
+
+
+def explain(plan: pn.PlanNode, conf: Optional[RapidsConf] = None) -> str:
+    conf = conf or RapidsConf()
+    meta = NodeMeta(plan, conf)
+    meta.tag_for_tpu()
+    return meta.explain()

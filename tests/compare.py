@@ -1,0 +1,93 @@
+"""CPU-vs-TPU golden comparison harness.
+
+The reference's core test idea (SparkQueryCompareTestSuite.scala:153-161,
+integration_tests asserts.py): run the same plan on the CPU engine (the
+oracle) and through the TPU override pipeline, then assert equal results
+with knobs for sort-before-compare and float approximation.
+"""
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.cpu.engine import execute_cpu
+from spark_rapids_tpu.execs.base import collect
+from spark_rapids_tpu.plan.overrides import apply_overrides
+
+
+def _normalize(df: pd.DataFrame, sort: bool) -> pd.DataFrame:
+    out = {}
+    for c in df.columns:
+        s = df[c]
+        vals = []
+        for v in s:
+            if v is None or (isinstance(v, float) and np.isnan(v)) or \
+                    v is pd.NA:
+                vals.append(None)
+            elif isinstance(v, (bool, np.bool_)):
+                vals.append(bool(v))
+            elif isinstance(v, (int, np.integer)):
+                vals.append(int(v))
+            elif isinstance(v, (float, np.floating)):
+                vals.append(float(v))
+            else:
+                vals.append(str(v))
+        out[c] = vals
+    # object dtype everywhere: pandas would otherwise coerce int+None
+    # columns to float64/NaN, and NaN poisons row-sort comparisons
+    norm = pd.DataFrame(
+        {c: pd.Series(v, dtype=object) for c, v in out.items()},
+        columns=list(df.columns))
+    if sort and len(norm):
+        rows = list(zip(*[out[c] for c in df.columns])) if out else []
+
+        def row_key(i):
+            return tuple(
+                (v is None, "" if v is None else type(v).__name__,
+                 0 if v is None else v) for v in rows[i])
+
+        order = sorted(range(len(rows)), key=row_key)
+        norm = norm.iloc[order]
+    return norm.reset_index(drop=True)
+
+
+def assert_frames_equal(cpu: pd.DataFrame, tpu: pd.DataFrame,
+                        sort: bool = True, approx_float: float = 1e-9):
+    assert list(cpu.columns) == list(tpu.columns), \
+        f"column mismatch: {list(cpu.columns)} vs {list(tpu.columns)}"
+    a = _normalize(cpu, sort)
+    b = _normalize(tpu, sort)
+    assert len(a) == len(b), f"row count: cpu={len(a)} tpu={len(b)}"
+    for col in a.columns:
+        av, bv = list(a[col]), list(b[col])
+        for i, (x, y) in enumerate(zip(av, bv)):
+            if x is None or y is None:
+                assert x is None and y is None, \
+                    f"{col}[{i}]: cpu={x!r} tpu={y!r}"
+            elif isinstance(x, float) and isinstance(y, float):
+                if np.isnan(x) or np.isnan(y):
+                    assert np.isnan(x) and np.isnan(y), \
+                        f"{col}[{i}]: cpu={x!r} tpu={y!r}"
+                else:
+                    assert x == y or \
+                        abs(x - y) <= approx_float * max(abs(x), abs(y),
+                                                         1.0), \
+                        f"{col}[{i}]: cpu={x!r} tpu={y!r}"
+            else:
+                assert x == y, f"{col}[{i}]: cpu={x!r} tpu={y!r}"
+
+
+def assert_cpu_and_tpu_equal(plan, conf: RapidsConf = None,
+                             sort: bool = True, approx_float: float = 1e-9,
+                             require_on_tpu: bool = True):
+    """The testSparkResultsAreEqual analogue. ``require_on_tpu`` enables
+    the test-mode whole-plan-on-TPU assertion
+    (GpuTransitionOverrides.scala:270-326)."""
+    conf = conf or RapidsConf()
+    if require_on_tpu:
+        conf = conf.with_overrides({"rapids.tpu.sql.test.enabled": True})
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, conf)
+    tpu_df = collect(exec_)
+    assert_frames_equal(cpu_df, tpu_df, sort=sort,
+                        approx_float=approx_float)
+    return exec_

@@ -1,0 +1,273 @@
+"""End-to-end plan tests: CPU oracle vs TPU override pipeline
+(the SparkQueryCompareTestSuite layer of the reference, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from compare import assert_cpu_and_tpu_equal, assert_frames_equal
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.expressions import (Add, Alias, And, Average,
+                                          BoundReference, CaseWhen, Cast,
+                                          Count, Divide, EqualTo,
+                                          GreaterThan, If, IsNotNull,
+                                          LessThan, Literal, Max, Min,
+                                          Multiply, Subtract, Sum)
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan import nodes as pn
+
+RNG = np.random.default_rng(42)
+
+
+def ref(i, t, nullable=True):
+    return BoundReference(i, t, nullable)
+
+
+def scan(data, validity=None):
+    return pn.ScanNode(pn.InMemorySource(data, validity=validity))
+
+
+def random_table(n=1000, with_nulls=True, seed=0):
+    rng = np.random.default_rng(seed)
+    data = {
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "v": rng.normal(size=n),
+        "w": rng.integers(-100, 100, n).astype(np.int64),
+    }
+    validity = {}
+    if with_nulls:
+        validity = {"k": rng.random(n) > 0.1, "v": rng.random(n) > 0.1}
+    return data, validity
+
+
+def test_project_filter_pipeline():
+    data, validity = random_table()
+    plan = scan(data, validity)
+    plan = pn.FilterNode(
+        And(GreaterThan(ref(1, dt.FLOAT64), Literal(-0.5)),
+            IsNotNull(ref(0, dt.INT64))), plan)
+    plan = pn.ProjectNode(
+        [Alias(Add(ref(0, dt.INT64), Literal(1)), "k1"),
+         Alias(Multiply(ref(1, dt.FLOAT64), Literal(2.0)), "v2"),
+         Alias(If(LessThan(ref(2, dt.INT64), Literal(0)),
+                  Literal(-1), Literal(1)), "sgn")], plan)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_case_when_cast():
+    data, validity = random_table(300, seed=1)
+    plan = scan(data, validity)
+    plan = pn.ProjectNode(
+        [Alias(CaseWhen(
+            [(LessThan(ref(2, dt.INT64), Literal(-50)), Literal(0)),
+             (LessThan(ref(2, dt.INT64), Literal(0)), Literal(1))],
+            Literal(2)), "bucket"),
+         Alias(Cast(ref(2, dt.INT64), dt.FLOAT64), "wf"),
+         Alias(Cast(ref(1, dt.FLOAT64), dt.INT64), "vi")], plan)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_groupby_aggregate_single_partition():
+    data, validity = random_table(2000, seed=2)
+    plan = scan(data, validity)
+    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64)), "s"),
+            pn.AggCall(Count(ref(1, dt.FLOAT64)), "c"),
+            pn.AggCall(Count(), "n"),
+            pn.AggCall(Min(ref(2, dt.INT64)), "lo"),
+            pn.AggCall(Max(ref(2, dt.INT64)), "hi"),
+            pn.AggCall(Average(ref(1, dt.FLOAT64)), "m")]
+    plan = pn.AggregateNode([ref(0, dt.INT64)], aggs, plan,
+                            grouping_names=["k"])
+    assert_cpu_and_tpu_equal(plan, approx_float=1e-9)
+
+
+def test_global_aggregate():
+    data, validity = random_table(500, seed=3)
+    plan = scan(data, validity)
+    aggs = [pn.AggCall(Sum(ref(2, dt.INT64)), "s"),
+            pn.AggCall(Count(), "n")]
+    plan = pn.AggregateNode([], aggs, plan)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_global_aggregate_empty():
+    plan = scan({"v": np.array([], dtype=np.int64)})
+    aggs = [pn.AggCall(Sum(ref(0, dt.INT64)), "s"),
+            pn.AggCall(Count(), "n")]
+    plan = pn.AggregateNode([], aggs, plan)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_sort_with_nulls_and_limit():
+    data, validity = random_table(500, seed=4)
+    plan = scan(data, validity)
+    plan = pn.SortNode([SortKeySpec.spark_default(1, ascending=False),
+                        SortKeySpec.spark_default(0)], plan)
+    plan = pn.LimitNode(37, plan)
+    assert_cpu_and_tpu_equal(plan, sort=False)
+
+
+@pytest.mark.parametrize("kind", ["inner", "left", "right", "full",
+                                  "left_semi", "left_anti"])
+def test_join_kinds(kind):
+    rng = np.random.default_rng(5)
+    nl, nr = 400, 150
+    left = scan({"k": rng.integers(0, 50, nl).astype(np.int64),
+                 "v": rng.normal(size=nl)},
+                {"k": rng.random(nl) > 0.05})
+    right = scan({"k2": rng.integers(0, 50, nr).astype(np.int64),
+                  "w": rng.integers(0, 1000, nr).astype(np.int64)},
+                 {"k2": rng.random(nr) > 0.05})
+    plan = pn.JoinNode(kind, left, right, [0], [0])
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_join_with_condition():
+    rng = np.random.default_rng(6)
+    n = 200
+    left = scan({"k": rng.integers(0, 20, n).astype(np.int64),
+                 "v": rng.integers(0, 100, n).astype(np.int64)})
+    right = scan({"k2": rng.integers(0, 20, 50).astype(np.int64),
+                  "w": rng.integers(0, 100, 50).astype(np.int64)})
+    cond = GreaterThan(ref(3, dt.INT64), ref(1, dt.INT64))
+    plan = pn.JoinNode("inner", left, right, [0], [0], condition=cond)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_string_join_keys():
+    left = scan({"s": np.array(["a", "b", "c", "a", None], dtype=object),
+                 "v": np.arange(5, dtype=np.int64)})
+    right = scan({"s2": np.array(["a", "c", "x"], dtype=object),
+                  "w": np.array([10, 20, 30], dtype=np.int64)})
+    plan = pn.JoinNode("inner", left, right, [0], [0])
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_union_expand_limit():
+    a = scan({"x": np.arange(10, dtype=np.int64)})
+    b = scan({"x": np.arange(100, 110, dtype=np.int64)})
+    u = pn.UnionNode([a, b])
+    plan = pn.ExpandNode([[ref(0, dt.INT64), Literal(0)],
+                          [Multiply(ref(0, dt.INT64), Literal(2)),
+                           Literal(1)]], u, ["x", "tag"])
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_window_functions():
+    rng = np.random.default_rng(7)
+    n = 300
+    plan = scan({"p": rng.integers(0, 10, n).astype(np.int64),
+                 "o": rng.permutation(n).astype(np.int64),
+                 "v": rng.normal(size=n)},
+                {"v": rng.random(n) > 0.1})
+    calls = [pn.WindowCall("row_number", "rn"),
+             pn.WindowCall("rank", "rk"),
+             pn.WindowCall("dense_rank", "dr"),
+             pn.WindowCall(Sum(ref(2, dt.FLOAT64)), "rs",
+                           frame=pn.WindowFrame(None, 0)),
+             pn.WindowCall(Min(ref(2, dt.FLOAT64)), "rmin",
+                           frame=pn.WindowFrame(None, 0)),
+             pn.WindowCall(Max(ref(2, dt.FLOAT64)), "pmax",
+                           frame=pn.WindowFrame(None, None)),
+             pn.WindowCall(Count(ref(2, dt.FLOAT64)), "rc",
+                           frame=pn.WindowFrame(-2, 2)),
+             pn.WindowCall(Average(ref(2, dt.FLOAT64)), "ra",
+                           frame=pn.WindowFrame(-3, 0)),
+             pn.WindowCall(("lag", ref(2, dt.FLOAT64)), "lg"),
+             pn.WindowCall(("lead", ref(1, dt.INT64)), "ld")]
+    plan = pn.WindowNode([0], [SortKeySpec.spark_default(1)], calls, plan)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_range_node():
+    plan = pn.RangeNode(5, 500, 7)
+    plan = pn.FilterNode(
+        EqualTo(Literal(0),
+                Add(ref(0, dt.INT64), Multiply(ref(0, dt.INT64),
+                                               Literal(-1)))), plan)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_fallback_unsupported_agg():
+    """First/Last windows etc. that the TPU doesn't do fall back with a
+    reason, and results still match (assertDidFallBack analogue,
+    Plugin.scala:155-231)."""
+    from spark_rapids_tpu.expressions.aggregates import First
+
+    data, validity = random_table(200, seed=8)
+    plan = scan(data, validity)
+    calls = [pn.WindowCall(First(ref(1, dt.FLOAT64)), "f")]
+    wplan = pn.WindowNode([0], [SortKeySpec.spark_default(2)], calls, plan)
+    from spark_rapids_tpu.plan.overrides import explain
+
+    text = explain(wplan)
+    assert "First" in text and "!" in text
+    assert_cpu_and_tpu_equal(wplan, require_on_tpu=False)
+
+
+def test_fallback_mixed_tree_keeps_tpu_children():
+    """A CPU-only parent over a TPU-able child: child accelerates, parent
+    falls back, results match."""
+    from spark_rapids_tpu.expressions.aggregates import First
+
+    data, validity = random_table(300, seed=9)
+    child = pn.FilterNode(GreaterThan(ref(2, dt.INT64), Literal(0)),
+                          scan(data, validity))
+    aggs = [pn.AggCall(First(ref(1, dt.FLOAT64)), "f"),
+            pn.AggCall(Sum(ref(2, dt.INT64)), "s")]
+    plan = pn.AggregateNode([ref(0, dt.INT64)], aggs, child,
+                            grouping_names=["k"])
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.execs.basic import CpuFallbackExec
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    exec_ = apply_overrides(plan)
+    assert isinstance(exec_, CpuFallbackExec)
+    assert exec_.children, "TPU-able child subtree should be preserved"
+    cpu_df = execute_cpu(plan).to_pandas()
+    assert_frames_equal(cpu_df, collect(exec_))
+
+
+def test_test_mode_raises_on_fallback():
+    from spark_rapids_tpu.expressions.aggregates import First
+    from spark_rapids_tpu.plan.overrides import PlanOnCpuError, \
+        apply_overrides
+
+    data, validity = random_table(50, seed=10)
+    aggs = [pn.AggCall(First(ref(1, dt.FLOAT64)), "f")]
+    plan = pn.AggregateNode([ref(0, dt.INT64)], aggs,
+                            scan(data, validity))
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    with pytest.raises(PlanOnCpuError):
+        apply_overrides(plan, conf)
+
+
+def test_op_config_gate_disables_exec():
+    data, validity = random_table(50, seed=11)
+    plan = pn.FilterNode(GreaterThan(ref(2, dt.INT64), Literal(0)),
+                         scan(data, validity))
+    conf = RapidsConf({"rapids.tpu.sql.exec.FilterNode": False})
+    from spark_rapids_tpu.execs.basic import CpuFallbackExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    exec_ = apply_overrides(plan, conf)
+    assert isinstance(exec_, CpuFallbackExec)
+    assert any("disabled" in r for r in exec_.reasons)
+    assert_cpu_and_tpu_equal(plan, conf, require_on_tpu=False)
+
+
+def test_incompat_math_gated():
+    from spark_rapids_tpu.expressions.math import Exp
+
+    data, _ = random_table(20, with_nulls=False, seed=12)
+    plan = pn.ProjectNode([Alias(Exp(ref(1, dt.FLOAT64)), "e")],
+                          scan(data))
+    from spark_rapids_tpu.execs.basic import CpuFallbackExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    assert isinstance(apply_overrides(plan), CpuFallbackExec)
+    conf = RapidsConf({"rapids.tpu.sql.incompatibleOps.enabled": True})
+    exec_ = apply_overrides(plan, conf)
+    assert not isinstance(exec_, CpuFallbackExec)
+    assert_cpu_and_tpu_equal(plan, conf, approx_float=1e-7,
+                             require_on_tpu=False)
