@@ -63,9 +63,10 @@ class CpuFrame:
                     [int(c.data[i]) if valid[i] else None
                      for i in range(self.num_rows)], dtype="Int64")
             else:
-                vals = c.data.astype(np.float64).copy()
-                vals[~valid] = np.nan
-                data[name] = vals
+                # object dtype so SQL NULL (None) stays distinct from NaN
+                vals = c.data.astype(np.float64).astype(object)
+                vals[~valid] = None
+                data[name] = pd.array(vals, dtype="object")
         return pd.DataFrame(data)
 
 
@@ -222,6 +223,19 @@ def _group_ids(cols: List[CV], n: int) -> Tuple[np.ndarray, int, np.ndarray]:
     return gid, len(seen), np.array(reps, dtype=np.int64)
 
 
+def _distinct_row_mask(cv: CV, gid: np.ndarray, n: int) -> np.ndarray:
+    """Boolean mask keeping the first row of each (group, value) pair,
+    with Spark value semantics (null==null, NaN==NaN, -0.0==0.0)."""
+    seen = set()
+    mask = np.zeros(n, dtype=bool)
+    for i in range(n):
+        key = (int(gid[i]), _group_key(cv, i))
+        if key not in seen:
+            seen.add(key)
+            mask[i] = True
+    return mask
+
+
 def _agg_op(op: str, cv: Optional[CV], gid: np.ndarray, ng: int,
             n: int) -> CV:
     """One kernel-level aggregate op over groups (ops/groupby.AGG_OPS)."""
@@ -340,6 +354,17 @@ def _aggregate(node: pn.AggregateNode) -> CpuFrame:
         if ops_mode == "update":
             inp = eval_expr(fn.input, ctx) if fn.input is not None else None
             ops = fn.update_ops()
+            if fn.distinct and inp is not None:
+                # DISTINCT: keep one row per (group, value) pair before
+                # aggregating (the TPU planner falls back for distinct, so
+                # the oracle only sees complete mode here).
+                sel = _distinct_row_mask(inp, gid, n)
+                gid_d = gid[sel]
+                inp_d = CV(inp.dtype, inp.data[sel], inp.valid_mask()[sel])
+                for op in ops:
+                    partial_cvs.append(
+                        _agg_op(op, inp_d, gid_d, ng, int(sel.sum())))
+                continue
             for op in ops:
                 partial_cvs.append(_agg_op(op, inp, gid, ng, n))
         else:

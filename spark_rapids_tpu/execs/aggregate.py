@@ -109,9 +109,10 @@ class HashAggregateExec(TpuExec):
 
     @property
     def coalesce_after(self):
-        from spark_rapids_tpu.execs.batching import TargetSize
+        # the merge loop leaves exactly one batch per partition
+        from spark_rapids_tpu.execs.batching import RequireSingleBatch
 
-        return TargetSize(1 << 30)
+        return RequireSingleBatch
 
     # ------------------------------------------------------------------
 
@@ -158,7 +159,7 @@ class HashAggregateExec(TpuExec):
                 with TraceRange("HashAggregateExec.finalProject"):
                     running = self.final_proj(running)
             yield rebucket(running)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
     def _empty_global_partials(self) -> ColumnarBatch:
         """Default partials for a global aggregate over zero rows: count=0,

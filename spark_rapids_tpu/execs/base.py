@@ -17,17 +17,22 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 
 class Metrics:
     """num_output_rows / num_output_batches / op_time_ns per exec
-    (GpuMetricNames, GpuExec.scala:27-55)."""
+    (GpuMetricNames, GpuExec.scala:27-55). ``op_time_ns`` is self time —
+    like the reference's totalTime it excludes time spent pulling child
+    batches; ``pipeline_time_ns`` is inclusive."""
 
     def __init__(self):
         self.num_output_rows = 0
         self.num_output_batches = 0
         self.op_time_ns = 0
+        self.pipeline_time_ns = 0
 
-    def record(self, batch: ColumnarBatch, elapsed_ns: int = 0):
+    def record(self, batch: ColumnarBatch, elapsed_ns: int = 0,
+               child_ns: int = 0):
         self.num_output_batches += 1
         self.num_output_rows += batch.realized_num_rows()
-        self.op_time_ns += elapsed_ns
+        self.pipeline_time_ns += elapsed_ns
+        self.op_time_ns += max(elapsed_ns - child_ns, 0)
 
 
 class TpuExec:
@@ -76,15 +81,26 @@ class TpuExec:
         return out
 
 
-def timed(metrics: Metrics, it: Iterator[ColumnarBatch]
+def timed(owner, it: Iterator[ColumnarBatch]
           ) -> Iterator[ColumnarBatch]:
+    """Wrap an exec's output iterator with metric recording. ``owner`` is
+    the TpuExec (self time = pull time minus children's pipeline time); a
+    bare Metrics is accepted for exec-less iterators."""
+    if isinstance(owner, Metrics):
+        metrics, children = owner, ()
+    else:
+        metrics, children = owner.metrics, owner.children
     while True:
+        child0 = sum(c.metrics.pipeline_time_ns for c in children)
         t0 = time.perf_counter_ns()
         try:
             batch = next(it)
         except StopIteration:
             return
-        metrics.record(batch, time.perf_counter_ns() - t0)
+        elapsed = time.perf_counter_ns() - t0
+        child_ns = sum(c.metrics.pipeline_time_ns
+                       for c in children) - child0
+        metrics.record(batch, elapsed, child_ns)
         yield batch
 
 

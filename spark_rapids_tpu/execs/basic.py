@@ -47,7 +47,7 @@ class ScanExec(TpuExec):
                     with TraceRange("ScanExec.upload"):
                         yield interop.host_to_batch(data, validity,
                                                     self.schema, start, end)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class ProjectExec(TpuExec):
@@ -64,7 +64,7 @@ class ProjectExec(TpuExec):
             for b in self.children[0].execute(partition):
                 with TraceRange("ProjectExec"):
                     yield self.projection(b)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class FilterExec(TpuExec):
@@ -80,7 +80,7 @@ class FilterExec(TpuExec):
             for b in self.children[0].execute(partition):
                 with TraceRange("FilterExec"):
                     yield self.filter(b)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class RangeExec(TpuExec):
@@ -105,7 +105,7 @@ class RangeExec(TpuExec):
                     lo, lo + cnt * self.step, self.step, dtype=np.int64)
                 yield ColumnarBatch(
                     [Column.from_numpy(vals, dtype=dt.INT64)], cnt)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class LocalLimitExec(TpuExec):
@@ -128,7 +128,7 @@ class LocalLimitExec(TpuExec):
                 else:
                     yield b.slice(0, remaining)
                     remaining = 0
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class UnionExec(TpuExec):
@@ -152,7 +152,7 @@ class UnionExec(TpuExec):
                     return
                 p -= c.num_partitions
             raise IndexError(partition)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class ExpandExec(TpuExec):
@@ -171,7 +171,7 @@ class ExpandExec(TpuExec):
                 parts = [proj(b) for proj in self.projections]
                 with TraceRange("ExpandExec.concat"):
                     yield concat_batches(parts)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class CpuFallbackExec(TpuExec):
@@ -205,4 +205,4 @@ class CpuFallbackExec(TpuExec):
                 end = min(start + self.batch_rows, n)
                 idx = np.arange(start, end)
                 yield interop.frame_to_batch(frame.take(idx))
-        return timed(self.metrics, it())
+        return timed(self, it())

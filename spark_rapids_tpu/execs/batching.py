@@ -61,6 +61,16 @@ def max_goal(a: Optional[CoalesceGoal],
     return a if a.target_bytes >= b.target_bytes else b
 
 
+def drain_to_single_batch(it: Iterator[ColumnarBatch], schema
+                          ) -> ColumnarBatch:
+    """Drain a child iterator into exactly one batch (the in-place
+    RequireSingleBatch: global sort, join build side, window input)."""
+    batches = [b for b in it if b.realized_num_rows() > 0]
+    if not batches:
+        return ColumnarBatch.empty(schema)
+    return concat_batches(batches) if len(batches) > 1 else batches[0]
+
+
 def coalesce_iterator(it: Iterator[ColumnarBatch], goal: CoalesceGoal
                       ) -> Iterator[ColumnarBatch]:
     """Concatenate incoming batches until the goal is met
@@ -94,6 +104,6 @@ class CoalesceBatchesExec(TpuExec):
         return self.goal
 
     def execute(self, partition: int = 0):
-        return timed(self.metrics,
+        return timed(self,
                      coalesce_iterator(self.children[0].execute(partition),
                                        self.goal))

@@ -92,7 +92,7 @@ class ShuffleExchangeExec(TpuExec):
             for h in handles:
                 with h.acquired() as batch:
                     yield batch
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class BroadcastExchangeExec(TpuExec):
@@ -107,6 +107,12 @@ class BroadcastExchangeExec(TpuExec):
     @property
     def num_partitions(self) -> int:
         return 1
+
+    @property
+    def coalesce_after(self):
+        from spark_rapids_tpu.execs.batching import RequireSingleBatch
+
+        return RequireSingleBatch
 
     def _materialize(self) -> SpillableBatch:
         if self._cached is None:
@@ -126,4 +132,4 @@ class BroadcastExchangeExec(TpuExec):
         def it():
             with self._materialize().acquired() as batch:
                 yield batch
-        return timed(self.metrics, it())
+        return timed(self, it())

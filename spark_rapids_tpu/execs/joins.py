@@ -23,7 +23,6 @@ from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.execs.batching import RequireSingleBatch
 from spark_rapids_tpu.expressions.base import Expression
 from spark_rapids_tpu.expressions.compiler import CompiledFilter
-from spark_rapids_tpu.ops.concat import concat_batches
 from spark_rapids_tpu.ops.join import cross_join, equi_join
 from spark_rapids_tpu.utils.tracing import TraceRange
 
@@ -63,11 +62,10 @@ class HashJoinExec(TpuExec):
         return [stream_goal, RequireSingleBatch]
 
     def _build_side(self, partition: int) -> ColumnarBatch:
-        batches = [b for b in self.children[1].execute(partition)
-                   if b.realized_num_rows() > 0]
-        if not batches:
-            return ColumnarBatch.empty(self.children[1].schema)
-        return concat_batches(batches) if len(batches) > 1 else batches[0]
+        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+
+        return drain_to_single_batch(self.children[1].execute(partition),
+                                     self.children[1].schema)
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         left_types = list(self.children[0].schema.types)
@@ -78,10 +76,12 @@ class HashJoinExec(TpuExec):
             if self.kind == "full":
                 # unmatched-build rows are emitted exactly once, so the
                 # stream side must arrive as one batch
-                batches = [b for b in self.children[0].execute(partition)]
-                stream_batches = [concat_batches(batches) if batches else
-                                  ColumnarBatch.empty(
-                                      self.children[0].schema)]
+                from spark_rapids_tpu.execs.batching import \
+                    drain_to_single_batch
+
+                stream_batches = [drain_to_single_batch(
+                    self.children[0].execute(partition),
+                    self.children[0].schema)]
             else:
                 stream_batches = self.children[0].execute(partition)
             saw = False
@@ -101,7 +101,7 @@ class HashJoinExec(TpuExec):
                 if self.condition is not None:
                     out = self.condition(out)
                 yield out
-        return timed(self.metrics, it())
+        return timed(self, it())
 
 
 class BroadcastHashJoinExec(HashJoinExec):

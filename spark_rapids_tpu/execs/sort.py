@@ -13,7 +13,6 @@ from typing import Iterator, List
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.execs.base import TpuExec, timed
-from spark_rapids_tpu.ops.concat import concat_batches
 from spark_rapids_tpu.ops.sort import sort_batch
 from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 from spark_rapids_tpu.utils.tracing import TraceRange
@@ -26,22 +25,32 @@ class SortExec(TpuExec):
         self.specs = specs
         self.global_sort = global_sort
 
+    @property
+    def coalesce_after(self):
+        # global sort concatenates the partition into one batch; a local
+        # (per-batch) sort preserves the child's batching, so it makes no
+        # single-batch promise (GpuSortExec.scala:50).
+        from spark_rapids_tpu.execs.batching import RequireSingleBatch
+
+        return RequireSingleBatch if self.global_sort else None
+
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         types = list(self.schema.types)
 
         def it():
             if self.global_sort:
-                batches = [b for b in self.children[0].execute(partition)
-                           if b.realized_num_rows() > 0]
-                if not batches:
-                    yield ColumnarBatch.empty(self.schema)
+                from spark_rapids_tpu.execs.batching import \
+                    drain_to_single_batch
+
+                merged = drain_to_single_batch(
+                    self.children[0].execute(partition), self.schema)
+                if merged.realized_num_rows() == 0:
+                    yield merged
                     return
                 with TraceRange("SortExec.global"):
-                    merged = concat_batches(batches) \
-                        if len(batches) > 1 else batches[0]
                     yield sort_batch(merged, self.specs, types)
             else:
                 for b in self.children[0].execute(partition):
                     with TraceRange("SortExec.local"):
                         yield sort_batch(b, self.specs, types)
-        return timed(self.metrics, it())
+        return timed(self, it())

@@ -19,7 +19,6 @@ RequireSingleBatch below this exec.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Iterator, List, Tuple
 
 import jax
@@ -38,7 +37,7 @@ from spark_rapids_tpu.expressions.compiler import CompiledProjection
 from spark_rapids_tpu.ops import sortkeys
 from spark_rapids_tpu.ops.sort import sort_batch
 from spark_rapids_tpu.ops.sortkeys import SortKeySpec
-from spark_rapids_tpu.plan.nodes import WindowCall, WindowFrame
+from spark_rapids_tpu.plan.nodes import WindowCall
 from spark_rapids_tpu.utils.tracing import TraceRange
 
 
@@ -98,17 +97,17 @@ class WindowExec(TpuExec):
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():
-            batches = [b for b in self.children[0].execute(partition)
-                       if b.realized_num_rows() > 0]
-            if not batches:
-                yield ColumnarBatch.empty(self.schema)
-                return
-            from spark_rapids_tpu.ops.concat import concat_batches
+            from spark_rapids_tpu.execs.batching import \
+                drain_to_single_batch
 
-            b = concat_batches(batches) if len(batches) > 1 else batches[0]
+            b = drain_to_single_batch(
+                self.children[0].execute(partition), self.schema)
+            if b.realized_num_rows() == 0:
+                yield b
+                return
             with TraceRange("WindowExec"):
                 yield self._run(b)
-        return timed(self.metrics, it())
+        return timed(self, it())
 
     def _run(self, batch: ColumnarBatch) -> ColumnarBatch:
         ext = self.pre_proj(batch)
@@ -220,12 +219,12 @@ class WindowExec(TpuExec):
                 jnp.maximum(idx + frame.lower, start_of_row)
             hi = (end_of_row - 1) if frame.upper is None else \
                 jnp.minimum(idx + frame.upper, end_of_row - 1)
-            hi = jnp.maximum(hi, lo - 1)  # empty frame -> zero
+            empty = hi < lo  # e.g. rows (-2,-1) at partition start
             upper = jnp.take(ps, jnp.clip(hi, 0, cap - 1))
             lower = jnp.where(lo > 0,
                               jnp.take(ps, jnp.clip(lo - 1, 0, cap - 1)),
                               jnp.zeros((), ps.dtype))
-            return upper - lower
+            return jnp.where(empty, jnp.zeros((), ps.dtype), upper - lower)
 
         if isinstance(fn, (Sum, Average, Count)):
             acc_t = jnp.int64 if fn.dtype.is_integral else jnp.float64

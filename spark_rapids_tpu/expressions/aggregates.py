@@ -85,8 +85,9 @@ class Max(AggregateFunction):
 
 
 class Sum(AggregateFunction):
-    def __init__(self, child: Expression):
+    def __init__(self, child: Expression, distinct: bool = False):
         super().__init__([child])
+        self.distinct = distinct
 
     @property
     def dtype(self):
@@ -106,8 +107,10 @@ class Sum(AggregateFunction):
 class Count(AggregateFunction):
     """count(expr); count(*) when child is None."""
 
-    def __init__(self, child: Optional[Expression] = None):
+    def __init__(self, child: Optional[Expression] = None,
+                 distinct: bool = False):
         super().__init__([child] if child is not None else [])
+        self.distinct = distinct
 
     @property
     def dtype(self):

@@ -476,6 +476,10 @@ class _ReplayExec(TpuExec):
     def num_partitions(self) -> int:
         return self._n
 
+    @property
+    def coalesce_after(self):
+        return self.children[0].coalesce_after
+
     def execute(self, partition: int = 0):
         return self.children[0].execute(0)
 
@@ -508,7 +512,21 @@ class _WindowRule(NodeRule):
                         c.fn.input.dtype is dt.STRING:
                     meta.will_not_work("string window aggregates fall back")
             elif isinstance(c.fn, tuple):
+                kind = c.fn[0]
+                if kind not in ("lead", "lag"):
+                    meta.will_not_work(f"window shift {kind!r} unknown")
+                    continue
                 tag_expression(c.fn[1], meta, meta.conf)
+                if c.default is not None:
+                    in_t = c.fn[1].dtype
+                    if in_t is dt.STRING:
+                        meta.will_not_work(
+                            "lead/lag default over strings falls back")
+                    elif in_t.is_integral and \
+                            not isinstance(c.default, (int, bool)):
+                        meta.will_not_work(
+                            "lead/lag non-integral default over an "
+                            f"integral column ({c.default!r})")
             elif c.fn not in ("row_number", "rank", "dense_rank"):
                 meta.will_not_work(f"window function {c.fn} unknown")
 
@@ -573,9 +591,6 @@ def insert_coalesce(root: TpuExec) -> TpuExec:
         produced = child.coalesce_after
         if produced is not None and produced.satisfies(goal):
             continue
-        if isinstance(child, (sort.SortExec, agg_exec.HashAggregateExec,
-                              exchange.BroadcastExchangeExec, _ReplayExec)):
-            continue  # already single-batch producers
         new_children[i] = batching.CoalesceBatchesExec(child, goal)
     root.children = new_children
     return root

@@ -20,9 +20,10 @@ def _normalize(df: pd.DataFrame, sort: bool) -> pd.DataFrame:
         s = df[c]
         vals = []
         for v in s:
-            if v is None or (isinstance(v, float) and np.isnan(v)) or \
-                    v is pd.NA:
+            if v is None or v is pd.NA:
                 vals.append(None)
+            elif isinstance(v, (float, np.floating)) and np.isnan(v):
+                vals.append(float("nan"))  # NaN is a value, not NULL
             elif isinstance(v, (bool, np.bool_)):
                 vals.append(bool(v))
             elif isinstance(v, (int, np.integer)):
@@ -43,7 +44,9 @@ def _normalize(df: pd.DataFrame, sort: bool) -> pd.DataFrame:
         def row_key(i):
             return tuple(
                 (v is None, "" if v is None else type(v).__name__,
-                 0 if v is None else v) for v in rows[i])
+                 isinstance(v, float) and np.isnan(v),
+                 0 if v is None or (isinstance(v, float) and np.isnan(v))
+                 else v) for v in rows[i])
 
         order = sorted(range(len(rows)), key=row_key)
         norm = norm.iloc[order]

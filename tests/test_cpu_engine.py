@@ -96,7 +96,7 @@ def test_global_agg_empty_input():
     df = execute_cpu(plan).to_pandas()
     assert len(df) == 1
     assert df["n"][0] == 0
-    assert np.isnan(df["s"][0])
+    assert df["s"][0] is None  # SUM over empty input is NULL, not NaN
 
 
 def test_sort_nulls_and_nan():
@@ -230,7 +230,7 @@ def test_divide_by_zero_null():
     plan = pn.ProjectNode(
         [Alias(Divide(ref(0, dt.FLOAT64), ref(1, dt.FLOAT64)), "q")], plan)
     df = execute_cpu(plan).to_pandas()
-    assert np.isnan(df["q"][0])  # null -> NaN in pandas float
+    assert df["q"][0] is None  # Spark Divide: x/0 is NULL
     assert df["q"][1] == 1.0
 
 

@@ -172,6 +172,12 @@ def test_window_functions():
                            frame=pn.WindowFrame(-2, 2)),
              pn.WindowCall(Average(ref(2, dt.FLOAT64)), "ra",
                            frame=pn.WindowFrame(-3, 0)),
+             # frames that are EMPTY at partition edges (regression: the
+             # clamp must not pull in row 0 / the last row)
+             pn.WindowCall(Sum(ref(2, dt.FLOAT64)), "prev2",
+                           frame=pn.WindowFrame(-2, -1)),
+             pn.WindowCall(Count(ref(2, dt.FLOAT64)), "next2",
+                           frame=pn.WindowFrame(1, 2)),
              pn.WindowCall(("lag", ref(2, dt.FLOAT64)), "lg"),
              pn.WindowCall(("lead", ref(1, dt.INT64)), "ld")]
     plan = pn.WindowNode([0], [SortKeySpec.spark_default(1)], calls, plan)
@@ -206,13 +212,12 @@ def test_fallback_unsupported_agg():
 
 def test_fallback_mixed_tree_keeps_tpu_children():
     """A CPU-only parent over a TPU-able child: child accelerates, parent
-    falls back, results match."""
-    from spark_rapids_tpu.expressions.aggregates import First
-
+    falls back, results match. DISTINCT aggregates are the fallback case
+    (GpuOverrides distinct fallback, aggregate.scala:56-130)."""
     data, validity = random_table(300, seed=9)
     child = pn.FilterNode(GreaterThan(ref(2, dt.INT64), Literal(0)),
                           scan(data, validity))
-    aggs = [pn.AggCall(First(ref(1, dt.FLOAT64)), "f"),
+    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64), distinct=True), "f"),
             pn.AggCall(Sum(ref(2, dt.INT64)), "s")]
     plan = pn.AggregateNode([ref(0, dt.INT64)], aggs, child,
                             grouping_names=["k"])
@@ -229,12 +234,11 @@ def test_fallback_mixed_tree_keeps_tpu_children():
 
 
 def test_test_mode_raises_on_fallback():
-    from spark_rapids_tpu.expressions.aggregates import First
     from spark_rapids_tpu.plan.overrides import PlanOnCpuError, \
         apply_overrides
 
     data, validity = random_table(50, seed=10)
-    aggs = [pn.AggCall(First(ref(1, dt.FLOAT64)), "f")]
+    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64), distinct=True), "f")]
     plan = pn.AggregateNode([ref(0, dt.INT64)], aggs,
                             scan(data, validity))
     conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
