@@ -274,6 +274,48 @@ UDF_COMPILER_ENABLED = conf("rapids.tpu.sql.udfCompiler.enabled").doc(
     "(udf-compiler analogue)."
 ).boolean_conf.create_with_default(True)
 
+# -- file format gates (RapidsConf.scala per-format enables) ----------------
+
+PARQUET_ENABLED = conf("rapids.tpu.sql.format.parquet.enabled").doc(
+    "Enable parquet input and output on the TPU path."
+).boolean_conf.create_with_default(True)
+
+PARQUET_READ_ENABLED = conf("rapids.tpu.sql.format.parquet.read.enabled").doc(
+    "Enable parquet scans."
+).boolean_conf.create_with_default(True)
+
+PARQUET_WRITE_ENABLED = conf(
+    "rapids.tpu.sql.format.parquet.write.enabled").doc(
+    "Enable parquet writes."
+).boolean_conf.create_with_default(True)
+
+ORC_ENABLED = conf("rapids.tpu.sql.format.orc.enabled").doc(
+    "Enable ORC input and output on the TPU path."
+).boolean_conf.create_with_default(True)
+
+ORC_READ_ENABLED = conf("rapids.tpu.sql.format.orc.read.enabled").doc(
+    "Enable ORC scans."
+).boolean_conf.create_with_default(True)
+
+ORC_WRITE_ENABLED = conf("rapids.tpu.sql.format.orc.write.enabled").doc(
+    "Enable ORC writes."
+).boolean_conf.create_with_default(True)
+
+CSV_ENABLED = conf("rapids.tpu.sql.format.csv.enabled").doc(
+    "Enable CSV input on the TPU path (the reference is read-only for CSV)."
+).boolean_conf.create_with_default(True)
+
+CSV_READ_ENABLED = conf("rapids.tpu.sql.format.csv.read.enabled").doc(
+    "Enable CSV scans."
+).boolean_conf.create_with_default(True)
+
+FILTER_PUSHDOWN_ENABLED = conf(
+    "rapids.tpu.sql.format.pushDownFilters.enabled").doc(
+    "Push comparison conjuncts from a Filter above a file scan into the "
+    "source for row-group/stripe pruning (GpuParquetScan.scala:228-265 "
+    "row-group filtering analogue; exact filtering still runs on device)."
+).boolean_conf.create_with_default(True)
+
 
 class RapidsConf:
     """Immutable snapshot of configuration values.

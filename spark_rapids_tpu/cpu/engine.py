@@ -666,3 +666,18 @@ _NODES = {
     pn.ShuffleExchangeNode: _passthrough,
     pn.BroadcastExchangeNode: _passthrough,
 }
+
+
+def _write_files(node) -> CpuFrame:
+    from spark_rapids_tpu.io.write import execute_write_cpu
+
+    return execute_write_cpu(node)
+
+
+def _register_io_nodes():
+    from spark_rapids_tpu.io.write import WriteFilesNode
+
+    _NODES[WriteFilesNode] = _write_files
+
+
+_register_io_nodes()

@@ -25,7 +25,9 @@ from spark_rapids_tpu.utils.tracing import TraceRange
 class ScanExec(TpuExec):
     """Host read -> sliced device uploads (GpuFileSourceScanExec +
     the semaphore acquire before first device touch, GpuSemaphore.scala:106).
-    Rows per upload slice come from the batch-size config."""
+    Rows per upload slice come from the batch-size config. File sources
+    with multiple splits expose them as scan partitions (the reference's
+    FilePartition -> task mapping)."""
 
     def __init__(self, source: DataSource, schema: Schema,
                  batch_rows: int = 1 << 20):
@@ -33,9 +35,13 @@ class ScanExec(TpuExec):
         self.source = source
         self.batch_rows = batch_rows
 
+    @property
+    def num_partitions(self) -> int:
+        return self.source.num_splits()
+
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():
-            data, validity = self.source.read_host()
+            data, validity = self.source.read_host_split(partition)
             first = self.schema.names[0] if len(self.schema) else None
             n = len(np.asarray(data[first])) if first else 0
             if n == 0:

@@ -1,0 +1,16 @@
+"""File I/O: TPU-accelerated scans and writes (SURVEY.md §2.7).
+
+The reference splits file work: CPU parses footers / filters row groups /
+assembles host buffers, then cuDF decodes on device (GpuParquetScan.scala:
+228-265). TPUs have no device-side decoders, so the TPU-native split is:
+host decode (pyarrow, multi-threaded across files — the MultiFileParquet
+PartitionReader analogue) -> columnar host buffers -> device upload, with
+the same row-group pruning / predicate pushdown / column projection on the
+metadata path.
+"""
+from spark_rapids_tpu.io.csv import CsvSource
+from spark_rapids_tpu.io.orc import OrcSource
+from spark_rapids_tpu.io.parquet import ParquetSource
+from spark_rapids_tpu.io.write import WriteFilesNode
+
+__all__ = ["ParquetSource", "OrcSource", "CsvSource", "WriteFilesNode"]

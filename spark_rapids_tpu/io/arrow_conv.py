@@ -1,0 +1,125 @@
+"""pyarrow <-> host-columnar conversion shared by all file sources.
+
+Host representation (what DataSource.read_host returns): numpy arrays in the
+engine's physical encodings — int32 days for DATE, int64 UTC microseconds for
+TIMESTAMP, object arrays (None = null) for STRING — plus bool validity masks.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+
+
+def schema_from_arrow(arrow_schema, columns: Optional[List[str]] = None
+                      ) -> Schema:
+    names, types = [], []
+    for field in arrow_schema:
+        if columns is not None and field.name not in columns:
+            continue
+        names.append(field.name)
+        types.append(dt.from_arrow(field.type))
+    if columns is not None:
+        order = {n: i for i, n in enumerate(names)}
+        missing = [c for c in columns if c not in order]
+        if missing:
+            raise KeyError(f"columns not in file schema: {missing}")
+        names = list(columns)
+        types = [types[order[c]] for c in columns]
+    return Schema(names, types)
+
+
+def column_to_host(col, typ: dt.DType) -> Tuple[np.ndarray, np.ndarray]:
+    """One arrow ChunkedArray/Array -> (data ndarray, validity ndarray)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    valid = pc.is_valid(col)
+    valid = valid.to_numpy(zero_copy_only=False).astype(bool)
+    if typ is dt.STRING:
+        data = np.array(col.to_pylist(), dtype=object)
+        return data, valid
+    if typ is dt.DATE:
+        ints = pc.fill_null(col.cast(pa.int32()), 0)
+        return ints.to_numpy(zero_copy_only=False).astype(np.int32), valid
+    if typ is dt.TIMESTAMP:
+        # normalize to UTC microseconds (the engine is UTC-only, like the
+        # reference: GpuOverrides.scala:341)
+        ts = col
+        if isinstance(ts, pa.ChunkedArray):
+            ts = ts.combine_chunks()
+        ts = ts.cast(pa.timestamp("us", tz="UTC")) \
+            if ts.type.tz is not None else ts.cast(pa.timestamp("us"))
+        ints = pc.fill_null(ts.cast(pa.int64()), 0)
+        return ints.to_numpy(zero_copy_only=False).astype(np.int64), valid
+    if typ is dt.BOOLEAN:
+        filled = pc.fill_null(col, False)
+        return (filled.to_numpy(zero_copy_only=False).astype(bool), valid)
+    sentinel = 0
+    filled = pc.fill_null(col, sentinel)
+    arr = filled.to_numpy(zero_copy_only=False).astype(typ.np_dtype)
+    return arr, valid
+
+
+def table_to_host(table, schema: Schema
+                  ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    data: Dict[str, np.ndarray] = {}
+    validity: Dict[str, np.ndarray] = {}
+    for name, typ in zip(schema.names, schema.types):
+        col = table.column(name)
+        if str(col.type).startswith("dictionary"):
+            col = col.cast("string")
+        data[name], validity[name] = column_to_host(col, typ)
+    return data, validity
+
+
+def empty_host(schema: Schema):
+    data, validity = {}, {}
+    for name, typ in zip(schema.names, schema.types):
+        data[name] = np.array(
+            [], dtype=object if typ is dt.STRING else typ.np_dtype)
+        validity[name] = np.array([], dtype=bool)
+    return data, validity
+
+
+def concat_host(parts, schema: Schema):
+    """Concatenate per-split host dicts in order."""
+    parts = [p for p in parts if p is not None]
+    if not parts:
+        return empty_host(schema)
+    data, validity = {}, {}
+    for name in schema.names:
+        data[name] = np.concatenate([p[0][name] for p in parts])
+        validity[name] = np.concatenate([p[1][name] for p in parts])
+    return data, validity
+
+
+def batch_to_arrow(batch, schema: Schema):
+    """Device ColumnarBatch -> pyarrow Table (the write path's device ->
+    host handoff; ColumnarOutputWriter analogue)."""
+    import pyarrow as pa
+
+    n = batch.realized_num_rows()
+    arrays = []
+    for c, typ in zip(batch.columns, schema.types):
+        data, valid = c.to_numpy(n)
+        mask = None if valid is None else ~np.asarray(valid, dtype=bool)
+        if typ is dt.STRING:
+            vals = list(data)
+            if mask is not None:
+                vals = [None if m else v for v, m in zip(vals, mask)]
+            arrays.append(pa.array(vals, type=pa.string()))
+        elif typ is dt.DATE:
+            arrays.append(pa.array(np.asarray(data, dtype=np.int32),
+                                   mask=mask).cast(pa.date32()))
+        elif typ is dt.TIMESTAMP:
+            arrays.append(pa.array(np.asarray(data, dtype=np.int64),
+                                   mask=mask).cast(
+                pa.timestamp("us", tz="UTC")))
+        else:
+            arrays.append(pa.array(np.asarray(data, dtype=typ.np_dtype),
+                                   mask=mask, type=dt.to_arrow(typ)))
+    return pa.Table.from_arrays(arrays, names=list(schema.names))

@@ -1,0 +1,68 @@
+"""CSV scan (GpuCSVScan analogue, GpuBatchScanExec.scala:507).
+
+The reference parses CSV with cuDF's device parser behind many compat
+gates (timestamp formats, RapidsConf.scala:482). Host-side pyarrow CSV
+fills that role here; an explicit Schema may be supplied (the common Spark
+usage) or types are inferred from the first file. Splits are whole files.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.io import arrow_conv
+from spark_rapids_tpu.io.filesrc import FileSourceBase, Filter
+
+
+class CsvSource(FileSourceBase):
+    def __init__(self, paths, schema: Optional[Schema] = None,
+                 header: bool = True, delimiter: str = ",",
+                 columns: Optional[List[str]] = None,
+                 filters: Optional[Sequence[Filter]] = None,
+                 conf: Optional[cfg.RapidsConf] = None):
+        super().__init__(paths, columns, filters, conf)
+        self.declared_schema = schema
+        self.header = header
+        self.delimiter = delimiter
+
+    def _read_options(self):
+        from pyarrow import csv as pacsv
+
+        ropts = {}
+        copts = {}
+        if self.declared_schema is not None:
+            col_types = {n: dt.to_arrow(t) for n, t in
+                         zip(self.declared_schema.names,
+                             self.declared_schema.types)}
+            copts["column_types"] = col_types
+            if not self.header:
+                ropts["column_names"] = list(self.declared_schema.names)
+        elif not self.header:
+            raise ValueError("headerless CSV requires an explicit schema")
+        return (pacsv.ReadOptions(**ropts),
+                pacsv.ParseOptions(delimiter=self.delimiter),
+                pacsv.ConvertOptions(**copts,
+                                     strings_can_be_null=True))
+
+    def _read_file(self, path: str):
+        from pyarrow import csv as pacsv
+
+        ropts, popts, copts = self._read_options()
+        return pacsv.read_csv(path, read_options=ropts,
+                              parse_options=popts, convert_options=copts)
+
+    def _file_schema(self) -> Schema:
+        if self.declared_schema is not None and self.columns is None:
+            return self.declared_schema
+        table = self._read_file(self.paths[0])
+        return arrow_conv.schema_from_arrow(table.schema, self.columns)
+
+    def _build_splits(self) -> list:
+        self.chunks_total += len(self.paths)
+        return list(self.paths)
+
+    def _read_split(self, desc: str):
+        table = self._read_file(desc)
+        return table.select(list(self.schema().names))

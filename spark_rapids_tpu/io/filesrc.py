@@ -1,0 +1,166 @@
+"""Shared file-source machinery: path resolution, split -> partition
+mapping, the multi-file thread pool, and pushed-down filters.
+
+Filters are conjunct triples ``(column, op, value)`` with op in
+``= < <= > >=`` — the subset the planner can extract from a FilterNode
+condition (GpuParquetScan.scala:228-265 does the same with Spark's
+pushed-down sources.filters). They are used ONLY for pruning (row groups /
+stripes / files); exact filtering still happens in the plan's FilterNode,
+so pruning that keeps extra rows is always safe.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.io import arrow_conv
+from spark_rapids_tpu.plan.nodes import DataSource
+
+Filter = Tuple[str, str, object]
+
+_OPS = ("=", "<", "<=", ">", ">=")
+
+
+def resolve_paths(paths) -> List[str]:
+    """file | directory | glob | list of those -> sorted file list."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in files
+                           if not f.startswith((".", "_")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(f for f in glob.glob(p) if os.path.isfile(f))
+        else:
+            out.append(p)
+    out = sorted(dict.fromkeys(out))
+    if not out:
+        raise FileNotFoundError(f"no input files for {paths!r}")
+    return out
+
+
+def filter_may_match(filters: Sequence[Filter], stats: dict) -> bool:
+    """May any row in a chunk with the given per-column ``{name: (min, max,
+    has_nulls)}`` stats satisfy every conjunct? Missing stats -> True (keep:
+    pruning must be conservative)."""
+    for name, op, value in filters:
+        st = stats.get(name)
+        if st is None:
+            continue
+        lo, hi, _ = st
+        if lo is None or hi is None:
+            continue
+        try:
+            if op == "=" and not (lo <= value <= hi):
+                return False
+            if op == "<" and not (lo < value):
+                return False
+            if op == "<=" and not (lo <= value):
+                return False
+            if op == ">" and not (hi > value):
+                return False
+            if op == ">=" and not (hi >= value):
+                return False
+        except TypeError:
+            continue  # incomparable stats: keep the chunk
+    return True
+
+
+class FileSourceBase(DataSource):
+    """A DataSource over files with splits, projection and pruning filters.
+
+    Subclasses implement ``_build_splits()`` (returning opaque split
+    descriptors, already pruned) and ``_read_split(desc)`` (returning a
+    pyarrow Table with exactly the projected columns).
+    """
+
+    def __init__(self, paths, columns: Optional[List[str]] = None,
+                 filters: Optional[Sequence[Filter]] = None,
+                 conf: Optional[cfg.RapidsConf] = None):
+        self.paths = resolve_paths(paths)
+        self.columns = list(columns) if columns is not None else None
+        self.filters: List[Filter] = list(filters or [])
+        for f in self.filters:
+            assert f[1] in _OPS, f"bad pushdown op {f[1]!r}"
+        self.conf = conf or cfg.DEFAULT_CONF
+        self._schema: Optional[Schema] = None
+        self._splits: Optional[list] = None
+        # reentrant: splits() -> _build_splits() -> schema() nests
+        self._lock = threading.RLock()
+        # observability for tests / explain (pruning effectiveness)
+        self.chunks_total = 0
+        self.chunks_pruned = 0
+
+    # -- subclass surface --------------------------------------------------
+
+    def _file_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def _build_splits(self) -> list:
+        raise NotImplementedError
+
+    def _read_split(self, desc):
+        raise NotImplementedError
+
+    # -- DataSource --------------------------------------------------------
+
+    def schema(self) -> Schema:
+        with self._lock:
+            if self._schema is None:
+                self._schema = self._file_schema()
+            return self._schema
+
+    def splits(self) -> list:
+        with self._lock:
+            if self._splits is None:
+                self._splits = self._build_splits()
+            return self._splits
+
+    def num_splits(self) -> int:
+        return max(len(self.splits()), 1)
+
+    def read_host_split(self, split: int):
+        descs = self.splits()
+        if not descs:
+            return arrow_conv.empty_host(self.schema())
+        table = self._read_split(descs[split])
+        return arrow_conv.table_to_host(table, self.schema())
+
+    def read_host(self):
+        """Read ALL splits through the multi-file thread pool and stitch
+        (MultiFileParquetPartitionReader analogue,
+        GpuParquetScan.scala:700-839)."""
+        descs = self.splits()
+        if not descs:
+            return arrow_conv.empty_host(self.schema())
+        schema = self.schema()
+        n_threads = min(self.conf.get(cfg.MULTIFILE_READ_THREADS),
+                        len(descs))
+        if n_threads <= 1 or len(descs) == 1:
+            parts = [arrow_conv.table_to_host(self._read_split(d), schema)
+                     for d in descs]
+        else:
+            with ThreadPoolExecutor(max_workers=n_threads) as pool:
+                tables = list(pool.map(self._read_split, descs))
+            parts = [arrow_conv.table_to_host(t, schema) for t in tables]
+        return arrow_conv.concat_host(parts, schema)
+
+    def with_filters(self, filters: Sequence[Filter]) -> "FileSourceBase":
+        """New source with extra pruning conjuncts (planner pushdown)."""
+        import copy
+
+        c = copy.copy(self)
+        c.filters = self.filters + list(filters)
+        c._splits = None
+        c._lock = threading.RLock()
+        c.chunks_total = 0
+        c.chunks_pruned = 0
+        return c

@@ -1,0 +1,114 @@
+"""Parquet scan: footer metadata pruning + threaded host decode + upload.
+
+Reference flow (GpuParquetScan.scala): CPU parses the footer, filters row
+groups by predicate/statistics (:228-265), assembles the needed column
+chunks, then decodes on device; many small files are read by a thread pool
+and stitched into one batch (MultiFileParquetPartitionReader, :700-839).
+TPU-native flow: identical metadata path (pyarrow footer statistics), host
+decode, device upload in the scan exec. Splits are row-group ranges packed
+to the reader byte target, so scan partitions parallelize over row groups.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.io import arrow_conv
+from spark_rapids_tpu.io.filesrc import (FileSourceBase, Filter,
+                                         filter_may_match)
+
+
+@dataclasses.dataclass(frozen=True)
+class _RgSplit:
+    path: str
+    row_groups: tuple  # row-group ordinals within the file
+
+
+def _stat_value(typ: dt.DType, v):
+    """Normalize a parquet footer statistic to the engine's physical
+    encoding so it compares against pushdown literals."""
+    if v is None:
+        return None
+    if typ is dt.DATE:
+        import datetime
+
+        if isinstance(v, datetime.date):
+            return (v - datetime.date(1970, 1, 1)).days
+        return v
+    if typ is dt.TIMESTAMP:
+        import datetime
+
+        if isinstance(v, datetime.datetime):
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=datetime.timezone.utc)
+            return int(v.timestamp() * 1_000_000)
+        return v
+    return v
+
+
+class ParquetSource(FileSourceBase):
+    """Columnar parquet reader with row-group statistics pruning."""
+
+    def __init__(self, paths, columns: Optional[List[str]] = None,
+                 filters: Optional[Sequence[Filter]] = None,
+                 conf: Optional[cfg.RapidsConf] = None):
+        super().__init__(paths, columns, filters, conf)
+
+    def _file_schema(self) -> Schema:
+        import pyarrow.parquet as pq
+
+        return arrow_conv.schema_from_arrow(
+            pq.read_schema(self.paths[0]), self.columns)
+
+    def _build_splits(self) -> list:
+        import pyarrow.parquet as pq
+
+        schema = self.schema()
+        types = dict(zip(schema.names, schema.types))
+        target = self.conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES)
+        splits: List[_RgSplit] = []
+        for path in self.paths:
+            meta = pq.ParquetFile(path).metadata
+            name_to_col = {meta.schema.column(i).name: i
+                           for i in range(meta.num_columns)}
+            kept: List[int] = []
+            kept_bytes = 0
+            for rg in range(meta.num_row_groups):
+                self.chunks_total += 1
+                rgmeta = meta.row_group(rg)
+                stats = {}
+                for cname, typ in types.items():
+                    ci = name_to_col.get(cname)
+                    if ci is None:
+                        continue
+                    st = rgmeta.column(ci).statistics
+                    if st is None or not st.has_min_max:
+                        continue
+                    stats[cname] = (_stat_value(typ, st.min),
+                                    _stat_value(typ, st.max),
+                                    bool(st.null_count))
+                if self.filters and not filter_may_match(self.filters,
+                                                         stats):
+                    self.chunks_pruned += 1
+                    continue
+                rg_bytes = rgmeta.total_byte_size
+                if kept and kept_bytes + rg_bytes > target:
+                    splits.append(_RgSplit(path, tuple(kept)))
+                    kept, kept_bytes = [], 0
+                kept.append(rg)
+                kept_bytes += rg_bytes
+            if kept:
+                splits.append(_RgSplit(path, tuple(kept)))
+        return splits
+
+    def _read_split(self, desc: _RgSplit):
+        import pyarrow.parquet as pq
+
+        f = pq.ParquetFile(desc.path)
+        schema = self.schema()
+        return f.read_row_groups(list(desc.row_groups),
+                                 columns=list(schema.names),
+                                 use_threads=False)

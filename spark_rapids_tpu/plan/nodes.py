@@ -89,6 +89,17 @@ class DataSource:
         String columns are object arrays (None = null)."""
         raise NotImplementedError
 
+    # -- splits: file sources map splits onto scan partitions (the
+    # reference's FilePartition model; GpuParquetScan.scala partition
+    # readers). Default: one split backed by read_host().
+
+    def num_splits(self) -> int:
+        return 1
+
+    def read_host_split(self, split: int):
+        assert split == 0, split
+        return self.read_host()
+
 
 class InMemorySource(DataSource):
     """Host-resident columns (dict name -> numpy array / list), the analogue

@@ -270,12 +270,50 @@ def _check_types(meta: NodeMeta, types, what: str):
 class _ScanRule(NodeRule):
     def tag(self, meta: NodeMeta):
         _check_types(meta, meta.node.output_schema().types, "scan")
+        src = meta.node.source
+        from spark_rapids_tpu.io.csv import CsvSource
+        from spark_rapids_tpu.io.orc import OrcSource
+        from spark_rapids_tpu.io.parquet import ParquetSource
+
+        gates = {
+            ParquetSource: (cfg.PARQUET_ENABLED, cfg.PARQUET_READ_ENABLED),
+            OrcSource: (cfg.ORC_ENABLED, cfg.ORC_READ_ENABLED),
+            CsvSource: (cfg.CSV_ENABLED, cfg.CSV_READ_ENABLED),
+        }
+        for klass, (fmt_flag, read_flag) in gates.items():
+            if isinstance(src, klass):
+                for flag in (fmt_flag, read_flag):
+                    if not meta.conf.get(flag):
+                        meta.will_not_work(
+                            f"{klass.__name__} scan disabled by "
+                            f"{flag.key}")
 
     def convert(self, meta, children):
         node: pn.ScanNode = meta.node
         rows = meta.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)
         return basic.ScanExec(node.source, node.output_schema(),
                               batch_rows=rows)
+
+
+class _WriteRule(NodeRule):
+    def tag(self, meta: NodeMeta):
+        from spark_rapids_tpu.io.write import WriteFilesNode
+
+        node: WriteFilesNode = meta.node
+        _check_types(meta, node.children[0].output_schema().types, "write")
+        gates = {
+            "parquet": (cfg.PARQUET_ENABLED, cfg.PARQUET_WRITE_ENABLED),
+            "orc": (cfg.ORC_ENABLED, cfg.ORC_WRITE_ENABLED),
+        }
+        for flag in gates[node.format]:
+            if not meta.conf.get(flag):
+                meta.will_not_work(
+                    f"{node.format} write disabled by {flag.key}")
+
+    def convert(self, meta, children):
+        from spark_rapids_tpu.io.write import WriteFilesExec
+
+        return WriteFilesExec(meta.node, children[0])
 
 
 class _RangeRule(NodeRule):
@@ -489,6 +527,20 @@ def _concat_schema(a: Schema, b: Schema) -> Schema:
                   list(a.types) + list(b.types))
 
 
+def _default_coercible(in_t: dt.DType, default) -> bool:
+    """Can ``default`` be stored in a column of ``in_t``'s physical dtype?
+    (lead/lag fill value; WindowExec materializes it with jnp.asarray)."""
+    if isinstance(default, bool):
+        return True  # bool coerces into every numeric physical dtype
+    if in_t.is_integral or in_t in (dt.DATE, dt.TIMESTAMP):
+        return isinstance(default, int)
+    if in_t.is_floating:
+        return isinstance(default, (int, float))
+    if in_t is dt.BOOLEAN:
+        return False  # non-bool default over a boolean column
+    return False
+
+
 class _WindowRule(NodeRule):
     def tag(self, meta: NodeMeta):
         node: pn.WindowNode = meta.node
@@ -522,11 +574,10 @@ class _WindowRule(NodeRule):
                     if in_t is dt.STRING:
                         meta.will_not_work(
                             "lead/lag default over strings falls back")
-                    elif in_t.is_integral and \
-                            not isinstance(c.default, (int, bool)):
+                    elif not _default_coercible(in_t, c.default):
                         meta.will_not_work(
-                            "lead/lag non-integral default over an "
-                            f"integral column ({c.default!r})")
+                            f"lead/lag default {c.default!r} does not "
+                            f"coerce to {in_t} column")
             elif c.fn not in ("row_number", "rank", "dense_rank"):
                 meta.will_not_work(f"window function {c.fn} unknown")
 
@@ -558,6 +609,12 @@ class _BroadcastRule(NodeRule):
         return exchange.BroadcastExchangeExec(children[0])
 
 
+def _register_io_rules():
+    from spark_rapids_tpu.io.write import WriteFilesNode
+
+    _NODE_RULES[WriteFilesNode] = _WriteRule()
+
+
 _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {
     pn.ScanNode: _ScanRule(),
     pn.RangeNode: _RangeRule(),
@@ -573,6 +630,80 @@ _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {
     pn.ShuffleExchangeNode: _ExchangeRule(),
     pn.BroadcastExchangeNode: _BroadcastRule(),
 }
+
+_register_io_rules()
+
+
+# ---------------------------------------------------------------------------
+# File-filter pushdown (GpuParquetScan.scala:228-265 row-group filtering)
+# ---------------------------------------------------------------------------
+
+_PUSHDOWN_OPS = {
+    predicates.EqualTo: "=",
+    predicates.LessThan: "<",
+    predicates.LessThanOrEqual: "<=",
+    predicates.GreaterThan: ">",
+    predicates.GreaterThanOrEqual: ">=",
+}
+
+_FLIP = {"=": "=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+
+def _split_conjuncts(e: Expression) -> List[Expression]:
+    if isinstance(e, predicates.And):
+        return (_split_conjuncts(e.children[0])
+                + _split_conjuncts(e.children[1]))
+    return [e]
+
+
+def _extract_pushdown(cond: Expression, schema: Schema):
+    """-> list of (column, op, value) pruning triples, one per conjunct of
+    shape ``col <cmp> literal`` (either side). Literals are already in the
+    engine's physical encodings, which is what io/parquet.py _stat_value
+    normalizes footer statistics to."""
+    out = []
+    for c in _split_conjuncts(cond):
+        op = _PUSHDOWN_OPS.get(type(c))
+        if op is None:
+            continue
+        left, right = c.children
+        if isinstance(left, BoundReference) and isinstance(right, Literal):
+            ref, lit, o = left, right, op
+        elif isinstance(right, BoundReference) and isinstance(left,
+                                                             Literal):
+            ref, lit, o = right, left, _FLIP[op]
+        else:
+            continue
+        if lit.value is None:
+            continue
+        if ref.dtype is dt.STRING and not isinstance(lit.value, str):
+            continue
+        out.append((schema.names[ref.ordinal], o, lit.value))
+    return out
+
+
+def push_down_file_filters(plan: pn.PlanNode,
+                           conf: RapidsConf) -> pn.PlanNode:
+    """Rewrite Filter(Scan(file-source)) so the source also receives the
+    comparison conjuncts for chunk pruning; the Filter stays (exact
+    semantics on device)."""
+    from spark_rapids_tpu.io.filesrc import FileSourceBase
+
+    if not conf.get(cfg.FILTER_PUSHDOWN_ENABLED):
+        return plan
+    new_children = [push_down_file_filters(c, conf)
+                    for c in plan.children]
+    plan = plan.with_children(new_children) if plan.children else plan
+    if isinstance(plan, pn.FilterNode):
+        child = plan.children[0]
+        if isinstance(child, pn.ScanNode) and \
+                isinstance(child.source, FileSourceBase):
+            filters = _extract_pushdown(plan.condition,
+                                        child.output_schema())
+            if filters:
+                new_scan = pn.ScanNode(child.source.with_filters(filters))
+                return plan.with_children([new_scan])
+    return plan
 
 
 # ---------------------------------------------------------------------------
@@ -609,6 +740,7 @@ class PlanOnCpuError(AssertionError):
 def apply_overrides(plan: pn.PlanNode,
                     conf: Optional[RapidsConf] = None) -> TpuExec:
     conf = conf or RapidsConf()
+    plan = push_down_file_filters(plan, conf)
     meta = NodeMeta(plan, conf)
     meta.tag_for_tpu()
     explain_mode = conf.get(cfg.EXPLAIN).upper()

@@ -275,3 +275,26 @@ def test_incompat_math_gated():
     assert not isinstance(exec_, CpuFallbackExec)
     assert_cpu_and_tpu_equal(plan, conf, approx_float=1e-7,
                              require_on_tpu=False)
+
+
+def test_window_lag_bad_default_falls_back():
+    """A lead/lag default that can't coerce into the input column's
+    physical dtype must fall back at plan time, not crash at execution
+    (review finding: FLOAT/DATE columns previously slipped through)."""
+    rng = np.random.default_rng(5)
+    n = 50
+    plan = scan({"p": rng.integers(0, 4, n).astype(np.int64),
+                 "o": rng.permutation(n).astype(np.int64),
+                 "v": rng.normal(size=n)})
+    calls = [pn.WindowCall(("lag", ref(2, dt.FLOAT64)), "lg",
+                           default="not-a-number")]
+    wnode = pn.WindowNode([0], [SortKeySpec.spark_default(1)], calls, plan)
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.execs.basic import CpuFallbackExec
+    ex = apply_overrides(wnode, RapidsConf())
+    assert isinstance(ex, CpuFallbackExec)
+    # int default over a float column is fine and must stay on TPU
+    ok = pn.WindowNode([0], [SortKeySpec.spark_default(1)],
+                       [pn.WindowCall(("lag", ref(2, dt.FLOAT64)), "lg",
+                                      default=7)], plan)
+    assert_cpu_and_tpu_equal(ok)
