@@ -1,0 +1,235 @@
+// Native host runtime for the TPU columnar engine.
+//
+// The reference reaches native code for its host-side data plane through
+// cuDF JNI + nvcomp (LZ4 batch compression, NvcompLZ4CompressionCodec.scala)
+// and UCX. The TPU build's device compute is XLA; this library supplies the
+// host-side hot loops that stay native in any serious runtime:
+//
+//   - LZ4 block-format compress/decompress (shuffle + spill payloads; the
+//     nvcomp-LZ4 analogue). Clean-room implementation of the public block
+//     format (token | literals | offset | matchlen sequences).
+//   - validity bitmap pack/unpack (bool bytes <-> bits; 8x smaller wire
+//     validity, like cudf's packed validity masks).
+//   - CRC32C (Castagnoli) checksums for spill-file integrity.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in the image).
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// LZ4 block format
+// ---------------------------------------------------------------------------
+
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+static const int kMinMatch = 4;
+static const int kHashBits = 16;
+
+static inline uint32_t hash4(uint32_t v) {
+  return (v * 2654435761u) >> (32 - kHashBits);
+}
+
+// Worst-case compressed size for n input bytes (classic LZ4 bound).
+long srt_lz4_max_compressed(long n) {
+  return n + n / 255 + 16;
+}
+
+// Returns compressed size, or -1 if dst is too small.
+long srt_lz4_compress(const uint8_t* src, long n, uint8_t* dst,
+                      long dst_cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  // spec: last match must start >= 12 bytes before end; last 5 bytes are
+  // always literals
+  const uint8_t* const mflimit = (n >= 13) ? iend - 12 : src;
+  const uint8_t* const matchlimit = iend - 5;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + dst_cap;
+  const uint8_t* anchor = src;
+
+  if (n > 0 && n < 13) goto last_literals;  // too small to form matches
+
+  {
+    // hash table of positions (offsets from src), 0 = empty sentinel via
+    // first-position ambiguity handled by verifying the match bytes
+    static thread_local uint32_t table[1 << kHashBits];
+    std::memset(table, 0, sizeof(table));
+
+    while (ip < mflimit) {
+      uint32_t h = hash4(read32(ip));
+      const uint8_t* match = src + table[h];
+      table[h] = (uint32_t)(ip - src);
+      if (match >= ip || ip - match > 65535 ||
+          read32(match) != read32(ip)) {
+        ++ip;
+        continue;
+      }
+      // extend match forward
+      const uint8_t* mp = match + kMinMatch;
+      const uint8_t* cp = ip + kMinMatch;
+      while (cp < matchlimit && *cp == *mp) { ++cp; ++mp; }
+      long mlen = cp - ip - kMinMatch;
+      long llen = ip - anchor;
+      // emit token
+      uint8_t* token = op;
+      if (op + 1 + llen + llen / 255 + 8 > oend) return -1;
+      ++op;
+      if (llen >= 15) {
+        *token = 15 << 4;
+        long rest = llen - 15;
+        while (rest >= 255) { *op++ = 255; rest -= 255; }
+        *op++ = (uint8_t)rest;
+      } else {
+        *token = (uint8_t)(llen << 4);
+      }
+      std::memcpy(op, anchor, llen);
+      op += llen;
+      // offset
+      uint16_t off = (uint16_t)(ip - match);
+      *op++ = (uint8_t)(off & 0xff);
+      *op++ = (uint8_t)(off >> 8);
+      // match length
+      if (mlen >= 15) {
+        *token |= 15;
+        long rest = mlen - 15;
+        while (rest >= 255) {
+          if (op >= oend) return -1;
+          *op++ = 255;
+          rest -= 255;
+        }
+        if (op >= oend) return -1;
+        *op++ = (uint8_t)rest;
+      } else {
+        *token |= (uint8_t)mlen;
+      }
+      ip = cp;
+      anchor = ip;
+      if (ip < mflimit) table[hash4(read32(ip - 2))] = (uint32_t)(ip - 2 - src);
+    }
+  }
+
+last_literals: {
+    long llen = iend - anchor;
+    if (op + 1 + llen + llen / 255 > oend) return -1;
+    uint8_t* token = op++;
+    if (llen >= 15) {
+      *token = 15 << 4;
+      long rest = llen - 15;
+      while (rest >= 255) { *op++ = 255; rest -= 255; }
+      *op++ = (uint8_t)rest;
+    } else {
+      *token = (uint8_t)(llen << 4);
+    }
+    std::memcpy(op, anchor, llen);
+    op += llen;
+  }
+  return op - dst;
+}
+
+// Returns decompressed size, or -1 on malformed/overflow input.
+long srt_lz4_decompress(const uint8_t* src, long n, uint8_t* dst,
+                        long dst_cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + dst_cap;
+
+  while (ip < iend) {
+    uint8_t token = *ip++;
+    // literals
+    long llen = token >> 4;
+    if (llen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        llen += b;
+      } while (b == 255);
+    }
+    if (ip + llen > iend || op + llen > oend) return -1;
+    std::memcpy(op, ip, llen);
+    ip += llen;
+    op += llen;
+    if (ip >= iend) break;  // last sequence has no match part
+    // offset
+    if (ip + 2 > iend) return -1;
+    uint16_t off = (uint16_t)(ip[0] | (ip[1] << 8));
+    ip += 2;
+    if (off == 0 || op - dst < off) return -1;
+    // match length
+    long mlen = (token & 15) + kMinMatch;
+    if ((token & 15) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        mlen += b;
+      } while (b == 255);
+    }
+    if (op + mlen > oend) return -1;
+    const uint8_t* mp = op - off;
+    if (off >= 8) {
+      // non-overlapping enough for memcpy chunks
+      long rest = mlen;
+      while (rest >= 8) { std::memcpy(op, mp, 8); op += 8; mp += 8; rest -= 8; }
+      while (rest--) *op++ = *mp++;
+    } else {
+      while (mlen--) *op++ = *mp++;  // overlapping copy, byte-wise
+    }
+  }
+  return op - dst;
+}
+
+// ---------------------------------------------------------------------------
+// Validity bitmap pack/unpack (bool bytes <-> LSB-first bits)
+// ---------------------------------------------------------------------------
+
+long srt_pack_bits(const uint8_t* bools, long n, uint8_t* out) {
+  long nbytes = (n + 7) / 8;
+  std::memset(out, 0, nbytes);
+  for (long i = 0; i < n; ++i) {
+    if (bools[i]) out[i >> 3] |= (uint8_t)(1u << (i & 7));
+  }
+  return nbytes;
+}
+
+long srt_unpack_bits(const uint8_t* bits, long n, uint8_t* bools) {
+  for (long i = 0; i < n; ++i) {
+    bools[i] = (bits[i >> 3] >> (i & 7)) & 1;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, table-driven)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t srt_crc32c(const uint8_t* data, long n, uint32_t seed) {
+  if (!crc_init_done) crc_init();
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (long i = 0; i < n; ++i)
+    c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"

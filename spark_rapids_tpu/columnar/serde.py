@@ -124,6 +124,8 @@ def _np_wire(arr: np.ndarray) -> np.ndarray:
 def serialize_host_batch(hb: HostBatch, out: Optional[io.RawIOBase] = None
                          ) -> Optional[bytes]:
     """Write the wire format; returns bytes if ``out`` is None."""
+    from spark_rapids_tpu import native
+
     buffers: List[bytes] = []
     col_headers = []
     for hc in hb.columns:
@@ -133,10 +135,14 @@ def serialize_host_batch(hb: HostBatch, out: Optional[io.RawIOBase] = None
             "np": data.dtype.str,
             "len": int(data.shape[0]),
             "has_validity": hc.validity is not None,
+            # validity travels as an LSB-first bitmap (8x smaller; the
+            # packed-validity layout cudf uses on the wire)
+            "validity_packed": True,
         }
         buffers.append(data.tobytes())
         if hc.validity is not None:
-            buffers.append(_np_wire(hc.validity.astype(np.bool_)).tobytes())
+            buffers.append(native.pack_bits(
+                np.ascontiguousarray(hc.validity, dtype=np.uint8)))
         if hc.dictionary is not None:
             hdr["dictionary"] = [str(s) for s in hc.dictionary]
         col_headers.append(hdr)
@@ -172,8 +178,16 @@ def deserialize_host_batch(data: bytes) -> HostBatch:
         off += nbytes
         validity = None
         if ch["has_validity"]:
-            validity = np.frombuffer(mv[off:off + n], dtype=np.bool_)
-            off += n
+            if ch.get("validity_packed"):
+                from spark_rapids_tpu import native
+
+                nbits = (n + 7) // 8
+                validity = native.unpack_bits(bytes(mv[off:off + nbits]),
+                                              n)
+                off += nbits
+            else:
+                validity = np.frombuffer(mv[off:off + n], dtype=np.bool_)
+                off += n
         dictionary = None
         if "dictionary" in ch:
             dictionary = np.array(ch["dictionary"], dtype=object)

@@ -213,9 +213,9 @@ SHUFFLE_PARTITIONS = conf("rapids.tpu.sql.shuffle.partitions").doc(
 ).int_conf.create_with_default(16)
 
 SHUFFLE_COMPRESSION_CODEC = conf("rapids.tpu.shuffle.compression.codec").doc(
-    "Compression for shuffle payloads: none or zlib "
-    "(nvcomp-LZ4 analogue, RapidsConf.scala:685)."
-).string_conf.create_with_default("none")
+    "Compression for host-path shuffle payloads: none, lz4 (native C++ "
+    "codec; the nvcomp-LZ4 analogue, RapidsConf.scala:685) or zlib."
+).string_conf.create_with_default("lz4")
 
 SHUFFLE_MAX_INFLIGHT = conf(
     "rapids.tpu.shuffle.transport.maxReceiveInflightBytes").doc(

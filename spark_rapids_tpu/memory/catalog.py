@@ -67,7 +67,9 @@ class BufferCatalog:
 
     def __init__(self, device_budget: Optional[int] = None,
                  host_budget: Optional[int] = None,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 disk_codec: str = "lz4"):
+        self.disk_codec = disk_codec
         self._lock = threading.RLock()
         self._entries: Dict[int, _Entry] = {}
         self._ids = itertools.count(1)
@@ -242,7 +244,10 @@ class BufferCatalog:
                     e.tier is not StorageTier.HOST or hb is None or \
                     e.refcount > 0:
                 return 0
-        data = serde.serialize_host_batch(hb)
+        from spark_rapids_tpu.columnar import compression
+
+        data = compression.wrap(serde.serialize_host_batch(hb),
+                                self.disk_codec)
         path = os.path.join(self._ensure_spill_dir(),
                             f"spill-{e.buffer_id}.srt")
         with open(path, "wb") as f:
@@ -270,8 +275,11 @@ class BufferCatalog:
             path = e.disk_path
             tier = e.tier
         if tier is StorageTier.DISK:
+            from spark_rapids_tpu.columnar import compression
+
             with open(path, "rb") as f:
-                hb = serde.deserialize_host_batch(f.read())
+                hb = serde.deserialize_host_batch(
+                    compression.unwrap(f.read()))
         batch = serde.to_device_batch(hb)
         with self._lock:
             if e.buffer_id not in self._entries:
