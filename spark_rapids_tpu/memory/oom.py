@@ -36,7 +36,7 @@ def with_oom_retry(fn: Callable[[], T],
     Retry ladder mirrors DeviceMemoryEventHandler's store-exhausted logic:
     first spill down to half the tracked bytes, then spill everything.
     """
-    cat = catalog or get_catalog()
+    cat = catalog if catalog is not None else get_catalog()
     attempt = 0
     while True:
         try:

@@ -18,7 +18,10 @@ class SpillableBatch:
 
     def __init__(self, batch: ColumnarBatch, priority: int,
                  catalog: Optional[BufferCatalog] = None):
-        self._catalog = catalog or get_catalog()
+        # explicit None-check: BufferCatalog defines __len__, so an EMPTY
+        # catalog is falsy and `catalog or get_catalog()` would silently
+        # route buffers to the global catalog
+        self._catalog = catalog if catalog is not None else get_catalog()
         # realize the row count before the batch can spill: host metadata
         # must survive tier changes (the reference stores it in TableMeta)
         batch.realized_num_rows()

@@ -1,0 +1,25 @@
+"""Shuffle subsystem: device-resident partition cache + peer transport.
+
+The reference's accelerated shuffle (SURVEY.md §2.8) is a GPU-side block
+cache (RapidsCachingWriter/Reader) over a spillable buffer catalog, plus a
+transport that moves blocks between executors with a metadata exchange
+followed by tag-addressed windowed bulk transfers over UCX.
+
+The TPU build keeps that architecture for the host/DCN path — metadata
+exchange, windowed transfers with an inflight throttle, spillable shuffle
+catalog, map-output tracking, fetch-failure semantics — while the
+same-slice bulk path is the fused mesh ``all_to_all`` program in
+parallel/shuffle.py (ICI replaces RDMA; XLA replaces the progress thread).
+"""
+from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.cluster import LocalCluster
+from spark_rapids_tpu.shuffle.iterator import (ShuffleFetchFailedError,
+                                               ShuffleIterator)
+from spark_rapids_tpu.shuffle.meta import BlockId, ShuffleTableMeta
+from spark_rapids_tpu.shuffle.transport import (LocalTransport,
+                                                ShuffleClient,
+                                                ShuffleServer)
+
+__all__ = ["ShuffleBufferCatalog", "LocalCluster", "ShuffleIterator",
+           "ShuffleFetchFailedError", "BlockId", "ShuffleTableMeta",
+           "LocalTransport", "ShuffleClient", "ShuffleServer"]

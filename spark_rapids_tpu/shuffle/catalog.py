@@ -1,0 +1,115 @@
+"""Per-executor shuffle buffer catalog (ShuffleBufferCatalog analogue,
+sql-plugin §2.8: ShuffleBlockId -> buffer ids -> TableMeta).
+
+Blocks written by map tasks are registered as spillable batches at
+shuffle-output priority (spills FIRST — SpillPriorities.scala:32-60); the
+serving path acquires through the spill catalog, transparently unspilling
+(RapidsShuffleServer acquires "possibly unspilling")."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.columnar import compression, serde
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory import priorities
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.memory.spillable import SpillableBatch
+from spark_rapids_tpu.shuffle.meta import BlockId, ShuffleTableMeta
+
+
+class ShuffleBufferCatalog:
+    def __init__(self, buffer_catalog: BufferCatalog,
+                 codec: str = "lz4"):
+        self.buffer_catalog = buffer_catalog
+        self.codec = codec
+        self._lock = threading.Lock()
+        self._blocks: Dict[BlockId, SpillableBatch] = {}
+        self._metas: Dict[BlockId, ShuffleTableMeta] = {}
+
+    def register(self, block: BlockId, batch: ColumnarBatch
+                 ) -> ShuffleTableMeta:
+        """Cache one map-output sub-batch (RapidsCachingWriter.write,
+        RapidsShuffleInternalManager.scala:90-155)."""
+        n = batch.realized_num_rows()
+        dtypes = tuple(c.dtype.name for c in batch.columns)
+        if n == 0:
+            # degenerate (rows-only / empty) batch: meta only, no buffer
+            meta = ShuffleTableMeta(block, 0, 0, dtypes)
+            with self._lock:
+                self._metas[block] = meta
+            return meta
+        sb = SpillableBatch(batch,
+                            priorities.OUTPUT_FOR_SHUFFLE_PRIORITY,
+                            catalog=self.buffer_catalog)
+        payload_len = self._payload_len_estimate(batch)
+        meta = ShuffleTableMeta(block, n, payload_len, dtypes)
+        with self._lock:
+            self._blocks[block] = sb
+            self._metas[block] = meta
+        return meta
+
+    @staticmethod
+    def _payload_len_estimate(batch: ColumnarBatch) -> int:
+        # upper bound before compression; the actual wire chunking uses
+        # the real payload length from serialize()
+        return batch.device_memory_size() + 4096
+
+    def meta(self, block: BlockId) -> Optional[ShuffleTableMeta]:
+        with self._lock:
+            return self._metas.get(block)
+
+    def metas_for(self, shuffle_id: int, partition: int
+                  ) -> List[ShuffleTableMeta]:
+        with self._lock:
+            return [m for b, m in sorted(self._metas.items())
+                    if b.shuffle_id == shuffle_id
+                    and b.partition == partition]
+
+    def has_block(self, block: BlockId) -> bool:
+        with self._lock:
+            return block in self._metas
+
+    def acquire_batch(self, block: BlockId):
+        """Zero-copy local read (RapidsCachingReader local-hit path).
+        Returns an ``acquired()`` context manager, or None for degenerate
+        blocks."""
+        with self._lock:
+            sb = self._blocks.get(block)
+        if sb is None:
+            return None
+        return sb.acquired()
+
+    def serialize_payload(self, block: BlockId) -> bytes:
+        """Wire payload for remote fetch: acquire (unspill if needed) ->
+        host serialize -> compression envelope."""
+        with self._lock:
+            sb = self._blocks.get(block)
+        if sb is None:
+            raise KeyError(f"block {block} not in shuffle catalog")
+        with sb.acquired() as batch:
+            hb = serde.to_host_batch(batch)
+        return compression.wrap(serde.serialize_host_batch(hb),
+                                self.codec)
+
+    def deserialize_payload(self, payload: bytes) -> ColumnarBatch:
+        hb = serde.deserialize_host_batch(compression.unwrap(payload))
+        return serde.to_device_batch(hb)
+
+    def unregister_shuffle(self, shuffle_id: int) -> int:
+        """Drop all blocks of a shuffle (unregisterShuffle on shuffle
+        cleanup); returns blocks removed."""
+        with self._lock:
+            victims = [b for b in self._metas
+                       if b.shuffle_id == shuffle_id]
+            handles = [self._blocks.pop(b) for b in victims
+                       if b in self._blocks]
+            for b in victims:
+                del self._metas[b]
+        for h in handles:
+            h.close()
+        return len(victims)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._metas)

@@ -1,0 +1,131 @@
+"""In-process multi-executor shuffle runtime.
+
+Ties the pieces together the way a Spark cluster does for the reference:
+each executor owns a spill BufferCatalog + ShuffleBufferCatalog + server
+endpoint; a map-output tracker records which executor holds each map
+task's output (the MapStatus registration,
+RapidsShuffleInternalManager.scala:164-191); reduce-side reads go through
+ShuffleIterator (local hits + transport fetches). This is the control
+plane a real multi-host deployment keeps, with LocalTransport swapped for
+a DCN-backed transport."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.iterator import ShuffleIterator
+from spark_rapids_tpu.shuffle.meta import BlockId
+from spark_rapids_tpu.shuffle.transport import (DEFAULT_BOUNCE_SIZE,
+                                                DEFAULT_MAX_INFLIGHT,
+                                                LocalTransport,
+                                                ShuffleClient,
+                                                ShuffleServer)
+
+
+class Executor:
+    def __init__(self, executor_id: str,
+                 device_budget: Optional[int] = None,
+                 host_budget: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 codec: str = "lz4"):
+        self.executor_id = executor_id
+        self.buffer_catalog = BufferCatalog(device_budget=device_budget,
+                                            host_budget=host_budget,
+                                            spill_dir=spill_dir)
+        self.shuffle_catalog = ShuffleBufferCatalog(self.buffer_catalog,
+                                                    codec=codec)
+        self.server = ShuffleServer(executor_id, self.shuffle_catalog)
+
+
+class LocalCluster:
+    """N executors + transport + map-output tracker."""
+
+    def __init__(self, n_executors: int,
+                 device_budget: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 codec: str = "lz4",
+                 bounce_size: int = DEFAULT_BOUNCE_SIZE,
+                 max_inflight: int = DEFAULT_MAX_INFLIGHT):
+        self.transport = LocalTransport()
+        self.executors: List[Executor] = []
+        self.bounce_size = bounce_size
+        self.max_inflight = max_inflight
+        for i in range(n_executors):
+            ex = Executor(
+                f"exec-{i}", device_budget=device_budget,
+                spill_dir=None if spill_dir is None
+                else f"{spill_dir}/exec-{i}",
+                codec=codec)
+            self.executors.append(ex)
+            self.transport.register(ex.server)
+        # shuffle_id -> map_id -> executor_id (MapOutputTracker)
+        self._map_outputs: Dict[int, Dict[int, str]] = {}
+        self._lock = threading.Lock()
+        self._clients: Dict[tuple, ShuffleClient] = {}
+
+    def executor(self, i: int) -> Executor:
+        return self.executors[i]
+
+    # -- map side ---------------------------------------------------------
+
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         executor_index: int,
+                         partition_batches: Dict[int, ColumnarBatch]
+                         ) -> None:
+        """One map task's partitioned output lands in its executor's cache
+        (RapidsCachingWriter.write + MapStatus registration)."""
+        ex = self.executors[executor_index]
+        for partition, batch in partition_batches.items():
+            ex.shuffle_catalog.register(
+                BlockId(shuffle_id, map_id, partition), batch)
+        with self._lock:
+            # MapStatus: executor + the partitions this map produced.
+            # Reads trust THIS record — a tracked block the owner lost is
+            # a fetch failure, never a silent skip.
+            self._map_outputs.setdefault(shuffle_id, {})[map_id] = (
+                ex.executor_id, frozenset(partition_batches))
+
+    # -- reduce side ------------------------------------------------------
+
+    def _client(self, from_executor: str, to_executor: str
+                ) -> ShuffleClient:
+        key = (from_executor, to_executor)
+        with self._lock:
+            c = self._clients.get(key)
+            if c is None:
+                c = ShuffleClient(self.transport.connect(to_executor),
+                                  bounce_size=self.bounce_size,
+                                  max_inflight=self.max_inflight)
+                self._clients[key] = c
+            return c
+
+    def read_partition(self, shuffle_id: int, partition: int,
+                       reader_executor_index: int
+                       ) -> Iterator[ColumnarBatch]:
+        """All batches of one reduce partition, read from the reader
+        executor's perspective."""
+        with self._lock:
+            maps = dict(self._map_outputs.get(shuffle_id, {}))
+        reader = self.executors[reader_executor_index]
+        locations = {}
+        for map_id, (executor_id, partitions) in maps.items():
+            if partition in partitions:
+                locations[BlockId(shuffle_id, map_id, partition)] = \
+                    executor_id
+        it = ShuffleIterator(
+            reader.shuffle_catalog, reader.executor_id, locations,
+            lambda peer: self._client(reader.executor_id, peer))
+        self.last_iterator = it  # for metric assertions in tests
+        return iter(it)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        for ex in self.executors:
+            ex.shuffle_catalog.unregister_shuffle(shuffle_id)
+        with self._lock:
+            self._map_outputs.pop(shuffle_id, None)
+
+    def shutdown(self):
+        self.transport.shutdown()

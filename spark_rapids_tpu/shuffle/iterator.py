@@ -1,0 +1,83 @@
+"""Task-facing shuffle read iterator (RapidsShuffleIterator:363 +
+RapidsCachingReader.scala:59-166).
+
+Given the blocks a reduce task needs, partitions them into local catalog
+hits (zero-copy device reads, possibly unspilled) and per-peer remote
+fetches; transport errors surface as ``ShuffleFetchFailedError`` naming
+the failed block — the reference converts these into Spark fetch-failures
+so the stage retries (RapidsShuffleIterator.scala:242-300)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.meta import BlockId
+from spark_rapids_tpu.shuffle.transport import ShuffleClient, TransportError
+
+
+class ShuffleFetchFailedError(RuntimeError):
+    def __init__(self, block: BlockId, executor_id: str, cause):
+        super().__init__(
+            f"fetch failed for {block} from executor {executor_id}: "
+            f"{cause}")
+        self.block = block
+        self.executor_id = executor_id
+        self.cause = cause
+
+
+class ShuffleIterator:
+    """Yields the batches of one reduce partition.
+
+    ``block_locations`` maps each wanted block to the executor that holds
+    it (the MapStatus/MapOutputTracker answer); ``client_for`` lazily
+    opens a transport client per peer."""
+
+    def __init__(self, local_catalog: ShuffleBufferCatalog,
+                 local_executor_id: str,
+                 block_locations: Dict[BlockId, str],
+                 client_for: Callable[[str], ShuffleClient]):
+        self.local_catalog = local_catalog
+        self.local_executor_id = local_executor_id
+        self.block_locations = block_locations
+        self.client_for = client_for
+        self.local_blocks_read = 0
+        self.remote_blocks_read = 0
+        self.remote_bytes_read = 0
+
+    def __iter__(self) -> Iterator[ColumnarBatch]:
+        local: List[BlockId] = []
+        by_peer: Dict[str, List[BlockId]] = {}
+        for block, executor in sorted(self.block_locations.items()):
+            if executor == self.local_executor_id:
+                local.append(block)
+            else:
+                by_peer.setdefault(executor, []).append(block)
+        # local hits first (RapidsCachingReader serves catalog hits
+        # before starting transport fetches)
+        for block in local:
+            meta = self.local_catalog.meta(block)
+            if meta is None:
+                raise ShuffleFetchFailedError(
+                    block, self.local_executor_id, "missing local block")
+            self.local_blocks_read += 1
+            if meta.num_rows == 0:
+                continue
+            ctx = self.local_catalog.acquire_batch(block)
+            with ctx as batch:
+                yield batch
+        for executor, blocks in sorted(by_peer.items()):
+            client = self.client_for(executor)
+            try:
+                results = client.fetch(blocks)
+            except (TransportError, TimeoutError, KeyError) as e:
+                raise ShuffleFetchFailedError(blocks[0], executor, e)
+            for meta, payload in results:
+                self.remote_blocks_read += 1
+                if payload is None:
+                    continue
+                self.remote_bytes_read += len(payload)
+                try:
+                    yield self.local_catalog.deserialize_payload(payload)
+                except ValueError as e:  # checksum/corruption
+                    raise ShuffleFetchFailedError(meta.block, executor, e)

@@ -1,0 +1,53 @@
+"""Shuffle block identity and table metadata.
+
+The reference describes serialized tables with FlatBuffers ``TableMeta``
+(format/TableMeta.java:59, built by MetaUtils.scala:144) keyed by Spark
+ShuffleBlockIds. Here the metadata is a plain dataclass (it crosses the
+wire as JSON inside the metadata response — the control plane is tiny
+compared to payloads, exactly why the reference splits metadata from bulk
+transfer)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class BlockId:
+    """(shuffle, map task, reduce partition) — ShuffleBlockId analogue."""
+
+    shuffle_id: int
+    map_id: int
+    partition: int
+
+    def __str__(self) -> str:
+        return f"shuffle_{self.shuffle_id}_{self.map_id}_{self.partition}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleTableMeta:
+    """Describes one cached shuffle block (TableMeta analogue).
+
+    ``payload_len`` is the enveloped wire size the receiver must budget
+    for (the inflight throttle counts these bytes); ``num_rows`` lets
+    degenerate rows-only batches skip the bulk transfer entirely
+    (MetaUtils.scala:144 degenerate-batch path)."""
+
+    block: BlockId
+    num_rows: int
+    payload_len: int
+    dtype_names: Tuple[str, ...]
+
+    def to_json(self) -> dict:
+        return {"shuffle_id": self.block.shuffle_id,
+                "map_id": self.block.map_id,
+                "partition": self.block.partition,
+                "num_rows": self.num_rows,
+                "payload_len": self.payload_len,
+                "dtypes": list(self.dtype_names)}
+
+    @staticmethod
+    def from_json(d: dict) -> "ShuffleTableMeta":
+        return ShuffleTableMeta(
+            BlockId(d["shuffle_id"], d["map_id"], d["partition"]),
+            d["num_rows"], d["payload_len"], tuple(d["dtypes"]))

@@ -1,0 +1,315 @@
+"""Transport-agnostic shuffle client/server protocol.
+
+Mirrors the reference's layering (RapidsShuffleTransport.scala:38-280):
+
+- control plane: ``MetadataRequest`` -> exact per-block ``TableMeta``s
+  (payload sizes realized by serializing, like JCudfSerialization sizes in
+  the reference's metadata response),
+- data plane: tag-addressed windowed chunk transfers sized to bounce
+  buffers, client-driven, throttled by inflight bytes
+  (BufferReceiveState / WindowedBlockIterator analogues),
+- a single progress thread per server endpoint (UCX.scala:70-155 runs all
+  UCX work on one progress thread for lock-freedom; LocalTransport does
+  the same with a request queue),
+- fault-injection hooks so error paths are testable without a cluster
+  (the RapidsShuffleClientSuite mocked-transport strategy, SURVEY.md §4).
+
+The bulk path between same-slice chips does NOT go through here — that is
+the fused mesh all_to_all (parallel/shuffle.py). This transport is the
+DCN/host path and the protocol reference for a future multi-host backend.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.meta import BlockId, ShuffleTableMeta
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+DEFAULT_BOUNCE_SIZE = 4 << 20       # bounce-buffer length (4 MiB)
+DEFAULT_MAX_INFLIGHT = 1 << 30      # inflight receive bytes throttle
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class ShuffleServer:
+    """Serves one executor's shuffle catalog (RapidsShuffleServer:671).
+
+    Payloads are serialized at metadata time (realizing exact wire sizes
+    for the response) and cached until the client releases the block, so
+    windowed chunk requests never re-serialize."""
+
+    def __init__(self, executor_id: str, catalog: ShuffleBufferCatalog):
+        self.executor_id = executor_id
+        self.catalog = catalog
+        self._payloads: Dict[BlockId, bytes] = {}
+        self._lock = threading.Lock()
+        # fault-injection hooks (tests): raise/mutate per request
+        self.on_metadata: Optional[Callable] = None
+        self.on_chunk: Optional[Callable] = None
+
+    def handle_metadata(self, blocks: List[BlockId]
+                        ) -> List[ShuffleTableMeta]:
+        if self.on_metadata is not None:
+            self.on_metadata(blocks)
+        out = []
+        for b in blocks:
+            meta = self.catalog.meta(b)
+            if meta is None:
+                raise TransportError(
+                    f"{self.executor_id}: block {b} not found")
+            if meta.num_rows > 0:
+                with self._lock:
+                    payload = self._payloads.get(b)
+                if payload is None:
+                    payload = self.catalog.serialize_payload(b)
+                    with self._lock:
+                        self._payloads[b] = payload
+                meta = ShuffleTableMeta(meta.block, meta.num_rows,
+                                        len(payload), meta.dtype_names)
+            out.append(meta)
+        return out
+
+    def handle_chunk(self, block: BlockId, offset: int,
+                     length: int) -> bytes:
+        if self.on_chunk is not None:
+            self.on_chunk(block, offset, length)
+        with self._lock:
+            payload = self._payloads.get(block)
+        if payload is None:
+            # metadata not requested first, or already released
+            payload = self.catalog.serialize_payload(block)
+            with self._lock:
+                self._payloads[block] = payload
+        if offset >= len(payload):
+            raise TransportError(
+                f"chunk out of range: {block} @{offset}")
+        return payload[offset:offset + length]
+
+    def handle_release(self, block: BlockId) -> None:
+        with self._lock:
+            self._payloads.pop(block, None)
+
+
+# ---------------------------------------------------------------------------
+# In-process transport (the UCX impl analogue)
+# ---------------------------------------------------------------------------
+
+
+class _Request:
+    __slots__ = ("kind", "args", "future")
+
+    def __init__(self, kind: str, args: tuple):
+        self.kind = kind
+        self.args = args
+        self.future: Future = Future()
+
+
+class _Endpoint:
+    """One executor's server endpoint: a request queue drained by a single
+    progress thread (the UCX progress-thread model, UCX.scala:80-97)."""
+
+    def __init__(self, server: ShuffleServer):
+        self.server = server
+        self.q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self.thread = threading.Thread(
+            target=self._progress, name=f"shuffle-{server.executor_id}",
+            daemon=True)
+        self.thread.start()
+
+    def _progress(self):
+        while True:
+            req = self.q.get()
+            if req is None:
+                return
+            try:
+                if req.kind == "metadata":
+                    req.future.set_result(
+                        self.server.handle_metadata(*req.args))
+                elif req.kind == "chunk":
+                    req.future.set_result(
+                        self.server.handle_chunk(*req.args))
+                elif req.kind == "release":
+                    self.server.handle_release(*req.args)
+                    req.future.set_result(None)
+                else:  # pragma: no cover
+                    raise TransportError(f"bad request {req.kind}")
+            except BaseException as e:
+                req.future.set_exception(e)
+
+    def submit(self, kind: str, *args) -> Future:
+        r = _Request(kind, args)
+        self.q.put(r)
+        return r.future
+
+    def shutdown(self):
+        self.q.put(None)
+
+
+class Connection:
+    """Client view of a peer (RapidsShuffleTransport connection traits)."""
+
+    def request_metadata(self, blocks: List[BlockId], timeout: float
+                         ) -> List[ShuffleTableMeta]:
+        raise NotImplementedError
+
+    def request_chunk(self, block: BlockId, offset: int, length: int,
+                      timeout: float) -> bytes:
+        raise NotImplementedError
+
+    def release(self, block: BlockId) -> None:
+        raise NotImplementedError
+
+
+class LocalConnection(Connection):
+    def __init__(self, endpoint: _Endpoint):
+        self._ep = endpoint
+
+    def request_metadata(self, blocks, timeout=30.0):
+        return self._ep.submit("metadata", blocks).result(timeout)
+
+    def request_chunk(self, block, offset, length, timeout=30.0):
+        return self._ep.submit("chunk", block, offset, length
+                               ).result(timeout)
+
+    def release(self, block):
+        self._ep.submit("release", block)
+
+
+class LocalTransport:
+    """In-process executor registry: the management-port/endpoint-map role
+    of UCXShuffleTransport (TCP bootstrap + endpoint table)."""
+
+    def __init__(self):
+        self._endpoints: Dict[str, _Endpoint] = {}
+        self._lock = threading.Lock()
+
+    def register(self, server: ShuffleServer) -> None:
+        with self._lock:
+            self._endpoints[server.executor_id] = _Endpoint(server)
+
+    def connect(self, peer_executor_id: str) -> Connection:
+        with self._lock:
+            ep = self._endpoints.get(peer_executor_id)
+        if ep is None:
+            raise TransportError(f"no endpoint for {peer_executor_id}")
+        return LocalConnection(ep)
+
+    def shutdown(self):
+        with self._lock:
+            for ep in self._endpoints.values():
+                ep.shutdown()
+            self._endpoints.clear()
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class _InflightThrottle:
+    """Blocks fetches while inflight receive bytes exceed the budget
+    (RapidsConf maxReceiveInflightBytes, RapidsConf.scala:603-685)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._inflight = 0
+        self._cv = threading.Condition()
+        self.peak = 0  # observability
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            while self._inflight > 0 and \
+                    self._inflight + n > self.max_bytes:
+                self._cv.wait()
+            self._inflight += n
+            self.peak = max(self.peak, self._inflight)
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._inflight -= n
+            self._cv.notify_all()
+
+
+class BounceBufferPool:
+    """Fixed-count pool of receive windows (BounceBufferManager
+    analogue). A window must be borrowed for every in-flight chunk, so
+    chunk concurrency is bounded by pool size like the reference's
+    registered bounce buffers."""
+
+    def __init__(self, count: int, size: int):
+        self.size = size
+        self._sem = threading.Semaphore(count)
+
+    def borrow(self):
+        self._sem.acquire()
+        return bytearray(self.size)
+
+    def give_back(self, buf) -> None:
+        self._sem.release()
+
+
+class ShuffleClient:
+    """Fetches remote blocks: metadata exchange then windowed chunk
+    transfers (the doFetch flow, RapidsShuffleClient.scala:480-610)."""
+
+    def __init__(self, connection: Connection,
+                 bounce_size: int = DEFAULT_BOUNCE_SIZE,
+                 max_inflight: int = DEFAULT_MAX_INFLIGHT,
+                 bounce_count: int = 8):
+        self.conn = connection
+        self.bounce_size = bounce_size
+        self.throttle = _InflightThrottle(max_inflight)
+        self.pool = BounceBufferPool(bounce_count, bounce_size)
+
+    def fetch(self, blocks: List[BlockId], timeout: float = 30.0
+              ) -> List[Tuple[ShuffleTableMeta, Optional[bytes]]]:
+        """Returns (meta, payload|None) per block; None payload for
+        degenerate rows-only blocks."""
+        with TraceRange("ShuffleClient.metadata"):
+            metas = self.conn.request_metadata(blocks, timeout)
+        out: List[Tuple[ShuffleTableMeta, Optional[bytes]]] = []
+        for meta in metas:
+            if meta.num_rows == 0 or meta.payload_len == 0:
+                out.append((meta, None))
+                continue
+            payload = self._fetch_payload(meta, timeout)
+            out.append((meta, payload))
+            self.conn.release(meta.block)
+        return out
+
+    def _fetch_payload(self, meta: ShuffleTableMeta,
+                       timeout: float) -> bytes:
+        """Windowed transfer of one block (BufferReceiveState windows,
+        RapidsShuffleClient.scala:108-343)."""
+        buf = bytearray(meta.payload_len)
+        offset = 0
+        while offset < meta.payload_len:
+            length = min(self.bounce_size, meta.payload_len - offset)
+            self.throttle.acquire(length)
+            window = self.pool.borrow()
+            try:
+                with TraceRange("ShuffleClient.chunk"):
+                    chunk = self.conn.request_chunk(
+                        meta.block, offset, length, timeout)
+                if len(chunk) != length:
+                    raise TransportError(
+                        f"short chunk for {meta.block}: "
+                        f"{len(chunk)} != {length}")
+                window[:length] = chunk          # recv into bounce buffer
+                buf[offset:offset + length] = window[:length]
+            finally:
+                self.pool.give_back(window)
+                self.throttle.release(length)
+            offset += length
+        return bytes(buf)
