@@ -83,6 +83,9 @@ def eval_expr(e: Expression, ctx: CpuEvalContext) -> CV:
     """Evaluate to a full-length CV (literals broadcast)."""
     fn = _DISPATCH.get(type(e))
     if fn is None:
+        # expressions may carry their own CPU evaluation (PythonUdf)
+        if hasattr(e, "eval_cpu"):
+            return e.eval_cpu(ctx)
         for klass, f in _DISPATCH.items():
             if isinstance(e, klass):
                 fn = f

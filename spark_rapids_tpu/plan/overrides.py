@@ -740,6 +740,10 @@ class PlanOnCpuError(AssertionError):
 def apply_overrides(plan: pn.PlanNode,
                     conf: Optional[RapidsConf] = None) -> TpuExec:
     conf = conf or RapidsConf()
+    if conf.get(cfg.UDF_COMPILER_ENABLED):
+        from spark_rapids_tpu.udf import compile_udfs_in_plan
+
+        plan = compile_udfs_in_plan(plan)
     plan = push_down_file_filters(plan, conf)
     meta = NodeMeta(plan, conf)
     meta.tag_for_tpu()

@@ -1,0 +1,19 @@
+"""Python-UDF compiler: trace opaque user functions into the expression
+layer (SURVEY.md §2.11).
+
+The reference compiles Scala UDF *JVM bytecode* into Catalyst expressions
+(udf-compiler: LambdaReflection -> CFG -> symbolic execution,
+CatalystExpressionBuilder.scala:44-100), falling back silently to the
+original UDF when compilation fails. The TPU-native analogue traces the
+*Python callable* with symbolic operands: operators and recognized
+method/builtin calls record expression nodes, so a successful trace turns
+the UDF into native expressions that fuse into the jitted projection.
+Failures (data-dependent branches, unknown calls) leave the UDF opaque —
+it then runs row-wise on the CPU engine, the reference's fallback path.
+"""
+from spark_rapids_tpu.udf.tracer import (PythonUdf, UdfCompileError,
+                                         compile_udf,
+                                         compile_udfs_in_plan, sym_if)
+
+__all__ = ["PythonUdf", "UdfCompileError", "compile_udf",
+           "compile_udfs_in_plan", "sym_if"]

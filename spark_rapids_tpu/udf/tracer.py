@@ -1,0 +1,387 @@
+"""Symbolic tracer turning Python scalar functions into Expression trees.
+
+Structure mirrors the reference compiler's pieces (SURVEY.md §2.11):
+
+- ``SymbolicValue``        <- the operand-stack values of the symbolic
+  executor (State, CatalystExpressionBuilder.scala): every overloaded
+  operator or recognized call appends Expression nodes instead of
+  computing.
+- ``compile_udf``          <- CatalystExpressionBuilder.compile: runs the
+  function once on symbolic arguments; any escape (bool coercion = data-
+  dependent branch, unknown method, foreign type) raises UdfCompileError.
+- ``PythonUdf``            <- the uncompiled ScalaUDF: an opaque
+  Expression the TPU planner rejects (so the plan falls back) but the CPU
+  engine evaluates row-wise with None-for-NULL semantics.
+- ``compile_udfs_in_plan`` <- LogicalPlanRules.apply (udf-compiler/.../
+  Plugin.scala:36-94): rewrites every compilable PythonUdf in a plan,
+  keeping the original on failure.
+
+``sym_if(cond, a, b)`` is the explicit branch construct (Python's ``if``
+on traced values cannot be intercepted without bytecode rewriting — the
+JVM compiler gets branches from bytecode; here the user writes the
+conditional functionally, as in jax).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.cpu.evaluator import CV, CpuEvalContext, eval_expr
+from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import conditional as cond
+from spark_rapids_tpu.expressions import math as mth
+from spark_rapids_tpu.expressions import predicates as pr
+from spark_rapids_tpu.expressions import strings as st
+from spark_rapids_tpu.expressions.base import (Expression, Literal)
+from spark_rapids_tpu.expressions.cast import Cast
+from spark_rapids_tpu.plan import nodes as pn
+
+
+class UdfCompileError(Exception):
+    pass
+
+
+def _lift(v) -> Expression:
+    if isinstance(v, SymbolicValue):
+        return v.expr
+    if isinstance(v, Expression):
+        return v
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return Literal(v)
+    raise UdfCompileError(f"cannot lift {type(v).__name__} into the "
+                          "expression language")
+
+
+class SymbolicValue:
+    """Expression-building proxy handed to the traced function."""
+
+    __slots__ = ("expr",)
+
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # -- arithmetic -------------------------------------------------------
+
+    def _bin(self, other, klass, flip=False):
+        l, r = _lift(self), _lift(other)
+        if flip:
+            l, r = r, l
+        return SymbolicValue(klass(l, r))
+
+    def __add__(self, o):
+        if self.expr.dtype is dt.STRING or (
+                isinstance(o, str)) or (
+                isinstance(o, SymbolicValue) and
+                o.expr.dtype is dt.STRING):
+            return SymbolicValue(st.ConcatStrings(
+                [_lift(self), _lift(o)]))
+        return self._bin(o, ar.Add)
+
+    def __radd__(self, o):
+        if isinstance(o, str) or self.expr.dtype is dt.STRING:
+            return SymbolicValue(st.ConcatStrings(
+                [_lift(o), _lift(self)]))
+        return self._bin(o, ar.Add, flip=True)
+
+    def __sub__(self, o):
+        return self._bin(o, ar.Subtract)
+
+    def __rsub__(self, o):
+        return self._bin(o, ar.Subtract, flip=True)
+
+    def __mul__(self, o):
+        return self._bin(o, ar.Multiply)
+
+    def __rmul__(self, o):
+        return self._bin(o, ar.Multiply, flip=True)
+
+    def __truediv__(self, o):
+        return self._bin(o, ar.Divide)
+
+    def __rtruediv__(self, o):
+        return self._bin(o, ar.Divide, flip=True)
+
+    def __floordiv__(self, o):
+        return self._bin(o, ar.IntegralDivide)
+
+    def __rfloordiv__(self, o):
+        return self._bin(o, ar.IntegralDivide, flip=True)
+
+    def __mod__(self, o):
+        return self._bin(o, ar.Remainder)
+
+    def __rmod__(self, o):
+        return self._bin(o, ar.Remainder, flip=True)
+
+    def __pow__(self, o):
+        return self._bin(o, mth.Pow)
+
+    def __rpow__(self, o):
+        return self._bin(o, mth.Pow, flip=True)
+
+    def __neg__(self):
+        return SymbolicValue(ar.UnaryMinus(_lift(self)))
+
+    def __pos__(self):
+        return SymbolicValue(ar.UnaryPositive(_lift(self)))
+
+    def __abs__(self):
+        return SymbolicValue(ar.Abs(_lift(self)))
+
+    # -- comparisons ------------------------------------------------------
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin(o, pr.EqualTo)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return SymbolicValue(pr.Not(pr.EqualTo(_lift(self), _lift(o))))
+
+    def __lt__(self, o):
+        return self._bin(o, pr.LessThan)
+
+    def __le__(self, o):
+        return self._bin(o, pr.LessThanOrEqual)
+
+    def __gt__(self, o):
+        return self._bin(o, pr.GreaterThan)
+
+    def __ge__(self, o):
+        return self._bin(o, pr.GreaterThanOrEqual)
+
+    # -- boolean ----------------------------------------------------------
+
+    def __and__(self, o):
+        return self._bin(o, pr.And)
+
+    def __rand__(self, o):
+        return self._bin(o, pr.And, flip=True)
+
+    def __or__(self, o):
+        return self._bin(o, pr.Or)
+
+    def __ror__(self, o):
+        return self._bin(o, pr.Or, flip=True)
+
+    def __invert__(self):
+        return SymbolicValue(pr.Not(_lift(self)))
+
+    def __bool__(self):
+        raise UdfCompileError(
+            "data-dependent control flow (if/while/and/or on a traced "
+            "value); use sym_if(cond, a, b) or let the UDF fall back")
+
+    def __str__(self):
+        raise UdfCompileError(
+            "str() on a traced value; use Cast via .astype(STRING)")
+
+    def __repr__(self) -> str:
+        return f"Symbolic({self.expr!r})"
+
+    def __hash__(self):  # __eq__ is symbolic; identity hash keeps dicts sane
+        return id(self)
+
+    # -- recognized methods (the Instruction.scala method-call table) -----
+
+    def upper(self):
+        return SymbolicValue(st.Upper(_lift(self)))
+
+    def lower(self):
+        return SymbolicValue(st.Lower(_lift(self)))
+
+    def strip(self):
+        return SymbolicValue(st.StringTrim(_lift(self)))
+
+    def lstrip(self):
+        return SymbolicValue(st.StringTrimLeft(_lift(self)))
+
+    def rstrip(self):
+        return SymbolicValue(st.StringTrimRight(_lift(self)))
+
+    @staticmethod
+    def _want_str(v, what: str) -> str:
+        # these expressions take literal needles (the reference's
+        # GpuSubstring-style lit-only restriction)
+        if not isinstance(v, str):
+            raise UdfCompileError(f"{what} needs a literal string")
+        return v
+
+    def startswith(self, prefix):
+        return SymbolicValue(st.StartsWith(
+            _lift(self), self._want_str(prefix, "startswith")))
+
+    def endswith(self, suffix):
+        return SymbolicValue(st.EndsWith(
+            _lift(self), self._want_str(suffix, "endswith")))
+
+    def replace(self, a, b):
+        return SymbolicValue(st.StringReplace(
+            _lift(self), self._want_str(a, "replace"),
+            self._want_str(b, "replace")))
+
+    def __contains__(self, item):
+        raise UdfCompileError("`in` coerces to bool; use .contains()")
+
+    def contains(self, item):
+        return SymbolicValue(st.Contains(
+            _lift(self), self._want_str(item, "contains")))
+
+    def __len__(self):
+        raise UdfCompileError("len() must return int; use .length()")
+
+    def length(self):
+        return SymbolicValue(st.Length(_lift(self)))
+
+    # -- float/round group ------------------------------------------------
+
+    def sqrt(self):
+        return SymbolicValue(mth.Sqrt(_lift(self)))
+
+    def __float__(self):
+        raise UdfCompileError("float() coercion is data-dependent; "
+                              "use float-typed arithmetic instead")
+
+    def __int__(self):
+        raise UdfCompileError("int() coercion is data-dependent; "
+                              "use .astype(dtype) instead")
+
+    def astype(self, to: dt.DType):
+        return SymbolicValue(Cast(_lift(self), to))
+
+    def __floor__(self):
+        return SymbolicValue(mth.Floor(_lift(self)))
+
+    def __ceil__(self):
+        return SymbolicValue(mth.Ceil(_lift(self)))
+
+
+def sym_if(cond_v, then_v, else_v):
+    """Functional conditional for traced UDFs (the If/CaseWhen the JVM
+    compiler folds branches into). With concrete (non-traced) arguments it
+    evaluates eagerly, so a sym_if-using UDF also runs row-wise when the
+    surrounding function is untraceable."""
+    if not any(isinstance(v, SymbolicValue)
+               for v in (cond_v, then_v, else_v)):
+        return then_v if cond_v else else_v
+    return SymbolicValue(cond.If(_lift(cond_v), _lift(then_v),
+                                 _lift(else_v)))
+
+
+def compile_udf(fn: Callable, args: Sequence[Expression]
+                ) -> Optional[Expression]:
+    """Trace ``fn`` over symbolic arguments; returns the compiled
+    expression or None when the function escapes the traceable subset
+    (the reference's silent-fallback contract)."""
+    sym_args = [SymbolicValue(a) for a in args]
+    try:
+        out = fn(*sym_args)
+    except UdfCompileError:
+        return None
+    except TypeError:
+        # e.g. math.sqrt(SymbolicValue) — the C function rejects proxies.
+        # Retry with a shim namespace is not possible generically; treat
+        # as untraceable.
+        return None
+    except Exception:
+        return None
+    try:
+        return _lift(out)
+    except UdfCompileError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The opaque UDF expression
+# ---------------------------------------------------------------------------
+
+
+class PythonUdf(Expression):
+    """Uncompiled Python scalar UDF over child expressions.
+
+    TPU planner: no rule exists -> subtree falls back (the reference's
+    GpuOverrides would equally reject an unreplaced ScalaUDF). CPU
+    engine: row-wise apply with None passed for NULL inputs and a None
+    result meaning NULL (Spark UDF semantics)."""
+
+    def __init__(self, fn: Callable, children: Sequence[Expression],
+                 return_dtype: dt.DType, name: Optional[str] = None):
+        super().__init__(list(children))
+        self.fn = fn
+        self._dtype = return_dtype
+        self.udf_name = name or getattr(fn, "__name__", "udf")
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def device_only(self) -> bool:
+        return False
+
+    def eval_cpu(self, ctx: CpuEvalContext) -> CV:
+        ins = [eval_expr(c, ctx) for c in self.children]
+        n = ctx.num_rows
+        out_dtype = self._dtype
+        if out_dtype is dt.STRING:
+            data = np.empty(n, dtype=object)
+        else:
+            data = np.zeros(n, dtype=out_dtype.np_dtype)
+        validity = np.ones(n, dtype=bool)
+        for i in range(n):
+            row = [None if (cv.validity is not None and not cv.validity[i])
+                   else cv.data[i] for cv in ins]
+            # numpy scalars -> python values so user code sees plain types
+            row = [v.item() if isinstance(v, np.generic) else v
+                   for v in row]
+            r = self.fn(*row)
+            if r is None:
+                validity[i] = False
+            else:
+                data[i] = r
+        return CV(out_dtype, data, validity)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"PythonUdf({self.udf_name})"
+
+
+# ---------------------------------------------------------------------------
+# Plan rewrite (LogicalPlanRules analogue)
+# ---------------------------------------------------------------------------
+
+
+def _rewrite_expr(e: Expression, stats: List[int]) -> Expression:
+    def fn(node: Expression) -> Expression:
+        if isinstance(node, PythonUdf):
+            compiled = compile_udf(node.fn, node.children)
+            if compiled is not None:
+                if compiled.dtype is not node.dtype:
+                    # honor the declared return type (the traced tree may
+                    # naturally be narrower/wider)
+                    compiled = Cast(compiled, node.dtype)
+                stats[0] += 1
+                return compiled
+            stats[1] += 1
+        return node
+    return e.transform(fn)
+
+
+def compile_udfs_in_plan(plan: pn.PlanNode) -> pn.PlanNode:
+    """Rewrite compilable PythonUdfs throughout a plan tree. Safe on any
+    node type; only expression-bearing nodes are touched."""
+    stats = [0, 0]
+    new_children = [compile_udfs_in_plan(c) for c in plan.children]
+    plan = plan.with_children(new_children) if plan.children else plan
+    import copy
+
+    if isinstance(plan, pn.ProjectNode):
+        plan = copy.copy(plan)
+        plan.exprs = [_rewrite_expr(e, stats) for e in plan.exprs]
+    elif isinstance(plan, pn.FilterNode):
+        plan = copy.copy(plan)
+        plan.condition = _rewrite_expr(plan.condition, stats)
+    return plan
