@@ -309,6 +309,18 @@ CSV_READ_ENABLED = conf("rapids.tpu.sql.format.csv.read.enabled").doc(
     "Enable CSV scans."
 ).boolean_conf.create_with_default(True)
 
+ADAPTIVE_ENABLED = conf("rapids.tpu.sql.adaptive.enabled").doc(
+    "Adaptive shuffle reads: after an exchange materializes, coalesce "
+    "small reduce partitions toward the advisory size using exact map "
+    "output statistics (GpuCustomShuffleReaderExec analogue, "
+    "GpuOverrides.scala:1874-1887)."
+).boolean_conf.create_with_default(True)
+
+ADVISORY_PARTITION_SIZE = conf(
+    "rapids.tpu.sql.adaptive.advisoryPartitionSizeBytes").doc(
+    "Target bytes per coalesced shuffle partition."
+).bytes_conf.create_with_default(64 << 20)
+
 FILTER_PUSHDOWN_ENABLED = conf(
     "rapids.tpu.sql.format.pushDownFilters.enabled").doc(
     "Push comparison conjuncts from a Filter above a file scan into the "

@@ -1,0 +1,157 @@
+"""Adaptive (AQE-equivalent) shuffle reads.
+
+Reference: with AQE on, exchanges become query stages; after a stage's map
+side runs, Spark replans reads using MapOutputStatistics and the plugin
+supplies GpuCustomShuffleReaderExec for coalesced-partition reads
+(GpuOverrides.scala:1874-1887, GpuTransitionOverrides.scala:51-94). The
+reference v0.3 supports COALESCED reads (skewed-join splitting stayed on
+CPU), and so does this exec.
+
+Here the exchange exec already materializes map output into a block store,
+so statistics are exact: the reader computes contiguous partition groups
+targeting the advisory size and serves each group as one output
+partition. For joins, BOTH sides must coalesce identically — build the
+groups from the summed per-partition sizes and share the spec
+(CoalesceShufflePartitions applies one spec per stage the same way).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+
+
+class MapOutputStatistics:
+    """Exact per-reduce-partition byte sizes of a materialized exchange
+    (the MapOutputStatistics the AQE replan consumes)."""
+
+    def __init__(self, bytes_by_partition: List[int]):
+        self.bytes_by_partition = list(bytes_by_partition)
+
+    @staticmethod
+    def of(exchange: ShuffleExchangeExec) -> "MapOutputStatistics":
+        exchange._materialize()
+        sizes = []
+        for p in range(exchange.num_out_partitions):
+            sizes.append(sum(h.device_memory_size()
+                             for h in exchange._blocks[p]))
+        return MapOutputStatistics(sizes)
+
+    def skewed_partitions(self, factor: float = 5.0,
+                          threshold: int = 256 << 20) -> List[int]:
+        """Partitions larger than max(threshold, factor * median) — the
+        OptimizeSkewedJoin detection rule; surfaced as diagnostics (the
+        reference keeps skew handling on CPU in v0.3)."""
+        sizes = sorted(self.bytes_by_partition)
+        if not sizes:
+            return []
+        median = sizes[len(sizes) // 2]
+        cut = max(threshold, factor * max(median, 1))
+        return [i for i, s in enumerate(self.bytes_by_partition)
+                if s > cut]
+
+
+def coalesce_groups(stats: MapOutputStatistics, advisory_bytes: int,
+                    min_partitions: int = 1) -> List[List[int]]:
+    """Contiguous grouping targeting advisory_bytes per group (Spark's
+    coalesceShufflePartitions algorithm: accumulate until the next
+    partition would overflow a non-empty group)."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for p, size in enumerate(stats.bytes_by_partition):
+        if cur and cur_bytes + size > advisory_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(p)
+        cur_bytes += size
+    if cur:
+        groups.append(cur)
+    # honor a minimum parallelism by splitting the largest groups
+    while len(groups) < min_partitions:
+        big = max(range(len(groups)),
+                  key=lambda i: (len(groups[i]),
+                                 sum(stats.bytes_by_partition[p]
+                                     for p in groups[i])))
+        g = groups[big]
+        if len(g) <= 1:
+            break
+        mid = len(g) // 2
+        groups[big:big + 1] = [g[:mid], g[mid:]]
+    return groups
+
+
+class AdaptiveShuffleReaderExec(TpuExec):
+    """Serves coalesced partition groups of a materialized exchange
+    (GpuCustomShuffleReaderExec analogue). ``groups_provider`` defers the
+    statistics read until first access — the map stage runs when the
+    first consumer pulls, exactly AQE's materialize-then-replan order."""
+
+    def __init__(self, exchange: ShuffleExchangeExec,
+                 advisory_bytes: int,
+                 groups_provider=None):
+        super().__init__([exchange], exchange.schema)
+        self.advisory_bytes = advisory_bytes
+        self._groups_provider = groups_provider
+        self._groups: Optional[List[List[int]]] = None
+
+    @property
+    def exchange(self) -> ShuffleExchangeExec:
+        return self.children[0]
+
+    @property
+    def groups(self) -> List[List[int]]:
+        if self._groups is None:
+            if self._groups_provider is not None:
+                self._groups = self._groups_provider()
+            else:
+                stats = MapOutputStatistics.of(self.exchange)
+                self._groups = coalesce_groups(stats, self.advisory_bytes)
+        return self._groups
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.groups)
+
+    @property
+    def coalesce_after(self):
+        return self.exchange.coalesce_after
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            empty = True
+            for p in self.groups[partition]:
+                for b in self.exchange.execute(p):
+                    if b.realized_num_rows() == 0:
+                        continue
+                    empty = False
+                    yield b
+            if empty:
+                yield ColumnarBatch.empty(self.schema)
+        return timed(self, it())
+
+
+def paired_adaptive_readers(left: ShuffleExchangeExec,
+                            right: ShuffleExchangeExec,
+                            advisory_bytes: int
+                            ) -> "tuple[TpuExec, TpuExec]":
+    """One shared group spec for a join's two shuffles, computed lazily
+    from the summed per-partition sizes so the partition-aligned join
+    contract survives coalescing."""
+    assert left.num_out_partitions == right.num_out_partitions
+    cache: List[Optional[List[List[int]]]] = [None]
+
+    def provider():
+        if cache[0] is None:
+            ls = MapOutputStatistics.of(left)
+            rs = MapOutputStatistics.of(right)
+            combined = MapOutputStatistics(
+                [a + b for a, b in zip(ls.bytes_by_partition,
+                                       rs.bytes_by_partition)])
+            cache[0] = coalesce_groups(combined, advisory_bytes)
+        return cache[0]
+
+    return (AdaptiveShuffleReaderExec(left, advisory_bytes, provider),
+            AdaptiveShuffleReaderExec(right, advisory_bytes, provider))

@@ -21,6 +21,7 @@ from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import Schema
 from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs import adaptive as adaptive_exec
 from spark_rapids_tpu.execs import aggregate as agg_exec
 from spark_rapids_tpu.execs import basic, batching, exchange, joins, sort, \
     window
@@ -261,6 +262,16 @@ class NodeRule:
         raise NotImplementedError
 
 
+def _adaptive_read(ex: exchange.ShuffleExchangeExec,
+                   conf: RapidsConf) -> TpuExec:
+    """Wrap a multi-partition exchange in an adaptive coalescing reader
+    (AQE's coalesce-shuffle-partitions applied with exact statistics)."""
+    if not conf.get(cfg.ADAPTIVE_ENABLED) or ex.num_out_partitions <= 1:
+        return ex
+    return adaptive_exec.AdaptiveShuffleReaderExec(
+        ex, conf.get(cfg.ADVISORY_PARTITION_SIZE))
+
+
 def _check_types(meta: NodeMeta, types, what: str):
     for t in types:
         if not dt.is_supported(t):
@@ -387,11 +398,11 @@ class _AggregateRule(NodeRule):
             mode="partial", conf=meta.conf)
         nkeys = len(node.grouping)
         if nkeys:
-            ex = exchange.ShuffleExchangeExec(
+            ex = _adaptive_read(exchange.ShuffleExchangeExec(
                 ("hash", list(range(nkeys))),
                 min(meta.conf.get(cfg.SHUFFLE_PARTITIONS),
                     max(child.num_partitions, 1)),
-                partial)
+                partial), meta.conf)
         else:
             ex = exchange.ShuffleExchangeExec(("single",), 1, partial)
         final_grouping = [BoundReference(i, e.dtype)
@@ -488,9 +499,15 @@ class _JoinRule(NodeRule):
         multi = left.num_partitions > 1 or right.num_partitions > 1
         if kind != "cross" and multi:
             parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
-            left = exchange.ShuffleExchangeExec(("hash", lk), parts, left)
-            right = exchange.ShuffleExchangeExec(("hash", rk), parts,
-                                                 right)
+            lex = exchange.ShuffleExchangeExec(("hash", lk), parts, left)
+            rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right)
+            if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1:
+                # one shared group spec keeps the sides partition-aligned
+                left, right = adaptive_exec.paired_adaptive_readers(
+                    lex, rex,
+                    meta.conf.get(cfg.ADVISORY_PARTITION_SIZE))
+            else:
+                left, right = lex, rex
             return joins.ShuffledHashJoinExec(
                 kind, left, right, lk, rk, out_schema, cond, meta.conf)
         if kind == "cross" and multi:
@@ -587,8 +604,9 @@ class _WindowRule(NodeRule):
         if child.num_partitions > 1:
             if node.partition_ordinals:
                 parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
-                child = exchange.ShuffleExchangeExec(
-                    ("hash", node.partition_ordinals), parts, child)
+                child = _adaptive_read(exchange.ShuffleExchangeExec(
+                    ("hash", node.partition_ordinals), parts, child),
+                    meta.conf)
             else:
                 child = exchange.ShuffleExchangeExec(("single",), 1, child)
         return window.WindowExec(node.partition_ordinals, node.order_specs,

@@ -1,0 +1,110 @@
+"""Adaptive shuffle-read (AQE-equivalent) tests."""
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs.adaptive import (AdaptiveShuffleReaderExec,
+                                             MapOutputStatistics,
+                                             coalesce_groups)
+from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions.base import BoundReference
+from spark_rapids_tpu.io import ParquetSource
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.overrides import apply_overrides
+
+from tests.compare import assert_cpu_and_tpu_equal
+
+
+def test_coalesce_groups_algorithm():
+    stats = MapOutputStatistics([10, 10, 10, 100, 5, 5, 5, 5])
+    groups = coalesce_groups(stats, advisory_bytes=30)
+    # contiguity + full coverage, groups near the target
+    assert [p for g in groups for p in g] == list(range(8))
+    assert groups == [[0, 1, 2], [3], [4, 5, 6, 7]]
+
+
+def test_coalesce_groups_min_partitions():
+    stats = MapOutputStatistics([1] * 8)
+    groups = coalesce_groups(stats, advisory_bytes=1 << 30,
+                             min_partitions=4)
+    assert len(groups) >= 4
+    assert [p for g in groups for p in g] == list(range(8))
+
+
+def test_skew_detection():
+    sizes = [10] * 9 + [10_000_000_000]
+    stats = MapOutputStatistics(sizes)
+    assert stats.skewed_partitions() == [9]
+    assert MapOutputStatistics([10] * 10).skewed_partitions() == []
+
+
+@pytest.fixture()
+def multifile_scan(tmp_path):
+    rng = np.random.default_rng(0)
+    for k in range(4):
+        n = 500
+        t = pa.table({
+            "k": rng.integers(0, 40, n).astype(np.int64),
+            "v": rng.random(n),
+        })
+        pq.write_table(t, tmp_path / f"f{k}.parquet")
+    return pn.ScanNode(ParquetSource(str(tmp_path)))
+
+
+def _agg_plan(scan):
+    return pn.AggregateNode(
+        [BoundReference(0, dt.INT64)],
+        [pn.AggCall(A.Sum(BoundReference(1, dt.FLOAT64)), "sv"),
+         pn.AggCall(A.Count(BoundReference(1, dt.FLOAT64)), "cv")],
+        scan, grouping_names=["k"])
+
+
+def _find(exec_, klass):
+    out = []
+    stack = [exec_]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, klass):
+            out.append(e)
+        stack.extend(e.children)
+    return out
+
+
+def test_adaptive_agg_coalesces_and_matches(multifile_scan):
+    plan = _agg_plan(multifile_scan)
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    exec_ = assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
+    readers = _find(exec_, AdaptiveShuffleReaderExec)
+    assert readers, "adaptive reader must wrap the hash exchange"
+    r = readers[0]
+    # tiny data -> far fewer coalesced groups than shuffle partitions
+    assert r.num_partitions < r.exchange.num_out_partitions
+
+
+def test_adaptive_disabled_no_reader(multifile_scan):
+    plan = _agg_plan(multifile_scan)
+    conf = RapidsConf({"rapids.tpu.sql.adaptive.enabled": False})
+    exec_ = apply_overrides(plan, conf)
+    assert not _find(exec_, AdaptiveShuffleReaderExec)
+    assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
+
+
+def test_adaptive_join_sides_stay_aligned(tmp_path, multifile_scan):
+    rng = np.random.default_rng(1)
+    n = 300
+    t = pa.table({"k2": rng.integers(0, 40, n).astype(np.int64),
+                  "w": rng.random(n)})
+    pq.write_table(t, tmp_path / "right.parquet")
+    pq.write_table(t, tmp_path / "right2.parquet")
+    right = pn.ScanNode(ParquetSource(
+        [str(tmp_path / "right.parquet"), str(tmp_path / "right2.parquet")]))
+    plan = pn.JoinNode("inner", multifile_scan, right, [0], [0])
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    exec_ = assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
+    readers = _find(exec_, AdaptiveShuffleReaderExec)
+    assert len(readers) == 2
+    # shared spec: identical groups on both sides
+    assert readers[0].groups == readers[1].groups
