@@ -1,0 +1,11 @@
+"""Benchmark suites (the reference's integration_tests benchmark layer,
+SURVEY.md §2.14: TpchLikeSpark.scala hand-written query definitions +
+BenchmarkRunner CLI + BenchUtils.compareResults verification).
+
+"-like" has the same meaning as in the reference: schema- and
+shape-faithful versions of the TPC queries over generated data, NOT
+audited TPC runs (reference README disclaimer)."""
+from spark_rapids_tpu.benchmarks import datagen, tpch
+from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner
+
+__all__ = ["datagen", "tpch", "BenchmarkRunner"]

@@ -1,0 +1,99 @@
+"""TPC-H-like table generators (the CSV->parquet converter role of the
+reference's integration_tests tpch/ConvertFiles, but generated directly:
+no dbgen in the image). Row counts scale with ``sf`` like TPC-H
+(lineitem ~ 6M rows/SF)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+EPOCH_1992 = (np.datetime64("1992-01-01") -
+              np.datetime64("1970-01-01")).astype(int)
+
+RETURN_FLAGS = np.array(["A", "N", "R"], dtype=object)
+LINE_STATUS = np.array(["F", "O"], dtype=object)
+SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                     "MACHINERY"], dtype=object)
+PRIORITIES = np.array(["1-URGENT", "2-HIGH", "3-MEDIUM",
+                       "4-NOT SPECIFIED", "5-LOW"], dtype=object)
+
+
+def _dates(rng, n, lo_year=1992, hi_year=1998):
+    lo = (np.datetime64(f"{lo_year}-01-01") -
+          np.datetime64("1970-01-01")).astype(int)
+    hi = (np.datetime64(f"{hi_year}-12-31") -
+          np.datetime64("1970-01-01")).astype(int)
+    days = rng.integers(lo, hi + 1, n)
+    return days.astype("datetime64[D]")
+
+
+def gen_lineitem(sf: float, seed: int = 11) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(6_000_000 * sf), 100)
+    orderkey = rng.integers(1, max(int(1_500_000 * sf), 25) * 4, n)
+    return pa.table({
+        "l_orderkey": orderkey.astype(np.int64),
+        "l_partkey": rng.integers(1, max(int(200_000 * sf), 10), n
+                                  ).astype(np.int64),
+        "l_suppkey": rng.integers(1, max(int(10_000 * sf), 5), n
+                                  ).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+        "l_extendedprice": np.round(rng.random(n) * 100_000 + 900, 2),
+        "l_discount": np.round(rng.integers(0, 11, n) / 100.0, 2),
+        "l_tax": np.round(rng.integers(0, 9, n) / 100.0, 2),
+        "l_returnflag": RETURN_FLAGS[rng.integers(0, 3, n)],
+        "l_linestatus": LINE_STATUS[rng.integers(0, 2, n)],
+        "l_shipdate": _dates(rng, n),
+    })
+
+
+def gen_orders(sf: float, seed: int = 12) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(1_500_000 * sf), 25)
+    return pa.table({
+        "o_orderkey": np.arange(1, n + 1, dtype=np.int64) * 4,
+        "o_custkey": rng.integers(1, max(int(150_000 * sf), 10), n
+                                  ).astype(np.int64),
+        "o_totalprice": np.round(rng.random(n) * 400_000 + 800, 2),
+        "o_orderdate": _dates(rng, n),
+        "o_orderpriority": PRIORITIES[rng.integers(0, 5, n)],
+        "o_shippriority": np.zeros(n, dtype=np.int32),
+    })
+
+
+def gen_customer(sf: float, seed: int = 13) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(150_000 * sf), 10)
+    return pa.table({
+        "c_custkey": np.arange(1, n + 1, dtype=np.int64),
+        "c_mktsegment": SEGMENTS[rng.integers(0, 5, n)],
+        "c_acctbal": np.round(rng.random(n) * 11_000 - 1_000, 2),
+    })
+
+
+GENERATORS = {
+    "lineitem": gen_lineitem,
+    "orders": gen_orders,
+    "customer": gen_customer,
+}
+
+
+def write_tables(data_dir: str, sf: float, tables=None,
+                 files_per_table: int = 4) -> None:
+    """Generate and write parquet (multi-file: scan splits become TPU scan
+    partitions, like the reference's multi-file parquet layout)."""
+    os.makedirs(data_dir, exist_ok=True)
+    for name in tables or GENERATORS:
+        table = GENERATORS[name](sf)
+        tdir = os.path.join(data_dir, name)
+        os.makedirs(tdir, exist_ok=True)
+        n = table.num_rows
+        per = -(-n // files_per_table)
+        for i in range(files_per_table):
+            chunk = table.slice(i * per, per)
+            if chunk.num_rows:
+                pq.write_table(chunk,
+                               os.path.join(tdir, f"part-{i:03d}.parquet"))

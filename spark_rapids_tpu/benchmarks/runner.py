@@ -1,0 +1,134 @@
+"""BenchmarkRunner CLI (the reference's BenchmarkRunner.scala + BenchUtils:
+run a named query N times, capture env/plan/timings as JSON, optionally
+verify TPU results against the CPU oracle — docs/benchmarks.md:26-190).
+
+    python -m spark_rapids_tpu.benchmarks.runner \
+        --benchmark tpch_q1 --sf 0.01 --iterations 3 --compare \
+        --data-dir /tmp/tpch --output q1.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Optional
+
+from spark_rapids_tpu.benchmarks import datagen, tpch
+from spark_rapids_tpu.config import RapidsConf
+
+
+class BenchmarkRunner:
+    def __init__(self, data_dir: str, sf: float,
+                 conf: Optional[RapidsConf] = None):
+        self.data_dir = data_dir
+        self.sf = sf
+        self.conf = conf or RapidsConf()
+
+    def ensure_data(self) -> None:
+        marker = os.path.join(self.data_dir, f".sf-{self.sf}")
+        if os.path.exists(marker):
+            return
+        datagen.write_tables(self.data_dir, self.sf)
+        with open(marker, "w") as f:
+            f.write("ok")
+
+    @staticmethod
+    def _env() -> dict:
+        import jax
+
+        import spark_rapids_tpu
+
+        return {
+            "framework_version": getattr(spark_rapids_tpu, "__version__",
+                                         "dev"),
+            "jax_version": jax.__version__,
+            "backend": jax.devices()[0].platform,
+            "device_count": len(jax.devices()),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+
+    def run(self, benchmark: str, iterations: int = 3,
+            compare: bool = False, warmup: int = 1) -> dict:
+        from spark_rapids_tpu.execs.base import collect
+        from spark_rapids_tpu.plan.overrides import apply_overrides
+
+        self.ensure_data()
+        plan_fn = tpch.QUERIES[benchmark]
+        result: dict = {
+            "benchmark": benchmark,
+            "scale_factor": self.sf,
+            "env": self._env(),
+            "iterations": [],
+        }
+        df = None
+        for i in range(warmup + iterations):
+            plan = plan_fn(self.data_dir)  # fresh plan: no cached blocks
+            exec_ = apply_overrides(plan, self.conf)
+            t0 = time.perf_counter()
+            df = collect(exec_)
+            elapsed = time.perf_counter() - t0
+            if i >= warmup:
+                result["iterations"].append({"time_sec": elapsed})
+        result["query_plan"] = exec_.tree_string()
+        result["metrics"] = {
+            name: {"rows": m.num_output_rows,
+                   "batches": m.num_output_batches,
+                   "op_time_ms": m.op_time_ns / 1e6}
+            for name, m in exec_.all_metrics().items()}
+        times = [it["time_sec"] for it in result["iterations"]]
+        result["min_time_sec"] = min(times)
+        result["rows_returned"] = len(df)
+        if compare:
+            result["compare"] = self.compare_results(benchmark, df)
+        return result
+
+    def compare_results(self, benchmark: str, tpu_df) -> dict:
+        """BenchUtils.compareResults: run the CPU oracle and diff."""
+        from spark_rapids_tpu.cpu.engine import execute_cpu
+
+        plan = tpch.QUERIES[benchmark](self.data_dir)
+        t0 = time.perf_counter()
+        cpu_df = execute_cpu(plan).to_pandas()
+        cpu_time = time.perf_counter() - t0
+        ok, reason = _frames_match(cpu_df, tpu_df)
+        return {"matches_cpu": ok, "cpu_time_sec": cpu_time,
+                "detail": reason}
+
+
+def _frames_match(cpu_df, tpu_df) -> "tuple[bool, str]":
+    try:
+        from tests.compare import assert_frames_equal
+    except ImportError:  # installed without tests/: structural check only
+        ok = len(cpu_df) == len(tpu_df)
+        return ok, "" if ok else "row count mismatch"
+    try:
+        assert_frames_equal(cpu_df, tpu_df, approx_float=1e-6)
+        return True, ""
+    except AssertionError as e:
+        return False, str(e)[:500]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--benchmark", required=True,
+                   choices=sorted(tpch.QUERIES))
+    p.add_argument("--sf", type=float, default=0.01)
+    p.add_argument("--iterations", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--compare", action="store_true")
+    p.add_argument("--data-dir", default="/tmp/rapids_tpu_tpch")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+    runner = BenchmarkRunner(args.data_dir, args.sf)
+    result = runner.run(args.benchmark, iterations=args.iterations,
+                        compare=args.compare, warmup=args.warmup)
+    text = json.dumps(result, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

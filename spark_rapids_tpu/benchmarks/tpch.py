@@ -1,0 +1,130 @@
+"""TPC-H-like query definitions as plan trees (TpchLikeSpark.scala
+analogue: each query is a function from the data directory to a plan)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Literal)
+from spark_rapids_tpu.io import ParquetSource
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan import nodes as pn
+
+
+def _date_days(s: str) -> int:
+    return int((np.datetime64(s) - np.datetime64("1970-01-01")
+                ).astype(int))
+
+
+def _scan(data_dir: str, table: str, columns):
+    return pn.ScanNode(ParquetSource(os.path.join(data_dir, table),
+                                     columns=columns))
+
+
+def ref(i, t):
+    return BoundReference(i, t)
+
+
+def q1(data_dir: str) -> pn.PlanNode:
+    """Pricing summary report: scan-heavy groupby with many aggregates
+    (the reference's headline scan+agg shape)."""
+    scan = _scan(data_dir, "lineitem",
+                 ["l_returnflag", "l_linestatus", "l_quantity",
+                  "l_extendedprice", "l_discount", "l_tax", "l_shipdate"])
+    filt = pn.FilterNode(
+        P.LessThanOrEqual(ref(6, dt.DATE),
+                          Literal(_date_days("1998-09-02"), dt.DATE)),
+        scan)
+    qty = ref(2, dt.FLOAT64)
+    price = ref(3, dt.FLOAT64)
+    disc = ref(4, dt.FLOAT64)
+    tax = ref(5, dt.FLOAT64)
+    disc_price = ar.Multiply(price, ar.Subtract(Literal(1.0), disc))
+    charge = ar.Multiply(disc_price, ar.Add(Literal(1.0), tax))
+    agg = pn.AggregateNode(
+        [ref(0, dt.STRING), ref(1, dt.STRING)],
+        [pn.AggCall(A.Sum(qty), "sum_qty"),
+         pn.AggCall(A.Sum(price), "sum_base_price"),
+         pn.AggCall(A.Sum(disc_price), "sum_disc_price"),
+         pn.AggCall(A.Sum(charge), "sum_charge"),
+         pn.AggCall(A.Average(qty), "avg_qty"),
+         pn.AggCall(A.Average(price), "avg_price"),
+         pn.AggCall(A.Average(disc), "avg_disc"),
+         pn.AggCall(A.Count(), "count_order")],
+        filt, grouping_names=["l_returnflag", "l_linestatus"])
+    return pn.SortNode([SortKeySpec.spark_default(0),
+                        SortKeySpec.spark_default(1)], agg)
+
+
+def q6(data_dir: str) -> pn.PlanNode:
+    """Forecasting revenue change: tight filter + global aggregate."""
+    scan = _scan(data_dir, "lineitem",
+                 ["l_extendedprice", "l_discount", "l_quantity",
+                  "l_shipdate"])
+    d = ref(3, dt.DATE)
+    cond = P.And(
+        P.And(P.GreaterThanOrEqual(d, Literal(_date_days("1994-01-01"),
+                                              dt.DATE)),
+              P.LessThan(d, Literal(_date_days("1995-01-01"), dt.DATE))),
+        P.And(P.And(P.GreaterThanOrEqual(ref(1, dt.FLOAT64),
+                                         Literal(0.05)),
+                    P.LessThanOrEqual(ref(1, dt.FLOAT64),
+                                      Literal(0.07))),
+              P.LessThan(ref(2, dt.FLOAT64), Literal(24.0))))
+    filt = pn.FilterNode(cond, scan)
+    revenue = ar.Multiply(ref(0, dt.FLOAT64), ref(1, dt.FLOAT64))
+    return pn.AggregateNode([], [pn.AggCall(A.Sum(revenue), "revenue")],
+                            filt)
+
+
+def q3(data_dir: str) -> pn.PlanNode:
+    """Shipping priority: 3-way join + groupby + top-N (the multi-way
+    join shape of BASELINE config #3)."""
+    customer = _scan(data_dir, "customer", ["c_custkey", "c_mktsegment"])
+    orders = _scan(data_dir, "orders",
+                   ["o_orderkey", "o_custkey", "o_orderdate",
+                    "o_shippriority"])
+    lineitem = _scan(data_dir, "lineitem",
+                     ["l_orderkey", "l_extendedprice", "l_discount",
+                      "l_shipdate"])
+    cust_f = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("BUILDING")), customer)
+    ord_f = pn.FilterNode(
+        P.LessThan(ref(2, dt.DATE),
+                   Literal(_date_days("1995-03-15"), dt.DATE)), orders)
+    li_f = pn.FilterNode(
+        P.GreaterThan(ref(3, dt.DATE),
+                      Literal(_date_days("1995-03-15"), dt.DATE)),
+        lineitem)
+    # customer ⋈ orders on custkey
+    co = pn.JoinNode("inner", cust_f, ord_f, [0], [1])
+    # (c..., o...) ⋈ lineitem on orderkey;  co schema:
+    # [c_custkey, c_mktsegment, o_orderkey, o_custkey, o_orderdate,
+    #  o_shippriority]
+    col = pn.JoinNode("inner", co, li_f, [2], [0])
+    # col schema adds [l_orderkey, l_extendedprice, l_discount,
+    # l_shipdate] at 6..9
+    revenue = ar.Multiply(ref(7, dt.FLOAT64),
+                          ar.Subtract(Literal(1.0), ref(8, dt.FLOAT64)))
+    proj = pn.ProjectNode(
+        [Alias(ref(6, dt.INT64), "l_orderkey"),
+         Alias(ref(4, dt.DATE), "o_orderdate"),
+         Alias(ref(5, dt.INT32), "o_shippriority"),
+         Alias(revenue, "rev")], col)
+    agg = pn.AggregateNode(
+        [ref(0, dt.INT64), ref(1, dt.DATE), ref(2, dt.INT32)],
+        [pn.AggCall(A.Sum(ref(3, dt.FLOAT64)), "revenue")],
+        proj, grouping_names=["l_orderkey", "o_orderdate",
+                              "o_shippriority"])
+    sort = pn.SortNode([SortKeySpec.spark_default(3, ascending=False),
+                        SortKeySpec.spark_default(1)], agg)
+    return pn.LimitNode(10, sort)
+
+
+QUERIES = {"tpch_q1": q1, "tpch_q3": q3, "tpch_q6": q6}

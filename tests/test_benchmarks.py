@@ -1,0 +1,53 @@
+"""Benchmark-suite smoke tests (TpchLikeSparkSuite analogue: every query
+runs on the accelerated path and matches the CPU oracle at tiny SF)."""
+import json
+
+import pytest
+
+from spark_rapids_tpu.benchmarks import datagen, tpch
+from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner
+from spark_rapids_tpu.config import RapidsConf
+
+from tests.compare import assert_cpu_and_tpu_equal
+
+SF = 0.001
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tpch")
+    datagen.write_tables(str(d), SF)
+    return str(d)
+
+
+@pytest.mark.parametrize("query", sorted(tpch.QUERIES))
+def test_query_on_tpu_matches_oracle(data_dir, query):
+    plan = tpch.QUERIES[query](data_dir)
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
+
+
+def test_q1_returns_flag_groups(data_dir):
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    df = collect(apply_overrides(tpch.QUERIES["tpch_q1"](data_dir),
+                                 RapidsConf()))
+    # 3 return flags x 2 line statuses
+    assert len(df) == 6
+    assert df["count_order"].astype(int).sum() > 0
+
+
+def test_runner_json_output(data_dir, capsys):
+    from spark_rapids_tpu.benchmarks import runner as runner_mod
+
+    runner_mod.main(["--benchmark", "tpch_q6", "--sf", str(SF),
+                     "--iterations", "2", "--warmup", "1", "--compare",
+                     "--data-dir", data_dir])
+    out = capsys.readouterr().out
+    result = json.loads(out)
+    assert result["benchmark"] == "tpch_q6"
+    assert len(result["iterations"]) == 2
+    assert result["compare"]["matches_cpu"], result["compare"]["detail"]
+    assert "query_plan" in result and "metrics" in result
+    assert result["env"]["device_count"] >= 1
