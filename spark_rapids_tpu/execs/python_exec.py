@@ -1,0 +1,126 @@
+"""Python (pandas) integration execs — SURVEY.md §2.12.
+
+The reference streams Arrow batches to GPU-aware Python workers for
+pandas UDFs (GpuArrowEvalPythonExec.scala: BatchQueue + GpuArrowPython
+Runner) and gates the map/grouped variants behind default-off flags
+(GpuOverrides.scala:1888-1907). In-process, the "worker" is a direct
+call: device batch -> pandas frame -> user function -> re-upload. A
+worker-slot semaphore mirrors PythonWorkerSemaphore (bounding concurrent
+Python evaluation when partitions run in parallel threads).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.execs import interop
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.plan.nodes import PlanNode
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+
+class MapInPandasNode(PlanNode):
+    """df.mapInPandas analogue: ``fn`` maps a pandas DataFrame (one batch)
+    to a pandas DataFrame with ``schema``."""
+
+    def __init__(self, fn: Callable, schema: Schema, child: PlanNode):
+        super().__init__([child])
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class PythonWorkerSemaphore:
+    """Bounds concurrent in-flight Python evaluations
+    (python/PythonWorkerSemaphore.scala:144)."""
+
+    _sem: Optional[threading.Semaphore] = None
+    _slots = 4
+    _lock = threading.Lock()
+
+    @classmethod
+    def acquire(cls):
+        with cls._lock:
+            if cls._sem is None:
+                cls._sem = threading.Semaphore(cls._slots)
+        cls._sem.acquire()
+
+    @classmethod
+    def release(cls):
+        cls._sem.release()
+
+
+def _pandas_to_host(df, schema: Schema):
+    data = {}
+    validity = {}
+    for name, typ in zip(schema.names, schema.types):
+        if name not in df.columns:
+            raise ValueError(
+                f"mapInPandas result missing column {name!r}")
+        s = df[name]
+        if typ is dt.STRING:
+            vals = np.array(
+                [None if v is None or (isinstance(v, float) and
+                                       np.isnan(v)) else str(v)
+                 for v in s], dtype=object)
+            data[name] = vals
+            validity[name] = np.array([v is not None for v in vals],
+                                      dtype=bool)
+        else:
+            isna = s.isna().to_numpy(dtype=bool)
+            filled = s.fillna(0).to_numpy()
+            data[name] = filled.astype(typ.np_dtype)
+            validity[name] = ~isna
+    return data, validity
+
+
+class MapInPandasExec(TpuExec):
+    def __init__(self, node: MapInPandasNode, child: TpuExec):
+        super().__init__([child], node.output_schema())
+        self.node = node
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        child_schema = self.node.children[0].output_schema()
+        out_schema = self.schema
+
+        def it():
+            for b in self.children[0].execute(partition):
+                if b.realized_num_rows() == 0:
+                    continue
+                PythonWorkerSemaphore.acquire()
+                try:
+                    with TraceRange("MapInPandasExec.python"):
+                        pdf = b.to_pandas(child_schema)
+                        out = self.node.fn(pdf)
+                        data, validity = _pandas_to_host(out, out_schema)
+                finally:
+                    PythonWorkerSemaphore.release()
+                yield interop.host_to_batch(data, validity, out_schema)
+            yield ColumnarBatch.empty(out_schema)
+        return timed(self, it())
+
+
+def execute_map_in_pandas_cpu(node: MapInPandasNode):
+    """CPU-engine implementation (oracle): same function applied to the
+    whole child frame."""
+    from spark_rapids_tpu.cpu.engine import CpuFrame, execute_cpu
+    from spark_rapids_tpu.cpu.evaluator import CV
+
+    child = execute_cpu(node.children[0])
+    schema = node.output_schema()
+    pdf = child.to_pandas()
+    out = node.fn(pdf)
+    data, validity = _pandas_to_host(out, schema)
+    n = len(next(iter(data.values()))) if len(schema) else 0
+    cols = [CV(t, data[nm], validity[nm])
+            for nm, t in zip(schema.names, schema.types)]
+    return CpuFrame(schema, cols, n)

@@ -1,0 +1,77 @@
+"""Zero-copy-where-possible handoff of columnar results to ML frameworks.
+
+The reference's ColumnarRdd gives XGBoost the raw device tables
+(ColumnarRdd.scala:20-49); the TPU analogue hands jax arrays (or torch
+tensors via dlpack) straight from the exec pipeline — BASELINE config #5's
+ETL -> DMatrix flow.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.execs.base import TpuExec
+
+
+def exec_to_device_matrices(exec_: TpuExec
+                            ) -> Iterator[Tuple[jax.Array, jax.Array]]:
+    """Stream (features, validity) float32 device matrices per batch —
+    rows trimmed to the live count, columns = the exec's numeric outputs.
+    The RDD[Table] analogue: consumers keep everything on device."""
+    numeric = [i for i, t in enumerate(exec_.schema.types)
+               if t.is_numeric or t is dt.BOOLEAN]
+    if not numeric:
+        raise ValueError("no numeric columns to hand off")
+    for p in range(exec_.num_partitions):
+        for b in exec_.execute(p):
+            n = b.realized_num_rows()
+            if n == 0:
+                continue
+            cols = []
+            valids = []
+            for i in numeric:
+                c = b.columns[i]
+                cols.append(c.data[:n].astype(jnp.float32))
+                v = c.validity
+                valids.append(jnp.ones(n, dtype=bool) if v is None
+                              else v[:n])
+            yield jnp.stack(cols, axis=1), jnp.stack(valids, axis=1)
+
+
+def collect_feature_matrix(exec_: TpuExec) -> jax.Array:
+    """One (rows, features) float32 device matrix from the whole exec
+    (the DMatrix build input). NULLs become NaN — XGBoost's missing-value
+    convention."""
+    mats = []
+    for feats, valid in exec_to_device_matrices(exec_):
+        mats.append(jnp.where(valid, feats, jnp.nan))
+    if not mats:
+        ncols = sum(1 for t in exec_.schema.types
+                    if t.is_numeric or t is dt.BOOLEAN)
+        return jnp.zeros((0, ncols), dtype=jnp.float32)
+    return jnp.concatenate(mats, axis=0)
+
+
+def batch_to_torch(batch: ColumnarBatch, schema_types: List[dt.DType]):
+    """Device batch -> dict of torch tensors, dlpack zero-copy when the
+    backends share memory (CPU<->CPU), explicit copy otherwise."""
+    import torch
+
+    n = batch.realized_num_rows()
+    out = {}
+    for i, (c, t) in enumerate(zip(batch.columns, schema_types)):
+        if t is dt.STRING:
+            continue  # torch has no string tensors; keep numerics
+        arr = c.data[:max(n, 1)][:n]
+        try:
+            tensor = torch.from_dlpack(arr)
+        except Exception:
+            import numpy as np
+
+            tensor = torch.from_numpy(np.asarray(jax.device_get(arr)))
+        out[i] = tensor
+    return out

@@ -627,10 +627,26 @@ class _BroadcastRule(NodeRule):
         return exchange.BroadcastExchangeExec(children[0])
 
 
+class _MapInPandasRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.execs.python_exec import MapInPandasExec
+
+        return MapInPandasExec(meta.node, children[0])
+
+
 def _register_io_rules():
+    from spark_rapids_tpu.execs.python_exec import MapInPandasNode
     from spark_rapids_tpu.io.write import WriteFilesNode
 
     _NODE_RULES[WriteFilesNode] = _WriteRule()
+    _NODE_RULES[MapInPandasNode] = _MapInPandasRule()
+    # mirror the reference: pandas execs are off by default because data
+    # leaves the accelerator for the Python worker
+    # (GpuOverrides.scala:1888-1907)
+    cfg.register_op_flag(
+        "exec", "MapInPandasNode",
+        "Run mapInPandas around the TPU pipeline (device->pandas->device "
+        "round trip per batch)", default_enabled=False)
 
 
 _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {

@@ -1,0 +1,103 @@
+"""ML handoff + pandas-exec tests (§2.6 ColumnarRdd / §2.12 analogues)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.cpu.engine import execute_cpu
+from spark_rapids_tpu.execs.base import collect
+from spark_rapids_tpu.execs.python_exec import MapInPandasNode
+from spark_rapids_tpu.expressions.base import BoundReference
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import Literal
+from spark_rapids_tpu.ml import (batch_to_torch, collect_feature_matrix,
+                                 exec_to_device_matrices)
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.overrides import apply_overrides
+
+from tests.compare import assert_frames_equal
+
+
+def scan(n=500, seed=4):
+    rng = np.random.default_rng(seed)
+    return pn.ScanNode(pn.InMemorySource(
+        {"a": rng.integers(0, 100, n).astype(np.int64),
+         "b": rng.random(n),
+         "s": np.array([f"x{k % 5}" for k in range(n)], dtype=object)},
+        validity={"b": rng.random(n) > 0.1}))
+
+
+def test_feature_matrix_from_pipeline():
+    plan = pn.FilterNode(
+        P.GreaterThan(BoundReference(0, dt.INT64), Literal(50)), scan())
+    exec_ = apply_overrides(plan, RapidsConf())
+    mat = collect_feature_matrix(exec_)
+    # string column excluded; rows = filter survivors; NULL -> NaN
+    cpu = execute_cpu(plan).to_pandas()
+    assert mat.shape == (len(cpu), 2)
+    nan_count = int(np.isnan(np.asarray(mat)[:, 1]).sum())
+    assert nan_count == int(cpu["b"].isna().sum())
+    np.testing.assert_allclose(
+        np.asarray(mat)[:, 0],
+        cpu["a"].astype(np.float64).to_numpy().astype(np.float32))
+
+
+def test_streamed_device_matrices():
+    exec_ = apply_overrides(scan(), RapidsConf())
+    total = 0
+    for feats, valid in exec_to_device_matrices(exec_):
+        assert feats.shape == valid.shape
+        assert feats.shape[1] == 2
+        total += feats.shape[0]
+    assert total == 500
+
+
+def test_batch_to_torch_dlpack():
+    torch = pytest.importorskip("torch")
+    exec_ = apply_overrides(scan(100), RapidsConf())
+    batches = [b for p in range(exec_.num_partitions)
+               for b in exec_.execute(p)]
+    tensors = batch_to_torch(batches[0], exec_.schema.types)
+    assert 0 in tensors and 1 in tensors and 2 not in tensors
+    assert tensors[0].shape[0] == 100
+    assert tensors[0].dtype == torch.int64
+
+
+def test_map_in_pandas_matches_oracle():
+    def double_and_tag(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({
+            "a2": df["a"].astype("int64") * 2,
+            "tag": df["s"].astype(str) + "!",
+        })
+
+    schema = Schema(["a2", "tag"], [dt.INT64, dt.STRING])
+    plan = MapInPandasNode(double_and_tag, schema, scan(300))
+    conf = RapidsConf({"rapids.tpu.sql.exec.MapInPandasNode": True})
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, conf)
+    assert type(exec_).__name__ == "MapInPandasExec"
+    assert_frames_equal(cpu_df, collect(exec_))
+
+
+def test_map_in_pandas_disabled_by_default():
+    schema = Schema(["a"], [dt.INT64])
+    plan = MapInPandasNode(lambda df: df[["a"]], schema, scan(50))
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert type(exec_).__name__ == "CpuFallbackExec"
+    cpu_df = execute_cpu(plan).to_pandas()
+    assert_frames_equal(cpu_df, collect(exec_))
+
+
+def test_map_in_pandas_null_handling():
+    def keep_nulls(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({"b": df["b"]})
+
+    schema = Schema(["b"], [dt.FLOAT64])
+    plan = MapInPandasNode(keep_nulls, schema, scan(200))
+    conf = RapidsConf({"rapids.tpu.sql.exec.MapInPandasNode": True})
+    cpu_df = execute_cpu(plan).to_pandas()
+    assert cpu_df["b"].isna().any()
+    exec_ = apply_overrides(plan, conf)
+    assert_frames_equal(cpu_df, collect(exec_))
