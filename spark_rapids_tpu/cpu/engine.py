@@ -434,12 +434,18 @@ def _rank_arrays(c: CV, spec: SortKeySpec, n: int) -> List[np.ndarray]:
         f = c.data.astype(np.float64)
         isnan = np.isnan(f)
         nan_rank = isnan.astype(np.int8)  # NaN greatest
-        vals = np.where(isnan, 0.0, f + 0.0)
+        vals = np.where(isnan, 0.0, f + 0.0)  # and -0.0 -> +0.0
     else:
-        vals = c.data
+        vals = c.data.astype(np.int64)
         nan_rank = np.zeros(n, dtype=np.int8)
+    # canonicalize NULL slots: their stored data is garbage and must not
+    # order rows within the null group (later sort terms decide)
+    vals = np.where(valid, vals, vals.dtype.type(0))
+    nan_rank = np.where(valid, nan_rank, np.int8(0))
     if not spec.ascending:
-        vals = -vals.astype(np.float64) if c.dtype.is_floating else -vals
+        # ints descend via bitwise NOT (= -x-1): exact and monotone even
+        # at INT64_MIN, where plain negation wraps onto itself
+        vals = -vals if c.dtype.is_floating else np.invert(vals)
         nan_rank = -nan_rank
     return [vals, nan_rank, null_rank]
 

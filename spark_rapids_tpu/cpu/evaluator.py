@@ -183,7 +183,9 @@ def _pmod(e, ctx):
     safe = np.where(zero, 1, rd)
     with np.errstate(all="ignore"):
         m = np.fmod(ld, safe)
-        data = np.where(m != 0, np.fmod(m + safe, safe), m)
+        # Spark: only NEGATIVE remainders are corrected; (r + n) wraps at
+        # integer boundaries exactly like Java addition
+        data = np.where(m < 0, np.fmod(m + safe, safe), m)
     return CV(odt, data.astype(odt.np_dtype),
               and_valid(l.validity, r.validity, ~zero))
 
@@ -400,15 +402,28 @@ def _unary_math(e, ctx):
     return CV(dt.FLOAT64, data, v.validity)
 
 
+def _java_double_to_long(x: np.ndarray) -> np.ndarray:
+    """Java (long) cast: NaN -> 0, saturate at Long.MIN/MAX."""
+    hi = x >= 9.223372036854776e18   # 2^63
+    lo = x <= -9.223372036854776e18
+    nan = np.isnan(x)
+    safe = np.where(hi | lo | nan, 0.0, x)
+    with np.errstate(all="ignore"):
+        out = safe.astype(np.int64)
+    out = np.where(hi, np.iinfo(np.int64).max, out)
+    out = np.where(lo, np.iinfo(np.int64).min, out)
+    return np.where(nan, 0, out)
+
+
 def _floor(e, ctx):
     v = eval_expr(e.children[0], ctx)
-    data = np.floor(v.data.astype(np.float64)).astype(np.int64)
+    data = _java_double_to_long(np.floor(v.data.astype(np.float64)))
     return CV(dt.INT64, data, v.validity)
 
 
 def _ceil(e, ctx):
     v = eval_expr(e.children[0], ctx)
-    data = np.ceil(v.data.astype(np.float64)).astype(np.int64)
+    data = _java_double_to_long(np.ceil(v.data.astype(np.float64)))
     return CV(dt.INT64, data, v.validity)
 
 

@@ -138,12 +138,9 @@ class Remainder(_DivLike):
         kt = out.kernel_dtype
 
         def f(a, b):
-            a = a.astype(kt)
-            b = b.astype(kt)
-            m = jnp.remainder(a, b)  # python semantics: sign of divisor
-            # java: sign of dividend -> subtract b where signs mismatch
-            fix = (m != 0) & ((m < 0) != (a < 0))
-            return jnp.where(fix, m - b, m)
+            # truncated remainder = Java % (sign of dividend); also
+            # correct for ±Inf operands, unlike jnp.remainder
+            return jnp.fmod(a.astype(kt), b.astype(kt))
 
         return self._apply(ctx, f, out)
 
@@ -160,8 +157,13 @@ class Pmod(_DivLike):
         kt = out.kernel_dtype
 
         def f(a, b):
-            m = jnp.remainder(a.astype(kt), b.astype(kt))
-            return jnp.where(m < 0, m + jnp.abs(b).astype(kt), m)
+            # Spark's pmod (arithmetic.scala): r = a % n (truncated);
+            # if r < 0 then (r + n) % n — including the Java wrap-around
+            # on r + n at integer boundaries (XLA int add wraps too)
+            a = a.astype(kt)
+            b = b.astype(kt)
+            r = jnp.fmod(a, b)
+            return jnp.where(r < 0, jnp.fmod(r + b, b), r)
 
         return self._apply(ctx, f, out)
 

@@ -126,6 +126,19 @@ class Rint(_UnaryMathF64):
     fn = staticmethod(jnp.rint)
 
 
+def _java_f64_to_i64(y):
+    """Java (long) cast on device: NaN -> 0, saturate at Long.MIN/MAX
+    (XLA's out-of-range float->int convert is implementation-defined,
+    so the edges must be explicit)."""
+    hi = y >= jnp.float64(9.223372036854776e18)   # 2^63
+    lo = y <= jnp.float64(-9.223372036854776e18)
+    nan = jnp.isnan(y)
+    safe = jnp.where(hi | lo | nan, 0.0, y).astype(jnp.int64)
+    safe = jnp.where(hi, jnp.int64(2**63 - 1), safe)
+    safe = jnp.where(lo, jnp.int64(-(2**63)), safe)
+    return jnp.where(nan, jnp.int64(0), safe)
+
+
 class Floor(Expression):
     def __init__(self, child):
         super().__init__([child])
@@ -137,7 +150,7 @@ class Floor(Expression):
     def eval(self, ctx):
         return eval_unary(
             self, ctx,
-            lambda x: jnp.floor(x.astype(jnp.float64)).astype(jnp.int64),
+            lambda x: _java_f64_to_i64(jnp.floor(x.astype(jnp.float64))),
             dt.INT64)
 
 
@@ -152,7 +165,7 @@ class Ceil(Expression):
     def eval(self, ctx):
         return eval_unary(
             self, ctx,
-            lambda x: jnp.ceil(x.astype(jnp.float64)).astype(jnp.int64),
+            lambda x: _java_f64_to_i64(jnp.ceil(x.astype(jnp.float64))),
             dt.INT64)
 
 

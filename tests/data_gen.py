@@ -1,0 +1,147 @@
+"""Composable random data generators — the integration_tests data_gen.py
+DSL of the reference (per-type gens, special values, nullable wrappers,
+seeds; SURVEY.md §4)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.plan import nodes as pn
+
+
+class DataGen:
+    dtype: dt.DType
+
+    def __init__(self, nullable: float = 0.1):
+        self.null_prob = nullable
+
+    def _values(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def generate(self, rng: np.random.Generator, n: int):
+        data = self._values(rng, n)
+        validity = rng.random(n) >= self.null_prob \
+            if self.null_prob > 0 else np.ones(n, dtype=bool)
+        return data, validity
+
+
+class _IntGen(DataGen):
+    lo: int
+    hi: int
+
+    def _values(self, rng, n):
+        vals = rng.integers(self.lo, self.hi, n, endpoint=True)
+        # seed boundary values like the reference's special cases
+        for v in (self.lo, self.hi, 0):
+            if n > 3:
+                vals[rng.integers(0, n)] = v
+        return vals.astype(self.dtype.np_dtype)
+
+
+class ByteGen(_IntGen):
+    dtype, lo, hi = dt.INT8, -128, 127
+
+
+class ShortGen(_IntGen):
+    dtype, lo, hi = dt.INT16, -(1 << 15), (1 << 15) - 1
+
+
+class IntegerGen(_IntGen):
+    dtype, lo, hi = dt.INT32, -(1 << 31), (1 << 31) - 1
+
+
+class LongGen(_IntGen):
+    dtype, lo, hi = dt.INT64, -(1 << 63), (1 << 63) - 1
+
+
+class SmallIntGen(_IntGen):
+    """Small-range ints: friendly keys for joins/groupbys."""
+
+    dtype, lo, hi = dt.INT64, -50, 50
+
+
+class BooleanGen(DataGen):
+    dtype = dt.BOOLEAN
+
+    def _values(self, rng, n):
+        return rng.random(n) > 0.5
+
+
+class _FloatGen(DataGen):
+    specials = (float("nan"), float("inf"), float("-inf"), -0.0, 0.0)
+
+    def _values(self, rng, n):
+        vals = (rng.random(n) * 2 - 1) * 10.0 ** rng.integers(-3, 6, n)
+        for s in self.specials:
+            if n > len(self.specials):
+                vals[rng.integers(0, n)] = s
+        return vals.astype(self.dtype.np_dtype)
+
+
+class DoubleGen(_FloatGen):
+    dtype = dt.FLOAT64
+
+
+class FloatGen(_FloatGen):
+    dtype = dt.FLOAT32
+
+
+class StringGen(DataGen):
+    dtype = dt.STRING
+
+    def __init__(self, nullable: float = 0.1, alphabet: str = "abXY z01_",
+                 max_len: int = 8):
+        super().__init__(nullable)
+        self.alphabet = alphabet
+        self.max_len = max_len
+
+    def _values(self, rng, n):
+        letters = np.array(list(self.alphabet))
+        out = np.empty(n, dtype=object)
+        lens = rng.integers(0, self.max_len + 1, n)
+        for i in range(n):
+            out[i] = "".join(rng.choice(letters, lens[i]))
+        return out
+
+
+class DateGen(DataGen):
+    dtype = dt.DATE
+
+    def _values(self, rng, n):
+        days = rng.integers(-3650, 20000, n)  # ~1960..2024
+        return days.astype("datetime64[D]")
+
+
+class TimestampGen(DataGen):
+    dtype = dt.TIMESTAMP
+
+    def _values(self, rng, n):
+        us = rng.integers(0, 1_700_000_000, n) * np.int64(1_000_000)
+        return us.astype("datetime64[us]")
+
+
+ALL_GENS: Sequence[DataGen] = (
+    ByteGen(), ShortGen(), IntegerGen(), LongGen(), BooleanGen(),
+    DoubleGen(), FloatGen(), StringGen(), DateGen(), TimestampGen())
+
+NUMERIC_GENS = (ByteGen(), ShortGen(), IntegerGen(), LongGen(),
+                DoubleGen(), FloatGen())
+
+
+def gen_scan(gens: Dict[str, DataGen], n: int = 100,
+             seed: int = 0) -> pn.ScanNode:
+    """Fuzzed in-memory scan: one column per generator."""
+    rng = np.random.default_rng(seed)
+    data, validity, names, types = {}, {}, [], []
+    for name, g in gens.items():
+        d, v = g.generate(rng, n)
+        data[name] = d
+        validity[name] = v
+        names.append(name)
+        types.append(g.dtype)
+    from spark_rapids_tpu.columnar.batch import Schema
+
+    return pn.ScanNode(pn.InMemorySource(
+        data, schema=Schema(names, types), validity=validity))
