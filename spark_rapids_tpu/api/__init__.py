@@ -1,0 +1,29 @@
+"""User-facing DataFrame API.
+
+The reference accelerates Spark's DataFrame/SQL API transparently; this
+standalone framework exposes an equivalent front end so a Spark user
+finds the familiar surface: a Session with readers, a Column expression
+DSL (``col``/``lit``/functions), and a lazy DataFrame whose operations
+build the engine-neutral plan tree. ``collect()`` plans through
+TpuOverrides (accelerated with reasoned fallback); ``explain()`` shows
+the same tag/reason output Spark users get from
+``spark.rapids.sql.explain``.
+
+    from spark_rapids_tpu.api import Session, col, lit, functions as F
+
+    s = Session()
+    df = s.read.parquet("/data/lineitem")
+    out = (df.filter(col("l_shipdate") <= lit(10000))
+             .group_by("l_returnflag")
+             .agg(F.sum(col("l_quantity")).alias("qty"))
+             .order_by("l_returnflag"))
+    print(out.explain())
+    pdf = out.collect()
+"""
+from spark_rapids_tpu.api.column import Column, col, lit, when
+from spark_rapids_tpu.api import functions
+from spark_rapids_tpu.api.dataframe import DataFrame, GroupedData
+from spark_rapids_tpu.api.session import Session
+
+__all__ = ["Session", "DataFrame", "GroupedData", "Column", "col",
+           "lit", "when", "functions"]

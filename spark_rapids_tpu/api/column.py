@@ -1,0 +1,225 @@
+"""Column DSL: unresolved expression builders.
+
+A ``Column`` wraps ``resolve(schema) -> Expression``: names bind to
+ordinals only when the parent DataFrame applies the operation (Spark's
+analysis phase). Operators mirror pyspark.sql.Column.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import conditional as cond
+from spark_rapids_tpu.expressions import predicates as pr
+from spark_rapids_tpu.expressions import strings as st
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression, Literal)
+from spark_rapids_tpu.expressions.cast import Cast
+
+
+class Column:
+    def __init__(self, resolve: Callable[[Schema], Expression],
+                 name: Optional[str] = None):
+        self._resolve = resolve
+        self._name = name
+
+    def resolve(self, schema: Schema) -> Expression:
+        e = self._resolve(schema)
+        return e
+
+    def named(self, schema: Schema, fallback: str) -> Expression:
+        e = self.resolve(schema)
+        name = self._name or fallback
+        if isinstance(e, Alias):
+            return e
+        return Alias(e, name)
+
+    def out_name(self, fallback: str) -> str:
+        return self._name or fallback
+
+    # -- naming -----------------------------------------------------------
+
+    def alias(self, name: str) -> "Column":
+        return Column(self._resolve, name)
+
+    name = alias
+
+    # -- operators --------------------------------------------------------
+
+    def _bin(self, other, klass, flip=False) -> "Column":
+        o = _to_col(other)
+
+        def rf(schema: Schema) -> Expression:
+            l, r = self.resolve(schema), o.resolve(schema)
+            if flip:
+                l, r = r, l
+            return klass(l, r)
+        return Column(rf)
+
+    def __add__(self, o):
+        return self._bin(o, ar.Add)
+
+    def __radd__(self, o):
+        return self._bin(o, ar.Add, flip=True)
+
+    def __sub__(self, o):
+        return self._bin(o, ar.Subtract)
+
+    def __rsub__(self, o):
+        return self._bin(o, ar.Subtract, flip=True)
+
+    def __mul__(self, o):
+        return self._bin(o, ar.Multiply)
+
+    def __rmul__(self, o):
+        return self._bin(o, ar.Multiply, flip=True)
+
+    def __truediv__(self, o):
+        return self._bin(o, ar.Divide)
+
+    def __rtruediv__(self, o):
+        return self._bin(o, ar.Divide, flip=True)
+
+    def __mod__(self, o):
+        return self._bin(o, ar.Remainder)
+
+    def __neg__(self):
+        return Column(lambda s: ar.UnaryMinus(self.resolve(s)))
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin(o, pr.EqualTo)
+
+    def __ne__(self, o):  # type: ignore[override]
+        c = self._bin(o, pr.EqualTo)
+        return Column(lambda s: pr.Not(c.resolve(s)))
+
+    def __lt__(self, o):
+        return self._bin(o, pr.LessThan)
+
+    def __le__(self, o):
+        return self._bin(o, pr.LessThanOrEqual)
+
+    def __gt__(self, o):
+        return self._bin(o, pr.GreaterThan)
+
+    def __ge__(self, o):
+        return self._bin(o, pr.GreaterThanOrEqual)
+
+    def __and__(self, o):
+        return self._bin(o, pr.And)
+
+    def __or__(self, o):
+        return self._bin(o, pr.Or)
+
+    def __invert__(self):
+        return Column(lambda s: pr.Not(self.resolve(s)))
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise TypeError(
+            "Column is not a boolean; use & | ~ for combinators")
+
+    # -- methods ----------------------------------------------------------
+
+    def is_null(self) -> "Column":
+        return Column(lambda s: pr.IsNull(self.resolve(s)))
+
+    isNull = is_null
+
+    def is_not_null(self) -> "Column":
+        return Column(lambda s: pr.IsNotNull(self.resolve(s)))
+
+    isNotNull = is_not_null
+
+    def isin(self, *values) -> "Column":
+        vals = list(values[0]) if len(values) == 1 and \
+            isinstance(values[0], (list, tuple, set)) else list(values)
+        return Column(lambda s: pr.In(self.resolve(s),
+                                      [Literal(v) for v in sorted(
+                                          vals, key=repr)]))
+
+    def between(self, lo, hi) -> "Column":
+        return (self >= lo) & (self <= hi)
+
+    def cast(self, to) -> "Column":
+        typ = dt.by_name(to) if isinstance(to, str) else to
+        return Column(lambda s: Cast(self.resolve(s), typ),
+                      self._name)
+
+    astype = cast
+
+    def startswith(self, prefix: str) -> "Column":
+        return Column(lambda s: st.StartsWith(self.resolve(s), prefix))
+
+    def endswith(self, suffix: str) -> "Column":
+        return Column(lambda s: st.EndsWith(self.resolve(s), suffix))
+
+    def contains(self, needle: str) -> "Column":
+        return Column(lambda s: st.Contains(self.resolve(s), needle))
+
+    def like(self, pattern: str) -> "Column":
+        return Column(lambda s: st.Like(self.resolve(s), pattern))
+
+    def substr(self, pos: int, length: Optional[int] = None) -> "Column":
+        return Column(lambda s: st.Substring(self.resolve(s), pos,
+                                             length))
+
+    def when(self, condition: "Column", value) -> "Column":
+        raise TypeError("use functions.when(cond, val) to start a CASE")
+
+    def otherwise(self, value) -> "Column":
+        raise TypeError("otherwise() only applies to when() chains")
+
+
+class WhenColumn(Column):
+    """CASE WHEN builder (functions.when)."""
+
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(self._build, None)
+
+    def _build(self, schema: Schema) -> Expression:
+        return cond.CaseWhen(
+            [(c.resolve(schema), _to_col(v).resolve(schema))
+             for c, v in self._branches], None)
+
+    def when(self, condition: Column, value) -> "WhenColumn":
+        return WhenColumn(self._branches + [(condition, value)])
+
+    def otherwise(self, value) -> Column:
+        branches = self._branches
+
+        def rf(schema: Schema) -> Expression:
+            return cond.CaseWhen(
+                [(c.resolve(schema), _to_col(v).resolve(schema))
+                 for c, v in branches],
+                _to_col(value).resolve(schema))
+        return Column(rf)
+
+
+def col(name: str) -> Column:
+    def rf(schema: Schema) -> Expression:
+        i = schema.index_of(name)
+        return BoundReference(i, schema.types[i])
+    return Column(rf, name)
+
+
+column = col
+
+
+def lit(value) -> Column:
+    return Column(lambda s: Literal(value))
+
+
+def when(condition: Column, value) -> WhenColumn:
+    return WhenColumn([(condition, value)])
+
+
+def _to_col(v) -> Column:
+    if isinstance(v, Column):
+        return v
+    return lit(v)

@@ -1,0 +1,289 @@
+"""Lazy DataFrame over the engine-neutral plan tree."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from spark_rapids_tpu.api.column import Column, _to_col, col
+from spark_rapids_tpu.api.functions import AggColumn
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression)
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan import nodes as pn
+
+ColumnOrName = Union[Column, str]
+
+
+def _as_col(c: ColumnOrName) -> Column:
+    return col(c) if isinstance(c, str) else c
+
+
+class DataFrame:
+    def __init__(self, plan: pn.PlanNode, session):
+        self._plan = plan
+        self.session = session
+
+    # -- metadata ---------------------------------------------------------
+
+    @property
+    def schema(self) -> Schema:
+        return self._plan.output_schema()
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self.schema.names)
+
+    @property
+    def dtypes(self):
+        s = self.schema
+        return [(n, t.name) for n, t in zip(s.names, s.types)]
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"DataFrame[{', '.join(f'{n}: {t}' for n, t in self.dtypes)}]"
+
+    # -- transformations --------------------------------------------------
+
+    def _df(self, plan: pn.PlanNode) -> "DataFrame":
+        return DataFrame(plan, self.session)
+
+    def select(self, *cols: ColumnOrName) -> "DataFrame":
+        schema = self.schema
+        exprs: List[Expression] = []
+        names: List[str] = []
+        for i, c in enumerate(cols):
+            cc = _as_col(c)
+            e = cc.resolve(schema)
+            names.append(cc.out_name(f"col{i}"))
+            exprs.append(e.children[0] if isinstance(e, Alias) else e)
+        return self._df(pn.ProjectNode(exprs, self._plan, names))
+
+    def filter(self, condition: Column) -> "DataFrame":
+        return self._df(pn.FilterNode(
+            condition.resolve(self.schema), self._plan))
+
+    where = filter
+
+    def with_column(self, name: str, c: Column) -> "DataFrame":
+        schema = self.schema
+        exprs = [BoundReference(i, t)
+                 for i, t in enumerate(schema.types)]
+        names = list(schema.names)
+        new = c.resolve(schema)
+        if name in names:
+            exprs[names.index(name)] = new
+        else:
+            exprs.append(new)
+            names.append(name)
+        return self._df(pn.ProjectNode(exprs, self._plan, names))
+
+    withColumn = with_column
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [n for n in self.columns if n not in names]
+        return self.select(*keep)
+
+    def group_by(self, *cols: ColumnOrName) -> "GroupedData":
+        return GroupedData(self, [_as_col(c) for c in cols],
+                           [c if isinstance(c, str) else c.out_name(None)
+                            for c in cols])
+
+    groupBy = group_by
+
+    def agg(self, *aggs: AggColumn) -> "DataFrame":
+        return GroupedData(self, [], []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"leftsemi": "left_semi", "left_semi": "left_semi",
+               "leftanti": "left_anti", "left_anti": "left_anti",
+               "leftouter": "left", "rightouter": "right",
+               "outer": "full", "fullouter": "full",
+               "full_outer": "full"}.get(how, how)
+        if how == "cross" or on is None:
+            return self._df(pn.JoinNode("cross", self._plan, other._plan,
+                                        [], []))
+        ls, rs = self.schema, other.schema
+        if isinstance(on, str):
+            on = [on]
+        lk, rk = [], []
+        for o in on:
+            if isinstance(o, tuple):
+                lname, rname = o
+            else:
+                lname = rname = o
+            lk.append(ls.index_of(lname))
+            rk.append(rs.index_of(rname))
+        return self._df(pn.JoinNode(how, self._plan, other._plan, lk, rk))
+
+    def order_by(self, *cols: ColumnOrName,
+                 ascending: Union[bool, Sequence[bool]] = True
+                 ) -> "DataFrame":
+        schema = self.schema
+        if isinstance(ascending, bool):
+            asc = [ascending] * len(cols)
+        else:
+            asc = list(ascending)
+        specs = []
+        for c, a in zip(cols, asc):
+            e = _as_col(c).resolve(schema)
+            if not isinstance(e, BoundReference):
+                raise ValueError(
+                    "order_by requires plain columns; project computed "
+                    "keys first (with_column)")
+            specs.append(SortKeySpec.spark_default(e.ordinal,
+                                                   ascending=a))
+        return self._df(pn.SortNode(specs, self._plan))
+
+    sort = order_by
+    orderBy = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._df(pn.LimitNode(n, self._plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._df(pn.UnionNode([self._plan, other._plan]))
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        schema = self.schema
+        grouping = [BoundReference(i, t)
+                    for i, t in enumerate(schema.types)]
+        return self._df(pn.AggregateNode(
+            grouping, [], self._plan,
+            grouping_names=list(schema.names)))
+
+    def map_in_pandas(self, fn, schema: Schema) -> "DataFrame":
+        from spark_rapids_tpu.execs.python_exec import MapInPandasNode
+
+        return self._df(MapInPandasNode(fn, schema, self._plan))
+
+    mapInPandas = map_in_pandas
+
+    # -- actions ----------------------------------------------------------
+
+    def _exec(self):
+        from spark_rapids_tpu.plan.overrides import apply_overrides
+
+        return apply_overrides(self._plan, self.session.conf)
+
+    def collect(self):
+        from spark_rapids_tpu.execs.base import collect
+
+        return collect(self._exec())
+
+    to_pandas = collect
+    toPandas = collect
+
+    def count(self) -> int:
+        from spark_rapids_tpu.expressions import aggregates as A
+
+        plan = pn.AggregateNode(
+            [], [pn.AggCall(A.Count(None), "count")], self._plan)
+        from spark_rapids_tpu.execs.base import collect
+        from spark_rapids_tpu.plan.overrides import apply_overrides
+
+        df = collect(apply_overrides(plan, self.session.conf))
+        return int(df["count"].iloc[0])
+
+    def show(self, n: int = 20) -> None:  # pragma: no cover - console
+        print(self.limit(n).collect().to_string(index=False))
+
+    def explain(self) -> str:
+        """Tag/convert report (spark.rapids.sql.explain analogue)."""
+        from spark_rapids_tpu.plan.overrides import explain
+
+        return explain(self._plan, self.session.conf)
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[Column],
+                 key_names: List[Optional[str]]):
+        self.df = df
+        self.keys = keys
+        self.key_names = key_names
+
+    def agg(self, *aggs: AggColumn) -> DataFrame:
+        schema = self.df.schema
+        grouping = []
+        gnames = []
+        for i, (k, nm) in enumerate(zip(self.keys, self.key_names)):
+            e = k.resolve(schema)
+            grouping.append(e.children[0] if isinstance(e, Alias) else e)
+            gnames.append(nm or k.out_name(f"key{i}"))
+        calls = []
+        for i, a in enumerate(aggs):
+            assert isinstance(a, AggColumn), \
+                "group_by().agg takes aggregate functions"
+            calls.append(pn.AggCall(a.make(schema),
+                                    a.out_name(f"agg{i}")))
+        return self.df._df(pn.AggregateNode(
+            grouping, calls, self.df._plan, grouping_names=gnames))
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+
+        return self.agg(F.count("*").alias("count"))
+
+    def _shortcut(self, fn_name: str, *cols: str) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+
+        fn = getattr(F, fn_name)
+        targets = cols or [n for n, t in zip(self.df.schema.names,
+                                             self.df.schema.types)
+                           if t.is_numeric]
+        return self.agg(*[fn(col(c)).alias(f"{fn_name}({c})")
+                          for c in targets])
+
+    def sum(self, *cols: str) -> DataFrame:
+        return self._shortcut("sum", *cols)
+
+    def min(self, *cols: str) -> DataFrame:
+        return self._shortcut("min", *cols)
+
+    def max(self, *cols: str) -> DataFrame:
+        return self._shortcut("max", *cols)
+
+    def avg(self, *cols: str) -> DataFrame:
+        return self._shortcut("avg", *cols)
+
+    mean = avg
+
+
+class DataFrameWriter:
+    def __init__(self, df: DataFrame):
+        self.df = df
+        self._mode = "overwrite"
+        self._partition_by: List[str] = []
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = {"overwrite": "overwrite",
+                      "error": "error",
+                      "errorifexists": "error"}[m]
+        return self
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    partitionBy = partition_by
+
+    def _write(self, path: str, fmt: str):
+        from spark_rapids_tpu.execs.base import collect
+        from spark_rapids_tpu.io.write import WriteFilesNode
+        from spark_rapids_tpu.plan.overrides import apply_overrides
+
+        node = WriteFilesNode(self.df._plan, path, format=fmt,
+                              partition_by=self._partition_by,
+                              mode=self._mode)
+        return collect(apply_overrides(node, self.df.session.conf))
+
+    def parquet(self, path: str):
+        return self._write(path, "parquet")
+
+    def orc(self, path: str):
+        return self._write(path, "orc")

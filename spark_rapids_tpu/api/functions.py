@@ -1,0 +1,144 @@
+"""pyspark.sql.functions analogue over the Column DSL."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from spark_rapids_tpu.api.column import Column, _to_col, col, lit, when  # noqa: F401
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import conditional as cond
+from spark_rapids_tpu.expressions import datetime as dte
+from spark_rapids_tpu.expressions import math as mth
+from spark_rapids_tpu.expressions import strings as st
+from spark_rapids_tpu.expressions.base import Expression
+
+
+class AggColumn(Column):
+    """An aggregate call (sum/min/.../count) awaiting GroupedData.agg."""
+
+    def __init__(self, make: Callable, name: Optional[str] = None):
+        self.make = make          # schema -> AggregateFunction
+        super().__init__(self._err, name)
+
+    @staticmethod
+    def _err(schema) -> Expression:
+        raise TypeError("aggregate functions are only valid in "
+                        "group_by(...).agg(...) or DataFrame.agg(...)")
+
+    def alias(self, name: str) -> "AggColumn":
+        return AggColumn(self.make, name)
+
+    name = alias
+
+
+def _unary(klass) -> Callable[[object], Column]:
+    def f(c) -> Column:
+        cc = _to_col(c)
+        return Column(lambda s: klass(cc.resolve(s)))
+    return f
+
+
+def _agg(klass) -> Callable[[object], AggColumn]:
+    def f(c) -> AggColumn:
+        cc = _to_col(c) if not isinstance(c, str) else col(c)
+        return AggColumn(lambda s: klass(cc.resolve(s)))
+    return f
+
+
+# aggregates ---------------------------------------------------------------
+
+sum = _agg(A.Sum)          # noqa: A001  (pyspark parity)
+min = _agg(A.Min)          # noqa: A001
+max = _agg(A.Max)          # noqa: A001
+avg = _agg(A.Average)
+mean = avg
+first = _agg(A.First)
+last = _agg(A.Last)
+
+
+def count(c="*") -> AggColumn:
+    if isinstance(c, str) and c == "*":
+        return AggColumn(lambda s: A.Count(None))
+    cc = col(c) if isinstance(c, str) else _to_col(c)
+    return AggColumn(lambda s: A.Count(cc.resolve(s)))
+
+
+# scalar functions ---------------------------------------------------------
+
+abs = _unary(ar.Abs)       # noqa: A001
+sqrt = _unary(mth.Sqrt)
+exp = _unary(mth.Exp)
+log = _unary(mth.Log)
+log2 = _unary(mth.Log2)
+log10 = _unary(mth.Log10)
+sin = _unary(mth.Sin)
+cos = _unary(mth.Cos)
+tan = _unary(mth.Tan)
+floor = _unary(mth.Floor)
+ceil = _unary(mth.Ceil)
+signum = _unary(ar.Signum)
+
+upper = _unary(st.Upper)
+lower = _unary(st.Lower)
+length = _unary(st.Length)
+trim = _unary(st.StringTrim)
+ltrim = _unary(st.StringTrimLeft)
+rtrim = _unary(st.StringTrimRight)
+initcap = _unary(st.InitCap)
+reverse = _unary(st.Reverse)
+
+year = _unary(dte.Year)
+month = _unary(dte.Month)
+dayofmonth = _unary(dte.DayOfMonth)
+dayofweek = _unary(dte.DayOfWeek)
+dayofyear = _unary(dte.DayOfYear)
+quarter = _unary(dte.Quarter)
+hour = _unary(dte.Hour)
+minute = _unary(dte.Minute)
+second = _unary(dte.Second)
+last_day = _unary(dte.LastDay)
+
+
+def concat(*cols) -> Column:
+    cs = [_to_col(c) for c in cols]
+    return Column(lambda s: st.ConcatStrings(
+        [c.resolve(s) for c in cs]))
+
+
+def coalesce(*cols) -> Column:
+    cs = [_to_col(c) for c in cols]
+    return Column(lambda s: cond.Coalesce([c.resolve(s) for c in cs]))
+
+
+def date_add(c, days: int) -> Column:
+    cc = _to_col(c)
+    return Column(lambda s: dte.DateAdd(cc.resolve(s),
+                                        _to_col(days).resolve(s)))
+
+
+def date_sub(c, days: int) -> Column:
+    cc = _to_col(c)
+    return Column(lambda s: dte.DateSub(cc.resolve(s),
+                                        _to_col(days).resolve(s)))
+
+
+def datediff(end, start) -> Column:
+    e, st_ = _to_col(end), _to_col(start)
+    return Column(lambda s: dte.DateDiff(e.resolve(s), st_.resolve(s)))
+
+
+def udf(fn, return_type) -> Callable[..., Column]:
+    """Wrap a Python scalar function (the reference's udf registration);
+    the planner traces it into native expressions where possible
+    (SURVEY.md §2.11), else it runs row-wise on the CPU engine."""
+    from spark_rapids_tpu.udf import PythonUdf
+
+    typ = dt.by_name(return_type) if isinstance(return_type, str) \
+        else return_type
+
+    def make(*cols) -> Column:
+        cs = [col(c) if isinstance(c, str) else _to_col(c) for c in cols]
+        return Column(lambda s: PythonUdf(
+            fn, [c.resolve(s) for c in cs], typ))
+    return make

@@ -1,0 +1,90 @@
+"""Session: the SparkSession-shaped entry point."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.api.dataframe import DataFrame
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.plan import nodes as pn
+
+
+class Session:
+    """Holds the config snapshot and builds root DataFrames. The
+    reference's SQLPlugin injects itself into a SparkSession; here the
+    Session IS the host (standalone framework), and acceleration gates
+    ride the same rapids.tpu.* keys."""
+
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = conf if isinstance(conf, RapidsConf) else \
+            RapidsConf(conf)
+
+    # -- readers ----------------------------------------------------------
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    def create_dataframe(self, data, schema: Optional[Schema] = None
+                         ) -> DataFrame:
+        """From a pandas DataFrame or a dict of columns."""
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            cols = {}
+            validity = {}
+            for name in data.columns:
+                s = data[name]
+                if s.dtype == object or str(s.dtype) == "string":
+                    cols[name] = np.array(
+                        [None if v is None or (isinstance(v, float) and
+                                               np.isnan(v)) else v
+                         for v in s], dtype=object)
+                else:
+                    isna = s.isna().to_numpy(dtype=bool)
+                    cols[name] = s.fillna(0).to_numpy()
+                    if isna.any():
+                        validity[name] = ~isna
+            src = pn.InMemorySource(cols, schema=schema,
+                                    validity=validity)
+        else:
+            src = pn.InMemorySource(dict(data), schema=schema)
+        return DataFrame(pn.ScanNode(src), self)
+
+    createDataFrame = create_dataframe
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(pn.RangeNode(start, end, step), self)
+
+
+class DataFrameReader:
+    def __init__(self, session: Session):
+        self.session = session
+
+    def parquet(self, *paths, columns=None) -> DataFrame:
+        from spark_rapids_tpu.io import ParquetSource
+
+        src = ParquetSource(list(paths) if len(paths) > 1 else paths[0],
+                            columns=columns, conf=self.session.conf)
+        return DataFrame(pn.ScanNode(src), self.session)
+
+    def orc(self, *paths, columns=None) -> DataFrame:
+        from spark_rapids_tpu.io import OrcSource
+
+        src = OrcSource(list(paths) if len(paths) > 1 else paths[0],
+                        columns=columns, conf=self.session.conf)
+        return DataFrame(pn.ScanNode(src), self.session)
+
+    def csv(self, *paths, schema: Optional[Schema] = None,
+            header: bool = True, delimiter: str = ",") -> DataFrame:
+        from spark_rapids_tpu.io import CsvSource
+
+        src = CsvSource(list(paths) if len(paths) > 1 else paths[0],
+                        schema=schema, header=header,
+                        delimiter=delimiter, conf=self.session.conf)
+        return DataFrame(pn.ScanNode(src), self.session)

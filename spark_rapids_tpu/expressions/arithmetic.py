@@ -90,6 +90,10 @@ class _DivLike(Expression):
         data = fn(ad, safe_b)
         validity = and_validity(value_validity(a), value_validity(b))
         validity = nonzero if validity is None else (validity & nonzero)
+        if validity.ndim == 0:
+            # scalar divisor: validity must still be full-length (the
+            # column convention downstream kernels rely on)
+            validity = jnp.broadcast_to(validity, data.shape)
         return ColV(out_dtype, data.astype(out_dtype.kernel_dtype), validity)
 
 
