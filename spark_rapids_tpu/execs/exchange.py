@@ -63,24 +63,50 @@ class ShuffleExchangeExec(TpuExec):
 
     def _materialize(self) -> None:
         """Map-side write: run the child once, cache partitioned blocks
-        (RapidsCachingWriter.write)."""
+        (RapidsCachingWriter.write). Range partitioning with unresolved
+        bounds stages the input (spillable) and samples bounds host-side
+        first — the reference runs a separate sampling pass the same way
+        (GpuRangePartitioner.scala:42-95)."""
         if self._blocks is not None:
             return
+        source = self._input_batches()
+        if self.partitioning[0] == "range" and \
+                (len(self.partitioning) < 3 or
+                 self.partitioning[2] is None):
+            staged = [SpillableBatch(
+                b, priorities.INPUT_FROM_SHUFFLE_PRIORITY)
+                for b in source]
+            bounds = part_ops.sample_range_bounds_multi(
+                staged, list(self.partitioning[1]),
+                list(self.schema.types), self.num_out_partitions)
+            self.partitioning = ("range", self.partitioning[1], bounds)
+            source = self._drain_staged(staged)
         blocks: Dict[int, List[SpillableBatch]] = {
             p: [] for p in range(self.num_out_partitions)}
+        for b in source:
+            with TraceRange("ShuffleExchangeExec.partition"):
+                sorted_b, counts = self._partition_batch(b)
+                subs = part_ops.slice_partitions(sorted_b, counts)
+            for p, sub in enumerate(subs):
+                if sub is None:
+                    continue
+                blocks[p].append(SpillableBatch(
+                    sub, priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
+        self._blocks = blocks
+
+    def _input_batches(self):
         for in_p in range(self.children[0].num_partitions):
             for b in self.children[0].execute(in_p):
                 if b.realized_num_rows() == 0:
                     continue
-                with TraceRange("ShuffleExchangeExec.partition"):
-                    sorted_b, counts = self._partition_batch(b)
-                    subs = part_ops.slice_partitions(sorted_b, counts)
-                for p, sub in enumerate(subs):
-                    if sub is None:
-                        continue
-                    blocks[p].append(SpillableBatch(
-                        sub, priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
-        self._blocks = blocks
+                yield b
+
+    @staticmethod
+    def _drain_staged(staged: List[SpillableBatch]):
+        for sb in staged:
+            with sb.acquired() as b:
+                yield b
+            sb.close()
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():

@@ -112,6 +112,49 @@ def sample_range_bounds(batch: ColumnarBatch, spec: SortKeySpec,
     return picks if spec.ascending else picks[::-1]
 
 
+def sample_range_bounds_multi(staged, specs: List[SortKeySpec],
+                              dtypes: List[dt.DType],
+                              num_partitions: int,
+                              max_sample: int = 100_000) -> np.ndarray:
+    """Bounds from ALL staged (spillable) batches of an exchange input:
+    sample up to ``max_sample`` key values across batches, sort, take
+    equi-quantile cut points (the reference samples the child RDD the
+    same way through Spark's RangePartitioner)."""
+    spec = specs[0]
+    t = dtypes[spec.ordinal]
+    per_batch = max(max_sample // max(len(staged), 1), 1)
+    samples = []
+    rng = np.random.default_rng(0x5EED)
+    for sb in staged:
+        with sb.acquired() as b:
+            col = b.columns[spec.ordinal]
+            n = b.realized_num_rows()
+            values, validity = col.to_numpy(n)
+            values = np.asarray(values[:n])
+            if validity is not None:
+                values = values[np.asarray(validity[:n], dtype=bool)]
+            if len(values) > per_batch:
+                values = rng.choice(values, per_batch, replace=False)
+            samples.append(values)
+    if t is dt.STRING:
+        values = np.concatenate([s.astype(object) for s in samples]) \
+            if samples else np.array([], dtype=object)
+        values = np.array(sorted(values, key=str), dtype=object)
+    else:
+        values = np.concatenate(samples) if samples else \
+            np.array([], dtype=t.np_dtype)
+        values = np.sort(values)
+        if t.is_floating:
+            # NaN sorts last in np.sort; keep them out of the cut points
+            values = values[~np.isnan(values)]
+    if len(values) == 0 or num_partitions <= 1:
+        return np.array([], dtype=object)
+    qs = [int(len(values) * (i + 1) / num_partitions)
+          for i in range(num_partitions - 1)]
+    picks = values[np.clip(qs, 0, len(values) - 1)]
+    return picks if spec.ascending else picks[::-1]
+
+
 def _pmod(h: jax.Array, n: int) -> jax.Array:
     m = h % jnp.int64(n)
     return jnp.where(m < 0, m + n, m).astype(jnp.int32)

@@ -420,7 +420,20 @@ class _SortRule(NodeRule):
         node: pn.SortNode = meta.node
         child = children[0]
         if node.global_sort and child.num_partitions > 1:
-            child = exchange.ShuffleExchangeExec(("single",), 1, child)
+            parts = min(meta.conf.get(cfg.SHUFFLE_PARTITIONS),
+                        child.num_partitions)
+            if len(node.specs) == 1 and parts > 1:
+                # distributed global sort: range-partition on sampled
+                # bounds, then sort each (range-ordered) partition — no
+                # single-partition funnel (GpuRangePartitioning +
+                # GpuSortExec, avoiding the SURVEY §5.7 cliff).
+                # Single-key only: multi-key ties could split across a
+                # first-key-only boundary and break the total order.
+                child = exchange.ShuffleExchangeExec(
+                    ("range", list(node.specs), None), parts, child)
+            else:
+                child = exchange.ShuffleExchangeExec(("single",), 1,
+                                                     child)
         return sort.SortExec(node.specs, child,
                              global_sort=node.global_sort)
 

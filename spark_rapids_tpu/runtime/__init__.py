@@ -1,0 +1,25 @@
+"""Runtime bootstrap: device acquisition, memory sizing, global wiring.
+
+The reference's executor-plugin init sequence (Plugin.scala:122-147 ->
+GpuDeviceManager.initializeGpuAndMemory, SURVEY.md §3.1): acquire one
+GPU, size the RMM pool from the alloc fraction/reserve math, install the
+spill catalog + OOM handler, initialize the pinned pool and the task
+semaphore — and exit the process on failure so the cluster manager
+replaces the executor rather than hanging.
+
+TPU-native sequence (``initialize(conf)``):
+  1. TpuDeviceManager.acquire(): pick the chip (or host device), read its
+     HBM size from the device API,
+  2. budget = hbm * allocFraction - reserve (GpuDeviceManager.scala:
+     159-258 sizing math) -> global BufferCatalog with host/disk tiers,
+  3. TpuSemaphore(concurrentTpuTasks),
+  4. GpuShuffleEnv analogue: shuffle codec selection.
+
+``initialize`` is idempotent; ``shutdown`` tears down for tests.
+"""
+from spark_rapids_tpu.runtime.device import (RuntimeEnv, TpuDeviceManager,
+                                             get_env, initialize,
+                                             shutdown)
+
+__all__ = ["initialize", "shutdown", "get_env", "RuntimeEnv",
+           "TpuDeviceManager"]

@@ -1,0 +1,117 @@
+"""Device manager + runtime environment singleton."""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory import semaphore as sem
+from spark_rapids_tpu.memory.catalog import (BufferCatalog, get_catalog,
+                                             reset_catalog)
+
+
+class TpuDeviceManager:
+    """GpuDeviceManager analogue (GpuDeviceManager.scala:31): owns the
+    chosen device and the memory-budget math."""
+
+    def __init__(self, device_ordinal: int = 0):
+        self.device_ordinal = device_ordinal
+        self._device = None
+
+    @property
+    def device(self):
+        if self._device is None:
+            import jax
+
+            devices = jax.devices()
+            if self.device_ordinal >= len(devices):
+                raise RuntimeError(
+                    f"device ordinal {self.device_ordinal} out of range "
+                    f"({len(devices)} devices)")
+            self._device = devices[self.device_ordinal]
+        return self._device
+
+    def hbm_bytes(self) -> Optional[int]:
+        """Total device memory (Cuda.memGetInfo analogue). None when the
+        backend doesn't report it (CPU host platform)."""
+        try:
+            stats = self.device.memory_stats()
+        except Exception:
+            return None
+        if not stats:
+            return None
+        return stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit")
+
+    def device_budget(self, conf: RapidsConf) -> Optional[int]:
+        """allocFraction * hbm - reserve (GpuDeviceManager.scala:159-258
+        pool sizing). None = unbounded (no HBM accounting available)."""
+        total = self.hbm_bytes()
+        if total is None:
+            return None
+        frac = conf.get(cfg.HBM_POOL_FRACTION)
+        reserve = conf.get(cfg.HBM_RESERVE)
+        budget = int(total * frac) - reserve
+        if budget <= 0:
+            raise RuntimeError(
+                f"HBM budget non-positive: total={total} frac={frac} "
+                f"reserve={reserve}")
+        return budget
+
+
+@dataclasses.dataclass
+class RuntimeEnv:
+    conf: RapidsConf
+    device_manager: TpuDeviceManager
+    catalog: BufferCatalog
+    semaphore: "sem.TpuSemaphore"
+    shuffle_codec: str
+
+    @property
+    def device(self):
+        return self.device_manager.device
+
+
+_env: Optional[RuntimeEnv] = None
+_lock = threading.Lock()
+
+
+def initialize(conf: Optional[RapidsConf] = None,
+               device_ordinal: int = 0) -> RuntimeEnv:
+    """Executor-init analogue (RapidsExecutorPlugin.init,
+    Plugin.scala:122-147). Idempotent: re-initializing with a new conf
+    replaces the environment."""
+    global _env
+    conf = conf or RapidsConf()
+    with _lock:
+        dm = TpuDeviceManager(device_ordinal)
+        _ = dm.device  # fail fast if the device is unavailable
+        budget = dm.device_budget(conf)
+        catalog = BufferCatalog(
+            device_budget=budget,
+            host_budget=conf.get(cfg.HOST_SPILL_STORAGE_SIZE),
+            spill_dir=conf.get(cfg.SPILL_DIR),
+            disk_codec=conf.get(cfg.SHUFFLE_COMPRESSION_CODEC)
+            if conf.get(cfg.SHUFFLE_COMPRESSION_CODEC) != "none"
+            else "lz4")
+        reset_catalog(catalog)
+        semaphore = sem.initialize(conf.get(cfg.CONCURRENT_TPU_TASKS))
+        _env = RuntimeEnv(conf, dm, catalog, semaphore,
+                          conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
+        return _env
+
+
+def get_env() -> Optional[RuntimeEnv]:
+    with _lock:
+        return _env
+
+
+def shutdown() -> None:
+    """Test teardown: drop the environment and restore defaults."""
+    global _env
+    with _lock:
+        _env = None
+        reset_catalog(BufferCatalog())
+        sem.initialize(2)

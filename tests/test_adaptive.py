@@ -108,3 +108,61 @@ def test_adaptive_join_sides_stay_aligned(tmp_path, multifile_scan):
     assert len(readers) == 2
     # shared spec: identical groups on both sides
     assert readers[0].groups == readers[1].groups
+
+
+def test_distributed_global_sort_range_partitioned(tmp_path):
+    """Global sort over a multi-partition scan goes through a sampled
+    range exchange (no single-partition funnel) and stays ordered."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.execs.sort import SortExec
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    rng = np.random.default_rng(3)
+    for k in range(4):
+        pq.write_table(pa.table(
+            {"v": rng.random(400) * 1000,
+             "tag": rng.integers(0, 5, 400).astype(np.int64)}),
+            tmp_path / f"s{k}.parquet")
+    scan = pn.ScanNode(ParquetSource(str(tmp_path)))
+    plan = pn.SortNode([SortKeySpec.spark_default(0)], scan)
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    exec_ = apply_overrides(plan, conf)
+    exchanges = _find(exec_, ShuffleExchangeExec)
+    assert exchanges and exchanges[0].partitioning[0] == "range"
+    assert exchanges[0].num_out_partitions > 1
+    assert isinstance(exec_, SortExec)
+    # compare IN ORDER against the oracle
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+    from spark_rapids_tpu.execs.base import collect
+    from tests.compare import assert_frames_equal
+
+    cpu_df = execute_cpu(plan).to_pandas()
+    assert_frames_equal(cpu_df, collect(exec_), sort=False)
+
+
+def test_distributed_sort_descending_strings(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    rng = np.random.default_rng(4)
+    for k in range(3):
+        strs = np.array([f"w{int(x)}" if x > 2 else None
+                         for x in rng.integers(0, 40, 200)], dtype=object)
+        pq.write_table(pa.table({"s": pa.array(strs, type=pa.string())}),
+                       tmp_path / f"p{k}.parquet")
+    scan = pn.ScanNode(ParquetSource(str(tmp_path)))
+    plan = pn.SortNode([SortKeySpec.spark_default(0, ascending=False)],
+                       scan)
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+    from spark_rapids_tpu.execs.base import collect
+    from tests.compare import assert_frames_equal
+
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, RapidsConf(
+        {"rapids.tpu.sql.test.enabled": True}))
+    assert_frames_equal(cpu_df, collect(exec_), sort=False)

@@ -1,0 +1,65 @@
+"""Runtime bootstrap tests (§2.1 plugin-init analogue)."""
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory import semaphore as sem
+from spark_rapids_tpu.memory.catalog import get_catalog
+from spark_rapids_tpu import runtime
+from spark_rapids_tpu.runtime.device import TpuDeviceManager
+
+
+@pytest.fixture(autouse=True)
+def _teardown():
+    yield
+    runtime.shutdown()
+
+
+def test_initialize_wires_globals(tmp_path):
+    conf = RapidsConf({
+        "rapids.tpu.sql.concurrentTpuTasks": 5,
+        "rapids.tpu.memory.spillDir": str(tmp_path),
+        "rapids.tpu.shuffle.compression.codec": "zlib",
+    })
+    env = runtime.initialize(conf)
+    assert env.semaphore is sem.get()
+    assert env.catalog is get_catalog()
+    assert env.catalog._spill_dir == str(tmp_path)
+    assert env.catalog.disk_codec == "zlib"
+    assert env.shuffle_codec == "zlib"
+    assert env.device.platform in ("cpu", "tpu")
+    # semaphore honors the conf
+    for _ in range(5):
+        assert env.semaphore.acquire_if_necessary(task_id=_) is True
+    assert env.semaphore.holds(task_id=0)
+
+
+def test_initialize_idempotent_replaces():
+    e1 = runtime.initialize(RapidsConf())
+    e2 = runtime.initialize(RapidsConf(
+        {"rapids.tpu.sql.concurrentTpuTasks": 1}))
+    assert runtime.get_env() is e2
+    assert e1 is not e2
+
+
+def test_device_budget_math():
+    dm = TpuDeviceManager()
+    dm.hbm_bytes = lambda: 16 << 30  # pretend 16 GiB HBM
+    conf = RapidsConf({"rapids.tpu.memory.hbm.allocFraction": 0.5,
+                       "rapids.tpu.memory.hbm.reserve": 1 << 30})
+    assert dm.device_budget(conf) == (8 << 30) - (1 << 30)
+    bad = RapidsConf({"rapids.tpu.memory.hbm.allocFraction": 0.01,
+                      "rapids.tpu.memory.hbm.reserve": 8 << 30})
+    with pytest.raises(RuntimeError, match="non-positive"):
+        dm.device_budget(bad)
+
+
+def test_budget_none_without_memory_stats():
+    dm = TpuDeviceManager()
+    dm.hbm_bytes = lambda: None
+    assert dm.device_budget(RapidsConf()) is None
+
+
+def test_bad_device_ordinal():
+    with pytest.raises(RuntimeError, match="out of range"):
+        runtime.initialize(RapidsConf(), device_ordinal=512)
