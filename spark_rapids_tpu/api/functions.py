@@ -7,6 +7,7 @@ from spark_rapids_tpu.api.column import Column, _to_col, col, lit, when  # noqa:
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.expressions import aggregates as A
 from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import bitwise as bw
 from spark_rapids_tpu.expressions import conditional as cond
 from spark_rapids_tpu.expressions import datetime as dte
 from spark_rapids_tpu.expressions import math as mth
@@ -98,6 +99,22 @@ hour = _unary(dte.Hour)
 minute = _unary(dte.Minute)
 second = _unary(dte.Second)
 last_day = _unary(dte.LastDay)
+
+
+def _binary_fn(klass) -> Callable[[object, object], Column]:
+    def f(a, b) -> Column:
+        ca, cb = _to_col(a), _to_col(b)
+        return Column(lambda s: klass(ca.resolve(s), cb.resolve(s)))
+    return f
+
+
+shiftleft = _binary_fn(bw.ShiftLeft)
+shiftright = _binary_fn(bw.ShiftRight)
+shiftrightunsigned = _binary_fn(bw.ShiftRightUnsigned)
+bitwise_and = _binary_fn(bw.BitwiseAnd)
+bitwise_or = _binary_fn(bw.BitwiseOr)
+bitwise_xor = _binary_fn(bw.BitwiseXor)
+bitwise_not = _unary(bw.BitwiseNot)
 
 
 def concat(*cols) -> Column:

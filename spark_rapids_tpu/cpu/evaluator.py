@@ -16,6 +16,7 @@ import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import bitwise as bw
 from spark_rapids_tpu.expressions import conditional as cond
 from spark_rapids_tpu.expressions import datetime as dte
 from spark_rapids_tpu.expressions import math as mth
@@ -188,6 +189,41 @@ def _pmod(e, ctx):
         data = np.where(m < 0, np.fmod(m + safe, safe), m)
     return CV(odt, data.astype(odt.np_dtype),
               and_valid(l.validity, r.validity, ~zero))
+
+
+def _bitwise_binary(op):
+    def f(e, ctx):
+        l = eval_expr(e.children[0], ctx)
+        r = eval_expr(e.children[1], ctx)
+        odt = e.dtype
+        data = op(l.data.astype(odt.np_dtype), r.data.astype(odt.np_dtype))
+        return CV(odt, data.astype(odt.np_dtype),
+                  and_valid(l.validity, r.validity))
+    return f
+
+
+def _bitwise_not(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    return CV(e.dtype, np.invert(v.data.astype(e.dtype.np_dtype)),
+              v.validity)
+
+
+def _shift(op, unsigned=False):
+    def f(e, ctx):
+        l = eval_expr(e.children[0], ctx)
+        r = eval_expr(e.children[1], ctx)
+        odt = e.dtype
+        width = 64 if odt is dt.INT64 else 32
+        a = l.data.astype(odt.np_dtype)
+        s = r.data.astype(np.int64) & (width - 1)  # Java shift mask
+        if unsigned:
+            ut = np.uint64 if odt is dt.INT64 else np.uint32
+            data = (a.view(ut) >> s.astype(ut)).view(odt.np_dtype)
+        else:
+            data = op(a, s.astype(odt.np_dtype))
+        return CV(odt, data.astype(odt.np_dtype),
+                  and_valid(l.validity, r.validity))
+    return f
 
 
 def _unary_minus(e, ctx):
@@ -727,6 +763,13 @@ _DISPATCH = {
     ar.IntegralDivide: _int_div,
     ar.Remainder: _remainder,
     ar.Pmod: _pmod,
+    bw.BitwiseAnd: _bitwise_binary(np.bitwise_and),
+    bw.BitwiseOr: _bitwise_binary(np.bitwise_or),
+    bw.BitwiseXor: _bitwise_binary(np.bitwise_xor),
+    bw.BitwiseNot: _bitwise_not,
+    bw.ShiftLeft: _shift(np.left_shift),
+    bw.ShiftRight: _shift(np.right_shift),
+    bw.ShiftRightUnsigned: _shift(None, unsigned=True),
     ar.UnaryMinus: _unary_minus,
     ar.UnaryPositive: _unary_pos,
     ar.Abs: _abs,

@@ -1,0 +1,119 @@
+"""Bitwise and shift expressions (reference org/apache/spark/sql/rapids/
+bitwise.scala; registered in GpuOverrides.scala expression table).
+
+Spark semantics: operands are integral; shifts take an INT shift amount
+and, like Java, mask it by the value width (x << 33 on an int == x << 1).
+ShiftRight is arithmetic, ShiftRightUnsigned logical.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions.base import (EvalContext, EvalValue,
+                                               Expression, eval_binary,
+                                               eval_unary)
+
+
+class _BitwiseBinary(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+        assert left.dtype.is_integral and right.dtype.is_integral, \
+            "bitwise ops require integral operands"
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.common_type(self.children[0].dtype,
+                              self.children[1].dtype)
+
+    def _op(self, a, b):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        kt = self.dtype.kernel_dtype
+        return eval_binary(self, ctx,
+                           lambda a, b: self._op(a.astype(kt),
+                                                 b.astype(kt)),
+                           self.dtype)
+
+
+class BitwiseAnd(_BitwiseBinary):
+    def _op(self, a, b):
+        return a & b
+
+
+class BitwiseOr(_BitwiseBinary):
+    def _op(self, a, b):
+        return a | b
+
+
+class BitwiseXor(_BitwiseBinary):
+    def _op(self, a, b):
+        return a ^ b
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+        assert child.dtype.is_integral
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        return eval_unary(self, ctx, lambda x: ~x, self.dtype)
+
+
+class _Shift(Expression):
+    """value width decides the Java shift-amount mask (31 or 63)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+        assert left.dtype in (dt.INT32, dt.INT64), \
+            "shifts take int or bigint values (Spark)"
+        assert right.dtype.is_integral
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def _mask(self):
+        return 63 if self.children[0].dtype is dt.INT64 else 31
+
+    def _op(self, a, s):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        kt = self.dtype.kernel_dtype
+
+        def f(a, s):
+            return self._op(a.astype(kt),
+                            (s.astype(jnp.int32) & self._mask()))
+        return eval_binary(self, ctx, f, self.dtype)
+
+
+class ShiftLeft(_Shift):
+    def _op(self, a, s):
+        return a << s.astype(a.dtype)
+
+
+class ShiftRight(_Shift):
+    """Arithmetic (sign-propagating) right shift — Java >>."""
+
+    def _op(self, a, s):
+        return a >> s.astype(a.dtype)
+
+
+class ShiftRightUnsigned(_Shift):
+    """Logical right shift — Java >>>: arithmetic shift then clear the
+    sign-propagated top bits (no unsigned bitcast: bitcast_convert on
+    64-bit types is unavailable under the TPU x64 rewrite)."""
+
+    def _op(self, a, s):
+        width = 64 if self.children[0].dtype is dt.INT64 else 32
+        sa = s.astype(a.dtype)
+        shifted = a >> sa
+        sc = jnp.maximum(sa, 1)          # avoid shift-by-width UB below
+        keep = (jnp.ones((), a.dtype) << (width - sc)) - 1
+        return jnp.where(sa == 0, a, shifted & keep)

@@ -27,8 +27,8 @@ from spark_rapids_tpu.execs import basic, batching, exchange, joins, sort, \
     window
 from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.expressions import aggregates as aggfn
-from spark_rapids_tpu.expressions import arithmetic, cast, conditional, \
-    datetime as dtexpr, math as mathexpr, predicates, strings
+from spark_rapids_tpu.expressions import arithmetic, bitwise, cast, \
+    conditional, datetime as dtexpr, math as mathexpr, predicates, strings
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression, Literal)
 from spark_rapids_tpu.plan import nodes as pn
@@ -88,8 +88,8 @@ _EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
 def _register_exprs():
     import inspect
 
-    for mod in (arithmetic, predicates, conditional, mathexpr, dtexpr,
-                strings, cast, aggfn):
+    for mod in (arithmetic, bitwise, predicates, conditional, mathexpr,
+                dtexpr, strings, cast, aggfn):
         for _, klass in inspect.getmembers(mod, inspect.isclass):
             if not issubclass(klass, Expression):
                 continue

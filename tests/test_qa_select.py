@@ -167,3 +167,36 @@ def test_join_over_fuzz():
     for kind in ("inner", "left", "left_semi", "left_anti"):
         plan = pn.JoinNode(kind, left, right, [0], [0])
         assert_cpu_and_tpu_equal(plan, conf=CONF, approx_float=1e-6)
+
+
+@pytest.mark.parametrize("op_name", ["BitwiseAnd", "BitwiseOr",
+                                     "BitwiseXor"])
+@pytest.mark.parametrize("gen", [dg.IntegerGen(), dg.LongGen(),
+                                 dg.ShortGen()],
+                         ids=lambda g: g.dtype.name)
+def test_bitwise_binary_matrix(op_name, gen):
+    from spark_rapids_tpu.expressions import bitwise as bw
+
+    op = getattr(bw, op_name)
+    scan = dg.gen_scan({"a": gen, "b": type(gen)()}, n=150, seed=21)
+    exprs = [op(ref(0, gen.dtype), ref(1, gen.dtype)),
+             bw.BitwiseNot(ref(0, gen.dtype))]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
+
+
+@pytest.mark.parametrize("op_name", ["ShiftLeft", "ShiftRight",
+                                     "ShiftRightUnsigned"])
+@pytest.mark.parametrize("gen", [dg.IntegerGen(), dg.LongGen()],
+                         ids=lambda g: g.dtype.name)
+def test_shift_matrix(op_name, gen):
+    from spark_rapids_tpu.expressions import bitwise as bw
+    from spark_rapids_tpu.expressions.base import Literal
+
+    op = getattr(bw, op_name)
+    scan = dg.gen_scan({"a": gen, "s": dg.IntegerGen()}, n=150, seed=22)
+    # fuzzed shift amounts exercise the Java width mask (s & 31/63)
+    exprs = [op(ref(0, gen.dtype), ref(1, dt.INT32)),
+             op(ref(0, gen.dtype), Literal(3, dt.INT32)),
+             op(ref(0, gen.dtype), Literal(0, dt.INT32)),
+             op(ref(0, gen.dtype), Literal(65, dt.INT32))]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
