@@ -20,6 +20,7 @@ from spark_rapids_tpu.expressions import bitwise as bw
 from spark_rapids_tpu.expressions import conditional as cond
 from spark_rapids_tpu.expressions import datetime as dte
 from spark_rapids_tpu.expressions import math as mth
+from spark_rapids_tpu.expressions import nondeterministic as nd
 from spark_rapids_tpu.expressions import predicates as pr
 from spark_rapids_tpu.expressions import strings as st
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
@@ -770,6 +771,14 @@ _DISPATCH = {
     bw.ShiftLeft: _shift(np.left_shift),
     bw.ShiftRight: _shift(np.right_shift),
     bw.ShiftRightUnsigned: _shift(None, unsigned=True),
+    # the CPU oracle is one partition: pid 0, absolute positions
+    nd.SparkPartitionID: lambda e, ctx: CV(
+        dt.INT32, np.zeros(ctx.num_rows, dtype=np.int32)),
+    nd.MonotonicallyIncreasingID: lambda e, ctx: CV(
+        dt.INT64, np.arange(ctx.num_rows, dtype=np.int64)),
+    nd.Rand: lambda e, ctx: CV(
+        dt.FLOAT64, nd.rand_reference(e.seed, 0,
+                                      np.arange(ctx.num_rows))),
     ar.UnaryMinus: _unary_minus,
     ar.UnaryPositive: _unary_pos,
     ar.Abs: _abs,

@@ -66,10 +66,16 @@ class ProjectExec(TpuExec):
         self.projection = CompiledProjection(exprs, conf)
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
+
         def it():
+            row_base = 0
             for b in self.children[0].execute(partition):
+                ti = TaskInfo.make(partition, row_base)
                 with TraceRange("ProjectExec"):
-                    yield self.projection(b)
+                    out = self.projection(b, task_info=ti)
+                row_base += b.realized_num_rows()
+                yield out
         return timed(self, it())
 
 
@@ -82,10 +88,16 @@ class FilterExec(TpuExec):
         self.filter = CompiledFilter(condition, conf)
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
+
         def it():
+            row_base = 0
             for b in self.children[0].execute(partition):
+                ti = TaskInfo.make(partition, row_base)
                 with TraceRange("FilterExec"):
-                    yield self.filter(b)
+                    out = self.filter(b, task_info=ti)
+                row_base += b.realized_num_rows()
+                yield out
         return timed(self, it())
 
 

@@ -22,6 +22,8 @@ from spark_rapids_tpu.expressions.base import (
 
 
 class BinaryArithmetic(Expression):
+    abstract = True  # template only; never registered or planned
+
     def __init__(self, left: Expression, right: Expression):
         super().__init__([left, right])
 

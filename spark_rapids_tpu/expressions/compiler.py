@@ -56,11 +56,12 @@ class CompiledProjection:
         exprs = self.exprs
 
         @partial(jax.jit, static_argnames=("types",))
-        def run(datas, validities, num_rows, types):
+        def run(datas, validities, num_rows, task, types):
             capacity = datas[0].shape[0] if datas else 128
             cols = [ColV(t, d, v) for (t, d, v) in
                     zip(types, datas, validities)]
-            ctx = EvalContext(cols, capacity, num_rows, in_jit=True)
+            ctx = EvalContext(cols, capacity, num_rows, in_jit=True,
+                              task_info=task)
             outs = []
             for e in exprs:
                 v = e.eval(ctx)
@@ -72,12 +73,16 @@ class CompiledProjection:
 
     def __call__(self, batch: ColumnarBatch,
                  task_info=None) -> ColumnarBatch:
+        from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
+
+        if task_info is None:
+            task_info = TaskInfo.make()
         if self.fused:
             datas = [c.data for c in batch.columns]
             validities = [c.validity for c in batch.columns]
             types = tuple(c.dtype for c in batch.columns)
             outs = self._jit(datas, validities, batch.num_rows_device(),
-                             types)
+                             task_info, types)
             cols = []
             for e, (data, validity) in zip(self.exprs, outs):
                 if e.dtype is dt.STRING:
@@ -114,11 +119,12 @@ class CompiledFilter:
             cond = condition
 
             @partial(jax.jit, static_argnames=("types",))
-            def run_mask(datas, validities, num_rows, types):
+            def run_mask(datas, validities, num_rows, task, types):
                 capacity = datas[0].shape[0] if datas else 128
                 cols = [ColV(t, d, v) for (t, d, v) in
                         zip(types, datas, validities)]
-                ctx = EvalContext(cols, capacity, num_rows, in_jit=True)
+                ctx = EvalContext(cols, capacity, num_rows, in_jit=True,
+                                  task_info=task)
                 v = broadcast(cond.eval(ctx), ctx)
                 keep = v.data
                 if v.validity is not None:
@@ -129,14 +135,17 @@ class CompiledFilter:
 
     def __call__(self, batch: ColumnarBatch,
                  task_info=None) -> ColumnarBatch:
+        from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
         from spark_rapids_tpu.ops.filter import compact_batch
 
+        if task_info is None:
+            task_info = TaskInfo.make()
         if self.fused:
             datas = [c.data for c in batch.columns]
             validities = [c.validity for c in batch.columns]
             types = tuple(c.dtype for c in batch.columns)
             keep = self._mask(datas, validities, batch.num_rows_device(),
-                              types)
+                              task_info, types)
             return compact_batch(batch, keep)
         ctx = EvalContext.from_batch(batch, conf=self.conf,
                                      task_info=task_info)

@@ -1,0 +1,175 @@
+"""Nondeterministic / partition-context expressions (reference §2.5:
+GpuRandomExpressions.scala:75, GpuSparkPartitionID.scala:58,
+GpuMonotonicallyIncreasingID.scala:75).
+
+These read the per-task ``TaskInfo`` (partition id, rows already emitted
+by earlier batches of this partition, session seed) that the exec layer
+threads through the compiler — the TaskContext the reference reads on
+the JVM side.
+
+``Rand`` is a counter-based generator: value = mix64(seed', position),
+with seed' = expr seed + partition id (Spark's rand seeds per partition
+the same way). The stream differs from Spark's XORShift — the reference
+has the identical caveat with cuDF's Philox and flags the expression
+incompatible; so do we.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions.base import (ColV, EvalContext,
+                                               EvalValue, Expression,
+                                               LeafExpression)
+
+
+class TaskInfo(NamedTuple):
+    """Per-(partition, batch) evaluation context; fields are 0-d device
+    scalars so the fused projection jit treats them as dynamic inputs."""
+
+    partition_id: jax.Array   # int32
+    row_base: jax.Array       # int64: rows emitted before this batch
+    seed: jax.Array           # int64: session seed
+
+    @staticmethod
+    def make(partition_id: int = 0, row_base: int = 0,
+             seed: int = 0) -> "TaskInfo":
+        return TaskInfo(jnp.int32(partition_id), jnp.int64(row_base),
+                        jnp.int64(seed))
+
+
+def _task(ctx: EvalContext) -> TaskInfo:
+    if ctx.task_info is not None:
+        return ctx.task_info
+    return TaskInfo.make()
+
+
+class SparkPartitionID(LeafExpression):
+    """spark_partition_id(): INT32 partition ordinal."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.INT32
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    @property
+    def device_only(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        ti = _task(ctx)
+        data = jnp.full(ctx.capacity, ti.partition_id, dtype=jnp.int32)
+        return ColV(dt.INT32, data, None)
+
+
+class MonotonicallyIncreasingID(LeafExpression):
+    """monotonically_increasing_id(): (partition << 33) + row position —
+    Spark's exact encoding (unique, monotonic within a partition)."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.INT64
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    @property
+    def device_only(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        ti = _task(ctx)
+        iota = jnp.arange(ctx.capacity, dtype=jnp.int64)
+        data = (ti.partition_id.astype(jnp.int64) << 33) + \
+            ti.row_base + iota
+        return ColV(dt.INT64, data, None)
+
+
+# splitmix64 constants as signed int64 (two's complement)
+_GOLDEN = jnp.int64(-7046029254386353131)    # 0x9E3779B97F4A7C15
+_MIX1 = jnp.int64(-4658895280553007687)      # 0xBF58476D1CE4E5B9
+_MIX2 = jnp.int64(-7723592293110705685)      # 0x94D049BB133111EB
+
+
+def _lshr(z, k: int):
+    """Logical right shift by a STATIC amount on signed int64 (no
+    unsigned bitcast — unavailable under the TPU x64 rewrite)."""
+    return (z >> k) & jnp.int64((1 << (64 - k)) - 1)
+
+
+def _mix64(z):
+    z = (z ^ _lshr(z, 30)) * _MIX1
+    z = (z ^ _lshr(z, 27)) * _MIX2
+    return z ^ _lshr(z, 31)
+
+
+class Rand(LeafExpression):
+    """rand(seed): uniform [0, 1) doubles, counter-based (splitmix64 of
+    the absolute row position), reproducible per (seed, partition, row)."""
+
+    incompat = True  # stream differs from Spark's XORShiftRandom
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.seed = int(seed)
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.FLOAT64
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    @property
+    def deterministic(self) -> bool:
+        return False
+
+    @property
+    def device_only(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        ti = _task(ctx)
+        pos = ti.row_base + jnp.arange(ctx.capacity, dtype=jnp.int64)
+        # pre-mix the stream id: a linear (seed+pid+pos)*GOLDEN counter
+        # would collide across partitions at shifted positions
+        stream = _mix64((jnp.int64(self.seed) +
+                         ti.partition_id.astype(jnp.int64)) * _GOLDEN)
+        h = _mix64(stream + pos * _GOLDEN)
+        u53 = _lshr(h, 11)  # top 53 bits -> exactly representable
+        data = u53.astype(jnp.float64) * jnp.float64(2.0 ** -53)
+        return ColV(dt.FLOAT64, data, None)
+
+
+def rand_reference(seed: int, partition_id, positions):
+    """numpy mirror of Rand (the CPU oracle), exact to the bit."""
+    import numpy as np
+
+    GOLDEN = np.int64(-7046029254386353131)
+    MIX1 = np.int64(-4658895280553007687)
+    MIX2 = np.int64(-7723592293110705685)
+
+    def lshr(z, k):
+        return (z >> k) & np.int64((1 << (64 - k)) - 1)
+
+    def mix(z):
+        z = (z ^ lshr(z, 30)) * MIX1
+        z = (z ^ lshr(z, 27)) * MIX2
+        return z ^ lshr(z, 31)
+
+    with np.errstate(all="ignore"):
+        pos = np.asarray(positions, dtype=np.int64)
+        stream = mix((np.int64(seed) + np.int64(partition_id)) * GOLDEN)
+        z = stream + pos * GOLDEN
+        z = (z ^ lshr(z, 30)) * MIX1
+        z = (z ^ lshr(z, 27)) * MIX2
+        z = z ^ lshr(z, 31)
+        return lshr(z, 11).astype(np.float64) * 2.0 ** -53

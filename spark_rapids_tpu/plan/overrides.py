@@ -28,7 +28,8 @@ from spark_rapids_tpu.execs import basic, batching, exchange, joins, sort, \
 from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.expressions import aggregates as aggfn
 from spark_rapids_tpu.expressions import arithmetic, bitwise, cast, \
-    conditional, datetime as dtexpr, math as mathexpr, predicates, strings
+    conditional, datetime as dtexpr, math as mathexpr, \
+    nondeterministic, predicates, strings
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression, Literal)
 from spark_rapids_tpu.plan import nodes as pn
@@ -89,7 +90,7 @@ def _register_exprs():
     import inspect
 
     for mod in (arithmetic, bitwise, predicates, conditional, mathexpr,
-                dtexpr, strings, cast, aggfn):
+                dtexpr, nondeterministic, strings, cast, aggfn):
         for _, klass in inspect.getmembers(mod, inspect.isclass):
             if not issubclass(klass, Expression):
                 continue
@@ -97,6 +98,8 @@ def _register_exprs():
                 continue
             if klass.__name__.startswith("_"):
                 continue
+            if vars(klass).get("abstract", False):  # own attr only:
+                continue  # subclasses of an abstract template register
             incompat = bool(getattr(klass, "incompat", False))
             _EXPR_RULES[klass] = ExprRule(klass, incompat)
     for klass in (BoundReference, Literal, Alias):

@@ -1,0 +1,95 @@
+"""API-drift validation (the reference's api_validation module,
+ApiValidation.scala:24-60: reflection-diff Gpu exec signatures against
+Spark's). Here the invariants are internal: every plan node must be
+covered by BOTH engines, and every registered expression must evaluate
+on BOTH engines — so the accelerated path and the oracle can never drift
+structurally."""
+import inspect
+
+import pytest
+
+from spark_rapids_tpu.cpu import engine as cpu_engine
+from spark_rapids_tpu.cpu import evaluator as cpu_eval
+from spark_rapids_tpu.expressions.base import Expression
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan import overrides
+
+
+def _all_plan_nodes():
+    out = [klass for _, klass in inspect.getmembers(pn, inspect.isclass)
+           if issubclass(klass, pn.PlanNode) and klass is not pn.PlanNode]
+    from spark_rapids_tpu.execs.python_exec import MapInPandasNode
+    from spark_rapids_tpu.io.write import WriteFilesNode
+
+    out += [MapInPandasNode, WriteFilesNode]
+    return out
+
+
+def test_every_plan_node_has_planner_rule():
+    missing = [k.__name__ for k in _all_plan_nodes()
+               if k not in overrides._NODE_RULES]
+    assert not missing, (
+        f"plan nodes without a TpuOverrides rule: {missing} — add a "
+        "NodeRule (or an explicit fallback decision) for each")
+
+
+def test_every_plan_node_has_cpu_engine_impl():
+    missing = [k.__name__ for k in _all_plan_nodes()
+               if k not in cpu_engine._NODES]
+    assert not missing, (
+        f"plan nodes the CPU oracle cannot execute: {missing}")
+
+
+def _registered_expressions():
+    return [k for k in overrides._EXPR_RULES
+            if issubclass(k, Expression)]
+
+
+def test_every_registered_expression_evaluates_on_cpu():
+    from spark_rapids_tpu.expressions.aggregates import AggregateFunction
+
+    missing = []
+    for klass in _registered_expressions():
+        if issubclass(klass, AggregateFunction):
+            continue  # evaluated through the aggregate exec, not eval_expr
+        if klass in cpu_eval._DISPATCH:
+            continue
+        if any(issubclass(klass, k) for k in cpu_eval._DISPATCH):
+            continue
+        if hasattr(klass, "eval_cpu"):
+            continue
+        missing.append(klass.__name__)
+    assert not missing, (
+        f"registered expressions the CPU oracle cannot evaluate: "
+        f"{missing}")
+
+
+def test_every_registered_expression_has_device_eval():
+    from spark_rapids_tpu.expressions.aggregates import AggregateFunction
+
+    missing = []
+    for klass in _registered_expressions():
+        if issubclass(klass, AggregateFunction):
+            continue
+        if "eval" not in {m for k in klass.__mro__ if k is not Expression
+                          for m in vars(k)}:
+            missing.append(klass.__name__)
+    assert not missing, (
+        f"registered expressions without a device eval: {missing}")
+
+
+def test_aggregate_functions_declare_partial_contract():
+    """Partial/final split requires coherent update/merge halves
+    (CudfAggregate pairs, AggregateFunctions.scala:531)."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expressions import aggregates as A
+    from spark_rapids_tpu.expressions.base import BoundReference
+
+    child = BoundReference(0, dt.FLOAT64)
+    for klass in (A.Sum, A.Min, A.Max, A.Count, A.Average, A.First,
+                  A.Last):
+        inst = klass(child)
+        assert inst.partial_types(), klass.__name__
+        assert inst.update_ops(), klass.__name__
+        assert inst.merge_ops(), klass.__name__
+        assert len(inst.update_ops()) == len(inst.partial_types())

@@ -1,0 +1,99 @@
+"""Nondeterministic / partition-context expression tests (§2.5:
+rand, spark_partition_id, monotonically_increasing_id)."""
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs.base import collect
+from spark_rapids_tpu.expressions import nondeterministic as nd
+from spark_rapids_tpu.expressions.base import Alias, BoundReference
+from spark_rapids_tpu.io import ParquetSource
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.overrides import apply_overrides
+
+from tests.compare import assert_cpu_and_tpu_equal
+
+CONF = RapidsConf({"rapids.tpu.sql.test.enabled": True,
+                   "rapids.tpu.sql.incompatibleOps.enabled": True})
+
+
+def scan(n=100):
+    return pn.ScanNode(pn.InMemorySource(
+        {"x": np.arange(n, dtype=np.int64)}))
+
+
+def _proj(exprs, names, child):
+    return pn.ProjectNode(exprs, child, names=names)
+
+
+def test_single_partition_matches_oracle():
+    """One partition: pid/rowpos/rand formulas agree bit-for-bit with the
+    CPU oracle."""
+    plan = _proj(
+        [BoundReference(0, dt.INT64), nd.SparkPartitionID(),
+         nd.MonotonicallyIncreasingID(), nd.Rand(seed=7)],
+        ["x", "pid", "mid", "r"], scan(200))
+    assert_cpu_and_tpu_equal(plan, sort=False, conf=CONF,
+                             approx_float=0.0)
+
+
+def test_multi_partition_structure(tmp_path):
+    for k in range(3):
+        pq.write_table(pa.table(
+            {"x": np.arange(k * 100, k * 100 + 100, dtype=np.int64)}),
+            tmp_path / f"f{k}.parquet")
+    plan = _proj(
+        [BoundReference(0, dt.INT64), nd.SparkPartitionID(),
+         nd.MonotonicallyIncreasingID(), nd.Rand(seed=3)],
+        ["x", "pid", "mid", "r"], pn.ScanNode(ParquetSource(str(tmp_path))))
+    df = collect(apply_overrides(plan, CONF))
+    pids = df["pid"].astype(int)
+    mids = df["mid"].astype(int)
+    assert set(pids) == {0, 1, 2}
+    # Spark encoding: partition << 33 | position
+    assert all(mids[i] == (pids[i] << 33) + (i % 100)
+               for i in range(len(df)))
+    assert mids.is_unique
+    rs = df["r"].astype(float)
+    assert ((rs >= 0) & (rs < 1)).all()
+    assert rs.nunique() > 290  # essentially all distinct
+    # rand depends on partition: partition streams differ
+    assert not np.allclose(sorted(rs[pids == 0]), sorted(rs[pids == 1]))
+
+
+def test_rand_deterministic_per_seed():
+    plan = _proj([nd.Rand(seed=11)], ["r"], scan(50))
+    a = collect(apply_overrides(plan, CONF))["r"].astype(float)
+    b = collect(apply_overrides(plan, CONF))["r"].astype(float)
+    np.testing.assert_array_equal(a, b)
+    c = collect(apply_overrides(
+        _proj([nd.Rand(seed=12)], ["r"], scan(50)), CONF))["r"]
+    assert not np.array_equal(a, c.astype(float))
+
+
+def test_rand_uniformity():
+    plan = _proj([nd.Rand(seed=0)], ["r"], scan(20_000))
+    r = collect(apply_overrides(plan, CONF))["r"].astype(float)
+    assert abs(r.mean() - 0.5) < 0.01
+    hist, _ = np.histogram(r, bins=10, range=(0, 1))
+    assert hist.min() > 1600  # no empty decile
+
+def test_rand_disabled_without_incompat_flag():
+    plan = _proj([nd.Rand(seed=0)], ["r"], scan(10))
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert type(exec_).__name__ == "CpuFallbackExec"
+
+
+def test_row_base_advances_across_batches():
+    """Multiple batches in one partition must continue the id stream."""
+    src = pn.InMemorySource({"x": np.arange(5000, dtype=np.int64)})
+    plan = _proj([nd.MonotonicallyIncreasingID()], ["mid"],
+                 pn.ScanNode(src))
+    conf = CONF.with_overrides(
+        {"rapids.tpu.sql.reader.batchSizeRows": 1000})
+    df = collect(apply_overrides(plan, conf))
+    np.testing.assert_array_equal(df["mid"].astype(int),
+                                  np.arange(5000))
