@@ -91,12 +91,18 @@ def sort_key_arrays(data: jax.Array, validity: Optional[jax.Array],
 def lexsort_indices(cols: List[Tuple[jax.Array, Optional[jax.Array]]],
                     dtypes: List[dt.DType],
                     specs: List[SortKeySpec],
-                    num_rows: jax.Array) -> jax.Array:
-    """Stable permutation ordering live rows by ``specs``; padding rows sort
-    last. ``cols`` indexed by spec.ordinal."""
+                    num_rows: jax.Array,
+                    live_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Stable permutation ordering live rows by ``specs``; padding rows
+    sort last. ``cols`` indexed by spec.ordinal. ``live_mask`` narrows
+    liveness beyond the row-count prefix — a fused filter: masked-out
+    rows ride to the back of the same sort pass, so no separate
+    compaction (argsort + per-column gathers) is needed upstream."""
     capacity = cols[0][0].shape[0]
     pad_rank = (jnp.arange(capacity, dtype=jnp.int32) >=
                 num_rows).astype(jnp.int32)
+    if live_mask is not None:
+        pad_rank = jnp.maximum(pad_rank, (~live_mask).astype(jnp.int32))
     # jnp.lexsort: LAST key is primary.
     arrays: List[jax.Array] = []
     for spec in reversed(specs):
