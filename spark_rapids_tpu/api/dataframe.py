@@ -160,6 +160,41 @@ class DataFrame:
 
     mapInPandas = map_in_pandas
 
+    def cache(self) -> "DataFrame":
+        """Persist results as spillable device batches (HBM while it
+        fits, host/disk under pressure — unlike the reference, which
+        routes .cache() through the host-side Spark cache)."""
+        from spark_rapids_tpu.execs.cache import CacheNode
+
+        if isinstance(self._plan, CacheNode):
+            return self
+        return self._df(CacheNode(self._plan))
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        from spark_rapids_tpu.execs.cache import CacheNode
+
+        if isinstance(self._plan, CacheNode):
+            self._plan.holder.unpersist()
+        return self
+
+    def repartition(self, num_partitions: int,
+                    *cols: ColumnOrName) -> "DataFrame":
+        schema = self.schema
+        if cols:
+            ordinals = []
+            for c in cols:
+                e = _as_col(c).resolve(schema)
+                assert isinstance(e, BoundReference), \
+                    "repartition keys must be plain columns"
+                ordinals.append(e.ordinal)
+            part = ("hash", ordinals)
+        else:
+            part = ("round_robin",)
+        return self._df(pn.ShuffleExchangeNode(part, num_partitions,
+                                               self._plan))
+
     # -- actions ----------------------------------------------------------
 
     def _exec(self):

@@ -643,6 +643,13 @@ class _BroadcastRule(NodeRule):
         return exchange.BroadcastExchangeExec(children[0])
 
 
+class _CacheRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.execs.cache import CachedExec
+
+        return CachedExec(meta.node, children[0])
+
+
 class _MapInPandasRule(NodeRule):
     def convert(self, meta, children):
         from spark_rapids_tpu.execs.python_exec import MapInPandasExec
@@ -651,11 +658,13 @@ class _MapInPandasRule(NodeRule):
 
 
 def _register_io_rules():
+    from spark_rapids_tpu.execs.cache import CacheNode
     from spark_rapids_tpu.execs.python_exec import MapInPandasNode
     from spark_rapids_tpu.io.write import WriteFilesNode
 
     _NODE_RULES[WriteFilesNode] = _WriteRule()
     _NODE_RULES[MapInPandasNode] = _MapInPandasRule()
+    _NODE_RULES[CacheNode] = _CacheRule()
     # mirror the reference: pandas execs are off by default because data
     # leaves the accelerator for the Python worker
     # (GpuOverrides.scala:1888-1907)

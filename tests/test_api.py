@@ -171,3 +171,42 @@ def test_api_matches_cpu_engine(df):
                        F.max(col("s")).alias("ms")))
     cpu_df = execute_cpu(pipeline._plan).to_pandas()
     assert_frames_equal(cpu_df, pipeline.collect(), approx_float=1e-9)
+
+
+def test_cache_materializes_once(session, pdf):
+    df = session.create_dataframe(pdf).filter(col("v") > 10).cache()
+    a = df.collect()
+    # mutate nothing; second collect must serve from the cache holder
+    from spark_rapids_tpu.execs.cache import CacheNode
+
+    assert isinstance(df._plan, CacheNode)
+    assert df._plan.holder.is_materialized
+    b = df.group_by("k").count().collect()
+    assert b["count"].astype(int).sum() == len(a)
+    df.unpersist()
+    assert not df._plan.holder.is_materialized
+
+
+def test_cache_survives_spill(session, pdf, tmp_path):
+    from spark_rapids_tpu.memory.catalog import (BufferCatalog,
+                                                 reset_catalog)
+
+    cat = reset_catalog(BufferCatalog(spill_dir=str(tmp_path)))
+    try:
+        df = session.create_dataframe(pdf).cache()
+        a = df.collect()
+        assert cat.synchronous_spill(0) > 0   # evict HBM tier entirely
+        assert cat.spill_host_to_disk(0) > 0  # and the host tier
+        b = df.collect()
+        assert_frames_equal(a, b)
+    finally:
+        reset_catalog(BufferCatalog())
+
+
+def test_repartition_roundtrip(session, pdf):
+    df = session.create_dataframe(pdf)
+    r = df.repartition(4, "k")
+    out = r.collect()
+    assert len(out) == len(pdf)
+    rr = df.repartition(3)
+    assert len(rr.collect()) == len(pdf)
