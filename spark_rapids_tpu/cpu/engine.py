@@ -582,9 +582,11 @@ def _window(node: pn.WindowNode) -> CpuFrame:
             data = np.zeros(n, dtype=typ.np_dtype)
         valid = np.ones(n, dtype=bool)
 
+        order_ordinal = specs[0].ordinal if specs else -1
         for rows in rows_by_part:
             if isinstance(call.fn, AggregateFunction):
-                _window_agg(call, child, rows, data, valid)
+                _window_agg(call, child, rows, data, valid,
+                            order_ordinal)
             elif call.fn == "row_number":
                 for k, r in enumerate(rows):
                     data[r] = k + 1
@@ -606,17 +608,45 @@ def _window(node: pn.WindowNode) -> CpuFrame:
 
 
 def _window_agg(call: pn.WindowCall, child: CpuFrame, rows: List[int],
-                data: np.ndarray, valid: np.ndarray) -> None:
+                data: np.ndarray, valid: np.ndarray,
+                order_ordinal: int = -1) -> None:
     from spark_rapids_tpu.expressions.base import BoundReference
 
     fn = call.fn
     ctx = CpuEvalContext(child.cols, child.num_rows)
     inp = eval_expr(fn.input, ctx) if fn.input is not None else None
     lo, hi = call.frame.lower, call.frame.upper
+    range_keys = None
+    if call.frame.kind == "range":
+        assert order_ordinal >= 0, "range frame requires an order spec"
+        okey = child.cols[order_ordinal]
+        kvalid = okey.valid_mask()
+        range_keys = [(okey.data[r], bool(kvalid[r])) for r in rows]
     for k, r in enumerate(rows):
-        s = 0 if lo is None else max(k + lo, 0)
-        t = len(rows) if hi is None else min(k + hi + 1, len(rows))
-        frame_rows = np.array(rows[s:t], dtype=np.int64)
+        if range_keys is not None:
+            v, is_valid = range_keys[k]
+            # UNBOUNDED sides are positional (include nulls / partition
+            # end); value-bounded sides compare keys, with null rows
+            # matching only other nulls (Spark RangeFrame semantics)
+            def in_frame(j):
+                kv, jv = range_keys[j]
+                if not is_valid:
+                    # null current row: a bounded upper clamps to the
+                    # null run (nulls sort first, so the unbounded-
+                    # preceding prefix up to the run's end IS the run);
+                    # an unbounded upper reaches the partition end
+                    return hi is None or not jv
+                if not jv:  # null row vs valid current: only inside an
+                    return lo is None  # unbounded-preceding region
+                return (lo is None or kv >= v + lo) and \
+                       (hi is None or kv <= v + hi)
+
+            sel = [j for j in range(len(range_keys)) if in_frame(j)]
+            frame_rows = np.array([rows[j] for j in sel], dtype=np.int64)
+        else:
+            s = 0 if lo is None else max(k + lo, 0)
+            t = len(rows) if hi is None else min(k + hi + 1, len(rows))
+            frame_rows = np.array(rows[s:t], dtype=np.int64)
         sub_gid = np.zeros(len(frame_rows), dtype=np.int64)
         if inp is not None:
             sub = CV(inp.dtype, inp.data[frame_rows],

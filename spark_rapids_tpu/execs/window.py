@@ -198,6 +198,48 @@ class WindowExec(TpuExec):
         return self._window_agg(c, s, inp_ord, seg_id, idx, start_of_row,
                                 end_of_row, live)
 
+    def _range_bounds(self, s: ColumnarBatch, seg_id, start_of_row,
+                      end_of_row, frame, live):
+        """Per-row [lo, hi] row-index bounds of a RANGE frame over the
+        single ascending order key. Null keys sort first and are all
+        'equal': a null row's frame is exactly the null run."""
+        okey_ord = self.order_specs[0].ordinal
+        kcol = s.columns[okey_ord]
+        cap = s.capacity
+        key = kcol.data
+        kvalid = (kcol.validity if kcol.validity is not None
+                  else jnp.ones(cap, dtype=bool)) & live
+        if self.pre_types[okey_ord].is_floating:
+            key = sortkeys.canonicalize_floats(key)
+        lo_arr = start_of_row if frame.lower is None else \
+            _range_lower_upper_bound(seg_id, kvalid, key, seg_id,
+                                     key + frame.lower, cap, upper=False)
+        hi_arr = (end_of_row - 1) if frame.upper is None else \
+            _range_lower_upper_bound(seg_id, kvalid, key, seg_id,
+                                     key + frame.upper, cap,
+                                     upper=True) - 1
+        if frame.lower is not None:
+            lo_arr = jnp.maximum(lo_arr, start_of_row)
+        if frame.upper is not None:
+            hi_arr = jnp.minimum(hi_arr, end_of_row - 1)
+        # null-key rows: value offsets are undefined over null, so
+        # BOUNDED sides clamp to the null run (null peers); UNBOUNDED
+        # sides stay positional (partition start / end), like Spark
+        invalid_live = (~kvalid) & live
+        ps_null = jnp.cumsum(invalid_live.astype(jnp.int32))
+        hi_null = jnp.take(ps_null, jnp.clip(end_of_row - 1, 0, cap - 1))
+        lo_null = jnp.where(
+            start_of_row > 0,
+            jnp.take(ps_null, jnp.clip(start_of_row - 1, 0, cap - 1)), 0)
+        nulls_in_seg = hi_null - lo_null
+        # nulls-first: the null run always starts at the segment start,
+        # so the lower bound is start_of_row for null rows either way
+        lo_arr = jnp.where(kvalid, lo_arr, start_of_row)
+        if frame.upper is not None:
+            hi_arr = jnp.where(kvalid, hi_arr,
+                               start_of_row + nulls_in_seg - 1)
+        return lo_arr, hi_arr
+
     def _window_agg(self, c: WindowCall, s: ColumnarBatch, inp_ord: int,
                     seg_id, idx, start_of_row, end_of_row, live) -> Column:
         fn = c.fn
@@ -212,18 +254,24 @@ class WindowExec(TpuExec):
             valid_in = live if inp.validity is None else \
                 (live & inp.validity)
 
+        if frame.kind == "range":
+            lo_arr, hi_arr = self._range_bounds(s, seg_id, start_of_row,
+                                                end_of_row, frame, live)
+        else:
+            lo_arr = start_of_row if frame.lower is None else \
+                jnp.maximum(idx + frame.lower, start_of_row)
+            hi_arr = (end_of_row - 1) if frame.upper is None else \
+                jnp.minimum(idx + frame.upper, end_of_row - 1)
+
         def prefix_range_sum(x):
             """sum over [frame_start, frame_end] rows per row."""
             ps = jnp.cumsum(x)
-            lo = start_of_row if frame.lower is None else \
-                jnp.maximum(idx + frame.lower, start_of_row)
-            hi = (end_of_row - 1) if frame.upper is None else \
-                jnp.minimum(idx + frame.upper, end_of_row - 1)
-            empty = hi < lo  # e.g. rows (-2,-1) at partition start
-            upper = jnp.take(ps, jnp.clip(hi, 0, cap - 1))
-            lower = jnp.where(lo > 0,
-                              jnp.take(ps, jnp.clip(lo - 1, 0, cap - 1)),
-                              jnp.zeros((), ps.dtype))
+            empty = hi_arr < lo_arr  # e.g. rows (-2,-1) at segment start
+            upper = jnp.take(ps, jnp.clip(hi_arr, 0, cap - 1))
+            lower = jnp.where(
+                lo_arr > 0,
+                jnp.take(ps, jnp.clip(lo_arr - 1, 0, cap - 1)),
+                jnp.zeros((), ps.dtype))
             return jnp.where(empty, jnp.zeros((), ps.dtype), upper - lower)
 
         if isinstance(fn, (Sum, Average, Count)):
@@ -242,6 +290,9 @@ class WindowExec(TpuExec):
 
         if isinstance(fn, (Min, Max)):
             is_min = isinstance(fn, Min)
+            if frame.kind == "range":
+                raise NotImplementedError(
+                    "range-framed min/max windows fall back to CPU")
             if frame.lower is None and frame.upper == 0:
                 data, cnt = _running_minmax(vals, valid_in, seg_id, is_min)
                 return Column(fn.dtype, data.astype(fn.dtype.kernel_dtype),
@@ -262,6 +313,36 @@ class WindowExec(TpuExec):
             raise NotImplementedError(
                 "bounded min/max window frames fall back to CPU")
         raise NotImplementedError(f"window aggregate {type(fn).__name__}")
+
+
+def _range_lower_upper_bound(seg_id, kvalid, key, tseg, tkey, cap: int,
+                             upper: bool):
+    """Vectorized binary search over rows ordered by (segment, nulls
+    first, key): per row, the first index whose tuple is >= (>) the
+    target. O(log n) unrolled steps of full-width gathers — range frames
+    trade bandwidth for exactness (cuDF's range windows do a comparable
+    per-row bounds search)."""
+    import math
+
+    lo = jnp.zeros(cap, dtype=jnp.int32)
+    hi = jnp.full(cap, cap, dtype=jnp.int32)
+    for _ in range(max(int(math.ceil(math.log2(max(cap, 2)))), 1) + 1):
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, cap - 1)
+        sm = jnp.take(seg_id, midc)
+        vm = jnp.take(kvalid, midc)
+        km = jnp.take(key, midc)
+        # tuple (sm, vm, km) vs (tseg, True, tkey); invalid (null) rows
+        # sort first within a segment
+        if upper:
+            key_le = km <= tkey
+        else:
+            key_le = km < tkey
+        less = (sm < tseg) | ((sm == tseg) & (~vm | (vm & key_le)))
+        less = less & (mid < hi)  # converged lanes stay put
+        lo = jnp.where(less, mid + 1, lo)
+        hi = jnp.where(less, hi, mid)
+    return lo
 
 
 def _sentinel(dtype, is_min: bool):

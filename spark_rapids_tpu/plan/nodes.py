@@ -362,13 +362,22 @@ class JoinNode(PlanNode):
 
 @dataclasses.dataclass
 class WindowFrame:
-    """Row-based frame. Bounds are offsets relative to the current row;
-    None = unbounded. Spark default for aggregates with an order spec is
-    (None, 0) = unboundedPreceding..currentRow
-    (GpuWindowExpression.scala:208-263 frame validation)."""
+    """Window frame. ``kind`` is "rows" (bounds are row offsets) or
+    "range" (bounds are VALUE deltas against the single order key —
+    RANGE BETWEEN x PRECEDING AND y FOLLOWING = keys in
+    [k - x, k + y]); None = unbounded. Spark default for aggregates with
+    an order spec is range (None, 0) but rows (None, 0) is equivalent
+    for our run-aggregates, so "rows" stays the default here
+    (GpuWindowExpression.scala:208-263 frame validation; the reference
+    limits range frames to timestamp order keys — ours allow any
+    numeric/date/timestamp ascending key)."""
 
     lower: Optional[int] = None
     upper: Optional[int] = 0
+    kind: str = "rows"
+
+    def __post_init__(self):
+        assert self.kind in ("rows", "range"), self.kind
 
 
 @dataclasses.dataclass

@@ -585,7 +585,9 @@ class _WindowRule(NodeRule):
                     meta.will_not_work(
                         f"window aggregate {type(c.fn).__name__} "
                         "not implemented")
-                if isinstance(c.fn, (aggfn.Min, aggfn.Max)) and \
+                if c.frame.kind == "range":
+                    self._tag_range_frame(c, node, meta)
+                elif isinstance(c.fn, (aggfn.Min, aggfn.Max)) and \
                         not (c.frame.lower is None and
                              c.frame.upper in (0, None)):
                     meta.will_not_work(
@@ -613,6 +615,28 @@ class _WindowRule(NodeRule):
                             f"coerce to {in_t} column")
             elif c.fn not in ("row_number", "rank", "dense_rank"):
                 meta.will_not_work(f"window function {c.fn} unknown")
+
+    @staticmethod
+    def _tag_range_frame(c, node: pn.WindowNode, meta: NodeMeta):
+        """Device range frames: single ascending order key of an
+        orderable numeric/date/timestamp type, sum/count/avg only (the
+        reference limits range frames to timestamp keys,
+        GpuWindowExpression.scala:208-263 — ours are wider but min/max
+        still fall back)."""
+        if isinstance(c.fn, (aggfn.Min, aggfn.Max)):
+            meta.will_not_work("range-framed min/max windows fall back")
+            return
+        if len(node.order_specs) != 1:
+            meta.will_not_work(
+                "range frames need exactly one order key")
+            return
+        spec = node.order_specs[0]
+        if not spec.ascending:
+            meta.will_not_work("descending range frames fall back")
+        kt = node.children[0].output_schema().types[spec.ordinal]
+        if not (kt.is_numeric or kt in (dt.DATE, dt.TIMESTAMP)):
+            meta.will_not_work(
+                f"range frame over {kt} order key falls back")
 
     def convert(self, meta, children):
         node: pn.WindowNode = meta.node

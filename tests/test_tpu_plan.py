@@ -298,3 +298,44 @@ def test_window_lag_bad_default_falls_back():
                        [pn.WindowCall(("lag", ref(2, dt.FLOAT64)), "lg",
                                       default=7)], plan)
     assert_cpu_and_tpu_equal(ok)
+
+
+def test_window_range_frames():
+    """RANGE frames: value-based bounds over the order key, nulls first
+    and all-equal, device vs CPU oracle."""
+    rng = np.random.default_rng(21)
+    n = 400
+    plan = scan({"p": rng.integers(0, 6, n).astype(np.int64),
+                 "t": rng.integers(0, 80, n).astype(np.int64),
+                 "v": rng.normal(size=n)},
+                {"t": rng.random(n) > 0.08})
+    calls = [
+        pn.WindowCall(Sum(ref(2, dt.FLOAT64)), "rsum",
+                      frame=pn.WindowFrame(-10, 0, kind="range")),
+        pn.WindowCall(Count(ref(2, dt.FLOAT64)), "rcnt",
+                      frame=pn.WindowFrame(-5, 5, kind="range")),
+        pn.WindowCall(Average(ref(2, dt.FLOAT64)), "ravg",
+                      frame=pn.WindowFrame(None, 0, kind="range")),
+        pn.WindowCall(Sum(ref(2, dt.FLOAT64)), "rfut",
+                      frame=pn.WindowFrame(0, None, kind="range")),
+    ]
+    wnode = pn.WindowNode([0], [SortKeySpec.spark_default(1)], calls,
+                          plan)
+    assert_cpu_and_tpu_equal(wnode, approx_float=1e-9)
+
+
+def test_window_range_frame_minmax_falls_back():
+    rng = np.random.default_rng(22)
+    n = 60
+    plan = scan({"p": rng.integers(0, 3, n).astype(np.int64),
+                 "t": rng.integers(0, 30, n).astype(np.int64),
+                 "v": rng.normal(size=n)})
+    calls = [pn.WindowCall(Min(ref(2, dt.FLOAT64)), "rmin",
+                           frame=pn.WindowFrame(-5, 5, kind="range"))]
+    wnode = pn.WindowNode([0], [SortKeySpec.spark_default(1)], calls,
+                          plan)
+    from spark_rapids_tpu.execs.basic import CpuFallbackExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    ex = apply_overrides(wnode, RapidsConf())
+    assert isinstance(ex, CpuFallbackExec)
+    assert_cpu_and_tpu_equal(wnode, require_on_tpu=False)
