@@ -100,6 +100,7 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
     (they sort last with the padding and never reach a segment)."""
     capacity = cols[0][0].shape[0]
     live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    prefix_rows = num_rows  # PRE-mask count: the sort pads positionally
     if live_mask is not None:
         live = live & live_mask
         num_rows = jnp.sum(live).astype(jnp.int32)
@@ -107,7 +108,7 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
     # 1. sort by keys (ascending, nulls first — any consistent order works)
     specs = [SortKeySpec(o, True, True) for o in key_ordinals]
     order = sortkeys.lexsort_indices(list(cols), list(dtypes), specs,
-                                     num_rows, live_mask=live_mask)
+                                     prefix_rows, live_mask=live_mask)
     sorted_cols = [(jnp.take(d, order),
                     None if v is None else jnp.take(v, order))
                    for d, v in cols]

@@ -338,3 +338,37 @@ def test_reduce_first_last_empty_batch_is_null():
     assert fm is not None and not fm[0]
     assert lm is not None and not lm[0]
     assert out.columns[2].to_numpy(1)[0][0] == 0
+
+
+def test_groupby_live_mask_fused_filter():
+    """live_mask fuses a filter into the groupby sort; results must equal
+    filter-then-groupby. Regression: kept rows located beyond the
+    post-filter count must not be treated as padding."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops import groupby as gb
+
+    rng = np.random.default_rng(0)
+    n = 4096
+    keys = rng.integers(0, 37, n).astype(np.int64)
+    vals = rng.random(n)
+    # keep mask biased so many kept rows sit in the BACK half
+    keep = (np.arange(n) > n // 2) | (rng.random(n) < 0.1)
+    cols = [(jnp.asarray(keys), None), (jnp.asarray(vals), None)]
+    (kd, kv), (ad, av), ng = gb._groupby(
+        cols, (dt.INT64, dt.FLOAT64), (0,),
+        (gb.AggSpec("sum", 1), gb.AggSpec("count_star")),
+        jnp.int32(n), live_mask=jnp.asarray(keep))
+    ng = int(ng)
+    got_keys = np.asarray(kd[0])[:ng]
+    got_sums = np.asarray(ad[0])[:ng]
+    got_cnts = np.asarray(ad[1])[:ng]
+    import pandas as pd
+
+    expect = (pd.DataFrame({"k": keys[keep], "v": vals[keep]})
+              .groupby("k").agg(s=("v", "sum"), c=("v", "size")))
+    assert ng == len(expect)
+    order = np.argsort(got_keys)
+    np.testing.assert_array_equal(got_keys[order], expect.index.values)
+    np.testing.assert_allclose(got_sums[order], expect["s"], rtol=1e-9)
+    np.testing.assert_array_equal(got_cnts[order], expect["c"])
