@@ -30,6 +30,23 @@ if not os.environ.get("SPARK_RAPIDS_TPU_NO_X64"):
 
     jax.config.update("jax_enable_x64", True)
 
+# Persistent executable cache: the fused relational programs are LARGE
+# (sorts + scans over x64-rewritten graphs) and tunnel-remote compiles
+# run minutes; caching makes every process after the first start hot.
+if not os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
+    import jax
+
+    _cache_dir = os.environ.get(
+        "SPARK_RAPIDS_TPU_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(_cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # older jax without the knobs
+        pass
+
 __version__ = "0.1.0"
 
 from spark_rapids_tpu.config import RapidsConf  # noqa: E402,F401

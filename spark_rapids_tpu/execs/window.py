@@ -30,8 +30,8 @@ from spark_rapids_tpu.columnar.column import Column
 from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.execs.batching import RequireSingleBatch
 from spark_rapids_tpu.expressions.aggregates import (AggregateFunction,
-                                                     Average, Count, Max,
-                                                     Min, Sum)
+                                                     Average, Count, First,
+                                                     Last, Max, Min, Sum)
 from spark_rapids_tpu.expressions.base import BoundReference, Expression
 from spark_rapids_tpu.expressions.compiler import CompiledProjection
 from spark_rapids_tpu.ops import sortkeys
@@ -273,6 +273,18 @@ class WindowExec(TpuExec):
                 jnp.take(ps, jnp.clip(lo_arr - 1, 0, cap - 1)),
                 jnp.zeros((), ps.dtype))
             return jnp.where(empty, jnp.zeros((), ps.dtype), upper - lower)
+
+        if isinstance(fn, (First, Last)):
+            # ignoreNulls=False: the boundary row's value as-is (its own
+            # validity), NULL when the frame is empty
+            pos = lo_arr if isinstance(fn, First) else hi_arr
+            posc = jnp.clip(pos, 0, cap - 1)
+            inp = s.columns[inp_ord]
+            data = jnp.take(inp.data, posc)
+            src_valid = jnp.take(inp.validity, posc) \
+                if inp.validity is not None else jnp.ones(cap, dtype=bool)
+            ok = (hi_arr >= lo_arr) & src_valid
+            return inp._like(data, ok)
 
         if isinstance(fn, (Sum, Average, Count)):
             acc_t = jnp.int64 if fn.dtype.is_integral else jnp.float64

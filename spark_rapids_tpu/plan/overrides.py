@@ -581,10 +581,15 @@ class _WindowRule(NodeRule):
             if isinstance(c.fn, aggfn.AggregateFunction):
                 if not isinstance(c.fn, (aggfn.Sum, aggfn.Count,
                                          aggfn.Average, aggfn.Min,
-                                         aggfn.Max)):
+                                         aggfn.Max, aggfn.First,
+                                         aggfn.Last)):
                     meta.will_not_work(
                         f"window aggregate {type(c.fn).__name__} "
                         "not implemented")
+                if isinstance(c.fn, (aggfn.First, aggfn.Last)) and \
+                        c.fn.ignore_nulls:
+                    meta.will_not_work(
+                        "first/last(ignoreNulls) windows fall back")
                 if c.frame.kind == "range":
                     self._tag_range_frame(c, node, meta)
                 elif isinstance(c.fn, (aggfn.Min, aggfn.Max)) and \

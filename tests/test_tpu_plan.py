@@ -194,20 +194,47 @@ def test_range_node():
 
 
 def test_fallback_unsupported_agg():
-    """First/Last windows etc. that the TPU doesn't do fall back with a
-    reason, and results still match (assertDidFallBack analogue,
+    """first(ignoreNulls) windows etc. that the TPU doesn't do fall back
+    with a reason, and results still match (assertDidFallBack analogue,
     Plugin.scala:155-231)."""
     from spark_rapids_tpu.expressions.aggregates import First
 
     data, validity = random_table(200, seed=8)
     plan = scan(data, validity)
-    calls = [pn.WindowCall(First(ref(1, dt.FLOAT64)), "f")]
+    calls = [pn.WindowCall(First(ref(1, dt.FLOAT64), ignore_nulls=True),
+                           "f")]
     wplan = pn.WindowNode([0], [SortKeySpec.spark_default(2)], calls, plan)
     from spark_rapids_tpu.plan.overrides import explain
 
     text = explain(wplan)
-    assert "First" in text and "!" in text
+    assert "ignoreNulls" in text and "!" in text
     assert_cpu_and_tpu_equal(wplan, require_on_tpu=False)
+
+
+def test_window_first_last_on_device():
+    """first/last (ignoreNulls=False) window aggregates run on TPU for
+    row and range frames."""
+    from spark_rapids_tpu.expressions.aggregates import First, Last
+
+    rng = np.random.default_rng(23)
+    n = 300
+    plan = scan({"p": rng.integers(0, 5, n).astype(np.int64),
+                 "o": rng.integers(0, 50, n).astype(np.int64),
+                 "v": rng.normal(size=n)},
+                {"v": rng.random(n) > 0.15})
+    calls = [
+        pn.WindowCall(First(ref(2, dt.FLOAT64)), "f_run",
+                      frame=pn.WindowFrame(None, 0)),
+        pn.WindowCall(Last(ref(2, dt.FLOAT64)), "l_run",
+                      frame=pn.WindowFrame(None, 0)),
+        pn.WindowCall(First(ref(2, dt.FLOAT64)), "f_bounded",
+                      frame=pn.WindowFrame(-3, -1)),
+        pn.WindowCall(Last(ref(2, dt.FLOAT64)), "l_range",
+                      frame=pn.WindowFrame(-4, 4, kind="range")),
+    ]
+    wnode = pn.WindowNode([0], [SortKeySpec.spark_default(1)], calls,
+                          plan)
+    assert_cpu_and_tpu_equal(wnode, approx_float=1e-12)
 
 
 def test_fallback_mixed_tree_keeps_tpu_children():
