@@ -264,6 +264,24 @@ class GroupedData:
 
         return self.agg(F.count("*").alias("count"))
 
+    def apply_in_pandas(self, fn, schema: Schema) -> DataFrame:
+        """groupBy(keys).applyInPandas: ``fn`` maps each group's pandas
+        frame to a frame with ``schema``."""
+        from spark_rapids_tpu.execs.python_exec import \
+            GroupedMapInPandasNode
+
+        dfschema = self.df.schema
+        ordinals = []
+        for k in self.keys:
+            e = k.resolve(dfschema)
+            assert isinstance(e, BoundReference), \
+                "applyInPandas keys must be plain columns"
+            ordinals.append(e.ordinal)
+        return self.df._df(GroupedMapInPandasNode(
+            ordinals, fn, schema, self.df._plan))
+
+    applyInPandas = apply_in_pandas
+
     def _shortcut(self, fn_name: str, *cols: str) -> DataFrame:
         from spark_rapids_tpu.api import functions as F
 

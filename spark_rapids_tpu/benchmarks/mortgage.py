@@ -1,0 +1,94 @@
+"""Mortgage-like ETL benchmark (integration_tests/.../mortgage/
+MortgageSpark.scala analogue): the reference's second benchmark family —
+a join-enrich-aggregate ETL over loan performance + acquisition tables.
+
+Shapes kept faithful: a large "performance" fact table (loan_id,
+monthly_reporting_period, current_actual_upb, delinquency status) joined
+to an "acquisition" dimension (loan_id, orig_interest_rate, credit
+score band), filtered, then delinquency aggregates per band."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Literal)
+from spark_rapids_tpu.expressions.cast import Cast
+from spark_rapids_tpu.expressions.conditional import If
+from spark_rapids_tpu.io import ParquetSource
+from spark_rapids_tpu.plan import nodes as pn
+
+BANDS = np.array(["<600", "600-660", "660-720", "720-780", ">780"],
+                 dtype=object)
+
+
+def ref(i, t):
+    return BoundReference(i, t)
+
+
+def gen_tables(data_dir: str, sf: float, seed: int = 31,
+               files_per_table: int = 4) -> None:
+    rng = np.random.default_rng(seed)
+    n_loans = max(int(100_000 * sf), 50)
+    n_perf = n_loans * 12  # ~a year of monthly rows per loan
+    acq = pa.table({
+        "loan_id": np.arange(1, n_loans + 1, dtype=np.int64),
+        "orig_interest_rate": np.round(rng.random(n_loans) * 5 + 2, 3),
+        "credit_band": BANDS[rng.integers(0, len(BANDS), n_loans)],
+    })
+    perf = pa.table({
+        "loan_id": rng.integers(1, n_loans + 1, n_perf).astype(np.int64),
+        "period": rng.integers(0, 12, n_perf).astype(np.int32),
+        "current_actual_upb": np.round(
+            rng.random(n_perf) * 400_000 + 10_000, 2),
+        "delinquency_status": rng.choice(
+            np.arange(0, 6, dtype=np.int32), n_perf,
+            p=[0.82, 0.08, 0.04, 0.03, 0.02, 0.01]),
+    })
+    for name, table in (("acquisition", acq), ("performance", perf)):
+        tdir = os.path.join(data_dir, name)
+        os.makedirs(tdir, exist_ok=True)
+        per = -(-table.num_rows // files_per_table)
+        for i in range(files_per_table):
+            chunk = table.slice(i * per, per)
+            if chunk.num_rows:
+                pq.write_table(chunk, os.path.join(
+                    tdir, f"part-{i:03d}.parquet"))
+
+
+def etl(data_dir: str) -> pn.PlanNode:
+    """delinquency summary per credit band:
+    join perf->acq, filter upb, flag 90+-day delinquency, aggregate."""
+    perf = pn.ScanNode(ParquetSource(
+        os.path.join(data_dir, "performance")))
+    acq = pn.ScanNode(ParquetSource(
+        os.path.join(data_dir, "acquisition")))
+    perf_f = pn.FilterNode(
+        P.GreaterThan(ref(2, dt.FLOAT64), Literal(50_000.0)), perf)
+    # perf ⋈ acq on loan_id -> [loan_id, period, upb, delinq,
+    #                           loan_id2, rate, band]
+    joined = pn.JoinNode("inner", perf_f, acq, [0], [0])
+    severe = If(P.GreaterThanOrEqual(ref(3, dt.INT32),
+                                     Literal(3, dt.INT32)),
+                Literal(1, dt.INT32), Literal(0, dt.INT32))
+    proj = pn.ProjectNode(
+        [Alias(ref(6, dt.STRING), "band"),
+         Alias(ref(2, dt.FLOAT64), "upb"),
+         Alias(Cast(severe, dt.INT64), "severe"),
+         Alias(ref(5, dt.FLOAT64), "rate")], joined)
+    agg = pn.AggregateNode(
+        [ref(0, dt.STRING)],
+        [pn.AggCall(A.Count(), "loans"),
+         pn.AggCall(A.Sum(ref(2, dt.INT64)), "severe_cnt"),
+         pn.AggCall(A.Average(ref(1, dt.FLOAT64)), "avg_upb"),
+         pn.AggCall(A.Average(ref(3, dt.FLOAT64)), "avg_rate")],
+        proj, grouping_names=["band"])
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    return pn.SortNode([SortKeySpec.spark_default(0)], agg)

@@ -14,8 +14,11 @@ import os
 import time
 from typing import Optional
 
-from spark_rapids_tpu.benchmarks import datagen, tpch
+from spark_rapids_tpu.benchmarks import datagen, mortgage, tpch
 from spark_rapids_tpu.config import RapidsConf
+
+ALL_BENCHMARKS = dict(tpch.QUERIES)
+ALL_BENCHMARKS["mortgage_etl"] = mortgage.etl
 
 
 class BenchmarkRunner:
@@ -25,11 +28,18 @@ class BenchmarkRunner:
         self.sf = sf
         self.conf = conf or RapidsConf()
 
-    def ensure_data(self) -> None:
-        marker = os.path.join(self.data_dir, f".sf-{self.sf}")
+    def ensure_data(self, benchmark: str = "tpch") -> None:
+        family = "mortgage" if benchmark.startswith("mortgage") else \
+            "tpch"
+        marker = os.path.join(self.data_dir,
+                              f".{family}-sf-{self.sf}")
         if os.path.exists(marker):
             return
-        datagen.write_tables(self.data_dir, self.sf)
+        os.makedirs(self.data_dir, exist_ok=True)
+        if family == "mortgage":
+            mortgage.gen_tables(self.data_dir, self.sf)
+        else:
+            datagen.write_tables(self.data_dir, self.sf)
         with open(marker, "w") as f:
             f.write("ok")
 
@@ -53,8 +63,8 @@ class BenchmarkRunner:
         from spark_rapids_tpu.execs.base import collect
         from spark_rapids_tpu.plan.overrides import apply_overrides
 
-        self.ensure_data()
-        plan_fn = tpch.QUERIES[benchmark]
+        self.ensure_data(benchmark)
+        plan_fn = ALL_BENCHMARKS[benchmark]
         result: dict = {
             "benchmark": benchmark,
             "scale_factor": self.sf,
@@ -87,7 +97,7 @@ class BenchmarkRunner:
         """BenchUtils.compareResults: run the CPU oracle and diff."""
         from spark_rapids_tpu.cpu.engine import execute_cpu
 
-        plan = tpch.QUERIES[benchmark](self.data_dir)
+        plan = ALL_BENCHMARKS[benchmark](self.data_dir)
         t0 = time.perf_counter()
         cpu_df = execute_cpu(plan).to_pandas()
         cpu_time = time.perf_counter() - t0
@@ -112,7 +122,7 @@ def _frames_match(cpu_df, tpu_df) -> "tuple[bool, str]":
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--benchmark", required=True,
-                   choices=sorted(tpch.QUERIES))
+                   choices=sorted(ALL_BENCHMARKS))
     p.add_argument("--sf", type=float, default=0.01)
     p.add_argument("--iterations", type=int, default=3)
     p.add_argument("--warmup", type=int, default=1)

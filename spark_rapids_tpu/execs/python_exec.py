@@ -109,6 +109,98 @@ class MapInPandasExec(TpuExec):
         return timed(self, it())
 
 
+class GroupedMapInPandasNode(PlanNode):
+    """groupBy(keys).applyInPandas analogue (GpuFlatMapGroupsInPandasExec,
+    §2.12): ``fn`` maps each group's pandas DataFrame to a DataFrame with
+    ``schema``. Null keys form their own group (Spark semantics)."""
+
+    def __init__(self, grouping_ordinals, fn: Callable, schema: Schema,
+                 child: PlanNode):
+        super().__init__([child])
+        assert grouping_ordinals, "grouped map requires grouping keys"
+        self.grouping_ordinals = list(grouping_ordinals)
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return (f"GroupedMapInPandas[{getattr(self.fn, '__name__', 'fn')}"
+                f", keys={self.grouping_ordinals}]")
+
+
+def _apply_grouped(pdf, key_names, fn, out_schema: Schema):
+    import pandas as pd
+
+    outs = []
+    for _, g in pdf.groupby(key_names, dropna=False, sort=False):
+        r = fn(g.reset_index(drop=True))
+        if len(r):
+            outs.append(r)
+    if outs:
+        return pd.concat(outs, ignore_index=True)
+    return pd.DataFrame({n: pd.Series([], dtype=object)
+                         for n in out_schema.names})
+
+
+class GroupedMapInPandasExec(TpuExec):
+    """Consumes a hash-exchanged child (the planner co-partitions by the
+    grouping keys, so each group lives wholly in one partition)."""
+
+    def __init__(self, node: GroupedMapInPandasNode, child: TpuExec):
+        super().__init__([child], node.output_schema())
+        self.node = node
+
+    @property
+    def children_coalesce_goal(self):
+        from spark_rapids_tpu.execs.batching import RequireSingleBatch
+
+        return [RequireSingleBatch]
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+
+        child_schema = self.node.children[0].output_schema()
+        out_schema = self.schema
+        key_names = [child_schema.names[o]
+                     for o in self.node.grouping_ordinals]
+
+        def it():
+            b = drain_to_single_batch(
+                self.children[0].execute(partition), child_schema)
+            if b.realized_num_rows() == 0:
+                yield ColumnarBatch.empty(out_schema)
+                return
+            PythonWorkerSemaphore.acquire()
+            try:
+                with TraceRange("GroupedMapInPandasExec.python"):
+                    pdf = b.to_pandas(child_schema)
+                    out = _apply_grouped(pdf, key_names, self.node.fn,
+                                         out_schema)
+                    data, validity = _pandas_to_host(out, out_schema)
+            finally:
+                PythonWorkerSemaphore.release()
+            yield interop.host_to_batch(data, validity, out_schema)
+        return timed(self, it())
+
+
+def execute_grouped_map_cpu(node: GroupedMapInPandasNode):
+    from spark_rapids_tpu.cpu.engine import CpuFrame, execute_cpu
+    from spark_rapids_tpu.cpu.evaluator import CV
+
+    child = execute_cpu(node.children[0])
+    schema = node.output_schema()
+    child_schema = node.children[0].output_schema()
+    key_names = [child_schema.names[o] for o in node.grouping_ordinals]
+    out = _apply_grouped(child.to_pandas(), key_names, node.fn, schema)
+    data, validity = _pandas_to_host(out, schema)
+    n = len(next(iter(data.values()))) if len(schema) else 0
+    cols = [CV(t, data[nm], validity[nm])
+            for nm, t in zip(schema.names, schema.types)]
+    return CpuFrame(schema, cols, n)
+
+
 def execute_map_in_pandas_cpu(node: MapInPandasNode):
     """CPU-engine implementation (oracle): same function applied to the
     whole child frame."""

@@ -686,13 +686,31 @@ class _MapInPandasRule(NodeRule):
         return MapInPandasExec(meta.node, children[0])
 
 
+class _GroupedMapRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.execs.python_exec import \
+            GroupedMapInPandasExec
+
+        node = meta.node
+        child = children[0]
+        if child.num_partitions > 1:
+            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            child = _adaptive_read(exchange.ShuffleExchangeExec(
+                ("hash", list(node.grouping_ordinals)), parts, child),
+                meta.conf)
+        return GroupedMapInPandasExec(node, child)
+
+
 def _register_io_rules():
     from spark_rapids_tpu.execs.cache import CacheNode
     from spark_rapids_tpu.execs.python_exec import MapInPandasNode
     from spark_rapids_tpu.io.write import WriteFilesNode
 
+    from spark_rapids_tpu.execs.python_exec import GroupedMapInPandasNode
+
     _NODE_RULES[WriteFilesNode] = _WriteRule()
     _NODE_RULES[MapInPandasNode] = _MapInPandasRule()
+    _NODE_RULES[GroupedMapInPandasNode] = _GroupedMapRule()
     _NODE_RULES[CacheNode] = _CacheRule()
     # mirror the reference: pandas execs are off by default because data
     # leaves the accelerator for the Python worker
@@ -701,6 +719,11 @@ def _register_io_rules():
         "exec", "MapInPandasNode",
         "Run mapInPandas around the TPU pipeline (device->pandas->device "
         "round trip per batch)", default_enabled=False)
+    cfg.register_op_flag(
+        "exec", "GroupedMapInPandasNode",
+        "Run groupBy().applyInPandas around the TPU pipeline "
+        "(co-partitioned device->pandas->device round trip)",
+        default_enabled=False)
 
 
 _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {

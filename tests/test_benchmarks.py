@@ -51,3 +51,26 @@ def test_runner_json_output(data_dir, capsys):
     assert result["compare"]["matches_cpu"], result["compare"]["detail"]
     assert "query_plan" in result and "metrics" in result
     assert result["env"]["device_count"] >= 1
+
+
+def test_mortgage_etl_matches_oracle(tmp_path):
+    from spark_rapids_tpu.benchmarks import mortgage
+
+    mortgage.gen_tables(str(tmp_path), sf=0.005)
+    plan = mortgage.etl(str(tmp_path))
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6,
+                             sort=False)
+
+
+def test_mortgage_through_runner(tmp_path, capsys):
+    import json as _json
+
+    from spark_rapids_tpu.benchmarks import runner as runner_mod
+
+    runner_mod.main(["--benchmark", "mortgage_etl", "--sf", "0.003",
+                     "--iterations", "1", "--warmup", "0", "--compare",
+                     "--data-dir", str(tmp_path / "m")])
+    result = _json.loads(capsys.readouterr().out)
+    assert result["compare"]["matches_cpu"], result["compare"]["detail"]
+    assert result["rows_returned"] >= 1

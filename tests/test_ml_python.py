@@ -101,3 +101,65 @@ def test_map_in_pandas_null_handling():
     assert cpu_df["b"].isna().any()
     exec_ = apply_overrides(plan, conf)
     assert_frames_equal(cpu_df, collect(exec_))
+
+
+def test_grouped_map_in_pandas_matches_oracle():
+    from spark_rapids_tpu.execs.python_exec import GroupedMapInPandasNode
+
+    def summarize(g: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({
+            "a": [int(g["a"].iloc[0])],
+            "total": [float(pd.to_numeric(g["b"],
+                                          errors="coerce").sum())],
+            "n": [len(g)],
+        })
+
+    schema = Schema(["a", "total", "n"],
+                    [dt.INT64, dt.FLOAT64, dt.INT64])
+    base = scan(400)
+    # group by a % 10 -> project first so keys are plain columns
+    from spark_rapids_tpu.expressions import arithmetic as ar
+    from spark_rapids_tpu.expressions.base import Alias, Literal
+
+    proj = pn.ProjectNode(
+        [Alias(ar.Remainder(BoundReference(0, dt.INT64),
+                            Literal(10, dt.INT64)), "a"),
+         Alias(BoundReference(1, dt.FLOAT64), "b")], base)
+    plan = GroupedMapInPandasNode([0], summarize, schema, proj)
+    conf = RapidsConf(
+        {"rapids.tpu.sql.exec.GroupedMapInPandasNode": True})
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, conf)
+    assert type(exec_).__name__ == "GroupedMapInPandasExec"
+    assert_frames_equal(cpu_df, collect(exec_), approx_float=1e-9)
+
+
+def test_grouped_map_through_api():
+    import pandas as _pd
+
+    from spark_rapids_tpu.api import Session
+
+    s = Session({"rapids.tpu.sql.exec.GroupedMapInPandasNode": True})
+    df = s.create_dataframe(_pd.DataFrame(
+        {"k": [1, 1, 2, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]}))
+
+    def center(g: _pd.DataFrame) -> _pd.DataFrame:
+        v = g["v"].astype(float)
+        return _pd.DataFrame({"k": g["k"].astype(int),
+                              "centered": v - v.mean()})
+
+    schema = Schema(["k", "centered"], [dt.INT64, dt.FLOAT64])
+    out = df.group_by("k").apply_in_pandas(center, schema).collect()
+    assert len(out) == 6
+    got = out.groupby(out["k"].astype(int))["centered"].apply(
+        lambda x: round(float(x.astype(float).sum()), 9))
+    assert all(v == 0 for v in got)
+
+
+def test_grouped_map_disabled_by_default():
+    from spark_rapids_tpu.execs.python_exec import GroupedMapInPandasNode
+
+    plan = GroupedMapInPandasNode(
+        [0], lambda g: g[["a"]], Schema(["a"], [dt.INT64]), scan(50))
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert type(exec_).__name__ == "CpuFallbackExec"
