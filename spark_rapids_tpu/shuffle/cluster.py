@@ -127,5 +127,31 @@ class LocalCluster:
         with self._lock:
             self._map_outputs.pop(shuffle_id, None)
 
+    # -- failure recovery (SURVEY §5.3: Spark lineage/task-retry model) --
+
+    def lose_executor(self, executor_index: int) -> None:
+        """Simulate executor loss: its cached shuffle blocks are gone
+        (the catalog empties) but the tracker still references it until
+        invalidation — exactly the state that produces fetch failures."""
+        ex = self.executors[executor_index]
+        with ex.shuffle_catalog._lock:
+            shuffles = {b.shuffle_id
+                        for b in ex.shuffle_catalog._metas}
+        for sid in shuffles:
+            ex.shuffle_catalog.unregister_shuffle(sid)
+
+    def invalidate_map_output(self, shuffle_id: int,
+                              executor_id: str) -> List[int]:
+        """Drop tracker entries pointing at a failed executor; returns
+        the map ids that must re-run (Spark's fetch-failure handling
+        unregisters the executor's outputs and reschedules those tasks)."""
+        with self._lock:
+            maps = self._map_outputs.get(shuffle_id, {})
+            lost = [mid for mid, (eid, _) in maps.items()
+                    if eid == executor_id]
+            for mid in lost:
+                del maps[mid]
+        return lost
+
     def shutdown(self):
         self.transport.shutdown()

@@ -217,3 +217,31 @@ def test_concurrent_reduce_tasks(cluster):
             for v in range(m * 1000 + p * 100, m * 1000 + p * 100 + 50)
             if v % 7 != 3)
         assert results[p] == expect
+
+
+def test_stage_retry_after_executor_loss(cluster):
+    """The reference's recovery model is Spark lineage/task-retry
+    (SURVEY §5.3): a lost executor produces fetch failures; invalidating
+    its map outputs and re-running those tasks elsewhere restores reads."""
+    for map_id, ex in enumerate([0, 1, 2]):
+        cluster.write_map_output(7, map_id, ex,
+                                 {0: make_batch(map_id * 100, 10)})
+    # executor 1 dies: blocks gone, tracker stale
+    cluster.lose_executor(1)
+    with pytest.raises(ShuffleFetchFailedError) as e:
+        list(cluster.read_partition(7, 0, reader_executor_index=0))
+    failed_exec = e.value.executor_id
+    assert failed_exec == "exec-1"
+    # driver-side recovery: invalidate + re-run the lost map task on a
+    # surviving executor (lineage recomputation)
+    lost = cluster.invalidate_map_output(7, failed_exec)
+    assert lost == [1]
+    for map_id in lost:
+        cluster.write_map_output(7, map_id, 2,
+                                 {0: make_batch(map_id * 100, 10)})
+    got = []
+    for b in cluster.read_partition(7, 0, reader_executor_index=0):
+        got.extend(v for v in batch_values(b) if v is not None)
+    expect = [v for m in range(3) for v in range(m * 100, m * 100 + 10)
+              if v % 7 != 3]
+    assert sorted(got) == sorted(expect)
