@@ -118,12 +118,17 @@ class HashAggregateExec(TpuExec):
 
     def _agg_batch(self, batch: ColumnarBatch, specs: List[AggSpec],
                    types: List[dt.DType]) -> ColumnarBatch:
+        from spark_rapids_tpu.memory.oom import with_oom_retry
+
         nkeys = len(self.grouping)
         if nkeys == 0:
-            out, _ = reduce_aggregate(batch, specs, types)
-            return out
-        out, _ = groupby_aggregate(batch, list(range(nkeys)), specs, types)
-        return out
+            return with_oom_retry(
+                lambda: reduce_aggregate(batch, specs, types))[0]
+        # device OOM spills the catalog and retries (the RMM event
+        # handler's spill-and-retry, DeviceMemoryEventHandler.scala:42)
+        return with_oom_retry(
+            lambda: groupby_aggregate(batch, list(range(nkeys)), specs,
+                                      types))[0]
 
     def _merge_types(self) -> List[dt.DType]:
         return [e.dtype for e in self.grouping] + self.partial_types

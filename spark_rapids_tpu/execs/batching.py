@@ -68,7 +68,11 @@ def drain_to_single_batch(it: Iterator[ColumnarBatch], schema
     batches = [b for b in it if b.realized_num_rows() > 0]
     if not batches:
         return ColumnarBatch.empty(schema)
-    return concat_batches(batches) if len(batches) > 1 else batches[0]
+    if len(batches) == 1:
+        return batches[0]
+    from spark_rapids_tpu.memory.oom import with_oom_retry
+
+    return with_oom_retry(lambda: concat_batches(batches))
 
 
 def coalesce_iterator(it: Iterator[ColumnarBatch], goal: CoalesceGoal

@@ -89,15 +89,20 @@ class HashJoinExec(TpuExec):
                 if b.realized_num_rows() == 0 and saw:
                     continue
                 saw = True
+                from spark_rapids_tpu.memory.oom import with_oom_retry
+
                 with TraceRange(f"HashJoinExec.{self.kind}"):
                     if self.kind == "cross":
-                        out, _ = cross_join(b, build, left_types,
-                                            right_types)
+                        out, _ = with_oom_retry(
+                            lambda b=b: cross_join(b, build, left_types,
+                                                   right_types))
                     else:
-                        out, _ = equi_join(
-                            b, build, self.left_keys, self.right_keys,
-                            left_types, right_types,
-                            join_type=_KIND_MAP[self.kind])
+                        out, _ = with_oom_retry(
+                            lambda b=b: equi_join(
+                                b, build, self.left_keys,
+                                self.right_keys, left_types,
+                                right_types,
+                                join_type=_KIND_MAP[self.kind]))
                 if self.condition is not None:
                     out = self.condition(out)
                 yield out

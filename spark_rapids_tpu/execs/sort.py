@@ -47,8 +47,11 @@ class SortExec(TpuExec):
                 if merged.realized_num_rows() == 0:
                     yield merged
                     return
+                from spark_rapids_tpu.memory.oom import with_oom_retry
+
                 with TraceRange("SortExec.global"):
-                    yield sort_batch(merged, self.specs, types)
+                    yield with_oom_retry(
+                        lambda: sort_batch(merged, self.specs, types))
             else:
                 for b in self.children[0].execute(partition):
                     with TraceRange("SortExec.local"):
