@@ -73,6 +73,54 @@ def main():
     t0 = time.time()
     c = concat.concat_batches([f.select([0, 1]), j.select([0, 1])])
     print(f"concat: {time.time()-t0:.2f}s rows={c.realized_num_rows()}")
+
+    # fused filter-into-groupby (live_mask path)
+    import jax.numpy as jnp
+
+    t0 = time.time()
+    cols = [(batch.columns[0].data, None),
+            (batch.columns[1].data, batch.columns[1].validity)]
+    (kd, kv), (ad, av), ng = groupby._groupby(
+        cols, (dt.INT64, dt.FLOAT64), (0,),
+        (AggSpec("sum", 1), AggSpec("count_star")),
+        batch.num_rows_device(),
+        live_mask=(batch.columns[1].data > 0))
+    print(f"groupby live_mask: {time.time()-t0:.2f}s groups={int(ng)}")
+
+    # bitwise/shift kernels (64-bit emulation edges)
+    from spark_rapids_tpu.expressions import bitwise as bw
+    from spark_rapids_tpu.expressions.base import BoundReference
+    from spark_rapids_tpu.expressions.compiler import CompiledProjection
+
+    t0 = time.time()
+    r0 = BoundReference(0, dt.INT64)
+    proj = CompiledProjection([bw.BitwiseNot(r0),
+                               bw.ShiftRightUnsigned(
+                                   r0, BoundReference(0, dt.INT64))])
+    bb = proj(batch.select([0]))
+    bb.columns[0].data.block_until_ready()
+    print(f"bitwise/ushr: {time.time()-t0:.2f}s")
+
+    # window: running + range frames on device
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.expressions.aggregates import Sum as AggSum
+    from spark_rapids_tpu.plan import nodes as pn
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    t0 = time.time()
+    wn = 20_000
+    wplan = pn.WindowNode(
+        [0], [SortKeySpec.spark_default(1)],
+        [pn.WindowCall(AggSum(BoundReference(2, dt.FLOAT64)), "rs",
+                       frame=pn.WindowFrame(None, 0)),
+         pn.WindowCall(AggSum(BoundReference(2, dt.FLOAT64)), "rng",
+                       frame=pn.WindowFrame(-5, 5, kind="range"))],
+        pn.ScanNode(pn.InMemorySource({
+            "p": rng.integers(0, 50, wn).astype(np.int64),
+            "o": rng.integers(0, 1000, wn).astype(np.int64),
+            "v": rng.normal(size=wn)})))
+    wdf = collect(apply_overrides(wplan))
+    print(f"window run+range: {time.time()-t0:.2f}s rows={len(wdf)}")
     print("TPU SMOKE OK")
 
 

@@ -282,6 +282,37 @@ class GroupedData:
 
     applyInPandas = apply_in_pandas
 
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        return CoGroupedData(self, other)
+
+    def _key_ordinals(self) -> List[int]:
+        schema = self.df.schema
+        out = []
+        for k in self.keys:
+            e = k.resolve(schema)
+            assert isinstance(e, BoundReference), \
+                "cogroup keys must be plain columns"
+            out.append(e.ordinal)
+        return out
+
+
+class CoGroupedData:
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        assert len(left.keys) == len(right.keys)
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema: Schema) -> DataFrame:
+        from spark_rapids_tpu.execs.python_exec import \
+            CoGroupedMapInPandasNode
+
+        return self.left.df._df(CoGroupedMapInPandasNode(
+            self.left.df._plan, self.right.df._plan,
+            self.left._key_ordinals(), self.right._key_ordinals(),
+            fn, schema))
+
+    applyInPandas = apply_in_pandas
+
     def _shortcut(self, fn_name: str, *cols: str) -> DataFrame:
         from spark_rapids_tpu.api import functions as F
 

@@ -713,13 +713,15 @@ def _write_files(node) -> CpuFrame:
 def _register_io_nodes():
     from spark_rapids_tpu.execs.cache import CacheNode
     from spark_rapids_tpu.execs.python_exec import (
-        GroupedMapInPandasNode, MapInPandasNode,
+        CoGroupedMapInPandasNode, GroupedMapInPandasNode,
+        MapInPandasNode, execute_cogrouped_map_cpu,
         execute_grouped_map_cpu, execute_map_in_pandas_cpu)
     from spark_rapids_tpu.io.write import WriteFilesNode
 
     _NODES[WriteFilesNode] = _write_files
     _NODES[MapInPandasNode] = execute_map_in_pandas_cpu
     _NODES[GroupedMapInPandasNode] = execute_grouped_map_cpu
+    _NODES[CoGroupedMapInPandasNode] = execute_cogrouped_map_cpu
     _NODES[CacheNode] = _passthrough  # the oracle recomputes
 
 

@@ -185,6 +185,123 @@ class GroupedMapInPandasExec(TpuExec):
         return timed(self, it())
 
 
+class CoGroupedMapInPandasNode(PlanNode):
+    """cogroup(left, right).applyInPandas analogue
+    (GpuFlatMapCoGroupsInPandasExec, §2.12): ``fn`` maps the pair of
+    per-key group frames to a result frame; keys present on either side
+    produce a call (the missing side's frame is empty)."""
+
+    def __init__(self, left: PlanNode, right: PlanNode,
+                 left_ordinals, right_ordinals, fn: Callable,
+                 schema: Schema):
+        super().__init__([left, right])
+        assert len(left_ordinals) == len(right_ordinals) > 0
+        self.left_ordinals = list(left_ordinals)
+        self.right_ordinals = list(right_ordinals)
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return (f"CoGroupedMapInPandas["
+                f"{getattr(self.fn, '__name__', 'fn')}]")
+
+
+def _apply_cogrouped(lpdf, rpdf, lkeys, rkeys, fn, out_schema: Schema):
+    import pandas as pd
+
+    lgroups = {k: g.reset_index(drop=True)
+               for k, g in lpdf.groupby(lkeys, dropna=False, sort=False)}
+    rgroups = {k: g.reset_index(drop=True)
+               for k, g in rpdf.groupby(rkeys, dropna=False, sort=False)}
+    outs = []
+    seen = list(lgroups) + [k for k in rgroups if k not in lgroups]
+
+    def key_sort(k):
+        t = k if isinstance(k, tuple) else (k,)
+        return tuple((v is None or v != v, str(v)) for v in t)
+
+    for k in sorted(seen, key=key_sort):
+        lg = lgroups.get(k, lpdf.iloc[0:0])
+        rg = rgroups.get(k, rpdf.iloc[0:0])
+        r = fn(lg, rg)
+        if len(r):
+            outs.append(r)
+    if outs:
+        return pd.concat(outs, ignore_index=True)
+    return pd.DataFrame({n: pd.Series([], dtype=object)
+                         for n in out_schema.names})
+
+
+class CoGroupedMapInPandasExec(TpuExec):
+    """Both children are hash-co-partitioned on their keys by the
+    planner, so matching groups meet in the same partition."""
+
+    def __init__(self, node: CoGroupedMapInPandasNode, left: TpuExec,
+                 right: TpuExec):
+        super().__init__([left, right], node.output_schema())
+        self.node = node
+
+    @property
+    def children_coalesce_goal(self):
+        from spark_rapids_tpu.execs.batching import RequireSingleBatch
+
+        return [RequireSingleBatch, RequireSingleBatch]
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+
+        lschema = self.node.children[0].output_schema()
+        rschema = self.node.children[1].output_schema()
+        out_schema = self.schema
+        lkeys = [lschema.names[o] for o in self.node.left_ordinals]
+        rkeys = [rschema.names[o] for o in self.node.right_ordinals]
+
+        def it():
+            lb = drain_to_single_batch(
+                self.children[0].execute(partition), lschema)
+            rb = drain_to_single_batch(
+                self.children[1].execute(partition), rschema)
+            if lb.realized_num_rows() == 0 and \
+                    rb.realized_num_rows() == 0:
+                yield ColumnarBatch.empty(out_schema)
+                return
+            PythonWorkerSemaphore.acquire()
+            try:
+                with TraceRange("CoGroupedMapInPandasExec.python"):
+                    out = _apply_cogrouped(
+                        lb.to_pandas(lschema), rb.to_pandas(rschema),
+                        lkeys, rkeys, self.node.fn, out_schema)
+                    data, validity = _pandas_to_host(out, out_schema)
+            finally:
+                PythonWorkerSemaphore.release()
+            yield interop.host_to_batch(data, validity, out_schema)
+        return timed(self, it())
+
+
+def execute_cogrouped_map_cpu(node: CoGroupedMapInPandasNode):
+    from spark_rapids_tpu.cpu.engine import CpuFrame, execute_cpu
+    from spark_rapids_tpu.cpu.evaluator import CV
+
+    left = execute_cpu(node.children[0])
+    right = execute_cpu(node.children[1])
+    lschema = node.children[0].output_schema()
+    rschema = node.children[1].output_schema()
+    schema = node.output_schema()
+    out = _apply_cogrouped(
+        left.to_pandas(), right.to_pandas(),
+        [lschema.names[o] for o in node.left_ordinals],
+        [rschema.names[o] for o in node.right_ordinals],
+        node.fn, schema)
+    data, validity = _pandas_to_host(out, schema)
+    n = len(next(iter(data.values()))) if len(schema) else 0
+    cols = [CV(t, data[nm], validity[nm])
+            for nm, t in zip(schema.names, schema.types)]
+    return CpuFrame(schema, cols, n)
+
+
 def execute_grouped_map_cpu(node: GroupedMapInPandasNode):
     from spark_rapids_tpu.cpu.engine import CpuFrame, execute_cpu
     from spark_rapids_tpu.cpu.evaluator import CV

@@ -686,6 +686,22 @@ class _MapInPandasRule(NodeRule):
         return MapInPandasExec(meta.node, children[0])
 
 
+class _CoGroupedMapRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.execs.python_exec import \
+            CoGroupedMapInPandasExec
+
+        node = meta.node
+        left, right = children
+        if left.num_partitions > 1 or right.num_partitions > 1:
+            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            left = exchange.ShuffleExchangeExec(
+                ("hash", list(node.left_ordinals)), parts, left)
+            right = exchange.ShuffleExchangeExec(
+                ("hash", list(node.right_ordinals)), parts, right)
+        return CoGroupedMapInPandasExec(node, left, right)
+
+
 class _GroupedMapRule(NodeRule):
     def convert(self, meta, children):
         from spark_rapids_tpu.execs.python_exec import \
@@ -706,11 +722,13 @@ def _register_io_rules():
     from spark_rapids_tpu.execs.python_exec import MapInPandasNode
     from spark_rapids_tpu.io.write import WriteFilesNode
 
-    from spark_rapids_tpu.execs.python_exec import GroupedMapInPandasNode
+    from spark_rapids_tpu.execs.python_exec import (
+        CoGroupedMapInPandasNode, GroupedMapInPandasNode)
 
     _NODE_RULES[WriteFilesNode] = _WriteRule()
     _NODE_RULES[MapInPandasNode] = _MapInPandasRule()
     _NODE_RULES[GroupedMapInPandasNode] = _GroupedMapRule()
+    _NODE_RULES[CoGroupedMapInPandasNode] = _CoGroupedMapRule()
     _NODE_RULES[CacheNode] = _CacheRule()
     # mirror the reference: pandas execs are off by default because data
     # leaves the accelerator for the Python worker
@@ -723,6 +741,10 @@ def _register_io_rules():
         "exec", "GroupedMapInPandasNode",
         "Run groupBy().applyInPandas around the TPU pipeline "
         "(co-partitioned device->pandas->device round trip)",
+        default_enabled=False)
+    cfg.register_op_flag(
+        "exec", "CoGroupedMapInPandasNode",
+        "Run cogroup().applyInPandas around the TPU pipeline",
         default_enabled=False)
 
 

@@ -163,3 +163,40 @@ def test_grouped_map_disabled_by_default():
         [0], lambda g: g[["a"]], Schema(["a"], [dt.INT64]), scan(50))
     exec_ = apply_overrides(plan, RapidsConf())
     assert type(exec_).__name__ == "CpuFallbackExec"
+
+
+def test_cogrouped_map_matches_oracle():
+    from spark_rapids_tpu.api import Session
+
+    s = Session({"rapids.tpu.sql.exec.CoGroupedMapInPandasNode": True})
+    left = s.create_dataframe(pd.DataFrame(
+        {"k": [1, 1, 2, 4], "v": [1.0, 2.0, 3.0, 9.0]}))
+    right = s.create_dataframe(pd.DataFrame(
+        {"k2": [1, 2, 2, 3], "w": [10.0, 20.0, 30.0, 40.0]}))
+
+    def merge(lg: pd.DataFrame, rg: pd.DataFrame) -> pd.DataFrame:
+        k = int(lg["k"].iloc[0]) if len(lg) else int(rg["k2"].iloc[0])
+        return pd.DataFrame({
+            "k": [k],
+            "lsum": [float(pd.to_numeric(lg["v"],
+                                         errors="coerce").sum())
+                     if len(lg) else 0.0],
+            "rsum": [float(pd.to_numeric(rg["w"],
+                                         errors="coerce").sum())
+                     if len(rg) else 0.0],
+        })
+
+    schema = Schema(["k", "lsum", "rsum"],
+                    [dt.INT64, dt.FLOAT64, dt.FLOAT64])
+    out = (left.group_by("k").cogroup(right.group_by("k2"))
+           .apply_in_pandas(merge, schema).collect())
+    got = {int(r.k): (float(r.lsum), float(r.rsum))
+           for r in out.itertuples()}
+    # keys from EITHER side appear; missing side contributes 0
+    assert got == {1: (3.0, 10.0), 2: (3.0, 50.0), 3: (0.0, 40.0),
+                   4: (9.0, 0.0)}
+    # CPU oracle agrees
+    plan = (left.group_by("k").cogroup(right.group_by("k2"))
+            .apply_in_pandas(merge, schema))._plan
+    cpu = execute_cpu(plan).to_pandas()
+    assert_frames_equal(cpu, out)
