@@ -75,8 +75,6 @@ def main():
     print(f"concat: {time.time()-t0:.2f}s rows={c.realized_num_rows()}")
 
     # fused filter-into-groupby (live_mask path)
-    import jax.numpy as jnp
-
     t0 = time.time()
     cols = [(batch.columns[0].data, None),
             (batch.columns[1].data, batch.columns[1].validity)]

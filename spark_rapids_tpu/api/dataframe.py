@@ -270,15 +270,8 @@ class GroupedData:
         from spark_rapids_tpu.execs.python_exec import \
             GroupedMapInPandasNode
 
-        dfschema = self.df.schema
-        ordinals = []
-        for k in self.keys:
-            e = k.resolve(dfschema)
-            assert isinstance(e, BoundReference), \
-                "applyInPandas keys must be plain columns"
-            ordinals.append(e.ordinal)
         return self.df._df(GroupedMapInPandasNode(
-            ordinals, fn, schema, self.df._plan))
+            self._key_ordinals(), fn, schema, self.df._plan))
 
     applyInPandas = apply_in_pandas
 
@@ -291,7 +284,7 @@ class GroupedData:
         for k in self.keys:
             e = k.resolve(schema)
             assert isinstance(e, BoundReference), \
-                "cogroup keys must be plain columns"
+                "grouped/cogrouped pandas keys must be plain columns"
             out.append(e.ordinal)
         return out
 

@@ -209,19 +209,29 @@ class CoGroupedMapInPandasNode(PlanNode):
                 f"{getattr(self.fn, '__name__', 'fn')}]")
 
 
+_NULL_KEY = object()  # canonical image for None/NaN grouping keys
+
+
+def _canon_key(k):
+    """Dict-safe group key: pandas hands out nan objects whose hash is
+    identity-based, so NaN (and None) keys from the two sides would
+    never match — canonicalize them to one sentinel."""
+    t = k if isinstance(k, tuple) else (k,)
+    return tuple(_NULL_KEY if v is None or v != v else v for v in t)
+
+
 def _apply_cogrouped(lpdf, rpdf, lkeys, rkeys, fn, out_schema: Schema):
     import pandas as pd
 
-    lgroups = {k: g.reset_index(drop=True)
+    lgroups = {_canon_key(k): g.reset_index(drop=True)
                for k, g in lpdf.groupby(lkeys, dropna=False, sort=False)}
-    rgroups = {k: g.reset_index(drop=True)
+    rgroups = {_canon_key(k): g.reset_index(drop=True)
                for k, g in rpdf.groupby(rkeys, dropna=False, sort=False)}
     outs = []
     seen = list(lgroups) + [k for k in rgroups if k not in lgroups]
 
     def key_sort(k):
-        t = k if isinstance(k, tuple) else (k,)
-        return tuple((v is None or v != v, str(v)) for v in t)
+        return tuple((v is _NULL_KEY, str(v)) for v in k)
 
     for k in sorted(seen, key=key_sort):
         lg = lgroups.get(k, lpdf.iloc[0:0])
@@ -281,9 +291,21 @@ class CoGroupedMapInPandasExec(TpuExec):
         return timed(self, it())
 
 
-def execute_cogrouped_map_cpu(node: CoGroupedMapInPandasNode):
-    from spark_rapids_tpu.cpu.engine import CpuFrame, execute_cpu
+def _cpu_frame_from_pandas(out, schema: Schema):
+    """Shared pandas-result -> CpuFrame tail for the CPU-engine pandas
+    execs."""
+    from spark_rapids_tpu.cpu.engine import CpuFrame
     from spark_rapids_tpu.cpu.evaluator import CV
+
+    data, validity = _pandas_to_host(out, schema)
+    n = len(next(iter(data.values()))) if len(schema) else 0
+    cols = [CV(t, data[nm], validity[nm])
+            for nm, t in zip(schema.names, schema.types)]
+    return CpuFrame(schema, cols, n)
+
+
+def execute_cogrouped_map_cpu(node: CoGroupedMapInPandasNode):
+    from spark_rapids_tpu.cpu.engine import execute_cpu
 
     left = execute_cpu(node.children[0])
     right = execute_cpu(node.children[1])
@@ -295,41 +317,26 @@ def execute_cogrouped_map_cpu(node: CoGroupedMapInPandasNode):
         [lschema.names[o] for o in node.left_ordinals],
         [rschema.names[o] for o in node.right_ordinals],
         node.fn, schema)
-    data, validity = _pandas_to_host(out, schema)
-    n = len(next(iter(data.values()))) if len(schema) else 0
-    cols = [CV(t, data[nm], validity[nm])
-            for nm, t in zip(schema.names, schema.types)]
-    return CpuFrame(schema, cols, n)
+    return _cpu_frame_from_pandas(out, schema)
 
 
 def execute_grouped_map_cpu(node: GroupedMapInPandasNode):
-    from spark_rapids_tpu.cpu.engine import CpuFrame, execute_cpu
-    from spark_rapids_tpu.cpu.evaluator import CV
+    from spark_rapids_tpu.cpu.engine import execute_cpu
 
     child = execute_cpu(node.children[0])
     schema = node.output_schema()
     child_schema = node.children[0].output_schema()
     key_names = [child_schema.names[o] for o in node.grouping_ordinals]
     out = _apply_grouped(child.to_pandas(), key_names, node.fn, schema)
-    data, validity = _pandas_to_host(out, schema)
-    n = len(next(iter(data.values()))) if len(schema) else 0
-    cols = [CV(t, data[nm], validity[nm])
-            for nm, t in zip(schema.names, schema.types)]
-    return CpuFrame(schema, cols, n)
+    return _cpu_frame_from_pandas(out, schema)
 
 
 def execute_map_in_pandas_cpu(node: MapInPandasNode):
     """CPU-engine implementation (oracle): same function applied to the
     whole child frame."""
-    from spark_rapids_tpu.cpu.engine import CpuFrame, execute_cpu
-    from spark_rapids_tpu.cpu.evaluator import CV
+    from spark_rapids_tpu.cpu.engine import execute_cpu
 
     child = execute_cpu(node.children[0])
     schema = node.output_schema()
-    pdf = child.to_pandas()
-    out = node.fn(pdf)
-    data, validity = _pandas_to_host(out, schema)
-    n = len(next(iter(data.values()))) if len(schema) else 0
-    cols = [CV(t, data[nm], validity[nm])
-            for nm, t in zip(schema.names, schema.types)]
-    return CpuFrame(schema, cols, n)
+    out = node.fn(child.to_pandas())
+    return _cpu_frame_from_pandas(out, schema)

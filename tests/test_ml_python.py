@@ -200,3 +200,24 @@ def test_cogrouped_map_matches_oracle():
             .apply_in_pandas(merge, schema))._plan
     cpu = execute_cpu(plan).to_pandas()
     assert_frames_equal(cpu, out)
+
+
+def test_cogrouped_nan_keys_match_across_sides():
+    """Regression (review finding): NaN keys from the two sides must
+    land in ONE paired call, not one call per side."""
+    from spark_rapids_tpu.columnar.batch import Schema as _S
+    from spark_rapids_tpu.execs.python_exec import _apply_cogrouped
+
+    lpdf = pd.DataFrame({"k": [1.0, float("nan")], "v": [1.0, 2.0]})
+    rpdf = pd.DataFrame({"k2": [float("nan")], "w": [10.0]})
+
+    calls = []
+
+    def fn(lg, rg):
+        calls.append((len(lg), len(rg)))
+        return pd.DataFrame({"n": [len(lg) + len(rg)]})
+
+    out = _apply_cogrouped(lpdf, rpdf, ["k"], ["k2"], fn,
+                           _S(["n"], [dt.INT64]))
+    assert len(out) == 2  # groups: k=1.0 and k=NaN
+    assert (1, 1) in calls  # the NaN group saw BOTH sides
