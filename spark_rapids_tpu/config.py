@@ -309,6 +309,12 @@ CSV_READ_ENABLED = conf("rapids.tpu.sql.format.csv.read.enabled").doc(
     "Enable CSV scans."
 ).boolean_conf.create_with_default(True)
 
+OPTIMIZER_ENABLED = conf("rapids.tpu.sql.optimizer.enabled").doc(
+    "Structural plan rules before override planning: collapse adjacent "
+    "projections, combine filters, push filters through deterministic "
+    "projections (each removed node is one fewer executable per batch)."
+).boolean_conf.create_with_default(True)
+
 ADAPTIVE_ENABLED = conf("rapids.tpu.sql.adaptive.enabled").doc(
     "Adaptive shuffle reads: after an exchange materializes, coalesce "
     "small reduce partitions toward the advisory size using exact map "

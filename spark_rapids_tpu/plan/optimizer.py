@@ -1,0 +1,103 @@
+"""Plan-level optimizer rules applied before TpuOverrides.
+
+The reference inherits Catalyst's optimized plans; standalone, this
+engine needs the handful of structural rules with direct dispatch-count
+impact (each collapsed node is one fewer jitted executable per batch —
+at ~100 ms tunnel overhead per dispatch these rules are worth more here
+than on a local GPU):
+
+- CollapseProject: Project(Project(x)) -> one Project with the outer
+  expressions rewritten over the inner ones (Catalyst's CollapseProject)
+- CombineFilters: Filter(Filter(x)) -> one conjunctive Filter
+- CollapseFilterProject: Filter(Project(x)) where the condition only
+  references projected columns -> Project(Filter'(x)) is NOT generally
+  safe (the projection may rename/compute); instead the condition is
+  rewritten through the projection so the pair becomes
+  Project(..) over Filter(rewritten) — pushing the filter below the
+  projection lets scans prune earlier (PushDownPredicate subset for
+  deterministic projections).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression)
+from spark_rapids_tpu.plan import nodes as pn
+
+
+def _substitute(e: Expression, inner: List[Expression]) -> Expression:
+    """Rewrite ``e``'s bound references as the inner projection's
+    expressions (unwrapping aliases)."""
+    def fn(node: Expression) -> Expression:
+        if isinstance(node, BoundReference):
+            repl = inner[node.ordinal]
+            while isinstance(repl, Alias):
+                repl = repl.children[0]
+            return repl
+        return node
+    return e.transform(fn)
+
+
+def _all_deterministic(exprs) -> bool:
+    return all(e.deterministic for e in exprs)
+
+
+def _reference_counts(exprs: List[Expression], width: int) -> List[int]:
+    counts = [0] * width
+    for e in exprs:
+        for node in e.collect(lambda n: isinstance(n, BoundReference)):
+            counts[node.ordinal] += 1
+    return counts
+
+
+def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
+    """Bottom-up single pass collapsing Project/Filter chains."""
+    new_children = [collapse_project(c) for c in node.children]
+    node = node.with_children(new_children) if node.children else node
+
+    if isinstance(node, pn.ProjectNode) and \
+            isinstance(node.children[0], pn.ProjectNode):
+        inner: pn.ProjectNode = node.children[0]
+        if _all_deterministic(inner.exprs):
+            # avoid exploding duplicated non-trivial inner expressions:
+            # collapse only when every inner expr used more than once is
+            # a bare reference (Catalyst applies a similar cost guard)
+            counts = _reference_counts(node.exprs, len(inner.exprs))
+            cheap = all(
+                c <= 1 or isinstance(
+                    inner.exprs[i].children[0]
+                    if isinstance(inner.exprs[i], Alias)
+                    else inner.exprs[i], BoundReference)
+                for i, c in enumerate(counts))
+            if cheap:
+                exprs = [_substitute(e, inner.exprs)
+                         for e in node.exprs]
+                return collapse_project(pn.ProjectNode(
+                    exprs, inner.children[0], names=list(node.names)))
+
+    if isinstance(node, pn.FilterNode) and \
+            isinstance(node.children[0], pn.FilterNode):
+        from spark_rapids_tpu.expressions import predicates as pr
+
+        inner_f: pn.FilterNode = node.children[0]
+        return collapse_project(pn.FilterNode(
+            pr.And(inner_f.condition, node.condition),
+            inner_f.children[0]))
+
+    if isinstance(node, pn.FilterNode) and \
+            isinstance(node.children[0], pn.ProjectNode):
+        proj: pn.ProjectNode = node.children[0]
+        if _all_deterministic(proj.exprs) and \
+                _all_deterministic([node.condition]):
+            pushed = _substitute(node.condition, proj.exprs)
+            return collapse_project(pn.ProjectNode(
+                list(proj.exprs),
+                pn.FilterNode(pushed, proj.children[0]),
+                names=list(proj.names)))
+
+    return node
+
+
+def optimize(plan: pn.PlanNode) -> pn.PlanNode:
+    return collapse_project(plan)

@@ -877,6 +877,10 @@ def apply_overrides(plan: pn.PlanNode,
         from spark_rapids_tpu.udf import compile_udfs_in_plan
 
         plan = compile_udfs_in_plan(plan)
+    if conf.get(cfg.OPTIMIZER_ENABLED):
+        from spark_rapids_tpu.plan.optimizer import optimize
+
+        plan = optimize(plan)
     plan = push_down_file_filters(plan, conf)
     meta = NodeMeta(plan, conf)
     meta.tag_for_tpu()

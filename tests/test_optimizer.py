@@ -1,0 +1,97 @@
+"""Plan-optimizer rule tests (CollapseProject / CombineFilters /
+push-filter-through-projection)."""
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Literal)
+from spark_rapids_tpu.expressions.nondeterministic import Rand
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.optimizer import optimize
+
+from tests.compare import assert_cpu_and_tpu_equal
+
+
+def ref(i, t=dt.INT64):
+    return BoundReference(i, t)
+
+
+def scan(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    return pn.ScanNode(pn.InMemorySource(
+        {"a": rng.integers(0, 100, n).astype(np.int64),
+         "b": rng.random(n)}))
+
+
+def test_collapse_adjacent_projects():
+    p1 = pn.ProjectNode([Alias(ar.Add(ref(0), Literal(1)), "x"),
+                         Alias(ref(1, dt.FLOAT64), "b")], scan())
+    p2 = pn.ProjectNode([Alias(ar.Multiply(ref(0), Literal(2)), "y")],
+                        p1)
+    out = optimize(p2)
+    assert isinstance(out, pn.ProjectNode)
+    assert isinstance(out.children[0], pn.ScanNode)
+    assert out.output_schema().names == ["y"]
+    assert_cpu_and_tpu_equal(p2)
+
+
+def test_collapse_guard_against_duplication():
+    """An expensive inner expression referenced twice must NOT inline."""
+    inner = pn.ProjectNode(
+        [Alias(ar.Multiply(ref(0), ref(0)), "sq")], scan())
+    outer = pn.ProjectNode(
+        [Alias(ar.Add(ref(0), ref(0)), "dbl")], inner)
+    out = optimize(outer)
+    # still two projects: sq used twice and is non-trivial
+    assert isinstance(out.children[0], pn.ProjectNode)
+    assert_cpu_and_tpu_equal(outer)
+
+
+def test_combine_filters():
+    f1 = pn.FilterNode(P.GreaterThan(ref(0), Literal(10)), scan())
+    f2 = pn.FilterNode(P.LessThan(ref(0), Literal(90)), f1)
+    out = optimize(f2)
+    assert isinstance(out, pn.FilterNode)
+    assert isinstance(out.children[0], pn.ScanNode)
+    assert isinstance(out.condition, P.And)
+    assert_cpu_and_tpu_equal(f2)
+
+
+def test_filter_pushes_through_projection():
+    proj = pn.ProjectNode([Alias(ar.Add(ref(0), Literal(5)), "a5"),
+                           Alias(ref(1, dt.FLOAT64), "b")], scan())
+    filt = pn.FilterNode(P.GreaterThan(ref(0), Literal(50)), proj)
+    out = optimize(filt)
+    assert isinstance(out, pn.ProjectNode)
+    assert isinstance(out.children[0], pn.FilterNode)
+    assert isinstance(out.children[0].children[0], pn.ScanNode)
+    assert_cpu_and_tpu_equal(filt)
+
+
+def test_nondeterministic_blocks_pushdown():
+    proj = pn.ProjectNode([Alias(Rand(seed=1), "r"),
+                           Alias(ref(0), "a")], scan())
+    filt = pn.FilterNode(
+        P.GreaterThan(ref(0, dt.FLOAT64), Literal(0.5)), proj)
+    out = optimize(filt)
+    # rand() must evaluate once per input row BEFORE filtering; the
+    # rewrite would re-randomize — plan stays Filter(Project)
+    assert isinstance(out, pn.FilterNode)
+
+
+def test_long_chain_collapses_fully():
+    node = scan()
+    for k in range(4):
+        node = pn.ProjectNode(
+            [Alias(ar.Add(ref(0), Literal(1)), "a"),
+             Alias(ref(1, dt.FLOAT64), "b")], node)
+    node = pn.FilterNode(P.GreaterThan(ref(0), Literal(52)), node)
+    out = optimize(node)
+    # one project over one filter over the scan
+    assert isinstance(out, pn.ProjectNode)
+    assert isinstance(out.children[0], pn.FilterNode)
+    assert isinstance(out.children[0].children[0], pn.ScanNode)
+    assert_cpu_and_tpu_equal(node)
