@@ -179,6 +179,11 @@ class DataFrame:
             self._plan.holder.unpersist()
         return self
 
+    def coalesce(self, num_partitions: int) -> "DataFrame":
+        """Shrink partition count without a shuffle."""
+        return self._df(pn.CoalescePartitionsNode(num_partitions,
+                                                  self._plan))
+
     def repartition(self, num_partitions: int,
                     *cols: ColumnOrName) -> "DataFrame":
         schema = self.schema

@@ -700,6 +700,7 @@ _NODES = {
     pn.JoinNode: _join,
     pn.WindowNode: _window,
     pn.ShuffleExchangeNode: _passthrough,
+    pn.CoalescePartitionsNode: _passthrough,
     pn.BroadcastExchangeNode: _passthrough,
 }
 

@@ -192,6 +192,37 @@ class ExpandExec(TpuExec):
         return timed(self, it())
 
 
+class CoalescePartitionsExec(TpuExec):
+    """Maps n output partitions onto contiguous groups of child
+    partitions — no data movement beyond sequential reads."""
+
+    def __init__(self, num_partitions: int, child: TpuExec):
+        super().__init__([child], child.schema)
+        self._n = num_partitions
+
+    @property
+    def num_partitions(self) -> int:
+        return min(self._n, max(self.children[0].num_partitions, 1))
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            child_n = self.children[0].num_partitions
+            n = self.num_partitions
+            per = -(-child_n // n)
+            lo = partition * per
+            hi = min(lo + per, child_n)
+            empty = True
+            for p in range(lo, hi):
+                for b in self.children[0].execute(p):
+                    if b.realized_num_rows() == 0:
+                        continue
+                    empty = False
+                    yield b
+            if empty:
+                yield ColumnarBatch.empty(self.schema)
+        return timed(self, it())
+
+
 class CpuFallbackExec(TpuExec):
     """Executes a plan subtree on the CPU engine and uploads the result —
     the planner inserts this around nodes that can't go on TPU, with the

@@ -451,6 +451,23 @@ class ShuffleExchangeNode(PlanNode):
                 f"n={self.num_partitions}]")
 
 
+class CoalescePartitionsNode(PlanNode):
+    """df.coalesce(n): shrink partition count WITHOUT a shuffle by
+    reading contiguous groups of input partitions (GpuCoalesceExec,
+    GpuOverrides.scala:1777-1833 coalesce registration)."""
+
+    def __init__(self, num_partitions: int, child: PlanNode):
+        super().__init__([child])
+        assert num_partitions >= 1
+        self.num_partitions = num_partitions
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def describe(self) -> str:
+        return f"CoalescePartitions[{self.num_partitions}]"
+
+
 class BroadcastExchangeNode(PlanNode):
     """Marks the build side of a broadcast join
     (GpuBroadcastExchangeExec.scala:237)."""

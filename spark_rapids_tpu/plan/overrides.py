@@ -659,6 +659,12 @@ class _WindowRule(NodeRule):
                                  meta.conf)
 
 
+class _CoalescePartitionsRule(NodeRule):
+    def convert(self, meta, children):
+        return basic.CoalescePartitionsExec(meta.node.num_partitions,
+                                            children[0])
+
+
 class _ExchangeRule(NodeRule):
     def convert(self, meta, children):
         node: pn.ShuffleExchangeNode = meta.node
@@ -761,6 +767,7 @@ _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {
     pn.JoinNode: _JoinRule(),
     pn.WindowNode: _WindowRule(),
     pn.ShuffleExchangeNode: _ExchangeRule(),
+    pn.CoalescePartitionsNode: _CoalescePartitionsRule(),
     pn.BroadcastExchangeNode: _BroadcastRule(),
 }
 

@@ -210,3 +210,15 @@ def test_repartition_roundtrip(session, pdf):
     assert len(out) == len(pdf)
     rr = df.repartition(3)
     assert len(rr.collect()) == len(pdf)
+
+
+def test_coalesce_partitions(session, tmp_path, pdf):
+    for k in range(6):
+        pq.write_table(pa.Table.from_pandas(pdf.iloc[k * 60:(k + 1) * 60]),
+                       tmp_path / f"f{k}.parquet")
+    df = session.read.parquet(str(tmp_path))
+    c = df.coalesce(2)
+    exec_ = c._exec()
+    assert exec_.num_partitions == 2
+    out = c.collect()
+    assert len(out) == 360
