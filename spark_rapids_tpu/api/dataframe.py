@@ -205,12 +205,24 @@ class DataFrame:
     def _exec(self):
         from spark_rapids_tpu.plan.overrides import apply_overrides
 
-        return apply_overrides(self._plan, self.session.conf)
+        self._last_exec = apply_overrides(self._plan, self.session.conf)
+        return self._last_exec
 
     def collect(self):
         from spark_rapids_tpu.execs.base import collect
 
         return collect(self._exec())
+
+    def last_metrics(self) -> dict:
+        """Per-operator metrics of the most recent collect() — the SQL-UI
+        SQLMetrics view (GpuExec.scala:90-96): rows/batches/self-time."""
+        exec_ = getattr(self, "_last_exec", None)
+        if exec_ is None:
+            return {}
+        return {name: {"rows": m.num_output_rows,
+                       "batches": m.num_output_batches,
+                       "op_time_ms": round(m.op_time_ns / 1e6, 3)}
+                for name, m in exec_.all_metrics().items()}
 
     to_pandas = collect
     toPandas = collect

@@ -222,3 +222,13 @@ def test_coalesce_partitions(session, tmp_path, pdf):
     assert exec_.num_partitions == 2
     out = c.collect()
     assert len(out) == 360
+
+
+def test_last_metrics_after_collect(df):
+    pipe = df.filter(col("v") > 10).group_by("k").count()
+    assert pipe.last_metrics() == {}
+    pipe.collect()
+    m = pipe.last_metrics()
+    assert any("Aggregate" in k for k in m)
+    agg_key = next(k for k in m if "Aggregate" in k)
+    assert m[agg_key]["rows"] > 0
