@@ -37,13 +37,17 @@ class HashAggregateExec(TpuExec):
 
     def __init__(self, grouping: List[Expression], aggs: List[AggCall],
                  child: TpuExec, schema: Schema, mode: str = "complete",
-                 conf=None):
+                 conf=None, fused_filter=None):
         super().__init__([child], schema)
         assert mode in ("complete", "partial", "final")
         self.grouping = grouping
         self.aggs = aggs
         self.mode = mode
         self.conf = conf
+        # a CompiledFilter whose keep-mask rides into the groupby sort as
+        # a live_mask — the planner fuses Filter(child) pairs here, saving
+        # the per-batch compaction pass (argsort + per-column gathers)
+        self.fused_filter = fused_filter
         self._build()
 
     def _build(self):
@@ -117,18 +121,20 @@ class HashAggregateExec(TpuExec):
     # ------------------------------------------------------------------
 
     def _agg_batch(self, batch: ColumnarBatch, specs: List[AggSpec],
-                   types: List[dt.DType]) -> ColumnarBatch:
+                   types: List[dt.DType], live_mask=None
+                   ) -> ColumnarBatch:
         from spark_rapids_tpu.memory.oom import with_oom_retry
 
         nkeys = len(self.grouping)
         if nkeys == 0:
             return with_oom_retry(
-                lambda: reduce_aggregate(batch, specs, types))[0]
+                lambda: reduce_aggregate(batch, specs, types,
+                                         live_mask))[0]
         # device OOM spills the catalog and retries (the RMM event
         # handler's spill-and-retry, DeviceMemoryEventHandler.scala:42)
         return with_oom_retry(
             lambda: groupby_aggregate(batch, list(range(nkeys)), specs,
-                                      types))[0]
+                                      types, live_mask))[0]
 
     def _merge_types(self) -> List[dt.DType]:
         return [e.dtype for e in self.grouping] + self.partial_types
@@ -141,11 +147,16 @@ class HashAggregateExec(TpuExec):
                 if b.realized_num_rows() == 0:
                     continue
                 saw_input = True
+                mask = None
+                if self.fused_filter is not None:
+                    # keep-mask over the RAW batch (condition binds to
+                    # the child schema), row-aligned through projection
+                    mask = self.fused_filter.mask(b)
                 if self.input_proj is not None:
                     b = self.input_proj(b)
                 with TraceRange("HashAggregateExec.updateAgg"):
                     part = self._agg_batch(b, self.first_specs,
-                                           self.input_types)
+                                           self.input_types, mask)
                 if running is None:
                     running = part
                 else:

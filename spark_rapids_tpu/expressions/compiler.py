@@ -133,6 +133,21 @@ class CompiledFilter:
 
             self._mask = run_mask
 
+    def mask(self, batch: ColumnarBatch, task_info=None):
+        """Keep-mask only (no compaction): downstream sorts/groupbys fuse
+        it as a live_mask, skipping the compaction pass entirely. Fused
+        conditions only."""
+        from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
+
+        assert self.fused, "mask() requires a device_only condition"
+        if task_info is None:
+            task_info = TaskInfo.make()
+        datas = [c.data for c in batch.columns]
+        validities = [c.validity for c in batch.columns]
+        types = tuple(c.dtype for c in batch.columns)
+        return self._mask(datas, validities, batch.num_rows_device(),
+                          task_info, types)
+
     def __call__(self, batch: ColumnarBatch,
                  task_info=None) -> ColumnarBatch:
         from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
@@ -141,11 +156,7 @@ class CompiledFilter:
         if task_info is None:
             task_info = TaskInfo.make()
         if self.fused:
-            datas = [c.data for c in batch.columns]
-            validities = [c.validity for c in batch.columns]
-            types = tuple(c.dtype for c in batch.columns)
-            keep = self._mask(datas, validities, batch.num_rows_device(),
-                              task_info, types)
+            keep = self.mask(batch, task_info)
             return compact_batch(batch, keep)
         ctx = EvalContext.from_batch(batch, conf=self.conf,
                                      task_info=task_info)

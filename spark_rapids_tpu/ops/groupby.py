@@ -53,12 +53,14 @@ class AggSpec:
 
 
 def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
-                      aggs: List[AggSpec], dtypes: List[dt.DType]
+                      aggs: List[AggSpec], dtypes: List[dt.DType],
+                      live_mask=None
                       ) -> Tuple[ColumnarBatch, List[dt.DType]]:
-    """Returns (result batch [keys..., agg results...], result dtypes)."""
+    """Returns (result batch [keys..., agg results...], result dtypes).
+    ``live_mask`` fuses an upstream filter into the sort pass."""
     cols = [(c.data, c.validity) for c in batch.columns]
     out = _groupby(cols, tuple(dtypes), tuple(key_ordinals), tuple(aggs),
-                   batch.num_rows_device())
+                   batch.num_rows_device(), live_mask=live_mask)
     (key_d, key_v), (agg_d, agg_v), num_groups = out
     out_cols: List[Column] = []
     out_types: List[dt.DType] = []
@@ -259,7 +261,8 @@ def _one_agg(spec: AggSpec, sorted_cols, dtypes, boundary, live,
 
 
 def reduce_aggregate(batch: ColumnarBatch, aggs: List[AggSpec],
-                     dtypes: List[dt.DType]) -> Tuple[ColumnarBatch, List[dt.DType]]:
+                     dtypes: List[dt.DType], live_mask=None
+                     ) -> Tuple[ColumnarBatch, List[dt.DType]]:
     """Whole-batch reduction (no keys): grand aggregates
     (aggregate.scala:488-501 reduction path). Returns a 1-row batch."""
     if not batch.columns:
@@ -271,7 +274,7 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: List[AggSpec],
         return ColumnarBatch(out_cols, 1), [dt.INT64] * len(aggs)
     cols = [(c.data, c.validity) for c in batch.columns]
     agg_d, agg_v = _reduce(cols, tuple(dtypes), tuple(aggs),
-                           batch.num_rows_device())
+                           batch.num_rows_device(), live_mask)
     out_cols, out_types = [], []
     for i, spec in enumerate(aggs):
         rtype = agg_result_dtype(spec, dtypes)
@@ -281,15 +284,25 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: List[AggSpec],
 
 
 @partial(jax.jit, static_argnames=("dtypes", "aggs"))
-def _reduce(cols, dtypes, aggs, num_rows):
+def _reduce(cols, dtypes, aggs, num_rows, live_mask=None):
     capacity = cols[0][0].shape[0] if cols else 128
     iota = jnp.arange(capacity, dtype=jnp.int32)
     live = iota < num_rows
-    # reuse the segmented kernel with a single segment starting at row 0
+    if live_mask is not None:
+        live = live & live_mask
+    # reuse the segmented kernel with a single segment starting at row 0.
+    # With a fused live_mask the live rows need not be a prefix, so the
+    # boundary rows are the first/last LIVE positions.
     boundary = iota == 0
     n_live = jnp.sum(live.astype(jnp.int32)).astype(jnp.int32)
-    first_idx = jnp.zeros(capacity, dtype=jnp.int32)
-    last_idx = jnp.maximum(n_live - 1, 0) * jnp.ones(capacity, jnp.int32)
+    first_live = jnp.argmax(live).astype(jnp.int32)
+    last_live = (capacity - 1 -
+                 jnp.argmax(live[::-1])).astype(jnp.int32)
+    any_live = n_live > 0
+    first_idx = jnp.where(any_live, first_live, 0) * \
+        jnp.ones(capacity, jnp.int32)
+    last_idx = jnp.where(any_live, last_live, 0) * \
+        jnp.ones(capacity, jnp.int32)
     seg_sizes = jnp.zeros(capacity, jnp.int32).at[0].set(n_live)
     agg_d, agg_v = [], []
     for spec in aggs:

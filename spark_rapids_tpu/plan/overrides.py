@@ -375,18 +375,30 @@ class _AggregateRule(NodeRule):
             if call.fn.input is not None:
                 tag_expression(call.fn.input, meta, meta.conf)
 
+    @staticmethod
+    def _fuse_filter(child: TpuExec):
+        """Aggregate-over-filter fuses the keep-mask into the groupby
+        sort (one fewer compaction executable per batch)."""
+        if isinstance(child, basic.FilterExec) and \
+                child.filter.fused and \
+                child.filter.condition.deterministic:
+            return child.children[0], child.filter
+        return child, None
+
     def convert(self, meta, children):
         node: pn.AggregateNode = meta.node
         child = children[0]
         out_schema = node.output_schema()
         if node.mode != "complete":
+            child, ff = self._fuse_filter(child)
             return agg_exec.HashAggregateExec(
                 node.grouping, node.aggs, child, out_schema,
-                mode=node.mode, conf=meta.conf)
+                mode=node.mode, conf=meta.conf, fused_filter=ff)
         if child.num_partitions == 1:
+            child, ff = self._fuse_filter(child)
             return agg_exec.HashAggregateExec(
                 node.grouping, node.aggs, child, out_schema,
-                mode="complete", conf=meta.conf)
+                mode="complete", conf=meta.conf, fused_filter=ff)
         # distributed: partial -> exchange -> final (the physical split
         # Spark's planner produces, aggregate.scala partial/final modes)
         pnames = list(node.grouping_names)
@@ -396,9 +408,10 @@ class _AggregateRule(NodeRule):
                 pnames.append(f"{a.name}#p{j}")
                 ptypes.append(pt)
         partial_schema = Schema(pnames, ptypes)
+        child, ff = self._fuse_filter(child)
         partial = agg_exec.HashAggregateExec(
             node.grouping, node.aggs, child, partial_schema,
-            mode="partial", conf=meta.conf)
+            mode="partial", conf=meta.conf, fused_filter=ff)
         nkeys = len(node.grouping)
         if nkeys:
             ex = _adaptive_read(exchange.ShuffleExchangeExec(

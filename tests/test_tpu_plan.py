@@ -366,3 +366,53 @@ def test_window_range_frame_minmax_falls_back():
     ex = apply_overrides(wnode, RapidsConf())
     assert isinstance(ex, CpuFallbackExec)
     assert_cpu_and_tpu_equal(wnode, require_on_tpu=False)
+
+
+def test_filter_fuses_into_aggregate():
+    """Aggregate over Filter fuses the keep-mask into the groupby
+    (no FilterExec in the exec tree); results match the oracle
+    including all-rows-filtered and empty-global-agg cases."""
+    from spark_rapids_tpu.execs.aggregate import HashAggregateExec
+    from spark_rapids_tpu.execs.basic import FilterExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    rng = np.random.default_rng(31)
+    n = 500
+    plan = scan({"k": rng.integers(0, 9, n).astype(np.int64),
+                 "v": rng.random(n)},
+                {"v": rng.random(n) > 0.1})
+    cond = GreaterThan(ref(1, dt.FLOAT64), Literal(0.4))
+    agg = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(Sum(ref(1, dt.FLOAT64)), "sv"),
+         pn.AggCall(Count(ref(1, dt.FLOAT64)), "cv")],
+        pn.FilterNode(cond, plan), grouping_names=["k"])
+    ex = apply_overrides(agg, RapidsConf())
+
+    def find(e, klass):
+        out = [e] if isinstance(e, klass) else []
+        for c in e.children:
+            out += find(c, klass)
+        return out
+
+    assert not find(ex, FilterExec), "filter must fuse into the agg"
+    aggs = find(ex, HashAggregateExec)
+    assert any(a.fused_filter is not None for a in aggs)
+    assert_cpu_and_tpu_equal(agg, approx_float=1e-9)
+
+    # filter that drops everything: grouped -> zero rows
+    agg_none = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(Count(ref(1, dt.FLOAT64)), "cv")],
+        pn.FilterNode(GreaterThan(ref(1, dt.FLOAT64), Literal(2.0)),
+                      plan),
+        grouping_names=["k"])
+    assert_cpu_and_tpu_equal(agg_none)
+
+    # global aggregate over all-filtered input: count=0, sum NULL
+    glob = pn.AggregateNode(
+        [], [pn.AggCall(Sum(ref(1, dt.FLOAT64)), "sv"),
+             pn.AggCall(Count(ref(1, dt.FLOAT64)), "cv")],
+        pn.FilterNode(GreaterThan(ref(1, dt.FLOAT64), Literal(2.0)),
+                      plan))
+    assert_cpu_and_tpu_equal(glob)
