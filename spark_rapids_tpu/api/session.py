@@ -17,9 +17,18 @@ class Session:
     Session IS the host (standalone framework), and acceleration gates
     ride the same rapids.tpu.* keys."""
 
-    def __init__(self, conf: Optional[Dict] = None):
+    def __init__(self, conf: Optional[Dict] = None,
+                 initialize_runtime: bool = False):
         self.conf = conf if isinstance(conf, RapidsConf) else \
             RapidsConf(conf)
+        if initialize_runtime:
+            # executor-init analogue: device acquisition, HBM budget,
+            # global spill catalog + semaphore (runtime/device.py)
+            from spark_rapids_tpu import runtime
+
+            self.runtime = runtime.initialize(self.conf)
+        else:
+            self.runtime = None
 
     # -- readers ----------------------------------------------------------
 

@@ -268,3 +268,42 @@ def test_full_pipeline_on_files(tmp_path):
          pn.AggCall(A.Count(BoundReference(0, dt.INT64)), "cnt_i")],
         filt)
     assert_cpu_and_tpu_equal(agg, approx_float=1e-6)
+
+
+def test_csv_delimiter_and_multifile(tmp_path):
+    for k in range(3):
+        with open(tmp_path / f"f{k}.csv", "w") as f:
+            f.write("a|b\n")
+            for i in range(5):
+                f.write(f"{k * 10 + i}|x{i}\n")
+    schema = Schema(["a", "b"], [dt.INT64, dt.STRING])
+    src = CsvSource(str(tmp_path), schema=schema, delimiter="|")
+    assert src.num_splits() == 3
+    plan = pn.ScanNode(src)
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_orc_projection_and_write(tmp_path):
+    from pyarrow import orc
+
+    orc.write_table(_mixed_table(200), str(tmp_path / "d.orc"))
+    src = OrcSource(str(tmp_path / "d.orc"), columns=["f", "b"])
+    assert src.schema().names == ["f", "b"]
+    assert_cpu_and_tpu_equal(pn.ScanNode(src))
+
+
+def test_session_runtime_init(tmp_path):
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu import runtime as rt
+    from spark_rapids_tpu.memory.catalog import get_catalog
+
+    try:
+        s = Session({"rapids.tpu.memory.spillDir": str(tmp_path),
+                     "rapids.tpu.sql.concurrentTpuTasks": 3},
+                    initialize_runtime=True)
+        assert s.runtime is not None
+        assert s.runtime.catalog is get_catalog()
+        df = s.create_dataframe({"x": [1, 2, 3]})
+        assert df.count() == 3
+    finally:
+        rt.shutdown()
