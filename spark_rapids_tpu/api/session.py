@@ -23,12 +23,34 @@ class Session:
             RapidsConf(conf)
         if initialize_runtime:
             # executor-init analogue: device acquisition, HBM budget,
-            # global spill catalog + semaphore (runtime/device.py)
+            # global spill catalog + semaphore (runtime/device.py).
+            # The runtime is PROCESS-GLOBAL (one chip, one catalog):
+            # initializing a second Session replaces it, so refuse while
+            # another Session still owns it — stop() that one first.
             from spark_rapids_tpu import runtime
 
+            current = runtime.get_env()
+            if current is not None and \
+                    getattr(current, "_owner", None) is not None:
+                raise RuntimeError(
+                    "another Session owns the runtime; call its "
+                    ".stop() before initializing a new one")
             self.runtime = runtime.initialize(self.conf)
+            self.runtime._owner = self
         else:
             self.runtime = None
+
+    def stop(self) -> None:
+        """Release the process-global runtime this Session initialized
+        (SparkSession.stop analogue). No-op for sessions that did not
+        initialize it."""
+        if self.runtime is None:
+            return
+        from spark_rapids_tpu import runtime
+
+        if runtime.get_env() is self.runtime:
+            runtime.shutdown()
+        self.runtime = None
 
     # -- readers ----------------------------------------------------------
 

@@ -283,7 +283,7 @@ def test_csv_delimiter_and_multifile(tmp_path):
     assert_cpu_and_tpu_equal(plan)
 
 
-def test_orc_projection_and_write(tmp_path):
+def test_orc_projection(tmp_path):
     from pyarrow import orc
 
     orc.write_table(_mixed_table(200), str(tmp_path / "d.orc"))
@@ -292,18 +292,30 @@ def test_orc_projection_and_write(tmp_path):
     assert_cpu_and_tpu_equal(pn.ScanNode(src))
 
 
-def test_session_runtime_init(tmp_path):
+def test_session_runtime_lifecycle(tmp_path):
     from spark_rapids_tpu.api import Session
-    from spark_rapids_tpu import runtime as rt
+    from spark_rapids_tpu.memory import semaphore as sem
     from spark_rapids_tpu.memory.catalog import get_catalog
 
+    s = Session({"rapids.tpu.memory.spillDir": str(tmp_path),
+                 "rapids.tpu.sql.concurrentTpuTasks": 3},
+                initialize_runtime=True)
     try:
-        s = Session({"rapids.tpu.memory.spillDir": str(tmp_path),
-                     "rapids.tpu.sql.concurrentTpuTasks": 3},
-                    initialize_runtime=True)
         assert s.runtime is not None
         assert s.runtime.catalog is get_catalog()
-        df = s.create_dataframe({"x": [1, 2, 3]})
-        assert df.count() == 3
+        # conf actually reached the global wiring
+        assert sem.get()._max == 3
+        assert get_catalog()._spill_dir == str(tmp_path)
+        # a second runtime-owning Session must be refused while this
+        # one is alive (the runtime is process-global)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="owns the runtime"):
+            Session({}, initialize_runtime=True)
+        assert s.create_dataframe({"x": [1, 2, 3]}).count() == 3
     finally:
-        rt.shutdown()
+        s.stop()
+    assert s.runtime is None
+    # after stop, a new owner may initialize
+    s2 = Session({}, initialize_runtime=True)
+    s2.stop()
