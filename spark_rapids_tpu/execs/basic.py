@@ -56,6 +56,27 @@ class ScanExec(TpuExec):
         return timed(self, it())
 
 
+class DeviceBatchesExec(TpuExec):
+    """Serves pre-existing device batches without any host round trip
+    (the InternalColumnarRddConverter ingestion path)."""
+
+    def __init__(self, source, schema: Schema):
+        super().__init__([], schema)
+        self.source = source
+
+    @property
+    def num_partitions(self) -> int:
+        return max(len(self.source.batches), 1)
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            if not self.source.batches:
+                yield ColumnarBatch.empty(self.schema)
+                return
+            yield self.source.batches[partition]
+        return timed(self, it())
+
+
 class ProjectExec(TpuExec):
     """One fused XLA computation per batch (GpuProjectExec,
     basicPhysicalOperators.scala:35-95)."""

@@ -1,9 +1,12 @@
 """ML framework handoff (the ColumnarRdd / InternalColumnarRddConverter
 surface, SURVEY.md §2.6: ColumnarRdd.scala:20-49 exposes RDD[Table] so
 XGBoost builds DMatrix from GPU memory without a row round-trip)."""
-from spark_rapids_tpu.ml.handoff import (batch_to_torch,
+from spark_rapids_tpu.ml.handoff import (DeviceBatchesSource,
+                                         batch_to_torch,
                                          collect_feature_matrix,
-                                         exec_to_device_matrices)
+                                         exec_to_device_matrices,
+                                         from_device_arrays)
 
-__all__ = ["batch_to_torch", "collect_feature_matrix",
-           "exec_to_device_matrices"]
+__all__ = ["DeviceBatchesSource", "batch_to_torch",
+           "collect_feature_matrix", "exec_to_device_matrices",
+           "from_device_arrays"]

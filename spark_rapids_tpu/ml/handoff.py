@@ -56,6 +56,77 @@ def collect_feature_matrix(exec_: TpuExec) -> jax.Array:
     return jnp.concatenate(mats, axis=0)
 
 
+from spark_rapids_tpu.plan.nodes import DataSource
+
+
+class DeviceBatchesSource(DataSource):
+    """DataSource over ALREADY-DEVICE-RESIDENT batches — the reverse
+    ColumnarRdd path (InternalColumnarRddConverter.scala: build a
+    DataFrame from a GPU RDD without a row round trip). The TPU exec
+    yields the batches as-is; only the CPU oracle materializes host
+    copies."""
+
+    def __init__(self, batches, schema):
+        self.batches = list(batches)
+        self._schema = schema
+
+    def schema(self):
+        return self._schema
+
+    def num_splits(self) -> int:
+        return max(len(self.batches), 1)
+
+    def read_host_split(self, split: int):
+        from spark_rapids_tpu.execs.interop import batch_to_frame
+        from spark_rapids_tpu.io.arrow_conv import empty_host
+
+        if not self.batches:
+            return empty_host(self._schema)
+        frame = batch_to_frame(self.batches[split], self._schema)
+        data, validity = {}, {}
+        for i, name in enumerate(self._schema.names):
+            c = frame.cols[i]
+            data[name] = c.data
+            validity[name] = c.valid_mask()
+        return data, validity
+
+    def read_host(self):
+        from spark_rapids_tpu.io.arrow_conv import concat_host
+
+        return concat_host([self.read_host_split(i)
+                            for i in range(len(self.batches))],
+                           self._schema)
+
+
+def from_device_arrays(session, arrays, names: List[str],
+                       dtypes: List[dt.DType], validities=None):
+    """DataFrame over jax (or dlpack-importable, e.g. torch) device
+    arrays — zero-copy where backends share memory."""
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    from spark_rapids_tpu.columnar.batch import Schema
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.plan import nodes as pn
+
+    cols = []
+    n = None
+    vin = validities or [None] * len(arrays)
+    for a, t, v in zip(arrays, dtypes, vin):
+        if not isinstance(a, jax.Array):
+            try:
+                a = jnp.from_dlpack(a)
+            except Exception:
+                import numpy as _np
+
+                a = jnp.asarray(_np.asarray(a))
+        n = int(a.shape[0]) if n is None else n
+        cols.append(Column(t, a.astype(t.kernel_dtype),
+                           None if v is None else jnp.asarray(v)))
+    batch = ColumnarBatch(cols, n or 0)
+    schema = Schema(names, dtypes)
+    src = DeviceBatchesSource([batch], schema)
+    return DataFrame(pn.ScanNode(src), session)
+
+
 def batch_to_torch(batch: ColumnarBatch, schema_types: List[dt.DType]):
     """Device batch -> dict of torch tensors, dlpack zero-copy when the
     backends share memory (CPU<->CPU), explicit copy otherwise."""

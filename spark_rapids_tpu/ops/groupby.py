@@ -266,8 +266,14 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: List[AggSpec],
     """Whole-batch reduction (no keys): grand aggregates
     (aggregate.scala:488-501 reduction path). Returns a 1-row batch."""
     if not batch.columns:
-        # rows-only batch: only count(*) is expressible
-        n = batch.realized_num_rows()
+        # rows-only batch: only count(*) is expressible. A fused filter
+        # mask still applies — count the LIVE rows.
+        if live_mask is not None:
+            iota = jnp.arange(live_mask.shape[0], dtype=jnp.int32)
+            n = int(jax.device_get(jnp.sum(
+                live_mask & (iota < batch.num_rows_device()))))
+        else:
+            n = batch.realized_num_rows()
         out_cols = [Column(dt.INT64,
                            jnp.full(128, n, dtype=jnp.int64))
                     for spec in aggs]

@@ -304,6 +304,12 @@ class _ScanRule(NodeRule):
 
     def convert(self, meta, children):
         node: pn.ScanNode = meta.node
+        from spark_rapids_tpu.ml.handoff import DeviceBatchesSource
+
+        if isinstance(node.source, DeviceBatchesSource):
+            # already on device: serve as-is, no host round trip
+            return basic.DeviceBatchesExec(node.source,
+                                           node.output_schema())
         rows = meta.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)
         return basic.ScanExec(node.source, node.output_schema(),
                               batch_rows=rows)
