@@ -139,8 +139,7 @@ class Column:
         vals = list(values[0]) if len(values) == 1 and \
             isinstance(values[0], (list, tuple, set)) else list(values)
         return Column(lambda s: pr.In(self.resolve(s),
-                                      [Literal(v) for v in sorted(
-                                          vals, key=repr)]))
+                                      sorted(vals, key=repr)))
 
     def between(self, lo, hi) -> "Column":
         return (self >= lo) & (self <= hi)

@@ -313,7 +313,12 @@ class In(Expression):
 
     def __init__(self, child: Expression, values: List):
         super().__init__([child])
-        self.values = values
+        from spark_rapids_tpu.expressions.base import Literal
+
+        # contract: raw python values; unwrap Literal wrappers so both
+        # calling conventions mean the same thing on both engines
+        self.values = [v.value if isinstance(v, Literal) else v
+                       for v in values]
 
     @property
     def dtype(self):

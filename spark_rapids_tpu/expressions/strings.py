@@ -289,6 +289,7 @@ class Like(_StrPredicate):
     def __init__(self, child: Expression, pattern: str, escape: str = "\\"):
         super().__init__(child, pattern)
         self.pattern = pattern
+        self.escape = escape
         regex = []
         i = 0
         while i < len(pattern):

@@ -200,3 +200,58 @@ def test_shift_matrix(op_name, gen):
              op(ref(0, gen.dtype), Literal(0, dt.INT32)),
              op(ref(0, gen.dtype), Literal(65, dt.INT32))]
     assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
+
+
+def test_string_binary_matrix():
+    scan = dg.gen_scan({"s": dg.StringGen(), "t": dg.StringGen()},
+                       n=150, seed=30)
+    s = ref(0, dt.STRING)
+    exprs = [
+        st.Substring(s, 2, 3),
+        st.Substring(s, -3, None),
+        st.StringReplace(s, "a", "ZZ"),
+        st.StringRepeat(s, 2),
+        st.StringLPad(s, 6, "*"),
+        st.StringRPad(s, 6, "*"),
+        st.StartsWith(s, "a"),
+        st.EndsWith(s, "z"),
+        st.Contains(s, "X"),
+        st.Like(s, "a%b_"),
+        st.StringLocate("b", s),
+        st.ConcatStrings([s, ref(1, dt.STRING)]),
+    ]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
+
+
+def test_in_and_null_predicates():
+    scan = dg.gen_scan({"a": dg.IntegerGen(nullable=0.2),
+                        "s": dg.StringGen(nullable=0.2),
+                        "f": dg.DoubleGen(nullable=0.2)}, n=200,
+                       seed=31)
+    exprs = [
+        pr.In(ref(0, dt.INT32), [Literal(v, dt.INT32)
+                                 for v in (0, 7, -12, 2**31 - 1)]),
+        pr.In(ref(1, dt.STRING), [Literal(v) for v in ("ab", "", "X z")]),
+        pr.IsNull(ref(0, dt.INT32)),
+        pr.IsNotNull(ref(1, dt.STRING)),
+        pr.IsNaN(ref(2, dt.FLOAT64)),
+        pr.AtLeastNNonNulls(2, [ref(0, dt.INT32), ref(1, dt.STRING),
+                                ref(2, dt.FLOAT64)]),
+    ]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
+
+
+def test_datetime_arithmetic_matrix():
+    scan = dg.gen_scan({"d": dg.DateGen(), "d2": dg.DateGen(),
+                        "ts": dg.TimestampGen(),
+                        "n": dg.SmallIntGen()}, n=150, seed=32)
+    exprs = [
+        dte.DateAdd(ref(0, dt.DATE), Cast(ref(3, dt.INT64), dt.INT32)),
+        dte.DateSub(ref(0, dt.DATE), Literal(30, dt.INT32)),
+        dte.DateDiff(ref(0, dt.DATE), ref(1, dt.DATE)),
+        dte.Hour(ref(2, dt.TIMESTAMP)),
+        dte.Minute(ref(2, dt.TIMESTAMP)),
+        dte.Second(ref(2, dt.TIMESTAMP)),
+        dte.Year(Cast(ref(2, dt.TIMESTAMP), dt.DATE)),
+    ]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
