@@ -179,6 +179,94 @@ class DataFrame:
             self._plan.holder.unpersist()
         return self
 
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        names = [new if n == old else n for n in self.columns]
+        return self.to_df(*names)
+
+    withColumnRenamed = with_column_renamed
+
+    def to_df(self, *names: str) -> "DataFrame":
+        schema = self.schema
+        assert len(names) == len(schema)
+        exprs = [BoundReference(i, t)
+                 for i, t in enumerate(schema.types)]
+        return self._df(pn.ProjectNode(exprs, self._plan,
+                                       names=list(names)))
+
+    toDF = to_df
+
+    def fillna(self, value, subset: Optional[Sequence[str]] = None
+               ) -> "DataFrame":
+        """Replace NULLs with ``value`` in type-compatible columns
+        (pyspark DataFrameNaFunctions.fill)."""
+        from spark_rapids_tpu.columnar import dtypes as dt
+        from spark_rapids_tpu.expressions.conditional import Coalesce
+        from spark_rapids_tpu.expressions.base import Literal
+
+        schema = self.schema
+        exprs: List[Expression] = []
+        for i, (name, typ) in enumerate(zip(schema.names,
+                                            schema.types)):
+            e: Expression = BoundReference(i, typ)
+            applies = subset is None or name in subset
+            compat = (
+                (isinstance(value, bool) and typ is dt.BOOLEAN) or
+                (isinstance(value, (int, float)) and
+                 not isinstance(value, bool) and typ.is_numeric) or
+                (isinstance(value, str) and typ is dt.STRING))
+            if applies and compat:
+                e = Coalesce([e, Literal(
+                    typ.np_dtype.type(value).item()
+                    if typ.is_numeric and not isinstance(value, bool)
+                    else value, typ)])
+            exprs.append(e)
+        return self._df(pn.ProjectNode(exprs, self._plan,
+                                       names=list(schema.names)))
+
+    def dropna(self, how: str = "any",
+               subset: Optional[Sequence[str]] = None) -> "DataFrame":
+        """Drop rows with NULLs (pyspark DataFrameNaFunctions.drop)."""
+        from spark_rapids_tpu.expressions import predicates as pr
+
+        schema = self.schema
+        cols = [i for i, n in enumerate(schema.names)
+                if subset is None or n in subset]
+        if not cols:
+            return self
+        terms = [pr.IsNotNull(BoundReference(i, schema.types[i]))
+                 for i in cols]
+        cond = terms[0]
+        for t in terms[1:]:
+            cond = pr.And(cond, t) if how == "any" else pr.Or(cond, t)
+        return self._df(pn.FilterNode(cond, self._plan))
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        """Bernoulli row sample via the counter-based rand stream
+        (nondeterministic vs Spark's sampler, so it rides the same
+        incompatibleOps gate as rand())."""
+        from spark_rapids_tpu.expressions import predicates as pr
+        from spark_rapids_tpu.expressions.base import Literal
+        from spark_rapids_tpu.expressions.nondeterministic import Rand
+
+        return self._df(pn.FilterNode(
+            pr.LessThan(Rand(seed), Literal(float(fraction))),
+            self._plan))
+
+    def describe(self, *cols: str):
+        """count/mean/min/max summary of numeric columns (collected)."""
+        from spark_rapids_tpu.api import functions as F
+
+        schema = self.schema
+        targets = [n for n, t in zip(schema.names, schema.types)
+                   if t.is_numeric and (not cols or n in cols)]
+        aggs = []
+        for n in targets:
+            aggs += [F.count(col(n)).alias(f"count({n})"),
+                     F.avg(col(n)).alias(f"mean({n})"),
+                     F.min(col(n)).alias(f"min({n})"),
+                     F.max(col(n)).alias(f"max({n})")]
+        return self.agg(*aggs).collect()
+
     def coalesce(self, num_partitions: int) -> "DataFrame":
         """Shrink partition count without a shuffle."""
         return self._df(pn.CoalescePartitionsNode(num_partitions,

@@ -232,3 +232,40 @@ def test_last_metrics_after_collect(df):
     assert any("Aggregate" in k for k in m)
     agg_key = next(k for k in m if "Aggregate" in k)
     assert m[agg_key]["rows"] > 0
+
+
+def test_na_functions(session):
+    pdf2 = pd.DataFrame({"a": [1.0, None, 3.0, None],
+                         "s": ["x", None, "z", "w"],
+                         "i": [10, 20, 30, 40]})
+    df = session.create_dataframe(pdf2)
+    filled = df.fillna(-1.0, subset=["a"]).collect()
+    assert [float(v) for v in filled["a"]] == [1.0, -1.0, 3.0, -1.0]
+    assert filled["s"][1] is None or pd.isna(filled["s"][1])
+    fs = df.fillna("??").collect()
+    assert list(fs["s"]) == ["x", "??", "z", "w"]
+    assert pd.isna(fs["a"][1])  # numeric untouched by a string fill
+    assert df.dropna().count() == 2           # rows 0 and 2
+    assert df.dropna(subset=["a"]).count() == 2
+    assert df.dropna(how="all").count() == 4  # 'i' is never null
+
+
+def test_rename_and_todf(df):
+    r = df.with_column_renamed("v", "value")
+    assert r.columns == ["k", "value", "s"]
+    t = df.to_df("c1", "c2", "c3")
+    assert t.columns == ["c1", "c2", "c3"]
+    assert len(t.collect()) == 400
+
+
+def test_sample_and_describe(session, pdf):
+    s2 = Session({"rapids.tpu.sql.incompatibleOps.enabled": True})
+    df = s2.create_dataframe(pdf)
+    frac = df.sample(0.3, seed=5).count() / len(pdf)
+    assert 0.2 < frac < 0.4
+    # deterministic per seed
+    assert df.sample(0.3, seed=5).count() == \
+        df.sample(0.3, seed=5).count()
+    d = session.create_dataframe(pdf).describe("v")
+    assert int(d["count(v)"].iloc[0]) == len(pdf)
+    assert abs(float(d["mean(v)"].iloc[0]) - pdf.v.mean()) < 1e-9
