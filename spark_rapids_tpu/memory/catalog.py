@@ -33,6 +33,7 @@ from typing import Dict, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar import serde
+from spark_rapids_tpu.memory.hashed_pq import HashedPriorityQueue
 
 
 class StorageTier(enum.IntEnum):
@@ -79,6 +80,10 @@ class BufferCatalog:
         self._spill_dir = spill_dir
         self._device_bytes = 0
         self._host_bytes = 0
+        # per-tier spill-victim queues keyed by (priority, seq): O(log n)
+        # victim selection instead of full scans (HashedPriorityQueue.java
+        # analogue). Entries are queued only while refcount == 0.
+        self._queues = {t: HashedPriorityQueue() for t in StorageTier}
         self.spilled_device_bytes = 0  # task-metric accounting
         self.spilled_host_bytes = 0
 
@@ -90,9 +95,10 @@ class BufferCatalog:
         size = batch.device_memory_size()
         with self._lock:
             bid = next(self._ids)
-            self._entries[bid] = _Entry(bid, priority, batch, size,
-                                        next(self._seq))
+            e = _Entry(bid, priority, batch, size, next(self._seq))
+            self._entries[bid] = e
             self._device_bytes += size
+            self._queues[StorageTier.DEVICE].push(e, (e.priority, e.seq))
         self._maybe_spill_async()
         return bid
 
@@ -105,11 +111,15 @@ class BufferCatalog:
             if e is None:
                 raise KeyError(f"buffer {buffer_id} not in catalog")
             e.refcount += 1
+            if e.refcount == 1:
+                self._queues[e.tier].remove(e)  # pinned: not a victim
         try:
             return self._ensure_device(e)
         except BaseException:
             with self._lock:
                 e.refcount -= 1
+                if e.refcount == 0 and buffer_id in self._entries:
+                    self._requeue(e)
             raise
 
     def release(self, buffer_id: int) -> None:
@@ -124,6 +134,8 @@ class BufferCatalog:
                 self._entries.pop(buffer_id, None)
                 self._drop_tier_bytes(e)
                 path = e.disk_path
+            elif e.refcount == 0:
+                self._requeue(e)
         if path and os.path.exists(path):
             os.unlink(path)
 
@@ -140,6 +152,7 @@ class BufferCatalog:
                 e.pending_remove = True
                 return
             self._entries.pop(buffer_id, None)
+            self._queues[e.tier].remove(e)
             self._drop_tier_bytes(e)
             path = e.disk_path
         if path and os.path.exists(path):
@@ -150,6 +163,8 @@ class BufferCatalog:
             e = self._entries.get(buffer_id)
             if e is not None:
                 e.priority = priority
+                if e in self._queues[e.tier]:
+                    self._queues[e.tier].update(e, (priority, e.seq))
 
     # -- introspection ----------------------------------------------------
 
@@ -208,14 +223,17 @@ class BufferCatalog:
         return self.synchronous_spill(0)
 
     def _pick_spill_victim(self, tier: StorageTier) -> Optional[_Entry]:
-        """Called under lock. Min (priority, seq) unpinned entry in tier."""
-        best = None
-        for e in self._entries.values():
-            if e.tier is not tier or e.refcount > 0:
-                continue
-            if best is None or (e.priority, e.seq) < (best.priority, best.seq):
-                best = e
-        return best
+        """Called under lock. Min (priority, seq) unpinned entry in
+        tier — POPPED from its queue; the spill paths (or the release
+        path after a raced acquire) requeue it at its landing tier."""
+        return self._queues[tier].pop()
+
+    def _requeue(self, e: _Entry) -> None:
+        """Called under lock with refcount == 0: (re-)expose the entry
+        as a spill victim at its current tier."""
+        q = self._queues[e.tier]
+        if e not in q:
+            q.push(e, (e.priority, e.seq))
 
     def _spill_device_entry(self, e: _Entry) -> int:
         batch = e.device_batch
@@ -232,6 +250,7 @@ class BufferCatalog:
             self._device_bytes -= e.size
             self._host_bytes += e.size
             self.spilled_device_bytes += e.size
+            self._requeue(e)  # now a host-tier victim
         # host store may itself now exceed budget → cascade to disk
         if self.host_budget is not None:
             self.spill_host_to_disk(self.host_budget)
@@ -264,6 +283,7 @@ class BufferCatalog:
             e.tier = StorageTier.DISK
             self._host_bytes -= e.size
             self.spilled_host_bytes += e.size
+            self._requeue(e)  # disk entries stay tracked (removal)
         return e.size
 
     def _ensure_device(self, e: _Entry) -> ColumnarBatch:

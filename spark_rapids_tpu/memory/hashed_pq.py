@@ -1,0 +1,98 @@
+"""Hashed priority queue: O(log n) push/pop, O(1) membership, O(log n)
+arbitrary removal and priority update (the reference ships a dedicated
+HashedPriorityQueue.java for exactly this — spill victim selection must
+not degrade to linear scans as buffer counts grow).
+
+Min-heap over (priority, seq) with an index map entry -> heap slot,
+maintained through sift operations."""
+from __future__ import annotations
+
+from typing import Dict, Generic, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+class HashedPriorityQueue(Generic[T]):
+    def __init__(self):
+        self._heap: List[Tuple[Tuple, T]] = []
+        self._pos: Dict[T, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __contains__(self, item: T) -> bool:
+        return item in self._pos
+
+    def push(self, item: T, key: Tuple) -> None:
+        assert item not in self._pos, f"{item} already queued"
+        self._heap.append((key, item))
+        self._pos[item] = len(self._heap) - 1
+        self._sift_up(len(self._heap) - 1)
+
+    def peek(self) -> Optional[T]:
+        return self._heap[0][1] if self._heap else None
+
+    def pop(self) -> Optional[T]:
+        if not self._heap:
+            return None
+        item = self._heap[0][1]
+        self._remove_at(0)
+        return item
+
+    def remove(self, item: T) -> bool:
+        i = self._pos.get(item)
+        if i is None:
+            return False
+        self._remove_at(i)
+        return True
+
+    def update(self, item: T, key: Tuple) -> None:
+        i = self._pos.get(item)
+        if i is None:
+            self.push(item, key)
+            return
+        old = self._heap[i][0]
+        self._heap[i] = (key, item)
+        if key < old:
+            self._sift_up(i)
+        else:
+            self._sift_down(i)
+
+    # -- internals --------------------------------------------------------
+
+    def _remove_at(self, i: int) -> None:
+        last = len(self._heap) - 1
+        item = self._heap[i][1]
+        if i != last:
+            self._swap(i, last)
+        self._heap.pop()
+        del self._pos[item]
+        if i <= last - 1 and i < len(self._heap):
+            self._sift_up(i)
+            self._sift_down(i)
+
+    def _swap(self, a: int, b: int) -> None:
+        self._heap[a], self._heap[b] = self._heap[b], self._heap[a]
+        self._pos[self._heap[a][1]] = a
+        self._pos[self._heap[b][1]] = b
+
+    def _sift_up(self, i: int) -> None:
+        while i > 0:
+            parent = (i - 1) // 2
+            if self._heap[i][0] < self._heap[parent][0]:
+                self._swap(i, parent)
+                i = parent
+            else:
+                return
+
+    def _sift_down(self, i: int) -> None:
+        n = len(self._heap)
+        while True:
+            best = i
+            for c in (2 * i + 1, 2 * i + 2):
+                if c < n and self._heap[c][0] < self._heap[best][0]:
+                    best = c
+            if best == i:
+                return
+            self._swap(i, best)
+            i = best

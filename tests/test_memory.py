@@ -247,3 +247,61 @@ class TestCatalogRaces:
                 pass
             assert sem.holds()  # outer still holds after inner exit
         assert not sem.holds()
+
+
+def test_hashed_priority_queue():
+    from spark_rapids_tpu.memory.hashed_pq import HashedPriorityQueue
+
+    q = HashedPriorityQueue()
+    items = [(f"b{i}", ((i * 7) % 5, i)) for i in range(50)]
+    for it, key in items:
+        q.push(it, key)
+    assert len(q) == 50 and "b3" in q
+    # removal of arbitrary members
+    assert q.remove("b3") and not q.remove("b3")
+    # priority update resorts
+    q.update("b10", (-1, 0))
+    assert q.peek() == "b10"
+    # pops come out in key order
+    order = [q.pop() for _ in range(len(q))]
+    keys = dict(items)
+    assert order[0] == "b10"
+    rest = order[1:]
+    assert rest == sorted(rest, key=lambda it: keys[it])
+    assert q.pop() is None
+
+
+def test_victim_selection_uses_queues(tmp_path):
+    """Spill order: lowest (priority, seq) first, pinned entries skipped,
+    re-exposed after release."""
+    import numpy as np
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.memory.catalog import (BufferCatalog,
+                                                 StorageTier)
+
+    cat = BufferCatalog(spill_dir=str(tmp_path))
+
+    def mk(n):
+        return ColumnarBatch(
+            [Column.from_numpy(np.arange(n, dtype=np.int64))], n)
+
+    hi = cat.register(mk(256), priority=100)
+    lo = cat.register(mk(256), priority=0)
+    mid = cat.register(mk(256), priority=50)
+    # pin the lowest-priority entry: it must be skipped
+    cat.acquire(lo)
+    cat.synchronous_spill(cat.device_bytes - 1)  # spill exactly one
+    assert cat.tier_of(mid) is StorageTier.HOST  # mid, not pinned lo
+    assert cat.tier_of(lo) is StorageTier.DEVICE
+    cat.release(lo)
+    cat.synchronous_spill(0)
+    assert cat.tier_of(lo) is StorageTier.HOST
+    assert cat.tier_of(hi) is StorageTier.HOST
+    # everything re-acquirable after the shuffle of tiers
+    for bid in (hi, lo, mid):
+        b = cat.acquire(bid)
+        assert b.realized_num_rows() == 256
+        cat.release(bid)
