@@ -327,6 +327,12 @@ ADVISORY_PARTITION_SIZE = conf(
     "Target bytes per coalesced shuffle partition."
 ).bytes_conf.create_with_default(64 << 20)
 
+PARQUET_DEBUG_DUMP_PREFIX = conf(
+    "rapids.tpu.sql.parquet.debug.dumpPrefix").doc(
+    "When set, copy every parquet file a scan reads under this directory "
+    "for offline repro (RapidsConf.scala:575-581 debug dump analogue)."
+).string_conf.create_with_default("")
+
 FILTER_PUSHDOWN_ENABLED = conf(
     "rapids.tpu.sql.format.pushDownFilters.enabled").doc(
     "Push comparison conjuncts from a Filter above a file scan into the "

@@ -107,8 +107,24 @@ class ParquetSource(FileSourceBase):
     def _read_split(self, desc: _RgSplit):
         import pyarrow.parquet as pq
 
+        self._maybe_debug_dump(desc.path)
         f = pq.ParquetFile(desc.path)
         schema = self.schema()
         return f.read_row_groups(list(desc.row_groups),
                                  columns=list(schema.names),
                                  use_threads=False)
+
+    def _maybe_debug_dump(self, path: str) -> None:
+        """Copy read inputs for offline repro when
+        rapids.tpu.sql.parquet.debug.dumpPrefix is set
+        (GpuParquetScan dumpPrefix analogue)."""
+        import os
+        import shutil
+
+        prefix = self.conf.get(cfg.PARQUET_DEBUG_DUMP_PREFIX)
+        if not prefix:
+            return
+        os.makedirs(prefix, exist_ok=True)
+        dest = os.path.join(prefix, os.path.basename(path))
+        if not os.path.exists(dest):
+            shutil.copyfile(path, dest)

@@ -1,0 +1,63 @@
+"""Address-space sub-allocator (AddressSpaceAllocator.scala analogue):
+carves variable-length blocks out of ONE registered root buffer — the
+reference uses it to hand out bounce buffers from a single
+RDMA-registered allocation. First-fit with free-block coalescing."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class AddressSpaceAllocator:
+    def __init__(self, size: int):
+        assert size > 0
+        self.size = size
+        self._lock = threading.Lock()
+        self._free: List[Tuple[int, int]] = [(0, size)]  # (offset, len)
+        self._allocated: Dict[int, int] = {}             # offset -> len
+
+    def allocate(self, length: int) -> Optional[int]:
+        """Returns the block's offset, or None when fragmented/full."""
+        if length <= 0:
+            raise ValueError("length must be positive")
+        with self._lock:
+            for i, (off, flen) in enumerate(self._free):
+                if flen >= length:
+                    if flen == length:
+                        self._free.pop(i)
+                    else:
+                        self._free[i] = (off + length, flen - length)
+                    self._allocated[off] = length
+                    return off
+            return None
+
+    def free(self, offset: int) -> None:
+        with self._lock:
+            length = self._allocated.pop(offset, None)
+            if length is None:
+                raise KeyError(f"offset {offset} not allocated")
+            self._free.append((offset, length))
+            self._free.sort()
+            # coalesce adjacent free blocks
+            merged: List[Tuple[int, int]] = []
+            for off, flen in self._free:
+                if merged and merged[-1][0] + merged[-1][1] == off:
+                    merged[-1] = (merged[-1][0], merged[-1][1] + flen)
+                else:
+                    merged.append((off, flen))
+            self._free = merged
+
+    @property
+    def allocated_bytes(self) -> int:
+        with self._lock:
+            return sum(self._allocated.values())
+
+    @property
+    def available_bytes(self) -> int:
+        with self._lock:
+            return sum(flen for _, flen in self._free)
+
+    @property
+    def largest_free_block(self) -> int:
+        with self._lock:
+            return max((flen for _, flen in self._free), default=0)

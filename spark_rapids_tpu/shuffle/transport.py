@@ -242,21 +242,47 @@ class _InflightThrottle:
 
 
 class BounceBufferPool:
-    """Fixed-count pool of receive windows (BounceBufferManager
-    analogue). A window must be borrowed for every in-flight chunk, so
-    chunk concurrency is bounded by pool size like the reference's
-    registered bounce buffers."""
+    """Fixed-count pool of receive windows carved from ONE root buffer
+    by an address-space sub-allocator (BounceBufferManager +
+    AddressSpaceAllocator analogues: the reference registers a single
+    allocation with UCX and sub-allocates bounce buffers from it). A
+    window must be borrowed for every in-flight chunk, so chunk
+    concurrency is bounded like the registered bounce buffers."""
 
     def __init__(self, count: int, size: int):
+        from spark_rapids_tpu.memory.address_space import \
+            AddressSpaceAllocator
+
         self.size = size
+        self._root = bytearray(count * size)
+        self._alloc = AddressSpaceAllocator(count * size)
         self._sem = threading.Semaphore(count)
 
     def borrow(self):
         self._sem.acquire()
-        return bytearray(self.size)
+        off = self._alloc.allocate(self.size)
+        assert off is not None  # semaphore bounds outstanding windows
+        return _BounceWindow(self, off)
 
-    def give_back(self, buf) -> None:
+    def give_back(self, window: "_BounceWindow") -> None:
+        self._alloc.free(window.offset)
         self._sem.release()
+
+
+class _BounceWindow:
+    """A borrowed slice of the pool's root buffer."""
+
+    __slots__ = ("offset", "view")
+
+    def __init__(self, pool: BounceBufferPool, offset: int):
+        self.offset = offset
+        self.view = memoryview(pool._root)[offset:offset + pool.size]
+
+    def __getitem__(self, s):
+        return self.view[s]
+
+    def __setitem__(self, s, value):
+        self.view[s] = value
 
 
 class ShuffleClient:

@@ -319,3 +319,13 @@ def test_session_runtime_lifecycle(tmp_path):
     # after stop, a new owner may initialize
     s2 = Session({}, initialize_runtime=True)
     s2.stop()
+
+
+def test_parquet_debug_dump(tmp_path, pq_file):
+    import os
+
+    dump = tmp_path / "dump"
+    src = ParquetSource(pq_file, conf=RapidsConf(
+        {"rapids.tpu.sql.parquet.debug.dumpPrefix": str(dump)}))
+    src.read_host()
+    assert os.listdir(dump) == ["data.parquet"]

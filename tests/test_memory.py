@@ -305,3 +305,26 @@ def test_victim_selection_uses_queues(tmp_path):
         b = cat.acquire(bid)
         assert b.realized_num_rows() == 256
         cat.release(bid)
+
+
+def test_address_space_allocator():
+    from spark_rapids_tpu.memory.address_space import \
+        AddressSpaceAllocator
+
+    a = AddressSpaceAllocator(1000)
+    o1 = a.allocate(400)
+    o2 = a.allocate(400)
+    assert {o1, o2} == {0, 400}
+    assert a.allocate(400) is None  # only 200 left
+    o3 = a.allocate(200)
+    assert o3 == 800 and a.available_bytes == 0
+    a.free(o2)
+    # coalescing: freeing the middle then an end must merge
+    a.free(o3)
+    assert a.largest_free_block == 600
+    assert a.allocate(600) == 400
+    a.free(o1)
+    import pytest as _p
+
+    with _p.raises(KeyError):
+        a.free(123)
