@@ -55,6 +55,9 @@ class ShuffleExchangeExec(TpuExec):
         if kind == "range":
             specs: List[SortKeySpec] = list(self.partitioning[1])
             bounds = self.partitioning[2]
+            if len(specs) > 1:
+                return part_ops.range_partition_multi(
+                    b, specs, types, bounds, self.num_out_partitions)
             return part_ops.range_partition(b, specs, types, bounds,
                                             self.num_out_partitions)
         if kind == "single":
@@ -76,9 +79,15 @@ class ShuffleExchangeExec(TpuExec):
             staged = [SpillableBatch(
                 b, priorities.INPUT_FROM_SHUFFLE_PRIORITY)
                 for b in source]
-            bounds = part_ops.sample_range_bounds_multi(
-                staged, list(self.partitioning[1]),
-                list(self.schema.types), self.num_out_partitions)
+            specs = list(self.partitioning[1])
+            if len(specs) > 1:
+                bounds = part_ops.sample_range_bounds_rows(
+                    staged, specs, list(self.schema.types),
+                    self.num_out_partitions)
+            else:
+                bounds = part_ops.sample_range_bounds_multi(
+                    staged, specs, list(self.schema.types),
+                    self.num_out_partitions)
             self.partitioning = ("range", self.partitioning[1], bounds)
             source = self._drain_staged(staged)
         blocks: Dict[int, List[SpillableBatch]] = {

@@ -93,6 +93,153 @@ def range_partition(batch: ColumnarBatch, specs: List[SortKeySpec],
     return _split_by_pid(batch, pid, num_partitions)
 
 
+def _col_cmp_vs_bound(col, t: dt.DType, spec: SortKeySpec, bval):
+    """(gt, lt) boolean arrays: each row's key vs one scalar bound under
+    the spec's ordering (direction + null ordering + NaN-greatest +
+    -0.0 == 0.0). ``bval`` None = null bound."""
+    from spark_rapids_tpu.columnar.column import StringColumn
+
+    cap = col.capacity
+    valid = col.validity if col.validity is not None else \
+        jnp.ones(cap, dtype=bool)
+    zeros = jnp.zeros(cap, dtype=bool)
+    if bval is None:
+        # null bound: non-null rows compare after it under NULLS FIRST,
+        # before it under NULLS LAST; null rows are equal to it
+        if spec.nulls_first:
+            return valid, zeros
+        return zeros, valid
+    if isinstance(col, StringColumn):
+        d = col.dictionary.astype(str) if len(col.dictionary) else \
+            np.array([], dtype=str)
+        p = int(np.searchsorted(d, str(bval), side="left"))
+        bound_present = p < len(d) and d[p] == str(bval)
+        code = col.data
+        raw_gt = (code > p) | ((code == p) & (not bound_present))
+        raw_lt = code < p
+    else:
+        x = col.data
+        isnan = zeros
+        if t.is_floating:
+            x = sortkeys.canonicalize_floats(x)
+            isnan = jnp.isnan(x)
+        b = t.np_dtype.type(bval)
+        if t.is_floating and np.isnan(b):
+            raw_gt = zeros
+            raw_lt = ~isnan  # NaN == NaN; everything else < NaN
+        else:
+            raw_gt = (x > b) | isnan  # NaN greatest
+            raw_lt = (x < b) & ~isnan
+    if not spec.ascending:
+        raw_gt, raw_lt = raw_lt, raw_gt
+    # null rows: before any non-null bound under NULLS FIRST, after
+    # under NULLS LAST
+    null_lt = jnp.where(valid, raw_lt, spec.nulls_first)
+    null_gt = jnp.where(valid, raw_gt, not spec.nulls_first)
+    return null_gt, null_lt
+
+
+def range_partition_multi(batch: ColumnarBatch,
+                          specs: List[SortKeySpec],
+                          dtypes: List[dt.DType],
+                          bounds: List[tuple], num_partitions: int
+                          ) -> Tuple[ColumnarBatch, np.ndarray]:
+    """Multi-key range partitioning: ``bounds`` is a sorted list of row
+    tuples (one value-or-None per sort spec); each row's partition is
+    the count of bounds <= its key tuple (lexicographic, the same
+    searchsorted-right convention as the single-key path). Bounds is
+    small (num_partitions - 1), so the comparison loop is
+    O(num_partitions * num_keys) fused element-wise ops."""
+    cap = batch.capacity
+    pid = jnp.zeros(cap, dtype=jnp.int32)
+    for bound in bounds:
+        gt = jnp.zeros(cap, dtype=bool)
+        eq = jnp.ones(cap, dtype=bool)
+        for spec, bval in zip(specs, bound):
+            g, l = _col_cmp_vs_bound(batch.columns[spec.ordinal],
+                                     dtypes[spec.ordinal], spec, bval)
+            gt = gt | (eq & g)
+            eq = eq & ~(g | l)
+        pid = pid + (gt | eq).astype(jnp.int32)
+    return _split_by_pid(batch, pid, num_partitions)
+
+
+def sample_range_bounds_rows(staged, specs: List[SortKeySpec],
+                             dtypes: List[dt.DType],
+                             num_partitions: int,
+                             max_sample: int = 100_000) -> List[tuple]:
+    """Multi-key bounds: sample whole key ROWS across the staged input,
+    sort them host-side under the spec ordering, take equi-quantile rows
+    as bound tuples (value or None per key)."""
+    per_batch = max(max_sample // max(len(staged), 1), 1)
+    rng = np.random.default_rng(0x5EED)
+    col_samples = [[] for _ in specs]
+    valid_samples = [[] for _ in specs]
+    for sb in staged:
+        with sb.acquired() as b:
+            n = b.realized_num_rows()
+            idx = np.arange(n) if n <= per_batch else \
+                rng.choice(n, per_batch, replace=False)
+            for j, spec in enumerate(specs):
+                values, validity = b.columns[spec.ordinal].to_numpy(n)
+                values = np.asarray(values)[:n][idx]
+                v = np.ones(len(idx), dtype=bool) if validity is None \
+                    else np.asarray(validity)[:n][idx]
+                col_samples[j].append(values)
+                valid_samples[j].append(v)
+    cols = [np.concatenate(s) if s else np.array([])
+            for s in col_samples]
+    valids = [np.concatenate(s) if s else np.array([], dtype=bool)
+              for s in valid_samples]
+    total = len(cols[0]) if cols else 0
+    if total == 0 or num_partitions <= 1:
+        return []
+    # host lexsort under spec semantics (mirrors cpu engine rank arrays)
+    keys: List[np.ndarray] = []
+    for j in reversed(range(len(specs))):
+        spec = specs[j]
+        t = dtypes[spec.ordinal]
+        vals = cols[j]
+        valid = valids[j]
+        if t is dt.STRING:
+            filled = np.array([x if x is not None else ""
+                               for x in vals], dtype=object)
+            _, codes = np.unique(filled, return_inverse=True)
+            ranked = codes.astype(np.int64)
+            nan_rank = np.zeros(total, dtype=np.int8)
+        elif t.is_floating:
+            f = vals.astype(np.float64)
+            nan_rank = np.isnan(f).astype(np.int8)
+            ranked = np.where(np.isnan(f), 0.0, f + 0.0)
+        else:
+            ranked = vals.astype(np.int64)
+            nan_rank = np.zeros(total, dtype=np.int8)
+        ranked = np.where(valid, ranked, ranked.dtype.type(0))
+        nan_rank = np.where(valid, nan_rank, np.int8(0))
+        null_rank = np.where(valid, 1, 0) if spec.nulls_first else \
+            np.where(valid, 0, 1)
+        if not spec.ascending:
+            ranked = -ranked if t.is_floating else np.invert(ranked)
+            nan_rank = -nan_rank
+        keys.extend([ranked, nan_rank, null_rank])
+    order = np.lexsort(keys)
+    qs = [int(total * (i + 1) / num_partitions)
+          for i in range(num_partitions - 1)]
+    bounds = []
+    for q in np.clip(qs, 0, total - 1):
+        row = order[q]
+        bound = []
+        for j in range(len(specs)):
+            if not valids[j][row]:
+                bound.append(None)
+            else:
+                v = cols[j][row]
+                bound.append(v if isinstance(v, str) or v is None
+                             else v.item() if hasattr(v, "item") else v)
+        bounds.append(tuple(bound))
+    return bounds
+
+
 def sample_range_bounds(batch: ColumnarBatch, spec: SortKeySpec,
                         dtypes: List[dt.DType], num_partitions: int
                         ) -> np.ndarray:

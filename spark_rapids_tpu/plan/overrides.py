@@ -444,13 +444,12 @@ class _SortRule(NodeRule):
         if node.global_sort and child.num_partitions > 1:
             parts = min(meta.conf.get(cfg.SHUFFLE_PARTITIONS),
                         child.num_partitions)
-            if len(node.specs) == 1 and parts > 1:
+            if parts > 1:
                 # distributed global sort: range-partition on sampled
-                # bounds, then sort each (range-ordered) partition — no
+                # bounds (full key tuples for multi-key sorts), then
+                # sort each range-ordered partition — no
                 # single-partition funnel (GpuRangePartitioning +
-                # GpuSortExec, avoiding the SURVEY §5.7 cliff).
-                # Single-key only: multi-key ties could split across a
-                # first-key-only boundary and break the total order.
+                # GpuSortExec, avoiding the SURVEY §5.7 cliff)
                 child = exchange.ShuffleExchangeExec(
                     ("range", list(node.specs), None), parts, child)
             else:

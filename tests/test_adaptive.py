@@ -166,3 +166,38 @@ def test_distributed_sort_descending_strings(tmp_path):
     exec_ = apply_overrides(plan, RapidsConf(
         {"rapids.tpu.sql.test.enabled": True}))
     assert_frames_equal(cpu_df, collect(exec_), sort=False)
+
+
+def test_distributed_multikey_global_sort(tmp_path):
+    """Multi-key global sorts range-partition on full key tuples: ties
+    on the first key must not split across partition boundaries."""
+    rng = np.random.default_rng(7)
+    for k in range(4):
+        n = 300
+        pq.write_table(pa.table({
+            # heavy first-key ties force the lexicographic tiebreak
+            "a": rng.integers(0, 4, n).astype(np.int64),
+            "b": rng.random(n),
+            "s": np.array([f"t{int(x)}" if x > 1 else None
+                           for x in rng.integers(0, 30, n)],
+                          dtype=object),
+        }), tmp_path / f"m{k}.parquet")
+    from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    scan = pn.ScanNode(ParquetSource(str(tmp_path)))
+    plan = pn.SortNode(
+        [SortKeySpec.spark_default(0),
+         SortKeySpec.spark_default(2, ascending=False),
+         SortKeySpec.spark_default(1)], scan)
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+    from spark_rapids_tpu.execs.base import collect
+    from tests.compare import assert_frames_equal
+
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, conf)
+    exchanges = _find(exec_, ShuffleExchangeExec)
+    assert exchanges and exchanges[0].partitioning[0] == "range"
+    assert exchanges[0].num_out_partitions > 1
+    assert_frames_equal(cpu_df, collect(exec_), sort=False)
