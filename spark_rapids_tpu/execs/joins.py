@@ -23,7 +23,7 @@ from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.execs.batching import RequireSingleBatch
 from spark_rapids_tpu.expressions.base import Expression
 from spark_rapids_tpu.expressions.compiler import CompiledFilter
-from spark_rapids_tpu.ops.join import cross_join, equi_join
+from spark_rapids_tpu.ops.join import cross_join, equi_join, nested_loop_join
 from spark_rapids_tpu.utils.tracing import TraceRange
 
 _KIND_MAP = {"inner": "inner", "left": "left", "left_semi": "leftsemi",
@@ -40,9 +40,9 @@ class HashJoinExec(TpuExec):
                  schema: Schema, condition: Optional[Expression] = None,
                  conf=None):
         super().__init__([left, right], schema)
-        assert kind in _KIND_MAP or kind == "cross", kind
+        assert kind in _KIND_MAP, kind  # cross -> nested-loop/cartesian
         if condition is not None:
-            assert kind in ("inner", "cross"), \
+            assert kind == "inner", \
                 "conditioned outer joins must fall back (planner bug)"
         self.kind = kind
         self.left_keys = left_keys
@@ -92,17 +92,12 @@ class HashJoinExec(TpuExec):
                 from spark_rapids_tpu.memory.oom import with_oom_retry
 
                 with TraceRange(f"HashJoinExec.{self.kind}"):
-                    if self.kind == "cross":
-                        out, _ = with_oom_retry(
-                            lambda b=b: cross_join(b, build, left_types,
-                                                   right_types))
-                    else:
-                        out, _ = with_oom_retry(
-                            lambda b=b: equi_join(
-                                b, build, self.left_keys,
-                                self.right_keys, left_types,
-                                right_types,
-                                join_type=_KIND_MAP[self.kind]))
+                    out, _ = with_oom_retry(
+                        lambda b=b: equi_join(
+                            b, build, self.left_keys,
+                            self.right_keys, left_types,
+                            right_types,
+                            join_type=_KIND_MAP[self.kind]))
                 if self.condition is not None:
                     out = self.condition(out)
                 yield out
@@ -119,3 +114,95 @@ class ShuffledHashJoinExec(HashJoinExec):
     """Both children sit below hash ShuffleExchangeExecs on the same keys,
     so partition p of each side holds co-partitioned rows
     (GpuShuffledHashJoinExec)."""
+
+
+class _NestedLoopJoinBase(TpuExec):
+    """Shared body of the brute-force joins: stream the left child's
+    batches against a whole right-side build batch, emitting the cross
+    product with any residual condition fused into the pair expansion
+    (nested_loop_join kernel). Both subclasses are disabled by default at
+    the planner — same OOM-risk stance as the reference
+    (GpuOverrides.scala:1837-1856)."""
+
+    def __init__(self, left: TpuExec, right: TpuExec, schema: Schema,
+                 condition: Optional[Expression] = None, conf=None):
+        super().__init__([left, right], schema)
+        self.condition = CompiledFilter(condition, conf) \
+            if condition is not None else None
+
+    @property
+    def children_coalesce_goal(self):
+        return [None, RequireSingleBatch]
+
+    def _join_batches(self, stream_it, build: ColumnarBatch):
+        left_types = list(self.children[0].schema.types)
+        right_types = list(self.children[1].schema.types)
+        from spark_rapids_tpu.memory.oom import with_oom_retry
+
+        saw = False
+        for b in stream_it:
+            if b.realized_num_rows() == 0 and saw:
+                continue
+            saw = True
+            with TraceRange(self.name):
+                if self.condition is not None and self.condition.fused:
+                    out, _ = with_oom_retry(
+                        lambda b=b: nested_loop_join(
+                            b, build, left_types, right_types,
+                            self.condition.mask,
+                            self.condition.condition.references()))
+                else:
+                    out, _ = with_oom_retry(
+                        lambda b=b: cross_join(b, build, left_types,
+                                               right_types))
+                    if self.condition is not None:
+                        out = self.condition(out)
+            yield out
+
+
+class BroadcastNestedLoopJoinExec(_NestedLoopJoinBase):
+    """Streams the left child's partitions against a broadcast right side
+    (GpuBroadcastNestedLoopJoinExec, sql-plugin/.../execution/
+    GpuBroadcastNestedLoopJoinExec.scala). Inner-with-condition and cross
+    only; left keeps its partitioning."""
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+
+        def it():
+            build = drain_to_single_batch(
+                self.children[1].execute(partition),
+                self.children[1].schema)
+            yield from self._join_batches(
+                self.children[0].execute(partition), build)
+        return timed(self, it())
+
+
+class CartesianProductExec(_NestedLoopJoinBase):
+    """Both sides stay partitioned; the output partition grid is
+    left_partitions x right_partitions, partition p reading
+    (p // right_n, p % right_n) — the RDD-cartesian shape of
+    GpuCartesianProductExec (org/apache/spark/sql/rapids/
+    GpuCartesianProductExec.scala)."""
+
+    @property
+    def num_partitions(self) -> int:
+        return (self.children[0].num_partitions *
+                self.children[1].num_partitions)
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+
+        rn = self.children[1].num_partitions
+        lp, rp = divmod(partition, rn)
+
+        def it():
+            build = drain_to_single_batch(self.children[1].execute(rp),
+                                          self.children[1].schema)
+            yield from self._join_batches(
+                self.children[0].execute(lp), build)
+        return timed(self, it())

@@ -254,3 +254,59 @@ def cross_join(stream: ColumnarBatch, build: ColumnarBatch,
            [c.gather(bi) for c in build.columns]
     return (ColumnarBatch(cols, total),
             list(stream_types) + list(build_types))
+
+
+def nested_loop_join(stream: ColumnarBatch, build: ColumnarBatch,
+                     stream_types, build_types, cond_mask,
+                     referenced: List[int]
+                     ) -> Tuple[ColumnarBatch, List[dt.DType]]:
+    """Cross product with the residual condition fused into pair expansion
+    (GpuBroadcastNestedLoopJoinExec analogue, sql-plugin/.../execution/
+    GpuBroadcastNestedLoopJoinExec.scala — the reference materializes the
+    full product then filters; here only the columns the condition actually
+    reads are gathered at full n_s*n_b width, all remaining columns are
+    gathered once at the compacted match count).
+
+    ``cond_mask`` is a CompiledFilter.mask-style callable batch->bool[cap];
+    ``referenced`` lists the joined-schema ordinals the condition reads."""
+    n_s = stream.realized_num_rows()
+    n_b = build.realized_num_rows()
+    total = n_s * n_b
+    pair_cap = bucket_capacity(max(total, 1))
+    pi, bi, live = _pair_grid(pair_cap, max(n_b, 1), total)
+
+    refset = set(referenced)
+    n_left = len(stream.columns)
+    pair_cols: List[Column] = []
+    for o, (c, t) in enumerate(zip(stream.columns, stream_types)):
+        pair_cols.append(c.gather(pi) if o in refset
+                         else Column.all_null(t, pair_cap))
+    for o, (c, t) in enumerate(zip(build.columns, build_types)):
+        pair_cols.append(c.gather(bi) if (n_left + o) in refset
+                         else Column.all_null(t, pair_cap))
+    keep = cond_mask(ColumnarBatch(pair_cols, total))
+
+    pi_s, bi_s, n_match = _compact_pairs(pi, bi, keep & live)
+    n_match_i = int(jax.device_get(n_match))  # the one host sync
+    out_cap = bucket_capacity(max(n_match_i, 1))
+    pi_s, bi_s = pi_s[:out_cap], bi_s[:out_cap]
+
+    cols = [c.gather(pi_s) for c in stream.columns] + \
+           [c.gather(bi_s) for c in build.columns]
+    return (ColumnarBatch(cols, n_match_i),
+            list(stream_types) + list(build_types))
+
+
+@partial(jax.jit, static_argnames=("pair_cap",))
+def _pair_grid(pair_cap: int, n_b, total):
+    k = jnp.arange(pair_cap, dtype=jnp.int64)
+    pi = (k // n_b).astype(jnp.int32)
+    bi = (k % n_b).astype(jnp.int32)
+    return pi, bi, k < total
+
+
+@jax.jit
+def _compact_pairs(pi, bi, match):
+    order = jnp.argsort(~match, stable=True)
+    return (jnp.take(pi, order), jnp.take(bi, order),
+            jnp.sum(match).astype(jnp.int32))

@@ -487,6 +487,19 @@ class _ExpandRule(NodeRule):
                                 node.output_schema(), meta.conf)
 
 
+_BNLJ_FLAG = cfg.register_op_flag(
+    "exec", "BroadcastNestedLoopJoinExec",
+    "Brute-force cross/conditioned join streaming the left side against a "
+    "broadcast right side; the full pair grid is materialized per batch "
+    "(GpuOverrides.scala:1837-1840 disables it by default for the same "
+    "OOM risk)", default_enabled=False)
+_CARTESIAN_FLAG = cfg.register_op_flag(
+    "exec", "CartesianProductExec",
+    "Brute-force cartesian product over the left x right partition grid "
+    "(GpuOverrides.scala:1841-1856 disables it by default for the same "
+    "OOM risk)", default_enabled=False)
+
+
 class _JoinRule(NodeRule):
     def tag(self, meta: NodeMeta):
         node: pn.JoinNode = meta.node
@@ -495,6 +508,12 @@ class _JoinRule(NodeRule):
             meta.will_not_work(
                 "conditioned outer joins are post-join-filter unsafe "
                 "(GpuHashJoin.scala:285-291 applies the same restriction)")
+        if node.kind == "cross" and not (meta.conf.get(_BNLJ_FLAG) or
+                                         meta.conf.get(_CARTESIAN_FLAG)):
+            meta.will_not_work(
+                "cross joins are disabled by default (OOM risk, "
+                f"GpuOverrides.scala:1837-1856); set {_BNLJ_FLAG.key} or "
+                f"{_CARTESIAN_FLAG.key} to true")
         if node.condition is not None:
             tag_expression(node.condition, meta, meta.conf)
         ls = node.children[0].output_schema()
@@ -531,7 +550,26 @@ class _JoinRule(NodeRule):
     @staticmethod
     def _plan(meta, kind, left, right, lk, rk, cond, out_schema):
         multi = left.num_partitions > 1 or right.num_partitions > 1
-        if kind != "cross" and multi:
+        if kind == "cross":
+            # brute-force joins: nested-loop when the right side is already
+            # a single partition (broadcast is then free) or when the
+            # partition-grid cartesian isn't enabled; a multi-partition
+            # right side with both flags on goes to CartesianProductExec
+            # rather than funneling it whole into one device batch
+            use_bnlj = meta.conf.get(_BNLJ_FLAG) and (
+                right.num_partitions == 1 or
+                not meta.conf.get(_CARTESIAN_FLAG))
+            if use_bnlj:
+                if right.num_partitions > 1:
+                    right = exchange.ShuffleExchangeExec(("single",), 1,
+                                                         right)
+                build = exchange.BroadcastExchangeExec(right)
+                return joins.BroadcastNestedLoopJoinExec(
+                    left, _ReplayExec(build, left.num_partitions),
+                    out_schema, cond, meta.conf)
+            return joins.CartesianProductExec(left, right, out_schema,
+                                              cond, meta.conf)
+        if multi:
             parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
             lex = exchange.ShuffleExchangeExec(("hash", lk), parts, left)
             rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right)
@@ -544,9 +582,6 @@ class _JoinRule(NodeRule):
                 left, right = lex, rex
             return joins.ShuffledHashJoinExec(
                 kind, left, right, lk, rk, out_schema, cond, meta.conf)
-        if kind == "cross" and multi:
-            left = exchange.ShuffleExchangeExec(("single",), 1, left)
-            right = exchange.ShuffleExchangeExec(("single",), 1, right)
         build = exchange.BroadcastExchangeExec(right)
         # broadcast replays its single partition to every stream partition
         return joins.BroadcastHashJoinExec(

@@ -18,6 +18,14 @@ from spark_rapids_tpu.plan import nodes as pn
 RNG = np.random.default_rng(42)
 
 
+def find(e, klass):
+    """All execs of ``klass`` in the converted tree."""
+    out = [e] if isinstance(e, klass) else []
+    for c in e.children:
+        out += find(c, klass)
+    return out
+
+
 def ref(i, t, nullable=True):
     return BoundReference(i, t, nullable)
 
@@ -389,12 +397,6 @@ def test_filter_fuses_into_aggregate():
         pn.FilterNode(cond, plan), grouping_names=["k"])
     ex = apply_overrides(agg, RapidsConf())
 
-    def find(e, klass):
-        out = [e] if isinstance(e, klass) else []
-        for c in e.children:
-            out += find(c, klass)
-        return out
-
     assert not find(ex, FilterExec), "filter must fuse into the agg"
     aggs = find(ex, HashAggregateExec)
     assert any(a.fused_filter is not None for a in aggs)
@@ -416,3 +418,82 @@ def test_filter_fuses_into_aggregate():
         pn.FilterNode(GreaterThan(ref(1, dt.FLOAT64), Literal(2.0)),
                       plan))
     assert_cpu_and_tpu_equal(glob)
+
+
+# ---------------------------------------------------------------------------
+# Brute-force joins (BroadcastNestedLoopJoinExec / CartesianProductExec —
+# GpuOverrides.scala:1837-1856: both disabled by default, OOM risk)
+
+
+def _cross_inputs(nl=40, nr=25, seed=13):
+    rng = np.random.default_rng(seed)
+    left = scan({"a": rng.integers(0, 50, nl).astype(np.int64),
+                 "b": rng.normal(size=nl)},
+                {"a": rng.random(nl) > 0.1})
+    right = scan({"c": rng.integers(0, 50, nr).astype(np.int64),
+                  "d": rng.integers(0, 9, nr).astype(np.int64)},
+                 {"c": rng.random(nr) > 0.1})
+    return left, right
+
+
+def test_cross_join_disabled_by_default():
+    from spark_rapids_tpu.execs.basic import CpuFallbackExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    left, right = _cross_inputs()
+    plan = pn.JoinNode("cross", left, right, [], [])
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert isinstance(exec_, CpuFallbackExec)
+    assert any("disabled by default" in r for r in exec_.reasons)
+    assert_cpu_and_tpu_equal(plan, require_on_tpu=False)
+
+
+@pytest.mark.parametrize("with_cond", [False, True])
+def test_broadcast_nested_loop_join(with_cond):
+    from spark_rapids_tpu.execs.joins import BroadcastNestedLoopJoinExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    left, right = _cross_inputs()
+    cond = GreaterThan(ref(3, dt.INT64), ref(0, dt.INT64)) \
+        if with_cond else None
+    plan = pn.JoinNode("cross", left, right, [], [], condition=cond)
+    conf = RapidsConf(
+        {"rapids.tpu.sql.exec.BroadcastNestedLoopJoinExec": True})
+    exec_ = apply_overrides(plan, conf)
+
+    assert find(exec_, BroadcastNestedLoopJoinExec)
+    assert_cpu_and_tpu_equal(plan, conf)
+
+
+@pytest.mark.parametrize("with_cond", [False, True])
+def test_cartesian_product_partition_grid(with_cond):
+    from spark_rapids_tpu.execs.joins import CartesianProductExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    left, right = _cross_inputs(seed=14)
+    # both sides multi-partition: the output partition grid is l x r
+    left = pn.ShuffleExchangeNode(("round_robin",), 3, left)
+    right = pn.ShuffleExchangeNode(("round_robin",), 2, right)
+    cond = LessThan(ref(1, dt.FLOAT64), Literal(0.3)) if with_cond \
+        else None
+    plan = pn.JoinNode("cross", left, right, [], [], condition=cond)
+    conf = RapidsConf({"rapids.tpu.sql.exec.CartesianProductExec": True})
+    exec_ = apply_overrides(plan, conf)
+
+    carts = find(exec_, CartesianProductExec)
+    assert carts and carts[0].num_partitions == 6
+    assert_cpu_and_tpu_equal(plan, conf)
+
+
+def test_nested_loop_join_string_payload():
+    """Non-referenced payload columns (incl. strings) must survive the
+    fused-condition path untouched."""
+    left = scan({"s": np.array(["x", "y", None, "z"], dtype=object),
+                 "n": np.arange(4, dtype=np.int64)})
+    right = scan({"m": np.array([1, 3], dtype=np.int64),
+                  "t": np.array(["p", None], dtype=object)})
+    cond = GreaterThan(ref(2, dt.INT64), ref(1, dt.INT64))
+    plan = pn.JoinNode("cross", left, right, [], [], condition=cond)
+    conf = RapidsConf(
+        {"rapids.tpu.sql.exec.BroadcastNestedLoopJoinExec": True})
+    assert_cpu_and_tpu_equal(plan, conf)
