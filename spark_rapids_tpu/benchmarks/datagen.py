@@ -19,6 +19,29 @@ SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
                      "MACHINERY"], dtype=object)
 PRIORITIES = np.array(["1-URGENT", "2-HIGH", "3-MEDIUM",
                        "4-NOT SPECIFIED", "5-LOW"], dtype=object)
+SHIP_MODES = np.array(["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP",
+                       "TRUCK"], dtype=object)
+NATIONS = np.array(
+    ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+     "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ", "JAPAN",
+     "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU", "CHINA",
+     "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA", "UNITED KINGDOM",
+     "UNITED STATES"], dtype=object)
+# TPC-H nation -> region mapping (nation.tbl column 2)
+NATION_REGION = np.array([0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0,
+                          0, 1, 2, 3, 4, 2, 3, 3, 1], dtype=np.int64)
+REGIONS = np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE",
+                    "MIDDLE EAST"], dtype=object)
+P_TYPES_1 = np.array(["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY",
+                      "PROMO"], dtype=object)
+P_TYPES_2 = np.array(["ANODIZED", "BURNISHED", "PLATED", "POLISHED",
+                      "BRUSHED"], dtype=object)
+P_TYPES_3 = np.array(["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"],
+                     dtype=object)
+P_CONTAINERS_1 = np.array(["SM", "MED", "LG", "JUMBO", "WRAP"],
+                          dtype=object)
+P_CONTAINERS_2 = np.array(["CASE", "BOX", "BAG", "JAR", "PKG", "PACK",
+                           "CAN", "DRUM"], dtype=object)
 
 
 def _dates(rng, n, lo_year=1992, hi_year=1998):
@@ -34,6 +57,9 @@ def gen_lineitem(sf: float, seed: int = 11) -> pa.Table:
     rng = np.random.default_rng(seed)
     n = max(int(6_000_000 * sf), 100)
     orderkey = rng.integers(1, max(int(1_500_000 * sf), 25) * 4, n)
+    shipdate = _dates(rng, n)
+    commit_delta = rng.integers(-30, 61, n)
+    receipt_delta = rng.integers(1, 31, n)
     return pa.table({
         "l_orderkey": orderkey.astype(np.int64),
         "l_partkey": rng.integers(1, max(int(200_000 * sf), 10), n
@@ -46,7 +72,13 @@ def gen_lineitem(sf: float, seed: int = 11) -> pa.Table:
         "l_tax": np.round(rng.integers(0, 9, n) / 100.0, 2),
         "l_returnflag": RETURN_FLAGS[rng.integers(0, 3, n)],
         "l_linestatus": LINE_STATUS[rng.integers(0, 2, n)],
-        "l_shipdate": _dates(rng, n),
+        "l_shipdate": shipdate,
+        "l_commitdate": shipdate + commit_delta,
+        "l_receiptdate": shipdate + receipt_delta,
+        "l_shipmode": SHIP_MODES[rng.integers(0, 7, n)],
+        "l_shipinstruct": np.array(
+            ["DELIVER IN PERSON", "COLLECT COD", "NONE",
+             "TAKE BACK RETURN"], dtype=object)[rng.integers(0, 4, n)],
     })
 
 
@@ -71,6 +103,57 @@ def gen_customer(sf: float, seed: int = 13) -> pa.Table:
         "c_custkey": np.arange(1, n + 1, dtype=np.int64),
         "c_mktsegment": SEGMENTS[rng.integers(0, 5, n)],
         "c_acctbal": np.round(rng.random(n) * 11_000 - 1_000, 2),
+        "c_nationkey": rng.integers(0, 25, n).astype(np.int64),
+        "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n + 1)],
+                           dtype=object),
+        "c_phone": np.array(
+            [f"{rng.integers(10, 35)}-{i % 900 + 100}-{i % 9000 + 1000}"
+             for i in range(n)], dtype=object),
+    })
+
+
+def gen_supplier(sf: float, seed: int = 14) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(10_000 * sf), 5)
+    return pa.table({
+        "s_suppkey": np.arange(1, n + 1, dtype=np.int64),
+        "s_nationkey": rng.integers(0, 25, n).astype(np.int64),
+        "s_acctbal": np.round(rng.random(n) * 11_000 - 1_000, 2),
+    })
+
+
+def gen_nation(sf: float, seed: int = 15) -> pa.Table:
+    return pa.table({
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": NATIONS,
+        "n_regionkey": NATION_REGION,
+    })
+
+
+def gen_region(sf: float, seed: int = 16) -> pa.Table:
+    return pa.table({
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": REGIONS,
+    })
+
+
+def gen_part(sf: float, seed: int = 17) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(200_000 * sf), 10)
+    t1 = P_TYPES_1[rng.integers(0, 6, n)]
+    t2 = P_TYPES_2[rng.integers(0, 5, n)]
+    t3 = P_TYPES_3[rng.integers(0, 5, n)]
+    c1 = P_CONTAINERS_1[rng.integers(0, 5, n)]
+    c2 = P_CONTAINERS_2[rng.integers(0, 8, n)]
+    return pa.table({
+        "p_partkey": np.arange(1, n + 1, dtype=np.int64),
+        "p_brand": np.array(
+            [f"Brand#{b}" for b in rng.integers(11, 56, n)], dtype=object),
+        "p_type": np.array([f"{a} {b} {c}" for a, b, c in
+                            zip(t1, t2, t3)], dtype=object),
+        "p_size": rng.integers(1, 51, n).astype(np.int32),
+        "p_container": np.array([f"{a} {b}" for a, b in zip(c1, c2)],
+                                dtype=object),
     })
 
 
@@ -78,6 +161,10 @@ GENERATORS = {
     "lineitem": gen_lineitem,
     "orders": gen_orders,
     "customer": gen_customer,
+    "supplier": gen_supplier,
+    "nation": gen_nation,
+    "region": gen_region,
+    "part": gen_part,
 }
 
 

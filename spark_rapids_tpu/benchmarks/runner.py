@@ -14,10 +14,13 @@ import os
 import time
 from typing import Optional
 
-from spark_rapids_tpu.benchmarks import datagen, mortgage, tpch
+from spark_rapids_tpu.benchmarks import (datagen, mortgage, tpcds, tpch,
+                                         tpcxbb)
 from spark_rapids_tpu.config import RapidsConf
 
 ALL_BENCHMARKS = dict(tpch.QUERIES)
+ALL_BENCHMARKS.update(tpcds.QUERIES)
+ALL_BENCHMARKS.update(tpcxbb.QUERIES)
 ALL_BENCHMARKS["mortgage_etl"] = mortgage.etl
 
 
@@ -29,8 +32,14 @@ class BenchmarkRunner:
         self.conf = conf or RapidsConf()
 
     def ensure_data(self, benchmark: str = "tpch") -> None:
-        family = "mortgage" if benchmark.startswith("mortgage") else \
-            "tpch"
+        if benchmark.startswith("mortgage"):
+            family = "mortgage"
+        elif benchmark.startswith("tpcds"):
+            family = "tpcds"
+        elif benchmark.startswith("tpcxbb"):
+            family = "tpcxbb"
+        else:
+            family = "tpch"
         marker = os.path.join(self.data_dir,
                               f".{family}-sf-{self.sf}")
         if os.path.exists(marker):
@@ -38,6 +47,10 @@ class BenchmarkRunner:
         os.makedirs(self.data_dir, exist_ok=True)
         if family == "mortgage":
             mortgage.gen_tables(self.data_dir, self.sf)
+        elif family == "tpcds":
+            tpcds.write_tables(self.data_dir, self.sf)
+        elif family == "tpcxbb":
+            tpcxbb.write_tables(self.data_dir, self.sf)
         else:
             datagen.write_tables(self.data_dir, self.sf)
         with open(marker, "w") as f:

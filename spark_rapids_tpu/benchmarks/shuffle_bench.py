@@ -1,0 +1,100 @@
+"""Wide-shuffle benchmark (BASELINE config #4): repartition + groupBy over
+a device mesh, exercising the fused all_to_all shuffle/aggregate step.
+
+The reference's analogous measurement is shuffle GB/s between executor
+GPUs over UCX (SURVEY.md §2.8); here the transport is XLA's all_to_all
+over ICI inside one compiled program, so the benchmark times the whole
+exchange+aggregate step and reports rows/s and shuffled GB/s per chip.
+
+    python -m spark_rapids_tpu.benchmarks.shuffle_bench \
+        --rows 4000000 --keys 65536 --devices 8 --iterations 3
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def run(rows: int, n_keys: int, n_devices: int = 0,
+        iterations: int = 3, warmup: int = 1, seed: int = 7) -> dict:
+    import jax
+
+    import spark_rapids_tpu  # noqa: F401  (x64 on)
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.ops.groupby import AggSpec
+    from spark_rapids_tpu.parallel import (
+        DistributedGroupByStep,
+        data_mesh,
+        distributed_batch_from_host,
+        gather_distributed_result,
+    )
+
+    if n_devices:
+        from spark_rapids_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(n_devices)
+    n_dev = n_devices or len(jax.devices())
+    mesh = data_mesh(n_dev)
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_keys, rows).astype(np.int64)
+    vals = rng.random(rows)
+    dtypes = [dt.INT64, dt.FLOAT64]
+    datas, valids, counts, _cap = distributed_batch_from_host(
+        mesh, [keys, vals], dtypes)
+    step = DistributedGroupByStep(
+        mesh, dtypes, [0],
+        [AggSpec("sum", 1), AggSpec("count_star")])
+
+    times = []
+    for i in range(warmup + iterations):
+        t0 = time.perf_counter()
+        out_d, out_v, ng = step(datas, valids, counts)
+        jax.block_until_ready(out_d)
+        if i >= warmup:
+            times.append(time.perf_counter() - t0)
+
+    # every row carries both columns' payload + validity across the wire
+    # at most once (hash routing): bytes ~ rows * (8 + 8 + 2)
+    payload_bytes = rows * (8 + 8 + 2)
+    best = min(times)
+    result = {
+        "benchmark": "wide_shuffle",
+        "rows": rows,
+        "distinct_keys": n_keys,
+        "devices": n_dev,
+        "backend": jax.devices()[0].platform,
+        "times_sec": times,
+        "min_time_sec": best,
+        "rows_per_sec": rows / best,
+        "shuffle_gb_per_sec_per_chip": payload_bytes / best / 1e9 / n_dev,
+    }
+    res = gather_distributed_result(out_d, out_v, ng,
+                                    step.output_dtypes(), n_dev)
+    result["groups"] = res.realized_num_rows()
+    # correctness pin: global sum survives the exchange exactly
+    df = res.to_pandas()
+    result["sum_ok"] = bool(abs(float(df.iloc[:, 1].sum()) -
+                                float(vals.sum())) < 1e-6 * rows)
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", type=int, default=4_000_000)
+    p.add_argument("--keys", type=int, default=65_536)
+    p.add_argument("--devices", type=int, default=0,
+                   help="0 = all available devices; N forces a virtual "
+                        "N-device CPU mesh when fewer are attached")
+    p.add_argument("--iterations", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=1)
+    args = p.parse_args(argv)
+    print(json.dumps(run(args.rows, args.keys, args.devices,
+                         args.iterations, args.warmup)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,300 @@
+"""TPC-DS-like tables and query plans (TpcdsLikeSpark.scala analogue:
+integration_tests/src/main/scala/.../tpcds/TpcdsLikeSpark.scala defines the
+full table schemas + hand-written DataFrame queries; this module generates
+the subset of tables the -like queries read and defines each query as a
+function data_dir -> plan).
+
+Queries: the classic reporting shape (q3/q42/q52/q55: fact x date_dim x
+item, filtered group-by revenue) plus a q72-like (catalog_sales x
+inventory x warehouse x item x date_dim with an inter-fact inequality — the
+multi-way join headline of BASELINE config #3)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import Alias, BoundReference, Literal
+from spark_rapids_tpu.io import ParquetSource
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan import nodes as pn
+
+CATEGORIES = np.array(["Books", "Electronics", "Home", "Jewelry", "Men",
+                       "Music", "Shoes", "Sports", "Children", "Women"],
+                      dtype=object)
+
+
+# ---------------------------------------------------------------------------
+# datagen
+
+
+def gen_date_dim(sf: float, seed: int = 31) -> pa.Table:
+    # one row per day 1998-2002, d_date_sk dense from 2450815 (dsdgen's
+    # julian base is arbitrary; dense sks keep joins realistic)
+    days = np.arange(np.datetime64("1998-01-01"),
+                     np.datetime64("2003-01-01"))
+    n = len(days)
+    years = days.astype("datetime64[Y]").astype(int) + 1970
+    months = days.astype("datetime64[M]").astype(int) % 12 + 1
+    week_seq = (days - np.datetime64("1998-01-01")).astype(int) // 7
+    return pa.table({
+        "d_date_sk": np.arange(2450815, 2450815 + n, dtype=np.int64),
+        "d_date": days,
+        "d_year": years.astype(np.int32),
+        "d_moy": months.astype(np.int32),
+        "d_week_seq": week_seq.astype(np.int32),
+    })
+
+
+def gen_item(sf: float, seed: int = 32) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(18_000 * sf), 50)
+    brand_id = rng.integers(1, 1000, n).astype(np.int32)
+    cat_id = rng.integers(0, 10, n)
+    return pa.table({
+        "i_item_sk": np.arange(1, n + 1, dtype=np.int64),
+        "i_brand_id": brand_id,
+        "i_brand": np.array([f"brand#{b}" for b in brand_id],
+                            dtype=object),
+        "i_category_id": cat_id.astype(np.int32),
+        "i_category": CATEGORIES[cat_id],
+        "i_class_id": rng.integers(1, 9, n).astype(np.int32),
+        "i_manufact_id": rng.integers(1, 1000, n).astype(np.int32),
+        "i_manager_id": rng.integers(1, 100, n).astype(np.int32),
+        "i_item_desc": np.array([f"item description {i % 997}"
+                                 for i in range(n)], dtype=object),
+    })
+
+
+def _date_sks(rng, n):
+    return rng.integers(2450815, 2450815 + 5 * 365, n).astype(np.int64)
+
+
+def gen_store_sales(sf: float, seed: int = 33) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(2_880_000 * sf), 200)
+    n_item = max(int(18_000 * sf), 50)
+    return pa.table({
+        "ss_sold_date_sk": _date_sks(rng, n),
+        "ss_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
+        "ss_customer_sk": rng.integers(1, max(int(100_000 * sf), 20), n
+                                       ).astype(np.int64),
+        "ss_store_sk": rng.integers(1, max(int(12 * sf), 2) + 1, n
+                                    ).astype(np.int64),
+        "ss_quantity": rng.integers(1, 101, n).astype(np.int32),
+        "ss_sales_price": np.round(rng.random(n) * 200, 2),
+        "ss_ext_sales_price": np.round(rng.random(n) * 20_000, 2),
+        "ss_net_profit": np.round(rng.random(n) * 4_000 - 2_000, 2),
+    })
+
+
+def gen_catalog_sales(sf: float, seed: int = 34) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(1_440_000 * sf), 150)
+    n_item = max(int(18_000 * sf), 50)
+    return pa.table({
+        "cs_sold_date_sk": _date_sks(rng, n),
+        "cs_ship_date_sk": _date_sks(rng, n) + rng.integers(1, 30, n),
+        "cs_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
+        "cs_quantity": rng.integers(1, 101, n).astype(np.int32),
+        "cs_ext_sales_price": np.round(rng.random(n) * 20_000, 2),
+    })
+
+
+def gen_inventory(sf: float, seed: int = 35) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n_item = max(int(18_000 * sf), 50)
+    n_wh = max(int(5 * sf), 2)
+    # weekly snapshots: every item x warehouse x ~26 weeks
+    weeks = 26
+    n = n_item * n_wh * weeks
+    item = np.tile(np.arange(1, n_item + 1, dtype=np.int64), n_wh * weeks)
+    wh = np.repeat(np.arange(1, n_wh + 1, dtype=np.int64), n_item * weeks)
+    week_start = rng.integers(2450815, 2450815 + 5 * 365 - 7,
+                              weeks)
+    date_sk = np.tile(np.repeat(week_start, n_item), n_wh)
+    return pa.table({
+        "inv_date_sk": date_sk.astype(np.int64),
+        "inv_item_sk": item,
+        "inv_warehouse_sk": wh,
+        "inv_quantity_on_hand": rng.integers(0, 120, n).astype(np.int32),
+    })
+
+
+def gen_warehouse(sf: float, seed: int = 36) -> pa.Table:
+    n = max(int(5 * sf), 2)
+    return pa.table({
+        "w_warehouse_sk": np.arange(1, n + 1, dtype=np.int64),
+        "w_warehouse_name": np.array([f"Warehouse {i}"
+                                      for i in range(1, n + 1)],
+                                     dtype=object),
+    })
+
+
+GENERATORS = {
+    "date_dim": gen_date_dim,
+    "item": gen_item,
+    "store_sales": gen_store_sales,
+    "catalog_sales": gen_catalog_sales,
+    "inventory": gen_inventory,
+    "warehouse": gen_warehouse,
+}
+
+
+def write_tables(data_dir: str, sf: float, tables=None,
+                 files_per_table: int = 4) -> None:
+    os.makedirs(data_dir, exist_ok=True)
+    for name in tables or GENERATORS:
+        table = GENERATORS[name](sf)
+        tdir = os.path.join(data_dir, name)
+        os.makedirs(tdir, exist_ok=True)
+        per = -(-table.num_rows // files_per_table)
+        for i in range(files_per_table):
+            chunk = table.slice(i * per, per)
+            if chunk.num_rows:
+                pq.write_table(chunk,
+                               os.path.join(tdir,
+                                            f"part-{i:03d}.parquet"))
+
+
+# ---------------------------------------------------------------------------
+# queries
+
+
+def ref(i, t):
+    return BoundReference(i, t)
+
+
+def _scan(data_dir: str, table: str, columns):
+    return pn.ScanNode(ParquetSource(os.path.join(data_dir, table),
+                                     columns=columns))
+
+
+def _report_query(data_dir: str, item_filter, group_ordinal_names,
+                  date_filter_moy=11, date_filter_year=None):
+    """The q3/q42/q52/q55 family: date_dim x store_sales x item,
+    filtered on month (and maybe year) + an item attribute, grouped on
+    (d_year, item attrs), sum(ss_ext_sales_price) descending."""
+    dd_cond = P.EqualTo(ref(1, dt.INT32),
+                        Literal(date_filter_moy, dt.INT32))
+    if date_filter_year is not None:
+        dd_cond = P.And(dd_cond,
+                        P.EqualTo(ref(2, dt.INT32),
+                                  Literal(date_filter_year, dt.INT32)))
+    date_dim = pn.FilterNode(
+        dd_cond, _scan(data_dir, "date_dim",
+                       ["d_date_sk", "d_moy", "d_year"]))
+    sales = _scan(data_dir, "store_sales",
+                  ["ss_sold_date_sk", "ss_item_sk",
+                   "ss_ext_sales_price"])
+    item_cols, item_pred, group_item_ordinals = item_filter
+    item = pn.FilterNode(item_pred, _scan(data_dir, "item", item_cols))
+    # [d_date_sk 0, d_moy 1, d_year 2, ss_sold_date_sk 3, ss_item_sk 4,
+    #  ss_ext_sales_price 5]
+    ds = pn.JoinNode("inner", date_dim, sales, [0], [0])
+    # + item cols at 6..
+    dsi = pn.JoinNode("inner", ds, item, [4], [0])
+    group_refs = [ref(2, dt.INT32)] + \
+        [ref(6 + o, t) for o, t in group_item_ordinals]
+    proj = pn.ProjectNode(
+        [Alias(e, n) for e, n in zip(group_refs, group_ordinal_names)] +
+        [Alias(ref(5, dt.FLOAT64), "price")], dsi)
+    k = len(group_refs)
+    agg = pn.AggregateNode(
+        [ref(i, e.dtype) for i, e in enumerate(group_refs)],
+        [pn.AggCall(A.Sum(ref(k, dt.FLOAT64)), "sum_agg")],
+        proj, grouping_names=group_ordinal_names)
+    sort = pn.SortNode(
+        [SortKeySpec.spark_default(k, ascending=False)] +
+        [SortKeySpec.spark_default(i) for i in range(k)], agg)
+    return pn.LimitNode(100, sort)
+
+
+def q3(data_dir: str) -> pn.PlanNode:
+    """Brand revenue for one manufacturer in November
+    (TpcdsLikeSpark.scala q3)."""
+    item_filter = (["i_item_sk", "i_brand_id", "i_brand",
+                    "i_manufact_id"],
+                   P.EqualTo(ref(3, dt.INT32), Literal(128, dt.INT32)),
+                   [(1, dt.INT32), (2, dt.STRING)])
+    return _report_query(data_dir, item_filter,
+                         ["d_year", "brand_id", "brand"])
+
+
+def q42(data_dir: str) -> pn.PlanNode:
+    """Category revenue for one manager-year (q42)."""
+    item_filter = (["i_item_sk", "i_category_id", "i_category",
+                    "i_manager_id"],
+                   P.EqualTo(ref(3, dt.INT32), Literal(1, dt.INT32)),
+                   [(1, dt.INT32), (2, dt.STRING)])
+    return _report_query(data_dir, item_filter,
+                         ["d_year", "i_category_id", "i_category"],
+                         date_filter_year=2000)
+
+
+def q52(data_dir: str) -> pn.PlanNode:
+    """Brand revenue for one manager-year (q52)."""
+    item_filter = (["i_item_sk", "i_brand_id", "i_brand",
+                    "i_manager_id"],
+                   P.EqualTo(ref(3, dt.INT32), Literal(1, dt.INT32)),
+                   [(1, dt.INT32), (2, dt.STRING)])
+    return _report_query(data_dir, item_filter,
+                         ["d_year", "brand_id", "brand"],
+                         date_filter_year=2000)
+
+
+def q55(data_dir: str) -> pn.PlanNode:
+    """Brand revenue, manager 28, one month (q55)."""
+    item_filter = (["i_item_sk", "i_brand_id", "i_brand",
+                    "i_manager_id"],
+                   P.EqualTo(ref(3, dt.INT32), Literal(28, dt.INT32)),
+                   [(1, dt.INT32), (2, dt.STRING)])
+    return _report_query(data_dir, item_filter,
+                         ["d_year", "brand_id", "brand"],
+                         date_filter_year=1999)
+
+
+def q72(data_dir: str) -> pn.PlanNode:
+    """q72-like: catalog_sales x inventory (same item, on-hand below
+    ordered quantity) x warehouse x item x date_dim — the infamous
+    expansion join, simplified to the tables generated here."""
+    cs = _scan(data_dir, "catalog_sales",
+               ["cs_sold_date_sk", "cs_item_sk", "cs_quantity"])
+    inv = _scan(data_dir, "inventory",
+                ["inv_date_sk", "inv_item_sk", "inv_warehouse_sk",
+                 "inv_quantity_on_hand"])
+    # join on item; keep only rows where on-hand < ordered (the q72
+    # shortage condition) — an equi-join with an inter-fact residual
+    # [cs 0-2, inv 3-6]
+    short = pn.JoinNode(
+        "inner", cs, inv, [1], [1],
+        condition=P.LessThan(ref(6, dt.INT32), ref(2, dt.INT32)))
+    wh = _scan(data_dir, "warehouse",
+               ["w_warehouse_sk", "w_warehouse_name"])
+    # + [w_warehouse_sk 7, w_warehouse_name 8]
+    sw = pn.JoinNode("inner", short, wh, [5], [0])
+    item = _scan(data_dir, "item", ["i_item_sk", "i_item_desc"])
+    # + [i_item_sk 9, i_item_desc 10]
+    swi = pn.JoinNode("inner", sw, item, [1], [0])
+    dd = _scan(data_dir, "date_dim", ["d_date_sk", "d_week_seq"])
+    # + [d_date_sk 11, d_week_seq 12]
+    swid = pn.JoinNode("inner", swi, dd, [0], [0])
+    agg = pn.AggregateNode(
+        [ref(10, dt.STRING), ref(8, dt.STRING), ref(12, dt.INT32)],
+        [pn.AggCall(A.Count(), "no_promo")],
+        swid, grouping_names=["i_item_desc", "w_warehouse_name",
+                              "d_week_seq"])
+    sort = pn.SortNode([SortKeySpec.spark_default(3, ascending=False),
+                        SortKeySpec.spark_default(0),
+                        SortKeySpec.spark_default(1),
+                        SortKeySpec.spark_default(2)], agg)
+    return pn.LimitNode(100, sort)
+
+
+QUERIES = {"tpcds_q3": q3, "tpcds_q42": q42, "tpcds_q52": q52,
+           "tpcds_q55": q55, "tpcds_q72": q72}

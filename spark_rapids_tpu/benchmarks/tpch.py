@@ -127,4 +127,265 @@ def q3(data_dir: str) -> pn.PlanNode:
     return pn.LimitNode(10, sort)
 
 
-QUERIES = {"tpch_q1": q1, "tpch_q3": q3, "tpch_q6": q6}
+def q4(data_dir: str) -> pn.PlanNode:
+    """Order priority checking: date-window filter + EXISTS-subquery as a
+    left-semi join + groupby count."""
+    orders = _scan(data_dir, "orders",
+                   ["o_orderkey", "o_orderdate", "o_orderpriority"])
+    ord_f = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(1, dt.DATE),
+                                   Literal(_date_days("1993-07-01"),
+                                           dt.DATE)),
+              P.LessThan(ref(1, dt.DATE),
+                         Literal(_date_days("1993-10-01"), dt.DATE))),
+        orders)
+    lineitem = _scan(data_dir, "lineitem",
+                     ["l_orderkey", "l_commitdate", "l_receiptdate"])
+    li_f = pn.FilterNode(P.LessThan(ref(1, dt.DATE), ref(2, dt.DATE)),
+                         lineitem)
+    semi = pn.JoinNode("left_semi", ord_f, li_f, [0], [0])
+    agg = pn.AggregateNode(
+        [ref(2, dt.STRING)], [pn.AggCall(A.Count(), "order_count")],
+        semi, grouping_names=["o_orderpriority"])
+    return pn.SortNode([SortKeySpec.spark_default(0)], agg)
+
+
+def q5(data_dir: str) -> pn.PlanNode:
+    """Local supplier volume: 6-table join chain + groupby revenue
+    (the TPC-DS q72 / TPCxBB q3 multi-way-join shape of BASELINE
+    config #3)."""
+    region = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("ASIA")),
+        _scan(data_dir, "region", ["r_regionkey", "r_name"]))
+    nation = _scan(data_dir, "nation",
+                   ["n_nationkey", "n_name", "n_regionkey"])
+    # nation x region -> [n_nationkey, n_name, n_regionkey, r_regionkey,
+    #                     r_name]
+    nr = pn.JoinNode("inner", nation, region, [2], [0])
+    supplier = _scan(data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    # -> [s_suppkey, s_nationkey, n_nationkey, n_name, n_regionkey,
+    #     r_regionkey, r_name]
+    snr = pn.JoinNode("inner", supplier, nr, [1], [0])
+    customer = _scan(data_dir, "customer", ["c_custkey", "c_nationkey"])
+    orders = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(2, dt.DATE),
+                                   Literal(_date_days("1994-01-01"),
+                                           dt.DATE)),
+              P.LessThan(ref(2, dt.DATE),
+                         Literal(_date_days("1995-01-01"), dt.DATE))),
+        _scan(data_dir, "orders",
+              ["o_orderkey", "o_custkey", "o_orderdate"]))
+    # -> [c_custkey, c_nationkey, o_orderkey, o_custkey, o_orderdate]
+    co = pn.JoinNode("inner", customer, orders, [0], [1])
+    lineitem = _scan(data_dir, "lineitem",
+                     ["l_orderkey", "l_suppkey", "l_extendedprice",
+                      "l_discount"])
+    # -> co + [l_orderkey, l_suppkey, l_extendedprice, l_discount] @ 5..8
+    col = pn.JoinNode("inner", co, lineitem, [2], [0])
+    # l_suppkey = s_suppkey AND c_nationkey = s_nationkey (the "local
+    # supplier" constraint); snr cols land at 9..15, n_name @ 12
+    full = pn.JoinNode("inner", col, snr, [6, 1], [0, 1])
+    revenue = ar.Multiply(ref(7, dt.FLOAT64),
+                          ar.Subtract(Literal(1.0), ref(8, dt.FLOAT64)))
+    proj = pn.ProjectNode([Alias(ref(12, dt.STRING), "n_name"),
+                           Alias(revenue, "rev")], full)
+    agg = pn.AggregateNode(
+        [ref(0, dt.STRING)],
+        [pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "revenue")],
+        proj, grouping_names=["n_name"])
+    return pn.SortNode([SortKeySpec.spark_default(1, ascending=False)],
+                       agg)
+
+
+def q10(data_dir: str) -> pn.PlanNode:
+    """Returned item reporting: 4-table join, wide groupby, top 20."""
+    customer = _scan(data_dir, "customer",
+                     ["c_custkey", "c_nationkey", "c_acctbal", "c_name",
+                      "c_phone"])
+    orders = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(2, dt.DATE),
+                                   Literal(_date_days("1993-10-01"),
+                                           dt.DATE)),
+              P.LessThan(ref(2, dt.DATE),
+                         Literal(_date_days("1994-01-01"), dt.DATE))),
+        _scan(data_dir, "orders",
+              ["o_orderkey", "o_custkey", "o_orderdate"]))
+    lineitem = pn.FilterNode(
+        P.EqualTo(ref(3, dt.STRING), Literal("R")),
+        _scan(data_dir, "lineitem",
+              ["l_orderkey", "l_extendedprice", "l_discount",
+               "l_returnflag"]))
+    nation = _scan(data_dir, "nation", ["n_nationkey", "n_name"])
+    # [c...0-4, o_orderkey 5, o_custkey 6, o_orderdate 7]
+    co = pn.JoinNode("inner", customer, orders, [0], [1])
+    # + [l_orderkey 8, l_extendedprice 9, l_discount 10, l_returnflag 11]
+    col = pn.JoinNode("inner", co, lineitem, [5], [0])
+    # + [n_nationkey 12, n_name 13]
+    con = pn.JoinNode("inner", col, nation, [1], [0])
+    revenue = ar.Multiply(ref(9, dt.FLOAT64),
+                          ar.Subtract(Literal(1.0), ref(10, dt.FLOAT64)))
+    proj = pn.ProjectNode(
+        [Alias(ref(0, dt.INT64), "c_custkey"),
+         Alias(ref(3, dt.STRING), "c_name"),
+         Alias(ref(2, dt.FLOAT64), "c_acctbal"),
+         Alias(ref(4, dt.STRING), "c_phone"),
+         Alias(ref(13, dt.STRING), "n_name"),
+         Alias(revenue, "rev")], con)
+    agg = pn.AggregateNode(
+        [ref(0, dt.INT64), ref(1, dt.STRING), ref(2, dt.FLOAT64),
+         ref(3, dt.STRING), ref(4, dt.STRING)],
+        [pn.AggCall(A.Sum(ref(5, dt.FLOAT64)), "revenue")],
+        proj, grouping_names=["c_custkey", "c_name", "c_acctbal",
+                              "c_phone", "n_name"])
+    sort = pn.SortNode([SortKeySpec.spark_default(5, ascending=False)],
+                       agg)
+    return pn.LimitNode(20, sort)
+
+
+def q12(data_dir: str) -> pn.PlanNode:
+    """Shipping modes and order priority: join + conditional aggregation
+    (CASE WHEN inside SUM)."""
+    from spark_rapids_tpu.expressions.conditional import If
+    from spark_rapids_tpu.expressions.predicates import In
+
+    orders = _scan(data_dir, "orders",
+                   ["o_orderkey", "o_orderpriority"])
+    li = _scan(data_dir, "lineitem",
+               ["l_orderkey", "l_shipdate", "l_commitdate",
+                "l_receiptdate", "l_shipmode"])
+    li_f = pn.FilterNode(
+        P.And(P.And(In(ref(4, dt.STRING),
+                       [Literal("MAIL"), Literal("SHIP")]),
+                    P.LessThan(ref(2, dt.DATE), ref(3, dt.DATE))),
+              P.And(P.LessThan(ref(1, dt.DATE), ref(2, dt.DATE)),
+                    P.And(P.GreaterThanOrEqual(
+                              ref(3, dt.DATE),
+                              Literal(_date_days("1994-01-01"), dt.DATE)),
+                          P.LessThan(
+                              ref(3, dt.DATE),
+                              Literal(_date_days("1995-01-01"),
+                                      dt.DATE))))),
+        li)
+    # [o_orderkey 0, o_orderpriority 1, l_orderkey 2, ..., l_shipmode 6]
+    j = pn.JoinNode("inner", orders, li_f, [0], [0])
+    is_high = In(ref(1, dt.STRING),
+                 [Literal("1-URGENT"), Literal("2-HIGH")])
+    proj = pn.ProjectNode(
+        [Alias(ref(6, dt.STRING), "l_shipmode"),
+         Alias(If(is_high, Literal(1), Literal(0)), "high"),
+         Alias(If(is_high, Literal(0), Literal(1)), "low")], j)
+    agg = pn.AggregateNode(
+        [ref(0, dt.STRING)],
+        [pn.AggCall(A.Sum(ref(1, dt.INT64)), "high_line_count"),
+         pn.AggCall(A.Sum(ref(2, dt.INT64)), "low_line_count")],
+        proj, grouping_names=["l_shipmode"])
+    return pn.SortNode([SortKeySpec.spark_default(0)], agg)
+
+
+def q14(data_dir: str) -> pn.PlanNode:
+    """Promotion effect: join + CASE WHEN ratio of global aggregates."""
+    from spark_rapids_tpu.expressions.conditional import If
+    from spark_rapids_tpu.expressions.strings import StartsWith
+
+    li = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(3, dt.DATE),
+                                   Literal(_date_days("1995-09-01"),
+                                           dt.DATE)),
+              P.LessThan(ref(3, dt.DATE),
+                         Literal(_date_days("1995-10-01"), dt.DATE))),
+        _scan(data_dir, "lineitem",
+              ["l_partkey", "l_extendedprice", "l_discount",
+               "l_shipdate"]))
+    part = _scan(data_dir, "part", ["p_partkey", "p_type"])
+    # + [p_partkey 4, p_type 5]
+    j = pn.JoinNode("inner", li, part, [0], [0])
+    rev = ar.Multiply(ref(1, dt.FLOAT64),
+                      ar.Subtract(Literal(1.0), ref(2, dt.FLOAT64)))
+    promo = If(StartsWith(ref(5, dt.STRING), "PROMO"), rev,
+               Literal(0.0))
+    proj = pn.ProjectNode([Alias(promo, "promo_rev"),
+                           Alias(rev, "rev")], j)
+    agg = pn.AggregateNode(
+        [], [pn.AggCall(A.Sum(ref(0, dt.FLOAT64)), "sum_promo"),
+             pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "sum_rev")], proj)
+    ratio = ar.Multiply(Literal(100.0),
+                        ar.Divide(ref(0, dt.FLOAT64),
+                                  ref(1, dt.FLOAT64)))
+    return pn.ProjectNode([Alias(ratio, "promo_revenue")], agg)
+
+
+def q18(data_dir: str) -> pn.PlanNode:
+    """Large volume customer: IN-subquery over a grouped HAVING filter
+    realized as agg -> filter -> semi-join, then re-join + re-aggregate.
+    (Threshold lowered from 300 to 100 for the synthetic -like data.)"""
+    li_keys = _scan(data_dir, "lineitem", ["l_orderkey", "l_quantity"])
+    big = pn.FilterNode(
+        P.GreaterThan(ref(1, dt.FLOAT64), Literal(100.0)),
+        pn.AggregateNode([ref(0, dt.INT64)],
+                         [pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "sq")],
+                         li_keys, grouping_names=["l_orderkey"]))
+    orders = _scan(data_dir, "orders",
+                   ["o_orderkey", "o_custkey", "o_totalprice",
+                    "o_orderdate"])
+    ord_big = pn.JoinNode("left_semi", orders, big, [0], [0])
+    customer = _scan(data_dir, "customer", ["c_custkey", "c_name"])
+    # [o... 0-3, c_custkey 4, c_name 5]
+    oc = pn.JoinNode("inner", ord_big, customer, [1], [0])
+    li = _scan(data_dir, "lineitem", ["l_orderkey", "l_quantity"])
+    # + [l_orderkey 6, l_quantity 7]
+    ocl = pn.JoinNode("inner", oc, li, [0], [0])
+    agg = pn.AggregateNode(
+        [ref(5, dt.STRING), ref(4, dt.INT64), ref(0, dt.INT64),
+         ref(3, dt.DATE), ref(2, dt.FLOAT64)],
+        [pn.AggCall(A.Sum(ref(7, dt.FLOAT64)), "sum_qty")],
+        ocl, grouping_names=["c_name", "c_custkey", "o_orderkey",
+                             "o_orderdate", "o_totalprice"])
+    sort = pn.SortNode([SortKeySpec.spark_default(4, ascending=False),
+                        SortKeySpec.spark_default(3)], agg)
+    return pn.LimitNode(100, sort)
+
+
+def q19(data_dir: str) -> pn.PlanNode:
+    """Discounted revenue: equi-join on partkey with a 3-arm OR residual
+    condition over both sides (brand/container/size/quantity bands)."""
+    from spark_rapids_tpu.expressions.predicates import In
+    from spark_rapids_tpu.expressions.strings import StartsWith
+
+    li = pn.FilterNode(
+        P.And(In(ref(4, dt.STRING),
+                 [Literal("AIR"), Literal("REG AIR")]),
+              P.EqualTo(ref(5, dt.STRING),
+                        Literal("DELIVER IN PERSON"))),
+        _scan(data_dir, "lineitem",
+              ["l_partkey", "l_quantity", "l_extendedprice",
+               "l_discount", "l_shipmode", "l_shipinstruct"]))
+    part = _scan(data_dir, "part",
+                 ["p_partkey", "p_brand", "p_size", "p_container"])
+    qty = ref(1, dt.FLOAT64)
+    # part columns land at 6..9 after the join
+    brand = ref(7, dt.STRING)
+    size = ref(8, dt.INT32)
+    container = ref(9, dt.STRING)
+
+    def arm(brand_lit, cont_prefix, qlo, qhi, smax):
+        return P.And(
+            P.And(P.EqualTo(brand, Literal(brand_lit)),
+                  StartsWith(container, cont_prefix)),
+            P.And(P.And(P.GreaterThanOrEqual(qty, Literal(float(qlo))),
+                        P.LessThanOrEqual(qty, Literal(float(qhi)))),
+                  P.LessThanOrEqual(size, Literal(smax, dt.INT32))))
+
+    cond = P.Or(P.Or(arm("Brand#12", "SM", 1, 11, 5),
+                     arm("Brand#23", "MED", 10, 20, 10)),
+                arm("Brand#34", "LG", 20, 30, 15))
+    j = pn.JoinNode("inner", li, part, [0], [0], condition=cond)
+    rev = ar.Multiply(ref(2, dt.FLOAT64),
+                      ar.Subtract(Literal(1.0), ref(3, dt.FLOAT64)))
+    proj = pn.ProjectNode([Alias(rev, "rev")], j)
+    return pn.AggregateNode(
+        [], [pn.AggCall(A.Sum(ref(0, dt.FLOAT64)), "revenue")], proj)
+
+
+QUERIES = {"tpch_q1": q1, "tpch_q3": q3, "tpch_q4": q4, "tpch_q5": q5,
+           "tpch_q6": q6, "tpch_q10": q10, "tpch_q12": q12,
+           "tpch_q14": q14, "tpch_q18": q18, "tpch_q19": q19}

@@ -4,7 +4,7 @@ import json
 
 import pytest
 
-from spark_rapids_tpu.benchmarks import datagen, tpch
+from spark_rapids_tpu.benchmarks import datagen, tpcds, tpch
 from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner
 from spark_rapids_tpu.config import RapidsConf
 
@@ -20,9 +20,47 @@ def data_dir(tmp_path_factory):
     return str(d)
 
 
+@pytest.fixture(scope="module")
+def tpcds_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tpcds")
+    tpcds.write_tables(str(d), SF)
+    return str(d)
+
+
 @pytest.mark.parametrize("query", sorted(tpch.QUERIES))
 def test_query_on_tpu_matches_oracle(data_dir, query):
     plan = tpch.QUERIES[query](data_dir)
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
+
+
+@pytest.mark.parametrize("query", sorted(tpcds.QUERIES))
+def test_tpcds_query_on_tpu_matches_oracle(tpcds_dir, query):
+    plan = tpcds.QUERIES[query](tpcds_dir)
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
+
+
+@pytest.fixture(scope="module")
+def tpcxbb_dir(tmp_path_factory):
+    from spark_rapids_tpu.benchmarks import tpcxbb
+
+    d = tmp_path_factory.mktemp("tpcxbb")
+    tpcxbb.write_tables(str(d), SF)
+    return str(d)
+
+
+def _tpcxbb_queries():
+    from spark_rapids_tpu.benchmarks import tpcxbb
+
+    return sorted(tpcxbb.QUERIES)
+
+
+@pytest.mark.parametrize("query", _tpcxbb_queries())
+def test_tpcxbb_query_on_tpu_matches_oracle(tpcxbb_dir, query):
+    from spark_rapids_tpu.benchmarks import tpcxbb
+
+    plan = tpcxbb.QUERIES[query](tpcxbb_dir)
     conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
     assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
 
@@ -74,3 +112,16 @@ def test_mortgage_through_runner(tmp_path, capsys):
     result = _json.loads(capsys.readouterr().out)
     assert result["compare"]["matches_cpu"], result["compare"]["detail"]
     assert result["rows_returned"] >= 1
+
+
+def test_wide_shuffle_bench_on_mesh():
+    """BASELINE config #4 smoke: the wide-shuffle benchmark runs over the
+    8-device mesh and the exchanged aggregate is exact."""
+    from spark_rapids_tpu.benchmarks.shuffle_bench import run
+
+    result = run(rows=20_000, n_keys=512, n_devices=8, iterations=1,
+                 warmup=1)
+    assert result["devices"] == 8
+    assert result["groups"] == 512
+    assert result["sum_ok"]
+    assert result["rows_per_sec"] > 0
