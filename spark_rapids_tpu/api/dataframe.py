@@ -82,6 +82,21 @@ class DataFrame:
         keep = [n for n in self.columns if n not in names]
         return self.select(*keep)
 
+    def explode(self, *cols: ColumnOrName, value_name: str = "col",
+                pos: bool = False, pos_name: str = "pos") -> "DataFrame":
+        """explode/posexplode of a per-row array created from ``cols``
+        (the GenerateExec surface — the reference supports exactly
+        explode(array(...)), GpuGenerateExec.scala). Every original
+        column is kept; each input row emits len(cols) rows."""
+        schema = self.schema
+        exprs = []
+        for c in cols:
+            e = _as_col(c).resolve(schema)
+            exprs.append(e.children[0] if isinstance(e, Alias) else e)
+        return self._df(pn.GenerateNode(
+            exprs, self._plan, list(range(len(schema.names))),
+            value_name=value_name, include_pos=pos, pos_name=pos_name))
+
     def group_by(self, *cols: ColumnOrName) -> "GroupedData":
         return GroupedData(self, [_as_col(c) for c in cols],
                            [c if isinstance(c, str) else c.out_name(None)

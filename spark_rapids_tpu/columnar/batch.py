@@ -52,11 +52,17 @@ class Schema:
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "_num_rows")
+    __slots__ = ("columns", "_num_rows", "origin")
 
-    def __init__(self, columns: List[Column], num_rows: RowCount):
+    def __init__(self, columns: List[Column], num_rows: RowCount,
+                 origin=None):
         self.columns = columns
         self._num_rows = num_rows
+        #: (file_path, block_start, block_length) when this batch came
+        #: straight from one file split (input_file_name support,
+        #: GpuInputFileBlock.scala); transforms drop it — Spark's
+        #: input_file_name is likewise only defined directly above scans
+        self.origin = origin
         if columns:
             cap = columns[0].capacity
             assert all(c.capacity == cap for c in columns), \

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import threading
+
 import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dt
@@ -26,6 +28,9 @@ class CpuFrame:
         self.schema = schema
         self.cols = cols
         self.num_rows = num_rows
+        #: [(origin, row_count)] runs straight above a file scan
+        #: (input_file_name oracle support); transforms drop it
+        self.origins = None
 
     def take(self, idx: np.ndarray,
              null_mask: Optional[np.ndarray] = None) -> "CpuFrame":
@@ -70,21 +75,55 @@ class CpuFrame:
         return pd.DataFrame(data)
 
 
+_ORIGINS_STATE = threading.local()
+
+
+def _plan_needs_origins(plan: pn.PlanNode) -> bool:
+    """True when any expression in the tree is an input_file_* leaf —
+    only then does the oracle scan need per-split origin tracking."""
+    from spark_rapids_tpu.expressions.base import Expression
+    from spark_rapids_tpu.expressions.nondeterministic import \
+        _InputFileExpr
+
+    def expr_has(e) -> bool:
+        return bool(e.collect(lambda x: isinstance(x, _InputFileExpr)))
+
+    for node in pn.walk(plan):
+        for v in vars(node).values():
+            if isinstance(v, Expression) and expr_has(v):
+                return True
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, Expression) and expr_has(x):
+                        return True
+                    if isinstance(x, (list, tuple)) and any(
+                            isinstance(y, Expression) and expr_has(y)
+                            for y in x):
+                        return True
+    return False
+
+
 def execute_cpu(plan: pn.PlanNode) -> CpuFrame:
-    fn = _NODES.get(type(plan))
-    if fn is None:
-        raise NotImplementedError(
-            f"CPU engine: unsupported node {plan.name}")
-    return fn(plan)
+    root = not getattr(_ORIGINS_STATE, "active", False)
+    if root:
+        _ORIGINS_STATE.active = True
+        _ORIGINS_STATE.needed = _plan_needs_origins(plan)
+    try:
+        fn = _NODES.get(type(plan))
+        if fn is None:
+            raise NotImplementedError(
+                f"CPU engine: unsupported node {plan.name}")
+        return fn(plan)
+    finally:
+        if root:
+            _ORIGINS_STATE.active = False
 
 
 # ---------------------------------------------------------------------------
 # leaves
 
 
-def _scan(node: pn.ScanNode) -> CpuFrame:
-    schema = node.output_schema()
-    data, validity = node.source.read_host()
+def _host_to_frame(schema: Schema, data, validity) -> CpuFrame:
     cols = []
     n = None
     for name, typ in zip(schema.names, schema.types):
@@ -110,6 +149,41 @@ def _scan(node: pn.ScanNode) -> CpuFrame:
     return CpuFrame(schema, cols, n or 0)
 
 
+def _concat_frames(schema: Schema, frames: List[CpuFrame]) -> CpuFrame:
+    cols = []
+    total = sum(f.num_rows for f in frames)
+    for j, typ in enumerate(schema.types):
+        np_t = object if typ is dt.STRING else typ.np_dtype
+        data = np.concatenate([f.cols[j].data.astype(np_t)
+                               for f in frames]) if total else \
+            np.array([], dtype=np_t)
+        valid = np.concatenate([f.cols[j].valid_mask() for f in frames]) \
+            if total else np.array([], dtype=bool)
+        cols.append(CV(typ, data, valid))
+    return CpuFrame(schema, cols, total)
+
+
+def _scan(node: pn.ScanNode) -> CpuFrame:
+    schema = node.output_schema()
+    src = node.source
+    if not getattr(_ORIGINS_STATE, "needed", False) or \
+            (src.split_origin(0) is None and src.num_splits() == 1):
+        # common path: the multi-file thread-pool read
+        data, validity = src.read_host()
+        return _host_to_frame(schema, data, validity)
+    # input_file_name in the plan: read split-by-split so per-row
+    # origins exist (the oracle mirror of the device path's batch.origin)
+    frames, origin_runs = [], []
+    for s in range(src.num_splits()):
+        data, validity = src.read_host_split(s)
+        f = _host_to_frame(schema, data, validity)
+        frames.append(f)
+        origin_runs.append((src.split_origin(s), f.num_rows))
+    out = _concat_frames(schema, frames)
+    out.origins = origin_runs  # [(origin, row_count)] run-length
+    return out
+
+
 def _range(node: pn.RangeNode) -> CpuFrame:
     data = np.arange(node.start, node.end, node.step, dtype=np.int64)
     return CpuFrame(node.output_schema(),
@@ -122,7 +196,8 @@ def _range(node: pn.RangeNode) -> CpuFrame:
 
 def _project(node: pn.ProjectNode) -> CpuFrame:
     child = execute_cpu(node.children[0])
-    ctx = CpuEvalContext(child.cols, child.num_rows)
+    ctx = CpuEvalContext(child.cols, child.num_rows,
+                         origins=child.origins)
     cols = [eval_expr(e, ctx) for e in node.exprs]
     return CpuFrame(node.output_schema(), cols, child.num_rows)
 
@@ -183,6 +258,14 @@ def _expand(node: pn.ExpandNode) -> CpuFrame:
             valid[k::nproj] = parts_v[k]
         cols.append(CV(typ, data, valid))
     return CpuFrame(schema, cols, n * nproj)
+
+
+def _generate(node: pn.GenerateNode) -> CpuFrame:
+    """explode/posexplode of created-array slots: desugars to the same
+    row-major interleave _expand performs, one projection per slot."""
+    expand = pn.ExpandNode(node.expand_projections(), node.children[0],
+                           list(node.output_schema().names))
+    return _expand(expand)
 
 
 # ---------------------------------------------------------------------------
@@ -695,6 +778,7 @@ _NODES = {
     pn.LimitNode: _limit,
     pn.UnionNode: _union,
     pn.ExpandNode: _expand,
+    pn.GenerateNode: _generate,
     pn.AggregateNode: _aggregate,
     pn.SortNode: _sort,
     pn.JoinNode: _join,
@@ -715,14 +799,16 @@ def _register_io_nodes():
     from spark_rapids_tpu.execs.cache import CacheNode
     from spark_rapids_tpu.execs.python_exec import (
         CoGroupedMapInPandasNode, GroupedMapInPandasNode,
-        MapInPandasNode, execute_cogrouped_map_cpu,
-        execute_grouped_map_cpu, execute_map_in_pandas_cpu)
+        MapInPandasNode, WindowInPandasNode,
+        execute_cogrouped_map_cpu, execute_grouped_map_cpu,
+        execute_map_in_pandas_cpu, execute_window_in_pandas_cpu)
     from spark_rapids_tpu.io.write import WriteFilesNode
 
     _NODES[WriteFilesNode] = _write_files
     _NODES[MapInPandasNode] = execute_map_in_pandas_cpu
     _NODES[GroupedMapInPandasNode] = execute_grouped_map_cpu
     _NODES[CoGroupedMapInPandasNode] = execute_cogrouped_map_cpu
+    _NODES[WindowInPandasNode] = execute_window_in_pandas_cpu
     _NODES[CacheNode] = _passthrough  # the oracle recomputes
 
 

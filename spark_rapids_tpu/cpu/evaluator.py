@@ -10,6 +10,7 @@ the same logical encoding the device layer uses, so results compare 1:1.
 from __future__ import annotations
 
 import datetime
+import re as _re
 from typing import List, Optional
 
 import numpy as np
@@ -18,6 +19,7 @@ from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.expressions import arithmetic as ar
 from spark_rapids_tpu.expressions import bitwise as bw
 from spark_rapids_tpu.expressions import conditional as cond
+from spark_rapids_tpu.expressions import constraints as cns
 from spark_rapids_tpu.expressions import datetime as dte
 from spark_rapids_tpu.expressions import math as mth
 from spark_rapids_tpu.expressions import nondeterministic as nd
@@ -76,9 +78,10 @@ def and_valid(*vs: Optional[np.ndarray]) -> Optional[np.ndarray]:
 
 
 class CpuEvalContext:
-    def __init__(self, columns: List[CV], num_rows: int):
+    def __init__(self, columns: List[CV], num_rows: int, origins=None):
         self.columns = columns
         self.num_rows = num_rows
+        self.origins = origins  # [(origin, row_count)] above file scans
 
 
 def eval_expr(e: Expression, ctx: CpuEvalContext) -> CV:
@@ -429,7 +432,44 @@ _MATH_FNS = {
     mth.Acos: np.arccos, mth.Atan: np.arctan, mth.Sinh: np.sinh,
     mth.Cosh: np.cosh, mth.Tanh: np.tanh, mth.ToDegrees: np.degrees,
     mth.ToRadians: np.radians, mth.Rint: np.rint,
+    mth.Asinh: np.arcsinh, mth.Acosh: np.arccosh, mth.Atanh: np.arctanh,
+    mth.Cot: lambda x: 1.0 / np.tan(x),
 }
+
+
+def _logarithm(e, ctx):
+    def fn(b, x):
+        with np.errstate(all="ignore"):
+            return (np.log(x.astype(np.float64)) /
+                    np.log(b.astype(np.float64)))
+    return _binary_num(e, ctx, fn, dt.FLOAT64)
+
+
+def _java_regex_replacement(m, repl: str) -> str:
+    """Expand a replacement string with JAVA Matcher.replaceAll semantics
+    ($N = group reference, backslash escapes the next char) — Python's
+    re.sub uses \\N instead and would raise on Java-style escapes."""
+    out = []
+    i = 0
+    while i < len(repl):
+        ch = repl[i]
+        if ch == "\\" and i + 1 < len(repl):
+            out.append(repl[i + 1])
+            i += 2
+        elif ch == "$" and i + 1 < len(repl) and repl[i + 1].isdigit():
+            out.append(m.group(int(repl[i + 1])) or "")
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _normalize_nan_zero(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    x = v.data + np.zeros((), dtype=v.data.dtype)  # -0.0 -> +0.0
+    x = np.where(np.isnan(x), np.asarray(np.nan, dtype=x.dtype), x)
+    return CV(e.dtype, x, v.validity)
 
 
 def _unary_math(e, ctx):
@@ -555,6 +595,19 @@ def _day_of_week(e, ctx):
     # Spark: 1 = Sunday ... 7 = Saturday; epoch (1970-01-01) was a Thursday
     dow = ((v.data.astype(np.int64) + 4) % 7 + 7) % 7 + 1
     return CV(dt.INT32, dow.astype(np.int32), v.validity)
+
+
+def _week_day(e, ctx):
+    v = eval_expr(e.children[0], ctx)
+    # Spark WeekDay: 0 = Monday ... 6 = Sunday
+    wd = ((v.data.astype(np.int64) + 3) % 7 + 7) % 7
+    return CV(dt.INT32, wd.astype(np.int32), v.validity)
+
+
+def _time_add(e, ctx):
+    def fn(a, b):
+        return a.astype(np.int64) + b.astype(np.int64)
+    return _binary_num(e, ctx, fn, dt.TIMESTAMP)
 
 
 def _day_of_year(e, ctx):
@@ -807,10 +860,17 @@ _DISPATCH = {
     mth.Ceil: _ceil,
     mth.Pow: _pow,
     mth.Atan2: _atan2,
+    mth.Logarithm: _logarithm,
+    cns.NormalizeNaNAndZero: _normalize_nan_zero,
+    cns.KnownFloatingPointNormalized:
+        lambda e, ctx: eval_expr(e.children[0], ctx),
     dte.Year: _date_field("year"),
     dte.Month: _date_field("month"),
     dte.DayOfMonth: _date_field("day"),
     dte.DayOfWeek: _day_of_week,
+    dte.WeekDay: _week_day,
+    dte.TimeAdd: _time_add,
+    dte.ToUnixTimestamp: _unix_timestamp,
     dte.DayOfYear: _day_of_year,
     dte.Quarter: _quarter,
     dte.Hour: _time_field("hour"),
@@ -835,6 +895,13 @@ _DISPATCH = {
     st.Substring: _substring,
     st.StringReplace: _str_unary(
         lambda e, s: s.replace(e.search, e.replace)),
+    st.SubstringIndex: _str_unary(lambda e, s: e.fn(s)),
+    # the oracle runs the FULL regex (vanilla-Spark semantics); the TPU
+    # path only accepts regex-free patterns, where the two coincide
+    st.RegExpReplace: _str_unary(
+        lambda e, s: _re.sub(
+            e.pattern,
+            lambda m: _java_regex_replacement(m, e.replacement), s)),
     st.StringRepeat: _str_unary(lambda e, s: s * max(e.times, 0)),
     st.StringLPad: _str_unary(
         lambda e, s: (e.pad * e.width + s)[-e.width:]

@@ -47,12 +47,15 @@ class ScanExec(TpuExec):
             if n == 0:
                 yield ColumnarBatch.empty(self.schema)
                 return
+            origin = self.source.split_origin(partition)
             with semaphore.get():
                 for start in range(0, n, self.batch_rows):
                     end = min(start + self.batch_rows, n)
                     with TraceRange("ScanExec.upload"):
-                        yield interop.host_to_batch(data, validity,
-                                                    self.schema, start, end)
+                        b = interop.host_to_batch(data, validity,
+                                                  self.schema, start, end)
+                        b.origin = origin
+                        yield b
         return timed(self, it())
 
 
@@ -195,8 +198,9 @@ class UnionExec(TpuExec):
 
 
 class ExpandExec(TpuExec):
-    """Per input batch, evaluate each projection then concatenate
-    (GpuExpandExec.scala)."""
+    """Per input batch, evaluate each projection then interleave row-major
+    — Spark's ExpandExec/explode emission order, one output row per
+    (input row, projection) pair (GpuExpandExec.scala)."""
 
     def __init__(self, projections: List[List[Expression]], child: TpuExec,
                  schema: Schema, conf=None):
@@ -205,11 +209,13 @@ class ExpandExec(TpuExec):
                             for p in projections]
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.ops.concat import interleave_batches
+
         def it():
             for b in self.children[0].execute(partition):
                 parts = [proj(b) for proj in self.projections]
-                with TraceRange("ExpandExec.concat"):
-                    yield concat_batches(parts)
+                with TraceRange("ExpandExec.interleave"):
+                    yield interleave_batches(parts)
         return timed(self, it())
 
 

@@ -291,6 +291,108 @@ class CoGroupedMapInPandasExec(TpuExec):
         return timed(self, it())
 
 
+class WindowInPandasNode(PlanNode):
+    """window-over pandas UDF analogue (GpuWindowInPandasExec, the shim
+    exec of §2.12): ``fn`` receives one partition-group's pandas DataFrame
+    sorted by ``order_specs`` and returns a sequence/Series of
+    ``out_dtype`` values aligned 1:1 with the group's rows (the
+    unbounded-window grouped-vectorized case Spark's WindowInPandas
+    serves). Output = child columns + the new column; row identity is
+    preserved (results map back to input row order)."""
+
+    def __init__(self, partition_ordinals, order_specs, fn: Callable,
+                 out_name: str, out_dtype, child: PlanNode):
+        super().__init__([child])
+        assert partition_ordinals, "window-in-pandas requires partitions"
+        self.partition_ordinals = list(partition_ordinals)
+        self.order_specs = list(order_specs)
+        self.fn = fn
+        self.out_name = out_name
+        self.out_dtype = out_dtype
+
+    def output_schema(self) -> Schema:
+        s = self.children[0].output_schema()
+        return Schema(list(s.names) + [self.out_name],
+                      list(s.types) + [self.out_dtype])
+
+    def describe(self) -> str:
+        return (f"WindowInPandas[{getattr(self.fn, '__name__', 'fn')}, "
+                f"part={self.partition_ordinals}]")
+
+
+def _apply_window_in_pandas(pdf, node: "WindowInPandasNode",
+                            child_schema: Schema):
+    """Shared TPU/CPU body: group -> sort -> fn -> align back by index."""
+    import pandas as pd
+
+    key_names = [child_schema.names[o] for o in node.partition_ordinals]
+    order_cols = [child_schema.names[s.ordinal] for s in node.order_specs]
+    ascending = [s.ascending for s in node.order_specs]
+    out = pd.Series([None] * len(pdf), index=pdf.index, dtype=object)
+    for _, g in pdf.groupby(key_names, dropna=False, sort=False):
+        if order_cols:
+            g = g.sort_values(order_cols, ascending=ascending,
+                              kind="stable")
+        vals = node.fn(g.reset_index(drop=True))
+        vals = list(vals)
+        if len(vals) != len(g):
+            raise ValueError(
+                f"window fn returned {len(vals)} values for a "
+                f"{len(g)}-row partition")
+        out.loc[g.index] = vals
+    result = pdf.copy()
+    result[node.out_name] = out
+    return result
+
+
+class WindowInPandasExec(TpuExec):
+    """Child is hash-co-partitioned on the partition keys by the planner
+    (each window partition lives wholly in one task partition)."""
+
+    def __init__(self, node: WindowInPandasNode, child: TpuExec):
+        super().__init__([child], node.output_schema())
+        self.node = node
+
+    @property
+    def children_coalesce_goal(self):
+        from spark_rapids_tpu.execs.batching import RequireSingleBatch
+
+        return [RequireSingleBatch]
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+
+        child_schema = self.node.children[0].output_schema()
+        out_schema = self.schema
+
+        def it():
+            b = drain_to_single_batch(
+                self.children[0].execute(partition), child_schema)
+            if b.realized_num_rows() == 0:
+                yield ColumnarBatch.empty(out_schema)
+                return
+            PythonWorkerSemaphore.acquire()
+            try:
+                with TraceRange("WindowInPandasExec.python"):
+                    pdf = b.to_pandas(child_schema)
+                    out = _apply_window_in_pandas(pdf, self.node,
+                                                  child_schema)
+                    data, validity = _pandas_to_host(out, out_schema)
+            finally:
+                PythonWorkerSemaphore.release()
+            yield interop.host_to_batch(data, validity, out_schema)
+        return timed(self, it())
+
+
+def execute_window_in_pandas_cpu(node: WindowInPandasNode):
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+
+    child = execute_cpu(node.children[0])
+    child_schema = node.children[0].output_schema()
+    out = _apply_window_in_pandas(child.to_pandas(), node, child_schema)
+    return _cpu_frame_from_pandas(out, node.output_schema())
+
+
 def _cpu_frame_from_pandas(out, schema: Schema):
     """Shared pandas-result -> CpuFrame tail for the CPU-engine pandas
     execs."""

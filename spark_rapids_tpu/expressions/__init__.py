@@ -60,3 +60,9 @@ from spark_rapids_tpu.expressions.aggregates import (  # noqa: F401
     Min,
     Sum,
 )
+from spark_rapids_tpu.expressions.predicates import InSet  # noqa: F401
+from spark_rapids_tpu.expressions.constraints import (  # noqa: F401
+    KnownFloatingPointNormalized,
+    NormalizeNaNAndZero,
+)
+from spark_rapids_tpu.expressions.conditional import CaseWhen  # noqa: F401

@@ -24,6 +24,7 @@ from typing import Callable, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -58,13 +59,15 @@ class EvalContext:
     """What an expression sees during evaluation."""
 
     def __init__(self, columns: List[ColV], capacity: int, num_rows,
-                 conf=None, in_jit: bool = False, task_info=None):
+                 conf=None, in_jit: bool = False, task_info=None,
+                 origin=None):
         self.columns = columns
         self.capacity = capacity
         self.num_rows = num_rows
         self.conf = conf
         self.in_jit = in_jit
         self.task_info = task_info  # partition id etc (nondeterministic exprs)
+        self.origin = origin  # (file, block_start, block_len) above scans
 
     @staticmethod
     def from_batch(batch: ColumnarBatch, conf=None,
@@ -74,7 +77,8 @@ class EvalContext:
             scol = c if isinstance(c, StringColumn) else None
             cols.append(ColV(c.dtype, c.data, c.validity, scol))
         return EvalContext(cols, batch.capacity, batch.num_rows_device(),
-                           conf=conf, task_info=task_info)
+                           conf=conf, task_info=task_info,
+                           origin=batch.origin)
 
 
 class Expression:
@@ -82,6 +86,57 @@ class Expression:
 
     def __init__(self, children: Sequence["Expression"] = ()):
         self.children = list(children)
+
+    def tree_key(self):
+        """Hashable structural fingerprint, or None when this tree can't
+        be keyed. Two expressions with equal keys compile to the same
+        fused kernel, so CompiledProjection/CompiledFilter share one
+        jitted function across plan instances (a fresh plan per query —
+        the reference's per-query GpuOverrides pass — must not re-trace
+        every projection)."""
+        params = []
+        for k in sorted(vars(self)):
+            if k == "children":
+                continue
+            v = vars(self)[k]
+            private = k.startswith("_")
+            if isinstance(v, (int, float, str, bool, bytes,
+                              type(None))):
+                params.append((k, v))
+            elif isinstance(v, (np.integer, np.floating, np.bool_)):
+                params.append((k, ("#np", v.item())))
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, (int, float, str, bool, type(None)))
+                    for x in v):
+                params.append((k, ("#seq",) + tuple(v)))
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, Expression) for x in v):
+                subs = tuple(x.tree_key() for x in v)
+                if any(s is None for s in subs):
+                    return None
+                params.append((k, ("#exprs",) + subs))
+            elif hasattr(v, "name") and hasattr(v, "kernel_dtype"):
+                params.append((k, ("#dtype", v.name)))
+            elif isinstance(v, Expression):
+                sub = v.tree_key()
+                if sub is None:
+                    return None
+                params.append((k, sub))
+            elif private:
+                continue  # private unkeyable attrs are caches, not params
+            else:
+                return None  # unkeyable payload (arrays, callables, ...)
+        kids = []
+        for c in self.children:
+            if c is None:
+                kids.append(None)
+                continue
+            ck = c.tree_key()
+            if ck is None:
+                return None
+            kids.append(ck)
+        return (type(self).__module__, type(self).__qualname__,
+                tuple(params), tuple(kids))
 
     # -- static properties -------------------------------------------------
 

@@ -29,6 +29,28 @@ from spark_rapids_tpu.expressions.base import (
 )
 
 
+#: fused-kernel reuse across plan instances: every query gets a FRESH
+#: plan/exec tree (the per-query override pass), but two structurally
+#: identical projections must share ONE jitted function or each query
+#: re-traces (and re-loads) every kernel. Keyed by Expression.tree_key.
+_FUSED_CACHE: dict = {}
+_FUSED_CACHE_MAX = 1024
+
+
+def _fused_cache_get(key):
+    if key is None:
+        return None
+    return _FUSED_CACHE.get(key)
+
+
+def _fused_cache_put(key, fn):
+    if key is None:
+        return
+    if len(_FUSED_CACHE) >= _FUSED_CACHE_MAX:
+        _FUSED_CACHE.clear()  # crude bound; keys are tiny, fns are jits
+    _FUSED_CACHE[key] = fn
+
+
 def _unwrap_alias(e: Expression) -> Expression:
     while isinstance(e, Alias):
         e = e.children[0]
@@ -50,7 +72,17 @@ class CompiledProjection:
         self.conf = conf
         self.fused = all(e.device_only for e in self.exprs)
         if self.fused:
-            self._jit = self._build_fused()
+            key = None
+            # Alias is an eval passthrough — key on the unwrapped tree so
+            # q5's Alias(rev) and q10's Alias(revenue) share one kernel
+            kparts = tuple(_unwrap_alias(e).tree_key()
+                           for e in self.exprs)
+            if all(k is not None for k in kparts):
+                key = ("projection", kparts)
+            self._jit = _fused_cache_get(key)
+            if self._jit is None:
+                self._jit = self._build_fused()
+                _fused_cache_put(key, self._jit)
 
     def _build_fused(self):
         exprs = self.exprs
@@ -117,6 +149,11 @@ class CompiledFilter:
         self.fused = condition.device_only
         if self.fused:
             cond = condition
+            key = condition.tree_key()
+            key = ("filter", key) if key is not None else None
+            self._mask = _fused_cache_get(key)
+            if self._mask is not None:
+                return
 
             @partial(jax.jit, static_argnames=("types",))
             def run_mask(datas, validities, num_rows, task, types):
@@ -132,6 +169,7 @@ class CompiledFilter:
                 return keep
 
             self._mask = run_mask
+            _fused_cache_put(key, run_mask)
 
     def mask(self, batch: ColumnarBatch, task_info=None):
         """Keep-mask only (no compaction): downstream sorts/groupbys fuse

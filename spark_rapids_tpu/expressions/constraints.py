@@ -1,0 +1,46 @@
+"""Float-normalization constraint expressions (reference:
+sql-plugin/.../NormalizeFloatingNumbers.scala via GpuOverrides registry,
+constraintExpressions.scala): Catalyst inserts these around grouping/join
+keys; the engine must honor them so NaN/-0.0 keys group identically."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expressions.base import Expression, eval_unary
+
+
+class NormalizeNaNAndZero(Expression):
+    """-0.0 -> +0.0 and every NaN -> one canonical NaN."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        def f(x):
+            # explicit select: XLA's algebraic simplifier folds x + 0.0
+            # back to x, which would keep -0.0's sign
+            x = jnp.where(x == 0, jnp.asarray(0.0, dtype=x.dtype), x)
+            return jnp.where(jnp.isnan(x),
+                             jnp.asarray(jnp.nan, dtype=x.dtype), x)
+
+        return eval_unary(self, ctx, f, self.dtype)
+
+
+class KnownFloatingPointNormalized(Expression):
+    """Marker: the child is already normalized — evaluation is identity
+    (constraintExpressions.scala)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)

@@ -275,3 +275,47 @@ class Quarter(Expression):
             return ((m - 1) // 3 + 1).astype(jnp.int32)
 
         return eval_unary(self, ctx, f, dt.INT32)
+
+
+class WeekDay(Expression):
+    """0 = Monday ... 6 = Sunday (Spark WeekDay, vs DayOfWeek's
+    1=Sunday numbering)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval(self, ctx):
+        def f(days):
+            # 1970-01-01 was a Thursday (=3 in Monday-0 numbering)
+            return jnp.mod(days.astype(jnp.int64) + 3, 7).astype(jnp.int32)
+
+        return eval_unary(self, ctx, f, dt.INT32)
+
+
+class ToUnixTimestamp(UnixTimestamp):
+    """ToUnixTimestamp is UnixTimestamp with reversed SQL argument order;
+    as an expression node the semantics are identical (the reference maps
+    both onto the same GPU implementation, GpuOverrides.scala registry)."""
+
+
+class TimeAdd(Expression):
+    """timestamp + microsecond delta (Spark TimeAdd with a literal
+    CalendarInterval; the reference only supports literal intervals with
+    no month component — months are calendar-irregular)."""
+
+    def __init__(self, start, delta_us):
+        super().__init__([start, delta_us])
+
+    @property
+    def dtype(self):
+        return dt.TIMESTAMP
+
+    def eval(self, ctx):
+        return eval_binary(
+            self, ctx,
+            lambda a, b: a.astype(jnp.int64) + b.astype(jnp.int64),
+            dt.TIMESTAMP)

@@ -114,6 +114,26 @@ class Tanh(_UnaryMathF64):
     incompat = True
 
 
+class Asinh(_UnaryMathF64):
+    fn = staticmethod(jnp.arcsinh)
+    incompat = True
+
+
+class Acosh(_UnaryMathF64):
+    fn = staticmethod(jnp.arccosh)
+    incompat = True
+
+
+class Atanh(_UnaryMathF64):
+    fn = staticmethod(jnp.arctanh)
+    incompat = True
+
+
+class Cot(_UnaryMathF64):
+    fn = staticmethod(lambda x: 1.0 / jnp.tan(x))
+    incompat = True
+
+
 class ToDegrees(_UnaryMathF64):
     fn = staticmethod(jnp.degrees)
 
@@ -184,6 +204,26 @@ class Pow(Expression):
             self, ctx,
             lambda a, b: jnp.power(a.astype(jnp.float64),
                                    b.astype(jnp.float64)), dt.FLOAT64)
+
+
+class Logarithm(Expression):
+    """log(base, x) — Spark's two-argument logarithm."""
+
+    incompat = True
+
+    def __init__(self, base: Expression, child: Expression):
+        super().__init__([base, child])
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def eval(self, ctx):
+        return eval_binary(
+            self, ctx,
+            lambda b, x: jnp.log(x.astype(jnp.float64)) /
+            jnp.log(b.astype(jnp.float64)),
+            dt.FLOAT64)
 
 
 class Atan2(Expression):

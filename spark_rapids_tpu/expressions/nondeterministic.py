@@ -173,3 +173,67 @@ def rand_reference(seed: int, partition_id, positions):
         z = (z ^ lshr(z, 27)) * MIX2
         z = z ^ lshr(z, 31)
         return lshr(z, 11).astype(np.float64) * 2.0 ** -53
+
+
+class _InputFileExpr(LeafExpression):
+    """Base for input_file_name/_block_start/_block_length
+    (GpuInputFileBlock.scala): batch-constant values read from the scan
+    origin; outside a file scan Spark returns ""/-1 and so do we.
+    Not device_only — the value is a host scalar broadcast per batch."""
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def device_only(self):
+        return False
+
+    def _from_origin(self, origin):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.columnar.column import Scalar
+
+        return Scalar(self.dtype, self._from_origin(ctx.origin))
+
+    def eval_cpu(self, ctx):
+        """CPU-oracle evaluation (engine dispatch honors eval_cpu);
+        ``ctx.origins`` is [(origin, row_count)] runs from the scan."""
+        import numpy as np
+
+        from spark_rapids_tpu.cpu.evaluator import CV
+
+        runs = getattr(ctx, "origins", None) or [(None, ctx.num_rows)]
+        np_t = object if self.dtype is dt.STRING else np.int64
+        parts = [np.full(count, self._from_origin(o), dtype=np_t)
+                 for o, count in runs]
+        data = np.concatenate(parts) if parts else np.array([], dtype=np_t)
+        return CV(self.dtype, data, None)
+
+
+class InputFileName(_InputFileExpr):
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def _from_origin(self, origin):
+        return origin[0] if origin else ""
+
+
+class InputFileBlockStart(_InputFileExpr):
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def _from_origin(self, origin):
+        return int(origin[1]) if origin else -1
+
+
+class InputFileBlockLength(_InputFileExpr):
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def _from_origin(self, origin):
+        return int(origin[2]) if origin else -1

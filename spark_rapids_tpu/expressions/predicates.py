@@ -393,3 +393,8 @@ class AtLeastNNonNulls(Expression):
                 nn = not_nan if nn is None else (nn & not_nan)
             count = count + (nn.astype(jnp.int32) if nn is not None else 1)
         return ColV(dt.BOOLEAN, count >= self.n, None)
+
+
+#: InSet is Catalyst's optimized literal-set variant of In; as a plan
+#: node the semantics are identical (GpuInSet in the reference registry)
+InSet = In

@@ -191,6 +191,55 @@ class StringReplace(Expression):
             dt.STRING)
 
 
+class SubstringIndex(_StrUnary):
+    """substring_index(str, delim, count): count>0 keeps everything
+    before the count-th delimiter from the left, count<0 everything after
+    the |count|-th from the right, 0 -> empty (Spark semantics)."""
+
+    def __init__(self, child: Expression, delim: str, count: int):
+        super().__init__(child)
+        self.delim = delim
+        self.count = count
+
+    def fn(self, s):
+        if self.count == 0 or not self.delim:
+            return ""
+        parts = s.split(self.delim)
+        if self.count > 0:
+            return self.delim.join(parts[:self.count])
+        return self.delim.join(parts[self.count:])
+
+
+_REGEX_METACHARS = set("\\^$.|?*+()[]{}")
+
+
+class RegExpReplace(_StrUnary):
+    """regexp_replace limited to regex-free search patterns — exactly the
+    reference's constraint (GpuOverrides.scala:343-351
+    isSupportedStringReplacePattern gates GpuRegExpReplace on patterns
+    with no regex metacharacters); anything else falls back to the CPU
+    engine, whose oracle implementation runs the full regex."""
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        super().__init__(child)
+        self.pattern = pattern
+        self.replacement = replacement
+
+    def fn(self, s):
+        return s.replace(self.pattern, self.replacement)
+
+    def tag_self(self, meta, conf):
+        if not self.pattern or \
+                any(c in _REGEX_METACHARS for c in self.pattern):
+            meta.will_not_work(
+                "regexp_replace on the TPU requires a non-empty, "
+                "regex-free pattern (GpuOverrides.scala:343-351)")
+        if "\\" in self.replacement or "$" in self.replacement:
+            meta.will_not_work(
+                "regexp_replace replacement must not contain "
+                "backreferences (GpuOverrides.scala:423-438)")
+
+
 class StringRepeat(Expression):
     def __init__(self, child: Expression, times: int):
         super().__init__([child])
@@ -379,3 +428,8 @@ class ConcatStrings(Expression):
                 str(p[i] if len(p) > 1 else p[0]) for p in parts))
         sc = StringColumn.from_strings(out, capacity=cap)
         return ColV(dt.STRING, sc.data, validity, sc)
+
+
+#: Spark's Concat over string children — same node (the reference
+#: registers Concat, GpuOverrides.scala registry)
+Concat = ConcatStrings

@@ -134,6 +134,18 @@ class FileSourceBase(DataSource):
         table = self._read_split(descs[split])
         return arrow_conv.table_to_host(table, self.schema())
 
+    def split_origin(self, split: int):
+        descs = self.splits()
+        if not descs:
+            return None
+        desc = descs[split]
+        path = desc if isinstance(desc, str) else desc.path
+        try:
+            size = os.path.getsize(path)
+        except OSError:  # pragma: no cover - raced unlink
+            size = -1
+        return (path, 0, size)
+
     def read_host(self):
         """Read ALL splits through the multi-file thread pool and stitch
         (MultiFileParquetPartitionReader analogue,

@@ -58,6 +58,50 @@ def concat_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
     return ColumnarBatch(out_cols, total)
 
 
+def interleave_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
+    """Row-major interleave of same-schema, same-num_rows batches: output
+    row i*k+j comes from batches[j] row i. This is Spark's ExpandExec /
+    explode emission order (one output row per (input row, projection)
+    pair, projections adjacent). A stack+reshape keeps live rows in the
+    prefix [0, n*k): with every input's live rows in [0, n), output slot
+    i*k+j < n*k iff i < n."""
+    assert batches, "interleave of zero batches"
+    if len(batches) == 1:
+        return batches[0]
+    k = len(batches)
+    ncols = batches[0].num_columns
+    n = batches[0].realized_num_rows()
+    assert all(b.realized_num_rows() == n for b in batches), \
+        "interleave requires equal row counts"
+    cap = max(b.capacity for b in batches)
+
+    out_cols: List[Column] = []
+    for ci in range(ncols):
+        cols = [b.columns[ci].with_capacity(cap) for b in batches]
+        if isinstance(cols[0], StringColumn):
+            cols = unify_dictionaries(cols)  # type: ignore[arg-type]
+            dictionary = cols[0].dictionary
+        else:
+            dictionary = None
+        data = _interleave([c.data for c in cols])
+        if any(c.validity is not None for c in cols):
+            validity = _interleave(
+                [c.validity if c.validity is not None
+                 else jnp.ones(cap, dtype=bool) for c in cols])
+        else:
+            validity = None
+        if dictionary is not None:
+            out_cols.append(StringColumn(data, dictionary, validity))
+        else:
+            out_cols.append(Column(cols[0].dtype, data, validity))
+    return ColumnarBatch(out_cols, n * k)
+
+
+@jax.jit
+def _interleave(arrs: List[jax.Array]) -> jax.Array:
+    return jnp.stack(arrs, axis=1).reshape(-1)
+
+
 @jax.jit
 def _place(dst: jax.Array, src: jax.Array, offset, n):
     """Write src[0:n] into dst[offset:offset+n]. ``offset``/``n`` are traced

@@ -20,7 +20,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from spark_rapids_tpu.shims import get_shims
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_tpu.columnar import dtypes as dt
@@ -91,7 +91,7 @@ class DistributedDimJoinStep:
                     [P()] * n_dim, [P()] * n_dim)
         n_out = n_fact + n_dim - 1
         out_specs = ([P(ax)] * n_out, [P(ax)] * n_out, P(ax), P(ax))
-        fn = shard_map(device_step, mesh=self.mesh,
+        fn = get_shims().shard_map()(device_step, mesh=self.mesh,
                        in_specs=in_specs, out_specs=out_specs)
         return jax.jit(fn)
 

@@ -30,3 +30,25 @@ def data_mesh(n_devices: Optional[int] = None,
 
 def mesh_axis_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     return mesh.shape[axis]
+
+
+def force_cpu_mesh(n_devices: int) -> None:
+    """Ensure at least ``n_devices`` devices exist, falling back to a
+    virtual CPU mesh when the attached backend has fewer (e.g. one real
+    TPU chip). Used by multi-chip dry runs and mesh benchmarks."""
+    import os
+
+    if len(jax.devices()) >= n_devices:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.shims import get_shims
+
+    get_shims().clear_backends()
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} devices, have {jax.devices()}")

@@ -31,8 +31,9 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.shims import get_shims
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -146,8 +147,9 @@ class DistributedGroupByStep:
                     P(self.axis))
         out_specs = ([P(self.axis)] * n_out, [P(self.axis)] * n_out,
                      P(self.axis))
-        fn = shard_map(device_step, mesh=self.mesh,
-                       in_specs=in_specs, out_specs=out_specs)
+        fn = get_shims().shard_map()(device_step, mesh=self.mesh,
+                                     in_specs=in_specs,
+                                     out_specs=out_specs)
         return jax.jit(fn)
 
     def __call__(self, datas: List[jax.Array], valids: List[jax.Array],

@@ -25,7 +25,8 @@ from typing import List, Optional, Sequence, Tuple
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import Schema
 from spark_rapids_tpu.expressions.aggregates import AggregateFunction
-from spark_rapids_tpu.expressions.base import Alias, Expression
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression, Literal)
 from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 
 JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
@@ -99,6 +100,11 @@ class DataSource:
     def read_host_split(self, split: int):
         assert split == 0, split
         return self.read_host()
+
+    def split_origin(self, split: int):
+        """(file_path, block_start, block_length) for file-backed splits
+        (input_file_name support); None for non-file sources."""
+        return None
 
 
 class InMemorySource(DataSource):
@@ -318,6 +324,60 @@ class ExpandNode(PlanNode):
 
     def describe(self) -> str:
         return f"Expand[{len(self.projections)} projections]"
+
+
+class GenerateNode(PlanNode):
+    """explode/posexplode of a per-row created array of expressions
+    (GpuGenerateExec.scala: the reference supports exactly
+    Explode/PosExplode(CreateArray(exprs)) since v0.3 has no array type).
+    Each input row emits len(exprs) rows: the required child columns
+    repeated, an optional position column, and the k-th expression's
+    value. Lowering desugars this into Expand projections — one per array
+    slot — instead of a dedicated kernel."""
+
+    def __init__(self, exprs: List[Expression], child: PlanNode,
+                 required_ordinals: List[int], value_name: str = "col",
+                 include_pos: bool = False, pos_name: str = "pos"):
+        super().__init__([child])
+        assert exprs, "explode of an empty array produces no columns"
+        assert len({e.dtype for e in exprs}) == 1, \
+            "array slots must share one type (CreateArray type coercion " \
+            "happens before planning)"
+        self.exprs = list(exprs)
+        self.required_ordinals = list(required_ordinals)
+        self.value_name = value_name
+        self.include_pos = include_pos
+        self.pos_name = pos_name
+
+    def output_schema(self) -> Schema:
+        s = self.children[0].output_schema()
+        names = [s.names[o] for o in self.required_ordinals]
+        types = [s.types[o] for o in self.required_ordinals]
+        if self.include_pos:
+            names.append(self.pos_name)
+            types.append(dt.INT32)
+        names.append(self.value_name)
+        types.append(self.exprs[0].dtype)
+        return Schema(names, types)
+
+    def expand_projections(self) -> List[List[Expression]]:
+        """The Expand-projection desugaring (one projection per array
+        slot) shared by the planner rule and the CPU oracle."""
+        child_schema = self.children[0].output_schema()
+        projections = []
+        for k, e in enumerate(self.exprs):
+            p: List[Expression] = [
+                BoundReference(o, child_schema.types[o])
+                for o in self.required_ordinals]
+            if self.include_pos:
+                p.append(Literal(k, dt.INT32))
+            p.append(e)
+            projections.append(p)
+        return projections
+
+    def describe(self) -> str:
+        gen = "posexplode" if self.include_pos else "explode"
+        return f"Generate[{gen}, {len(self.exprs)} slots]"
 
 
 # --------------------------------------------------------------------------

@@ -28,7 +28,7 @@ from spark_rapids_tpu.execs import basic, batching, exchange, joins, sort, \
 from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.expressions import aggregates as aggfn
 from spark_rapids_tpu.expressions import arithmetic, bitwise, cast, \
-    conditional, datetime as dtexpr, math as mathexpr, \
+    conditional, constraints, datetime as dtexpr, math as mathexpr, \
     nondeterministic, predicates, strings
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression, Literal)
@@ -64,6 +64,11 @@ class ExprRule:
                     f"{self.flag.key}")
         if isinstance(e, cast.Cast):
             self._tag_cast(e, meta, conf)
+        tag_self = getattr(e, "tag_self", None)
+        if tag_self is not None:
+            # expression-specific gate (e.g. RegExpReplace's regex-free
+            # pattern requirement)
+            tag_self(meta, conf)
 
     @staticmethod
     def _tag_cast(e: cast.Cast, meta: "NodeMeta", conf: RapidsConf):
@@ -89,8 +94,8 @@ _EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
 def _register_exprs():
     import inspect
 
-    for mod in (arithmetic, bitwise, predicates, conditional, mathexpr,
-                dtexpr, nondeterministic, strings, cast, aggfn):
+    for mod in (arithmetic, bitwise, predicates, conditional, constraints,
+                mathexpr, dtexpr, nondeterministic, strings, cast, aggfn):
         for _, klass in inspect.getmembers(mod, inspect.isclass):
             if not issubclass(klass, Expression):
                 continue
@@ -487,6 +492,24 @@ class _ExpandRule(NodeRule):
                                 node.output_schema(), meta.conf)
 
 
+class _GenerateRule(NodeRule):
+    """GpuGenerateExecSparkPlanMeta analogue: only explode/posexplode of a
+    created array is supported (GpuGenerateExec.scala:66-82); lowering
+    desugars the generator into Expand projections (one per array slot)
+    so the existing ExpandExec kernel runs it."""
+
+    def tag(self, meta: NodeMeta):
+        node: pn.GenerateNode = meta.node
+        for e in node.exprs:
+            tag_expression(e, meta, meta.conf)
+        _check_types(meta, node.output_schema().types, "generate")
+
+    def convert(self, meta, children):
+        node: pn.GenerateNode = meta.node
+        return basic.ExpandExec(node.expand_projections(), children[0],
+                                node.output_schema(), meta.conf)
+
+
 _BNLJ_FLAG = cfg.register_op_flag(
     "exec", "BroadcastNestedLoopJoinExec",
     "Brute-force cross/conditioned join streaming the left side against a "
@@ -776,18 +799,34 @@ class _GroupedMapRule(NodeRule):
         return GroupedMapInPandasExec(node, child)
 
 
+class _WindowInPandasRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.execs.python_exec import WindowInPandasExec
+
+        node = meta.node
+        child = children[0]
+        if child.num_partitions > 1:
+            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            child = _adaptive_read(exchange.ShuffleExchangeExec(
+                ("hash", list(node.partition_ordinals)), parts, child),
+                meta.conf)
+        return WindowInPandasExec(node, child)
+
+
 def _register_io_rules():
     from spark_rapids_tpu.execs.cache import CacheNode
     from spark_rapids_tpu.execs.python_exec import MapInPandasNode
     from spark_rapids_tpu.io.write import WriteFilesNode
 
     from spark_rapids_tpu.execs.python_exec import (
-        CoGroupedMapInPandasNode, GroupedMapInPandasNode)
+        CoGroupedMapInPandasNode, GroupedMapInPandasNode,
+        WindowInPandasNode)
 
     _NODE_RULES[WriteFilesNode] = _WriteRule()
     _NODE_RULES[MapInPandasNode] = _MapInPandasRule()
     _NODE_RULES[GroupedMapInPandasNode] = _GroupedMapRule()
     _NODE_RULES[CoGroupedMapInPandasNode] = _CoGroupedMapRule()
+    _NODE_RULES[WindowInPandasNode] = _WindowInPandasRule()
     _NODE_RULES[CacheNode] = _CacheRule()
     # mirror the reference: pandas execs are off by default because data
     # leaves the accelerator for the Python worker
@@ -805,6 +844,10 @@ def _register_io_rules():
         "exec", "CoGroupedMapInPandasNode",
         "Run cogroup().applyInPandas around the TPU pipeline",
         default_enabled=False)
+    cfg.register_op_flag(
+        "exec", "WindowInPandasNode",
+        "Run a pandas window UDF over co-partitioned window partitions "
+        "(GpuWindowInPandasExec analogue)", default_enabled=False)
 
 
 _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {
@@ -817,6 +860,7 @@ _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {
     pn.LimitNode: _LimitRule(),
     pn.UnionNode: _UnionRule(),
     pn.ExpandNode: _ExpandRule(),
+    pn.GenerateNode: _GenerateRule(),
     pn.JoinNode: _JoinRule(),
     pn.WindowNode: _WindowRule(),
     pn.ShuffleExchangeNode: _ExchangeRule(),

@@ -1,0 +1,162 @@
+"""Host-framework version shims (SURVEY.md §2.13).
+
+The reference adapts to each Spark release through ServiceLoader-discovered
+``SparkShimServiceProvider``s that probe the running version and hand back
+a ``SparkShims`` implementation (ShimLoader.scala:26,
+SparkShimServiceProvider.scala:25), overridable via
+``spark.rapids.shims-provider-override`` (RapidsConf.scala:707). Our host
+framework is jax, whose public surface also moves between releases
+(``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map``; backend-reset moved into ``jax.extend``). Same design:
+providers declare the versions they serve, the loader probes the installed
+jax exactly once, and everything version-sensitive in the package goes
+through the resolved ``JaxShims``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Tuple
+
+
+def _parse_version(v: str) -> Tuple[int, ...]:
+    parts = []
+    for p in v.split("."):
+        digits = ""
+        for ch in p:
+            if ch.isdigit():
+                digits += ch
+            else:
+                break
+        if digits == "":
+            break
+        parts.append(int(digits))
+    return tuple(parts)
+
+
+class JaxShims:
+    """The version-varying API surface (SparkShims trait analogue,
+    SparkShims.scala:62-141) — only entries this package actually calls."""
+
+    def shard_map(self):
+        """The shard_map transform."""
+        raise NotImplementedError
+
+    def clear_backends(self):
+        """Reset backends so device-count flags re-apply."""
+        raise NotImplementedError
+
+    def pallas(self):
+        """The pallas kernel module (None when unavailable)."""
+        return None
+
+
+class JaxShimServiceProvider:
+    """SparkShimServiceProvider analogue: version probe + factory."""
+
+    #: inclusive lower bound, exclusive upper bound (None = open)
+    VERSION_RANGE: Tuple[Optional[str], Optional[str]] = (None, None)
+
+    @classmethod
+    def matches(cls, version: str) -> bool:
+        lo, hi = cls.VERSION_RANGE
+        v = _parse_version(version)
+        if lo is not None and v < _parse_version(lo):
+            return False
+        if hi is not None and v >= _parse_version(hi):
+            return False
+        return True
+
+    def build(self) -> JaxShims:
+        raise NotImplementedError
+
+
+class _ModernJaxShims(JaxShims):
+    """jax >= 0.6: public top-level shard_map, jax.extend backend API."""
+
+    def shard_map(self):
+        from jax import shard_map
+
+        return shard_map
+
+    def clear_backends(self):
+        from jax.extend import backend
+
+        backend.clear_backends()
+
+    def pallas(self):
+        try:
+            from jax.experimental import pallas
+
+            return pallas
+        except ImportError:  # pragma: no cover - platform-dependent
+            return None
+
+
+class ModernJaxShimProvider(JaxShimServiceProvider):
+    VERSION_RANGE = ("0.6", None)
+
+    def build(self) -> JaxShims:
+        return _ModernJaxShims()
+
+
+class _LegacyJaxShims(_ModernJaxShims):
+    """jax 0.4.x-0.5.x: shard_map lives in jax.experimental, backend
+    reset is jax.clear_backends."""
+
+    def shard_map(self):
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+        return shard_map
+
+    def clear_backends(self):
+        import jax
+
+        jax.clear_backends()  # type: ignore[attr-defined]
+
+
+class LegacyJaxShimProvider(JaxShimServiceProvider):
+    VERSION_RANGE = ("0.4", "0.6")
+
+    def build(self) -> JaxShims:
+        return _LegacyJaxShims()
+
+
+#: discovery order — the ServiceLoader registry (ShimLoader.scala:26)
+PROVIDERS: List[type] = [ModernJaxShimProvider, LegacyJaxShimProvider]
+
+OVERRIDE_ENV = "RAPIDS_TPU_SHIMS_PROVIDER_OVERRIDE"
+
+_lock = threading.Lock()
+_shims: Optional[JaxShims] = None
+
+
+def _resolve(version: str) -> JaxShims:
+    override = os.environ.get(OVERRIDE_ENV)
+    if override:
+        # spark.rapids.shims-provider-override analogue: fully qualified
+        # provider name trusted over the probe (RapidsConf.scala:707)
+        import importlib
+
+        mod, _, name = override.rpartition(".")
+        klass = getattr(importlib.import_module(mod), name) if mod else \
+            globals()[name]
+        return klass().build()
+    for p in PROVIDERS:
+        if p.matches(version):
+            return p().build()
+    raise RuntimeError(
+        f"Could not find a shim provider for jax {version}; supported "
+        f"ranges: {[p.VERSION_RANGE for p in PROVIDERS]} (set "
+        f"{OVERRIDE_ENV} to force one)")
+
+
+def get_shims() -> JaxShims:
+    """Probe once, cache forever (ShimLoader semantics)."""
+    global _shims
+    with _lock:
+        if _shims is None:
+            import jax
+
+            _shims = _resolve(jax.__version__)
+        return _shims

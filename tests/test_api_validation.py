@@ -93,3 +93,49 @@ def test_aggregate_functions_declare_partial_contract():
         assert inst.update_ops(), klass.__name__
         assert inst.merge_ops(), klass.__name__
         assert len(inst.update_ops()) == len(inst.partial_types())
+
+
+# ---------------------------------------------------------------------------
+# Shim loader (SURVEY.md §2.13: ShimLoader + SparkShimServiceProvider)
+
+
+def test_shim_provider_version_probe():
+    from spark_rapids_tpu import shims
+
+    assert shims.ModernJaxShimProvider.matches("0.9.0")
+    assert shims.ModernJaxShimProvider.matches("1.2.3")
+    assert not shims.ModernJaxShimProvider.matches("0.4.30")
+    assert shims.LegacyJaxShimProvider.matches("0.4.30")
+    assert shims.LegacyJaxShimProvider.matches("0.5.1")
+    assert not shims.LegacyJaxShimProvider.matches("0.6.0")
+
+
+def test_shim_loader_resolves_and_caches():
+    import jax
+
+    from spark_rapids_tpu import shims
+
+    s1 = shims.get_shims()
+    assert s1 is shims.get_shims()
+    # the resolved shard_map is the one the running jax serves
+    assert s1.shard_map() is not None
+    assert shims._resolve(jax.__version__) is not s1  # fresh build
+
+
+def test_shim_unsupported_version_raises():
+    import pytest
+
+    from spark_rapids_tpu import shims
+
+    with pytest.raises(RuntimeError, match="shim provider"):
+        shims._resolve("0.3.25")
+
+
+def test_shim_provider_override(monkeypatch):
+    from spark_rapids_tpu import shims
+
+    monkeypatch.setenv(
+        shims.OVERRIDE_ENV,
+        "spark_rapids_tpu.shims.LegacyJaxShimProvider")
+    resolved = shims._resolve("0.3.25")  # probe would fail; override wins
+    assert type(resolved).__name__ == "_LegacyJaxShims"

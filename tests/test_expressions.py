@@ -333,3 +333,136 @@ def test_string_scalar_scalar_comparison():
     assert col_out(out, 0)[0][0]
     assert col_out(out, 1)[0][0]
     assert not col_out(out, 2)[0][0]
+
+
+def test_inverse_hyperbolic_and_cot():
+    vals = np.array([0.3, 1.5, 2.0, -0.4])
+    b = make_batch(vals)
+    out = run_project(
+        [mexpr.Asinh(ref(0, dt.FLOAT64)), mexpr.Acosh(ref(0, dt.FLOAT64)),
+         mexpr.Atanh(ref(0, dt.FLOAT64)), mexpr.Cot(ref(0, dt.FLOAT64))],
+        b)
+    with np.errstate(all="ignore"):
+        np.testing.assert_allclose(col_out(out, 0)[0], np.arcsinh(vals))
+        np.testing.assert_allclose(col_out(out, 1)[0], np.arccosh(vals))
+        np.testing.assert_allclose(col_out(out, 2)[0], np.arctanh(vals))
+        np.testing.assert_allclose(col_out(out, 3)[0], 1.0 / np.tan(vals))
+
+
+def test_logarithm_two_arg():
+    b = make_batch(np.array([2.0, 10.0, 3.0]),
+                   np.array([8.0, 1000.0, 81.0]))
+    out = run_project(
+        [mexpr.Logarithm(ref(0, dt.FLOAT64), ref(1, dt.FLOAT64))], b)
+    np.testing.assert_allclose(col_out(out)[0], [3.0, 3.0, 4.0],
+                               rtol=1e-12)
+
+
+def test_weekday_vs_dayofweek():
+    import jax.numpy as jnp
+
+    # 1970-01-01 (epoch day 0) was a Thursday
+    days = jnp.asarray(np.array([0, 1, 2, 3, 4], dtype=np.int32))
+    b = ColumnarBatch([Column(dt.DATE, days, None)], 5)
+    out = run_project([dtexpr.WeekDay(ref(0, dt.DATE)),
+                       dtexpr.DayOfWeek(ref(0, dt.DATE))], b)
+    assert list(col_out(out, 0)[0]) == [3, 4, 5, 6, 0]   # Thu=3 Mon-based
+    assert list(col_out(out, 1)[0]) == [5, 6, 7, 1, 2]   # Thu=5 Sun-based
+
+
+def test_time_add_and_to_unix_timestamp():
+    import jax.numpy as jnp
+
+    ts = jnp.asarray(np.array([86_400_000_000, 1_000_000],
+                              dtype=np.int64))
+    b = ColumnarBatch([Column(dt.TIMESTAMP, ts, None)], 2)
+    out = run_project(
+        [dtexpr.TimeAdd(ref(0, dt.TIMESTAMP),
+                        Literal(3_600_000_000, dt.INT64)),
+         dtexpr.ToUnixTimestamp(ref(0, dt.TIMESTAMP))], b)
+    assert list(col_out(out, 0)[0]) == [90_000_000_000, 3_601_000_000]
+    assert list(col_out(out, 1)[0]) == [86_400, 1]
+
+
+def test_substring_index():
+    b = make_batch(["www.apache.org", "a.b", "noseparator", None])
+    out = run_project(
+        [sexpr.SubstringIndex(ref(0, dt.STRING), ".", 2),
+         sexpr.SubstringIndex(ref(0, dt.STRING), ".", -1)], b)
+    got2, _ = col_out(out, 0)
+    got_1, _ = col_out(out, 1)
+    # 'a.b' has one delimiter, so count=2 keeps the whole string (Spark)
+    assert list(got2) == ["www.apache", "a.b", "noseparator", None]
+    assert list(got_1) == ["org", "b", "noseparator", None]
+
+
+def test_regexp_replace_simple_pattern():
+    b = make_batch(["hello world", "nothing", None])
+    out = run_project(
+        [sexpr.RegExpReplace(ref(0, dt.STRING), "o", "0")], b)
+    got, _ = col_out(out)
+    assert list(got) == ["hell0 w0rld", "n0thing", None]
+
+
+def test_regexp_replace_regex_pattern_falls_back():
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.execs.basic import CpuFallbackExec
+    from spark_rapids_tpu.plan import nodes as pn
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    plan = pn.ProjectNode(
+        [Alias(sexpr.RegExpReplace(ref(0, dt.STRING), "o+", "0"), "r")],
+        pn.ScanNode(pn.InMemorySource(
+            {"s": np.array(["foo", "oo"], dtype=object)})))
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert isinstance(exec_, CpuFallbackExec)
+    assert any("regex-free" in r for r in exec_.reasons)
+    # the oracle-side fallback runs the real regex
+    from spark_rapids_tpu.execs.base import collect
+
+    got = collect(exec_)
+    assert list(got["r"]) == ["f0", "0"]
+
+
+def test_normalize_nan_and_zero():
+    from spark_rapids_tpu.expressions.constraints import (
+        KnownFloatingPointNormalized, NormalizeNaNAndZero)
+
+    vals = np.array([-0.0, 0.0, np.nan, 1.5])
+    b = make_batch(vals)
+    out = run_project(
+        [KnownFloatingPointNormalized(
+            NormalizeNaNAndZero(ref(0, dt.FLOAT64)))], b)
+    got, _ = col_out(out)
+    assert not np.signbit(got[0])  # -0.0 normalized
+    assert np.isnan(got[2]) and got[3] == 1.5
+
+
+def test_fused_kernel_reuse_across_instances():
+    """Structurally identical projections/filters share ONE jitted fn
+    (fresh per-query plans must not re-trace); different types or
+    literals must NOT collide."""
+    from spark_rapids_tpu.expressions.compiler import (CompiledFilter,
+                                                       CompiledProjection)
+
+    def proj(lit):
+        return CompiledProjection(
+            [Add(Multiply(ref(0, dt.FLOAT64), Literal(lit)),
+                 ref(1, dt.FLOAT64))])
+
+    p1, p2 = proj(2.0), proj(2.0)
+    assert p1.fused and p1._jit is p2._jit
+    p3 = proj(3.0)
+    assert p3._jit is not p1._jit
+    # same ordinal, different declared type -> different kernels
+    pa = CompiledProjection([Add(ref(0, dt.INT64), Literal(1))])
+    pb = CompiledProjection([Add(ref(0, dt.INT32), Literal(1))])
+    assert pa._jit is not pb._jit
+
+    f1 = CompiledFilter(GreaterThan(ref(0, dt.FLOAT64), Literal(0.5)))
+    f2 = CompiledFilter(GreaterThan(ref(0, dt.FLOAT64), Literal(0.5)))
+    assert f1.fused and f1._mask is f2._mask
+
+    # correctness through the shared kernel
+    b = make_batch(np.array([1.0, 2.0]), np.array([10.0, 20.0]))
+    np.testing.assert_allclose(col_out(p2(b))[0], [12.0, 24.0])

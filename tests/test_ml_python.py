@@ -263,3 +263,42 @@ def _walk(e):
     yield e
     for c in e.children:
         yield from _walk(c)
+
+
+def test_window_in_pandas_matches_oracle():
+    from spark_rapids_tpu.execs.python_exec import WindowInPandasNode
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    def running_share(g: pd.DataFrame):
+        v = pd.to_numeric(g["b"], errors="coerce").fillna(0.0)
+        total = float(v.sum()) or 1.0
+        return (v.cumsum() / total).tolist()
+
+    from spark_rapids_tpu.expressions import arithmetic as ar
+    from spark_rapids_tpu.expressions.base import Alias, Literal
+
+    base = scan(300)
+    proj = pn.ProjectNode(
+        [Alias(ar.Remainder(BoundReference(0, dt.INT64),
+                            Literal(7, dt.INT64)), "a"),
+         Alias(BoundReference(1, dt.FLOAT64), "b")], base)
+    plan = WindowInPandasNode([0], [SortKeySpec.spark_default(1)],
+                              running_share, "share", dt.FLOAT64, proj)
+    conf = RapidsConf({"rapids.tpu.sql.exec.WindowInPandasNode": True})
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, conf)
+    assert type(exec_).__name__ == "WindowInPandasExec"
+    assert_frames_equal(cpu_df, collect(exec_), approx_float=1e-9)
+
+
+def test_window_in_pandas_disabled_by_default():
+    from spark_rapids_tpu.execs.python_exec import WindowInPandasNode
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    plan = WindowInPandasNode([0], [SortKeySpec.spark_default(1)],
+                              lambda g: [0.0] * len(g), "z", dt.FLOAT64,
+                              scan(40))
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert type(exec_).__name__ == "CpuFallbackExec"
+    cpu_df = execute_cpu(plan).to_pandas()
+    assert_frames_equal(cpu_df, collect(exec_), approx_float=1e-9)

@@ -97,3 +97,61 @@ def test_row_base_advances_across_batches():
     df = collect(apply_overrides(plan, conf))
     np.testing.assert_array_equal(df["mid"].astype(int),
                                   np.arange(5000))
+
+
+def test_input_file_name_and_block(tmp_path):
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expressions.base import Alias, BoundReference
+    from spark_rapids_tpu.expressions.nondeterministic import (
+        InputFileBlockLength, InputFileBlockStart, InputFileName)
+    from spark_rapids_tpu.io import ParquetSource
+    from spark_rapids_tpu.plan import nodes as pn
+
+    from compare import assert_cpu_and_tpu_equal
+
+    d = tmp_path / "t"
+    os.makedirs(d)
+    for i in range(3):
+        pq.write_table(
+            pa.table({"x": np.arange(i * 10, i * 10 + 5,
+                                     dtype=np.int64)}),
+            str(d / f"f{i}.parquet"))
+    plan = pn.ProjectNode(
+        [Alias(BoundReference(0, dt.INT64), "x"),
+         Alias(InputFileName(), "fname"),
+         Alias(InputFileBlockStart(), "bstart"),
+         Alias(InputFileBlockLength(), "blen")],
+        pn.ScanNode(ParquetSource(str(d))))
+    exec_ = assert_cpu_and_tpu_equal(plan)
+    from spark_rapids_tpu.execs.base import collect
+    out = collect(exec_)
+    assert out["fname"].str.contains("f0.parquet").sum() == 5
+    assert set(out["bstart"]) == {0}
+    assert (out["blen"] > 0).all()
+
+
+def test_input_file_name_outside_scan_is_empty():
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expressions.base import Alias, BoundReference
+    from spark_rapids_tpu.expressions.nondeterministic import (
+        InputFileBlockStart, InputFileName)
+    from spark_rapids_tpu.plan import nodes as pn
+
+    from compare import assert_cpu_and_tpu_equal
+
+    plan = pn.ProjectNode(
+        [Alias(BoundReference(0, dt.INT64), "x"),
+         Alias(InputFileName(), "fname"),
+         Alias(InputFileBlockStart(), "bstart")],
+        pn.ScanNode(pn.InMemorySource(
+            {"x": np.arange(6, dtype=np.int64)})))
+    exec_ = assert_cpu_and_tpu_equal(plan)
+    from spark_rapids_tpu.execs.base import collect
+    out = collect(exec_)
+    assert set(out["fname"]) == {""}
+    assert set(out["bstart"]) == {-1}

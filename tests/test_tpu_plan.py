@@ -497,3 +497,54 @@ def test_nested_loop_join_string_payload():
     conf = RapidsConf(
         {"rapids.tpu.sql.exec.BroadcastNestedLoopJoinExec": True})
     assert_cpu_and_tpu_equal(plan, conf)
+
+
+# ---------------------------------------------------------------------------
+# Generate (explode/posexplode of created arrays — GpuGenerateExec.scala:
+# only Explode/PosExplode(CreateArray(exprs)) is supported in v0.3)
+
+
+@pytest.mark.parametrize("include_pos", [False, True])
+def test_generate_explode_created_array(include_pos):
+    data, validity = random_table(300, seed=21)
+    plan = pn.GenerateNode(
+        [ref(1, dt.FLOAT64),
+         Multiply(ref(1, dt.FLOAT64), Literal(2.0)),
+         Add(ref(1, dt.FLOAT64), Literal(1.0))],
+        scan(data, validity),
+        required_ordinals=[0, 2],
+        value_name="v", include_pos=include_pos)
+    assert_cpu_and_tpu_equal(plan, sort=False)
+
+
+def test_generate_lowered_to_expand():
+    from spark_rapids_tpu.execs.basic import ExpandExec
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    data, _ = random_table(20, with_nulls=False, seed=22)
+    plan = pn.GenerateNode([ref(0, dt.INT64), ref(2, dt.INT64)],
+                           scan(data), required_ordinals=[1],
+                           include_pos=True)
+    assert find(apply_overrides(plan, RapidsConf()), ExpandExec)
+    assert_cpu_and_tpu_equal(plan, sort=False)
+
+
+def test_api_explode():
+    import pandas as pd
+
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.column import col
+
+    s = Session()
+    try:
+        df = s.create_dataframe(pd.DataFrame(
+            {"k": [1, 2, 3], "a": [10.0, 20.0, 30.0],
+             "b": [0.5, 1.5, 2.5]}))
+        out = df.explode(col("a"), col("b"), value_name="x",
+                         pos=True).collect()
+        assert len(out) == 6
+        assert list(out["pos"]) == [0, 1, 0, 1, 0, 1]
+        assert list(out["x"]) == [10.0, 0.5, 20.0, 1.5, 30.0, 2.5]
+    finally:
+        s.stop()
