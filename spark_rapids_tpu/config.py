@@ -209,8 +209,25 @@ MEMORY_DEBUG = conf("rapids.tpu.memory.debug").doc(
 ).boolean_conf.create_with_default(False)
 
 SHUFFLE_PARTITIONS = conf("rapids.tpu.sql.shuffle.partitions").doc(
-    "Default number of shuffle partitions."
-).int_conf.create_with_default(16)
+    "Number of shuffle partitions; 0 (the default) auto-sizes to "
+    "2 x attached device count. Spark's 200-partition default exists to "
+    "feed many cheap CPU tasks; here every partition costs device "
+    "dispatches (and, behind a remote attachment, ~100 ms round trips "
+    "each), so fewer, larger partitions win until data exceeds HBM."
+).int_conf.create_with_default(0)
+
+
+def resolve_shuffle_partitions(conf_obj) -> int:
+    """SHUFFLE_PARTITIONS with 0 = auto (2 x device count)."""
+    n = conf_obj.get(SHUFFLE_PARTITIONS)
+    if n and n > 0:
+        return n
+    try:
+        import jax
+
+        return max(2 * len(jax.devices()), 2)
+    except Exception:  # pragma: no cover - no backend at plan time
+        return 8
 
 SHUFFLE_COMPRESSION_CODEC = conf("rapids.tpu.shuffle.compression.codec").doc(
     "Compression for host-path shuffle payloads: none, lz4 (native C++ "

@@ -204,11 +204,26 @@ def _project(node: pn.ProjectNode) -> CpuFrame:
 
 def _filter(node: pn.FilterNode) -> CpuFrame:
     child = execute_cpu(node.children[0])
-    ctx = CpuEvalContext(child.cols, child.num_rows)
+    ctx = CpuEvalContext(child.cols, child.num_rows,
+                         origins=child.origins)
     cond = eval_expr(node.condition, ctx)
     keep = cond.data.astype(bool) & cond.valid_mask()
     idx = np.nonzero(keep)[0]
-    return child.take(idx)
+    out = child.take(idx)
+    if child.origins is not None:
+        # compact the origin runs through the same selection (a filter
+        # keeps file provenance, matching the device path): map kept row
+        # indices to run ids vectorized, then re-run-length encode
+        bounds = np.cumsum([c for _, c in child.origins])
+        run_of = np.searchsorted(bounds, idx, side="right")
+        runs = []
+        for r in run_of:
+            if runs and runs[-1][1] == r:
+                runs[-1][0] += 1
+            else:
+                runs.append([1, r])
+        out.origins = [(child.origins[r][0], c) for c, r in runs]
+    return out
 
 
 def _limit(node: pn.LimitNode) -> CpuFrame:
@@ -239,7 +254,8 @@ def _union(node: pn.UnionNode) -> CpuFrame:
 
 def _expand(node: pn.ExpandNode) -> CpuFrame:
     child = execute_cpu(node.children[0])
-    ctx = CpuEvalContext(child.cols, child.num_rows)
+    ctx = CpuEvalContext(child.cols, child.num_rows,
+                         origins=child.origins)
     per_proj = [[eval_expr(e, ctx) for e in p] for p in node.projections]
     schema = node.output_schema()
     nproj = len(per_proj)
@@ -798,8 +814,10 @@ def _write_files(node) -> CpuFrame:
 def _register_io_nodes():
     from spark_rapids_tpu.execs.cache import CacheNode
     from spark_rapids_tpu.execs.python_exec import (
+        AggregateInPandasNode, ArrowEvalPythonNode,
         CoGroupedMapInPandasNode, GroupedMapInPandasNode,
         MapInPandasNode, WindowInPandasNode,
+        execute_agg_in_pandas_cpu, execute_arrow_eval_python_cpu,
         execute_cogrouped_map_cpu, execute_grouped_map_cpu,
         execute_map_in_pandas_cpu, execute_window_in_pandas_cpu)
     from spark_rapids_tpu.io.write import WriteFilesNode
@@ -809,6 +827,8 @@ def _register_io_nodes():
     _NODES[GroupedMapInPandasNode] = execute_grouped_map_cpu
     _NODES[CoGroupedMapInPandasNode] = execute_cogrouped_map_cpu
     _NODES[WindowInPandasNode] = execute_window_in_pandas_cpu
+    _NODES[ArrowEvalPythonNode] = execute_arrow_eval_python_cpu
+    _NODES[AggregateInPandasNode] = execute_agg_in_pandas_cpu
     _NODES[CacheNode] = _passthrough  # the oracle recomputes
 
 

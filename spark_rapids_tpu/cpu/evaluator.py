@@ -447,18 +447,30 @@ def _logarithm(e, ctx):
 
 def _java_regex_replacement(m, repl: str) -> str:
     """Expand a replacement string with JAVA Matcher.replaceAll semantics
-    ($N = group reference, backslash escapes the next char) — Python's
-    re.sub uses \\N instead and would raise on Java-style escapes."""
+    ($N = group reference taking the LONGEST valid group number,
+    backslash escapes the next char, trailing lone backslash throws) —
+    Python's re.sub uses \\N instead and would raise on Java escapes."""
     out = []
     i = 0
+    n_groups = m.re.groups
     while i < len(repl):
         ch = repl[i]
-        if ch == "\\" and i + 1 < len(repl):
+        if ch == "\\":
+            if i + 1 >= len(repl):
+                raise ValueError(
+                    "regexp_replace: trailing backslash in replacement")
             out.append(repl[i + 1])
             i += 2
         elif ch == "$" and i + 1 < len(repl) and repl[i + 1].isdigit():
-            out.append(m.group(int(repl[i + 1])) or "")
-            i += 2
+            # greedy: extend the group number while it stays valid
+            g = int(repl[i + 1])
+            j = i + 2
+            while j < len(repl) and repl[j].isdigit() and \
+                    g * 10 + int(repl[j]) <= n_groups:
+                g = g * 10 + int(repl[j])
+                j += 1
+            out.append(m.group(g) or "")
+            i = j
         else:
             out.append(ch)
             i += 1

@@ -120,6 +120,9 @@ class FilterExec(TpuExec):
                 ti = TaskInfo.make(partition, row_base)
                 with TraceRange("FilterExec"):
                     out = self.filter(b, task_info=ti)
+                # a filter keeps file provenance (Spark's
+                # input_file_name still works below a filter)
+                out.origin = b.origin
                 row_base += b.realized_num_rows()
                 yield out
         return timed(self, it())

@@ -320,19 +320,37 @@ class WindowInPandasNode(PlanNode):
                 f"part={self.partition_ordinals}]")
 
 
+def _sort_group_by_specs(g, child_schema: Schema, order_specs):
+    """Stable sort honoring per-key nulls_first/ascending (pandas
+    na_position is global, so null ranks become explicit key columns)."""
+    if not order_specs:
+        return g
+    work = g.copy()
+    sort_cols = []
+    ascending = []
+    for i, s in enumerate(order_specs):
+        name = child_schema.names[s.ordinal]
+        rank_col = f"__nullrank_{i}"
+        isna = work[name].isna()
+        # ascending rank: NULLS FIRST -> null rank 0; LAST -> null rank 1
+        work[rank_col] = (~isna).astype(int) if s.nulls_first \
+            else isna.astype(int)
+        sort_cols += [rank_col, name]
+        ascending += [True, s.ascending]
+    out = work.sort_values(sort_cols, ascending=ascending, kind="stable",
+                           na_position="last")
+    return out[g.columns]
+
+
 def _apply_window_in_pandas(pdf, node: "WindowInPandasNode",
                             child_schema: Schema):
     """Shared TPU/CPU body: group -> sort -> fn -> align back by index."""
     import pandas as pd
 
     key_names = [child_schema.names[o] for o in node.partition_ordinals]
-    order_cols = [child_schema.names[s.ordinal] for s in node.order_specs]
-    ascending = [s.ascending for s in node.order_specs]
     out = pd.Series([None] * len(pdf), index=pdf.index, dtype=object)
     for _, g in pdf.groupby(key_names, dropna=False, sort=False):
-        if order_cols:
-            g = g.sort_values(order_cols, ascending=ascending,
-                              kind="stable")
+        g = _sort_group_by_specs(g, child_schema, node.order_specs)
         vals = node.fn(g.reset_index(drop=True))
         vals = list(vals)
         if len(vals) != len(g):
@@ -390,6 +408,167 @@ def execute_window_in_pandas_cpu(node: WindowInPandasNode):
     child = execute_cpu(node.children[0])
     child_schema = node.children[0].output_schema()
     out = _apply_window_in_pandas(child.to_pandas(), node, child_schema)
+    return _cpu_frame_from_pandas(out, node.output_schema())
+
+
+class ArrowEvalPythonNode(PlanNode):
+    """Scalar pandas-UDF projection (GpuArrowEvalPythonExec,
+    GpuArrowEvalPythonExec.scala:494): each udf is
+    (fn, input_ordinals, out_name, out_dtype) where ``fn`` maps pandas
+    Series positionally to a Series of results, evaluated per batch and
+    APPENDED to the child columns (Spark's EvalPython output shape)."""
+
+    def __init__(self, udfs, child: PlanNode):
+        super().__init__([child])
+        assert udfs
+        self.udfs = list(udfs)
+
+    def output_schema(self) -> Schema:
+        s = self.children[0].output_schema()
+        names = list(s.names) + [u[2] for u in self.udfs]
+        types = list(s.types) + [u[3] for u in self.udfs]
+        return Schema(names, types)
+
+    def describe(self) -> str:
+        return f"ArrowEvalPython[{len(self.udfs)} udfs]"
+
+
+def _apply_scalar_udfs(pdf, node: "ArrowEvalPythonNode",
+                       child_schema: Schema):
+    import pandas as pd
+
+    out = pdf.copy()
+    for fn, ordinals, name, _dtype in node.udfs:
+        args = [pdf[child_schema.names[o]] for o in ordinals]
+        r = pd.Series(fn(*args))
+        if len(r) != len(pdf):
+            raise ValueError(
+                f"pandas UDF {name!r} returned {len(r)} rows for a "
+                f"{len(pdf)}-row batch")
+        out[name] = r.reset_index(drop=True).set_axis(out.index)
+    return out
+
+
+class ArrowEvalPythonExec(TpuExec):
+    def __init__(self, node: ArrowEvalPythonNode, child: TpuExec):
+        super().__init__([child], node.output_schema())
+        self.node = node
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        child_schema = self.node.children[0].output_schema()
+        out_schema = self.schema
+
+        def it():
+            for b in self.children[0].execute(partition):
+                if b.realized_num_rows() == 0:
+                    continue
+                PythonWorkerSemaphore.acquire()
+                try:
+                    with TraceRange("ArrowEvalPythonExec.python"):
+                        pdf = b.to_pandas(child_schema)
+                        out = _apply_scalar_udfs(pdf, self.node,
+                                                 child_schema)
+                        data, validity = _pandas_to_host(out, out_schema)
+                finally:
+                    PythonWorkerSemaphore.release()
+                yield interop.host_to_batch(data, validity, out_schema)
+            yield ColumnarBatch.empty(out_schema)
+        return timed(self, it())
+
+
+def execute_arrow_eval_python_cpu(node: ArrowEvalPythonNode):
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+
+    child = execute_cpu(node.children[0])
+    child_schema = node.children[0].output_schema()
+    out = _apply_scalar_udfs(child.to_pandas(), node, child_schema)
+    return _cpu_frame_from_pandas(out, node.output_schema())
+
+
+class AggregateInPandasNode(PlanNode):
+    """groupBy().agg(pandas_udf) analogue (GpuAggregateInPandasExec,
+    §2.12): ``fn`` maps one group's pandas DataFrame to a single row —
+    a tuple/list of the non-key output columns; output = keys + those."""
+
+    def __init__(self, grouping_ordinals, fn: Callable, schema: Schema,
+                 child: PlanNode):
+        super().__init__([child])
+        assert grouping_ordinals, "aggregate-in-pandas requires keys"
+        self.grouping_ordinals = list(grouping_ordinals)
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return (f"AggregateInPandas["
+                f"{getattr(self.fn, '__name__', 'fn')}]")
+
+
+def _apply_agg_in_pandas(pdf, node: "AggregateInPandasNode",
+                         child_schema: Schema):
+    import pandas as pd
+
+    key_names = [child_schema.names[o] for o in node.grouping_ordinals]
+    out_schema = node.output_schema()
+    rows = []
+    for key, g in pdf.groupby(key_names, dropna=False, sort=False):
+        key = key if isinstance(key, tuple) else (key,)
+        vals = node.fn(g.reset_index(drop=True))
+        if not isinstance(vals, (tuple, list)):
+            vals = (vals,)
+        rows.append(tuple(key) + tuple(vals))
+    if rows:
+        return pd.DataFrame(rows, columns=list(out_schema.names))
+    return pd.DataFrame({n: pd.Series([], dtype=object)
+                         for n in out_schema.names})
+
+
+class AggregateInPandasExec(TpuExec):
+    """Child hash-co-partitioned on the keys by the planner."""
+
+    def __init__(self, node: AggregateInPandasNode, child: TpuExec):
+        super().__init__([child], node.output_schema())
+        self.node = node
+
+    @property
+    def children_coalesce_goal(self):
+        from spark_rapids_tpu.execs.batching import RequireSingleBatch
+
+        return [RequireSingleBatch]
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+
+        child_schema = self.node.children[0].output_schema()
+        out_schema = self.schema
+
+        def it():
+            b = drain_to_single_batch(
+                self.children[0].execute(partition), child_schema)
+            if b.realized_num_rows() == 0:
+                yield ColumnarBatch.empty(out_schema)
+                return
+            PythonWorkerSemaphore.acquire()
+            try:
+                with TraceRange("AggregateInPandasExec.python"):
+                    out = _apply_agg_in_pandas(
+                        b.to_pandas(child_schema), self.node,
+                        child_schema)
+                    data, validity = _pandas_to_host(out, out_schema)
+            finally:
+                PythonWorkerSemaphore.release()
+            yield interop.host_to_batch(data, validity, out_schema)
+        return timed(self, it())
+
+
+def execute_agg_in_pandas_cpu(node: AggregateInPandasNode):
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+
+    child = execute_cpu(node.children[0])
+    child_schema = node.children[0].output_schema()
+    out = _apply_agg_in_pandas(child.to_pandas(), node, child_schema)
     return _cpu_frame_from_pandas(out, node.output_schema())
 
 

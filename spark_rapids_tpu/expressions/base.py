@@ -100,15 +100,26 @@ class Expression:
                 continue
             v = vars(self)[k]
             private = k.startswith("_")
-            if isinstance(v, (int, float, str, bool, bytes,
-                              type(None))):
+            if isinstance(v, (float, np.floating)):
+                # repr keys: NaN would never dict-hit (NaN != NaN, so
+                # every lookup misses and the cache only grows) and
+                # -0.0 == 0.0 would alias two semantically different
+                # constants onto one kernel
+                params.append((k, ("#f", repr(float(v)))))
+            elif isinstance(v, (int, str, bool, bytes, type(None))):
                 params.append((k, v))
-            elif isinstance(v, (np.integer, np.floating, np.bool_)):
+            elif isinstance(v, (np.integer, np.bool_)):
                 params.append((k, ("#np", v.item())))
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, (int, str, bool, type(None)))
+                    for x in v):
+                params.append((k, ("#seq",) + tuple(v)))
             elif isinstance(v, (list, tuple)) and all(
                     isinstance(x, (int, float, str, bool, type(None)))
                     for x in v):
-                params.append((k, ("#seq",) + tuple(v)))
+                params.append((k, ("#seq",) + tuple(
+                    ("#f", repr(float(x)))
+                    if isinstance(x, float) else x for x in v)))
             elif isinstance(v, (list, tuple)) and all(
                     isinstance(x, Expression) for x in v):
                 subs = tuple(x.tree_key() for x in v)

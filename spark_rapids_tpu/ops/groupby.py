@@ -107,13 +107,22 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
         live = live & live_mask
         num_rows = jnp.sum(live).astype(jnp.int32)
 
-    # 1. sort by keys (ascending, nulls first — any consistent order works)
+    # 1. sort by keys (ascending, nulls first — any consistent order
+    # works); every column's data+validity rides THROUGH the variadic
+    # sort as payload lanes, so there are no per-column permutation
+    # gathers afterwards
     specs = [SortKeySpec(o, True, True) for o in key_ordinals]
-    order = sortkeys.lexsort_indices(list(cols), list(dtypes), specs,
-                                     prefix_rows, live_mask=live_mask)
-    sorted_cols = [(jnp.take(d, order),
-                    None if v is None else jnp.take(v, order))
-                   for d, v in cols]
+    payloads = [d for d, _ in cols] + \
+               [v for _, v in cols if v is not None]
+    sorted_flat = sortkeys.sort_with_payloads(
+        list(cols), list(dtypes), specs, prefix_rows, payloads,
+        live_mask=live_mask)
+    sorted_d = sorted_flat[:len(cols)]
+    rest = sorted_flat[len(cols):]
+    sorted_cols = []
+    for i, (_, v) in enumerate(cols):
+        sv = rest.pop(0) if v is not None else None
+        sorted_cols.append((sorted_d[i], sv))
     # live rows are a prefix after the pad-last sort
     live_sorted = jnp.arange(capacity, dtype=jnp.int32) < num_rows
 

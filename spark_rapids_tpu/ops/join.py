@@ -112,7 +112,7 @@ def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
                                    key_types, out_cap)
 
     return _emit(stream, sorted_build, stream_types, build_types,
-                 pi, bi, match, total, join_type, out_cap)
+                 pi, bi, match, counts, total, join_type, out_cap)
 
 
 @jax.jit
@@ -166,13 +166,14 @@ def _expand_verify(lo, hi, counts, total, key_pairs, key_types,
 
 
 def _emit(stream: ColumnarBatch, build: ColumnarBatch,
-          stream_types, build_types, pi, bi, match, total, join_type: str,
-          out_cap: int) -> Tuple[ColumnarBatch, List[dt.DType]]:
+          stream_types, build_types, pi, bi, match, counts, total,
+          join_type: str, out_cap: int
+          ) -> Tuple[ColumnarBatch, List[dt.DType]]:
     s_rows = stream.num_rows_device()
     s_cap = stream.capacity
 
     if join_type in ("leftsemi", "leftanti"):
-        matched = _probe_matched(pi, match, s_cap)
+        matched = _probe_matched(counts, match, s_cap)
         live_s = jnp.arange(s_cap, dtype=jnp.int32) < s_rows
         keep = (matched if join_type == "leftsemi" else ~matched) & live_s
         from spark_rapids_tpu.ops.filter import compact_batch
@@ -198,7 +199,7 @@ def _emit(stream: ColumnarBatch, build: ColumnarBatch,
         return inner, out_types
 
     # left/full: append unmatched stream rows with null build side
-    matched = _probe_matched(pi, match, s_cap)
+    matched = _probe_matched(counts, match, s_cap)
     live_s = jnp.arange(s_cap, dtype=jnp.int32) < s_rows
     from spark_rapids_tpu.ops.concat import concat_batches
     from spark_rapids_tpu.ops.filter import compact_batch
@@ -225,9 +226,25 @@ def _emit(stream: ColumnarBatch, build: ColumnarBatch,
 
 
 @partial(jax.jit, static_argnames=("s_cap",))
-def _probe_matched(pi, match, s_cap: int):
-    return jax.ops.segment_max(match.astype(jnp.int32),
-                               pi, num_segments=s_cap) > 0
+def _probe_matched(counts, match, s_cap: int):
+    """Per-probe-row "has a match": pairs are laid out in ascending probe
+    order, so each row's pairs are the contiguous run
+    [offsets[r]-counts[r], offsets[r]) — a cumsum difference answers
+    "any match in the run" with gathers only (the segment_max scatter
+    this replaces measured ~30x a cumsum on TPU)."""
+    offsets = jnp.cumsum(counts)  # inclusive
+    cs = jnp.cumsum(match.astype(jnp.int64))
+    pair_cap = cs.shape[0]
+    hi_idx = jnp.clip(offsets - 1, 0, pair_cap - 1).astype(jnp.int32)
+    excl = offsets - counts
+    lo_gate = excl > 0
+    lo_idx = jnp.clip(excl - 1, 0, pair_cap - 1).astype(jnp.int32)
+    hi = jnp.take(cs, hi_idx)
+    lo = jnp.where(lo_gate, jnp.take(cs, lo_idx), 0)
+    got = jnp.where(counts > 0, hi - lo, 0)
+    out = got > 0
+    # counts has stream-capacity length == s_cap
+    return out[:s_cap]
 
 
 @partial(jax.jit, static_argnames=("b_cap",))

@@ -320,15 +320,26 @@ def _split_by_pid(batch: ColumnarBatch, pid: jax.Array, num_partitions: int
 
 @partial(jax.jit, static_argnames=("num_partitions",))
 def _partition_kernel(datas, validities, pid, num_rows, num_partitions: int):
+    """Contiguous-split by partition id: ONE variadic sort carries every
+    column (no per-column permutation gathers), per-partition counts come
+    from binary searches over the sorted ids (no segment_sum scatter)."""
     capacity = pid.shape[0]
     live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
     # padding rows to a virtual partition that sorts last
     pid_l = jnp.where(live, pid, num_partitions)
-    order = jnp.argsort(pid_l, stable=True)
-    counts = jax.ops.segment_sum(live.astype(jnp.int64), pid_l,
-                                 num_segments=num_partitions + 1)[:-1]
-    out_d = [jnp.take(d, order) for d in datas]
-    out_v = [None if v is None else jnp.take(v, order) for v in validities]
+    payloads = tuple(datas) + tuple(v for v in validities if v is not None)
+    sorted_all = jax.lax.sort((pid_l,) + payloads, num_keys=1,
+                              is_stable=True)
+    pid_s = sorted_all[0]
+    bounds = jnp.searchsorted(
+        pid_s, jnp.arange(num_partitions + 1, dtype=pid_s.dtype))
+    counts = (bounds[1:] - bounds[:-1]).astype(jnp.int64)
+    rest = list(sorted_all[1:])
+    out_d = rest[:len(datas)]
+    vrest = rest[len(datas):]
+    out_v = []
+    for v in validities:
+        out_v.append(vrest.pop(0) if v is not None else None)
     return out_d, out_v, counts
 
 

@@ -23,18 +23,10 @@ from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 @partial(jax.jit, static_argnames=("dtypes", "specs"))
 def _sort_carry(datas, validities, dtypes, specs, num_rows):
     """One stable variadic sort: [pad_rank, spec keys..., payloads...]."""
-    capacity = datas[0].shape[0]
-    pad_rank = (jnp.arange(capacity, dtype=jnp.int32) >=
-                num_rows).astype(jnp.int32)
-    keys: List[jax.Array] = [pad_rank]
-    for spec in specs:
-        keys.extend(sortkeys.sort_key_arrays(
-            datas[spec.ordinal], validities[spec.ordinal],
-            dtypes[spec.ordinal], spec))
     payloads = list(datas) + [v for v in validities if v is not None]
-    out = jax.lax.sort(tuple(keys) + tuple(payloads),
-                       num_keys=len(keys), is_stable=True)
-    out = out[len(keys):]
+    out = sortkeys.sort_with_payloads(
+        list(zip(datas, validities)), list(dtypes), list(specs),
+        num_rows, payloads)
     out_d = list(out[:len(datas)])
     rest = list(out[len(datas):])
     out_v = []

@@ -88,29 +88,58 @@ def sort_key_arrays(data: jax.Array, validity: Optional[jax.Array],
     return keys
 
 
+def order_key_arrays(cols: List[Tuple[jax.Array, Optional[jax.Array]]],
+                     dtypes: List[dt.DType],
+                     specs: List[SortKeySpec],
+                     num_rows: jax.Array,
+                     live_mask: Optional[jax.Array] = None
+                     ) -> List[jax.Array]:
+    """Sort keys MOST significant first: pad rank (padding and
+    masked-out rows last — ``live_mask`` is the fused-filter liveness),
+    then each spec's key arrays. One builder feeds both the
+    permutation-producing lexsort and the payload-carrying variadic
+    sort so pad/liveness semantics can't drift apart."""
+    capacity = cols[0][0].shape[0]
+    pad_rank = (jnp.arange(capacity, dtype=jnp.int32) >=
+                num_rows).astype(jnp.int32)
+    if live_mask is not None:
+        pad_rank = jnp.maximum(pad_rank, (~live_mask).astype(jnp.int32))
+    keys: List[jax.Array] = [pad_rank]
+    for spec in specs:
+        data, validity = cols[spec.ordinal]
+        keys.extend(sort_key_arrays(data, validity,
+                                    dtypes[spec.ordinal], spec))
+    return keys
+
+
 def lexsort_indices(cols: List[Tuple[jax.Array, Optional[jax.Array]]],
                     dtypes: List[dt.DType],
                     specs: List[SortKeySpec],
                     num_rows: jax.Array,
                     live_mask: Optional[jax.Array] = None) -> jax.Array:
     """Stable permutation ordering live rows by ``specs``; padding rows
-    sort last. ``cols`` indexed by spec.ordinal. ``live_mask`` narrows
-    liveness beyond the row-count prefix — a fused filter: masked-out
-    rows ride to the back of the same sort pass, so no separate
-    compaction (argsort + per-column gathers) is needed upstream."""
-    capacity = cols[0][0].shape[0]
-    pad_rank = (jnp.arange(capacity, dtype=jnp.int32) >=
-                num_rows).astype(jnp.int32)
-    if live_mask is not None:
-        pad_rank = jnp.maximum(pad_rank, (~live_mask).astype(jnp.int32))
-    # jnp.lexsort: LAST key is primary.
-    arrays: List[jax.Array] = []
-    for spec in reversed(specs):
-        data, validity = cols[spec.ordinal]
-        ks = sort_key_arrays(data, validity, dtypes[spec.ordinal], spec)
-        arrays.extend(reversed(ks))
-    arrays.append(pad_rank)
-    return jnp.lexsort(arrays)
+    sort last. ``cols`` indexed by spec.ordinal."""
+    keys = order_key_arrays(cols, dtypes, specs, num_rows, live_mask)
+    # jnp.lexsort: LAST key is primary
+    return jnp.lexsort(list(reversed(keys)))
+
+
+def sort_with_payloads(cols: List[Tuple[jax.Array, Optional[jax.Array]]],
+                       dtypes: List[dt.DType],
+                       specs: List[SortKeySpec],
+                       num_rows: jax.Array,
+                       payloads: List[jax.Array],
+                       live_mask: Optional[jax.Array] = None
+                       ) -> List[jax.Array]:
+    """ONE stable variadic sort ordering live rows by ``specs`` (padding
+    and masked-out rows last) that carries ``payloads`` through the sort
+    network — replacing argsort + per-column permutation gathers
+    (~75-150 ms/column at 4M rows on a v5e). Returns the sorted payloads
+    in order."""
+    keys = order_key_arrays(cols, dtypes, specs, num_rows, live_mask)
+    out = jax.lax.sort(tuple(keys) + tuple(payloads),
+                       num_keys=len(keys), is_stable=True)
+    return list(out[len(keys):])
 
 
 def equality_parts(data: jax.Array, validity: Optional[jax.Array],

@@ -59,39 +59,56 @@ def _exchange(datas: List[jax.Array], valids: List[jax.Array],
               dest: jax.Array, live: jax.Array, n_dev: int, axis: str
               ) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
     """All-to-all rows by per-row destination device. Returns compacted
-    (datas, valids, total_rows) with capacity n_dev * local_capacity."""
+    (datas, valids, total_rows) with capacity n_dev * local_capacity.
+
+    Scatter-free: ONE variadic sort carries every column to
+    destination-sorted order (padding to a sentinel bucket), per-dest
+    counts come from binary searches over the sorted destinations, and
+    the (n_dev, cap) send blocks are a plain gather from the contiguous
+    runs — TPU scatters measured ~30x a cumsum, so none appear here."""
     cap = dest.shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
     dest_l = jnp.where(live, dest, n_dev)  # padding → sentinel bucket
-    order = jnp.argsort(dest_l, stable=True)
-    dest_s = jnp.take(dest_l, order)
-    counts = jax.ops.segment_sum(live.astype(jnp.int32), dest_l,
-                                 num_segments=n_dev + 1)[:n_dev]
-    start = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                             jnp.cumsum(counts)[:-1].astype(jnp.int32),
-                             jnp.zeros(1, jnp.int32)])  # [n_dev] for sentinel
-    rank = iota - jnp.take(start, dest_s)
-    slot = jnp.where(dest_s < n_dev, dest_s * cap + rank, n_dev * cap)
-    slot = jnp.clip(slot, 0, n_dev * cap)
+    payloads = tuple(datas) + tuple(valids)
+    sorted_all = jax.lax.sort((dest_l,) + payloads, num_keys=1,
+                              is_stable=True)
+    dest_s = sorted_all[0]
+    datas_s = sorted_all[1:1 + len(datas)]
+    valids_s = sorted_all[1 + len(datas):]
+
+    bounds = jnp.searchsorted(
+        dest_s, jnp.arange(n_dev + 1, dtype=dest_s.dtype)).astype(jnp.int32)
+    counts = bounds[1:] - bounds[:-1]
+    start = bounds[:-1]
+
+    k = jnp.arange(n_dev * cap, dtype=jnp.int32)
+    d_of = k // cap
+    j_of = k % cap
+    src = jnp.clip(jnp.take(start, d_of) + j_of, 0, cap - 1)
+    sel = j_of < jnp.take(counts, d_of)
 
     def to_blocks(x):
-        buf = jnp.zeros(n_dev * cap + 1, dtype=x.dtype)
-        buf = buf.at[slot].set(jnp.take(x, order))
-        return buf[:n_dev * cap].reshape(n_dev, cap)
+        vals = jnp.where(sel, jnp.take(x, src), jnp.zeros((), x.dtype))
+        return vals.reshape(n_dev, cap)
 
-    recv_d = [jax.lax.all_to_all(to_blocks(d), axis, 0, 0) for d in datas]
-    recv_v = [jax.lax.all_to_all(to_blocks(v), axis, 0, 0) for v in valids]
+    recv_d = [jax.lax.all_to_all(to_blocks(d), axis, 0, 0)
+              for d in datas_s]
+    recv_v = [jax.lax.all_to_all(to_blocks(v), axis, 0, 0)
+              for v in valids_s]
     counts_recv = jax.lax.all_to_all(
         counts.reshape(n_dev, 1), axis, 0, 0).reshape(n_dev)
 
+    # compact received rows to a live prefix: one more variadic sort
+    # keyed on liveness, carrying every received column
     rcap = n_dev * cap
     riota = jnp.arange(rcap, dtype=jnp.int32)
     live_r = (riota % cap) < jnp.take(counts_recv, riota // cap)
-    order2 = jnp.argsort(~live_r, stable=True)  # live rows to the prefix
     total = jnp.sum(counts_recv).astype(jnp.int32)
-    out_d = [jnp.take(r.reshape(rcap), order2) for r in recv_d]
-    out_v = [jnp.take(r.reshape(rcap), order2) & (riota < total)
-             for r in recv_v]
+    flat = tuple(r.reshape(rcap) for r in recv_d) + \
+        tuple(r.reshape(rcap) for r in recv_v)
+    packed = jax.lax.sort(((~live_r).astype(jnp.int32),) + flat,
+                          num_keys=1, is_stable=True)[1:]
+    out_d = list(packed[:len(recv_d)])
+    out_v = [v & (riota < total) for v in packed[len(recv_d):]]
     return out_d, out_v, total
 
 

@@ -427,7 +427,7 @@ class _AggregateRule(NodeRule):
         if nkeys:
             ex = _adaptive_read(exchange.ShuffleExchangeExec(
                 ("hash", list(range(nkeys))),
-                min(meta.conf.get(cfg.SHUFFLE_PARTITIONS),
+                min(cfg.resolve_shuffle_partitions(meta.conf),
                     max(child.num_partitions, 1)),
                 partial), meta.conf)
         else:
@@ -447,7 +447,7 @@ class _SortRule(NodeRule):
         node: pn.SortNode = meta.node
         child = children[0]
         if node.global_sort and child.num_partitions > 1:
-            parts = min(meta.conf.get(cfg.SHUFFLE_PARTITIONS),
+            parts = min(cfg.resolve_shuffle_partitions(meta.conf),
                         child.num_partitions)
             if parts > 1:
                 # distributed global sort: range-partition on sampled
@@ -593,7 +593,7 @@ class _JoinRule(NodeRule):
             return joins.CartesianProductExec(left, right, out_schema,
                                               cond, meta.conf)
         if multi:
-            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            parts = cfg.resolve_shuffle_partitions(meta.conf)
             lex = exchange.ShuffleExchangeExec(("hash", lk), parts, left)
             rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right)
             if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1:
@@ -724,7 +724,7 @@ class _WindowRule(NodeRule):
         child = children[0]
         if child.num_partitions > 1:
             if node.partition_ordinals:
-                parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+                parts = cfg.resolve_shuffle_partitions(meta.conf)
                 child = _adaptive_read(exchange.ShuffleExchangeExec(
                     ("hash", node.partition_ordinals), parts, child),
                     meta.conf)
@@ -776,7 +776,7 @@ class _CoGroupedMapRule(NodeRule):
         node = meta.node
         left, right = children
         if left.num_partitions > 1 or right.num_partitions > 1:
-            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            parts = cfg.resolve_shuffle_partitions(meta.conf)
             left = exchange.ShuffleExchangeExec(
                 ("hash", list(node.left_ordinals)), parts, left)
             right = exchange.ShuffleExchangeExec(
@@ -792,11 +792,32 @@ class _GroupedMapRule(NodeRule):
         node = meta.node
         child = children[0]
         if child.num_partitions > 1:
-            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            parts = cfg.resolve_shuffle_partitions(meta.conf)
             child = _adaptive_read(exchange.ShuffleExchangeExec(
                 ("hash", list(node.grouping_ordinals)), parts, child),
                 meta.conf)
         return GroupedMapInPandasExec(node, child)
+
+
+class _ArrowEvalPythonRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.execs.python_exec import ArrowEvalPythonExec
+
+        return ArrowEvalPythonExec(meta.node, children[0])
+
+
+class _AggInPandasRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.execs.python_exec import AggregateInPandasExec
+
+        node = meta.node
+        child = children[0]
+        if child.num_partitions > 1:
+            parts = cfg.resolve_shuffle_partitions(meta.conf)
+            child = _adaptive_read(exchange.ShuffleExchangeExec(
+                ("hash", list(node.grouping_ordinals)), parts, child),
+                meta.conf)
+        return AggregateInPandasExec(node, child)
 
 
 class _WindowInPandasRule(NodeRule):
@@ -806,7 +827,7 @@ class _WindowInPandasRule(NodeRule):
         node = meta.node
         child = children[0]
         if child.num_partitions > 1:
-            parts = meta.conf.get(cfg.SHUFFLE_PARTITIONS)
+            parts = cfg.resolve_shuffle_partitions(meta.conf)
             child = _adaptive_read(exchange.ShuffleExchangeExec(
                 ("hash", list(node.partition_ordinals)), parts, child),
                 meta.conf)
@@ -819,6 +840,7 @@ def _register_io_rules():
     from spark_rapids_tpu.io.write import WriteFilesNode
 
     from spark_rapids_tpu.execs.python_exec import (
+        AggregateInPandasNode, ArrowEvalPythonNode,
         CoGroupedMapInPandasNode, GroupedMapInPandasNode,
         WindowInPandasNode)
 
@@ -827,6 +849,8 @@ def _register_io_rules():
     _NODE_RULES[GroupedMapInPandasNode] = _GroupedMapRule()
     _NODE_RULES[CoGroupedMapInPandasNode] = _CoGroupedMapRule()
     _NODE_RULES[WindowInPandasNode] = _WindowInPandasRule()
+    _NODE_RULES[ArrowEvalPythonNode] = _ArrowEvalPythonRule()
+    _NODE_RULES[AggregateInPandasNode] = _AggInPandasRule()
     _NODE_RULES[CacheNode] = _CacheRule()
     # mirror the reference: pandas execs are off by default because data
     # leaves the accelerator for the Python worker
@@ -848,6 +872,17 @@ def _register_io_rules():
         "exec", "WindowInPandasNode",
         "Run a pandas window UDF over co-partitioned window partitions "
         "(GpuWindowInPandasExec analogue)", default_enabled=False)
+    # scalar pandas UDFs stay enabled by default — the reference likewise
+    # keeps GpuArrowEvalPythonExec on (it holds data on the accelerator
+    # between the scan and the Python worker, GpuOverrides.scala:1888)
+    cfg.register_op_flag(
+        "exec", "ArrowEvalPythonNode",
+        "Evaluate scalar pandas UDFs per batch and append their columns "
+        "(GpuArrowEvalPythonExec analogue)")
+    cfg.register_op_flag(
+        "exec", "AggregateInPandasNode",
+        "Run pandas aggregation UDFs over co-partitioned groups "
+        "(GpuAggregateInPandasExec analogue)", default_enabled=False)
 
 
 _NODE_RULES: Dict[Type[pn.PlanNode], NodeRule] = {

@@ -270,25 +270,27 @@ def sym_if(cond_v, then_v, else_v):
 
 def compile_udf(fn: Callable, args: Sequence[Expression]
                 ) -> Optional[Expression]:
-    """Trace ``fn`` over symbolic arguments; returns the compiled
-    expression or None when the function escapes the traceable subset
-    (the reference's silent-fallback contract)."""
+    """Compile ``fn`` over symbolic arguments; returns the compiled
+    expression or None when the function escapes the compilable subset
+    (the reference's silent-fallback contract). Two attempts:
+    1. direct symbolic trace (fast; inlines helper calls naturally),
+    2. bytecode symbolic execution (udf/bytecode.py) — folds REAL
+       ``if``/``and``/``or`` control flow into If expressions, the
+       capability the reference gets from its JVM CFG walk."""
     sym_args = [SymbolicValue(a) for a in args]
     try:
         out = fn(*sym_args)
-    except UdfCompileError:
-        return None
-    except TypeError:
-        # e.g. math.sqrt(SymbolicValue) — the C function rejects proxies.
-        # Retry with a shim namespace is not possible generically; treat
-        # as untraceable.
-        return None
-    except Exception:
-        return None
-    try:
         return _lift(out)
     except UdfCompileError:
+        pass
+    except TypeError:
+        # e.g. math.sqrt(SymbolicValue) — the C function rejects proxies
+        pass
+    except Exception:
         return None
+    from spark_rapids_tpu.udf.bytecode import compile_udf_bytecode
+
+    return compile_udf_bytecode(fn, args)
 
 
 # ---------------------------------------------------------------------------

@@ -302,3 +302,81 @@ def test_window_in_pandas_disabled_by_default():
     assert type(exec_).__name__ == "CpuFallbackExec"
     cpu_df = execute_cpu(plan).to_pandas()
     assert_frames_equal(cpu_df, collect(exec_), approx_float=1e-9)
+
+
+def test_arrow_eval_python_scalar_udfs():
+    from spark_rapids_tpu.execs.python_exec import ArrowEvalPythonNode
+
+    def plus(a, b):
+        return a.astype(float) + b.astype(float)
+
+    def neg(a):
+        return -pd.to_numeric(a, errors="coerce")
+
+    base = scan(200)
+    plan = ArrowEvalPythonNode(
+        [(plus, [0, 0], "twice", dt.FLOAT64),
+         (neg, [1], "nb", dt.FLOAT64)], base)
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert type(exec_).__name__ == "ArrowEvalPythonExec"
+    assert_frames_equal(cpu_df, collect(exec_), approx_float=1e-9)
+
+
+def test_aggregate_in_pandas_matches_oracle():
+    from spark_rapids_tpu.execs.python_exec import AggregateInPandasNode
+    from spark_rapids_tpu.expressions import arithmetic as ar
+    from spark_rapids_tpu.expressions.base import Alias, Literal
+
+    def spread(g: pd.DataFrame):
+        v = pd.to_numeric(g["b"], errors="coerce")
+        return (float(v.max() - v.min()), int(len(g)))
+
+    base = scan(300)
+    proj = pn.ProjectNode(
+        [Alias(ar.Remainder(BoundReference(0, dt.INT64),
+                            Literal(6, dt.INT64)), "a"),
+         Alias(BoundReference(1, dt.FLOAT64), "b")], base)
+    schema = Schema(["a", "spread", "n"],
+                    [dt.INT64, dt.FLOAT64, dt.INT64])
+    plan = AggregateInPandasNode([0], spread, schema, proj)
+    conf = RapidsConf(
+        {"rapids.tpu.sql.exec.AggregateInPandasNode": True})
+    cpu_df = execute_cpu(plan).to_pandas()
+    exec_ = apply_overrides(plan, conf)
+    assert type(exec_).__name__ == "AggregateInPandasExec"
+    assert_frames_equal(cpu_df, collect(exec_), approx_float=1e-9)
+
+    # disabled by default -> CPU fallback
+    assert type(apply_overrides(plan, RapidsConf())).__name__ == \
+        "CpuFallbackExec"
+
+
+def test_window_in_pandas_nulls_first_ordering():
+    """Direct expectation (not oracle-vs-oracle): ASC default = NULLS
+    FIRST, so the window fn must see null order-key rows first."""
+    from spark_rapids_tpu.execs.python_exec import WindowInPandasNode
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    seen = []
+
+    def record(g: pd.DataFrame):
+        seen.append([None if pd.isna(v) else float(v)
+                     for v in g["b"]])
+        return list(range(len(g)))
+
+    plan = WindowInPandasNode(
+        [0], [SortKeySpec.spark_default(1)], record, "pos", dt.INT64,
+        pn.ScanNode(pn.InMemorySource(
+            {"a": np.array([1, 1, 1, 1], dtype=np.int64),
+             "b": np.array([5.0, 2.0, 9.0, 3.0])},
+            validity={"b": np.array([True, False, True, True])})))
+    execute_cpu(plan)
+    assert seen == [[None, 3.0, 5.0, 9.0]]
+
+    seen.clear()
+    plan2 = WindowInPandasNode(
+        [0], [SortKeySpec(1, ascending=False, nulls_first=False)],
+        record, "pos", dt.INT64, plan.children[0])
+    execute_cpu(plan2)
+    assert seen == [[9.0, 5.0, 3.0, None]]

@@ -131,8 +131,44 @@ def test_input_file_name_and_block(tmp_path):
     from spark_rapids_tpu.execs.base import collect
     out = collect(exec_)
     assert out["fname"].str.contains("f0.parquet").sum() == 5
-    assert set(out["bstart"]) == {0}
+    # parquet block offsets come from the row-group byte extent
+    assert (out["bstart"] >= 0).all()
     assert (out["blen"] > 0).all()
+
+
+def test_input_file_block_per_row_group(tmp_path):
+    """Multiple row groups in ONE file -> distinct block starts per
+    split (Spark InputFileBlockStart semantics)."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.expressions.base import Alias, BoundReference
+    from spark_rapids_tpu.expressions.nondeterministic import (
+        InputFileBlockStart, InputFileName)
+    from spark_rapids_tpu.io import ParquetSource
+    from spark_rapids_tpu.plan import nodes as pn
+
+    d = tmp_path / "rg"
+    os.makedirs(d)
+    pq.write_table(pa.table({"x": np.arange(4000, dtype=np.int64)}),
+                   str(d / "one.parquet"), row_group_size=1000)
+    conf = RapidsConf({"rapids.tpu.sql.reader.batchSizeBytes": 4000})
+    src_ = ParquetSource(str(d), conf=conf)
+    plan = pn.ProjectNode(
+        [Alias(BoundReference(0, dt.INT64), "x"),
+         Alias(InputFileName(), "fname"),
+         Alias(InputFileBlockStart(), "bstart")],
+        pn.ScanNode(src_))
+    from compare import assert_cpu_and_tpu_equal
+    exec_ = assert_cpu_and_tpu_equal(plan)
+    from spark_rapids_tpu.execs.base import collect
+    out = collect(exec_)
+    if src_.num_splits() > 1:
+        assert out["bstart"].nunique() > 1
 
 
 def test_input_file_name_outside_scan_is_empty():
