@@ -92,6 +92,8 @@ def gen_orders(sf: float, seed: int = 12) -> pa.Table:
         "o_totalprice": np.round(rng.random(n) * 400_000 + 800, 2),
         "o_orderdate": _dates(rng, n),
         "o_orderpriority": PRIORITIES[rng.integers(0, 5, n)],
+        "o_orderstatus": np.array(["F", "O", "P"], dtype=object)[
+            rng.integers(0, 3, n)],
         "o_shippriority": np.zeros(n, dtype=np.int32),
     })
 
@@ -157,6 +159,22 @@ def gen_part(sf: float, seed: int = 17) -> pa.Table:
     })
 
 
+def gen_partsupp(sf: float, seed: int = 18) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n_part = max(int(200_000 * sf), 10)
+    n_supp = max(int(10_000 * sf), 5)
+    # 4 suppliers per part (TPC-H shape)
+    partkey = np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4)
+    suppkey = rng.integers(1, n_supp + 1, n_part * 4).astype(np.int64)
+    return pa.table({
+        "ps_partkey": partkey,
+        "ps_suppkey": suppkey,
+        "ps_availqty": rng.integers(1, 10_000, n_part * 4
+                                    ).astype(np.int32),
+        "ps_supplycost": np.round(rng.random(n_part * 4) * 1_000 + 1, 2),
+    })
+
+
 GENERATORS = {
     "lineitem": gen_lineitem,
     "orders": gen_orders,
@@ -165,6 +183,7 @@ GENERATORS = {
     "nation": gen_nation,
     "region": gen_region,
     "part": gen_part,
+    "partsupp": gen_partsupp,
 }
 
 

@@ -12,6 +12,7 @@ from spark_rapids_tpu.expressions import arithmetic as ar
 from spark_rapids_tpu.expressions import predicates as P
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Literal)
+from spark_rapids_tpu.expressions.cast import Cast
 from spark_rapids_tpu.io import ParquetSource
 from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 from spark_rapids_tpu.plan import nodes as pn
@@ -386,6 +387,517 @@ def q19(data_dir: str) -> pn.PlanNode:
         [], [pn.AggCall(A.Sum(ref(0, dt.FLOAT64)), "revenue")], proj)
 
 
-QUERIES = {"tpch_q1": q1, "tpch_q3": q3, "tpch_q4": q4, "tpch_q5": q5,
-           "tpch_q6": q6, "tpch_q10": q10, "tpch_q12": q12,
-           "tpch_q14": q14, "tpch_q18": q18, "tpch_q19": q19}
+def _lit_one(plan: pn.PlanNode, names) -> pn.PlanNode:
+    """Append a constant key column — the decorrelation trick that turns
+    a scalar subquery into an equi-join on lit(1)."""
+    schema_types = plan.output_schema().types
+    exprs = [ref(i, t) for i, t in enumerate(schema_types)]
+    exprs.append(Literal(1, dt.INT64))
+    return pn.ProjectNode(exprs, plan, names + ["one"])
+
+
+def q7(data_dir: str) -> pn.PlanNode:
+    """Volume shipping: 2-nation flow pairs with a year extract and an
+    OR condition over the joined nations."""
+    from spark_rapids_tpu.expressions.datetime import Year
+
+    supplier = _scan(data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    n1 = _scan(data_dir, "nation", ["n_nationkey", "n_name"])
+    # supp x n1 -> [s_suppkey, s_nationkey, n1_key 2, supp_nation 3]
+    sn = pn.JoinNode("inner", supplier, n1, [1], [0])
+    customer = _scan(data_dir, "customer", ["c_custkey", "c_nationkey"])
+    n2 = _scan(data_dir, "nation", ["n_nationkey", "n_name"])
+    # cust x n2 -> [c_custkey, c_nationkey, n2_key 2, cust_nation 3]
+    cn = pn.JoinNode("inner", customer, n2, [1], [0])
+    li = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(4, dt.DATE),
+                                   Literal(_date_days("1995-01-01"),
+                                           dt.DATE)),
+              P.LessThanOrEqual(ref(4, dt.DATE),
+                                Literal(_date_days("1996-12-31"),
+                                        dt.DATE))),
+        _scan(data_dir, "lineitem",
+              ["l_orderkey", "l_suppkey", "l_extendedprice",
+               "l_discount", "l_shipdate"]))
+    orders = _scan(data_dir, "orders", ["o_orderkey", "o_custkey"])
+    # li x orders -> [l..0-4, o_orderkey 5, o_custkey 6]
+    lo = pn.JoinNode("inner", li, orders, [0], [0])
+    # x sn on l_suppkey -> + [s_suppkey 7, s_nationkey 8, nk 9,
+    #                         supp_nation 10]
+    los = pn.JoinNode("inner", lo, sn, [1], [0])
+    # x cn on o_custkey -> + [c_custkey 11, c_nationkey 12, nk 13,
+    #                         cust_nation 14]
+    losc = pn.JoinNode("inner", los, cn, [6], [0])
+    flow = P.Or(
+        P.And(P.EqualTo(ref(10, dt.STRING), Literal("FRANCE")),
+              P.EqualTo(ref(14, dt.STRING), Literal("GERMANY"))),
+        P.And(P.EqualTo(ref(10, dt.STRING), Literal("GERMANY")),
+              P.EqualTo(ref(14, dt.STRING), Literal("FRANCE"))))
+    filt = pn.FilterNode(flow, losc)
+    vol = ar.Multiply(ref(2, dt.FLOAT64),
+                      ar.Subtract(Literal(1.0), ref(3, dt.FLOAT64)))
+    proj = pn.ProjectNode(
+        [Alias(ref(10, dt.STRING), "supp_nation"),
+         Alias(ref(14, dt.STRING), "cust_nation"),
+         Alias(Year(ref(4, dt.DATE)), "l_year"),
+         Alias(vol, "volume")], filt)
+    agg = pn.AggregateNode(
+        [ref(0, dt.STRING), ref(1, dt.STRING), ref(2, dt.INT32)],
+        [pn.AggCall(A.Sum(ref(3, dt.FLOAT64)), "revenue")],
+        proj, grouping_names=["supp_nation", "cust_nation", "l_year"])
+    return pn.SortNode([SortKeySpec.spark_default(0),
+                        SortKeySpec.spark_default(1),
+                        SortKeySpec.spark_default(2)], agg)
+
+
+def q9(data_dir: str) -> pn.PlanNode:
+    """Product type profit: 5-way join, profit expression, groupby
+    nation x year."""
+    from spark_rapids_tpu.expressions.datetime import Year
+    from spark_rapids_tpu.expressions.strings import Contains
+
+    part = pn.FilterNode(
+        Contains(ref(1, dt.STRING), "BRASS"),
+        _scan(data_dir, "part", ["p_partkey", "p_type"]))
+    li = _scan(data_dir, "lineitem",
+               ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
+                "l_extendedprice", "l_discount"])
+    # li x part -> + [p_partkey 6, p_type 7]
+    lp = pn.JoinNode("inner", li, part, [1], [0])
+    supplier = _scan(data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    # + [s_suppkey 8, s_nationkey 9]
+    lps = pn.JoinNode("inner", lp, supplier, [2], [0])
+    partsupp = _scan(data_dir, "partsupp",
+                     ["ps_partkey", "ps_suppkey", "ps_supplycost"])
+    # join on (partkey, suppkey) -> + [ps_partkey 10, ps_suppkey 11,
+    #                                  ps_supplycost 12]
+    lpsp = pn.JoinNode("inner", lps, partsupp, [1, 2], [0, 1])
+    orders = _scan(data_dir, "orders", ["o_orderkey", "o_orderdate"])
+    # + [o_orderkey 13, o_orderdate 14]
+    lpspo = pn.JoinNode("inner", lpsp, orders, [0], [0])
+    nation = _scan(data_dir, "nation", ["n_nationkey", "n_name"])
+    # + [n_nationkey 15, n_name 16]
+    full = pn.JoinNode("inner", lpspo, nation, [9], [0])
+    profit = ar.Subtract(
+        ar.Multiply(ref(4, dt.FLOAT64),
+                    ar.Subtract(Literal(1.0), ref(5, dt.FLOAT64))),
+        ar.Multiply(ref(12, dt.FLOAT64), ref(3, dt.FLOAT64)))
+    proj = pn.ProjectNode(
+        [Alias(ref(16, dt.STRING), "nation"),
+         Alias(Year(ref(14, dt.DATE)), "o_year"),
+         Alias(profit, "amount")], full)
+    agg = pn.AggregateNode(
+        [ref(0, dt.STRING), ref(1, dt.INT32)],
+        [pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "sum_profit")],
+        proj, grouping_names=["nation", "o_year"])
+    return pn.SortNode([SortKeySpec.spark_default(0),
+                        SortKeySpec.spark_default(1, ascending=False)],
+                       agg)
+
+
+def q13(data_dir: str) -> pn.PlanNode:
+    """Customer distribution: LEFT join + two-level aggregation
+    (count-of-counts)."""
+    customer = _scan(data_dir, "customer", ["c_custkey"])
+    orders = pn.FilterNode(
+        P.Not(P.In(ref(2, dt.STRING),
+                   [Literal("1-URGENT")])),
+        _scan(data_dir, "orders",
+              ["o_orderkey", "o_custkey", "o_orderpriority"]))
+    # LEFT join keeps order-less customers -> [c_custkey,
+    #  o_orderkey 1, o_custkey 2, o_orderpriority 3]
+    co = pn.JoinNode("left", customer, orders, [0], [1])
+    counts = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Count(ref(1, dt.INT64)), "c_count")],
+        co, grouping_names=["c_custkey"])
+    dist = pn.AggregateNode(
+        [ref(1, dt.INT64)], [pn.AggCall(A.Count(), "custdist")],
+        counts, grouping_names=["c_count"])
+    return pn.SortNode([SortKeySpec.spark_default(1, ascending=False),
+                        SortKeySpec.spark_default(0, ascending=False)],
+                       dist)
+
+
+def q11(data_dir: str) -> pn.PlanNode:
+    """Important stock: partsupp value per part vs a global-threshold
+    scalar subquery, decorrelated into an equi-join on lit(1)."""
+    partsupp = _scan(data_dir, "partsupp",
+                     ["ps_partkey", "ps_suppkey", "ps_availqty",
+                      "ps_supplycost"])
+    supplier = _scan(data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    nation = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("GERMANY")),
+        _scan(data_dir, "nation", ["n_nationkey", "n_name"]))
+    sn = pn.JoinNode("inner", supplier, nation, [1], [0])
+    # ps x sn on suppkey -> value rows; [ps..0-3, s_suppkey 4,
+    #  s_nationkey 5, n_nationkey 6, n_name 7]
+    psn = pn.JoinNode("inner", partsupp, sn, [1], [0])
+    value = ar.Multiply(ref(3, dt.FLOAT64),
+                        Cast(ref(2, dt.INT32), dt.FLOAT64))
+    vals = pn.ProjectNode([Alias(ref(0, dt.INT64), "ps_partkey"),
+                           Alias(value, "value")], psn)
+    per_part = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "value")],
+        vals, grouping_names=["ps_partkey"])
+    total = pn.AggregateNode(
+        [], [pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "total")], vals)
+    thresh = pn.ProjectNode(
+        [Alias(ar.Multiply(ref(0, dt.FLOAT64), Literal(0.0001)),
+               "threshold"), Alias(Literal(1, dt.INT64), "one")], total)
+    keyed = _lit_one(per_part, ["ps_partkey", "value"])
+    # join per-part values against the single threshold row
+    j = pn.JoinNode("inner", keyed, thresh, [2], [1])
+    filt = pn.FilterNode(P.GreaterThan(ref(1, dt.FLOAT64),
+                                       ref(3, dt.FLOAT64)), j)
+    proj = pn.ProjectNode([Alias(ref(0, dt.INT64), "ps_partkey"),
+                           Alias(ref(1, dt.FLOAT64), "value")], filt)
+    return pn.SortNode([SortKeySpec.spark_default(1, ascending=False)],
+                       proj)
+
+
+def q16(data_dir: str) -> pn.PlanNode:
+    """Parts/supplier relationship: anti join + count distinct."""
+    from spark_rapids_tpu.expressions.strings import StartsWith
+
+    part = pn.FilterNode(
+        P.And(P.Not(P.EqualTo(ref(1, dt.STRING), Literal("Brand#45"))),
+              P.And(P.Not(StartsWith(ref(2, dt.STRING), "MEDIUM")),
+                    P.In(ref(3, dt.INT32),
+                         [Literal(k, dt.INT32)
+                          for k in (49, 14, 23, 45, 19, 3, 36, 9)]))),
+        _scan(data_dir, "part",
+              ["p_partkey", "p_brand", "p_type", "p_size"]))
+    supplier_bad = pn.FilterNode(
+        P.LessThan(ref(1, dt.FLOAT64), Literal(-500.0)),
+        _scan(data_dir, "supplier", ["s_suppkey", "s_acctbal"]))
+    partsupp = _scan(data_dir, "partsupp",
+                     ["ps_partkey", "ps_suppkey"])
+    # exclude "bad" suppliers (the NOT IN subquery)
+    ps_ok = pn.JoinNode("left_anti", partsupp, supplier_bad, [1], [0])
+    # x part -> + [p_partkey 2, p_brand 3, p_type 4, p_size 5]
+    pp = pn.JoinNode("inner", ps_ok, part, [0], [0])
+    agg = pn.AggregateNode(
+        [ref(3, dt.STRING), ref(4, dt.STRING), ref(5, dt.INT32)],
+        [pn.AggCall(A.Count(ref(1, dt.INT64), distinct=True),
+                    "supplier_cnt")],
+        pp, grouping_names=["p_brand", "p_type", "p_size"])
+    return pn.SortNode([SortKeySpec.spark_default(3, ascending=False),
+                        SortKeySpec.spark_default(0),
+                        SortKeySpec.spark_default(1),
+                        SortKeySpec.spark_default(2)], agg)
+
+
+def q17(data_dir: str) -> pn.PlanNode:
+    """Small-quantity-order revenue: per-part average joined back
+    (correlated scalar subquery, decorrelated)."""
+    from spark_rapids_tpu.expressions.strings import StartsWith
+
+    part = pn.FilterNode(
+        P.And(P.EqualTo(ref(1, dt.STRING), Literal("Brand#23")),
+              StartsWith(ref(2, dt.STRING), "MED")),
+        _scan(data_dir, "part", ["p_partkey", "p_brand", "p_container"]))
+    li = _scan(data_dir, "lineitem",
+               ["l_partkey", "l_quantity", "l_extendedprice"])
+    per_part_avg = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Average(ref(1, dt.FLOAT64)), "avg_qty")],
+        li, grouping_names=["l_partkey"])
+    # li x part -> [l..0-2, p_partkey 3, p_brand 4, p_container 5]
+    lp = pn.JoinNode("inner", li, part, [0], [0])
+    # + [l_partkey(avg) 6, avg_qty 7]
+    lpa = pn.JoinNode("inner", lp, per_part_avg, [0], [0])
+    filt = pn.FilterNode(
+        P.LessThan(ref(1, dt.FLOAT64),
+                   ar.Multiply(Literal(0.2), ref(7, dt.FLOAT64))), lpa)
+    agg = pn.AggregateNode(
+        [], [pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "sum_rev")], filt)
+    return pn.ProjectNode(
+        [Alias(ar.Divide(ref(0, dt.FLOAT64), Literal(7.0)),
+               "avg_yearly")], agg)
+
+
+def q22(data_dir: str) -> pn.PlanNode:
+    """Global sales opportunity: phone-prefix filter, above-average
+    balance (decorrelated), anti join against orders."""
+    from spark_rapids_tpu.expressions.strings import Substring
+
+    cust = _scan(data_dir, "customer",
+                 ["c_custkey", "c_acctbal", "c_phone"])
+    with_cc = pn.ProjectNode(
+        [ref(0, dt.INT64), ref(1, dt.FLOAT64),
+         Substring(ref(2, dt.STRING), 1, 2)],
+        cust, ["c_custkey", "c_acctbal", "cntrycode"])
+    sel = pn.FilterNode(
+        P.In(ref(2, dt.STRING),
+             [Literal(c) for c in ("13", "31", "23", "29", "30")]),
+        with_cc)
+    pos = pn.FilterNode(P.GreaterThan(ref(1, dt.FLOAT64),
+                                      Literal(0.0)), sel)
+    avg_bal = pn.AggregateNode(
+        [], [pn.AggCall(A.Average(ref(1, dt.FLOAT64)), "avg_bal")], pos)
+    avg_keyed = pn.ProjectNode(
+        [ref(0, dt.FLOAT64), Literal(1, dt.INT64)], avg_bal,
+        ["avg_bal", "one"])
+    sel_keyed = _lit_one(sel, ["c_custkey", "c_acctbal", "cntrycode"])
+    # join the single avg row in, keep above-average customers
+    j = pn.JoinNode("inner", sel_keyed, avg_keyed, [3], [1])
+    rich = pn.FilterNode(P.GreaterThan(ref(1, dt.FLOAT64),
+                                       ref(4, dt.FLOAT64)), j)
+    orders = _scan(data_dir, "orders", ["o_custkey"])
+    # customers with no orders
+    no_orders = pn.JoinNode("left_anti", rich, orders, [0], [0])
+    agg = pn.AggregateNode(
+        [ref(2, dt.STRING)],
+        [pn.AggCall(A.Count(), "numcust"),
+         pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "totacctbal")],
+        no_orders, grouping_names=["cntrycode"])
+    return pn.SortNode([SortKeySpec.spark_default(0)], agg)
+
+
+def q2(data_dir: str) -> pn.PlanNode:
+    """Minimum cost supplier: per-part min supplycost within a region,
+    joined back (correlated MIN subquery, decorrelated)."""
+    from spark_rapids_tpu.expressions.strings import EndsWith
+
+    part = pn.FilterNode(
+        P.And(P.EqualTo(ref(2, dt.INT32), Literal(15, dt.INT32)),
+              EndsWith(ref(1, dt.STRING), "BRASS")),
+        _scan(data_dir, "part", ["p_partkey", "p_type", "p_size"]))
+    region = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("EUROPE")),
+        _scan(data_dir, "region", ["r_regionkey", "r_name"]))
+    nation = _scan(data_dir, "nation",
+                   ["n_nationkey", "n_name", "n_regionkey"])
+    nr = pn.JoinNode("inner", nation, region, [2], [0])
+    supplier = _scan(data_dir, "supplier",
+                     ["s_suppkey", "s_nationkey", "s_acctbal"])
+    # [s..0-2, n_nationkey 3, n_name 4, n_regionkey 5, r_regionkey 6,
+    #  r_name 7]
+    snr = pn.JoinNode("inner", supplier, nr, [1], [0])
+    partsupp = _scan(data_dir, "partsupp",
+                     ["ps_partkey", "ps_suppkey", "ps_supplycost"])
+    # ps x snr -> [ps..0-2, snr 3..10]
+    ps_eu = pn.JoinNode("inner", partsupp, snr, [1], [0])
+    # region-scoped min cost per part
+    min_cost = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Min(ref(2, dt.FLOAT64)), "min_cost")],
+        ps_eu, grouping_names=["ps_partkey"])
+    # x part -> keep BRASS size-15 parts; [ps_eu 0..10, p_partkey 11,
+    #  p_type 12, p_size 13]
+    psp = pn.JoinNode("inner", ps_eu, part, [0], [0])
+    # x min_cost on partkey -> + [mc_partkey 14, min_cost 15]
+    pspm = pn.JoinNode("inner", psp, min_cost, [0], [0])
+    best = pn.FilterNode(
+        P.EqualTo(ref(2, dt.FLOAT64), ref(15, dt.FLOAT64)), pspm)
+    proj = pn.ProjectNode(
+        [Alias(ref(5, dt.FLOAT64), "s_acctbal"),
+         Alias(ref(7, dt.STRING), "n_name"),
+         Alias(ref(0, dt.INT64), "p_partkey"),
+         Alias(ref(12, dt.STRING), "p_type"),
+         Alias(ref(2, dt.FLOAT64), "ps_supplycost")], best)
+    sort = pn.SortNode([SortKeySpec.spark_default(0, ascending=False),
+                        SortKeySpec.spark_default(1),
+                        SortKeySpec.spark_default(2)], proj)
+    return pn.LimitNode(100, sort)
+
+
+def q8(data_dir: str) -> pn.PlanNode:
+    """National market share: nation's share of regional revenue by
+    year (CASE-conditional ratio of aggregates)."""
+    from spark_rapids_tpu.expressions.conditional import If
+    from spark_rapids_tpu.expressions.datetime import Year
+
+    part = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING),
+                  Literal("ECONOMY ANODIZED STEEL")),
+        _scan(data_dir, "part", ["p_partkey", "p_type"]))
+    li = _scan(data_dir, "lineitem",
+               ["l_orderkey", "l_partkey", "l_suppkey",
+                "l_extendedprice", "l_discount"])
+    # li x part -> + [p_partkey 5, p_type 6]
+    lp = pn.JoinNode("inner", li, part, [1], [0])
+    orders = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(2, dt.DATE),
+                                   Literal(_date_days("1995-01-01"),
+                                           dt.DATE)),
+              P.LessThanOrEqual(ref(2, dt.DATE),
+                                Literal(_date_days("1996-12-31"),
+                                        dt.DATE))),
+        _scan(data_dir, "orders",
+              ["o_orderkey", "o_custkey", "o_orderdate"]))
+    # + [o_orderkey 7, o_custkey 8, o_orderdate 9]
+    lpo = pn.JoinNode("inner", lp, orders, [0], [0])
+    customer = _scan(data_dir, "customer", ["c_custkey", "c_nationkey"])
+    # + [c_custkey 10, c_nationkey 11]
+    lpoc = pn.JoinNode("inner", lpo, customer, [8], [0])
+    region = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("AMERICA")),
+        _scan(data_dir, "region", ["r_regionkey", "r_name"]))
+    n1 = _scan(data_dir, "nation", ["n_nationkey", "n_regionkey"])
+    n1r = pn.JoinNode("inner", n1, region, [1], [0])
+    # customer nation must be in AMERICA; + [n_nationkey 12,
+    #  n_regionkey 13, r_regionkey 14, r_name 15]
+    lpocn = pn.JoinNode("inner", lpoc, n1r, [11], [0])
+    n2 = _scan(data_dir, "nation", ["n_nationkey", "n_name"])
+    supplier = _scan(data_dir, "supplier", ["s_suppkey", "s_nationkey"])
+    # supplier -> its nation name
+    sn = pn.JoinNode("inner", supplier, n2, [1], [0])
+    # + [s_suppkey 16, s_nationkey 17, n_nationkey 18, supp_nation 19]
+    full = pn.JoinNode("inner", lpocn, sn, [2], [0])
+    vol = ar.Multiply(ref(3, dt.FLOAT64),
+                      ar.Subtract(Literal(1.0), ref(4, dt.FLOAT64)))
+    brazil_vol = If(P.EqualTo(ref(19, dt.STRING), Literal("BRAZIL")),
+                    vol, Literal(0.0))
+    proj = pn.ProjectNode(
+        [Alias(Year(ref(9, dt.DATE)), "o_year"),
+         Alias(vol, "volume"), Alias(brazil_vol, "brazil_volume")],
+        full)
+    agg = pn.AggregateNode(
+        [ref(0, dt.INT32)],
+        [pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "brazil"),
+         pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "total")],
+        proj, grouping_names=["o_year"])
+    share = pn.ProjectNode(
+        [Alias(ref(0, dt.INT32), "o_year"),
+         Alias(ar.Divide(ref(1, dt.FLOAT64), ref(2, dt.FLOAT64)),
+               "mkt_share")], agg)
+    return pn.SortNode([SortKeySpec.spark_default(0)], share)
+
+
+def q15(data_dir: str) -> pn.PlanNode:
+    """Top supplier: per-supplier revenue equal to the global maximum
+    (the revenue view + scalar MAX subquery, decorrelated)."""
+    li = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(3, dt.DATE),
+                                   Literal(_date_days("1996-01-01"),
+                                           dt.DATE)),
+              P.LessThan(ref(3, dt.DATE),
+                         Literal(_date_days("1996-04-01"), dt.DATE))),
+        _scan(data_dir, "lineitem",
+              ["l_suppkey", "l_extendedprice", "l_discount",
+               "l_shipdate"]))
+    rev = ar.Multiply(ref(1, dt.FLOAT64),
+                      ar.Subtract(Literal(1.0), ref(2, dt.FLOAT64)))
+    proj = pn.ProjectNode([Alias(ref(0, dt.INT64), "supplier_no"),
+                           Alias(rev, "rev")], li)
+    revenue = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "total_revenue")],
+        proj, grouping_names=["supplier_no"])
+    max_rev = pn.AggregateNode(
+        [], [pn.AggCall(A.Max(ref(1, dt.FLOAT64)), "max_rev")], revenue)
+    max_keyed = pn.ProjectNode(
+        [ref(0, dt.FLOAT64), Literal(1, dt.INT64)], max_rev,
+        ["max_rev", "one"])
+    rev_keyed = _lit_one(revenue, ["supplier_no", "total_revenue"])
+    j = pn.JoinNode("inner", rev_keyed, max_keyed, [2], [1])
+    top = pn.FilterNode(P.EqualTo(ref(1, dt.FLOAT64),
+                                  ref(3, dt.FLOAT64)), j)
+    supplier = _scan(data_dir, "supplier", ["s_suppkey", "s_acctbal"])
+    # + [s_suppkey 5, s_acctbal 6]
+    js = pn.JoinNode("inner", top, supplier, [0], [0])
+    proj2 = pn.ProjectNode(
+        [Alias(ref(5, dt.INT64), "s_suppkey"),
+         Alias(ref(1, dt.FLOAT64), "total_revenue")], js)
+    return pn.SortNode([SortKeySpec.spark_default(0)], proj2)
+
+
+def q20(data_dir: str) -> pn.PlanNode:
+    """Potential part promotion: suppliers whose stock exceeds half a
+    year's shipments of forest parts (nested IN subqueries as
+    semi-joins + a decorrelated per-(part,supp) quantity sum)."""
+    from spark_rapids_tpu.expressions.strings import StartsWith
+
+    part = pn.FilterNode(
+        StartsWith(ref(1, dt.STRING), "STANDARD"),
+        _scan(data_dir, "part", ["p_partkey", "p_type"]))
+    li = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(3, dt.DATE),
+                                   Literal(_date_days("1994-01-01"),
+                                           dt.DATE)),
+              P.LessThan(ref(3, dt.DATE),
+                         Literal(_date_days("1995-01-01"), dt.DATE))),
+        _scan(data_dir, "lineitem",
+              ["l_partkey", "l_suppkey", "l_quantity", "l_shipdate"]))
+    shipped = pn.AggregateNode(
+        [ref(0, dt.INT64), ref(1, dt.INT64)],
+        [pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "qty")],
+        li, grouping_names=["l_partkey", "l_suppkey"])
+    partsupp = _scan(data_dir, "partsupp",
+                     ["ps_partkey", "ps_suppkey", "ps_availqty"])
+    # only forest parts
+    ps_f = pn.JoinNode("left_semi", partsupp, part, [0], [0])
+    # x shipped quantities on (part, supp) -> + [l_partkey 3,
+    #  l_suppkey 4, qty 5]
+    psq = pn.JoinNode("inner", ps_f, shipped, [0, 1], [0, 1])
+    over = pn.FilterNode(
+        P.GreaterThan(Cast(ref(2, dt.INT32), dt.FLOAT64),
+                      ar.Multiply(Literal(0.5), ref(5, dt.FLOAT64))),
+        psq)
+    supplier = _scan(data_dir, "supplier",
+                     ["s_suppkey", "s_nationkey"])
+    nation = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("CANADA")),
+        _scan(data_dir, "nation", ["n_nationkey", "n_name"]))
+    sn = pn.JoinNode("inner", supplier, nation, [1], [0])
+    good = pn.JoinNode("left_semi", sn, over, [0], [1])
+    proj = pn.ProjectNode([Alias(ref(0, dt.INT64), "s_suppkey")], good)
+    return pn.SortNode([SortKeySpec.spark_default(0)], proj)
+
+
+def q21(data_dir: str) -> pn.PlanNode:
+    """Suppliers who kept orders waiting: the EXISTS/NOT-EXISTS pair
+    decorrelated through per-order distinct-supplier counts (orders
+    with multiple suppliers where ONLY this supplier delivered late)."""
+    li = _scan(data_dir, "lineitem",
+               ["l_orderkey", "l_suppkey", "l_commitdate",
+                "l_receiptdate"])
+    late = pn.FilterNode(P.GreaterThan(ref(3, dt.DATE),
+                                       ref(2, dt.DATE)), li)
+    # per order: how many distinct suppliers at all / delivered late
+    supp_all = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Count(ref(1, dt.INT64), distinct=True), "n")],
+        li, grouping_names=["l_orderkey"])
+    supp_late = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Count(ref(1, dt.INT64), distinct=True), "n")],
+        late, grouping_names=["l_orderkey"])
+    multi = pn.FilterNode(P.GreaterThan(ref(1, dt.INT64),
+                                        Literal(1, dt.INT64)), supp_all)
+    solo_late = pn.FilterNode(P.EqualTo(ref(1, dt.INT64),
+                                        Literal(1, dt.INT64)), supp_late)
+    orders = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("F")),
+        _scan(data_dir, "orders", ["o_orderkey", "o_orderstatus"]))
+    # failing orders with >1 supplier where exactly one was late
+    o1 = pn.JoinNode("left_semi", orders, multi, [0], [0])
+    o2 = pn.JoinNode("left_semi", o1, solo_late, [0], [0])
+    # the waiting supplier = the late lineitem's supplier on those orders
+    late_on = pn.JoinNode("left_semi", late, o2, [0], [0])
+    supplier = _scan(data_dir, "supplier",
+                     ["s_suppkey", "s_nationkey"])
+    nation = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("SAUDI ARABIA")),
+        _scan(data_dir, "nation", ["n_nationkey", "n_name"]))
+    sn = pn.JoinNode("inner", supplier, nation, [1], [0])
+    # [late 0-3, s_suppkey 4, s_nationkey 5, n_nationkey 6, n_name 7]
+    ls = pn.JoinNode("inner", late_on, sn, [1], [0])
+    agg = pn.AggregateNode(
+        [ref(4, dt.INT64)], [pn.AggCall(A.Count(), "numwait")],
+        ls, grouping_names=["s_suppkey"])
+    sort = pn.SortNode([SortKeySpec.spark_default(1, ascending=False),
+                        SortKeySpec.spark_default(0)], agg)
+    return pn.LimitNode(100, sort)
+
+
+QUERIES = {"tpch_q1": q1, "tpch_q2": q2, "tpch_q3": q3, "tpch_q4": q4,
+           "tpch_q5": q5, "tpch_q6": q6, "tpch_q7": q7, "tpch_q8": q8,
+           "tpch_q9": q9, "tpch_q10": q10, "tpch_q11": q11,
+           "tpch_q12": q12, "tpch_q13": q13, "tpch_q14": q14,
+           "tpch_q15": q15, "tpch_q16": q16, "tpch_q17": q17,
+           "tpch_q18": q18, "tpch_q19": q19, "tpch_q20": q20,
+           "tpch_q21": q21, "tpch_q22": q22}

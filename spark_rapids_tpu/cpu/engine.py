@@ -216,13 +216,14 @@ def _filter(node: pn.FilterNode) -> CpuFrame:
         # indices to run ids vectorized, then re-run-length encode
         bounds = np.cumsum([c for _, c in child.origins])
         run_of = np.searchsorted(bounds, idx, side="right")
-        runs = []
-        for r in run_of:
-            if runs and runs[-1][1] == r:
-                runs[-1][0] += 1
-            else:
-                runs.append([1, r])
-        out.origins = [(child.origins[r][0], c) for c, r in runs]
+        if len(run_of) == 0:
+            out.origins = []
+        else:
+            # vectorized run-length re-encode of the kept rows' run ids
+            starts = np.r_[0, np.flatnonzero(np.diff(run_of)) + 1]
+            counts = np.diff(np.r_[starts, len(run_of)])
+            out.origins = [(child.origins[int(run_of[s])][0], int(c))
+                           for s, c in zip(starts, counts)]
     return out
 
 

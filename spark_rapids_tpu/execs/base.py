@@ -19,10 +19,16 @@ class Metrics:
     """num_output_rows / num_output_batches / op_time_ns per exec
     (GpuMetricNames, GpuExec.scala:27-55). ``op_time_ns`` is self time —
     like the reference's totalTime it excludes time spent pulling child
-    batches; ``pipeline_time_ns`` is inclusive."""
+    batches; ``pipeline_time_ns`` is inclusive.
+
+    Row counts are recorded as DEVICE scalars and realized lazily when
+    read: metric accounting must not inject a host sync per exec per
+    batch into the pipeline (each sync is a full round trip behind a
+    remote device attachment)."""
 
     def __init__(self):
-        self.num_output_rows = 0
+        self._pending_rows = []
+        self._rows = 0
         self.num_output_batches = 0
         self.op_time_ns = 0
         self.pipeline_time_ns = 0
@@ -30,9 +36,23 @@ class Metrics:
     def record(self, batch: ColumnarBatch, elapsed_ns: int = 0,
                child_ns: int = 0):
         self.num_output_batches += 1
-        self.num_output_rows += batch.realized_num_rows()
+        n = batch.num_rows
+        if isinstance(n, int):
+            self._rows += n
+        else:
+            self._pending_rows.append(n)
         self.pipeline_time_ns += elapsed_ns
         self.op_time_ns += max(elapsed_ns - child_ns, 0)
+
+    @property
+    def num_output_rows(self) -> int:
+        if self._pending_rows:
+            import jax
+
+            self._rows += int(sum(
+                int(jax.device_get(n)) for n in self._pending_rows))
+            self._pending_rows.clear()
+        return self._rows
 
 
 class TpuExec:

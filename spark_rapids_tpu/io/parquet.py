@@ -130,6 +130,7 @@ class ParquetSource(FileSourceBase):
             for rg in desc.row_groups:
                 rgm = meta.row_group(rg)
                 offs = []
+                comp = 0
                 for c in range(rgm.num_columns):
                     cm = rgm.column(c)
                     # file_offset is 0 from many writers; the first page
@@ -138,8 +139,12 @@ class ParquetSource(FileSourceBase):
                     if off is None or off <= 0:
                         off = cm.data_page_offset
                     offs.append(off)
+                    # on-disk (compressed) extent — Spark's block
+                    # semantics (the row-group meta only carries the
+                    # uncompressed total)
+                    comp += cm.total_compressed_size
                 starts.append(min(offs))
-                lengths += rgm.total_byte_size
+                lengths += comp
             return (desc.path, int(min(starts)), int(lengths))
         except Exception:  # pragma: no cover - odd footers
             return super().split_origin(split)

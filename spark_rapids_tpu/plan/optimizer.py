@@ -99,5 +99,50 @@ def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
     return node
 
 
+def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
+    """count/sum/avg(DISTINCT x) -> dedup-then-aggregate: an inner
+    zero-agg group-by over (keys..., x) removes duplicates, then the
+    outer aggregate runs the plain (non-distinct) function. This is the
+    planner-level role of the reference's distinct handling
+    (aggregate.scala:56-130); only the all-distinct-same-input shape
+    rewrites — mixed distinct + plain aggregates still fall back, as in
+    the reference's multi-distinct case."""
+    from spark_rapids_tpu.expressions import aggregates as aggfn
+
+    new_children = [rewrite_distinct_aggregates(c)
+                    for c in node.children]
+    node = node.with_children(new_children) if node.children else node
+
+    if not isinstance(node, pn.AggregateNode) or node.mode != "complete":
+        return node
+    if not node.aggs or not all(
+            getattr(a.fn, "distinct", False) for a in node.aggs):
+        return node
+    if not all(isinstance(a.fn, (aggfn.Count, aggfn.Sum,
+                                 aggfn.Average)) for a in node.aggs):
+        return node
+    inputs = [a.fn.children[0] if a.fn.children else None
+              for a in node.aggs]
+    if any(i is None for i in inputs):
+        return node
+    first_key = inputs[0].tree_key()
+    if first_key is None or any(i.tree_key() != first_key
+                                for i in inputs[1:]):
+        return node  # multi-distinct: fall back like the reference
+
+    nkeys = len(node.grouping)
+    inner = pn.AggregateNode(
+        list(node.grouping) + [inputs[0]], [], node.children[0],
+        grouping_names=list(node.grouping_names) + ["__distinct"])
+    x = BoundReference(nkeys, inputs[0].dtype)
+    outer_aggs = []
+    for a in node.aggs:
+        outer_aggs.append(pn.AggCall(type(a.fn)(x), a.name))
+    outer_keys = [BoundReference(i, e.dtype)
+                  for i, e in enumerate(node.grouping)]
+    return pn.AggregateNode(outer_keys, outer_aggs, inner,
+                            grouping_names=list(node.grouping_names))
+
+
 def optimize(plan: pn.PlanNode) -> pn.PlanNode:
-    return collapse_project(plan)
+    return rewrite_distinct_aggregates(collapse_project(plan))

@@ -336,10 +336,17 @@ class _Frame:
                 self.budget[0] -= 1
                 if self.budget[0] <= 0:
                     raise UdfCompileError("too many branches")
-                # fork: taken path vs fall-through, merged at return
+                # fork: taken path vs fall-through, merged at return.
+                # NULL-condition semantics must match row-wise Python
+                # (None is falsy): If(cond, a, b) picks b when cond is
+                # NULL, so the jump-on-false branch must sit in the
+                # ELSE slot with the UN-negated condition — negating
+                # would send NULL rows down the then-path instead
                 taken_r = self.run(self.by_offset[ins.argval],
                                    list(stack), dict(local))
                 fall_r = self.run(pos + 1, list(stack), dict(local))
+                if op in ("POP_JUMP_IF_FALSE",):
+                    return _merge_returns(ce, fall_r, taken_r)
                 return _merge_returns(ce, taken_r, fall_r)
             raise UdfCompileError(f"unsupported opcode {op}")
 
@@ -397,7 +404,9 @@ class _Frame:
             # false) is NOT SQL boolean semantics — refuse, don't guess
             raise UdfCompileError(
                 "branch on a non-boolean traced value")
-        return e if op == "POP_JUMP_IF_TRUE" else pr.Not(e)
+        # both jump flavors keep the UN-negated condition; the caller
+        # places the branches so NULL lands on the Python-falsy path
+        return e
 
 
 _NULL_SENTINEL = object()

@@ -72,7 +72,8 @@ def test_comparison_matrix(op, gen):
 
 @pytest.mark.parametrize("op", [mth.Sqrt, mth.Exp, mth.Log, mth.Sin,
                                 mth.Cos, mth.Tanh, mth.Floor, mth.Ceil,
-                                mth.Rint],
+                                mth.Rint, mth.Asinh, mth.Acosh,
+                                mth.Atanh, mth.Cot],
                          ids=lambda o: o.__name__)
 def test_unary_math_matrix(op):
     scan = dg.gen_scan({"a": dg.DoubleGen()}, n=200, seed=6)
@@ -254,4 +255,47 @@ def test_datetime_arithmetic_matrix():
         dte.Second(ref(2, dt.TIMESTAMP)),
         dte.Year(Cast(ref(2, dt.TIMESTAMP), dt.DATE)),
     ]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
+
+
+# ---------------------------------------------------------------------------
+# round-2 expression additions: two-arg log, weekday/time math, string
+# index/replace, normalization wrappers
+# ---------------------------------------------------------------------------
+
+
+def test_logarithm_matrix():
+    scan = dg.gen_scan({"a": dg.DoubleGen(), "b": dg.DoubleGen()},
+                       n=200, seed=31)
+    assert_cpu_and_tpu_equal(
+        _project([mth.Logarithm(ref(0, dt.FLOAT64),
+                                ref(1, dt.FLOAT64))], scan),
+        conf=CONF, approx_float=1e-6)
+
+
+def test_weekday_timeadd_tounix_matrix():
+    scan = dg.gen_scan({"d": dg.DateGen(), "t": dg.TimestampGen()},
+                       n=200, seed=32)
+    exprs = [dte.WeekDay(ref(0, dt.DATE)),
+             dte.ToUnixTimestamp(ref(1, dt.TIMESTAMP)),
+             dte.TimeAdd(ref(1, dt.TIMESTAMP),
+                         Literal(3_600_000_000, dt.INT64))]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
+
+
+def test_substring_index_regexp_replace_matrix():
+    scan = dg.gen_scan({"s": dg.StringGen()}, n=200, seed=33)
+    exprs = [st.SubstringIndex(ref(0, dt.STRING), "a", 1),
+             st.SubstringIndex(ref(0, dt.STRING), "b", -2),
+             st.RegExpReplace(ref(0, dt.STRING), "a", "_")]
+    assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)
+
+
+def test_normalize_wrappers_matrix():
+    from spark_rapids_tpu.expressions.constraints import (
+        KnownFloatingPointNormalized, NormalizeNaNAndZero)
+
+    scan = dg.gen_scan({"a": dg.DoubleGen()}, n=200, seed=34)
+    exprs = [KnownFloatingPointNormalized(
+        NormalizeNaNAndZero(ref(0, dt.FLOAT64)))]
     assert_cpu_and_tpu_equal(_project(exprs, scan), conf=CONF)

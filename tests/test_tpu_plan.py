@@ -273,7 +273,10 @@ def test_test_mode_raises_on_fallback():
         apply_overrides
 
     data, validity = random_table(50, seed=10)
-    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64), distinct=True), "f")]
+    # MIXED distinct + plain aggregates stay unsupported (the optimizer
+    # only rewrites the all-distinct-same-input shape)
+    aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64), distinct=True), "f"),
+            pn.AggCall(Count(ref(1, dt.FLOAT64)), "c")]
     plan = pn.AggregateNode([ref(0, dt.INT64)], aggs,
                             scan(data, validity))
     conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})

@@ -365,3 +365,19 @@ def test_bytecode_truthiness_falls_back():
         return 0
 
     assert compile_udf(f, [BoundReference(0, dtt.INT64)]) is None
+
+
+def test_bytecode_null_condition_is_falsy():
+    """A NULL boolean condition must take the Python-falsy (else)
+    branch in the compiled expression, matching row-wise evaluation."""
+    import numpy as np
+
+    def f(flag):
+        if flag:
+            return 1
+        return 0
+
+    _assert_compiles_and_matches(
+        f, [dt.BOOLEAN], dt.INT64,
+        {"flag": np.array([True, False, True])},
+        {"flag": np.array([True, True, False])})
