@@ -18,6 +18,7 @@ import pyarrow.parquet as pq
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions import arithmetic as ar
 from spark_rapids_tpu.expressions import predicates as P
 from spark_rapids_tpu.expressions.base import Alias, BoundReference, Literal
 from spark_rapids_tpu.io import ParquetSource
@@ -81,13 +82,24 @@ def gen_store_sales(sf: float, seed: int = 33) -> pa.Table:
     n_item = max(int(18_000 * sf), 50)
     return pa.table({
         "ss_sold_date_sk": _date_sks(rng, n),
+        "ss_sold_time_sk": rng.integers(0, 86_400, n).astype(np.int64),
         "ss_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
         "ss_customer_sk": rng.integers(1, max(int(100_000 * sf), 20), n
                                        ).astype(np.int64),
+        "ss_cdemo_sk": rng.integers(1, max(int(1_000 * sf), 20) + 1, n
+                                    ).astype(np.int64),
+        "ss_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
+        "ss_promo_sk": rng.integers(1, max(int(300 * sf), 10) + 1, n
+                                    ).astype(np.int64),
         "ss_store_sk": rng.integers(1, max(int(12 * sf), 2) + 1, n
                                     ).astype(np.int64),
         "ss_quantity": rng.integers(1, 101, n).astype(np.int32),
         "ss_sales_price": np.round(rng.random(n) * 200, 2),
+        "ss_list_price": np.round(rng.random(n) * 250, 2),
+        "ss_coupon_amt": np.round(rng.random(n) * 50, 2),
+        "ss_ext_list_price": np.round(rng.random(n) * 25_000, 2),
+        "ss_ext_wholesale_cost": np.round(rng.random(n) * 10_000, 2),
+        "ss_ext_discount_amt": np.round(rng.random(n) * 4_000, 2),
         "ss_ext_sales_price": np.round(rng.random(n) * 20_000, 2),
         "ss_net_profit": np.round(rng.random(n) * 4_000 - 2_000, 2),
     })
@@ -136,6 +148,61 @@ def gen_warehouse(sf: float, seed: int = 36) -> pa.Table:
     })
 
 
+def gen_customer_demographics(sf: float, seed: int = 37) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(1_000 * sf), 20)
+    return pa.table({
+        "cd_demo_sk": np.arange(1, n + 1, dtype=np.int64),
+        "cd_gender": np.array(["M", "F"], dtype=object)[
+            rng.integers(0, 2, n)],
+        "cd_marital_status": np.array(["M", "S", "D", "W", "U"],
+                                      dtype=object)[rng.integers(0, 5, n)],
+        "cd_education_status": np.array(
+            ["Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown"],
+            dtype=object)[rng.integers(0, 7, n)],
+    })
+
+
+def gen_promotion(sf: float, seed: int = 38) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(300 * sf), 10)
+    return pa.table({
+        "p_promo_sk": np.arange(1, n + 1, dtype=np.int64),
+        "p_channel_email": np.array(["Y", "N"], dtype=object)[
+            rng.integers(0, 2, n)],
+        "p_channel_event": np.array(["Y", "N"], dtype=object)[
+            rng.integers(0, 2, n)],
+    })
+
+
+def gen_household_demographics(sf: float, seed: int = 39) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = 7200  # fixed-size dim in TPC-DS
+    return pa.table({
+        "hd_demo_sk": np.arange(1, n + 1, dtype=np.int64),
+        "hd_dep_count": rng.integers(0, 10, n).astype(np.int32),
+    })
+
+
+def gen_time_dim(sf: float, seed: int = 40) -> pa.Table:
+    secs = np.arange(86_400, dtype=np.int64)
+    return pa.table({
+        "t_time_sk": secs,
+        "t_hour": (secs // 3600).astype(np.int32),
+        "t_minute": (secs // 60 % 60).astype(np.int32),
+    })
+
+
+def gen_store(sf: float, seed: int = 41) -> pa.Table:
+    n = max(int(12 * sf), 2)
+    return pa.table({
+        "s_store_sk": np.arange(1, n + 1, dtype=np.int64),
+        "s_store_name": np.array([f"ese{i}" for i in range(1, n + 1)],
+                                 dtype=object),
+    })
+
+
 GENERATORS = {
     "date_dim": gen_date_dim,
     "item": gen_item,
@@ -143,6 +210,11 @@ GENERATORS = {
     "catalog_sales": gen_catalog_sales,
     "inventory": gen_inventory,
     "warehouse": gen_warehouse,
+    "customer_demographics": gen_customer_demographics,
+    "promotion": gen_promotion,
+    "household_demographics": gen_household_demographics,
+    "time_dim": gen_time_dim,
+    "store": gen_store,
 }
 
 
@@ -296,5 +368,117 @@ def q72(data_dir: str) -> pn.PlanNode:
     return pn.LimitNode(100, sort)
 
 
-QUERIES = {"tpcds_q3": q3, "tpcds_q42": q42, "tpcds_q52": q52,
-           "tpcds_q55": q55, "tpcds_q72": q72}
+def q7(data_dir: str) -> pn.PlanNode:
+    """Promotional-item averages per item for one demographic slice
+    (TpcdsLikeSpark q7): 5-way join + multi-average group-by."""
+    ss = _scan(data_dir, "store_sales",
+               ["ss_sold_date_sk", "ss_item_sk", "ss_cdemo_sk",
+                "ss_promo_sk", "ss_quantity", "ss_list_price",
+                "ss_coupon_amt", "ss_sales_price"])
+    cd = pn.FilterNode(
+        P.And(P.EqualTo(ref(1, dt.STRING), Literal("M")),
+              P.And(P.EqualTo(ref(2, dt.STRING), Literal("S")),
+                    P.EqualTo(ref(3, dt.STRING), Literal("College")))),
+        _scan(data_dir, "customer_demographics",
+              ["cd_demo_sk", "cd_gender", "cd_marital_status",
+               "cd_education_status"]))
+    # + [cd 8..11]
+    s1 = pn.JoinNode("inner", ss, cd, [2], [0])
+    dd = pn.FilterNode(
+        P.EqualTo(ref(1, dt.INT32), Literal(2000, dt.INT32)),
+        _scan(data_dir, "date_dim", ["d_date_sk", "d_year"]))
+    # + [d_date_sk 12, d_year 13]
+    s2 = pn.JoinNode("inner", s1, dd, [0], [0])
+    promo = pn.FilterNode(
+        P.Or(P.EqualTo(ref(1, dt.STRING), Literal("N")),
+             P.EqualTo(ref(2, dt.STRING), Literal("N"))),
+        _scan(data_dir, "promotion",
+              ["p_promo_sk", "p_channel_email", "p_channel_event"]))
+    # + [p_promo_sk 14, p_channel_email 15, p_channel_event 16]
+    s3 = pn.JoinNode("inner", s2, promo, [3], [0])
+    item = _scan(data_dir, "item", ["i_item_sk", "i_item_desc"])
+    # + [i_item_sk 17, i_item_desc 18]
+    s4 = pn.JoinNode("inner", s3, item, [1], [0])
+    from spark_rapids_tpu.expressions.cast import Cast
+
+    agg = pn.AggregateNode(
+        [ref(18, dt.STRING)],
+        [pn.AggCall(A.Average(Cast(ref(4, dt.INT32), dt.FLOAT64)),
+                    "agg1"),
+         pn.AggCall(A.Average(ref(5, dt.FLOAT64)), "agg2"),
+         pn.AggCall(A.Average(ref(6, dt.FLOAT64)), "agg3"),
+         pn.AggCall(A.Average(ref(7, dt.FLOAT64)), "agg4")],
+        s4, grouping_names=["i_item_desc"])
+    sort = pn.SortNode([SortKeySpec.spark_default(0)], agg)
+    return pn.LimitNode(100, sort)
+
+
+def q96(data_dir: str) -> pn.PlanNode:
+    """Count of evening purchases by large households at one store
+    (TpcdsLikeSpark q96): pure 4-way join + count."""
+    ss = _scan(data_dir, "store_sales",
+               ["ss_sold_time_sk", "ss_hdemo_sk", "ss_store_sk"])
+    hd = pn.FilterNode(
+        P.EqualTo(ref(1, dt.INT32), Literal(7, dt.INT32)),
+        _scan(data_dir, "household_demographics",
+              ["hd_demo_sk", "hd_dep_count"]))
+    td = pn.FilterNode(
+        P.And(P.EqualTo(ref(1, dt.INT32), Literal(20, dt.INT32)),
+              P.GreaterThanOrEqual(ref(2, dt.INT32),
+                                   Literal(30, dt.INT32))),
+        _scan(data_dir, "time_dim", ["t_time_sk", "t_hour", "t_minute"]))
+    store = pn.FilterNode(
+        P.EqualTo(ref(1, dt.STRING), Literal("ese1")),
+        _scan(data_dir, "store", ["s_store_sk", "s_store_name"]))
+    s1 = pn.JoinNode("inner", ss, hd, [1], [0])
+    s2 = pn.JoinNode("inner", s1, td, [0], [0])
+    s3 = pn.JoinNode("inner", s2, store, [2], [0])
+    return pn.AggregateNode([], [pn.AggCall(A.Count(), "cnt")], s3)
+
+
+def q98(data_dir: str) -> pn.PlanNode:
+    """Revenue share within item class (TpcdsLikeSpark q98): the
+    windowed-aggregate shape — per-item revenue plus a partitioned
+    window SUM over the class for the ratio."""
+    ss = _scan(data_dir, "store_sales",
+               ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    dd = pn.FilterNode(
+        P.EqualTo(ref(2, dt.INT32), Literal(1999, dt.INT32)),
+        _scan(data_dir, "date_dim",
+              ["d_date_sk", "d_moy", "d_year"]))
+    item = pn.FilterNode(
+        P.In(ref(2, dt.STRING),
+             [Literal("Sports"), Literal("Books"), Literal("Home")]),
+        _scan(data_dir, "item",
+              ["i_item_sk", "i_class_id", "i_category",
+               "i_item_desc"]))
+    s1 = pn.JoinNode("inner", ss, dd, [0], [0])
+    # + item at 6..9
+    s2 = pn.JoinNode("inner", s1, item, [1], [0])
+    per_item = pn.AggregateNode(
+        [ref(9, dt.STRING), ref(7, dt.INT32), ref(8, dt.STRING)],
+        [pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "itemrevenue")],
+        s2, grouping_names=["i_item_desc", "i_class_id", "i_category"])
+    # windowed class total: partition by class, unbounded frame sum
+    win = pn.WindowNode(
+        [1], [],
+        [pn.WindowCall(A.Sum(ref(3, dt.FLOAT64)), "classrevenue",
+                       pn.WindowFrame(None, None))],
+        per_item)
+    share = pn.ProjectNode(
+        [Alias(ref(0, dt.STRING), "i_item_desc"),
+         Alias(ref(2, dt.STRING), "i_category"),
+         Alias(ref(3, dt.FLOAT64), "itemrevenue"),
+         Alias(ar.Multiply(
+             Literal(100.0),
+             ar.Divide(ref(3, dt.FLOAT64), ref(4, dt.FLOAT64))),
+             "revenueratio")], win)
+    sort = pn.SortNode([SortKeySpec.spark_default(1),
+                        SortKeySpec.spark_default(3),
+                        SortKeySpec.spark_default(0)], share)
+    return pn.LimitNode(100, sort)
+
+
+QUERIES = {"tpcds_q3": q3, "tpcds_q7": q7, "tpcds_q42": q42,
+           "tpcds_q52": q52, "tpcds_q55": q55, "tpcds_q72": q72,
+           "tpcds_q96": q96, "tpcds_q98": q98}

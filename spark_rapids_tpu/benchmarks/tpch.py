@@ -637,9 +637,7 @@ def q22(data_dir: str) -> pn.PlanNode:
                                       Literal(0.0)), sel)
     avg_bal = pn.AggregateNode(
         [], [pn.AggCall(A.Average(ref(1, dt.FLOAT64)), "avg_bal")], pos)
-    avg_keyed = pn.ProjectNode(
-        [ref(0, dt.FLOAT64), Literal(1, dt.INT64)], avg_bal,
-        ["avg_bal", "one"])
+    avg_keyed = _lit_one(avg_bal, ["avg_bal"])
     sel_keyed = _lit_one(sel, ["c_custkey", "c_acctbal", "cntrycode"])
     # join the single avg row in, keep above-average customers
     j = pn.JoinNode("inner", sel_keyed, avg_keyed, [3], [1])
@@ -789,9 +787,7 @@ def q15(data_dir: str) -> pn.PlanNode:
         proj, grouping_names=["supplier_no"])
     max_rev = pn.AggregateNode(
         [], [pn.AggCall(A.Max(ref(1, dt.FLOAT64)), "max_rev")], revenue)
-    max_keyed = pn.ProjectNode(
-        [ref(0, dt.FLOAT64), Literal(1, dt.INT64)], max_rev,
-        ["max_rev", "one"])
+    max_keyed = _lit_one(max_rev, ["max_rev"])
     rev_keyed = _lit_one(revenue, ["supplier_no", "total_revenue"])
     j = pn.JoinNode("inner", rev_keyed, max_keyed, [2], [1])
     top = pn.FilterNode(P.EqualTo(ref(1, dt.FLOAT64),

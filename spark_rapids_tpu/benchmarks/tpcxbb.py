@@ -26,8 +26,10 @@ import pyarrow.parquet as pq
 from spark_rapids_tpu.benchmarks import tpcds
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions import arithmetic as ar
 from spark_rapids_tpu.expressions import predicates as P
 from spark_rapids_tpu.expressions.base import Alias, BoundReference, Literal
+from spark_rapids_tpu.expressions.cast import Cast
 from spark_rapids_tpu.expressions.conditional import If
 from spark_rapids_tpu.io import ParquetSource
 from spark_rapids_tpu.ops.sortkeys import SortKeySpec
@@ -97,12 +99,47 @@ def gen_store(sf: float, seed: int = 45) -> pa.Table:
     })
 
 
+def gen_web_sales(sf: float, seed: int = 46) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(700_000 * sf), 200)
+    n_cust = max(int(100_000 * sf), 20)
+    n_item = max(int(18_000 * sf), 50)
+    return pa.table({
+        "ws_sold_date_sk": rng.integers(2450815, 2450815 + 5 * 365, n
+                                        ).astype(np.int64),
+        "ws_bill_customer_sk": rng.integers(1, n_cust + 1, n
+                                            ).astype(np.int64),
+        "ws_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
+        "ws_net_paid": np.round(rng.random(n) * 300, 2),
+        "ws_ext_list_price": np.round(rng.random(n) * 250, 2),
+        "ws_ext_wholesale_cost": np.round(rng.random(n) * 100, 2),
+        "ws_ext_discount_amt": np.round(rng.random(n) * 40, 2),
+        "ws_ext_sales_price": np.round(rng.random(n) * 200, 2),
+    })
+
+
+def gen_product_reviews(sf: float, seed: int = 47) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(60_000 * sf), 100)
+    n_item = max(int(18_000 * sf), 50)
+    item = rng.integers(1, n_item + 1, n).astype(np.int64)
+    null = rng.random(n) < 0.03
+    return pa.table({
+        "pr_item_sk": pa.array(
+            [None if m else int(i) for i, m in zip(item, null)],
+            type=pa.int64()),
+        "pr_review_rating": rng.integers(1, 6, n).astype(np.int32),
+    })
+
+
 GENERATORS = {
     "web_clickstreams": gen_web_clickstreams,
     "customer": gen_customer,
     "customer_demographics": gen_customer_demographics,
     "customer_address": gen_customer_address,
     "store": gen_store,
+    "web_sales": gen_web_sales,
+    "product_reviews": gen_product_reviews,
 }
 
 
@@ -277,4 +314,129 @@ def q26(data_dir: str) -> pn.PlanNode:
     return pn.SortNode([SortKeySpec.spark_default(0)], proj)
 
 
-QUERIES = {"tpcxbb_q5": q5, "tpcxbb_q9": q9, "tpcxbb_q26": q26}
+def _channel_year_totals(data_dir, scan, date_col, cust_col,
+                         price_cols, cust_name):
+    """The q6 per-channel view: conditional first/second-year totals per
+    customer with HAVING first_year_total > 0
+    (TpcxbbLikeSpark.scala:891-970)."""
+    dd = pn.FilterNode(
+        P.And(P.GreaterThanOrEqual(ref(1, dt.INT32),
+                                   Literal(2000, dt.INT32)),
+              P.LessThanOrEqual(ref(1, dt.INT32),
+                                Literal(2001, dt.INT32))),
+        _scan(data_dir, "date_dim", ["d_date_sk", "d_year"]))
+    ncols = len(scan.output_schema().names)
+    j = pn.JoinNode("inner", scan, dd, [date_col], [0])
+    lp, wc, da, sp = price_cols
+    half = ar.Divide(
+        ar.Add(ar.Subtract(ar.Subtract(ref(lp, dt.FLOAT64),
+                                       ref(wc, dt.FLOAT64)),
+                           ref(da, dt.FLOAT64)),
+               ref(sp, dt.FLOAT64)), Literal(2.0))
+    is_y1 = P.EqualTo(ref(ncols + 1, dt.INT32), Literal(2000, dt.INT32))
+    proj = pn.ProjectNode(
+        [Alias(ref(cust_col, dt.INT64), cust_name),
+         Alias(If(is_y1, half, Literal(0.0)), "y1"),
+         Alias(If(P.Not(is_y1), half, Literal(0.0)), "y2")], j)
+    agg = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "first_year_total"),
+         pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "second_year_total")],
+        proj, grouping_names=[cust_name])
+    return pn.FilterNode(P.GreaterThan(ref(1, dt.FLOAT64),
+                                       Literal(0.0)), agg)
+
+
+def q6(data_dir: str) -> pn.PlanNode:
+    """Store-to-web purchase-habit shift: per-channel year-over-year
+    ratio comparison, top customers by web increase
+    (TpcxbbLikeSpark.scala:891-970)."""
+    store = _channel_year_totals(
+        data_dir,
+        _scan(data_dir, "store_sales",
+              ["ss_sold_date_sk", "ss_customer_sk", "ss_ext_list_price",
+               "ss_ext_wholesale_cost", "ss_ext_discount_amt",
+               "ss_ext_sales_price"]),
+        date_col=0, cust_col=1, price_cols=(2, 3, 4, 5),
+        cust_name="customer_sk")
+    web = _channel_year_totals(
+        data_dir,
+        _scan(data_dir, "web_sales",
+              ["ws_sold_date_sk", "ws_bill_customer_sk",
+               "ws_ext_list_price", "ws_ext_wholesale_cost",
+               "ws_ext_discount_amt", "ws_ext_sales_price"]),
+        date_col=0, cust_col=1, price_cols=(2, 3, 4, 5),
+        cust_name="customer_sk")
+    # web x store per customer -> ratio comparison
+    # [w_cust 0, w_y1 1, w_y2 2, s_cust 3, s_y1 4, s_y2 5]
+    j = pn.JoinNode("inner", web, store, [0], [0])
+    web_ratio = ar.Divide(ref(2, dt.FLOAT64), ref(1, dt.FLOAT64))
+    store_ratio = ar.Divide(ref(5, dt.FLOAT64), ref(4, dt.FLOAT64))
+    shifted = pn.FilterNode(P.GreaterThan(web_ratio, store_ratio), j)
+    proj = pn.ProjectNode(
+        [Alias(web_ratio, "web_sales_increase_ratio"),
+         Alias(ref(0, dt.INT64), "c_customer_sk")], shifted)
+    sort = pn.SortNode([SortKeySpec.spark_default(0, ascending=False),
+                        SortKeySpec.spark_default(1)], proj)
+    return pn.LimitNode(100, sort)
+
+
+def q11(data_dir: str) -> pn.PlanNode:
+    """Review-sentiment vs revenue correlation
+    (TpcxbbLikeSpark.scala:1126-1180): per-item review stats joined to
+    per-item revenue, then Pearson corr computed from moment sums
+    (n, Σx, Σy, Σxy, Σx², Σy²) — corr() itself is not a device
+    aggregate, the same gap the reference has."""
+    reviews = pn.FilterNode(
+        P.IsNotNull(ref(0, dt.INT64)),
+        _scan(data_dir, "product_reviews",
+              ["pr_item_sk", "pr_review_rating"]))
+    stats = pn.AggregateNode(
+        [ref(0, dt.INT64)],
+        [pn.AggCall(A.Count(), "r_count"),
+         pn.AggCall(A.Average(Cast(ref(1, dt.INT32), dt.FLOAT64)),
+                    "avg_rating")],
+        reviews, grouping_names=["pr_item_sk"])
+    dd = pn.FilterNode(
+        P.EqualTo(ref(1, dt.INT32), Literal(2001, dt.INT32)),
+        _scan(data_dir, "date_dim", ["d_date_sk", "d_year"]))
+    ws = pn.FilterNode(
+        P.IsNotNull(ref(1, dt.INT64)),
+        _scan(data_dir, "web_sales",
+              ["ws_sold_date_sk", "ws_item_sk", "ws_net_paid"]))
+    ws_in = pn.JoinNode("left_semi", ws, dd, [0], [0])
+    revenue = pn.AggregateNode(
+        [ref(1, dt.INT64)],
+        [pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "revenue")],
+        ws_in, grouping_names=["ws_item_sk"])
+    # [pr_item_sk 0, r_count 1, avg_rating 2, ws_item_sk 3, revenue 4]
+    j = pn.JoinNode("inner", stats, revenue, [0], [0])
+    x = Cast(ref(1, dt.INT64), dt.FLOAT64)   # reviews_count
+    y = ref(2, dt.FLOAT64)                   # avg_rating
+    moments = pn.ProjectNode(
+        [Alias(x, "x"), Alias(y, "y"),
+         Alias(ar.Multiply(x, y), "xy"),
+         Alias(ar.Multiply(x, x), "xx"),
+         Alias(ar.Multiply(y, y), "yy")], j)
+    sums = pn.AggregateNode(
+        [], [pn.AggCall(A.Count(), "n"),
+             pn.AggCall(A.Sum(ref(0, dt.FLOAT64)), "sx"),
+             pn.AggCall(A.Sum(ref(1, dt.FLOAT64)), "sy"),
+             pn.AggCall(A.Sum(ref(2, dt.FLOAT64)), "sxy"),
+             pn.AggCall(A.Sum(ref(3, dt.FLOAT64)), "sxx"),
+             pn.AggCall(A.Sum(ref(4, dt.FLOAT64)), "syy")], moments)
+    n = Cast(ref(0, dt.INT64), dt.FLOAT64)
+    sx, sy = ref(1, dt.FLOAT64), ref(2, dt.FLOAT64)
+    sxy, sxx, syy = (ref(3, dt.FLOAT64), ref(4, dt.FLOAT64),
+                     ref(5, dt.FLOAT64))
+    cov = ar.Subtract(ar.Multiply(n, sxy), ar.Multiply(sx, sy))
+    vx = ar.Subtract(ar.Multiply(n, sxx), ar.Multiply(sx, sx))
+    vy = ar.Subtract(ar.Multiply(n, syy), ar.Multiply(sy, sy))
+    from spark_rapids_tpu.expressions.math import Sqrt
+
+    corr = ar.Divide(cov, ar.Multiply(Sqrt(vx), Sqrt(vy)))
+    return pn.ProjectNode([Alias(corr, "corr")], sums)
+
+
+QUERIES = {"tpcxbb_q5": q5, "tpcxbb_q6": q6, "tpcxbb_q9": q9,
+           "tpcxbb_q11": q11, "tpcxbb_q26": q26}

@@ -49,8 +49,11 @@ class Metrics:
         if self._pending_rows:
             import jax
 
-            self._rows += int(sum(
-                int(jax.device_get(n)) for n in self._pending_rows))
+            # ONE transfer for all pending scalars — per-batch
+            # device_get here would re-serialize the round trips the
+            # deferral exists to avoid
+            realized = jax.device_get(self._pending_rows)
+            self._rows += int(sum(int(n) for n in realized))
             self._pending_rows.clear()
         return self._rows
 

@@ -100,7 +100,7 @@ def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
 
 
 def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
-    """count/sum/avg(DISTINCT x) -> dedup-then-aggregate: an inner
+    """count/sum(DISTINCT x) -> dedup-then-aggregate: an inner
     zero-agg group-by over (keys..., x) removes duplicates, then the
     outer aggregate runs the plain (non-distinct) function. This is the
     planner-level role of the reference's distinct handling
@@ -118,9 +118,9 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
     if not node.aggs or not all(
             getattr(a.fn, "distinct", False) for a in node.aggs):
         return node
-    if not all(isinstance(a.fn, (aggfn.Count, aggfn.Sum,
-                                 aggfn.Average)) for a in node.aggs):
-        return node
+    if not all(isinstance(a.fn, (aggfn.Count, aggfn.Sum))
+               for a in node.aggs):
+        return node  # (Average has no distinct form to rewrite)
     inputs = [a.fn.children[0] if a.fn.children else None
               for a in node.aggs]
     if any(i is None for i in inputs):
