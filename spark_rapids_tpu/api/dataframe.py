@@ -82,6 +82,13 @@ class DataFrame:
         keep = [n for n in self.columns if n not in names]
         return self.select(*keep)
 
+    def create_or_replace_temp_view(self, name: str) -> None:
+        """Register this DataFrame in the session catalog for
+        Session.sql (Spark's createOrReplaceTempView)."""
+        self.session.create_temp_view(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
     def explode(self, *cols: ColumnOrName, value_name: str = "col",
                 pos: bool = False, pos_name: str = "pos") -> "DataFrame":
         """explode/posexplode of a per-row array created from ``cols``

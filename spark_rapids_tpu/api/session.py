@@ -39,6 +39,7 @@ class Session:
             self.runtime._owner = self
         else:
             self.runtime = None
+        self._catalog: Dict = {}
 
     def stop(self) -> None:
         """Release the process-global runtime this Session initialized
@@ -91,6 +92,33 @@ class Session:
         if end is None:
             start, end = 0, start
         return DataFrame(pn.RangeNode(start, end, step), self)
+
+    # -- SQL entry point ---------------------------------------------------
+
+    def create_temp_view(self, name: str, df_or_source) -> None:
+        """Register a DataFrame / DataSource / plan under ``name`` for
+        Session.sql (createOrReplaceTempView analogue)."""
+        target = df_or_source
+        if isinstance(target, DataFrame):
+            target = target._plan
+        self._catalog[name] = target
+
+    createOrReplaceTempView = create_temp_view
+
+    def register_parquet(self, name: str, path, columns=None) -> None:
+        """Catalog a parquet directory as a SQL table."""
+        from spark_rapids_tpu.io import ParquetSource
+
+        self.create_temp_view(name, ParquetSource(path, columns=columns))
+
+    def sql(self, query: str) -> DataFrame:
+        """Parse + plan a SELECT over the catalog; returns a lazy
+        DataFrame like any other (the whole override/oracle machinery
+        downstream is shared). Unsupported SQL raises SqlError."""
+        from spark_rapids_tpu.sql import parse, plan_statement
+
+        return DataFrame(plan_statement(parse(query), self._catalog),
+                         self)
 
 
 class DataFrameReader:

@@ -1,0 +1,19 @@
+"""SQL front end: parse a SELECT statement into plan nodes.
+
+The reference rides on Spark SQL for parsing/analysis and only replaces
+physical planning; a STANDALONE framework needs its own entry point, so
+this package provides the SQL surface the engine's node vocabulary can
+express (the TPC-H/DS/xBB-like query shapes):
+
+    SELECT [DISTINCT] exprs FROM t [JOIN u ON ...] [WHERE ...]
+    [GROUP BY ...] [HAVING ...] [ORDER BY ...] [LIMIT n]
+
+with arithmetic/comparison/boolean expressions, CASE WHEN, IN, BETWEEN,
+LIKE, IS [NOT] NULL, casts, and the aggregate/scalar function names in
+``planner._FUNCTIONS``. Tables resolve through the session catalog
+(``Session.sql`` / ``create_temp_view``). Everything else raises
+``SqlError`` — unsupported SQL fails loudly at parse/plan time, never
+silently misplans.
+"""
+from spark_rapids_tpu.sql.parser import SqlError, parse  # noqa: F401
+from spark_rapids_tpu.sql.planner import plan_statement  # noqa: F401

@@ -1,0 +1,398 @@
+"""Recursive-descent SQL parser -> untyped AST.
+
+Grammar (case-insensitive keywords):
+
+    query     := SELECT [DISTINCT] sel (',' sel)* FROM relation
+                 [WHERE expr] [GROUP BY expr (',' expr)*] [HAVING expr]
+                 [ORDER BY order (',' order)*] [LIMIT int]
+    sel       := expr [[AS] ident] | '*'
+    relation  := table_or_sub ([INNER|LEFT [OUTER]|RIGHT [OUTER]|
+                 FULL [OUTER]|LEFT SEMI|LEFT ANTI|CROSS] JOIN
+                 table_or_sub [ON expr])*
+    table_or_sub := ident [[AS] ident] | '(' query ')' [AS] ident
+    order     := expr [ASC|DESC] [NULLS FIRST|NULLS LAST]
+    expr      := OR-precedence expression grammar with NOT, comparison,
+                 BETWEEN, IN (list | subquery-free), LIKE, IS [NOT] NULL,
+                 additive/multiplicative arithmetic, unary -, literals,
+                 CASE WHEN, CAST(e AS type), DATE 'lit', function calls,
+                 [table.]column
+
+AST nodes are plain tuples: ('select', {...}), ('col', tab, name),
+('lit', value, kind), ('call', name, distinct, args), ('case', whens,
+else_), ('cast', e, type), ('star',), binary ops ('and' 'or' 'not'
+'cmp' 'arith' 'in' 'between' 'like' 'isnull').
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+
+class SqlError(Exception):
+    pass
+
+
+_TOKEN_RE = re.compile(r"""
+    \s+
+  | --[^\n]*
+  | (?P<num>\d+\.\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|\d+([eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|/|%|\+|-|\.)
+""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having",
+    "order", "limit", "as", "and", "or", "not", "in", "between", "like",
+    "is", "null", "case", "when", "then", "else", "end", "cast", "join",
+    "inner", "left", "right", "full", "outer", "semi", "anti", "cross",
+    "on", "asc", "desc", "nulls", "first", "last", "date", "timestamp",
+    "true", "false", "interval",
+}
+
+
+def _tokenize(sql: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SqlError(f"cannot tokenize at: {sql[pos:pos+30]!r}")
+        pos = m.end()
+        if m.lastgroup is None:
+            continue  # whitespace/comment
+        text = m.group(m.lastgroup)
+        kind = m.lastgroup
+        if kind == "ident" and text.lower() in _KEYWORDS:
+            out.append(("kw", text.lower()))
+        else:
+            out.append((kind, text))
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, sql: str):
+        self.toks = _tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+
+    def peek(self, k: int = 0):
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        kind, text = self.peek()
+        if kind == "kw" and text in kws:
+            self.i += 1
+            return text
+        return None
+
+    def expect_kw(self, kw: str):
+        if not self.accept_kw(kw):
+            raise SqlError(f"expected {kw.upper()}, got "
+                           f"{self.peek()[1]!r}")
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        kind, text = self.peek()
+        if kind == "op" and text in ops:
+            self.i += 1
+            return text
+        return None
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise SqlError(f"expected {op!r}, got {self.peek()[1]!r}")
+
+    def expect_ident(self) -> str:
+        kind, text = self.next()
+        if kind != "ident":
+            raise SqlError(f"expected identifier, got {text!r}")
+        return text
+
+    # -- query -------------------------------------------------------------
+
+    def parse_query(self):
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        sels = [self.parse_select_item()]
+        while self.accept_op(","):
+            sels.append(self.parse_select_item())
+        self.expect_kw("from")
+        rel = self.parse_relation()
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        group = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group = [self.parse_expr()]
+            while self.accept_op(","):
+                group.append(self.parse_expr())
+        having = None
+        if self.accept_kw("having"):
+            having = self.parse_expr()
+        order = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = [self.parse_order_item()]
+            while self.accept_op(","):
+                order.append(self.parse_order_item())
+        limit = None
+        if self.accept_kw("limit"):
+            kind, text = self.next()
+            if kind != "num" or not re.fullmatch(r"\d+", text):
+                raise SqlError("LIMIT needs an integer")
+            limit = int(text)
+        return ("select", {"distinct": distinct, "sels": sels,
+                           "from": rel, "where": where, "group": group,
+                           "having": having, "order": order,
+                           "limit": limit})
+
+    def parse_select_item(self):
+        if self.accept_op("*"):
+            return (("star",), None)
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek()[0] == "ident":
+            alias = self.expect_ident()
+        return (e, alias)
+
+    def parse_order_item(self):
+        e = self.parse_expr()
+        asc = True
+        if self.accept_kw("desc"):
+            asc = False
+        else:
+            self.accept_kw("asc")
+        nulls_first = asc  # Spark default: ASC->FIRST, DESC->LAST
+        if self.accept_kw("nulls"):
+            which = self.accept_kw("first", "last")
+            if which is None:
+                raise SqlError("NULLS must be followed by FIRST/LAST")
+            nulls_first = which == "first"
+        return (e, asc, nulls_first)
+
+    # -- relations ---------------------------------------------------------
+
+    def parse_relation(self):
+        rel = self.parse_table_or_sub()
+        while True:
+            kind = None
+            if self.accept_kw("cross"):
+                kind = "cross"
+            elif self.accept_kw("inner"):
+                kind = "inner"
+            elif self.accept_kw("left"):
+                if self.accept_kw("semi"):
+                    kind = "left_semi"
+                elif self.accept_kw("anti"):
+                    kind = "left_anti"
+                else:
+                    self.accept_kw("outer")
+                    kind = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                kind = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                kind = "full"
+            elif self.peek() == ("kw", "join"):
+                kind = "inner"
+            if kind is None:
+                return rel
+            self.expect_kw("join")
+            right = self.parse_table_or_sub()
+            cond = None
+            if self.accept_kw("on"):
+                cond = self.parse_expr()
+            elif kind != "cross":
+                raise SqlError(f"{kind.upper()} JOIN requires ON")
+            rel = ("join", kind, rel, right, cond)
+
+    def parse_table_or_sub(self):
+        if self.accept_op("("):
+            sub = self.parse_query()
+            self.expect_op(")")
+            self.accept_kw("as")
+            alias = self.expect_ident()
+            return ("subquery", sub, alias)
+        name = self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek()[0] == "ident":
+            alias = self.expect_ident()
+        return ("table", name, alias or name)
+
+    # -- expressions (precedence climbing) ---------------------------------
+
+    def parse_expr(self):
+        return self.parse_or()
+
+    def parse_or(self):
+        e = self.parse_and()
+        while self.accept_kw("or"):
+            e = ("or", e, self.parse_and())
+        return e
+
+    def parse_and(self):
+        e = self.parse_not()
+        while self.accept_kw("and"):
+            e = ("and", e, self.parse_not())
+        return e
+
+    def parse_not(self):
+        if self.accept_kw("not"):
+            return ("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self):
+        e = self.parse_additive()
+        negate = bool(self.accept_kw("not"))
+        if self.accept_kw("between"):
+            lo = self.parse_additive()
+            self.expect_kw("and")
+            hi = self.parse_additive()
+            out = ("between", e, lo, hi)
+            return ("not", out) if negate else out
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            vals = [self.parse_expr()]
+            while self.accept_op(","):
+                vals.append(self.parse_expr())
+            self.expect_op(")")
+            out = ("in", e, vals)
+            return ("not", out) if negate else out
+        if self.accept_kw("like"):
+            pat = self.parse_additive()
+            out = ("like", e, pat)
+            return ("not", out) if negate else out
+        if negate:
+            raise SqlError("dangling NOT before a non-predicate")
+        if self.accept_kw("is"):
+            isnot = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return ("isnull", e, isnot)
+        op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
+        if op:
+            rhs = self.parse_additive()
+            return ("cmp", op, e, rhs)
+        return e
+
+    def parse_additive(self):
+        e = self.parse_multiplicative()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return e
+            e = ("arith", op, e, self.parse_multiplicative())
+
+    def parse_multiplicative(self):
+        e = self.parse_unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return e
+            e = ("arith", op, e, self.parse_unary())
+
+    def parse_unary(self):
+        if self.accept_op("-"):
+            return ("neg", self.parse_unary())
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self):
+        kind, text = self.peek()
+        if kind == "op" and text == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if kind == "num":
+            self.next()
+            if re.fullmatch(r"\d+", text):
+                return ("lit", int(text), "int")
+            return ("lit", float(text), "float")
+        if kind == "str":
+            self.next()
+            return ("lit", text[1:-1].replace("''", "'"), "str")
+        if kind == "kw":
+            if text in ("date", "timestamp"):
+                # DATE 'yyyy-mm-dd' literal
+                if self.peek(1)[0] == "str":
+                    self.next()
+                    _, s = self.next()
+                    return ("lit", s[1:-1], text)
+                # else: fall through (it may be a cast type name usage)
+            if text == "null":
+                self.next()
+                return ("lit", None, "null")
+            if text in ("true", "false"):
+                self.next()
+                return ("lit", text == "true", "bool")
+            if text == "case":
+                return self.parse_case()
+            if text == "cast":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("as")
+                tkind, tname = self.next()
+                if tkind not in ("ident", "kw"):
+                    raise SqlError(f"bad cast type {tname!r}")
+                self.expect_op(")")
+                return ("cast", e, tname.lower())
+        if kind == "ident":
+            # function call or column reference
+            if self.peek(1) == ("op", "("):
+                name = self.expect_ident().lower()
+                self.expect_op("(")
+                distinct = bool(self.accept_kw("distinct"))
+                args = []
+                if self.accept_op("*"):
+                    args.append(("star",))
+                elif self.peek() != ("op", ")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return ("call", name, distinct, args)
+            tab_or_col = self.expect_ident()
+            if self.accept_op("."):
+                col = self.expect_ident()
+                return ("col", tab_or_col, col)
+            return ("col", None, tab_or_col)
+        raise SqlError(f"unexpected token {text!r}")
+
+    def parse_case(self):
+        self.expect_kw("case")
+        whens = []
+        while self.accept_kw("when"):
+            c = self.parse_expr()
+            self.expect_kw("then")
+            v = self.parse_expr()
+            whens.append((c, v))
+        els = None
+        if self.accept_kw("else"):
+            els = self.parse_expr()
+        self.expect_kw("end")
+        if not whens:
+            raise SqlError("CASE requires at least one WHEN")
+        return ("case", whens, els)
+
+
+def parse(sql: str):
+    p = _Parser(sql)
+    q = p.parse_query()
+    if p.peek()[0] != "eof":
+        raise SqlError(f"trailing tokens at {p.peek()[1]!r}")
+    return q

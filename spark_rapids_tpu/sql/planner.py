@@ -1,0 +1,499 @@
+"""AST -> plan-node planner with catalog-based name resolution.
+
+The analysis layer Spark provides for the reference: resolve column
+names against the FROM scope, split join conditions into equi-keys +
+residual, stage aggregates (GROUP BY / HAVING / aggregate-of-expression
+selects), then wrap DISTINCT / ORDER BY / LIMIT. Produces the same plan
+nodes the DataFrame API builds, so everything downstream (override
+tagging, CPU oracle, explain) is shared.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.expressions import aggregates as A
+from spark_rapids_tpu.expressions import arithmetic as ar
+from spark_rapids_tpu.expressions import conditional as cond
+from spark_rapids_tpu.expressions import datetime as dte
+from spark_rapids_tpu.expressions import math as mth
+from spark_rapids_tpu.expressions import predicates as pr
+from spark_rapids_tpu.expressions import strings as st
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression, Literal)
+from spark_rapids_tpu.expressions.cast import Cast
+from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.sql.parser import SqlError
+
+_AGG_FNS = {"sum", "count", "avg", "min", "max", "first", "last"}
+
+_CAST_TYPES = {
+    "tinyint": dt.INT8, "smallint": dt.INT16,
+    "int": dt.INT32, "integer": dt.INT32,
+    "bigint": dt.INT64, "long": dt.INT64,
+    "float": dt.FLOAT32, "real": dt.FLOAT32,
+    "double": dt.FLOAT64,
+    "string": dt.STRING, "varchar": dt.STRING,
+    "date": dt.DATE, "timestamp": dt.TIMESTAMP,
+    "boolean": dt.BOOLEAN,
+}
+
+
+def _date_days(s: str) -> int:
+    try:
+        return int((np.datetime64(s) -
+                    np.datetime64("1970-01-01")).astype(int))
+    except Exception:
+        raise SqlError(f"bad DATE literal {s!r}")
+
+
+def _ts_us(s: str) -> int:
+    try:
+        return int(np.datetime64(s, "us").astype(np.int64))
+    except Exception:
+        raise SqlError(f"bad TIMESTAMP literal {s!r}")
+
+
+class _Scope:
+    """Resolved FROM output: [(table_alias, column_name, dtype)]."""
+
+    def __init__(self, entries: List[Tuple[Optional[str], str, dt.DType]]):
+        self.entries = entries
+
+    def resolve(self, tab: Optional[str], name: str) -> Tuple[int, dt.DType]:
+        hits = [(i, t) for i, (a, n, t) in enumerate(self.entries)
+                if n.lower() == name.lower() and
+                (tab is None or (a or "").lower() == tab.lower())]
+        if not hits:
+            raise SqlError(f"column {tab + '.' if tab else ''}{name} "
+                           "not found")
+        if len(hits) > 1:
+            raise SqlError(f"column {name} is ambiguous; qualify it")
+        return hits[0]
+
+    @property
+    def width(self) -> int:
+        return len(self.entries)
+
+
+# ---------------------------------------------------------------------------
+# expression planning
+# ---------------------------------------------------------------------------
+
+
+def _fn_scalar(name: str, args: List[Expression]) -> Expression:
+    def need(n):
+        if len(args) != n:
+            raise SqlError(f"{name}() takes {n} arguments")
+
+    if name == "abs":
+        need(1)
+        return ar.Abs(args[0])
+    if name == "sqrt":
+        need(1)
+        return mth.Sqrt(args[0])
+    if name in ("floor", "ceil"):
+        need(1)
+        return (mth.Floor if name == "floor" else mth.Ceil)(args[0])
+    if name in ("year", "month", "quarter", "weekday", "dayofweek"):
+        need(1)
+        klass = {"year": dte.Year, "month": dte.Month,
+                 "quarter": dte.Quarter, "weekday": dte.WeekDay,
+                 "dayofweek": dte.DayOfWeek}[name]
+        return klass(args[0])
+    if name in ("day", "dayofmonth"):
+        need(1)
+        return dte.DayOfMonth(args[0])
+    if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+                "initcap"):
+        need(1)
+        klass = {"upper": st.Upper, "lower": st.Lower,
+                 "trim": st.StringTrim, "ltrim": st.StringTrimLeft,
+                 "rtrim": st.StringTrimRight, "reverse": st.Reverse,
+                 "initcap": st.InitCap}[name]
+        return klass(args[0])
+    if name == "length":
+        need(1)
+        return st.Length(args[0])
+    if name in ("substr", "substring"):
+        if len(args) not in (2, 3):
+            raise SqlError("substring(col, pos[, len])")
+        pos = _want_int_lit(args[1], "substring position")
+        ln = _want_int_lit(args[2], "substring length") \
+            if len(args) == 3 else None
+        return st.Substring(args[0], pos, ln)
+    if name == "concat":
+        return st.ConcatStrings(args)
+    if name == "coalesce":
+        return cond.Coalesce(args)
+    if name == "nvl":
+        need(2)
+        return cond.Nvl(args[0], args[1])
+    if name == "pow" or name == "power":
+        need(2)
+        return mth.Pow(args[0], args[1])
+    if name in ("exp", "log", "log2", "log10", "sin", "cos", "tan"):
+        need(1)
+        klass = {"exp": mth.Exp, "log": mth.Log, "log2": mth.Log2,
+                 "log10": mth.Log10, "sin": mth.Sin, "cos": mth.Cos,
+                 "tan": mth.Tan}[name]
+        return klass(args[0])
+    raise SqlError(f"unknown function {name}()")
+
+
+def _want_int_lit(e: Expression, what: str) -> int:
+    if isinstance(e, Literal) and isinstance(e.value, int):
+        return e.value
+    raise SqlError(f"{what} must be an integer literal")
+
+
+def _cmp(op: str, lhs: Expression, rhs: Expression) -> Expression:
+    if op == "=":
+        return pr.EqualTo(lhs, rhs)
+    if op in ("<>", "!="):
+        return pr.Not(pr.EqualTo(lhs, rhs))
+    return {"<": pr.LessThan, "<=": pr.LessThanOrEqual,
+            ">": pr.GreaterThan, ">=": pr.GreaterThanOrEqual}[op](lhs, rhs)
+
+
+class _ExprPlanner:
+    """Plans value expressions against a scope; ``env`` maps canonical
+    AST reprs to output ordinals (the post-aggregation namespace)."""
+
+    def __init__(self, scope: _Scope,
+                 env: Optional[Dict[str, Tuple[int, dt.DType]]] = None,
+                 allow_aggs: bool = False):
+        self.scope = scope
+        self.env = env or {}
+        self.allow_aggs = allow_aggs
+
+    def plan(self, ast) -> Expression:
+        key = repr(ast)
+        if key in self.env:
+            i, t = self.env[key]
+            return BoundReference(i, t)
+        kind = ast[0]
+        if kind == "col":
+            _, tab, name = ast
+            i, t = self.scope.resolve(tab, name)
+            return BoundReference(i, t)
+        if kind == "lit":
+            return self._literal(ast)
+        if kind == "neg":
+            return ar.UnaryMinus(self.plan(ast[1]))
+        if kind == "arith":
+            _, op, l, r = ast
+            lhs, rhs = self.plan(l), self.plan(r)
+            klass = {"+": ar.Add, "-": ar.Subtract, "*": ar.Multiply,
+                     "/": ar.Divide, "%": ar.Remainder}[op]
+            return klass(lhs, rhs)
+        if kind == "cmp":
+            _, op, l, r = ast
+            return _cmp(op, self.plan(l), self.plan(r))
+        if kind == "and":
+            return pr.And(self.plan(ast[1]), self.plan(ast[2]))
+        if kind == "or":
+            return pr.Or(self.plan(ast[1]), self.plan(ast[2]))
+        if kind == "not":
+            return pr.Not(self.plan(ast[1]))
+        if kind == "isnull":
+            e = self.plan(ast[1])
+            return pr.IsNotNull(e) if ast[2] else pr.IsNull(e)
+        if kind == "between":
+            e = self.plan(ast[1])
+            lo = self.plan(ast[2])
+            hi = self.plan(ast[3])
+            return pr.And(pr.GreaterThanOrEqual(e, lo),
+                          pr.LessThanOrEqual(e, hi))
+        if kind == "in":
+            e = self.plan(ast[1])
+            vals = [self.plan(v) for v in ast[2]]
+            if not all(isinstance(v, Literal) for v in vals):
+                raise SqlError("IN list must contain literals")
+            return pr.In(e, vals)
+        if kind == "like":
+            e = self.plan(ast[1])
+            pat = self.plan(ast[2])
+            if not (isinstance(pat, Literal) and
+                    isinstance(pat.value, str)):
+                raise SqlError("LIKE pattern must be a string literal")
+            return st.Like(e, pat.value)
+        if kind == "case":
+            _, whens, els = ast
+            pairs = [(self.plan(c), self.plan(v)) for c, v in whens]
+            els_e = self.plan(els) if els is not None else \
+                Literal(None, pairs[0][1].dtype)
+            return cond.CaseWhen(pairs, els_e)
+        if kind == "cast":
+            to = _CAST_TYPES.get(ast[2])
+            if to is None:
+                raise SqlError(f"unknown cast type {ast[2]!r}")
+            return Cast(self.plan(ast[1]), to)
+        if kind == "call":
+            _, name, distinct, args = ast
+            if name in _AGG_FNS:
+                raise SqlError(
+                    f"aggregate {name}() not allowed here")
+            if distinct:
+                raise SqlError("DISTINCT only applies to aggregates")
+            return _fn_scalar(name, [self.plan(a) for a in args])
+        if kind == "star":
+            raise SqlError("* only allowed as a bare select item or "
+                           "inside count(*)")
+        raise SqlError(f"unsupported expression {kind!r}")
+
+    def _literal(self, ast) -> Expression:
+        _, v, k = ast
+        if k == "date":
+            return Literal(_date_days(v), dt.DATE)
+        if k == "timestamp":
+            return Literal(_ts_us(v), dt.TIMESTAMP)
+        if k == "null":
+            return Literal(None)
+        return Literal(v)
+
+
+def _plan_agg_call(ast, scope: _Scope) -> A.AggregateFunction:
+    _, name, distinct, args = ast
+    ep = _ExprPlanner(scope)
+    if name == "count":
+        if args and args[0] != ("star",):
+            return A.Count(ep.plan(args[0]), distinct=distinct)
+        if distinct:
+            raise SqlError("count(DISTINCT *) is unsupported")
+        return A.Count()
+    if len(args) != 1:
+        raise SqlError(f"{name}() takes one argument")
+    arg = ep.plan(args[0])
+    if name == "sum":
+        return A.Sum(arg, distinct=distinct)
+    if distinct:
+        raise SqlError(f"{name}(DISTINCT) is unsupported")
+    return {"avg": A.Average, "min": A.Min, "max": A.Max,
+            "first": A.First, "last": A.Last}[name](arg)
+
+
+def _collect_agg_calls(ast, out: List):
+    if not isinstance(ast, tuple):
+        return
+    if ast[0] == "call" and ast[1] in _AGG_FNS:
+        if repr(ast) not in {repr(o) for o in out}:
+            out.append(ast)
+        return  # no nested aggregates
+    for part in ast:
+        if isinstance(part, tuple):
+            _collect_agg_calls(part, out)
+        elif isinstance(part, list):
+            for p in part:
+                if isinstance(p, tuple):
+                    _collect_agg_calls(p, out)
+
+
+# ---------------------------------------------------------------------------
+# relation planning
+# ---------------------------------------------------------------------------
+
+
+def _split_join_condition(cond_ast, left_scope: _Scope,
+                          right_scope: _Scope):
+    """Split ON into equi-key ordinal pairs + residual conjuncts."""
+    conjuncts = []
+
+    def walk(a):
+        if isinstance(a, tuple) and a[0] == "and":
+            walk(a[1])
+            walk(a[2])
+        else:
+            conjuncts.append(a)
+
+    if cond_ast is not None:
+        walk(cond_ast)
+    lk, rk, residual = [], [], []
+    for c in conjuncts:
+        if isinstance(c, tuple) and c[0] == "cmp" and c[1] == "=" and \
+                c[2][0] == "col" and c[3][0] == "col":
+            sides = []
+            for colast in (c[2], c[3]):
+                _, tab, name = colast
+                side = None
+                try:
+                    i, _t = left_scope.resolve(tab, name)
+                    side = ("l", i)
+                except SqlError:
+                    pass
+                try:
+                    i, _t = right_scope.resolve(tab, name)
+                    if side is not None:
+                        side = None  # ambiguous across sides
+                        break
+                    side = ("r", i)
+                except SqlError:
+                    pass
+                sides.append(side)
+            if len(sides) == 2 and sides[0] and sides[1] and \
+                    {sides[0][0], sides[1][0]} == {"l", "r"}:
+                l = sides[0] if sides[0][0] == "l" else sides[1]
+                r = sides[0] if sides[0][0] == "r" else sides[1]
+                lk.append(l[1])
+                rk.append(r[1])
+                continue
+        residual.append(c)
+    residual_ast = None
+    for c in residual:
+        residual_ast = c if residual_ast is None else \
+            ("and", residual_ast, c)
+    return lk, rk, residual_ast
+
+
+def _plan_relation(rel, catalog) -> Tuple[pn.PlanNode, _Scope]:
+    kind = rel[0]
+    if kind == "table":
+        _, name, alias = rel
+        matches = [k for k in catalog if k.lower() == name.lower()]
+        if not matches:
+            raise SqlError(f"table {name!r} not found "
+                           f"(known: {sorted(catalog)})")
+        entry = catalog[matches[0]]
+        node = entry if isinstance(entry, pn.PlanNode) else \
+            pn.ScanNode(entry)
+        schema = node.output_schema()
+        scope = _Scope([(alias, n, t)
+                        for n, t in zip(schema.names, schema.types)])
+        return node, scope
+    if kind == "subquery":
+        _, sub, alias = rel
+        node = plan_statement(sub, catalog)
+        schema = node.output_schema()
+        return node, _Scope([(alias, n, t)
+                             for n, t in zip(schema.names,
+                                             schema.types)])
+    if kind == "join":
+        _, jkind, lrel, rrel, on = rel
+        if jkind == "cross" and on is not None:
+            # Spark parses CROSS JOIN ... ON as an inner join; planning
+            # it as cross would silently drop the condition
+            jkind = "inner"
+        lnode, lscope = _plan_relation(lrel, catalog)
+        rnode, rscope = _plan_relation(rrel, catalog)
+        lk, rk, residual = _split_join_condition(on, lscope, rscope)
+        if jkind != "cross" and not lk:
+            raise SqlError("join requires at least one equi-condition "
+                           "(col = col across the two sides)")
+        cond_expr = None
+        joined_scope = _Scope(
+            lscope.entries + rscope.entries
+            if jkind not in ("left_semi", "left_anti")
+            else lscope.entries)
+        if residual is not None:
+            if jkind in ("left_semi", "left_anti"):
+                raise SqlError("semi/anti joins support only "
+                               "equi-conditions")
+            full_scope = _Scope(lscope.entries + rscope.entries)
+            cond_expr = _ExprPlanner(full_scope).plan(residual)
+        node = pn.JoinNode(jkind, lnode, rnode, lk, rk,
+                           condition=cond_expr)
+        return node, joined_scope
+    raise SqlError(f"unsupported relation {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# statement planning
+# ---------------------------------------------------------------------------
+
+
+def plan_statement(ast, catalog) -> pn.PlanNode:
+    assert ast[0] == "select"
+    q = ast[1]
+    node, scope = _plan_relation(q["from"], catalog)
+
+    if q["where"] is not None:
+        node = pn.FilterNode(_ExprPlanner(scope).plan(q["where"]), node)
+
+    # expand SELECT * / build select item list
+    sels: List[Tuple[tuple, Optional[str]]] = []
+    for e, alias in q["sels"]:
+        if e == ("star",):
+            for i, (tab, name, t) in enumerate(scope.entries):
+                sels.append((("col", tab, name), name))
+        else:
+            sels.append((e, alias))
+
+    agg_calls: List[tuple] = []
+    for e, _ in sels:
+        _collect_agg_calls(e, agg_calls)
+    if q["having"] is not None:
+        _collect_agg_calls(q["having"], agg_calls)
+    for e, _asc, _nf in q["order"]:
+        _collect_agg_calls(e, agg_calls)
+
+    env: Dict[str, Tuple[int, dt.DType]] = {}
+    if q["group"] or agg_calls:
+        grouping = [_ExprPlanner(scope).plan(g) for g in q["group"]]
+        calls = [pn.AggCall(_plan_agg_call(c, scope), f"_a{i}")
+                 for i, c in enumerate(agg_calls)]
+        gnames = []
+        for i, g in enumerate(q["group"]):
+            gname = g[2] if g[0] == "col" else f"_g{i}"
+            gnames.append(gname)
+        node = pn.AggregateNode(grouping, calls, node,
+                                grouping_names=gnames)
+        # post-agg namespace: group ASTs then agg-call ASTs
+        for i, g in enumerate(q["group"]):
+            env[repr(g)] = (i, grouping[i].dtype)
+        base = len(grouping)
+        agg_schema = node.output_schema()
+        for i, c in enumerate(agg_calls):
+            env[repr(c)] = (base + i, agg_schema.types[base + i])
+        scope = _Scope([(None, n, t)
+                        for n, t in zip(agg_schema.names,
+                                        agg_schema.types)])
+        # group columns stay resolvable by name too
+
+    if q["having"] is not None:
+        node = pn.FilterNode(
+            _ExprPlanner(scope, env).plan(q["having"]), node)
+
+    # final projection
+    out_exprs: List[Expression] = []
+    out_names: List[str] = []
+    for i, (e, alias) in enumerate(sels):
+        expr = _ExprPlanner(scope, env).plan(e)
+        name = alias or (e[2] if e[0] == "col" else f"col{i}")
+        out_exprs.append(Alias(expr, name))
+        out_names.append(name)
+    node = pn.ProjectNode(out_exprs, node, out_names)
+
+    if q["distinct"]:
+        schema = node.output_schema()
+        node = pn.AggregateNode(
+            [BoundReference(i, t) for i, t in enumerate(schema.types)],
+            [], node, grouping_names=list(schema.names))
+
+    if q["order"]:
+        schema = node.output_schema()
+        sel_keys = {repr(e): i for i, (e, _a) in enumerate(sels)}
+        specs = []
+        for e, asc, nulls_first in q["order"]:
+            if e[0] == "lit" and isinstance(e[1], int):
+                ordinal = e[1] - 1  # ORDER BY position
+                if not 0 <= ordinal < len(schema.names):
+                    raise SqlError(f"ORDER BY position {e[1]} out of "
+                                   "range")
+            elif repr(e) in sel_keys:
+                ordinal = sel_keys[repr(e)]
+            elif e[0] == "col" and e[1] is None and \
+                    e[2] in schema.names:
+                ordinal = schema.names.index(e[2])
+            else:
+                raise SqlError("ORDER BY must reference a select item, "
+                               "its alias, or a position")
+            specs.append(SortKeySpec(ordinal, asc, nulls_first))
+        node = pn.SortNode(specs, node)
+
+    if q["limit"] is not None:
+        node = pn.LimitNode(q["limit"], node)
+    return node

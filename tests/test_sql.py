@@ -1,0 +1,193 @@
+"""SQL front-end tests: parse -> plan -> both engines agree, and the
+planned SQL matches the equivalent hand-built DataFrame results."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from compare import assert_cpu_and_tpu_equal, assert_frames_equal
+from spark_rapids_tpu.api import Session
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.sql import SqlError, parse, plan_statement
+
+
+def _catalog(seed=0, n=300):
+    rng = np.random.default_rng(seed)
+    t = pn.InMemorySource(
+        {"k": rng.integers(0, 10, n).astype(np.int64),
+         "v": np.round(rng.random(n) * 100, 3),
+         "s": np.array([f"name{i % 7}" for i in range(n)],
+                       dtype=object),
+         "d": (np.datetime64("1995-01-01") +
+               rng.integers(0, 1000, n)).astype("datetime64[D]")},
+        validity={"v": rng.random(n) > 0.1})
+    u = pn.InMemorySource(
+        {"k2": rng.integers(0, 10, 40).astype(np.int64),
+         "w": rng.integers(0, 50, 40).astype(np.int64)})
+    return {"t": t, "u": u}
+
+
+def run_sql(sql, seed=0, **kw):
+    plan = plan_statement(parse(sql), _catalog(seed))
+    assert_cpu_and_tpu_equal(plan, **kw)
+    return plan
+
+
+def test_select_where_order_limit():
+    run_sql("SELECT k, v FROM t WHERE v > 50.0 AND k <> 3 "
+            "ORDER BY v DESC, k LIMIT 17", sort=False)
+
+
+def test_select_star_and_exprs():
+    run_sql("SELECT *, v * 2.0 AS v2, -v AS nv FROM t")
+
+
+def test_group_by_aggregates():
+    run_sql("SELECT k, sum(v) AS sv, count(*) AS n, avg(v) AS av, "
+            "min(v) AS mn, max(v) AS mx FROM t GROUP BY k ORDER BY k",
+            sort=False, approx_float=1e-9)
+
+
+def test_aggregate_of_expression_and_having():
+    run_sql("SELECT k, sum(v) / count(v) AS manual_avg FROM t "
+            "GROUP BY k HAVING count(*) > 20 ORDER BY k", sort=False)
+
+
+def test_global_aggregate():
+    run_sql("SELECT sum(v) AS s, count(*) AS n FROM t")
+
+
+def test_join_with_residual_condition():
+    run_sql("SELECT t.k, t.v, u.w FROM t JOIN u ON t.k = u.k2 "
+            "AND u.w > 25")
+
+
+def test_left_and_semi_joins():
+    run_sql("SELECT t.k, u.w FROM t LEFT JOIN u ON t.k = u.k2")
+    run_sql("SELECT k, v FROM t LEFT SEMI JOIN u ON t.k = u.k2")
+    run_sql("SELECT k, v FROM t LEFT ANTI JOIN u ON t.k = u.k2")
+
+
+def test_subquery_in_from():
+    run_sql("SELECT kk, total FROM (SELECT k AS kk, sum(v) AS total "
+            "FROM t GROUP BY k) agg WHERE total > 100.0 ORDER BY kk",
+            sort=False)
+
+
+def test_case_when_in_between_like():
+    run_sql("SELECT k, CASE WHEN v > 66.0 THEN 'hi' WHEN v > 33.0 "
+            "THEN 'mid' ELSE 'lo' END AS bucket FROM t")
+    run_sql("SELECT k FROM t WHERE k IN (1, 3, 5) OR v BETWEEN 10.0 "
+            "AND 20.0")
+    run_sql("SELECT s FROM t WHERE s LIKE 'name1%'")
+
+
+def test_date_literal_and_functions():
+    run_sql("SELECT year(d) AS y, month(d) AS m, count(*) AS n FROM t "
+            "WHERE d >= DATE '1995-06-01' GROUP BY year(d), month(d) "
+            "ORDER BY y, m", sort=False)
+
+
+def test_distinct_and_cast():
+    run_sql("SELECT DISTINCT k FROM t ORDER BY k", sort=False)
+    run_sql("SELECT CAST(k AS string) AS ks, CAST(v AS int) AS vi "
+            "FROM t")
+
+
+def test_count_distinct():
+    run_sql("SELECT k, count(DISTINCT s) AS ds FROM t GROUP BY k "
+            "ORDER BY k", sort=False)
+
+
+def test_is_null_and_not():
+    run_sql("SELECT k FROM t WHERE v IS NULL")
+    run_sql("SELECT k FROM t WHERE v IS NOT NULL AND NOT k = 2")
+
+
+def test_order_by_position_and_alias():
+    run_sql("SELECT k, sum(v) AS sv FROM t GROUP BY k ORDER BY 2 DESC",
+            sort=False)
+    run_sql("SELECT k, sum(v) AS sv FROM t GROUP BY k ORDER BY sv",
+            sort=False)
+
+
+def test_sql_through_session_api():
+    s = Session()
+    pdf = pd.DataFrame({"a": [1, 2, 2, 3], "b": [10.0, 5.0, 7.0, 1.0]})
+    s.create_temp_view("x", s.create_dataframe(pdf))
+    out = s.sql("SELECT a, sum(b) AS sb FROM x GROUP BY a ORDER BY a") \
+        .collect()
+    assert list(out["a"]) == [1, 2, 3]
+    assert list(out["sb"]) == [10.0, 12.0, 1.0]
+
+
+def test_sql_errors_are_loud():
+    cat = _catalog()
+    with pytest.raises(SqlError, match="not found"):
+        plan_statement(parse("SELECT z FROM t"), cat)
+    with pytest.raises(SqlError, match="table"):
+        plan_statement(parse("SELECT a FROM missing"), cat)
+    with pytest.raises(SqlError):
+        parse("SELECT FROM t")
+    with pytest.raises(SqlError, match="equi"):
+        plan_statement(parse("SELECT t.k FROM t JOIN u ON t.v > u.w"),
+                       cat)
+
+
+def test_tpch_q1_as_sql():
+    """The reference's headline query shape, straight from SQL text."""
+    rng = np.random.default_rng(9)
+    n = 2000
+    li = pn.InMemorySource({
+        "l_returnflag": np.array(["A", "N", "R"], dtype=object)[
+            rng.integers(0, 3, n)],
+        "l_linestatus": np.array(["F", "O"], dtype=object)[
+            rng.integers(0, 2, n)],
+        "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+        "l_extendedprice": np.round(rng.random(n) * 1000, 2),
+        "l_discount": np.round(rng.integers(0, 11, n) / 100, 2),
+        "l_tax": np.round(rng.integers(0, 9, n) / 100, 2),
+        "l_shipdate": (np.datetime64("1994-01-01") +
+                       rng.integers(0, 1500, n)).astype("datetime64[D]"),
+    })
+    sql = """
+        SELECT l_returnflag, l_linestatus,
+               sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice) AS sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax))
+                   AS sum_charge,
+               avg(l_quantity) AS avg_qty,
+               avg(l_extendedprice) AS avg_price,
+               avg(l_discount) AS avg_disc,
+               count(*) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= DATE '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """
+    plan = plan_statement(parse(sql), {"lineitem": li})
+    assert_cpu_and_tpu_equal(plan, sort=False, approx_float=1e-6)
+
+
+def test_cross_join_on_is_inner():
+    """CROSS JOIN ... ON must behave as an inner join (Spark parse), not
+    silently drop the condition."""
+    cat = {"a": pn.InMemorySource({"k": np.array([1, 2], np.int64)}),
+           "b": pn.InMemorySource({"k2": np.array([1, 3], np.int64)})}
+    plan = plan_statement(
+        parse("SELECT k, k2 FROM a CROSS JOIN b ON k = k2"), cat)
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+
+    assert len(execute_cpu(plan).to_pandas()) == 1
+    assert_cpu_and_tpu_equal(plan)
+
+
+def test_limit_float_and_case_insensitive_table():
+    cat = _catalog()
+    with pytest.raises(SqlError, match="LIMIT"):
+        parse("SELECT k FROM t LIMIT 2.5")
+    plan = plan_statement(parse("SELECT K FROM T LIMIT 3"), cat)
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+
+    assert len(execute_cpu(plan).to_pandas()) == 3
