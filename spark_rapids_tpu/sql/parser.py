@@ -183,6 +183,14 @@ class _Parser:
     # -- relations ---------------------------------------------------------
 
     def parse_relation(self):
+        rel = self.parse_joined()
+        # comma-separated FROM (the classic TPC syntax): implicit joins
+        # whose conditions live in WHERE; the planner hoists them
+        while self.accept_op(","):
+            rel = ("join", "implicit", rel, self.parse_joined(), None)
+        return rel
+
+    def parse_joined(self):
         rel = self.parse_table_or_sub()
         while True:
             kind = None

@@ -405,13 +405,99 @@ def _plan_relation(rel, catalog) -> Tuple[pn.PlanNode, _Scope]:
 # ---------------------------------------------------------------------------
 
 
+def _flatten_implicit(rel) -> List:
+    if rel[0] == "join" and rel[1] == "implicit":
+        return _flatten_implicit(rel[2]) + [rel[3]]
+    return [rel]
+
+
+def _conjuncts(ast) -> List:
+    if ast is None:
+        return []
+    if isinstance(ast, tuple) and ast[0] == "and":
+        return _conjuncts(ast[1]) + _conjuncts(ast[2])
+    return [ast]
+
+
+def _equi_pair(c, lscope: _Scope, rscope: _Scope):
+    """ordinal pair when conjunct ``c`` is col=col across the scopes."""
+    if not (isinstance(c, tuple) and c[0] == "cmp" and c[1] == "=" and
+            c[2][0] == "col" and c[3][0] == "col"):
+        return None
+
+    def side(colast):
+        _, tab, name = colast
+        l = r = None
+        try:
+            l = lscope.resolve(tab, name)[0]
+        except SqlError:
+            pass
+        try:
+            r = rscope.resolve(tab, name)[0]
+        except SqlError:
+            pass
+        if (l is None) == (r is None):
+            return None  # missing or ambiguous across the scopes
+        return ("l", l) if l is not None else ("r", r)
+
+    a, b = side(c[2]), side(c[3])
+    if a and b and {a[0], b[0]} == {"l", "r"}:
+        l = a if a[0] == "l" else b
+        r = a if a[0] == "r" else b
+        return l[1], r[1]
+    return None
+
+
+def _plan_implicit_joins(rels, where_ast, catalog):
+    """Comma-FROM planning: hoist WHERE equi-conjuncts into inner-join
+    keys, folding relations left-to-right (the analysis step Spark's
+    optimizer performs for the classic TPC join syntax)."""
+    planned = [_plan_relation(r, catalog) for r in rels]
+    conjuncts = _conjuncts(where_ast)
+    node, scope = planned[0]
+    remaining = list(planned[1:])
+    while remaining:
+        progress = False
+        for idx, (n2, s2) in enumerate(remaining):
+            lk, rk, used = [], [], []
+            for ci, c in enumerate(conjuncts):
+                pair = _equi_pair(c, scope, s2)
+                if pair:
+                    lk.append(pair[0])
+                    rk.append(pair[1])
+                    used.append(ci)
+            if lk:
+                node = pn.JoinNode("inner", node, n2, lk, rk)
+                scope = _Scope(scope.entries + s2.entries)
+                for ci in reversed(used):
+                    conjuncts.pop(ci)
+                remaining.pop(idx)
+                progress = True
+                break
+        if not progress:
+            names = [r[0] for r in rels]
+            raise SqlError(
+                "comma-joined tables need WHERE equi-conditions "
+                f"linking them (unlinked remain among {names})")
+    residual = None
+    for c in conjuncts:
+        residual = c if residual is None else ("and", residual, c)
+    if residual is not None:
+        node = pn.FilterNode(_ExprPlanner(scope).plan(residual), node)
+    return node, scope
+
+
 def plan_statement(ast, catalog) -> pn.PlanNode:
     assert ast[0] == "select"
     q = ast[1]
-    node, scope = _plan_relation(q["from"], catalog)
-
-    if q["where"] is not None:
-        node = pn.FilterNode(_ExprPlanner(scope).plan(q["where"]), node)
+    rels = _flatten_implicit(q["from"])
+    if len(rels) > 1:
+        node, scope = _plan_implicit_joins(rels, q["where"], catalog)
+    else:
+        node, scope = _plan_relation(q["from"], catalog)
+        if q["where"] is not None:
+            node = pn.FilterNode(_ExprPlanner(scope).plan(q["where"]),
+                                 node)
 
     # expand SELECT * / build select item list
     sels: List[Tuple[tuple, Optional[str]]] = []

@@ -191,3 +191,79 @@ def test_limit_float_and_case_insensitive_table():
     from spark_rapids_tpu.cpu.engine import execute_cpu
 
     assert len(execute_cpu(plan).to_pandas()) == 3
+
+
+def _tpcds_catalog(tmp_path):
+    from spark_rapids_tpu.benchmarks import tpcds
+    from spark_rapids_tpu.io import ParquetSource
+
+    d = str(tmp_path / "tpcds_sql")
+    tpcds.write_tables(d, 0.001,
+                       tables=["store_sales", "item", "date_dim"])
+    import os
+
+    return {t: ParquetSource(os.path.join(d, t))
+            for t in ("store_sales", "item", "date_dim")}
+
+
+def test_reference_tpcds_q3_verbatim(tmp_path):
+    """The reference's ACTUAL q3 SQL text (TpcdsLikeSpark.scala:788),
+    comma-FROM join syntax and all, parsed and executed on both
+    engines."""
+    sql = """
+        SELECT dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+               SUM(ss_ext_sales_price) sum_agg
+        FROM  date_dim dt, store_sales, item
+        WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+          AND store_sales.ss_item_sk = item.i_item_sk
+          AND item.i_manufact_id = 128
+          AND dt.d_moy=11
+        GROUP BY dt.d_year, item.i_brand, item.i_brand_id
+        ORDER BY dt.d_year, sum_agg desc, brand_id
+        LIMIT 100
+    """
+    plan = plan_statement(parse(sql), _tpcds_catalog(tmp_path))
+    assert_cpu_and_tpu_equal(plan, sort=False, approx_float=1e-6)
+
+
+def test_reference_tpcds_q55_verbatim(tmp_path):
+    """TpcdsLikeSpark.scala:2946 q55, verbatim."""
+    sql = """
+        select i_brand_id brand_id, i_brand brand,
+           sum(ss_ext_sales_price) ext_price
+         from date_dim, store_sales, item
+         where d_date_sk = ss_sold_date_sk
+           and ss_item_sk = i_item_sk
+           and i_manager_id=28
+           and d_moy=11
+           and d_year=1999
+         group by i_brand, i_brand_id
+         order by ext_price desc, brand_id
+         limit 100
+    """
+    plan = plan_statement(parse(sql), _tpcds_catalog(tmp_path))
+    assert_cpu_and_tpu_equal(plan, sort=False, approx_float=1e-6)
+
+
+def test_reference_tpcds_q42_verbatim(tmp_path):
+    """TpcdsLikeSpark.scala:2445 q42, verbatim — aggregate call repeated
+    in ORDER BY."""
+    sql = """
+        select dt.d_year, item.i_category_id, item.i_category,
+               sum(ss_ext_sales_price)
+         from   date_dim dt, store_sales, item
+         where dt.d_date_sk = store_sales.ss_sold_date_sk
+           and store_sales.ss_item_sk = item.i_item_sk
+           and item.i_manager_id = 1
+           and dt.d_moy=11
+           and dt.d_year=2000
+         group by   dt.d_year
+             ,item.i_category_id
+             ,item.i_category
+         order by       sum(ss_ext_sales_price) desc,dt.d_year
+             ,item.i_category_id
+             ,item.i_category
+         limit 100
+    """
+    plan = plan_statement(parse(sql), _tpcds_catalog(tmp_path))
+    assert_cpu_and_tpu_equal(plan, sort=False, approx_float=1e-6)
