@@ -1,0 +1,247 @@
+"""Round-2 on-chip profiling: where does the q5lite step spend its time?
+
+Times (one process, warmup + async pipelined iterations):
+  1. current entry() step (9 sort words)
+  2. main variadic sort alone, current lane layout
+  3. packed single-i32-key sort carrying f64 val (3 words) — narrow-key
+     prototype: pad/validity/key packed into one int32 lane
+  4. argsort(~boundary) compaction sort (2 lanes)
+  5. cumsum i64 vs i32, segmented f64 associative_scan
+  6. candidate fully-packed groupby step end to end
+  7. dispatch overhead: per-iter device_get vs pipelined async
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import spark_rapids_tpu  # noqa: F401  (x64 on)
+import jax
+import jax.numpy as jnp
+
+N = 4_000_000
+N_KEYS = 65_536
+WARMUP = 2
+ITERS = 5
+
+
+def timeit(name, fn, *args, iters=ITERS, pipelined=True):
+    for _ in range(WARMUP):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        _force(out)
+    t0 = time.perf_counter()
+    if pipelined:
+        outs = [fn(*args) for _ in range(iters)]
+        _force(outs[-1])
+    else:
+        for _ in range(iters):
+            out = fn(*args)
+            _force(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:45s} {dt*1e3:9.2f} ms", flush=True)
+    return dt
+
+
+def _force(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    jax.device_get(leaves[-1].ravel()[0])
+
+
+def main():
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, N_KEYS, N).astype(np.int64)
+    key_valid = rng.random(N) > 0.02
+    vals = rng.random(N)
+
+    from spark_rapids_tpu.ops.buckets import bucket_capacity
+    cap = bucket_capacity(N)
+    kd = jnp.asarray(np.concatenate([keys, np.zeros(cap - N, np.int64)]))
+    kv = jnp.asarray(np.concatenate([key_valid, np.zeros(cap - N, bool)]))
+    vd = jnp.asarray(np.concatenate([vals, np.zeros(cap - N)]))
+    nr = jnp.int32(N)
+    print(f"capacity={cap}", flush=True)
+
+    # --- 1. current step
+    from __graft_entry__ import entry
+    step, _ = entry()
+    jstep = jax.jit(step)
+    timeit("1a. current step (pipelined)", jstep, kd, kv, vd, nr)
+    timeit("1b. current step (sync per iter)", jstep, kd, kv, vd, nr,
+           pipelined=False)
+
+    # --- 2. main sort, current lanes: keys [i32 pad, i32 vrank, i64 key]
+    #     payloads [i64 key, f64 val, bool valid]
+    @jax.jit
+    def cur_sort(kd, kv, vd, nr):
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        keep = (vd > 0.5) & kv
+        pad = jnp.maximum((iota >= nr).astype(jnp.int32),
+                          (~keep).astype(jnp.int32))
+        vrank = kv.astype(jnp.int32)
+        kz = jnp.where(kv, kd, 0)
+        out = jax.lax.sort((pad, vrank, kz, kd, vd, kv), num_keys=3,
+                           is_stable=True)
+        return out[3], out[4], out[5]
+    timeit("2. current-layout sort alone", cur_sort, kd, kv, vd, nr)
+
+    # --- 3. packed i32-key sort + f64 payload
+    @jax.jit
+    def packed_sort(kd, kv, vd, nr):
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        keep = (vd > 0.5) & kv & (iota < nr)
+        packed = jnp.where(keep, kd.astype(jnp.int32) + 1,
+                           jnp.int32(0x7FFFFFFF))
+        out = jax.lax.sort((packed, vd), num_keys=1, is_stable=True)
+        return out
+    timeit("3. packed i32-key sort (+f64 payload)", packed_sort,
+           kd, kv, vd, nr)
+
+    @jax.jit
+    def packed_sort_f32pair(kd, kv, vd, nr):
+        # payload as two f32 lanes instead of one f64 (is f64 payload
+        # more than 2 words on v5e?)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        keep = (vd > 0.5) & kv & (iota < nr)
+        packed = jnp.where(keep, kd.astype(jnp.int32) + 1,
+                           jnp.int32(0x7FFFFFFF))
+        hi = vd.astype(jnp.float32)
+        lo = (vd - hi.astype(jnp.float64)).astype(jnp.float32)
+        out = jax.lax.sort((packed, hi, lo), num_keys=1, is_stable=True)
+        return out
+    timeit("3b. packed i32-key sort (+2xf32 payload)", packed_sort_f32pair,
+           kd, kv, vd, nr)
+
+    @jax.jit
+    def packed_sort_i32payload(kd, kv, vd, nr):
+        # carry row-id instead of value
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        keep = (vd > 0.5) & kv & (iota < nr)
+        packed = jnp.where(keep, kd.astype(jnp.int32) + 1,
+                           jnp.int32(0x7FFFFFFF))
+        out = jax.lax.sort((packed, iota), num_keys=1, is_stable=True)
+        return out
+    timeit("3c. packed i32-key sort (+i32 rowid)", packed_sort_i32payload,
+           kd, kv, vd, nr)
+
+    @jax.jit
+    def rowid_gather(kd, kv, vd, nr):
+        packed, rowid = packed_sort_i32payload(kd, kv, vd, nr)
+        return packed, jnp.take(vd, rowid)
+    timeit("3d. packed sort + permutation gather f64", rowid_gather,
+           kd, kv, vd, nr)
+
+    # --- 4. compaction argsort
+    bnd = np.zeros(cap, dtype=bool)
+    bnd[np.sort(rng.choice(cap, N_KEYS, replace=False))] = True
+    bndd = jnp.asarray(bnd)
+
+    @jax.jit
+    def compaction(b):
+        return jnp.argsort(~b, stable=True).astype(jnp.int32)
+    timeit("4. argsort(~boundary) compaction", compaction, bndd)
+
+    # --- 5. scans
+    xi64 = jnp.asarray(rng.integers(0, 2, cap).astype(np.int64))
+    xf64 = vd
+
+    @jax.jit
+    def cs64(x):
+        return jnp.cumsum(x)
+    timeit("5a. cumsum i64", cs64, xi64)
+    timeit("5b. cumsum i32", cs64, xi64.astype(jnp.int32))
+    timeit("5c. cumsum f64", cs64, xf64)
+    timeit("5d. cumsum f32", cs64, xf64.astype(jnp.float32))
+
+    @jax.jit
+    def segscan(x, b):
+        def combine(a, c):
+            av, af = a
+            cv, cf = c
+            return jnp.where(cf, cv, av + cv), af | cf
+        v, _ = jax.lax.associative_scan(combine, (x, b))
+        return v
+    timeit("5e. segmented assoc-scan f64", segscan, xf64, bndd)
+
+    # --- 6. candidate packed groupby end-to-end
+    @jax.jit
+    def packed_step(kd, kv, vd, nr):
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        keep = (vd > 0.5) & kv & (iota < nr)
+        nlive = jnp.sum(keep).astype(jnp.int32)
+        packed = jnp.where(keep, kd.astype(jnp.int32) + 1,
+                           jnp.int32(0x7FFFFFFF))
+        sp, sv = jax.lax.sort((packed, vd), num_keys=1, is_stable=True)
+        live_sorted = iota < nlive
+        boundary = jnp.concatenate(
+            [jnp.ones(1, bool), sp[1:] != sp[:-1]]) & live_sorted
+        ng = jnp.sum(boundary).astype(jnp.int32)
+        first_idx = jnp.argsort(~boundary, stable=True).astype(jnp.int32)
+        glive = iota < ng
+        next_first = jnp.where(iota < ng - 1, jnp.roll(first_idx, -1),
+                               nlive)
+        seg_sizes = jnp.where(glive, next_first - first_idx, 0)
+        last_idx = first_idx + jnp.maximum(seg_sizes, 1) - 1
+        key_out = (jnp.take(sp, first_idx) - 1).astype(jnp.int64)
+        # f64 sum via cumsum-diff (bench data has no inf)
+        cs = jnp.cumsum(jnp.where(live_sorted, sv, 0.0))
+        hi = jnp.take(cs, last_idx)
+        lo = jnp.where(first_idx > 0,
+                       jnp.take(cs, jnp.maximum(first_idx - 1, 0)), 0.0)
+        s = hi - lo
+        cnt = seg_sizes.astype(jnp.int64)
+        return key_out, s, cnt, cnt, ng
+    timeit("6. candidate packed step e2e", packed_step, kd, kv, vd, nr)
+
+    # --- 6b. packed step with segscan sum (inf-safe)
+    @jax.jit
+    def packed_step_segscan(kd, kv, vd, nr):
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        keep = (vd > 0.5) & kv & (iota < nr)
+        nlive = jnp.sum(keep).astype(jnp.int32)
+        packed = jnp.where(keep, kd.astype(jnp.int32) + 1,
+                           jnp.int32(0x7FFFFFFF))
+        sp, sv = jax.lax.sort((packed, vd), num_keys=1, is_stable=True)
+        live_sorted = iota < nlive
+        boundary = jnp.concatenate(
+            [jnp.ones(1, bool), sp[1:] != sp[:-1]]) & live_sorted
+        ng = jnp.sum(boundary).astype(jnp.int32)
+        first_idx = jnp.argsort(~boundary, stable=True).astype(jnp.int32)
+        glive = iota < ng
+        next_first = jnp.where(iota < ng - 1, jnp.roll(first_idx, -1),
+                               nlive)
+        seg_sizes = jnp.where(glive, next_first - first_idx, 0)
+        last_idx = first_idx + jnp.maximum(seg_sizes, 1) - 1
+        key_out = (jnp.take(sp, first_idx) - 1).astype(jnp.int64)
+
+        def combine(a, c):
+            av, af = a
+            cv, cf = c
+            return jnp.where(cf, cv, av + cv), af | cf
+        scan, _ = jax.lax.associative_scan(
+            combine, (jnp.where(live_sorted, sv, 0.0), boundary))
+        s = jnp.take(scan, last_idx)
+        cnt = seg_sizes.astype(jnp.int64)
+        return key_out, s, cnt, cnt, ng
+    timeit("6b. packed step segscan-sum e2e", packed_step_segscan,
+           kd, kv, vd, nr)
+
+    # correctness cross-check of candidate vs current
+    ref = jstep(kd, kv, vd, nr)
+    got = packed_step(kd, kv, vd, nr)
+    ngr = int(jax.device_get(ref[4]))
+    ngg = int(jax.device_get(got[4]))
+    assert ngr == ngg, (ngr, ngg)
+    rs = np.asarray(jax.device_get(ref[1]))[:ngr].sum()
+    gs = np.asarray(jax.device_get(got[1]))[:ngg].sum()
+    assert abs(rs - gs) / abs(rs) < 1e-12, (rs, gs)
+    print("candidate matches current step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,15 +48,22 @@ class Scalar:
 
 
 class Column:
-    """A device column: ``data`` (capacity,) + optional validity mask."""
+    """A device column: ``data`` (capacity,) + optional validity mask.
 
-    __slots__ = ("dtype", "data", "validity")
+    ``stats`` optionally holds host-known (min, max) value bounds (from
+    file footer statistics or an upload-time pass). Kernels use them to
+    pick narrow packed-key paths (ops/groupby); transforms drop them —
+    they are never propagated through expressions."""
+
+    __slots__ = ("dtype", "data", "validity", "stats")
 
     def __init__(self, dtype: dt.DType, data: jax.Array,
-                 validity: Optional[jax.Array] = None):
+                 validity: Optional[jax.Array] = None,
+                 stats=None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
+        self.stats = stats
 
     # -- construction -----------------------------------------------------
 

@@ -229,6 +229,18 @@ def resolve_shuffle_partitions(conf_obj) -> int:
     except Exception:  # pragma: no cover - no backend at plan time
         return 8
 
+MESH_ENABLED = conf("rapids.tpu.mesh.enabled").doc(
+    "Lower planned queries onto the device mesh: hash exchanges become "
+    "in-program lax.all_to_all collectives and aggregation/join execs run "
+    "per-chip kernels inside one shard_map program (the planner-reachable "
+    "multi-chip path; GpuShuffleExchangeExec.scala:146-248 re-imagined as "
+    "ICI collectives)."
+).boolean_conf.create_with_default(False)
+
+MESH_DEVICES = conf("rapids.tpu.mesh.devices").doc(
+    "Device count for the mesh data axis; 0 = all visible devices."
+).int_conf.create_with_default(0)
+
 SHUFFLE_COMPRESSION_CODEC = conf("rapids.tpu.shuffle.compression.codec").doc(
     "Compression for host-path shuffle payloads: none, lz4 (native C++ "
     "codec; the nvcomp-LZ4 analogue, RapidsConf.scala:685) or zlib."

@@ -38,8 +38,17 @@ def host_to_batch(data: Dict[str, np.ndarray],
                 arr = (arr.astype("datetime64[D]").astype(np.int32)
                        if typ is dt.DATE else
                        arr.astype("datetime64[us]").astype(np.int64))
-            cols.append(Column.from_numpy(arr.astype(typ.np_dtype),
-                                          dtype=typ, validity=v))
+            col = Column.from_numpy(arr.astype(typ.np_dtype),
+                                    dtype=typ, validity=v)
+            if typ.is_integral or typ in (dt.DATE, dt.TIMESTAMP):
+                # upload-time (min, max): one vectorized host pass that
+                # lets the groupby kernel pick its packed-key sort lane
+                # (Column.stats; the parquet path gets the same numbers
+                # from footer statistics)
+                vals = arr if v is None else arr[v]
+                if len(vals):
+                    col.stats = (int(vals.min()), int(vals.max()))
+            cols.append(col)
     return ColumnarBatch(cols, n or 0)
 
 

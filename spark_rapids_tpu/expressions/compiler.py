@@ -125,7 +125,13 @@ class CompiledProjection:
                     assert isinstance(src, StringColumn)
                     cols.append(StringColumn(data, src.dictionary, validity))
                 else:
-                    cols.append(Column(e.dtype, data, validity))
+                    col = Column(e.dtype, data, validity)
+                    ref = _passthrough_ref(e)
+                    if ref is not None:
+                        # plain column refs keep upload/footer stats so
+                        # downstream groupbys can pick packed-key sorts
+                        col.stats = batch.columns[ref].stats
+                    cols.append(col)
             return ColumnarBatch(cols, batch.num_rows)
         # eager path
         ctx = EvalContext.from_batch(batch, conf=self.conf,

@@ -1,22 +1,37 @@
-"""Group-by aggregation: sort-based segmented reduction.
+"""Group-by aggregation: sort-based segmented reduction, gather-free.
 
 cuDF gives the reference a hash-based ``groupBy.aggregate``
-(aggregate.scala:810-890). TPUs have no device hash tables, but XLA's sort is
-fast, so the TPU-native plan is:
+(aggregate.scala:810-890). TPUs have no device hash tables, but XLA's sort
+is fast, so the TPU-native plan is:
 
-  1. stable lexsort rows by group keys (nulls group together; NaN==NaN and
-     -0.0==0.0 per Spark grouping semantics — sortkeys.equality_normalize),
-  2. mark segment boundaries where any key differs from the previous row,
-  3. ``segment_id = cumsum(boundary)-1``; padding rows park in a reserved
-     segment that is never emitted,
-  4. every aggregate becomes a prefix-scan + boundary gather over the
-     CONTIGUOUS runs: sums/counts are cumsum differences at segment edges
-     (exact for ints even across wrap; float error bounded like any
-     reordered sum), min/max are segmented associative scans. TPU scatter
-     (segment_sum et al.) measured ~30x slower than cumsum at 4M rows, so
-     no scatters appear anywhere on this path,
-  5. group keys gather from each segment's first row; the group count is a
-     device scalar (no host sync until the consumer needs it).
+  1. ONE stable variadic sort clusters equal keys (nulls group; NaN==NaN
+     and -0.0==0.0 per Spark grouping semantics). When every key's value
+     range is host-known (string dictionaries always are; numeric columns
+     via footer/upload stats) all keys PACK into a single int32/int64 sort
+     lane — measured 37 ms vs 52 ms for the multi-lane layout at 4M rows
+     on a v5e,
+  2. boundaries where any key lane differs from the previous row,
+  3. per-aggregate ROW-SPACE lanes: prefix sums for sum/count (cumsum
+     diffs at segment edges — exact for ints even across wrap), segmented
+     scans for min/max, shifted lanes for first/last,
+  4. ONE more stable sort keyed on ~boundary compacts every per-group
+     output lane to a group prefix. This replaces the per-output
+     ``jnp.take`` gathers of the round-1 kernel — a single 4M-row f64
+     gather measured ~100 ms on a v5e while a whole extra sort pass is
+     ~25-35 ms, and ALL outputs ride one pass,
+  5. segment aggregates become roll/subtract arithmetic on the compacted
+     lanes; the group count stays a device scalar (no host sync).
+
+Float sums are IEEE-exact without paying for it when data is benign: the
+predicate isfinite(grand total) selects (lax.cond, one HLO conditional)
+between the cumsum-diff tail and a per-segment-scan tail. Inf is sticky
+under addition of finite values and NaN propagates, so a finite total
+proves no Inf/NaN input contributed AND no prefix of the running sum
+overflowed — either would poison cumsum diffs across segment edges (the
+overflow case poisons them even with every input finite).
+
+TPU scatter (segment_sum et al.) measured ~30x slower than cumsum at 4M
+rows — no scatters appear anywhere on this path.
 
 Both halves of the reference's CudfAggregate split (update-from-raw and
 merge-of-partials, AggregateFunctions.scala) map onto the same kernel with
@@ -26,7 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,8 +49,6 @@ import jax.numpy as jnp
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import Column, StringColumn
-from spark_rapids_tpu.ops import sortkeys
-from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 
 # Aggregate op names understood by the kernel.
 AGG_OPS = ("sum", "min", "max", "count", "count_star", "first", "last",
@@ -52,6 +65,21 @@ class AggSpec:
     ordinal: int = -1
 
 
+def key_range_of(col: Column, dtype: dt.DType) -> Optional[Tuple[int, int]]:
+    """Host-known closed value range for packed-key grouping, if any.
+    String dictionaries and booleans always have one; numerics only when
+    the column carries stats."""
+    if isinstance(col, StringColumn):
+        return (0, max(len(col.dictionary) - 1, 0))
+    if dtype is dt.BOOLEAN:
+        return (0, 1)
+    if dtype.is_integral or dtype in (dt.DATE, dt.TIMESTAMP):
+        s = getattr(col, "stats", None)
+        if s is not None:
+            return (int(s[0]), int(s[1]))
+    return None
+
+
 def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
                       aggs: List[AggSpec], dtypes: List[dt.DType],
                       live_mask=None
@@ -59,8 +87,11 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
     """Returns (result batch [keys..., agg results...], result dtypes).
     ``live_mask`` fuses an upstream filter into the sort pass."""
     cols = [(c.data, c.validity) for c in batch.columns]
+    key_ranges = tuple(key_range_of(batch.columns[o], dtypes[o])
+                       for o in key_ordinals)
     out = _groupby(cols, tuple(dtypes), tuple(key_ordinals), tuple(aggs),
-                   batch.num_rows_device(), live_mask=live_mask)
+                   batch.num_rows_device(), live_mask=live_mask,
+                   key_ranges=key_ranges)
     (key_d, key_v), (agg_d, agg_v), num_groups = out
     out_cols: List[Column] = []
     out_types: List[dt.DType] = []
@@ -95,95 +126,471 @@ def agg_result_dtype(spec: AggSpec, dtypes: List[dt.DType]) -> dt.DType:
     return in_t  # min/max/first/last/any_valid preserve type
 
 
-@partial(jax.jit, static_argnames=("dtypes", "key_ordinals", "aggs"))
+# ---------------------------------------------------------------------------
+# sort-lane construction
+# ---------------------------------------------------------------------------
+
+
+def _pack_plan(dtypes, key_ordinals, key_ranges):
+    """Static decision: MAY every key pack into one integer lane?
+    Returns the validated per-key ranges (all present, all discrete
+    types) or None. The caller derives cards/strides/lane width from
+    them — and still falls back to the generic lanes if the cardinality
+    product overflows int64."""
+    if key_ranges is None or len(key_ranges) != len(key_ordinals):
+        return None
+    if not key_ordinals:
+        return None
+    for r, o in zip(key_ranges, key_ordinals):
+        if r is None:
+            return None
+        if not (dtypes[o].is_integral or dtypes[o] in
+                (dt.DATE, dt.TIMESTAMP, dt.BOOLEAN, dt.STRING)):
+            return None
+    return key_ranges
+
+
+def _equality_lanes(d, v, dtype):
+    """Sort-key lanes for one UNPACKED key column, every lane directly
+    equality-comparable row-to-row (floats contribute a NaN-zeroed value
+    plus an isnan flag so NaN==NaN without bitcasts)."""
+    valid = v if v is not None else None
+    if dtype.is_floating:
+        x = d + jnp.zeros((), d.dtype)  # -0.0 -> +0.0
+        isn = jnp.isnan(x)
+        if valid is not None:
+            isn = isn & valid
+            x = jnp.where(valid, x, jnp.zeros((), x.dtype))
+        x = jnp.where(isn, jnp.zeros((), x.dtype), x)
+        return [x, isn]
+    k = d.astype(jnp.int8) if dtype is dt.BOOLEAN else d
+    if valid is not None:
+        k = jnp.where(valid, k, jnp.zeros((), k.dtype))
+    return [k]
+
+
+def _shift1(x):
+    """x shifted down one row: out[i] = x[i-1], out[0] = 0."""
+    z = jnp.zeros((1,), x.dtype)
+    return jnp.concatenate([z, x[:-1]])
+
+
+def _cumsum_isolated(x):
+    """cumsum fenced from fusion: the TPU reduce-window lowering of a
+    wide (i64/f64 = 32-bit pair) prefix sum exceeds the 16 MiB scoped
+    VMEM limit when neighbouring ops fuse into it at multi-million-row
+    shapes. Standalone it compiles and runs fine (~30-44 ms at 4M rows on
+    a v5e), so barrier it off instead of lowering the whole program's
+    fusion level."""
+    x = jax.lax.optimization_barrier(x)
+    return jax.lax.optimization_barrier(jnp.cumsum(x))
+
+
+@partial(jax.jit, static_argnames=("dtypes", "key_ordinals", "aggs",
+                                   "key_ranges"))
 def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
-             live_mask=None):
+             live_mask=None, key_ranges=None):
     """``live_mask``: optional fused filter — masked-out rows are dead
     (they sort last with the padding and never reach a segment)."""
     capacity = cols[0][0].shape[0]
-    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
-    prefix_rows = num_rows  # PRE-mask count: the sort pads positionally
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    live = iota < num_rows
     if live_mask is not None:
         live = live & live_mask
         num_rows = jnp.sum(live).astype(jnp.int32)
 
-    # 1. sort by keys (ascending, nulls first — any consistent order
-    # works); every column's data+validity rides THROUGH the variadic
-    # sort as payload lanes, so there are no per-column permutation
-    # gathers afterwards
-    specs = [SortKeySpec(o, True, True) for o in key_ordinals]
-    payloads = [d for d, _ in cols] + \
-               [v for _, v in cols if v is not None]
-    sorted_flat = sortkeys.sort_with_payloads(
-        list(cols), list(dtypes), specs, prefix_rows, payloads,
-        live_mask=live_mask)
-    sorted_d = sorted_flat[:len(cols)]
-    rest = sorted_flat[len(cols):]
-    sorted_cols = []
-    for i, (_, v) in enumerate(cols):
-        sv = rest.pop(0) if v is not None else None
-        sorted_cols.append((sorted_d[i], sv))
-    # live rows are a prefix after the pad-last sort
-    live_sorted = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+    ranges = _pack_plan(dtypes, key_ordinals, key_ranges)
+    key_has_v = tuple(cols[o][1] is not None for o in key_ordinals)
 
-    # 2. boundaries: any normalized key differs from previous row
+    # ---- 1. sort-key lanes ------------------------------------------------
+    packed = None
+    key_lane_slices = []  # per key: (start, count) into sort_keys
+    if ranges is not None:
+        cards = []
+        for (lo, hi), has_v in zip(ranges, key_has_v):
+            cards.append((hi - lo + 1) + (1 if has_v else 0))
+        total = 1
+        for c in cards:
+            total *= max(c, 1)
+        if total + 1 <= 0x7FFFFFFF:
+            lane_dt = jnp.int32
+        elif total + 1 <= (1 << 62):
+            lane_dt = jnp.int64
+        else:
+            ranges = None
+    if ranges is not None:
+        pack = jnp.zeros(capacity, dtype=lane_dt)
+        strides = []
+        stride = 1
+        for card in reversed(cards):
+            strides.append(stride)
+            stride *= max(card, 1)
+        strides.reverse()
+        for (lo, hi), has_v, strd, o in zip(ranges, key_has_v, strides,
+                                            key_ordinals):
+            d, v = cols[o]
+            # subtract the range base BEFORE narrowing: int64 keys with a
+            # small span but large magnitude must not wrap
+            dd = d.astype(jnp.int32) if dtypes[o] is dt.BOOLEAN else d
+            code = (dd - jnp.asarray(lo, dd.dtype)).astype(lane_dt)
+            if has_v:
+                code = jnp.where(v, code + 1, jnp.zeros((), lane_dt))
+            pack = pack + code * lane_dt(strd)
+        sentinel = lane_dt(total)
+        packed = jnp.where(live, pack, sentinel)
+        sort_keys = [packed]
+    else:
+        rank = (~live).astype(jnp.int32)
+        for o, has_v in zip(key_ordinals, key_has_v):
+            if has_v:
+                # valid rows rank 1: nulls group FIRST (matching the
+                # packed path's reserved 0 slot and Spark's ASC default)
+                rank = (rank << 1) | cols[o][1].astype(jnp.int32)
+        sort_keys = [rank]
+        for o in key_ordinals:
+            d, v = cols[o]
+            lanes = _equality_lanes(d, v, dtypes[o])
+            key_lane_slices.append((len(sort_keys), len(lanes)))
+            sort_keys.extend(lanes)
+
+    # ---- 2. payload lanes: agg-input columns not derivable from keys ------
+    key_set = set(key_ordinals)
+    needed = []
+    for spec in aggs:
+        if spec.ordinal >= 0 and spec.ordinal not in key_set and \
+                spec.ordinal not in needed:
+            needed.append(spec.ordinal)
+    payloads = []
+    for o in needed:
+        d, v = cols[o]
+        payloads.append(d)
+        if v is not None:
+            payloads.append(v)
+
+    out = jax.lax.sort(tuple(sort_keys) + tuple(payloads),
+                       num_keys=len(sort_keys), is_stable=True)
+    s_keys = out[:len(sort_keys)]
+    rest = list(out[len(sort_keys):])
+    sorted_cols = {}
+    for o in needed:
+        d = rest.pop(0)
+        v = rest.pop(0) if cols[o][1] is not None else None
+        sorted_cols[o] = (d, v)
+
+    # reconstruct key columns (data, validity) in sorted order from the
+    # sort lanes themselves — key columns never ride as payloads
+    if ranges is not None:
+        sp = s_keys[0]
+        for ki, o in enumerate(key_ordinals):
+            code = (sp // lane_dt(strides[ki])) % lane_dt(
+                max(cards[ki], 1))
+            if key_has_v[ki]:
+                kv = code > 0
+                kd = (code - 1 + lane_dt(ranges[ki][0]))
+            else:
+                kv = None
+                kd = code + lane_dt(ranges[ki][0])
+            kd = kd.astype(cols[o][0].dtype)
+            if dtypes[o] is dt.BOOLEAN:
+                kd = kd.astype(jnp.bool_)
+            sorted_cols[o] = (kd, kv)
+    else:
+        s_rank = s_keys[0]
+        nbits = sum(1 for h in key_has_v if h)
+        bit = nbits
+        for ki, o in enumerate(key_ordinals):
+            start, cnt = key_lane_slices[ki]
+            if dtypes[o].is_floating:
+                val, isn = s_keys[start], s_keys[start + 1]
+                kd = jnp.where(isn, jnp.asarray(jnp.nan, val.dtype), val)
+            else:
+                kd = s_keys[start]
+                if dtypes[o] is dt.BOOLEAN:
+                    kd = kd.astype(jnp.bool_)
+            if key_has_v[ki]:
+                bit -= 1
+                kv = ((s_rank >> bit) & 1) == 1
+            else:
+                kv = None
+            sorted_cols[o] = (kd, kv)
+
+    live_sorted = iota < num_rows
+
+    # ---- 3. boundaries ----------------------------------------------------
+    def lane_diff(lane):
+        return jnp.concatenate(
+            [jnp.ones(1, dtype=bool), lane[1:] != lane[:-1]])
+
     boundary = jnp.zeros(capacity, dtype=bool).at[0].set(True)
-    for o in key_ordinals:
-        d, v = sorted_cols[o]
-        comps, valid = sortkeys.equality_parts(d, v, dtypes[o])
-        for comp in comps:
-            boundary = boundary | jnp.concatenate(
-                [jnp.ones(1, dtype=bool), comp[1:] != comp[:-1]])
-        boundary = boundary | jnp.concatenate(
-            [jnp.ones(1, dtype=bool), valid[1:] != valid[:-1]])
+    if ranges is not None:
+        boundary = boundary | lane_diff(s_keys[0])
+    else:
+        for lane in s_keys:
+            boundary = boundary | lane_diff(lane)
     boundary = boundary & live_sorted
-
     num_groups = jnp.sum(boundary).astype(jnp.int32)
 
-    # boundary row index of each segment: stable argsort of ~boundary is
-    # exactly nonzero-in-order, without the scatter nonzero() lowers to
-    first_idx = jnp.argsort(~boundary, stable=True).astype(jnp.int32)
-    giota = jnp.arange(capacity, dtype=jnp.int32)
-    group_live_ = giota < num_groups
-    next_first = jnp.where(giota < num_groups - 1,
-                           jnp.roll(first_idx, -1), num_rows)
-    seg_sizes = jnp.where(group_live_,
-                          next_first.astype(jnp.int32) - first_idx, 0)
-    last_idx = first_idx + jnp.maximum(seg_sizes, 1) - 1
+    # ---- 4. aggregate tails (fast vs Inf/NaN-safe float sums) -------------
+    # Per float sum: the masked value array and its prefix sum, computed
+    # ONCE (shared by the predicate and the fast tail). The predicate is
+    # simply isfinite(grand total): Inf is sticky under addition of
+    # finite values and NaN propagates, so a finite total proves both
+    # (a) no Inf/NaN input contributed and (b) no prefix of the running
+    # sum overflowed — either would poison cumsum DIFFS across segment
+    # edges. The safe tail abandons cumsum diffs for a per-segment scan,
+    # which is IEEE-exact no matter what.
+    fs_lanes = {}
+    for si, spec in enumerate(aggs):
+        if spec.op in ("sum", "sum_of_squares") and spec.ordinal >= 0 \
+                and dtypes[spec.ordinal].is_floating:
+            d, v = sorted_cols[spec.ordinal]
+            contrib = live_sorted if v is None else (v & live_sorted)
+            x = d.astype(jnp.float64)
+            if spec.op == "sum_of_squares":
+                x = x * x
+            xm = jnp.where(contrib, x, 0.0)
+            fs_lanes[si] = (xm, _cumsum_isolated(xm))
 
-    # 3. keys: gather first row of each segment
-    key_d, key_v = [], []
-    group_live = jnp.arange(capacity, dtype=jnp.int32) < num_groups
+    def tail(safe: bool):
+        return _segments_tail(
+            sorted_cols, dtypes, key_ordinals, aggs, boundary,
+            live_sorted, num_rows, num_groups, capacity, safe, fs_lanes)
+
+    if fs_lanes:
+        allfin = jnp.bool_(True)
+        for xm, cs in fs_lanes.values():
+            allfin = allfin & jnp.isfinite(cs[-1])
+        flat = jax.lax.cond(allfin, lambda: tail(False),
+                            lambda: tail(True))
+    else:
+        flat = tail(False)
+    key_d, key_v_arr, agg_d, agg_v_arr = flat
+
+    key_v = [key_v_arr[i] if key_has_v[i] else None
+             for i in range(len(key_ordinals))]
+    # counts are never null (reference: CudfCount merges to 0, not null)
+    agg_v = [None if spec.op in ("count", "count_star") else agg_v_arr[i]
+             for i, spec in enumerate(aggs)]
+    return (list(key_d), key_v), (list(agg_d), agg_v), num_groups
+
+
+def _segments_tail(sorted_cols, dtypes, key_ordinals, aggs, boundary,
+                   live_sorted, num_rows, num_groups, capacity,
+                   safe: bool, fs_lanes):
+    """Row-space lanes -> ONE compaction sort -> group-space arithmetic.
+    ``fs_lanes``: per-float-sum (masked values, prefix sums), precomputed
+    in the caller; the fast tail consumes the prefix sums, the safe tail
+    replaces them with per-segment scans. Returns (key_d, key_v_arrays,
+    agg_d, agg_v_arrays) with validity as plain bool arrays (the caller
+    maps Nones back — lax.cond branches must return identical pytrees)."""
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+
+    # ---- row-space lanes per aggregate
+    # each entry: (kind, lanes...) consumed positionally after compaction
+    lane_specs = []   # static description
+    lanes = []        # arrays riding the compaction sort
+
+    def add_lane(x):
+        lanes.append(x)
+        return len(lanes) - 1
+
+    def contrib_of(o):
+        d, v = sorted_cols[o]
+        return live_sorted if v is None else (v & live_sorted)
+
+    count_lane_of = {}
+
+    def ensure_count_lane(o):
+        """Segment valid-count via i32 cumsum (exact: counts <= capacity
+        < 2^31). Returns (lane index, grand total) — or (None, None) when
+        the column has no validity: live rows are a prefix after the sort,
+        so the valid count IS the segment size (no cumsum, no lane)."""
+        if sorted_cols[o][1] is None:
+            return (None, None)
+        if o not in count_lane_of:
+            cs = _cumsum_isolated(contrib_of(o).astype(jnp.int32))
+            count_lane_of[o] = (add_lane(_shift1(cs)), cs[-1])
+        return count_lane_of[o]
+
+    for si, spec in enumerate(aggs):
+        if spec.op == "count_star":
+            lane_specs.append(("sizes",))
+            continue
+        o = spec.ordinal
+        d, v = sorted_cols[o]
+        contrib = contrib_of(o)
+        valid_arr = v if v is not None else live_sorted
+        if spec.op == "count":
+            idx, tot = ensure_count_lane(o)
+            if idx is None:
+                lane_specs.append(("sizes",))
+            else:
+                lane_specs.append(("count", idx, tot))
+        elif spec.op == "sum" and not dtypes[o].is_floating:
+            x = jnp.where(contrib, d.astype(jnp.int64),
+                          jnp.zeros((), jnp.int64))
+            cs = _cumsum_isolated(x)
+            idx = add_lane(_shift1(cs))
+            cidx, ctot = ensure_count_lane(o)
+            lane_specs.append(("isum", idx, cs[-1], cidx, ctot))
+        elif spec.op in ("sum", "sum_of_squares"):
+            xm, cs = fs_lanes[si]
+            cidx, ctot = ensure_count_lane(o)
+            if safe:
+                # per-segment inclusive scan: IEEE-exact under Inf/NaN
+                # INPUTS and under running-total overflow of all-finite
+                # inputs — either poisons global cumsum diffs, and the
+                # caller's isfinite(total) predicate routes both here
+                scan = _seg_scan(xm, boundary, jnp.add)
+                sidx = add_lane(_shift1(scan))
+                last = jax.lax.dynamic_index_in_dim(
+                    scan, jnp.maximum(num_rows - 1, 0), keepdims=False)
+                lane_specs.append(("scan", sidx, last, cidx, ctot,
+                                   False))
+            else:
+                idx = add_lane(_shift1(cs))
+                lane_specs.append(("fsum", idx, cs[-1], cidx, ctot))
+        elif spec.op in ("min", "max"):
+            in_t = dtypes[o]
+            kd = d.dtype
+            dd = d
+            if in_t is dt.BOOLEAN:
+                dd = d.astype(jnp.int8)
+                kd = jnp.int8
+            if in_t.is_floating:
+                big = jnp.asarray(jnp.inf, kd)
+                small = jnp.asarray(-jnp.inf, kd)
+            elif in_t is dt.BOOLEAN:
+                big, small = jnp.asarray(1, kd), jnp.asarray(0, kd)
+            else:
+                big = jnp.asarray(jnp.iinfo(kd).max, kd)
+                small = jnp.asarray(jnp.iinfo(kd).min, kd)
+            if spec.op == "min":
+                x = jnp.where(contrib, dd, big)
+                scan = _seg_scan(x, boundary, jnp.minimum)
+            else:
+                x = jnp.where(contrib, dd, small)
+                scan = _seg_scan(x, boundary, jnp.maximum)
+            sidx = add_lane(_shift1(scan))
+            last = jax.lax.dynamic_index_in_dim(
+                scan, jnp.maximum(num_rows - 1, 0), keepdims=False)
+            cidx, ctot = ensure_count_lane(o)
+            lane_specs.append(("scan", sidx, last, cidx, ctot,
+                               dtypes[o] is dt.BOOLEAN))
+        elif spec.op in ("first", "any_valid"):
+            didx = add_lane(d)
+            vidx = add_lane(valid_arr)
+            cidx, ctot = ensure_count_lane(o) if spec.op == "any_valid" \
+                else (None, None)
+            lane_specs.append(("first", didx, vidx, spec.op, cidx, ctot))
+        elif spec.op == "last":
+            didx = add_lane(_shift1(d))
+            vidx = add_lane(_shift1(valid_arr))
+            dlast = jax.lax.dynamic_index_in_dim(
+                d, jnp.maximum(num_rows - 1, 0), keepdims=False)
+            vlast = jax.lax.dynamic_index_in_dim(
+                valid_arr, jnp.maximum(num_rows - 1, 0), keepdims=False)
+            lane_specs.append(("last", didx, vidx, dlast, vlast))
+        else:
+            raise ValueError(f"unknown aggregate op {spec.op}")
+
+    # key output lanes
+    key_lane_idx = []
     for o in key_ordinals:
         d, v = sorted_cols[o]
-        key_d.append(jnp.take(d, first_idx))
-        if v is None:
-            key_v.append(None)
-        else:
-            key_v.append(jnp.take(v, first_idx) & group_live)
+        di = add_lane(d)
+        vi = add_lane(v) if v is not None else None
+        key_lane_idx.append((di, vi))
 
-    # 4. aggregates
+    # ---- ONE compaction sort: boundary rows to a group prefix
+    packed = jax.lax.sort(
+        ((~boundary),) + (iota,) + tuple(lanes), num_keys=1,
+        is_stable=True)
+    first_idx = packed[1]
+    c = list(packed[2:])  # compacted lanes, group g at row g
+
+    giota = iota
+    glive = giota < num_groups
+    is_last_group = giota == (num_groups - 1)
+
+    def roll_next(x, last_value):
+        """x[g+1] for g < ng-1; ``last_value`` for the final group."""
+        nxt = jnp.roll(x, -1)
+        return jnp.where(is_last_group,
+                         jnp.asarray(last_value, x.dtype), nxt)
+
+    next_first = roll_next(first_idx, num_rows)
+    seg_sizes = jnp.where(glive, next_first - first_idx, 0)
+
+    def nvalid_of(cidx, ctot):
+        """Per-group valid count: cumsum-lane diff, or the segment size
+        when the input had no validity lane."""
+        if cidx is None:
+            return seg_sizes
+        clo = c[cidx]
+        return roll_next(clo, ctot) - clo
+
+    # ---- group-space decode
     agg_d, agg_v = [], []
-    for spec in aggs:
-        d_out, v_out = _one_agg(spec, sorted_cols, dtypes, boundary,
-                                live_sorted, first_idx, last_idx,
-                                seg_sizes, capacity)
-        agg_d.append(d_out)
-        agg_v.append(None if v_out is None else v_out & group_live)
-    return (key_d, key_v), (agg_d, agg_v), num_groups
+    for ls in lane_specs:
+        kind = ls[0]
+        if kind == "sizes":
+            agg_d.append(seg_sizes.astype(jnp.int64))
+            agg_v.append(glive)
+            continue
+        if kind == "count":
+            _, idx, tot = ls
+            lo = c[idx]
+            n = roll_next(lo, tot) - lo
+            agg_d.append(n.astype(jnp.int64))
+            agg_v.append(glive)
+            continue
+        if kind == "isum":
+            _, idx, tot, cidx, ctot = ls
+            lo = c[idx]
+            s = roll_next(lo, tot) - lo
+            nvalid = nvalid_of(cidx, ctot)
+            agg_d.append(s)
+            agg_v.append(glive & (nvalid > 0))
+            continue
+        if kind == "fsum":
+            _, idx, tot, cidx, ctot = ls
+            lo = c[idx]
+            s = roll_next(lo, tot) - lo
+            nvalid = nvalid_of(cidx, ctot)
+            agg_d.append(s)
+            agg_v.append(glive & (nvalid > 0))
+            continue
+        if kind == "scan":
+            _, sidx, last, cidx, ctot, was_bool = ls
+            vals = roll_next(c[sidx], last)
+            if was_bool:
+                vals = vals.astype(jnp.bool_)
+            nvalid = nvalid_of(cidx, ctot)
+            agg_d.append(vals)
+            agg_v.append(glive & (nvalid > 0))
+            continue
+        if kind == "first":
+            _, didx, vidx, op, cidx, ctot = ls
+            agg_d.append(c[didx])
+            if op == "any_valid":
+                nvalid = nvalid_of(cidx, ctot)
+                agg_v.append(glive & (nvalid > 0))
+            else:
+                agg_v.append(glive & c[vidx] & (seg_sizes > 0))
+            continue
+        if kind == "last":
+            _, didx, vidx, dlast, vlast = ls
+            agg_d.append(roll_next(c[didx], dlast))
+            agg_v.append(glive & roll_next(c[vidx], vlast) &
+                         (seg_sizes > 0))
+            continue
 
-
-def _seg_sum_by_bounds(x: jax.Array, first_idx: jax.Array,
-                       last_idx: jax.Array) -> jax.Array:
-    """Per-segment sum over contiguous runs as cumsum differences — exact
-    for integers even through wrap-around; float results are an ordinary
-    reordered sum."""
-    cs = jnp.cumsum(x)
-    hi = jnp.take(cs, last_idx)
-    lo = jnp.where(first_idx > 0,
-                   jnp.take(cs, jnp.maximum(first_idx - 1, 0)),
-                   jnp.zeros((), cs.dtype))
-    return hi - lo
+    key_d, key_v = [], []
+    for (di, vi) in key_lane_idx:
+        key_d.append(c[di])
+        key_v.append((c[vi] & glive) if vi is not None else glive)
+    return tuple(key_d), tuple(key_v), tuple(agg_d), tuple(agg_v)
 
 
 def _seg_scan(x: jax.Array, boundary: jax.Array, op) -> jax.Array:
@@ -196,77 +603,9 @@ def _seg_scan(x: jax.Array, boundary: jax.Array, op) -> jax.Array:
     return v
 
 
-def _one_agg(spec: AggSpec, sorted_cols, dtypes, boundary, live,
-             first_idx, last_idx, seg_sizes, capacity):
-    if spec.op == "count_star":
-        return seg_sizes.astype(jnp.int64), None
-
-    d, v = sorted_cols[spec.ordinal]
-    valid = v if v is not None else jnp.ones(capacity, dtype=bool)
-    contrib = valid & live
-    n_valid = _seg_sum_by_bounds(contrib.astype(jnp.int64), first_idx,
-                                 last_idx)
-
-    if spec.op == "count":
-        return n_valid, None
-    # first/last over an empty segment (reduction over 0 rows) must be NULL,
-    # so validity is always materialized and ANDed with segment non-emptiness
-    if spec.op == "first":
-        out = jnp.take(d, first_idx)
-        ov = jnp.take(valid, first_idx) if v is not None \
-            else jnp.ones(capacity, dtype=bool)
-        return out, ov & (seg_sizes > 0)
-    if spec.op == "last":
-        out = jnp.take(d, last_idx)
-        ov = jnp.take(valid, last_idx) if v is not None \
-            else jnp.ones(capacity, dtype=bool)
-        return out, ov & (seg_sizes > 0)
-
-    out_valid = n_valid > 0
-    in_t = dtypes[spec.ordinal]
-    if spec.op == "sum":
-        if in_t.is_integral or in_t is dt.BOOLEAN:
-            x = jnp.where(contrib, d.astype(jnp.int64),
-                          jnp.zeros((), jnp.int64))
-            return _seg_sum_by_bounds(x, first_idx, last_idx), out_valid
-        # floats: cumsum differences would poison later segments with
-        # NaN once any segment holds ±Inf (Inf - Inf); the segmented
-        # scan keeps Inf/NaN confined to their own segment
-        x = jnp.where(contrib, d.astype(jnp.float64), 0.0)
-        scan = _seg_scan(x, boundary, jnp.add)
-        return jnp.take(scan, last_idx), out_valid
-    if spec.op == "sum_of_squares":
-        x = d.astype(jnp.float64)
-        x = jnp.where(contrib, x * x, 0.0)
-        scan = _seg_scan(x, boundary, jnp.add)
-        return jnp.take(scan, last_idx), out_valid
-    if spec.op in ("min", "max"):
-        kd = d.dtype
-        if in_t.is_floating:
-            big = jnp.asarray(jnp.inf, kd)
-        elif in_t is dt.BOOLEAN:
-            d = d.astype(jnp.int8)
-            kd = jnp.int8
-            big = jnp.asarray(1, kd)
-        else:
-            big = jnp.asarray(jnp.iinfo(kd).max, kd)
-        if spec.op == "min":
-            x = jnp.where(contrib, d, big)
-            scan = _seg_scan(x, boundary, jnp.minimum)
-        else:
-            small = -big if in_t.is_floating else \
-                jnp.asarray(0, kd) if in_t is dt.BOOLEAN else \
-                jnp.asarray(jnp.iinfo(kd).min, kd)
-            x = jnp.where(contrib, d, small)
-            scan = _seg_scan(x, boundary, jnp.maximum)
-        r = jnp.take(scan, last_idx)
-        if in_t is dt.BOOLEAN:
-            r = r.astype(jnp.bool_)
-        return r, out_valid
-    if spec.op == "any_valid":
-        out = jnp.take(d, first_idx)
-        return out, out_valid
-    raise ValueError(f"unknown aggregate op {spec.op}")
+# ---------------------------------------------------------------------------
+# whole-batch reductions (no keys)
+# ---------------------------------------------------------------------------
 
 
 def reduce_aggregate(batch: ColumnarBatch, aggs: List[AggSpec],
@@ -300,30 +639,92 @@ def reduce_aggregate(batch: ColumnarBatch, aggs: List[AggSpec],
 
 @partial(jax.jit, static_argnames=("dtypes", "aggs"))
 def _reduce(cols, dtypes, aggs, num_rows, live_mask=None):
+    """Direct whole-array reductions — no sort, no segments. IEEE
+    semantics (Inf/NaN) come straight from jnp reductions."""
     capacity = cols[0][0].shape[0] if cols else 128
     iota = jnp.arange(capacity, dtype=jnp.int32)
     live = iota < num_rows
     if live_mask is not None:
         live = live & live_mask
-    # reuse the segmented kernel with a single segment starting at row 0.
-    # With a fused live_mask the live rows need not be a prefix, so the
-    # boundary rows are the first/last LIVE positions.
-    boundary = iota == 0
-    n_live = jnp.sum(live.astype(jnp.int32)).astype(jnp.int32)
-    first_live = jnp.argmax(live).astype(jnp.int32)
-    last_live = (capacity - 1 -
-                 jnp.argmax(live[::-1])).astype(jnp.int32)
+    n_live = jnp.sum(live.astype(jnp.int32))
     any_live = n_live > 0
-    first_idx = jnp.where(any_live, first_live, 0) * \
-        jnp.ones(capacity, jnp.int32)
-    last_idx = jnp.where(any_live, last_live, 0) * \
-        jnp.ones(capacity, jnp.int32)
-    seg_sizes = jnp.zeros(capacity, jnp.int32).at[0].set(n_live)
+    first_live = jnp.where(any_live, jnp.argmax(live).astype(jnp.int32), 0)
+    last_live = jnp.where(
+        any_live,
+        (capacity - 1 - jnp.argmax(live[::-1])).astype(jnp.int32), 0)
+
+    def full(x):
+        return jnp.full(capacity, x)
+
     agg_d, agg_v = [], []
     for spec in aggs:
-        d_out, v_out = _one_agg(spec, list(cols), dtypes, boundary, live,
-                                first_idx, last_idx, seg_sizes, capacity)
-        # only slot 0 is meaningful; broadcast capacity stays bucketed
-        agg_d.append(d_out)
-        agg_v.append(v_out)
+        if spec.op == "count_star":
+            agg_d.append(full(n_live.astype(jnp.int64)))
+            agg_v.append(None)
+            continue
+        d, v = cols[spec.ordinal]
+        valid = v if v is not None else jnp.ones(capacity, dtype=bool)
+        contrib = valid & live
+        n_valid = jnp.sum(contrib.astype(jnp.int64))
+        out_valid = full(n_valid > 0)
+        in_t = dtypes[spec.ordinal]
+        if spec.op == "count":
+            agg_d.append(full(n_valid))
+            agg_v.append(None)
+        elif spec.op == "sum":
+            if in_t.is_integral or in_t is dt.BOOLEAN:
+                x = jnp.where(contrib, d.astype(jnp.int64),
+                              jnp.zeros((), jnp.int64))
+                agg_d.append(full(jnp.sum(x)))
+            else:
+                x = jnp.where(contrib, d.astype(jnp.float64), 0.0)
+                agg_d.append(full(jnp.sum(x)))
+            agg_v.append(out_valid)
+        elif spec.op == "sum_of_squares":
+            x = d.astype(jnp.float64)
+            x = jnp.where(contrib, x * x, 0.0)
+            agg_d.append(full(jnp.sum(x)))
+            agg_v.append(out_valid)
+        elif spec.op in ("min", "max"):
+            kd = d.dtype
+            dd = d
+            if in_t is dt.BOOLEAN:
+                dd = d.astype(jnp.int8)
+                kd = jnp.int8
+            if in_t.is_floating:
+                big = jnp.asarray(jnp.inf, kd)
+            elif in_t is dt.BOOLEAN:
+                big = jnp.asarray(1, kd)
+            else:
+                big = jnp.asarray(jnp.iinfo(kd).max, kd)
+            if spec.op == "min":
+                r = jnp.min(jnp.where(contrib, dd, big))
+            else:
+                small = -big if in_t.is_floating else \
+                    jnp.asarray(0, kd) if in_t is dt.BOOLEAN else \
+                    jnp.asarray(jnp.iinfo(kd).min, kd)
+                r = jnp.max(jnp.where(contrib, dd, small))
+            if in_t is dt.BOOLEAN:
+                r = r.astype(jnp.bool_)
+            agg_d.append(full(r))
+            agg_v.append(out_valid)
+        elif spec.op in ("first", "any_valid"):
+            val = jax.lax.dynamic_index_in_dim(d, first_live,
+                                               keepdims=False)
+            agg_d.append(full(val))
+            if spec.op == "any_valid":
+                agg_v.append(out_valid)
+            else:
+                fv = jax.lax.dynamic_index_in_dim(valid, first_live,
+                                                  keepdims=False)
+                agg_v.append(full(fv & any_live))
+        elif spec.op == "last":
+            val = jax.lax.dynamic_index_in_dim(d, last_live,
+                                               keepdims=False)
+            lv = jax.lax.dynamic_index_in_dim(valid, last_live,
+                                              keepdims=False)
+            agg_d.append(full(val))
+            agg_v.append(full(lv & any_live))
+        else:
+            raise ValueError(f"unknown aggregate op {spec.op}")
     return agg_d, agg_v

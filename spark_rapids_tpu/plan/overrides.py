@@ -34,6 +34,12 @@ from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression, Literal)
 from spark_rapids_tpu.plan import nodes as pn
 
+
+def _session_mesh(conf):
+    from spark_rapids_tpu.parallel.mesh import session_mesh
+
+    return session_mesh(conf)
+
 # ---------------------------------------------------------------------------
 # Expression rule registry (ExprRule analogue, GpuOverrides.scala:536-1621)
 # ---------------------------------------------------------------------------
@@ -405,6 +411,14 @@ class _AggregateRule(NodeRule):
             return agg_exec.HashAggregateExec(
                 node.grouping, node.aggs, child, out_schema,
                 mode=node.mode, conf=meta.conf, fused_filter=ff)
+        mesh = _session_mesh(meta.conf)
+        if mesh is not None and node.grouping:
+            # mesh lowering: the partial/exchange/final pipeline becomes
+            # one all_to_all + local-groupby program per chip
+            from spark_rapids_tpu.parallel.execs import MeshGroupByExec
+
+            return MeshGroupByExec(node.grouping, node.aggs, child,
+                                   out_schema, meta.conf, mesh)
         if child.num_partitions == 1:
             child, ff = self._fuse_filter(child)
             return agg_exec.HashAggregateExec(
@@ -572,6 +586,13 @@ class _JoinRule(NodeRule):
 
     @staticmethod
     def _plan(meta, kind, left, right, lk, rk, cond, out_schema):
+        mesh = _session_mesh(meta.conf)
+        if mesh is not None and lk and kind in ("inner", "left",
+                                                "left_semi", "left_anti"):
+            from spark_rapids_tpu.parallel.execs import MeshShuffledJoinExec
+
+            return MeshShuffledJoinExec(kind, left, right, lk, rk,
+                                        out_schema, cond, meta.conf, mesh)
         multi = left.num_partitions > 1 or right.num_partitions > 1
         if kind == "cross":
             # brute-force joins: nested-loop when the right side is already

@@ -372,3 +372,59 @@ def test_groupby_live_mask_fused_filter():
     np.testing.assert_array_equal(got_keys[order], expect.index.values)
     np.testing.assert_allclose(got_sums[order], expect["s"], rtol=1e-9)
     np.testing.assert_array_equal(got_cnts[order], expect["c"])
+
+
+# -------------------------------------------- float-sum IEEE edge cases
+
+def test_groupby_float_sum_running_total_overflow_confined():
+    """All-finite inputs whose RUNNING total overflows must not poison
+    later groups: the isfinite(grand total) predicate routes to the
+    per-segment-scan tail (cumsum diffs would give inf-inf = NaN)."""
+    keys = np.array([0, 0, 1], dtype=np.int64)
+    vals = np.array([1.5e308, 1.5e308, 1.0])
+    batch = make_batch(keys, vals)
+    out, _ = groupby.groupby_aggregate(batch, [0], [AggSpec("sum", 1)],
+                                       [dt.INT64, dt.FLOAT64])
+    sums, _ = out.columns[1].to_numpy(2)
+    assert np.isinf(sums[0]) and sums[0] > 0
+    assert sums[1] == 1.0
+
+
+def test_groupby_sum_of_squares_square_overflow():
+    """A finite input whose SQUARE overflows must produce +inf, not be
+    silently dropped (the predicate must test the squared lane)."""
+    keys = np.array([0, 0, 0, 0], dtype=np.int64)
+    vals = np.array([1e200, 1.0, 2.0, 3.0])
+    batch = make_batch(keys, vals)
+    out, _ = groupby.groupby_aggregate(
+        batch, [0], [AggSpec("sum_of_squares", 1)],
+        [dt.INT64, dt.FLOAT64])
+    sums, _ = out.columns[1].to_numpy(1)
+    assert np.isinf(sums[0]) and sums[0] > 0
+
+
+def test_groupby_stats_survive_projection_and_pack():
+    """Upload-time int stats flow through a passthrough projection into
+    the groupby (packed-key path) without changing results."""
+    from spark_rapids_tpu.ops.groupby import key_range_of
+
+    from spark_rapids_tpu.api import Session, col, functions as F
+    import pandas as pd
+
+    pdf = pd.DataFrame({"k": np.array([5, 7, 5, 9], dtype=np.int64),
+                        "v": [1.0, 2.0, 3.0, 4.0]})
+    s = Session()
+    df = s.create_dataframe(pdf)
+    got = df.group_by("k").agg(F.sum(col("v")).alias("sv")).collect()
+    got = got.sort_values("k").reset_index(drop=True)
+    assert got["k"].tolist() == [5, 7, 9]
+    assert got["sv"].tolist() == [4.0, 2.0, 4.0]
+
+    # and the stats themselves exist at the scan boundary
+    from spark_rapids_tpu.execs.interop import host_to_batch
+    from spark_rapids_tpu.columnar.batch import Schema
+
+    b = host_to_batch({"k": pdf["k"].to_numpy()}, {},
+                      Schema(["k"], [dt.INT64]))
+    assert b.columns[0].stats == (5, 9)
+    assert key_range_of(b.columns[0], dt.INT64) == (5, 9)
