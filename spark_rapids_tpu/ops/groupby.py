@@ -22,13 +22,16 @@ is fast, so the TPU-native plan is:
   5. segment aggregates become roll/subtract arithmetic on the compacted
      lanes; the group count stays a device scalar (no host sync).
 
-Float sums are IEEE-exact without paying for it when data is benign: the
-predicate isfinite(grand total) selects (lax.cond, one HLO conditional)
-between the cumsum-diff tail and a per-segment-scan tail. Inf is sticky
-under addition of finite values and NaN propagates, so a finite total
-proves no Inf/NaN input contributed AND no prefix of the running sum
-overflowed — either would poison cumsum diffs across segment edges (the
-overflow case poisons them even with every input finite).
+Float sums always use the per-segment scan (never global cumsum diffs):
+a global prefix sum's diffs carry rounding error that scales with the
+running prefix of OTHER groups — catastrophic cancellation when a huge
+group precedes a tiny one — and Inf/NaN inputs poison every later
+segment. The segmented scan confines both error and poison to the group
+they belong to, matching the reference's per-group hash aggregation
+error behavior (cuDF groupBy.aggregate). Integer sums and counts keep
+exact cumsum diffs (wrap-exact for ints). Keeping one unconditional tail
+(no lax.cond) also halves the compiled program vs a dual-branch design —
+compile time over the tunnel is a first-class cost.
 
 TPU scatter (segment_sum et al.) measured ~30x slower than cumsum at 4M
 rows — no scatters appear anywhere on this path.
@@ -50,9 +53,14 @@ from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import Column, StringColumn
 
-# Aggregate op names understood by the kernel.
+# Aggregate op names understood by the kernel. ``m2`` is the exact
+# per-group centered second moment sum((x - group_mean)^2) — computed
+# shifted by the group's first value so no large-magnitude cancellation
+# occurs (variance/stddev building block; Spark's CentralMomentAgg /
+# cuDF variance role). ``rterm`` is the Konig merge-correction term
+# (sum x)^2 / n that lets m2 partials merge by plain addition.
 AGG_OPS = ("sum", "min", "max", "count", "count_star", "first", "last",
-           "any_valid", "sum_of_squares")
+           "any_valid", "sum_of_squares", "m2", "rterm")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,13 +292,18 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
         for ki, o in enumerate(key_ordinals):
             code = (sp // lane_dt(strides[ki])) % lane_dt(
                 max(cards[ki], 1))
+            # widen to the column dtype BEFORE adding the range base:
+            # int64/TIMESTAMP keys with magnitude above the lane dtype's
+            # range (small span, large base) must not wrap in lane_dt
+            wide = jnp.int32 if dtypes[o] is dt.BOOLEAN else \
+                cols[o][0].dtype
             if key_has_v[ki]:
                 kv = code > 0
-                kd = (code - 1 + lane_dt(ranges[ki][0]))
+                kd = (code - 1).astype(wide) + jnp.asarray(
+                    ranges[ki][0], wide)
             else:
                 kv = None
-                kd = code + lane_dt(ranges[ki][0])
-            kd = kd.astype(cols[o][0].dtype)
+                kd = code.astype(wide) + jnp.asarray(ranges[ki][0], wide)
             if dtypes[o] is dt.BOOLEAN:
                 kd = kd.astype(jnp.bool_)
             sorted_cols[o] = (kd, kv)
@@ -330,41 +343,10 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
     boundary = boundary & live_sorted
     num_groups = jnp.sum(boundary).astype(jnp.int32)
 
-    # ---- 4. aggregate tails (fast vs Inf/NaN-safe float sums) -------------
-    # Per float sum: the masked value array and its prefix sum, computed
-    # ONCE (shared by the predicate and the fast tail). The predicate is
-    # simply isfinite(grand total): Inf is sticky under addition of
-    # finite values and NaN propagates, so a finite total proves both
-    # (a) no Inf/NaN input contributed and (b) no prefix of the running
-    # sum overflowed — either would poison cumsum DIFFS across segment
-    # edges. The safe tail abandons cumsum diffs for a per-segment scan,
-    # which is IEEE-exact no matter what.
-    fs_lanes = {}
-    for si, spec in enumerate(aggs):
-        if spec.op in ("sum", "sum_of_squares") and spec.ordinal >= 0 \
-                and dtypes[spec.ordinal].is_floating:
-            d, v = sorted_cols[spec.ordinal]
-            contrib = live_sorted if v is None else (v & live_sorted)
-            x = d.astype(jnp.float64)
-            if spec.op == "sum_of_squares":
-                x = x * x
-            xm = jnp.where(contrib, x, 0.0)
-            fs_lanes[si] = (xm, _cumsum_isolated(xm))
-
-    def tail(safe: bool):
-        return _segments_tail(
-            sorted_cols, dtypes, key_ordinals, aggs, boundary,
-            live_sorted, num_rows, num_groups, capacity, safe, fs_lanes)
-
-    if fs_lanes:
-        allfin = jnp.bool_(True)
-        for xm, cs in fs_lanes.values():
-            allfin = allfin & jnp.isfinite(cs[-1])
-        flat = jax.lax.cond(allfin, lambda: tail(False),
-                            lambda: tail(True))
-    else:
-        flat = tail(False)
-    key_d, key_v_arr, agg_d, agg_v_arr = flat
+    # ---- 4. aggregate tail ------------------------------------------------
+    key_d, key_v_arr, agg_d, agg_v_arr = _segments_tail(
+        sorted_cols, dtypes, key_ordinals, aggs, boundary,
+        live_sorted, num_rows, num_groups, capacity)
 
     key_v = [key_v_arr[i] if key_has_v[i] else None
              for i in range(len(key_ordinals))]
@@ -375,14 +357,10 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
 
 
 def _segments_tail(sorted_cols, dtypes, key_ordinals, aggs, boundary,
-                   live_sorted, num_rows, num_groups, capacity,
-                   safe: bool, fs_lanes):
+                   live_sorted, num_rows, num_groups, capacity):
     """Row-space lanes -> ONE compaction sort -> group-space arithmetic.
-    ``fs_lanes``: per-float-sum (masked values, prefix sums), precomputed
-    in the caller; the fast tail consumes the prefix sums, the safe tail
-    replaces them with per-segment scans. Returns (key_d, key_v_arrays,
-    agg_d, agg_v_arrays) with validity as plain bool arrays (the caller
-    maps Nones back — lax.cond branches must return identical pytrees)."""
+    Returns (key_d, key_v_arrays, agg_d, agg_v_arrays) with validity as
+    plain bool arrays (the caller maps Nones back)."""
     iota = jnp.arange(capacity, dtype=jnp.int32)
 
     # ---- row-space lanes per aggregate
@@ -434,22 +412,52 @@ def _segments_tail(sorted_cols, dtypes, key_ordinals, aggs, boundary,
             cidx, ctot = ensure_count_lane(o)
             lane_specs.append(("isum", idx, cs[-1], cidx, ctot))
         elif spec.op in ("sum", "sum_of_squares"):
-            xm, cs = fs_lanes[si]
+            # per-segment inclusive scan, never global cumsum diffs:
+            # confines rounding error AND Inf/NaN poison to each group
+            # (a global prefix's diffs carry error scaling with the
+            # running prefix of OTHER groups)
+            x = d.astype(jnp.float64)
+            if spec.op == "sum_of_squares":
+                x = x * x
+            xm = jnp.where(contrib, x, 0.0)
+            scan = _seg_scan(xm, boundary, jnp.add)
+            sidx = add_lane(_shift1(scan))
+            last = jax.lax.dynamic_index_in_dim(
+                scan, jnp.maximum(num_rows - 1, 0), keepdims=False)
             cidx, ctot = ensure_count_lane(o)
-            if safe:
-                # per-segment inclusive scan: IEEE-exact under Inf/NaN
-                # INPUTS and under running-total overflow of all-finite
-                # inputs — either poisons global cumsum diffs, and the
-                # caller's isfinite(total) predicate routes both here
-                scan = _seg_scan(xm, boundary, jnp.add)
-                sidx = add_lane(_shift1(scan))
-                last = jax.lax.dynamic_index_in_dim(
-                    scan, jnp.maximum(num_rows - 1, 0), keepdims=False)
-                lane_specs.append(("scan", sidx, last, cidx, ctot,
-                                   False))
-            else:
-                idx = add_lane(_shift1(cs))
-                lane_specs.append(("fsum", idx, cs[-1], cidx, ctot))
+            lane_specs.append(("scan", sidx, last, cidx, ctot, False))
+        elif spec.op == "rterm":
+            # (sum x)^2 / n per group: rides the same xm seg scan shape
+            # as a float sum; squared/divided in group space
+            x = d.astype(jnp.float64)
+            xm = jnp.where(contrib, x, 0.0)
+            scan = _seg_scan(xm, boundary, jnp.add)
+            sidx = add_lane(_shift1(scan))
+            last = jax.lax.dynamic_index_in_dim(
+                scan, jnp.maximum(num_rows - 1, 0), keepdims=False)
+            cidx, ctot = ensure_count_lane(o)
+            lane_specs.append(("rterm", sidx, last, cidx, ctot))
+        elif spec.op == "m2":
+            # exact per-group centered second moment: shift every row by
+            # the group's FIRST valid value (a segmented first-valid
+            # scan), then m2 = sum(d^2) - (sum d)^2 / n — algebraically
+            # identical to sum((x - mean)^2) and free of the
+            # large-magnitude cancellation of the raw sum-of-squares
+            # formula (r3 advisor finding)
+            x = d.astype(jnp.float64)
+            xf = _seg_first_valid(x, contrib, boundary)
+            dd = jnp.where(contrib, x - xf, 0.0)
+            scan_d = _seg_scan(dd, boundary, jnp.add)
+            scan_d2 = _seg_scan(dd * dd, boundary, jnp.add)
+            sidx_d = add_lane(_shift1(scan_d))
+            last_d = jax.lax.dynamic_index_in_dim(
+                scan_d, jnp.maximum(num_rows - 1, 0), keepdims=False)
+            sidx_d2 = add_lane(_shift1(scan_d2))
+            last_d2 = jax.lax.dynamic_index_in_dim(
+                scan_d2, jnp.maximum(num_rows - 1, 0), keepdims=False)
+            cidx, ctot = ensure_count_lane(o)
+            lane_specs.append(("m2", sidx_d, last_d, sidx_d2, last_d2,
+                               cidx, ctot))
         elif spec.op in ("min", "max"):
             in_t = dtypes[o]
             kd = d.dtype
@@ -553,14 +561,6 @@ def _segments_tail(sorted_cols, dtypes, key_ordinals, aggs, boundary,
             agg_d.append(s)
             agg_v.append(glive & (nvalid > 0))
             continue
-        if kind == "fsum":
-            _, idx, tot, cidx, ctot = ls
-            lo = c[idx]
-            s = roll_next(lo, tot) - lo
-            nvalid = nvalid_of(cidx, ctot)
-            agg_d.append(s)
-            agg_v.append(glive & (nvalid > 0))
-            continue
         if kind == "scan":
             _, sidx, last, cidx, ctot, was_bool = ls
             vals = roll_next(c[sidx], last)
@@ -568,6 +568,24 @@ def _segments_tail(sorted_cols, dtypes, key_ordinals, aggs, boundary,
                 vals = vals.astype(jnp.bool_)
             nvalid = nvalid_of(cidx, ctot)
             agg_d.append(vals)
+            agg_v.append(glive & (nvalid > 0))
+            continue
+        if kind == "rterm":
+            _, sidx, last, cidx, ctot = ls
+            s = roll_next(c[sidx], last)
+            nvalid = nvalid_of(cidx, ctot)
+            nf = jnp.maximum(nvalid, 1).astype(jnp.float64)
+            agg_d.append((s * s) / nf)
+            agg_v.append(glive & (nvalid > 0))
+            continue
+        if kind == "m2":
+            _, sidx_d, last_d, sidx_d2, last_d2, cidx, ctot = ls
+            sd = roll_next(c[sidx_d], last_d)
+            sd2 = roll_next(c[sidx_d2], last_d2)
+            nvalid = nvalid_of(cidx, ctot)
+            nf = jnp.maximum(nvalid, 1).astype(jnp.float64)
+            m2 = sd2 - (sd * sd) / nf
+            agg_d.append(jnp.maximum(m2, 0.0))
             agg_v.append(glive & (nvalid > 0))
             continue
         if kind == "first":
@@ -600,6 +618,24 @@ def _seg_scan(x: jax.Array, boundary: jax.Array, op) -> jax.Array:
         bv, bf = b
         return jnp.where(bf, bv, op(av, bv)), af | bf
     v, _ = jax.lax.associative_scan(combine, (x, boundary))
+    return v
+
+
+def _seg_first_valid(x: jax.Array, valid: jax.Array,
+                     boundary: jax.Array) -> jax.Array:
+    """Row i = first VALID x in [seg_start..i] (i's own value when it is
+    the first). Rows before their segment's first valid value get 0 —
+    callers mask those rows out anyway."""
+    xm = jnp.where(valid, x, jnp.zeros((), x.dtype))
+
+    def combine(a, b):
+        av, aseen, af = a
+        bv, bseen, bf = b
+        v = jnp.where(bf, bv, jnp.where(aseen, av, bv))
+        seen = jnp.where(bf, bseen, aseen | bseen)
+        return v, seen, af | bf
+
+    v, _, _ = jax.lax.associative_scan(combine, (xm, valid, boundary))
     return v
 
 
@@ -684,6 +720,20 @@ def _reduce(cols, dtypes, aggs, num_rows, live_mask=None):
             x = d.astype(jnp.float64)
             x = jnp.where(contrib, x * x, 0.0)
             agg_d.append(full(jnp.sum(x)))
+            agg_v.append(out_valid)
+        elif spec.op in ("m2", "rterm"):
+            x = jnp.where(contrib, d.astype(jnp.float64), 0.0)
+            s = jnp.sum(x)
+            nf = jnp.maximum(n_valid, 1).astype(jnp.float64)
+            if spec.op == "rterm":
+                agg_d.append(full((s * s) / nf))
+            else:
+                # exact whole-batch second moment: mean available in one
+                # program, no shift trick needed
+                mean = s / nf
+                dd = jnp.where(contrib,
+                               d.astype(jnp.float64) - mean, 0.0)
+                agg_d.append(full(jnp.maximum(jnp.sum(dd * dd), 0.0)))
             agg_v.append(out_valid)
         elif spec.op in ("min", "max"):
             kd = d.dtype
