@@ -41,15 +41,30 @@ class Executor:
 
 
 class LocalCluster:
-    """N executors + transport + map-output tracker."""
+    """N executors + transport + map-output tracker.
+
+    ``transport="local"`` serves peers through in-process endpoints (the
+    mocked-transport testing mode, SURVEY §4); ``transport="tcp"`` binds
+    every executor's server to a real listening socket
+    (shuffle/tcp.py) — the same client/protocol stack then runs over the
+    wire, and executors served by OTHER PROCESSES can join via
+    ``register_remote_executor`` (the reference's UCX transport wired
+    into its shuffle manager, RapidsShuffleInternalManager.scala:200-305,
+    with the TCP management-port bootstrap of UCX.scala:70-155)."""
 
     def __init__(self, n_executors: int,
                  device_budget: Optional[int] = None,
                  spill_dir: Optional[str] = None,
                  codec: str = "lz4",
                  bounce_size: int = DEFAULT_BOUNCE_SIZE,
-                 max_inflight: int = DEFAULT_MAX_INFLIGHT):
-        self.transport = LocalTransport()
+                 max_inflight: int = DEFAULT_MAX_INFLIGHT,
+                 transport: str = "local"):
+        if transport == "tcp":
+            from spark_rapids_tpu.shuffle.tcp import TcpTransport
+
+            self.transport = TcpTransport()
+        else:
+            self.transport = LocalTransport()
         self.executors: List[Executor] = []
         self.bounce_size = bounce_size
         self.max_inflight = max_inflight
@@ -87,6 +102,24 @@ class LocalCluster:
             # a fetch failure, never a silent skip.
             self._map_outputs.setdefault(shuffle_id, {})[map_id] = (
                 ex.executor_id, frozenset(partition_batches))
+
+    # -- cross-process peers (tcp transport only) -------------------------
+
+    def register_remote_executor(self, executor_id: str, host: str,
+                                 port: int) -> None:
+        """Record a peer executor served by another OS process (its
+        address is the map-status topology info the reference encodes in
+        BlockManagerId, RapidsShuffleInternalManager.scala:171-183)."""
+        self.transport.register_remote(executor_id, host, port)
+
+    def register_remote_map_output(self, shuffle_id: int, map_id: int,
+                                   executor_id: str,
+                                   partitions) -> None:
+        """MapStatus entry for a map task whose output lives on a remote
+        (cross-process) executor."""
+        with self._lock:
+            self._map_outputs.setdefault(shuffle_id, {})[map_id] = (
+                executor_id, frozenset(partitions))
 
     # -- reduce side ------------------------------------------------------
 

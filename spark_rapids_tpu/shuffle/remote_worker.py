@@ -1,0 +1,79 @@
+"""Standalone shuffle-server process: one executor's catalog over TCP.
+
+The reference's shuffle peers are separate executor JVMs, each serving
+its cached blocks through the UCX transport
+(RapidsShuffleInternalManager.scala:249-269, UCX.scala:70-155). This
+module is the process entry point for the TPU build's equivalent: spawn
+``python -m spark_rapids_tpu.shuffle.remote_worker`` with a JSON config
+on stdin and it
+
+1. builds an executor (BufferCatalog + ShuffleBufferCatalog),
+2. registers the configured deterministic blocks (a map task's output),
+3. serves them over a real listening socket (shuffle/tcp.py),
+4. prints ``READY <host> <port>`` on stdout,
+5. exits when stdin closes (parent-death binding, like Spark executor
+   processes dying with their worker).
+
+Config JSON::
+
+    {"executor_id": "exec-remote",
+     "blocks": [[shuffle_id, map_id, partition, lo, n], ...],
+     "hangup_after_chunks": -1}   # >=0: raise Hangup after N chunk reqs
+
+Blocks hold ``int64 arange(lo, lo+n)`` with every ``v % 7 == 3`` row
+null — the same deterministic recipe the in-process shuffle tests use,
+so both processes can compute the expected result independently.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def make_block_batch(lo: int, n: int):
+    """Deterministic batch: int64 arange(lo, lo+n), v%7==3 -> null."""
+    import numpy as np
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column
+
+    vals = np.arange(lo, lo + n, dtype=np.int64)
+    valid = (vals % 7) != 3
+    return ColumnarBatch(
+        [Column.from_numpy(vals, dtype=dt.INT64, validity=valid)], n)
+
+
+def main() -> None:
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu.shuffle.cluster import Executor
+    from spark_rapids_tpu.shuffle.meta import BlockId
+    from spark_rapids_tpu.shuffle.tcp import Hangup, TcpShuffleServer
+
+    config = json.loads(sys.stdin.readline())
+    ex = Executor(config.get("executor_id", "exec-remote"))
+    for sid, mid, part, lo, n in config.get("blocks", []):
+        ex.shuffle_catalog.register(BlockId(sid, mid, part),
+                                    make_block_batch(lo, n))
+
+    hangup_after = int(config.get("hangup_after_chunks", -1))
+    if hangup_after >= 0:
+        state = {"served": 0}
+
+        def chunk_hook(block, offset, length):
+            if state["served"] >= hangup_after:
+                raise Hangup()
+            state["served"] += 1
+
+        ex.server.on_chunk = chunk_hook
+
+    ts = TcpShuffleServer(ex.server)
+    print(f"READY {ts.host} {ts.port}", flush=True)
+
+    # serve until the parent closes our stdin (or kills us)
+    sys.stdin.read()
+    ts.close()
+
+
+if __name__ == "__main__":
+    main()
