@@ -357,6 +357,21 @@ def _agg_op(op: str, cv: Optional[CV], gid: np.ndarray, ng: int,
         has = np.zeros(ng, dtype=bool)
         has[gid[valid]] = True
         return CV(odt, acc, has)
+    if op in ("m2", "rterm"):
+        s = np.zeros(ng, dtype=np.float64)
+        cnt = np.zeros(ng, dtype=np.int64)
+        vals = cv.data.astype(np.float64)
+        np.add.at(s, gid[valid], vals[valid])
+        np.add.at(cnt, gid[valid], 1)
+        nf = np.maximum(cnt, 1).astype(np.float64)
+        has = cnt > 0
+        if op == "rterm":
+            return CV(dt.FLOAT64, (s * s) / nf, has)
+        mean = s / nf
+        m2 = np.zeros(ng, dtype=np.float64)
+        dd = vals - mean[gid]
+        np.add.at(m2, gid[valid], (dd * dd)[valid])
+        return CV(dt.FLOAT64, np.maximum(m2, 0.0), has)
     if op in ("min", "max"):
         return _min_max(op, cv, gid, ng)
     if op in ("first", "last", "any_valid"):

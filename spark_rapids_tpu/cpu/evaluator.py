@@ -412,6 +412,20 @@ def _coalesce(e, ctx):
     return out
 
 
+def _greatest_least(e, ctx, op):
+    out = eval_expr(e.children[0], ctx)
+    data, valid = out.data, out.valid_mask()
+    for c in e.children[1:]:
+        v = eval_expr(c, ctx)
+        vv = v.valid_mask()
+        with np.errstate(all="ignore"):
+            combined = op(data, v.data)
+        data = np.where(valid & vv, combined,
+                        np.where(valid, data, v.data))
+        valid = valid | vv
+    return CV(e.dtype, data, valid)
+
+
 def _nanvl(e, ctx):
     l = eval_expr(e.children[0], ctx)
     r = eval_expr(e.children[1], ctx)
@@ -514,6 +528,23 @@ def _ceil(e, ctx):
     v = eval_expr(e.children[0], ctx)
     data = _java_double_to_long(np.ceil(v.data.astype(np.float64)))
     return CV(dt.INT64, data, v.validity)
+
+
+def _round(e, ctx):
+    """Spark HALF_UP rounding (away from zero on .5)."""
+    v = eval_expr(e.children[0], ctx)
+    s = e.scale
+    in_t = e.children[0].dtype
+    if in_t.is_integral and s >= 0:
+        return CV(e.dtype, v.data, v.validity)
+    p = 10.0 ** s
+    scaled = v.data.astype(np.float64) * p
+    with np.errstate(all="ignore"):
+        r = np.where(scaled >= 0, np.floor(scaled + 0.5),
+                     np.ceil(scaled - 0.5)) / p
+    if in_t.is_integral:
+        r = _java_double_to_long(r).astype(in_t.np_dtype)
+    return CV(e.dtype, r, v.validity)
 
 
 def _pow(e, ctx):
@@ -865,10 +896,13 @@ _DISPATCH = {
     cond.If: _if,
     cond.CaseWhen: _case_when,
     cond.Coalesce: _coalesce,
+    cond.Greatest: lambda e, ctx: _greatest_least(e, ctx, np.maximum),
+    cond.Least: lambda e, ctx: _greatest_least(e, ctx, np.fmin),
     cond.Nvl: _coalesce,
     cond.NaNvl: _nanvl,
     Cast: _cast,
     mth.Floor: _floor,
+    mth.Round: _round,
     mth.Ceil: _ceil,
     mth.Pow: _pow,
     mth.Atan2: _atan2,

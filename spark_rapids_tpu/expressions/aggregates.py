@@ -203,3 +203,90 @@ class Last(AggregateFunction):
 
     def merge_ops(self):
         return ["last"]
+
+
+class _CentralMoment(AggregateFunction):
+    """Shared base for variance/stddev (Spark CentralMomentAgg / cuDF
+    variance role, AggregateFunctions.scala).
+
+    Partials: [sum, count, m2, r] where ``m2`` is the EXACT per-batch
+    centered second moment (kernel op computes it shifted by the group's
+    first value — no large-magnitude cancellation) and ``r`` is the
+    Konig correction term (sum)^2/n. All four merge by plain addition;
+    the final evaluation recovers the total moment as
+    ``m2 + (sum_of_r - s^2/n)`` — exact for a single batch (the
+    correction cancels identically) and mean-dispersion-accurate across
+    merged batches.
+
+    ``_denom_minus``: 0 for population, 1 for sample. Sample variants
+    return NaN for single-row groups (Spark CentralMomentAgg n==1) and
+    NULL for empty/all-null groups via partial validity."""
+
+    abstract = True
+    _denom_minus = 1
+    _sqrt = False
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    @property
+    def nullable(self):
+        return True
+
+    def partial_types(self):
+        return [dt.FLOAT64, dt.INT64, dt.FLOAT64, dt.FLOAT64]
+
+    def update_ops(self):
+        return ["sum", "count", "m2", "rterm"]
+
+    def merge_ops(self):
+        return ["sum", "sum", "sum", "sum"]
+
+    def evaluate(self, partials: List[Expression]) -> Expression:
+        from spark_rapids_tpu.expressions.arithmetic import (Add, Divide,
+                                                             Multiply,
+                                                             Subtract)
+        from spark_rapids_tpu.expressions.cast import Cast
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.math import Sqrt
+        from spark_rapids_tpu.expressions.predicates import (EqualTo,
+                                                             LessThan)
+        from spark_rapids_tpu.expressions.base import Literal
+
+        s, n, m2, r = partials
+        nf = Cast(n, dt.FLOAT64)
+        # Konig merge correction: zero (exactly) when one batch
+        corr = Subtract(r, Divide(Multiply(s, s), nf))
+        total = Add(m2, corr)
+        total = If(LessThan(total, Literal(0.0)), Literal(0.0), total)
+        denom = Subtract(nf, Literal(float(self._denom_minus))) \
+            if self._denom_minus else nf
+        out = Divide(total, denom)
+        if self._denom_minus:
+            # Spark: sample variance/stddev of ONE row is NaN, not NULL
+            out = If(EqualTo(n, Literal(1, dt.INT64)),
+                     Literal(float("nan"), dt.FLOAT64), out)
+        return Sqrt(out) if self._sqrt else out
+
+
+class VarianceSamp(_CentralMoment):
+    """var_samp / variance."""
+
+
+class VariancePop(_CentralMoment):
+    _denom_minus = 0
+
+
+class StddevSamp(_CentralMoment):
+    """stddev_samp / stddev / std."""
+
+    _sqrt = True
+
+
+class StddevPop(_CentralMoment):
+    _denom_minus = 0
+    _sqrt = True

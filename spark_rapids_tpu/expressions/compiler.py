@@ -25,6 +25,7 @@ from spark_rapids_tpu.expressions.base import (
     ColV,
     EvalContext,
     Expression,
+    Literal,
     broadcast,
 )
 
@@ -119,11 +120,21 @@ class CompiledProjection:
             for e, (data, validity) in zip(self.exprs, outs):
                 if e.dtype is dt.STRING:
                     ref = _passthrough_ref(e)
-                    assert ref is not None, \
-                        "device_only string expr must be a passthrough ref"
-                    src = batch.columns[ref]
-                    assert isinstance(src, StringColumn)
-                    cols.append(StringColumn(data, src.dictionary, validity))
+                    if ref is not None:
+                        src = batch.columns[ref]
+                        assert isinstance(src, StringColumn)
+                        cols.append(StringColumn(data, src.dictionary,
+                                                 validity))
+                        continue
+                    lit = _unwrap_alias(e)
+                    assert isinstance(lit, Literal), \
+                        "device_only string expr must be a ref or literal"
+                    import numpy as np
+
+                    dictionary = np.array(
+                        [] if lit.value is None else [lit.value],
+                        dtype=object)
+                    cols.append(StringColumn(data, dictionary, validity))
                 else:
                     col = Column(e.dtype, data, validity)
                     ref = _passthrough_ref(e)

@@ -177,3 +177,65 @@ def _select(ctx: EvalContext, pred: ColV, t: EvalValue, e: EvalValue,
         validity = None
     scol = tb.scol if out_t is dt.STRING else None
     return ColV(out_t, data, validity, scol)
+
+
+class _GreatestLeast(Expression):
+    """n-ary greatest/least with Spark null-skipping (NULL only when all
+    arguments are NULL). One evaluation per child — the planner must NOT
+    lower these as nested Ifs (3^n trace blowup, r3 review finding).
+    NaN follows Spark's NaN-is-largest ordering: greatest propagates NaN
+    (jnp.maximum), least SKIPS it (jnp.fmin). STRING inputs are
+    unsupported (dictionary codes are not comparable across columns)."""
+
+    abstract = True
+    _combine = None
+
+    def __init__(self, children: List[Expression]):
+        assert len(children) >= 2
+        if any(c.dtype is dt.STRING for c in children):
+            raise TypeError("greatest/least over strings is unsupported")
+        super().__init__(children)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return all(c.nullable for c in self.children)
+
+    @property
+    def device_only(self) -> bool:
+        return super().device_only and self.dtype is not dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalValue:
+        op = type(self)._combine
+        acc = broadcast(self.children[0].eval(ctx), ctx)
+        data, valid = acc.data, acc.validity
+        for c in self.children[1:]:
+            v = broadcast(c.eval(ctx), ctx)
+            combined = op(data, v.data)
+            if valid is None and v.validity is None:
+                data = combined
+            elif valid is None:
+                data = jnp.where(v.validity, combined, data)
+                # acc always valid -> result stays valid
+            elif v.validity is None:
+                data = jnp.where(valid, combined, v.data)
+                valid = None
+            else:
+                data = jnp.where(
+                    valid & v.validity, combined,
+                    jnp.where(valid, data, v.data))
+                valid = valid | v.validity
+        return ColV(self.dtype, data, valid)
+
+
+class Greatest(_GreatestLeast):
+    _combine = staticmethod(jnp.maximum)
+
+
+class Least(_GreatestLeast):
+    # fmin: prefer the non-NaN operand — Spark orders NaN LARGEST, so
+    # least() skips NaN while greatest() (jnp.maximum) propagates it
+    _combine = staticmethod(jnp.fmin)

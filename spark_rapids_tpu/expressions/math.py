@@ -241,3 +241,38 @@ class Atan2(Expression):
             self, ctx,
             lambda a, b: jnp.arctan2(a.astype(jnp.float64),
                                      b.astype(jnp.float64)), dt.FLOAT64)
+
+
+class Round(Expression):
+    """ROUND(x[, scale]) with Spark/Java HALF_UP semantics (round .5 away
+    from zero — jnp.rint would bankers-round). Fractional input returns
+    double; integral input returns the column type (unchanged when
+    scale >= 0). Reference: GpuOverrides.scala registry (Round via cudf
+    round)."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        super().__init__([child])
+        self.scale = int(scale)
+
+    @property
+    def dtype(self):
+        ct = self.children[0].dtype
+        return ct if ct.is_integral else dt.FLOAT64
+
+    def eval(self, ctx):
+        s = self.scale
+        in_t = self.children[0].dtype
+
+        def f(x):
+            if in_t.is_integral and s >= 0:
+                return x
+            p = jnp.float64(10.0 ** s)
+            scaled = x.astype(jnp.float64) * p
+            r = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),
+                          jnp.ceil(scaled - 0.5))
+            r = r / p
+            if in_t.is_integral:
+                return _java_f64_to_i64(r).astype(in_t.kernel_dtype)
+            return r
+
+        return eval_unary(self, ctx, f, self.dtype)

@@ -100,13 +100,18 @@ def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
 
 
 def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
-    """count/sum(DISTINCT x) -> dedup-then-aggregate: an inner
-    zero-agg group-by over (keys..., x) removes duplicates, then the
-    outer aggregate runs the plain (non-distinct) function. This is the
-    planner-level role of the reference's distinct handling
-    (aggregate.scala:56-130); only the all-distinct-same-input shape
-    rewrites — mixed distinct + plain aggregates still fall back, as in
-    the reference's multi-distinct case."""
+    """count/sum(DISTINCT x) -> dedup-then-aggregate: an inner group-by
+    over (keys..., x) removes duplicates, then the outer aggregate runs
+    the plain (non-distinct) function. This is the planner-level role of
+    the reference's distinct handling (aggregate.scala:56-130).
+
+    Mixed distinct + plain aggregates also rewrite when every plain
+    aggregate is decomposable (Sum/Count/Min/Max): the inner group-by
+    computes the plain aggregate per (keys, x) sub-group and the outer
+    re-merges (Count -> Sum of counts; Sum/Min/Max self-merge) — the
+    two-phase expand Spark plans for one distinct column. Only
+    multi-distinct (different inputs) still falls back, as in the
+    reference."""
     from spark_rapids_tpu.expressions import aggregates as aggfn
 
     new_children = [rewrite_distinct_aggregates(c)
@@ -115,14 +120,23 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
 
     if not isinstance(node, pn.AggregateNode) or node.mode != "complete":
         return node
-    if not node.aggs or not all(
-            getattr(a.fn, "distinct", False) for a in node.aggs):
+    dist = [a for a in node.aggs if getattr(a.fn, "distinct", False)]
+    plain = [a for a in node.aggs if not getattr(a.fn, "distinct", False)]
+    if not dist:
         return node
     if not all(isinstance(a.fn, (aggfn.Count, aggfn.Sum))
-               for a in node.aggs):
+               for a in dist):
         return node  # (Average has no distinct form to rewrite)
+    if not all(isinstance(a.fn, (aggfn.Count, aggfn.Sum, aggfn.Min,
+                                 aggfn.Max)) for a in plain):
+        return node  # non-decomposable plain aggregate alongside
+    if not node.grouping and any(isinstance(a.fn, aggfn.Count)
+                                 for a in plain):
+        # ungrouped count(a) merges via Sum whose empty-input default is
+        # NULL, not Count's 0 — keep the unrewritten (fallback) plan
+        return node
     inputs = [a.fn.children[0] if a.fn.children else None
-              for a in node.aggs]
+              for a in dist]
     if any(i is None for i in inputs):
         return node
     first_key = inputs[0].tree_key()
@@ -131,13 +145,28 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
         return node  # multi-distinct: fall back like the reference
 
     nkeys = len(node.grouping)
+    inner_aggs = []
+    for j, a in enumerate(plain):
+        fn = a.fn
+        clone = type(fn)(*fn.children) if fn.children else type(fn)()
+        inner_aggs.append(pn.AggCall(clone, f"_p{j}"))
     inner = pn.AggregateNode(
-        list(node.grouping) + [inputs[0]], [], node.children[0],
+        list(node.grouping) + [inputs[0]], inner_aggs, node.children[0],
         grouping_names=list(node.grouping_names) + ["__distinct"])
     x = BoundReference(nkeys, inputs[0].dtype)
+    plain_index = {id(a): j for j, a in enumerate(plain)}
     outer_aggs = []
     for a in node.aggs:
-        outer_aggs.append(pn.AggCall(type(a.fn)(x), a.name))
+        if getattr(a.fn, "distinct", False):
+            outer_aggs.append(pn.AggCall(type(a.fn)(x), a.name))
+        else:
+            j = plain_index[id(a)]
+            ref = BoundReference(nkeys + 1 + j,
+                                 inner_aggs[j].fn.dtype)
+            merge = aggfn.Sum if isinstance(a.fn, (aggfn.Count,
+                                                   aggfn.Sum)) else \
+                type(a.fn)
+            outer_aggs.append(pn.AggCall(merge(ref), a.name))
     outer_keys = [BoundReference(i, e.dtype)
                   for i, e in enumerate(node.grouping)]
     return pn.AggregateNode(outer_keys, outer_aggs, inner,

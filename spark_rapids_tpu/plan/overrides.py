@@ -374,7 +374,9 @@ class _FilterRule(NodeRule):
 
 
 _SUPPORTED_AGGS = (aggfn.Min, aggfn.Max, aggfn.Sum, aggfn.Count,
-                   aggfn.Average, aggfn.First, aggfn.Last)
+                   aggfn.Average, aggfn.First, aggfn.Last,
+                   aggfn.StddevSamp, aggfn.StddevPop,
+                   aggfn.VarianceSamp, aggfn.VariancePop)
 
 
 class _AggregateRule(NodeRule):

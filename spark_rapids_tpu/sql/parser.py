@@ -273,6 +273,12 @@ class _Parser:
             return ("not", out) if negate else out
         if self.accept_kw("in"):
             self.expect_op("(")
+            if self.peek() == ("kw", "select"):
+                sub = self.parse_query()
+                self.expect_op(")")
+                # negation carried in-node: NOT IN (subquery) is an
+                # anti-join, not a boolean NOT (null semantics differ)
+                return ("in_sub", e, sub, negate)
             vals = [self.parse_expr()]
             while self.accept_op(","):
                 vals.append(self.parse_expr())

@@ -29,7 +29,9 @@ from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 from spark_rapids_tpu.plan import nodes as pn
 from spark_rapids_tpu.sql.parser import SqlError
 
-_AGG_FNS = {"sum", "count", "avg", "min", "max", "first", "last"}
+_AGG_FNS = {"sum", "count", "avg", "min", "max", "first", "last",
+            "stddev_samp", "stddev", "std", "stddev_pop",
+            "var_samp", "variance", "var_pop"}
 
 _CAST_TYPES = {
     "tinyint": dt.INT8, "smallint": dt.INT16,
@@ -136,6 +138,37 @@ def _fn_scalar(name: str, args: List[Expression]) -> Expression:
     if name == "pow" or name == "power":
         need(2)
         return mth.Pow(args[0], args[1])
+    if name == "round":
+        if len(args) not in (1, 2):
+            raise SqlError("round(col[, scale])")
+        scale = _want_int_lit(args[1], "round scale") if len(args) == 2 \
+            else 0
+        return mth.Round(args[0], scale)
+    if name == "pmod":
+        need(2)
+        return ar.Pmod(args[0], args[1])
+    if name == "datediff":
+        need(2)
+        return dte.DateDiff(_as_date(args[0]), _as_date(args[1]))
+    if name in ("unix_timestamp", "to_unix_timestamp"):
+        # format argument accepted and ignored for date/timestamp inputs
+        if not args:
+            raise SqlError(f"{name}(col[, fmt])")
+        return dte.UnixTimestamp(args[0])
+    if name == "to_date":
+        need(1)
+        return _as_date(args[0])
+    if name == "nullif":
+        need(2)
+        return cond.If(pr.EqualTo(args[0], args[1]),
+                       Literal(None, args[0].dtype), args[0])
+    if name in ("greatest", "least"):
+        if len(args) < 2:
+            raise SqlError(f"{name}() takes 2+ arguments")
+        if any(a.dtype is dt.STRING for a in args):
+            raise SqlError(f"{name}() over strings is unsupported")
+        return (cond.Greatest if name == "greatest" else
+                cond.Least)(args)
     if name in ("exp", "log", "log2", "log10", "sin", "cos", "tan"):
         need(1)
         klass = {"exp": mth.Exp, "log": mth.Log, "log2": mth.Log2,
@@ -145,6 +178,15 @@ def _fn_scalar(name: str, args: List[Expression]) -> Expression:
     raise SqlError(f"unknown function {name}()")
 
 
+def _as_date(e: Expression) -> Expression:
+    """Coerce to DATE: string literals parse eagerly, string columns cast."""
+    if e.dtype is dt.DATE:
+        return e
+    if isinstance(e, Literal) and isinstance(e.value, str):
+        return Literal(_date_days(e.value), dt.DATE)
+    return Cast(e, dt.DATE)
+
+
 def _want_int_lit(e: Expression, what: str) -> int:
     if isinstance(e, Literal) and isinstance(e.value, int):
         return e.value
@@ -152,6 +194,17 @@ def _want_int_lit(e: Expression, what: str) -> int:
 
 
 def _cmp(op: str, lhs: Expression, rhs: Expression) -> Expression:
+    # Spark coerces string literals compared against date/timestamp
+    # columns; TPC query texts lean on it ("d_date > '2002-01-02'")
+    def coerce(a, b):
+        if a.dtype in (dt.DATE, dt.TIMESTAMP) and isinstance(b, Literal) \
+                and isinstance(b.value, str):
+            return Literal(_date_days(b.value) if a.dtype is dt.DATE
+                           else _ts_us(b.value), a.dtype)
+        return b
+
+    rhs = coerce(lhs, rhs)
+    lhs = coerce(rhs, lhs)
     if op == "=":
         return pr.EqualTo(lhs, rhs)
     if op in ("<>", "!="):
@@ -184,10 +237,28 @@ class _ExprPlanner:
         if kind == "lit":
             return self._literal(ast)
         if kind == "neg":
-            return ar.UnaryMinus(self.plan(ast[1]))
+            e = self.plan(ast[1])
+            if isinstance(e, Literal) and isinstance(e.value, (int, float)):
+                return Literal(-e.value)
+            return ar.UnaryMinus(e)
         if kind == "arith":
             _, op, l, r = ast
             lhs, rhs = self.plan(l), self.plan(r)
+            if isinstance(lhs, Literal) and isinstance(rhs, Literal) \
+                    and lhs.value is not None and rhs.value is not None \
+                    and isinstance(lhs.value, (int, float)) \
+                    and isinstance(rhs.value, (int, float)) \
+                    and op in ("+", "-", "*", "/"):
+                # constant fold: IN-lists and join keys expect literals
+                # ("d_year IN (2001, (2001 + 1))"), and scalar-only
+                # subtrees must not reach the jit tracer ("2.0 / 3.0")
+                if op == "/":
+                    if rhs.value == 0:
+                        return Literal(None, dt.FLOAT64)
+                    return Literal(float(lhs.value) / float(rhs.value))
+                v = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
+                     "*": lambda a, b: a * b}[op](lhs.value, rhs.value)
+                return Literal(v)
             klass = {"+": ar.Add, "-": ar.Subtract, "*": ar.Multiply,
                      "/": ar.Divide, "%": ar.Remainder}[op]
             return klass(lhs, rhs)
@@ -262,7 +333,11 @@ def _plan_agg_call(ast, scope: _Scope) -> A.AggregateFunction:
     ep = _ExprPlanner(scope)
     if name == "count":
         if args and args[0] != ("star",):
-            return A.Count(ep.plan(args[0]), distinct=distinct)
+            arg = ep.plan(args[0])
+            if isinstance(arg, Literal) and arg.value is not None \
+                    and not distinct:
+                return A.Count()  # count(1) == count(*)
+            return A.Count(arg, distinct=distinct)
         if distinct:
             raise SqlError("count(DISTINCT *) is unsupported")
         return A.Count()
@@ -274,7 +349,11 @@ def _plan_agg_call(ast, scope: _Scope) -> A.AggregateFunction:
     if distinct:
         raise SqlError(f"{name}(DISTINCT) is unsupported")
     return {"avg": A.Average, "min": A.Min, "max": A.Max,
-            "first": A.First, "last": A.Last}[name](arg)
+            "first": A.First, "last": A.Last,
+            "stddev_samp": A.StddevSamp, "stddev": A.StddevSamp,
+            "std": A.StddevSamp, "stddev_pop": A.StddevPop,
+            "var_samp": A.VarianceSamp, "variance": A.VarianceSamp,
+            "var_pop": A.VariancePop}[name](arg)
 
 
 def _collect_agg_calls(ast, out: List):
@@ -349,6 +428,46 @@ def _split_join_condition(cond_ast, left_scope: _Scope,
     return lk, rk, residual_ast
 
 
+def _col_refs(ast, out: List):
+    if not isinstance(ast, tuple):
+        return
+    if ast[0] == "col":
+        out.append(ast)
+        return
+    for p in ast:
+        if isinstance(p, tuple):
+            _col_refs(p, out)
+        elif isinstance(p, list):
+            for x in p:
+                _col_refs(x, out)
+
+
+def _conjunct_side(c, lscope: _Scope, rscope: _Scope):
+    """'l'/'r' when every column in ``c`` resolves on exactly that side;
+    None when mixed/ambiguous."""
+    refs: List = []
+    _col_refs(c, refs)
+    sides = set()
+    for _, tab, name in refs:
+        inl = inr = False
+        try:
+            lscope.resolve(tab, name)
+            inl = True
+        except SqlError:
+            pass
+        try:
+            rscope.resolve(tab, name)
+            inr = True
+        except SqlError:
+            pass
+        if inl == inr:
+            return None  # unresolvable or ambiguous
+        sides.add("l" if inl else "r")
+    if len(sides) == 1:
+        return sides.pop()
+    return None
+
+
 def _plan_relation(rel, catalog) -> Tuple[pn.PlanNode, _Scope]:
     kind = rel[0]
     if kind == "table":
@@ -390,10 +509,27 @@ def _plan_relation(rel, catalog) -> Tuple[pn.PlanNode, _Scope]:
             else lscope.entries)
         if residual is not None:
             if jkind in ("left_semi", "left_anti"):
-                raise SqlError("semi/anti joins support only "
-                               "equi-conditions")
-            full_scope = _Scope(lscope.entries + rscope.entries)
-            cond_expr = _ExprPlanner(full_scope).plan(residual)
+                # one-sided ON conjuncts become pre-join filters (the
+                # planning Spark does for "LEFT SEMI JOIN d ON k AND
+                # d.x = lit": push the single-side predicate below the
+                # join); cross-side non-equi residuals stay unsupported
+                for c in _conjuncts(residual):
+                    side = _conjunct_side(c, lscope, rscope)
+                    if side == "r":
+                        rnode = pn.FilterNode(
+                            _ExprPlanner(rscope).plan(c), rnode)
+                    elif side == "l" and jkind == "left_semi":
+                        # valid for semi only: an anti join KEEPS left
+                        # rows whose ON condition is false
+                        lnode = pn.FilterNode(
+                            _ExprPlanner(lscope).plan(c), lnode)
+                    else:
+                        raise SqlError(
+                            "semi/anti joins support only equi or "
+                            "single-side conditions")
+            else:
+                full_scope = _Scope(lscope.entries + rscope.entries)
+                cond_expr = _ExprPlanner(full_scope).plan(residual)
         node = pn.JoinNode(jkind, lnode, rnode, lk, rk,
                            condition=cond_expr)
         return node, joined_scope
@@ -487,17 +623,97 @@ def _plan_implicit_joins(rels, where_ast, catalog):
     return node, scope
 
 
+def _subst_aliases(ast, alias_map, scope):
+    """Replace unqualified column refs that match a SELECT alias (and do
+    not resolve as real columns) with the aliased expression — Spark's
+    HAVING/ORDER BY alias resolution ("HAVING cnt >= 10")."""
+    if not isinstance(ast, tuple):
+        return ast
+    if ast[0] == "col" and ast[1] is None:
+        name = ast[2].lower()
+        if name in alias_map:
+            try:
+                scope.resolve(None, ast[2])
+            except SqlError:
+                return alias_map[name]
+        return ast
+    out = []
+    for p in ast:
+        if isinstance(p, tuple):
+            out.append(_subst_aliases(p, alias_map, scope))
+        elif isinstance(p, list):
+            out.append([_subst_aliases(x, alias_map, scope)
+                        if isinstance(x, tuple) else x for x in p])
+        else:
+            out.append(p)
+    return tuple(out)
+
+
+def _extract_in_subs(where_ast):
+    """Pull top-level ``x IN (SELECT ...)`` conjuncts out of WHERE; they
+    become semi/anti joins (the rewrite Spark's optimizer performs —
+    RewritePredicateSubquery)."""
+    subs = []
+    rest = None
+    for c in _conjuncts(where_ast):
+        if isinstance(c, tuple) and c[0] == "in_sub":
+            subs.append((c[1], c[2], c[3]))
+        else:
+            rest = c if rest is None else ("and", rest, c)
+    return rest, subs
+
+
+def _apply_in_subs(node, scope, subs, catalog):
+    from spark_rapids_tpu.expressions import aggregates as A_
+
+    for col_ast, sub, negated in subs:
+        e = _ExprPlanner(scope).plan(col_ast)
+        if not isinstance(e, BoundReference):
+            raise SqlError("IN (subquery) needs a plain column on the "
+                           "left")
+        subnode = plan_statement(sub, catalog)
+        sub_schema = subnode.output_schema()
+        if len(sub_schema) != 1:
+            raise SqlError("IN subquery must select exactly one column")
+        if not negated:
+            node = pn.JoinNode("left_semi", node, subnode,
+                               [e.ordinal], [0])
+            continue
+        # NOT IN: null-aware anti join (Spark RewritePredicateSubquery).
+        # SQL three-valued logic: a NULL probe never qualifies, and ANY
+        # null in the subquery empties the whole result.
+        node = pn.FilterNode(pr.IsNotNull(e), node)
+        node = pn.JoinNode("left_anti", node, subnode, [e.ordinal], [0])
+        width = len(node.output_schema())
+        sub_ref = BoundReference(0, sub_schema.types[0])
+        nullcnt = pn.AggregateNode(
+            [], [pn.AggCall(A_.Count(), "_subnulls")],
+            pn.FilterNode(pr.IsNull(sub_ref), subnode))
+        node = pn.JoinNode("cross", node, nullcnt, [], [])
+        node = pn.FilterNode(
+            pr.EqualTo(BoundReference(width, dt.INT64), Literal(0)),
+            node)
+        out_schema = node.output_schema()
+        node = pn.ProjectNode(
+            [Alias(BoundReference(i, out_schema.types[i]),
+                   out_schema.names[i]) for i in range(width)],
+            node, names=list(out_schema.names)[:width])
+    return node
+
+
 def plan_statement(ast, catalog) -> pn.PlanNode:
     assert ast[0] == "select"
     q = ast[1]
+    where_ast, in_subs = _extract_in_subs(q["where"])
     rels = _flatten_implicit(q["from"])
     if len(rels) > 1:
-        node, scope = _plan_implicit_joins(rels, q["where"], catalog)
+        node, scope = _plan_implicit_joins(rels, where_ast, catalog)
     else:
         node, scope = _plan_relation(q["from"], catalog)
-        if q["where"] is not None:
-            node = pn.FilterNode(_ExprPlanner(scope).plan(q["where"]),
+        if where_ast is not None:
+            node = pn.FilterNode(_ExprPlanner(scope).plan(where_ast),
                                  node)
+    node = _apply_in_subs(node, scope, in_subs, catalog)
 
     # expand SELECT * / build select item list
     sels: List[Tuple[tuple, Optional[str]]] = []
@@ -508,12 +724,18 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
         else:
             sels.append((e, alias))
 
+    alias_map = {a.lower(): e for e, a in sels if a}
+    having_ast = _subst_aliases(q["having"], alias_map, scope) \
+        if q["having"] is not None else None
+    order_items = [(_subst_aliases(e, alias_map, scope), asc, nf)
+                   for e, asc, nf in q["order"]]
+
     agg_calls: List[tuple] = []
     for e, _ in sels:
         _collect_agg_calls(e, agg_calls)
-    if q["having"] is not None:
-        _collect_agg_calls(q["having"], agg_calls)
-    for e, _asc, _nf in q["order"]:
+    if having_ast is not None:
+        _collect_agg_calls(having_ast, agg_calls)
+    for e, _asc, _nf in order_items:
         _collect_agg_calls(e, agg_calls)
 
     env: Dict[str, Tuple[int, dt.DType]] = {}
@@ -539,9 +761,9 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
                                         agg_schema.types)])
         # group columns stay resolvable by name too
 
-    if q["having"] is not None:
+    if having_ast is not None:
         node = pn.FilterNode(
-            _ExprPlanner(scope, env).plan(q["having"]), node)
+            _ExprPlanner(scope, env).plan(having_ast), node)
 
     # final projection
     out_exprs: List[Expression] = []
@@ -559,11 +781,11 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
             [BoundReference(i, t) for i, t in enumerate(schema.types)],
             [], node, grouping_names=list(schema.names))
 
-    if q["order"]:
+    if order_items:
         schema = node.output_schema()
         sel_keys = {repr(e): i for i, (e, _a) in enumerate(sels)}
         specs = []
-        for e, asc, nulls_first in q["order"]:
+        for e, asc, nulls_first in order_items:
             if e[0] == "lit" and isinstance(e[1], int):
                 ordinal = e[1] - 1  # ORDER BY position
                 if not 0 <= ordinal < len(schema.names):

@@ -1,0 +1,213 @@
+"""SQL front-end features added for TPCx-BB breadth (round 3): IN/NOT IN
+subqueries, one-sided semi-join ON conditions, HAVING/ORDER BY alias
+resolution, constant folding, round/datediff/pmod scalars,
+stddev/variance aggregates and the mixed distinct rewrite. Reference
+semantics: Spark SQL (the reference accelerates these same shapes via
+GpuOverrides; RewritePredicateSubquery for the subquery forms)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import Session
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    rng = np.random.default_rng(0)
+    n = 400
+    s.create_temp_view("sales", s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(1, 30, n),
+        "v": np.round(rng.random(n) * 100, 2),
+        "t": rng.integers(1, 40, n),
+    })))
+    s.create_temp_view("dim", s.create_dataframe(pd.DataFrame({
+        "id": np.arange(1, 31), "cat": rng.integers(0, 4, 30),
+    })))
+    return s
+
+
+def test_in_subquery_semi_join(sess):
+    got = sess.sql("SELECT COUNT(*) AS n FROM sales WHERE k IN "
+                   "(SELECT id FROM dim WHERE cat = 1)").collect()
+    dim = sess.sql("SELECT id FROM dim WHERE cat = 1").collect()
+    all_ = sess.sql("SELECT k FROM sales").collect()
+    want = int(all_["k"].isin(dim["id"]).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_not_in_subquery_null_aware():
+    s = Session()
+    s.create_temp_view("l", s.create_dataframe(pd.DataFrame(
+        {"x": pd.array([1, 2, None, 4], dtype="Int64")})))
+    s.create_temp_view("r", s.create_dataframe(pd.DataFrame(
+        {"y": pd.array([2, 3], dtype="Int64")})))
+    s.create_temp_view("rn", s.create_dataframe(pd.DataFrame(
+        {"y": pd.array([2, None], dtype="Int64")})))
+    got = s.sql(
+        "SELECT x FROM l WHERE x NOT IN (SELECT y FROM r)").collect()
+    assert sorted(got["x"].tolist()) == [1, 4]
+    # any NULL in the subquery -> empty (SQL three-valued logic)
+    got2 = s.sql(
+        "SELECT x FROM l WHERE x NOT IN (SELECT y FROM rn)").collect()
+    assert len(got2) == 0
+
+
+def test_semi_join_one_sided_on_condition(sess):
+    got = sess.sql("""
+        SELECT COUNT(*) AS n FROM sales s
+        LEFT SEMI JOIN dim d ON s.k = d.id AND d.cat = 2
+    """).collect()
+    dim = sess.sql("SELECT id FROM dim WHERE cat = 2").collect()
+    all_ = sess.sql("SELECT k FROM sales").collect()
+    assert int(got["n"][0]) == int(all_["k"].isin(dim["id"]).sum())
+
+
+def test_anti_join_left_side_on_condition_rejected(sess):
+    from spark_rapids_tpu.sql.parser import SqlError
+
+    with pytest.raises(SqlError):
+        sess.sql("SELECT * FROM sales s LEFT ANTI JOIN dim d "
+                 "ON s.k = d.id AND s.v > 3")
+
+
+def test_having_and_order_by_alias(sess):
+    got = sess.sql("""
+        SELECT k, COUNT(*) AS cnt FROM sales GROUP BY k
+        HAVING cnt >= 10 ORDER BY cnt DESC, k LIMIT 5
+    """).collect()
+    df = sess.sql("SELECT k FROM sales").collect()
+    vc = df["k"].value_counts()
+    want = vc[vc >= 10].reset_index()
+    want.columns = ["k", "cnt"]
+    want = want.sort_values(["cnt", "k"],
+                            ascending=[False, True]).head(5)
+    assert got["k"].tolist() == want["k"].tolist()
+    assert got["cnt"].tolist() == want["cnt"].tolist()
+
+
+def test_constant_folding_in_list_and_division(sess):
+    got = sess.sql("SELECT COUNT(*) AS n FROM sales "
+                   "WHERE k IN (3, (3 + 1)) AND v > 2.0 / 4.0").collect()
+    df = sess.sql("SELECT k, v FROM sales").collect()
+    want = int((df["k"].isin([3, 4]) & (df["v"] > 0.5)).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_round_half_up(sess):
+    s = Session()
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"v": [2.5, -2.5, 1.25, 1.35, 10.0]})))
+    got = s.sql("SELECT round(v, 0) AS r0, round(v, 1) AS r1 "
+                "FROM t").collect()
+    assert got["r0"].tolist() == [3.0, -3.0, 1.0, 1.0, 10.0]
+    # 1.25 is exact -> HALF_UP 1.3; double 1.35 is 1.35000...0089 -> 1.4.
+    # allclose: XLA lowers the /10 as *0.1 (1 ulp off exact division)
+    np.testing.assert_allclose(got["r1"], [2.5, -2.5, 1.3, 1.4, 10.0],
+                               rtol=1e-12)
+
+
+def test_stddev_variance_aggregates(sess):
+    got = sess.sql("""
+        SELECT k, stddev_samp(v) AS sd, var_samp(v) AS vs,
+               stddev_pop(v) AS sp, var_pop(v) AS vp
+        FROM sales GROUP BY k ORDER BY k
+    """).collect()
+    df = sess.sql("SELECT k, v FROM sales").collect()
+    g = df.groupby("k")["v"]
+    np.testing.assert_allclose(got["sd"], g.std(ddof=1).values,
+                               rtol=1e-6)
+    np.testing.assert_allclose(got["vs"], g.var(ddof=1).values,
+                               rtol=1e-6)
+    np.testing.assert_allclose(got["sp"], g.std(ddof=0).values,
+                               rtol=1e-6)
+    np.testing.assert_allclose(got["vp"], g.var(ddof=0).values,
+                               rtol=1e-6)
+
+
+def test_stddev_samp_single_row_is_nan():
+    s = Session()
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"k": [1, 2, 2], "v": [5.0, 1.0, 3.0]})))
+    got = s.sql("SELECT k, stddev_samp(v) AS sd FROM t GROUP BY k "
+                "ORDER BY k").collect()
+    # Spark CentralMomentAgg: n == 1 -> NaN (a value), not NULL
+    assert np.isnan(got["sd"][0])
+    np.testing.assert_allclose(got["sd"][1], np.std([1.0, 3.0], ddof=1))
+
+
+def test_variance_large_magnitude_no_cancellation():
+    """var over large-magnitude low-variance data must not collapse to
+    0.0 (r3 review: the raw sum-of-squares formula lost all precision at
+    |x| ~ 1e8; the m2 kernel op computes the moment shifted)."""
+    s = Session()
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"k": [1, 1, 2, 2, 2], "v": [1e8, 1e8 + 1,
+                                     7e7 + 0.1, 7e7 + 0.2, 7e7 + 0.3]})))
+    got = s.sql("SELECT k, var_samp(v) AS vs FROM t GROUP BY k "
+                "ORDER BY k").collect()
+    np.testing.assert_allclose(got["vs"][0], 0.5, rtol=1e-9)
+    np.testing.assert_allclose(got["vs"][1], 0.01, rtol=1e-6)
+
+
+def test_mixed_distinct_and_plain_aggregates(sess):
+    got = sess.sql("""
+        SELECT k, COUNT(DISTINCT t) AS dt, COUNT(v) AS c, SUM(v) AS sv,
+               MIN(v) AS mn, MAX(v) AS mx
+        FROM sales GROUP BY k ORDER BY k
+    """).collect()
+    df = sess.sql("SELECT k, v, t FROM sales").collect()
+    g = df.groupby("k")
+    np.testing.assert_array_equal(got["dt"],
+                                  g["t"].nunique().values)
+    np.testing.assert_array_equal(got["c"], g["v"].count().values)
+    np.testing.assert_allclose(got["sv"], g["v"].sum().values,
+                               rtol=1e-9)
+    np.testing.assert_allclose(got["mn"], g["v"].min().values)
+    np.testing.assert_allclose(got["mx"], g["v"].max().values)
+
+
+def test_ungrouped_mixed_distinct_on_empty_input():
+    """count(a) must stay 0 (not NULL) on empty input — the mixed
+    rewrite is skipped for ungrouped counts (r3 review finding)."""
+    s = Session()
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"a": [1.0], "b": [2]})))
+    got = s.sql("SELECT COUNT(a) AS c, COUNT(DISTINCT b) AS d FROM t "
+                "WHERE a > 100").collect()
+    assert int(got["c"][0]) == 0
+    assert int(got["d"][0]) == 0
+
+
+def test_least_skips_nan_greatest_propagates():
+    """Spark orders NaN LARGEST: least() skips NaN, greatest() keeps it."""
+    s = Session()
+    # NaN must be COMPUTED: pandas-ingested NaN becomes NULL (pyspark
+    # createDataFrame semantics), which greatest/least legitimately skip
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"a": [-1.0, 1.0], "b": [2.0, 5.0]})))
+    got = s.sql("SELECT least(sqrt(a), b) AS l, "
+                "greatest(sqrt(a), b) AS g FROM t").collect()
+    assert got["l"].tolist() == [2.0, 1.0]
+    assert np.isnan(got["g"][0]) and got["g"][1] == 5.0
+
+
+def test_greatest_over_strings_rejected():
+    from spark_rapids_tpu.sql.parser import SqlError
+
+    s = Session()
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"a": ["x"], "b": ["y"]})))
+    with pytest.raises(SqlError):
+        s.sql("SELECT greatest(a, b) FROM t")
+
+
+def test_datediff_and_pmod(sess):
+    s = Session()
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"d": pd.to_datetime(["2001-03-10", "2001-03-20"]),
+         "x": [7, -7]})))
+    got = s.sql("SELECT datediff(d, '2001-03-16') AS dd, "
+                "pmod(x, 5) AS pm FROM t").collect()
+    assert got["dd"].tolist() == [-6, 4]
+    assert got["pm"].tolist() == [2, 3]

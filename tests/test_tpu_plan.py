@@ -247,13 +247,15 @@ def test_window_first_last_on_device():
 
 def test_fallback_mixed_tree_keeps_tpu_children():
     """A CPU-only parent over a TPU-able child: child accelerates, parent
-    falls back, results match. DISTINCT aggregates are the fallback case
-    (GpuOverrides distinct fallback, aggregate.scala:56-130)."""
+    falls back, results match. MULTI-distinct (different inputs) is the
+    remaining fallback case (GpuOverrides distinct fallback,
+    aggregate.scala:56-130; single-input distinct — even mixed with plain
+    aggregates — now rewrites to dedup-then-aggregate)."""
     data, validity = random_table(300, seed=9)
     child = pn.FilterNode(GreaterThan(ref(2, dt.INT64), Literal(0)),
                           scan(data, validity))
     aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64), distinct=True), "f"),
-            pn.AggCall(Sum(ref(2, dt.INT64)), "s")]
+            pn.AggCall(Sum(ref(2, dt.INT64), distinct=True), "s")]
     plan = pn.AggregateNode([ref(0, dt.INT64)], aggs, child,
                             grouping_names=["k"])
     from spark_rapids_tpu.execs.base import collect
@@ -273,10 +275,10 @@ def test_test_mode_raises_on_fallback():
         apply_overrides
 
     data, validity = random_table(50, seed=10)
-    # MIXED distinct + plain aggregates stay unsupported (the optimizer
-    # only rewrites the all-distinct-same-input shape)
+    # MULTI-distinct over different inputs stays unsupported (the
+    # optimizer rewrites only the single-distinct-input shape)
     aggs = [pn.AggCall(Sum(ref(1, dt.FLOAT64), distinct=True), "f"),
-            pn.AggCall(Count(ref(1, dt.FLOAT64)), "c")]
+            pn.AggCall(Sum(ref(2, dt.INT64), distinct=True), "c")]
     plan = pn.AggregateNode([ref(0, dt.INT64)], aggs,
                             scan(data, validity))
     conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
