@@ -10,6 +10,7 @@ inventory x warehouse x item x date_dim with an inter-fact inequality — the
 multi-way join headline of BASELINE config #3)."""
 from __future__ import annotations
 
+import functools
 import os
 
 import numpy as np
@@ -67,6 +68,9 @@ def gen_item(sf: float, seed: int = 32) -> pa.Table:
         "i_class_id": rng.integers(1, 9, n).astype(np.int32),
         "i_manufact_id": rng.integers(1, 1000, n).astype(np.int32),
         "i_manager_id": rng.integers(1, 100, n).astype(np.int32),
+        "i_item_id": np.array([f"AAAAAAAA{i:08d}" for i in range(1, n + 1)],
+                              dtype=object),
+        "i_current_price": np.round(0.5 + rng.random(n) * 2.0, 2),
         "i_item_desc": np.array([f"item description {i % 997}"
                                  for i in range(n)], dtype=object),
     })
@@ -76,6 +80,7 @@ def _date_sks(rng, n):
     return rng.integers(2450815, 2450815 + 5 * 365, n).astype(np.int64)
 
 
+@functools.lru_cache(maxsize=2)  # returns generators re-sample the same fact table
 def gen_store_sales(sf: float, seed: int = 33) -> pa.Table:
     rng = np.random.default_rng(seed)
     n = max(int(2_880_000 * sf), 200)
@@ -93,8 +98,11 @@ def gen_store_sales(sf: float, seed: int = 33) -> pa.Table:
                                     ).astype(np.int64),
         "ss_store_sk": rng.integers(1, max(int(12 * sf), 2) + 1, n
                                     ).astype(np.int64),
+        "ss_ticket_number": rng.integers(1, max(n // 3, 2), n
+                                         ).astype(np.int64),
         "ss_quantity": rng.integers(1, 101, n).astype(np.int32),
         "ss_sales_price": np.round(rng.random(n) * 200, 2),
+        "ss_net_paid": np.round(rng.random(n) * 250, 2),
         "ss_list_price": np.round(rng.random(n) * 250, 2),
         "ss_coupon_amt": np.round(rng.random(n) * 50, 2),
         "ss_ext_list_price": np.round(rng.random(n) * 25_000, 2),
@@ -139,12 +147,46 @@ def gen_inventory(sf: float, seed: int = 35) -> pa.Table:
 
 
 def gen_warehouse(sf: float, seed: int = 36) -> pa.Table:
+    rng = np.random.default_rng(seed)
     n = max(int(5 * sf), 2)
+    states = np.array(["CA", "TX", "NY", "WA", "GA"], dtype=object)
     return pa.table({
         "w_warehouse_sk": np.arange(1, n + 1, dtype=np.int64),
         "w_warehouse_name": np.array([f"Warehouse {i}"
                                       for i in range(1, n + 1)],
                                      dtype=object),
+        "w_state": states[rng.integers(0, 5, n)],
+    })
+
+
+def gen_store_returns(sf: float, seed: int = 48) -> pa.Table:
+    """~8% of store_sales rows return; key columns are SAMPLED from the
+    sales table so multi-key joins (q21's ticket+item+customer) hit."""
+    rng = np.random.default_rng(seed)
+    sales = gen_store_sales(sf)
+    n_s = sales.num_rows
+    n = max(n_s // 12, 30)
+    idx = rng.choice(n_s, n, replace=False)
+    item = sales["ss_item_sk"].to_numpy()[idx]
+    cust = sales["ss_customer_sk"].to_numpy()[idx]
+    ticket = sales["ss_ticket_number"].to_numpy()[idx]
+    sold = sales["ss_sold_date_sk"].to_numpy()[idx]
+    return pa.table({
+        "sr_item_sk": item,
+        "sr_customer_sk": cust,
+        "sr_ticket_number": ticket,
+        "sr_returned_date_sk": sold + rng.integers(1, 90, n),
+        "sr_return_quantity": rng.integers(1, 20, n).astype(np.int32),
+        "sr_return_amt": np.round(rng.random(n) * 150, 2),
+    })
+
+
+def gen_web_page(sf: float, seed: int = 49) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(60 * sf), 5)
+    return pa.table({
+        "wp_web_page_sk": np.arange(1, n + 1, dtype=np.int64),
+        "wp_char_count": rng.integers(4000, 7001, n).astype(np.int32),
     })
 
 
@@ -173,6 +215,10 @@ def gen_promotion(sf: float, seed: int = 38) -> pa.Table:
             rng.integers(0, 2, n)],
         "p_channel_event": np.array(["Y", "N"], dtype=object)[
             rng.integers(0, 2, n)],
+        "p_channel_dmail": np.array(["Y", "N"], dtype=object)[
+            rng.integers(0, 2, n)],
+        "p_channel_tv": np.array(["Y", "N"], dtype=object)[
+            rng.integers(0, 2, n)],
     })
 
 
@@ -196,10 +242,14 @@ def gen_time_dim(sf: float, seed: int = 40) -> pa.Table:
 
 def gen_store(sf: float, seed: int = 41) -> pa.Table:
     n = max(int(12 * sf), 2)
+    rng = np.random.default_rng(seed)
     return pa.table({
         "s_store_sk": np.arange(1, n + 1, dtype=np.int64),
+        "s_store_id": np.array([f"AAAAAAAA{i:04d}" for i in range(1, n + 1)],
+                               dtype=object),
         "s_store_name": np.array([f"ese{i}" for i in range(1, n + 1)],
                                  dtype=object),
+        "s_gmt_offset": np.where(rng.random(n) < 0.7, -5.0, -6.0),
     })
 
 
@@ -215,6 +265,8 @@ GENERATORS = {
     "household_demographics": gen_household_demographics,
     "time_dim": gen_time_dim,
     "store": gen_store,
+    "store_returns": gen_store_returns,
+    "web_page": gen_web_page,
 }
 
 
