@@ -14,9 +14,34 @@ Prints exactly one JSON line:
 from __future__ import annotations
 
 import json
+import os
+import shutil
 import time
 
 import numpy as np
+
+
+def seed_compile_cache() -> None:
+    """Seed .jax_cache with the tracked TPU executable for the bench
+    pipeline (scripts/bench_cache/). A cold XLA compile of the 4M-row
+    fused kernel takes ~30 min over the remote-compile tunnel; the
+    persistent cache makes a fresh process start hot, and this seeding
+    survives even a clean checkout. Stale entries (from kernel edits)
+    are harmless — the cache key simply won't match.
+
+    NOTE (builder discipline): after ANY change to ops/groupby.py or the
+    entry pipeline, re-run `python bench.py` once without a timeout and
+    refresh scripts/bench_cache/ with the new jit_step-* entry."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(root, "scripts", "bench_cache")
+    dst = os.path.join(root, ".jax_cache")
+    if not os.path.isdir(src):
+        return
+    os.makedirs(dst, exist_ok=True)
+    for name in os.listdir(src):
+        target = os.path.join(dst, name)
+        if not os.path.exists(target):
+            shutil.copy2(os.path.join(src, name), target)
 
 
 N_ROWS = 4_000_000
@@ -87,6 +112,7 @@ def bench_cpu(keys, key_valid, vals):
 
 
 def main():
+    seed_compile_cache()
     keys, key_valid, vals = gen_data()
     tpu_dt, tpu_out = bench_tpu(keys, key_valid, vals)
     cpu_dt, cpu_out = bench_cpu(keys, key_valid, vals)
