@@ -321,7 +321,7 @@ class DataFrame:
     def collect(self):
         from spark_rapids_tpu.execs.base import collect
 
-        return collect(self._exec())
+        return collect(self._exec(), conf=self.session.conf)
 
     def last_metrics(self) -> dict:
         """Per-operator metrics of the most recent collect() — the SQL-UI
@@ -345,7 +345,8 @@ class DataFrame:
         from spark_rapids_tpu.execs.base import collect
         from spark_rapids_tpu.plan.overrides import apply_overrides
 
-        df = collect(apply_overrides(plan, self.session.conf))
+        df = collect(apply_overrides(plan, self.session.conf),
+                     conf=self.session.conf)
         return int(df["count"].iloc[0])
 
     def show(self, n: int = 20) -> None:  # pragma: no cover - console

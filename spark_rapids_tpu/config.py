@@ -170,6 +170,14 @@ CONCURRENT_TPU_TASKS = conf("rapids.tpu.sql.concurrentTpuTasks").doc(
     "(admission control; GpuSemaphore analogue, RapidsConf.scala:340)."
 ).int_conf.create_with_default(2)
 
+TASK_THREADS = conf("rapids.tpu.sql.taskThreads").doc(
+    "Worker threads driving partitions concurrently within this process "
+    "(the role of Spark's executor task slots). More threads than "
+    "concurrentTpuTasks lets host I/O (parquet decode, spill) overlap "
+    "device compute while the semaphore bounds device entry "
+    "(GpuSemaphore.scala:27-161 oversubscription strategy)."
+).int_conf.create_with_default(4)
+
 BATCH_SIZE_BYTES = conf("rapids.tpu.sql.batchSizeBytes").doc(
     "Target coalesced batch size in bytes (RapidsConf.scala:353-358; the "
     "reference defaults to 2GiB, we default lower: XLA prefers bounded "

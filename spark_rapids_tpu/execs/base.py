@@ -9,6 +9,7 @@ devices).
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -32,17 +33,19 @@ class Metrics:
         self.num_output_batches = 0
         self.op_time_ns = 0
         self.pipeline_time_ns = 0
+        self._lock = threading.Lock()
 
     def record(self, batch: ColumnarBatch, elapsed_ns: int = 0,
                child_ns: int = 0):
-        self.num_output_batches += 1
         n = batch.num_rows
-        if isinstance(n, int):
-            self._rows += n
-        else:
-            self._pending_rows.append(n)
-        self.pipeline_time_ns += elapsed_ns
-        self.op_time_ns += max(elapsed_ns - child_ns, 0)
+        with self._lock:  # partitions run on concurrent task threads
+            self.num_output_batches += 1
+            if isinstance(n, int):
+                self._rows += n
+            else:
+                self._pending_rows.append(n)
+            self.pipeline_time_ns += elapsed_ns
+            self.op_time_ns += max(elapsed_ns - child_ns, 0)
 
     @property
     def num_output_rows(self) -> int:
@@ -127,17 +130,45 @@ def timed(owner, it: Iterator[ColumnarBatch]
         yield batch
 
 
-def collect(exec_: TpuExec):
+def run_partitions(n_partitions: int, fn, task_threads: int = 4):
+    """Drive ``fn(partition) -> result`` over all partitions on a worker
+    pool, returning results in partition order. The reference's model:
+    Spark schedules many concurrent tasks per executor while GpuSemaphore
+    bounds how many touch the device (GpuSemaphore.scala:27-161,
+    RapidsConf.scala:340) — here the pool is the task-slot analogue and
+    execs acquire the shared TpuSemaphore at device entry, so host I/O of
+    one partition overlaps device compute of another. ``task_threads<=1``
+    or a single partition degrades to the serial loop (no thread hop)."""
+    if n_partitions <= 1 or task_threads <= 1:
+        return [fn(p) for p in range(n_partitions)]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(task_threads, n_partitions),
+                            thread_name_prefix="tpu-task") as pool:
+        return list(pool.map(fn, range(n_partitions)))
+
+
+def collect(exec_: TpuExec, conf=None):
     """Run all partitions and return one pandas DataFrame — the
-    GpuColumnarToRowExec boundary (GpuColumnarToRowExec.scala:111)."""
+    GpuColumnarToRowExec boundary (GpuColumnarToRowExec.scala:111).
+    Partitions run concurrently on the task pool (see run_partitions);
+    output row order is by partition then batch, same as the serial
+    loop."""
     import pandas as pd
 
-    frames = []
-    for p in range(exec_.num_partitions):
-        for batch in exec_.execute(p):
-            if batch.realized_num_rows() == 0:
-                continue
-            frames.append(batch.to_pandas(exec_.schema))
+    from spark_rapids_tpu import config as cfg
+
+    threads = (conf.get(cfg.TASK_THREADS) if conf is not None
+               else cfg.TASK_THREADS.default)
+
+    def one(p: int):
+        return [batch.to_pandas(exec_.schema)
+                for batch in exec_.execute(p)
+                if batch.realized_num_rows() > 0]
+
+    frames = [f for fs in
+              run_partitions(exec_.num_partitions, one, threads)
+              for f in fs]
     if not frames:
         cols = {n: pd.Series([], dtype=object)
                 for n in exec_.schema.names}

@@ -12,6 +12,7 @@ path rides the mesh all_to_all in parallel/shuffle.py.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -31,12 +32,21 @@ class ShuffleExchangeExec(TpuExec):
     ('round_robin',) | ('single',)."""
 
     def __init__(self, partitioning: Tuple, num_out_partitions: int,
-                 child: TpuExec):
+                 child: TpuExec, task_threads: int = 1):
         super().__init__([child], child.schema)
         self.partitioning = partitioning
         self.num_out_partitions = num_out_partitions
+        # default 1 (serial): concurrency is an OPT-IN the planner wires
+        # from rapids.tpu.sql.taskThreads — unplumbed construction sites
+        # (python-UDF exchanges running arbitrary user code, tests) must
+        # not silently multithread
+        self.task_threads = task_threads
         # block store: output partition -> spillable sub-batches
         self._blocks: Optional[Dict[int, List[SpillableBatch]]] = None
+        # reduce tasks run on concurrent threads; the map side must
+        # materialize exactly once (Spark serializes this via stage
+        # boundaries — here a lock is the stage barrier)
+        self._mat_lock = threading.Lock()
 
     @property
     def num_partitions(self) -> int:
@@ -66,30 +76,65 @@ class ShuffleExchangeExec(TpuExec):
 
     def _materialize(self) -> None:
         """Map-side write: run the child once, cache partitioned blocks
-        (RapidsCachingWriter.write). Range partitioning with unresolved
-        bounds stages the input (spillable) and samples bounds host-side
-        first — the reference runs a separate sampling pass the same way
-        (GpuRangePartitioner.scala:42-95)."""
-        if self._blocks is not None:
-            return
-        source = self._input_batches()
-        if self.partitioning[0] == "range" and \
-                (len(self.partitioning) < 3 or
-                 self.partitioning[2] is None):
-            staged = [SpillableBatch(
-                b, priorities.INPUT_FROM_SHUFFLE_PRIORITY)
-                for b in source]
-            specs = list(self.partitioning[1])
-            if len(specs) > 1:
-                bounds = part_ops.sample_range_bounds_rows(
-                    staged, specs, list(self.schema.types),
-                    self.num_out_partitions)
+        (RapidsCachingWriter.write). Child partitions run as concurrent
+        map tasks on the task pool (device entry gated by the shared
+        TpuSemaphore inside the execs). Range partitioning with
+        unresolved bounds stages the input (spillable) and samples bounds
+        host-side first — the reference runs a separate sampling pass the
+        same way (GpuRangePartitioner.scala:42-95)."""
+        with self._mat_lock:
+            if self._blocks is not None:
+                return
+            if self.partitioning[0] == "range" and \
+                    (len(self.partitioning) < 3 or
+                     self.partitioning[2] is None):
+                from spark_rapids_tpu.execs.base import run_partitions
+
+                def stage_task(in_p: int):
+                    return [SpillableBatch(
+                        b, priorities.INPUT_FROM_SHUFFLE_PRIORITY)
+                        for b in self.children[0].execute(in_p)
+                        if b.realized_num_rows() > 0]
+
+                staged = [sb for part in run_partitions(
+                    self.children[0].num_partitions, stage_task,
+                    self.task_threads) for sb in part]
+                specs = list(self.partitioning[1])
+                if len(specs) > 1:
+                    bounds = part_ops.sample_range_bounds_rows(
+                        staged, specs, list(self.schema.types),
+                        self.num_out_partitions)
+                else:
+                    bounds = part_ops.sample_range_bounds_multi(
+                        staged, specs, list(self.schema.types),
+                        self.num_out_partitions)
+                self.partitioning = ("range", self.partitioning[1],
+                                     bounds)
+                source = self._drain_staged(staged)
+                blocks = self._write_blocks(source)
             else:
-                bounds = part_ops.sample_range_bounds_multi(
-                    staged, specs, list(self.schema.types),
-                    self.num_out_partitions)
-            self.partitioning = ("range", self.partitioning[1], bounds)
-            source = self._drain_staged(staged)
+                from spark_rapids_tpu.execs.base import run_partitions
+
+                def map_task(in_p: int):
+                    return self._write_blocks(
+                        b for b in self.children[0].execute(in_p)
+                        if b.realized_num_rows() > 0)
+
+                # merge per-map outputs in PARTITION order, not thread
+                # completion order: float aggregates downstream must see
+                # a deterministic batch order or a recomputed shared
+                # subtree (tpch q15's revenue view) sums to a different
+                # last-ulp value than its sibling
+                outs = run_partitions(self.children[0].num_partitions,
+                                      map_task, self.task_threads)
+                blocks = {p: [] for p in range(self.num_out_partitions)}
+                for out in outs:
+                    for p, subs in out.items():
+                        blocks[p].extend(subs)
+            self._blocks = blocks
+
+    def _write_blocks(self, source
+                      ) -> Dict[int, List[SpillableBatch]]:
         blocks: Dict[int, List[SpillableBatch]] = {
             p: [] for p in range(self.num_out_partitions)}
         for b in source:
@@ -101,7 +146,7 @@ class ShuffleExchangeExec(TpuExec):
                     continue
                 blocks[p].append(SpillableBatch(
                     sub, priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
-        self._blocks = blocks
+        return blocks
 
     def _input_batches(self):
         for in_p in range(self.children[0].num_partitions):
@@ -138,6 +183,7 @@ class BroadcastExchangeExec(TpuExec):
     def __init__(self, child: TpuExec):
         super().__init__([child], child.schema)
         self._cached: Optional[SpillableBatch] = None
+        self._mat_lock = threading.Lock()
 
     @property
     def num_partitions(self) -> int:
@@ -150,6 +196,10 @@ class BroadcastExchangeExec(TpuExec):
         return RequireSingleBatch
 
     def _materialize(self) -> SpillableBatch:
+        with self._mat_lock:
+            return self._materialize_locked()
+
+    def _materialize_locked(self) -> SpillableBatch:
         if self._cached is None:
             batches = []
             for p in range(self.children[0].num_partitions):
