@@ -48,12 +48,14 @@ class ScanExec(TpuExec):
                 yield ColumnarBatch.empty(self.schema)
                 return
             origin = self.source.split_origin(partition)
+            stats = self.source.split_stats(partition)
             with semaphore.get():
                 for start in range(0, n, self.batch_rows):
                     end = min(start + self.batch_rows, n)
                     with TraceRange("ScanExec.upload"):
                         b = interop.host_to_batch(data, validity,
-                                                  self.schema, start, end)
+                                                  self.schema, start, end,
+                                                  stats=stats)
                         b.origin = origin
                         yield b
         return timed(self, it())

@@ -16,9 +16,14 @@ from spark_rapids_tpu.columnar.column import Column, StringColumn
 def host_to_batch(data: Dict[str, np.ndarray],
                   validity: Dict[str, Optional[np.ndarray]],
                   schema: Schema, start: int = 0,
-                  end: Optional[int] = None) -> ColumnarBatch:
+                  end: Optional[int] = None,
+                  stats: Optional[Dict[str, tuple]] = None
+                  ) -> ColumnarBatch:
     """Upload a row range of host columns (the device-upload half of the
-    reference's scan path, GpuParquetScan.scala host buffer -> readParquet)."""
+    reference's scan path, GpuParquetScan.scala host buffer -> readParquet).
+    ``stats``: footer-derived {col: (min, max)} — when provided the
+    upload-time host min/max pass is skipped entirely (the footer already
+    paid for those numbers during pruning)."""
     cols = []
     n = None
     for name, typ in zip(schema.names, schema.types):
@@ -41,13 +46,18 @@ def host_to_batch(data: Dict[str, np.ndarray],
             col = Column.from_numpy(arr.astype(typ.np_dtype),
                                     dtype=typ, validity=v)
             if typ.is_integral or typ in (dt.DATE, dt.TIMESTAMP):
-                # upload-time (min, max): one vectorized host pass that
-                # lets the groupby kernel pick its packed-key sort lane
-                # (Column.stats; the parquet path gets the same numbers
-                # from footer statistics)
-                vals = arr if v is None else arr[v]
-                if len(vals):
-                    col.stats = (int(vals.min()), int(vals.max()))
+                s = stats.get(name) if stats is not None else None
+                if s is not None:
+                    # footer statistics: free bounds, no host pass
+                    col.stats = (int(s[0]), int(s[1]))
+                else:
+                    # upload-time (min, max): one vectorized host pass
+                    # that lets the groupby kernel pick its packed-key
+                    # sort lane (Column.stats). Also the per-column
+                    # fallback when a footer omitted this column's stats
+                    vals = arr if v is None else arr[v]
+                    if len(vals):
+                        col.stats = (int(vals.min()), int(vals.max()))
             cols.append(col)
     return ColumnarBatch(cols, n or 0)
 

@@ -65,6 +65,109 @@ def _passthrough_ref(e: Expression) -> Optional[int]:
     return None
 
 
+_I64 = (-(1 << 63), (1 << 63) - 1)
+
+
+def derive_stats(e: Expression, cols) -> Optional[tuple]:
+    """Host-known (min, max) of a projected expression, derived from the
+    input columns' stats where the transform's bounds are computable:
+    refs/aliases, casts between discrete types, +/-/* by integer
+    literals, pmod by a positive literal, year() of a date. Conservative
+    None everywhere else. This keeps the packed-key groupby path alive
+    through projections like ``GROUP BY k % 4`` or ``year(d)``
+    (round-2 verdict: stats died at the first projection)."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expressions import arithmetic as ar
+    from spark_rapids_tpu.expressions import datetime as dte
+    from spark_rapids_tpu.expressions.cast import Cast
+
+    e = _unwrap_alias(e)
+    if isinstance(e, BoundReference):
+        return getattr(cols[e.ordinal], "stats", None)
+    if isinstance(e, Cast):
+        if not (e.to.is_integral or e.to in (dt.DATE, dt.TIMESTAMP)):
+            return None
+        src_t = e.children[0].dtype
+        if (src_t is dt.TIMESTAMP) != (e.to is dt.TIMESTAMP):
+            # date<->timestamp casts SCALE (days vs microseconds);
+            # passing bounds through unscaled corrupts packed keys
+            return None
+        s = derive_stats(e.children[0], cols)
+        if s is None:
+            return None
+        lo, hi = int(s[0]), int(s[1])
+        if e.to.is_integral:
+            import numpy as np
+
+            info = np.iinfo(e.to.np_dtype)
+            if lo < info.min or hi > info.max:
+                return None  # would wrap; bounds no longer hold
+        return (lo, hi)
+    if isinstance(e, (ar.Add, ar.Subtract, ar.Multiply)):
+        sides = []
+        for c in e.children:
+            if isinstance(c, Literal) and isinstance(c.value, int):
+                sides.append(("lit", c.value))
+            else:
+                s = derive_stats(c, cols)
+                if s is None:
+                    return None
+                sides.append(("col", s))
+        kinds = [k for k, _ in sides]
+        if kinds == ["lit", "lit"]:
+            a, b = sides[0][1], sides[1][1]
+            v = (a + b if isinstance(e, ar.Add) else
+                 a - b if isinstance(e, ar.Subtract) else a * b)
+            return (v, v)
+        if "lit" not in kinds:
+            return None  # col-op-col bounds not tracked
+        (ka, va), (kb, vb) = sides
+        if ka == "lit":
+            lit, (lo, hi) = va, vb
+            if isinstance(e, ar.Subtract):
+                lo, hi = lit - hi, lit - lo
+            elif isinstance(e, ar.Add):
+                lo, hi = lo + lit, hi + lit
+            else:
+                lo, hi = sorted((lo * lit, hi * lit))
+        else:
+            (lo, hi), lit = va, vb
+            if isinstance(e, ar.Subtract):
+                lo, hi = lo - lit, hi - lit
+            elif isinstance(e, ar.Add):
+                lo, hi = lo + lit, hi + lit
+            else:
+                lo, hi = sorted((lo * lit, hi * lit))
+        # bounds must fit the EXPRESSION dtype: int32 arithmetic that
+        # wraps on device must not advertise unwrapped bounds
+        if e.dtype.is_integral:
+            import numpy as np
+
+            info = np.iinfo(e.dtype.np_dtype)
+            if lo < info.min or hi > info.max:
+                return None
+        elif lo < _I64[0] or hi > _I64[1]:
+            return None
+        return (lo, hi)
+    if isinstance(e, ar.Pmod):
+        m = e.children[1]
+        if isinstance(m, Literal) and isinstance(m.value, int) \
+                and m.value > 0:
+            return (0, m.value - 1)
+        return None
+    if isinstance(e, dte.Year):
+        s = derive_stats(e.children[0], cols)
+        if s is None or e.children[0].dtype is not dt.DATE:
+            return None
+        import numpy as np
+
+        base = np.datetime64("1970-01-01", "D")
+        y = [(base + np.timedelta64(int(v), "D")).astype(
+            "datetime64[Y]").astype(int) + 1970 for v in s[:2]]
+        return (int(y[0]), int(y[1]))  # year() is monotone over days
+    return None
+
+
 class CompiledProjection:
     """Callable batch->batch for a fixed projection list."""
 
@@ -137,11 +240,10 @@ class CompiledProjection:
                     cols.append(StringColumn(data, dictionary, validity))
                 else:
                     col = Column(e.dtype, data, validity)
-                    ref = _passthrough_ref(e)
-                    if ref is not None:
-                        # plain column refs keep upload/footer stats so
-                        # downstream groupbys can pick packed-key sorts
-                        col.stats = batch.columns[ref].stats
+                    # stats flow through refs AND derivable transforms
+                    # (+c, *c, pmod, casts, year) so downstream groupbys
+                    # keep the packed-key sort
+                    col.stats = derive_stats(e, batch.columns)
                     cols.append(col)
             return ColumnarBatch(cols, batch.num_rows)
         # eager path

@@ -25,6 +25,10 @@ from spark_rapids_tpu.io.filesrc import (FileSourceBase, Filter,
 class _RgSplit:
     path: str
     row_groups: tuple  # row-group ordinals within the file
+    # ((col, lo, hi), ...) aggregated over this split's row groups for
+    # columns where EVERY row group published min/max — free Column.stats
+    # for the packed-key groupby path (no upload-time host pass)
+    stats: tuple = ()
 
 
 def _stat_value(typ: dt.DType, v):
@@ -47,6 +51,25 @@ def _stat_value(typ: dt.DType, v):
             return int(v.timestamp() * 1_000_000)
         return v
     return v
+
+
+def _merge_rg_stats(per_rg: List[dict], types) -> tuple:
+    """Aggregate per-row-group (min, max) into split-level stats; a
+    column qualifies only when EVERY row group in the split published
+    min/max for it. Integral/date/timestamp columns only (the packed-key
+    consumers)."""
+    if not per_rg:
+        return ()
+    out = []
+    for cname, typ in types.items():
+        if not (typ.is_integral or typ in (dt.DATE, dt.TIMESTAMP)):
+            continue
+        vals = [rg.get(cname) for rg in per_rg]
+        if any(v is None or v[0] is None or v[1] is None for v in vals):
+            continue
+        out.append((cname, int(min(v[0] for v in vals)),
+                    int(max(v[1] for v in vals))))
+    return tuple(out)
 
 
 class ParquetSource(FileSourceBase):
@@ -75,7 +98,14 @@ class ParquetSource(FileSourceBase):
             name_to_col = {meta.schema.column(i).name: i
                            for i in range(meta.num_columns)}
             kept: List[int] = []
+            kept_stats: List[dict] = []
             kept_bytes = 0
+
+            def emit(kept, kept_stats):
+                splits.append(_RgSplit(
+                    path, tuple(kept),
+                    _merge_rg_stats(kept_stats, types)))
+
             for rg in range(meta.num_row_groups):
                 self.chunks_total += 1
                 rgmeta = meta.row_group(rg)
@@ -96,13 +126,21 @@ class ParquetSource(FileSourceBase):
                     continue
                 rg_bytes = rgmeta.total_byte_size
                 if kept and kept_bytes + rg_bytes > target:
-                    splits.append(_RgSplit(path, tuple(kept)))
-                    kept, kept_bytes = [], 0
+                    emit(kept, kept_stats)
+                    kept, kept_stats, kept_bytes = [], [], 0
                 kept.append(rg)
+                kept_stats.append(stats)
                 kept_bytes += rg_bytes
             if kept:
-                splits.append(_RgSplit(path, tuple(kept)))
+                emit(kept, kept_stats)
         return splits
+
+    def split_stats(self, split: int):
+        descs = self.splits()
+        if not descs:
+            return None
+        return dict((c, (lo, hi))
+                    for c, lo, hi in descs[split].stats) or None
 
     def _read_split(self, desc: _RgSplit):
         import pyarrow.parquet as pq

@@ -73,18 +73,33 @@ class AggSpec:
     ordinal: int = -1
 
 
+def quantize_range(lo: int, hi: int) -> Tuple[int, int]:
+    """Widen a (lo, hi) key range to a power-of-two span on an aligned
+    base. ``key_ranges`` is a STATIC jit argument — raw per-batch
+    min/max would compile a fresh kernel per distinct pair (a
+    compilation storm with per-row-group footer stats); quantized
+    ranges bound the distinct signatures to O(log(range) * alignments).
+    Correctness only needs a SUPERSET of the true range."""
+    span = max(hi - lo + 1, 1)
+    grid = 1 << (span - 1).bit_length()
+    qlo = (lo // grid) * grid          # base on a span-scale grid
+    need = hi - qlo + 1
+    p = 1 << (need - 1).bit_length()   # pow2 span covering [qlo, hi]
+    return (qlo, qlo + p - 1)
+
+
 def key_range_of(col: Column, dtype: dt.DType) -> Optional[Tuple[int, int]]:
-    """Host-known closed value range for packed-key grouping, if any.
-    String dictionaries and booleans always have one; numerics only when
-    the column carries stats."""
+    """Host-known closed value range for packed-key grouping, if any
+    (quantized — see quantize_range). String dictionaries and booleans
+    always have one; numerics only when the column carries stats."""
     if isinstance(col, StringColumn):
-        return (0, max(len(col.dictionary) - 1, 0))
+        return quantize_range(0, max(len(col.dictionary) - 1, 0))
     if dtype is dt.BOOLEAN:
         return (0, 1)
     if dtype.is_integral or dtype in (dt.DATE, dt.TIMESTAMP):
         s = getattr(col, "stats", None)
         if s is not None:
-            return (int(s[0]), int(s[1]))
+            return quantize_range(int(s[0]), int(s[1]))
     return None
 
 

@@ -106,6 +106,13 @@ class DataSource:
         (input_file_name support); None for non-file sources."""
         return None
 
+    def split_stats(self, split: int):
+        """{column: (min, max)} from file footer statistics for this
+        split, or None. Sources with footer stats feed Column.stats for
+        free (the packed-key groupby path) instead of an upload-time
+        host min/max pass."""
+        return None
+
 
 class InMemorySource(DataSource):
     """Host-resident columns (dict name -> numpy array / list), the analogue
