@@ -307,3 +307,195 @@ def replicate_dim(mesh: Mesh, arrays, dtypes, validities=None):
             np.asarray(v, dtype=bool)
         valids.append(jax.device_put(jnp.asarray(mask), sharding))
     return datas, valids
+
+
+class DistributedExpandJoinStep:
+    """Shuffled equi-join over the mesh with ARBITRARY fan-out
+    (fact x fact): the many-to-many shape the windowed unique-build step
+    (DistributedShuffledJoinStep) must dup-flag away. Single join key.
+
+    Both sides route rows by the key's int64 content image (injective —
+    not a lossy hash), so per-chip probes are EXACT:
+
+      1. all_to_all route both sides by key image,
+      2. sort the local build shard by image: each probe row's match run
+         is [searchsorted(left), searchsorted(right)) — exact count, no
+         collision window, no dup flag,
+      3. inner/left expand: output row j maps back to its probe row via
+         one searchsorted over the inclusive-cumsum of match counts,
+         then stream/build columns GATHER into a static ``out_cap``
+         buffer (the reference's cuDF join also gathers both sides,
+         GpuHashJoin.scala:302-318),
+      4. semi/anti need no expansion — mask + liveness compaction.
+
+    Output capacity is static; ``overflow`` flags chips whose true join
+    size exceeded it — the caller re-plans with a bigger bucket (a
+    recompile, bounded by pow2 capacity buckets), never wrong results.
+    """
+
+    def __init__(self, mesh: Mesh, kind: str,
+                 stream_dtypes: Sequence[dt.DType],
+                 build_dtypes: Sequence[dt.DType],
+                 stream_key: int, build_key: int, out_cap: int,
+                 axis: str = DATA_AXIS):
+        assert kind in ("inner", "left", "leftsemi", "leftanti"), kind
+        self.mesh = mesh
+        self.kind = kind
+        self.stream_dtypes = tuple(stream_dtypes)
+        self.build_dtypes = tuple(build_dtypes)
+        self.stream_key = stream_key
+        self.build_key = build_key
+        self.out_cap = out_cap
+        self.axis = axis
+        self.n_dev = mesh.shape[axis]
+        self._fn = self._build()
+
+    @property
+    def emits_build_columns(self) -> bool:
+        return self.kind in ("inner", "left")
+
+    def output_dtypes(self) -> List[dt.DType]:
+        out = list(self.stream_dtypes)
+        if self.emits_build_columns:
+            out += list(self.build_dtypes)
+        return out
+
+    def _build(self):
+        from spark_rapids_tpu.parallel.shuffle import (_exchange,
+                                                       _key_image)
+
+        kind = self.kind
+        n_dev = self.n_dev
+        axis = self.axis
+        sdt, bdt = self.stream_dtypes, self.build_dtypes
+        skey_o, bkey_o = self.stream_key, self.build_key
+        ocap = self.out_cap
+        emits_build = self.emits_build_columns
+        I64MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+
+        def device_step(s_datas, s_valids, s_count, b_datas, b_valids,
+                        b_count):
+            scap = s_datas[0].shape[0]
+            bcap = b_datas[0].shape[0]
+            s_live = jnp.arange(scap, dtype=jnp.int32) < s_count[0]
+            b_live = jnp.arange(bcap, dtype=jnp.int32) < b_count[0]
+            s_img = _key_image(s_datas[skey_o], s_valids[skey_o],
+                               sdt[skey_o])
+            b_img = _key_image(b_datas[bkey_o], b_valids[bkey_o],
+                               bdt[bkey_o])
+
+            def dest_of(img):
+                d = (jax.lax.rem(img, jnp.int64(n_dev)) +
+                     jnp.int64(n_dev)) % jnp.int64(n_dev)
+                return d.astype(jnp.int32)
+
+            ex_s_d, ex_s_v, s_total = _exchange(
+                list(s_datas), list(s_valids), dest_of(s_img), s_live,
+                n_dev, axis)
+            ex_b_d, ex_b_v, b_total = _exchange(
+                list(b_datas), list(b_valids), dest_of(b_img), b_live,
+                n_dev, axis)
+
+            pcap = ex_s_d[0].shape[0]
+            qcap = ex_b_d[0].shape[0]
+            p_iota = jnp.arange(pcap, dtype=jnp.int32)
+            q_iota = jnp.arange(qcap, dtype=jnp.int32)
+            p_live = p_iota < s_total
+            q_live = q_iota < b_total
+
+            p_img = _key_image(ex_s_d[skey_o], ex_s_v[skey_o],
+                               sdt[skey_o])
+            q_img = _key_image(ex_b_d[bkey_o], ex_b_v[bkey_o],
+                               bdt[bkey_o])
+            p_use = p_live & ex_s_v[skey_o]
+            q_use = q_live & ex_b_v[bkey_o]
+
+            # sort local build: USABLE rows first (by exact key image),
+            # dead/null rows after. The usable rows form a prefix, so
+            # clamping [lo, hi) to it makes sentinel collisions
+            # impossible — a live key equal to I64MAX can never match a
+            # dead row (r3 review finding)
+            use_rank = (~q_use).astype(jnp.int32)
+            q_key = jnp.where(q_use, q_img, I64MAX)
+            sorted_b = jax.lax.sort(
+                (use_rank, q_key) + tuple(ex_b_d) + tuple(ex_b_v),
+                num_keys=2, is_stable=True)
+            bq_key = sorted_b[1]
+            nb = len(ex_b_d)
+            bq_d = sorted_b[2:2 + nb]
+            bq_v = sorted_b[2 + nb:]
+            n_usable = jnp.sum(q_use).astype(jnp.int32)
+
+            probe = jnp.where(p_use, p_img, I64MAX)
+            lo = jnp.searchsorted(bq_key, probe,
+                                  side="left").astype(jnp.int32)
+            hi = jnp.searchsorted(bq_key, probe,
+                                  side="right").astype(jnp.int32)
+            lo = jnp.minimum(lo, n_usable)
+            hi = jnp.minimum(hi, n_usable)
+            nmatch = jnp.where(p_use, hi - lo, 0)
+            hit = nmatch > 0
+
+            if kind in ("leftsemi", "leftanti"):
+                live_out = (hit if kind == "leftsemi"
+                            else p_live & ~hit)
+                total = jnp.sum(live_out).astype(jnp.int32)
+                packed = jax.lax.sort(
+                    ((~live_out).astype(jnp.int32),) + tuple(ex_s_d) +
+                    tuple(ex_s_v), num_keys=1, is_stable=True)[1:]
+                ns = len(ex_s_d)
+                res_d = list(packed[:ns])
+                res_v = [v & (p_iota < total) for v in packed[ns:]]
+                return (res_d, res_v, total.reshape(1),
+                        total.astype(jnp.int64).reshape(1))
+
+            # inner/left expansion. int64 accumulation: a hot key can
+            # expand past 2^31 rows per chip — int32 would wrap the
+            # total negative and mask the overflow flag (r3 review)
+            emit = nmatch if kind == "inner" else \
+                jnp.where(p_live, jnp.maximum(nmatch, 1), 0)
+            csum = jnp.cumsum(emit.astype(jnp.int64))
+            total = csum[-1]  # TRUE size, returned so the caller can
+            # size the retry bucket exactly on overflow
+            j = jnp.arange(ocap, dtype=jnp.int64)
+            p_of = jnp.searchsorted(csum, j,
+                                    side="right").astype(jnp.int32)
+            p_of = jnp.clip(p_of, 0, pcap - 1)
+            start = (jnp.take(csum, p_of) -
+                     jnp.take(emit, p_of).astype(jnp.int64))
+            off = (j - start).astype(jnp.int32)
+            jlive = j < jnp.minimum(total, jnp.int64(ocap))
+            j = j.astype(jnp.int32)
+            b_of = jnp.clip(jnp.take(lo, p_of) + off, 0, qcap - 1)
+            matched = jnp.take(hit, p_of) & jlive
+            out_d = [jnp.take(d, p_of) for d in ex_s_d]
+            out_v = [jnp.take(v, p_of) & jlive for v in ex_s_v]
+            for jb in range(nb):
+                out_d.append(jnp.take(bq_d[jb], b_of))
+                out_v.append(jnp.take(bq_v[jb], b_of) & matched)
+            return (out_d, out_v,
+                    jnp.minimum(total,
+                                jnp.int64(ocap)).astype(jnp.int32)
+                    .reshape(1),
+                    total.reshape(1))
+
+        ax = self.axis
+        n_s, n_b = len(sdt), len(bdt)
+        n_out = n_s + (n_b if emits_build else 0)
+        in_specs = ([P(ax)] * n_s, [P(ax)] * n_s, P(ax),
+                    [P(ax)] * n_b, [P(ax)] * n_b, P(ax))
+        out_specs = ([P(ax)] * n_out, [P(ax)] * n_out, P(ax), P(ax))
+        fn = get_shims().shard_map()(device_step, mesh=self.mesh,
+                                     in_specs=in_specs,
+                                     out_specs=out_specs)
+        return jax.jit(fn)
+
+    def __call__(self, stream_datas, stream_valids, stream_counts,
+                 build_datas, build_valids, build_counts):
+        """Returns (out_datas, out_valids, out_counts, true_totals);
+        per-chip true_totals (int64, UNclamped) above out_cap mean the
+        static bucket was too small — the caller rebuilds with
+        bucket_capacity(max(true_totals)) and reruns, so one retry
+        always suffices."""
+        return self._fn(stream_datas, stream_valids, stream_counts,
+                        build_datas, build_valids, build_counts)

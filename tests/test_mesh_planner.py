@@ -148,10 +148,10 @@ def test_mesh_join_kinds_match_plain():
         _assert_frames_equal(got, want, sort_by=sort_cols[:2])
 
 
-def test_mesh_join_duplicate_build_keys_falls_back_correct():
-    # both sides carry duplicate keys -> many-to-many; the dup flag must
-    # fire on both orientations and the local kernel must produce the
-    # exact expansion
+def test_mesh_join_many_to_many_stays_on_mesh():
+    # both sides carry duplicate keys -> many-to-many; the single-key
+    # EXPANSION step handles arbitrary fan-out ON the mesh (round 3 —
+    # previously this shape dup-flagged and fell back to one device)
     rng = np.random.default_rng(9)
     left = pd.DataFrame({
         "k": rng.integers(0, 10, 200).astype(np.int64),
@@ -197,3 +197,83 @@ def test_mesh_groupby_null_keys_and_strings():
         gs.iloc[:, 1].to_numpy(np.float64),
         ws["sum"].to_numpy(np.float64), rtol=1e-9)
     assert gs.iloc[:, 2].tolist() == ws["size"].tolist()
+
+
+def test_mesh_expand_join_left_with_nulls():
+    """Left join, many-to-many, null keys on both sides: null keys never
+    match but left rows survive with null build columns."""
+    rng = np.random.default_rng(21)
+    left = pd.DataFrame({
+        "k": pd.array([None if x == 0 else int(x)
+                       for x in rng.integers(0, 8, 250)], dtype="Int64"),
+        "v": np.arange(250, dtype=np.int64)})
+    right = pd.DataFrame({
+        "k2": pd.array([None if x == 1 else int(x)
+                        for x in rng.integers(0, 8, 120)], dtype="Int64"),
+        "w": np.arange(120, dtype=np.int64)})
+    ms = _mesh_session()
+    got_df = ms.create_dataframe(left).join(
+        ms.create_dataframe(right), on=[("k", "k2")], how="left")
+    assert "MeshShuffledJoinExec" in got_df._exec().tree_string()
+    got = got_df.collect()
+    want = left.dropna().merge(right.dropna(), left_on="k",
+                               right_on="k2", how="inner")
+    matched_v = set(want["v"].tolist())
+    unmatched = [v for v in left["v"] if v not in matched_v]
+    assert len(got) == len(want) + len(unmatched)
+    g_matched = got[got["w"].notna()]
+    assert sorted(g_matched["v"].tolist()) == sorted(want["v"].tolist())
+
+
+def test_mesh_expand_join_overflow_grows_bucket():
+    """A single hot key whose expansion exceeds the initial static
+    output bucket: the step must grow the bucket (recompile), never
+    return truncated results."""
+    left = pd.DataFrame({"k": np.zeros(200, dtype=np.int64),
+                         "v": np.arange(200, dtype=np.int64)})
+    right = pd.DataFrame({"k2": np.zeros(150, dtype=np.int64),
+                          "w": np.arange(150, dtype=np.int64)})
+    ms = _mesh_session()
+    got_df = ms.create_dataframe(left).join(
+        ms.create_dataframe(right), on=[("k", "k2")], how="inner")
+    assert "MeshShuffledJoinExec" in got_df._exec().tree_string()
+    got = got_df.collect()
+    assert len(got) == 200 * 150
+    assert got["v"].sum() == 150 * np.arange(200).sum()
+    assert got["w"].sum() == 200 * np.arange(150).sum()
+
+
+def test_mesh_global_sort():
+    """ORDER BY lowers onto the mesh (sampled bounds + all_to_all +
+    per-chip sort) and the gathered result is globally ordered —
+    including DESC keys, nulls, floats and ties."""
+    rng = np.random.default_rng(33)
+    n = 3000
+    df = pd.DataFrame({
+        "a": rng.integers(0, 50, n).astype(np.int64),
+        "b": pd.array([None if x == 0 else float(x)
+                       for x in np.round(rng.random(n) * 4, 1)],
+                      dtype="Float64"),
+        "s": rng.choice(["p", "q", "r"], n),
+    })
+    ms = _mesh_session()
+    mdf = ms.create_dataframe(df).order_by("a", "b",
+                                           ascending=[True, False])
+    plan = mdf._exec().tree_string()
+    assert "MeshSortExec" in plan, plan
+    got = mdf.collect()
+
+    ps = _plain_session()
+    want = ps.create_dataframe(df).order_by(
+        "a", "b", ascending=[True, False]).collect()
+    assert len(got) == n
+    np.testing.assert_array_equal(got["a"].to_numpy(),
+                                  want["a"].to_numpy())
+    gb = got["b"].to_numpy(dtype=object)
+    wb = want["b"].to_numpy(dtype=object)
+    for i in range(n):
+        gv = None if gb[i] is None or (isinstance(gv := gb[i], float)
+                                       and np.isnan(gv)) else float(gb[i])
+        wv = None if wb[i] is None or (isinstance(wv := wb[i], float)
+                                       and np.isnan(wv)) else float(wb[i])
+        assert gv == wv, (i, gb[i], wb[i])
