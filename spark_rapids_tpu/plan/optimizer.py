@@ -128,13 +128,11 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
                for a in dist):
         return node  # (Average has no distinct form to rewrite)
     if not all(isinstance(a.fn, (aggfn.Count, aggfn.Sum, aggfn.Min,
-                                 aggfn.Max)) for a in plain):
+                                 aggfn.Max, aggfn.Average))
+               for a in plain):
         return node  # non-decomposable plain aggregate alongside
-    if not node.grouping and any(isinstance(a.fn, aggfn.Count)
-                                 for a in plain):
-        # ungrouped count(a) merges via Sum whose empty-input default is
-        # NULL, not Count's 0 — keep the unrewritten (fallback) plan
-        return node
+    # (ungrouped plain Counts merge via Sum whose empty-input default is
+    # NULL, not Count's 0 — the final projection coalesces them back)
     inputs = [a.fn.children[0] if a.fn.children else None
               for a in dist]
     if any(i is None for i in inputs):
@@ -146,31 +144,90 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
 
     nkeys = len(node.grouping)
     inner_aggs = []
-    for j, a in enumerate(plain):
+    inner_ords = {}  # id(plain call) -> inner agg ordinals
+    for a in plain:
         fn = a.fn
-        clone = type(fn)(*fn.children) if fn.children else type(fn)()
-        inner_aggs.append(pn.AggCall(clone, f"_p{j}"))
+        i0 = len(inner_aggs)
+        if isinstance(fn, aggfn.Average):
+            # avg is not avg-of-avgs decomposable: split into sum+count
+            # partials, re-divided by a final projection
+            inner_aggs.append(pn.AggCall(aggfn.Sum(fn.children[0]),
+                                         f"_p{i0}"))
+            inner_aggs.append(pn.AggCall(aggfn.Count(fn.children[0]),
+                                         f"_p{i0 + 1}"))
+            inner_ords[id(a)] = [i0, i0 + 1]
+        else:
+            clone = type(fn)(*fn.children) if fn.children else type(fn)()
+            inner_aggs.append(pn.AggCall(clone, f"_p{i0}"))
+            inner_ords[id(a)] = [i0]
     inner = pn.AggregateNode(
         list(node.grouping) + [inputs[0]], inner_aggs, node.children[0],
         grouping_names=list(node.grouping_names) + ["__distinct"])
     x = BoundReference(nkeys, inputs[0].dtype)
-    plain_index = {id(a): j for j, a in enumerate(plain)}
     outer_aggs = []
+    out_spec = []  # per original agg: ("ref", j) | ("div", j1, j2)
     for a in node.aggs:
         if getattr(a.fn, "distinct", False):
+            out_spec.append(("ref", len(outer_aggs)))
             outer_aggs.append(pn.AggCall(type(a.fn)(x), a.name))
+            continue
+        ords = inner_ords[id(a)]
+        if isinstance(a.fn, aggfn.Average):
+            j1, j2 = len(outer_aggs), len(outer_aggs) + 1
+            for o in ords:
+                ref = BoundReference(nkeys + 1 + o,
+                                     inner_aggs[o].fn.dtype)
+                outer_aggs.append(pn.AggCall(aggfn.Sum(ref),
+                                             f"{a.name}_{o}"))
+            out_spec.append(("div", j1, j2))
         else:
-            j = plain_index[id(a)]
-            ref = BoundReference(nkeys + 1 + j,
-                                 inner_aggs[j].fn.dtype)
+            o = ords[0]
+            ref = BoundReference(nkeys + 1 + o,
+                                 inner_aggs[o].fn.dtype)
             merge = aggfn.Sum if isinstance(a.fn, (aggfn.Count,
                                                    aggfn.Sum)) else \
                 type(a.fn)
+            kind = "coalesce0" if (not node.grouping and
+                                   isinstance(a.fn, aggfn.Count)) \
+                else "ref"
+            out_spec.append((kind, len(outer_aggs)))
             outer_aggs.append(pn.AggCall(merge(ref), a.name))
     outer_keys = [BoundReference(i, e.dtype)
                   for i, e in enumerate(node.grouping)]
-    return pn.AggregateNode(outer_keys, outer_aggs, inner,
-                            grouping_names=list(node.grouping_names))
+    out = pn.AggregateNode(outer_keys, outer_aggs, inner,
+                           grouping_names=list(node.grouping_names))
+    if all(k == "ref" for k, *_ in out_spec):
+        return out
+    from spark_rapids_tpu.expressions.arithmetic import Divide
+
+    schema = out.output_schema()
+    exprs = [Alias(BoundReference(i, schema.types[i]), schema.names[i])
+             for i in range(nkeys)]
+    names = list(schema.names[:nkeys])
+    for spec, a in zip(out_spec, node.aggs):
+        if spec[0] == "ref":
+            j = nkeys + spec[1]
+            exprs.append(Alias(BoundReference(j, schema.types[j]),
+                               a.name))
+        elif spec[0] == "coalesce0":
+            from spark_rapids_tpu.expressions import conditional as cd_
+            from spark_rapids_tpu.expressions.base import Literal
+            from spark_rapids_tpu.columnar import dtypes as dt_
+
+            j = nkeys + spec[1]
+            exprs.append(Alias(cd_.Coalesce(
+                [BoundReference(j, schema.types[j]),
+                 Literal(0, dt_.INT64)]), a.name))
+        else:
+            _, j1, j2 = spec
+            exprs.append(Alias(
+                Divide(BoundReference(nkeys + j1,
+                                      schema.types[nkeys + j1]),
+                       BoundReference(nkeys + j2,
+                                      schema.types[nkeys + j2])),
+                a.name))
+        names.append(a.name)
+    return pn.ProjectNode(exprs, out, names)
 
 
 def optimize(plan: pn.PlanNode) -> pn.PlanNode:

@@ -445,9 +445,13 @@ class _AggregateRule(NodeRule):
                 ("hash", list(range(nkeys))),
                 min(cfg.resolve_shuffle_partitions(meta.conf),
                     max(child.num_partitions, 1)),
-                partial), meta.conf)
+                partial,
+                task_threads=meta.conf.get(cfg.TASK_THREADS)),
+                meta.conf)
         else:
-            ex = exchange.ShuffleExchangeExec(("single",), 1, partial)
+            ex = exchange.ShuffleExchangeExec(
+                ("single",), 1, partial,
+                task_threads=meta.conf.get(cfg.TASK_THREADS))
         final_grouping = [BoundReference(i, e.dtype)
                           for i, e in enumerate(node.grouping)]
         return agg_exec.HashAggregateExec(
@@ -462,6 +466,12 @@ class _SortRule(NodeRule):
     def convert(self, meta, children):
         node: pn.SortNode = meta.node
         child = children[0]
+        mesh = _session_mesh(meta.conf)
+        if node.global_sort and mesh is not None:
+            from spark_rapids_tpu.parallel.execs import MeshSortExec
+
+            return MeshSortExec(node.specs, child,
+                                node.output_schema(), meta.conf, mesh)
         if node.global_sort and child.num_partitions > 1:
             parts = min(cfg.resolve_shuffle_partitions(meta.conf),
                         child.num_partitions)
@@ -472,10 +482,12 @@ class _SortRule(NodeRule):
                 # single-partition funnel (GpuRangePartitioning +
                 # GpuSortExec, avoiding the SURVEY §5.7 cliff)
                 child = exchange.ShuffleExchangeExec(
-                    ("range", list(node.specs), None), parts, child)
+                    ("range", list(node.specs), None), parts, child,
+                    task_threads=meta.conf.get(cfg.TASK_THREADS))
             else:
-                child = exchange.ShuffleExchangeExec(("single",), 1,
-                                                     child)
+                child = exchange.ShuffleExchangeExec(
+                    ("single",), 1, child,
+                    task_threads=meta.conf.get(cfg.TASK_THREADS))
         return sort.SortExec(node.specs, child,
                              global_sort=node.global_sort)
 
@@ -486,7 +498,9 @@ class _LimitRule(NodeRule):
         child = children[0]
         limited = basic.LocalLimitExec(node.n, child)
         if node.global_limit and child.num_partitions > 1:
-            ex = exchange.ShuffleExchangeExec(("single",), 1, limited)
+            ex = exchange.ShuffleExchangeExec(
+                ("single",), 1, limited,
+                task_threads=meta.conf.get(cfg.TASK_THREADS))
             return basic.LocalLimitExec(node.n, ex)
         return limited
 
@@ -607,8 +621,9 @@ class _JoinRule(NodeRule):
                 not meta.conf.get(_CARTESIAN_FLAG))
             if use_bnlj:
                 if right.num_partitions > 1:
-                    right = exchange.ShuffleExchangeExec(("single",), 1,
-                                                         right)
+                    right = exchange.ShuffleExchangeExec(
+                        ("single",), 1, right,
+                        task_threads=meta.conf.get(cfg.TASK_THREADS))
                 build = exchange.BroadcastExchangeExec(right)
                 return joins.BroadcastNestedLoopJoinExec(
                     left, _ReplayExec(build, left.num_partitions),
@@ -617,8 +632,11 @@ class _JoinRule(NodeRule):
                                               cond, meta.conf)
         if multi:
             parts = cfg.resolve_shuffle_partitions(meta.conf)
-            lex = exchange.ShuffleExchangeExec(("hash", lk), parts, left)
-            rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right)
+            tt = meta.conf.get(cfg.TASK_THREADS)
+            lex = exchange.ShuffleExchangeExec(("hash", lk), parts, left,
+                                               task_threads=tt)
+            rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right,
+                                               task_threads=tt)
             if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1:
                 # one shared group spec keeps the sides partition-aligned
                 left, right = adaptive_exec.paired_adaptive_readers(
@@ -749,10 +767,13 @@ class _WindowRule(NodeRule):
             if node.partition_ordinals:
                 parts = cfg.resolve_shuffle_partitions(meta.conf)
                 child = _adaptive_read(exchange.ShuffleExchangeExec(
-                    ("hash", node.partition_ordinals), parts, child),
+                    ("hash", node.partition_ordinals), parts, child,
+                    task_threads=meta.conf.get(cfg.TASK_THREADS)),
                     meta.conf)
             else:
-                child = exchange.ShuffleExchangeExec(("single",), 1, child)
+                child = exchange.ShuffleExchangeExec(
+                    ("single",), 1, child,
+                    task_threads=meta.conf.get(cfg.TASK_THREADS))
         return window.WindowExec(node.partition_ordinals, node.order_specs,
                                  node.calls, child, node.output_schema(),
                                  meta.conf)
@@ -767,9 +788,9 @@ class _CoalescePartitionsRule(NodeRule):
 class _ExchangeRule(NodeRule):
     def convert(self, meta, children):
         node: pn.ShuffleExchangeNode = meta.node
-        return exchange.ShuffleExchangeExec(node.partitioning,
-                                            node.num_partitions,
-                                            children[0])
+        return exchange.ShuffleExchangeExec(
+            node.partitioning, node.num_partitions, children[0],
+            task_threads=meta.conf.get(cfg.TASK_THREADS))
 
 
 class _BroadcastRule(NodeRule):
@@ -800,10 +821,13 @@ class _CoGroupedMapRule(NodeRule):
         left, right = children
         if left.num_partitions > 1 or right.num_partitions > 1:
             parts = cfg.resolve_shuffle_partitions(meta.conf)
+            tt = meta.conf.get(cfg.TASK_THREADS)
             left = exchange.ShuffleExchangeExec(
-                ("hash", list(node.left_ordinals)), parts, left)
+                ("hash", list(node.left_ordinals)), parts, left,
+                task_threads=tt)
             right = exchange.ShuffleExchangeExec(
-                ("hash", list(node.right_ordinals)), parts, right)
+                ("hash", list(node.right_ordinals)), parts, right,
+                task_threads=tt)
         return CoGroupedMapInPandasExec(node, left, right)
 
 

@@ -47,7 +47,9 @@ _KEYWORDS = {
     "is", "null", "case", "when", "then", "else", "end", "cast", "join",
     "inner", "left", "right", "full", "outer", "semi", "anti", "cross",
     "on", "asc", "desc", "nulls", "first", "last", "date", "timestamp",
-    "true", "false", "interval",
+    "true", "false", "interval", "with", "union", "all", "over",
+    "partition", "rows", "unbounded", "preceding", "following",
+    "current", "row",
 }
 
 
@@ -118,6 +120,51 @@ class _Parser:
     # -- query -------------------------------------------------------------
 
     def parse_query(self):
+        """query := [WITH ctes] core (UNION [ALL] core)* [ORDER BY ...]
+        [LIMIT n]. A plain SELECT keeps the legacy ('select', {...})
+        shape; unions return ('union', {...})."""
+        ctes = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.expect_ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.parse_query()
+                self.expect_op(")")
+                ctes.append((name, sub))
+                if not self.accept_op(","):
+                    break
+        core = self.parse_select_core()
+        cores = [core]
+        alls = []
+        while self.accept_kw("union"):
+            alls.append(bool(self.accept_kw("all")))
+            cores.append(self.parse_select_core())
+        order, limit = self.parse_order_limit()
+        if len(cores) == 1:
+            core[1]["order"] = order
+            core[1]["limit"] = limit
+            core[1]["ctes"] = ctes
+            return core
+        return ("union", {"cores": cores, "alls": alls, "order": order,
+                          "limit": limit, "ctes": ctes})
+
+    def parse_order_limit(self):
+        order = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = [self.parse_order_item()]
+            while self.accept_op(","):
+                order.append(self.parse_order_item())
+        limit = None
+        if self.accept_kw("limit"):
+            kind, text = self.next()
+            if kind != "num" or not re.fullmatch(r"\d+", text):
+                raise SqlError("LIMIT needs an integer")
+            limit = int(text)
+        return order, limit
+
+    def parse_select_core(self):
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         sels = [self.parse_select_item()]
@@ -137,22 +184,10 @@ class _Parser:
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()
-        order = []
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            order = [self.parse_order_item()]
-            while self.accept_op(","):
-                order.append(self.parse_order_item())
-        limit = None
-        if self.accept_kw("limit"):
-            kind, text = self.next()
-            if kind != "num" or not re.fullmatch(r"\d+", text):
-                raise SqlError("LIMIT needs an integer")
-            limit = int(text)
         return ("select", {"distinct": distinct, "sels": sels,
                            "from": rel, "where": where, "group": group,
-                           "having": having, "order": order,
-                           "limit": limit})
+                           "having": having, "order": [],
+                           "limit": None, "ctes": []})
 
     def parse_select_item(self):
         if self.accept_op("*"):
@@ -328,6 +363,10 @@ class _Parser:
         kind, text = self.peek()
         if kind == "op" and text == "(":
             self.next()
+            if self.peek() in (("kw", "select"), ("kw", "with")):
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ("scalar_sub", sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e
@@ -350,6 +389,22 @@ class _Parser:
             if text == "null":
                 self.next()
                 return ("lit", None, "null")
+            if text == "interval":
+                # INTERVAL 'n' DAY -> day-count marker consumed by +/-
+                self.next()
+                kind2, s = self.next()
+                if kind2 == "str":
+                    n = int(s[1:-1])
+                elif kind2 == "num":
+                    n = int(s)
+                else:
+                    raise SqlError("INTERVAL needs a number")
+                unit = self.expect_ident().lower()
+                mult = {"day": 1, "days": 1, "week": 7,
+                        "weeks": 7}.get(unit)
+                if mult is None:
+                    raise SqlError(f"unsupported INTERVAL unit {unit!r}")
+                return ("interval", n * mult)
             if text in ("true", "false"):
                 self.next()
                 return ("lit", text == "true", "bool")
@@ -379,13 +434,63 @@ class _Parser:
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
-                return ("call", name, distinct, args)
+                call = ("call", name, distinct, args)
+                if self.accept_kw("over"):
+                    return self.parse_over(call)
+                return call
             tab_or_col = self.expect_ident()
             if self.accept_op("."):
                 col = self.expect_ident()
                 return ("col", tab_or_col, col)
             return ("col", None, tab_or_col)
         raise SqlError(f"unexpected token {text!r}")
+
+    def parse_over(self, call):
+        """OVER '(' [PARTITION BY exprs] [ORDER BY items]
+        [ROWS BETWEEN a AND b] ')' -> ('winfn', call, partition,
+        order, frame). Frame bounds: None=unbounded, 0=current row,
+        +-n=offset rows; default frame is the SQL standard (whole
+        partition without ORDER BY, running with it)."""
+        self.expect_op("(")
+        partition = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition = [self.parse_expr()]
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        order = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = [self.parse_order_item()]
+            while self.accept_op(","):
+                order.append(self.parse_order_item())
+        frame = None
+        if self.accept_kw("rows"):
+            self.expect_kw("between")
+
+            def bound(which):
+                if self.accept_kw("unbounded"):
+                    self.expect_kw("preceding" if which == "lo"
+                                   else "following")
+                    return None
+                if self.accept_kw("current"):
+                    self.expect_kw("row")
+                    return 0
+                kind, text = self.next()
+                if kind != "num" or not re.fullmatch(r"\d+", text):
+                    raise SqlError("ROWS bound needs an integer")
+                n = int(text)
+                if self.accept_kw("preceding"):
+                    return -n
+                self.expect_kw("following")
+                return n
+
+            lo = bound("lo")
+            self.expect_kw("and")
+            hi = bound("hi")
+            frame = (lo, hi)
+        self.expect_op(")")
+        return ("winfn", call, partition, order, frame)
 
     def parse_case(self):
         self.expect_kw("case")

@@ -241,8 +241,24 @@ class _ExprPlanner:
             if isinstance(e, Literal) and isinstance(e.value, (int, float)):
                 return Literal(-e.value)
             return ar.UnaryMinus(e)
+        if kind == "interval":
+            raise SqlError("INTERVAL only valid in date +/- interval")
         if kind == "arith":
             _, op, l, r = ast
+            # date +/- INTERVAL 'n' DAY
+            if isinstance(r, tuple) and r[0] == "interval" and \
+                    op in ("+", "-"):
+                base = _as_date(self.plan(l))
+                n = r[1] if op == "+" else -r[1]
+                if isinstance(base, Literal):
+                    return Literal(base.value + n, dt.DATE)
+                return (dte.DateAdd if op == "+" else
+                        dte.DateSub)(base, Literal(abs(n), dt.INT32))
+            if isinstance(l, tuple) and l[0] == "interval" and op == "+":
+                base = _as_date(self.plan(r))
+                if isinstance(base, Literal):
+                    return Literal(base.value + l[1], dt.DATE)
+                return dte.DateAdd(base, Literal(l[1], dt.INT32))
             lhs, rhs = self.plan(l), self.plan(r)
             if isinstance(lhs, Literal) and isinstance(rhs, Literal) \
                     and lhs.value is not None and rhs.value is not None \
@@ -296,14 +312,25 @@ class _ExprPlanner:
         if kind == "case":
             _, whens, els = ast
             pairs = [(self.plan(c), self.plan(v)) for c, v in whens]
-            els_e = self.plan(els) if els is not None else \
-                Literal(None, pairs[0][1].dtype)
+            if els is None or els == ("lit", None, "null"):
+                # explicit ELSE NULL types from the THEN branches
+                els_e = Literal(None, pairs[0][1].dtype)
+            else:
+                els_e = self.plan(els)
             return cond.CaseWhen(pairs, els_e)
         if kind == "cast":
             to = _CAST_TYPES.get(ast[2])
             if to is None:
                 raise SqlError(f"unknown cast type {ast[2]!r}")
-            return Cast(self.plan(ast[1]), to)
+            e = self.plan(ast[1])
+            # fold literal string->date/timestamp casts (scalar-only
+            # subtrees must not reach the jit tracer)
+            if isinstance(e, Literal) and isinstance(e.value, str):
+                if to is dt.DATE:
+                    return Literal(_date_days(e.value), dt.DATE)
+                if to is dt.TIMESTAMP:
+                    return Literal(_ts_us(e.value), dt.TIMESTAMP)
+            return Cast(e, to)
         if kind == "call":
             _, name, distinct, args = ast
             if name in _AGG_FNS:
@@ -328,9 +355,10 @@ class _ExprPlanner:
         return Literal(v)
 
 
-def _plan_agg_call(ast, scope: _Scope) -> A.AggregateFunction:
+def _plan_agg_call(ast, scope: _Scope,
+                   env=None) -> A.AggregateFunction:
     _, name, distinct, args = ast
-    ep = _ExprPlanner(scope)
+    ep = _ExprPlanner(scope, env)
     if name == "count":
         if args and args[0] != ("star",):
             arg = ep.plan(args[0])
@@ -358,6 +386,18 @@ def _plan_agg_call(ast, scope: _Scope) -> A.AggregateFunction:
 
 def _collect_agg_calls(ast, out: List):
     if not isinstance(ast, tuple):
+        return
+    if ast[0] == "winfn":
+        # the OUTER call is a window function (evaluated after
+        # grouping); only its arguments, partition and order keys may
+        # reference group aggregates ("rank() over (order by sum(x))")
+        _, call, partition, order, _frame = ast
+        for a in call[3]:
+            _collect_agg_calls(a, out)
+        for p_ in partition:
+            _collect_agg_calls(p_, out)
+        for e_, _a, _n in order:
+            _collect_agg_calls(e_, out)
         return
     if ast[0] == "call" and ast[1] in _AGG_FNS:
         if repr(ast) not in {repr(o) for o in out}:
@@ -701,10 +741,216 @@ def _apply_in_subs(node, scope, subs, catalog):
     return node
 
 
+def _replace_scalar_subs(ast, acc: List, prefix: str = "_ssq"):
+    """Replace ('scalar_sub', q) nodes with generated column refs;
+    ``acc`` collects (gen_name, subquery_ast)."""
+    if not isinstance(ast, tuple):
+        return ast
+    if ast[0] == "scalar_sub":
+        gen = f"{prefix}{len(acc)}"
+        acc.append((gen, ast[1]))
+        return ("col", None, gen)
+    out = []
+    for p in ast:
+        if isinstance(p, tuple):
+            out.append(_replace_scalar_subs(p, acc, prefix))
+        elif isinstance(p, list):
+            out.append([_replace_scalar_subs(x, acc, prefix)
+                        if isinstance(x, tuple) else x for x in p])
+        else:
+            out.append(p)
+    return tuple(out)
+
+
+def _attach_scalar_subs(node, scope: _Scope, subs, catalog):
+    """Cross-join 1-row scalar-subquery plans, extending the scope.
+    (Aggregate scalar subqueries always produce exactly one row; a
+    multi-row subquery here is a user error SQL rejects at runtime.)"""
+    for gen, sub in subs:
+        subnode = plan_statement(sub, catalog)
+        ss = subnode.output_schema()
+        if len(ss) != 1:
+            raise SqlError("scalar subquery must select one column")
+        node = pn.JoinNode("cross", node, subnode, [], [])
+        scope = _Scope(scope.entries + [(None, gen, ss.types[0])])
+    return node, scope
+
+
+def _contains_col(ast, names: set) -> bool:
+    refs: List = []
+    _col_refs(ast, refs)
+    return any(n.lower() in names for _, _t, n in refs)
+
+
+def _collect_winfns(ast, out: List):
+    if not isinstance(ast, tuple):
+        return
+    if ast[0] == "winfn":
+        if repr(ast) not in {repr(o) for o in out}:
+            out.append(ast)
+        return  # windows over windows are unsupported
+    for p in ast:
+        if isinstance(p, tuple):
+            _collect_winfns(p, out)
+        elif isinstance(p, list):
+            for x in p:
+                if isinstance(x, tuple):
+                    _collect_winfns(x, out)
+
+
+def _plan_window(wast, node, scope: _Scope, env):
+    """One ('winfn', call, partition, order, frame) -> WindowNode.
+    Partition/order expressions that are not plain columns are
+    materialized by a pre-projection (the planner-inserted project the
+    reference gets from Catalyst before GpuWindowExec)."""
+    _, call, partition, order, frame = wast
+    planner = _ExprPlanner(scope, env)
+    extra: List[Expression] = []
+    base = scope.width
+
+    def ordinal_of(e_ast) -> int:
+        expr = planner.plan(e_ast)
+        if isinstance(expr, BoundReference):
+            return expr.ordinal
+        extra.append(expr)
+        return base + len(extra) - 1
+
+    part_ords = [ordinal_of(p) for p in partition]
+    specs = [SortKeySpec(ordinal_of(e), asc, nf)
+             for e, asc, nf in order]
+
+    fname = call[1]
+    if fname in ("rank", "dense_rank", "row_number"):
+        if call[3]:
+            raise SqlError(f"{fname}() takes no arguments")
+        if not specs:
+            raise SqlError(f"{fname}() requires ORDER BY in OVER()")
+        fn = fname
+        wframe = pn.WindowFrame(None, 0)
+    elif fname in ("lead", "lag"):
+        args = call[3]
+        if not args:
+            raise SqlError(f"{fname}(col[, offset]) requires a column")
+        fn = (fname, planner.plan(args[0]))
+        wframe = pn.WindowFrame(None, 0)
+    else:
+        agg = _plan_agg_call(call, scope, env)
+        fn = agg
+        if frame is not None:
+            wframe = pn.WindowFrame(frame[0], frame[1])
+        elif specs:
+            wframe = pn.WindowFrame(None, 0)   # running (SQL default)
+        else:
+            wframe = pn.WindowFrame(None, None)  # whole partition
+    if extra:
+        schema = node.output_schema()
+        exprs = [Alias(BoundReference(i, t), schema.names[i])
+                 for i, t in enumerate(schema.types)]
+        names = list(schema.names)
+        for j, e in enumerate(extra):
+            exprs.append(Alias(e, f"_wk{j}"))
+            names.append(f"_wk{j}")
+        node = pn.ProjectNode(exprs, node, names)
+        scope = _Scope(scope.entries +
+                       [(None, f"_wk{j}", e.dtype)
+                        for j, e in enumerate(extra)])
+    gen = f"_win{len(env)}"
+    wcall = pn.WindowCall(fn, gen, frame=wframe)
+    node = pn.WindowNode(part_ords, specs, [wcall], node)
+    out_schema = node.output_schema()
+    new_ord = len(out_schema) - 1
+    env = dict(env)
+    env[repr(wast)] = (new_ord, out_schema.types[new_ord])
+    scope = _Scope(scope.entries +
+                   [(None, gen, out_schema.types[new_ord])])
+    return node, scope, env
+
+
+def _plan_union(q, catalog) -> pn.PlanNode:
+    """UNION [ALL] chain: left-associative UnionNode; plain UNION wraps
+    a dedup group-by after each merge (SQL set semantics)."""
+    nodes = [plan_statement(c, catalog) for c in q["cores"]]
+    node = nodes[0]
+    for i, rhs in enumerate(nodes[1:]):
+        node = pn.UnionNode([node, rhs])
+        if not q["alls"][i]:
+            schema = node.output_schema()
+            node = pn.AggregateNode(
+                [BoundReference(j, t)
+                 for j, t in enumerate(schema.types)],
+                [], node, grouping_names=list(schema.names))
+    if q["order"]:
+        schema = node.output_schema()
+        specs = []
+        for e, asc, nulls_first in q["order"]:
+            if e[0] == "lit" and isinstance(e[1], int):
+                ordinal = e[1] - 1
+            elif e[0] == "col" and e[1] is None and \
+                    e[2] in schema.names:
+                ordinal = schema.names.index(e[2])
+            else:
+                raise SqlError("UNION ORDER BY must use output names "
+                               "or positions")
+            specs.append(SortKeySpec(ordinal, asc, nulls_first))
+        node = pn.SortNode(specs, node)
+    if q["limit"] is not None:
+        node = pn.LimitNode(q["limit"], node)
+    return node
+
+
 def plan_statement(ast, catalog) -> pn.PlanNode:
-    assert ast[0] == "select"
     q = ast[1]
+    if q.get("ctes"):
+        # CTEs: plan each once into a catalog copy (Spark's
+        # CTESubstitution); self-references across branches share the
+        # plan node, like temp views
+        catalog = dict(catalog)
+        for name, sub in q["ctes"]:
+            catalog[name] = plan_statement(sub, catalog)
+    if ast[0] == "union":
+        return _plan_union(q, catalog)
+    assert ast[0] == "select"
     where_ast, in_subs = _extract_in_subs(q["where"])
+
+    # uncorrelated scalar subqueries: each becomes a generated column
+    # fed by a 1-row cross join (Spark's ScalarSubquery via subquery
+    # broadcast). WHERE-referenced subs (and subs used INSIDE aggregate
+    # arguments) attach before aggregation; SELECT/HAVING-level subs
+    # attach AFTER it — the aggregate's output schema would drop them
+    # (TPC-DS q32/q92 shape: sum(x) > (SELECT ...))
+    ssq_post: List = []
+    q = dict(q)
+    q["sels"] = [(_replace_scalar_subs(e, ssq_post), a)
+                 for e, a in q["sels"]]
+    if q["having"] is not None:
+        q["having"] = _replace_scalar_subs(q["having"], ssq_post)
+    ssq_pre: List = []
+    deferred_where = []
+    if where_ast is not None:
+        kept = None
+        for c in _conjuncts(where_ast):
+            before = len(ssq_pre)
+            c2 = _replace_scalar_subs(c, ssq_pre, prefix="_ssqw")
+            if len(ssq_pre) > before:
+                deferred_where.append(c2)
+            else:
+                kept = c2 if kept is None else ("and", kept, c2)
+        where_ast = kept
+    # subs referenced inside aggregate ARGUMENTS evaluate pre-grouping
+    agg_probe: List[tuple] = []
+    for e, _a in q["sels"]:
+        _collect_agg_calls(e, agg_probe)
+    if q["having"] is not None:
+        _collect_agg_calls(q["having"], agg_probe)
+    in_agg_names = set()
+    for call in agg_probe:
+        refs: List = []
+        _col_refs(call, refs)
+        in_agg_names |= {n for _, _t, n in refs
+                         if n.startswith("_ssq")}
+    moved = [(g, s) for g, s in ssq_post if g in in_agg_names]
+    ssq_post = [(g, s) for g, s in ssq_post if g not in in_agg_names]
+    ssq_pre.extend(moved)
     rels = _flatten_implicit(q["from"])
     if len(rels) > 1:
         node, scope = _plan_implicit_joins(rels, where_ast, catalog)
@@ -714,6 +960,10 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
             node = pn.FilterNode(_ExprPlanner(scope).plan(where_ast),
                                  node)
     node = _apply_in_subs(node, scope, in_subs, catalog)
+
+    node, scope = _attach_scalar_subs(node, scope, ssq_pre, catalog)
+    for c in deferred_where:
+        node = pn.FilterNode(_ExprPlanner(scope).plan(c), node)
 
     # expand SELECT * / build select item list
     sels: List[Tuple[tuple, Optional[str]]] = []
@@ -761,9 +1011,24 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
                                         agg_schema.types)])
         # group columns stay resolvable by name too
 
+    # SELECT/HAVING-level scalar subqueries join here — after the
+    # aggregate (whose schema would otherwise drop their columns), or
+    # directly onto the base relation for aggregation-free queries
+    node, scope = _attach_scalar_subs(node, scope, ssq_post, catalog)
+
     if having_ast is not None:
         node = pn.FilterNode(
             _ExprPlanner(scope, env).plan(having_ast), node)
+
+    # window functions anywhere in the select list: each plans to a
+    # WindowNode appending one column; env maps the winfn AST to that
+    # column so the final projection (including expressions OVER window
+    # results, e.g. ratios) resolves it like any other value
+    winfns: List[tuple] = []
+    for e, _a in sels:
+        _collect_winfns(e, winfns)
+    for wast in winfns:
+        node, scope, env = _plan_window(wast, node, scope, env)
 
     # final projection
     out_exprs: List[Expression] = []

@@ -211,3 +211,27 @@ def test_datediff_and_pmod(sess):
                 "pmod(x, 5) AS pm FROM t").collect()
     assert got["dd"].tolist() == [-6, 4]
     assert got["pm"].tolist() == [2, 3]
+
+
+def test_scalar_subquery_with_outer_aggregate():
+    """TPC-DS q32/q92 shape: an aggregate compared against a scalar
+    subquery — the sub's column must survive the aggregation (r3 review:
+    it used to vanish with the pre-agg scope)."""
+    s = Session()
+    s.create_temp_view("a", s.create_dataframe(pd.DataFrame(
+        {"k": [1, 1, 2, 2, 2], "x": [1.0, 2.0, 3.0, 4.0, 5.0]})))
+    s.create_temp_view("b", s.create_dataframe(pd.DataFrame(
+        {"y": [10.0, 20.0]})))
+    got = s.sql("SELECT SUM(x) / (SELECT SUM(y) FROM b) AS r "
+                "FROM a").collect()
+    np.testing.assert_allclose(got["r"][0], 15.0 / 30.0)
+    got2 = s.sql("SELECT k, COUNT(*) AS c FROM a "
+                 "WHERE x > (SELECT AVG(y) FROM b) - 13.5 "
+                 "GROUP BY k ORDER BY k").collect()
+    # avg(y)=15 -> threshold 1.5 -> x in {2,3,4,5}
+    assert got2["k"].tolist() == [1, 2]
+    assert got2["c"].tolist() == [1, 3]
+    # scalar sub INSIDE an aggregate argument evaluates pre-grouping
+    got3 = s.sql("SELECT k, SUM(x - (SELECT AVG(y) FROM b) / 15.0) "
+                 "AS s FROM a GROUP BY k ORDER BY k").collect()
+    np.testing.assert_allclose(got3["s"], [1.0, 9.0])
