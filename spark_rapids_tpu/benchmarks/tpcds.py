@@ -44,12 +44,23 @@ def gen_date_dim(sf: float, seed: int = 31) -> pa.Table:
     years = days.astype("datetime64[Y]").astype(int) + 1970
     months = days.astype("datetime64[M]").astype(int) % 12 + 1
     week_seq = (days - np.datetime64("1998-01-01")).astype(int) // 7
+    # TPC-DS d_dow: 0=Sunday .. 6=Saturday; numpy weekday: 0=Monday
+    dow = (days.astype("datetime64[D]").view("int64") + 4) % 7
+    day_names = np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
+                          "Thursday", "Friday", "Saturday"], dtype=object)
+    dom = (days - days.astype("datetime64[M]")).astype(int) + 1
+    month_seq = (years - 1998) * 12 + (months - 1)
     return pa.table({
         "d_date_sk": np.arange(2450815, 2450815 + n, dtype=np.int64),
         "d_date": days,
         "d_year": years.astype(np.int32),
         "d_moy": months.astype(np.int32),
+        "d_dom": dom.astype(np.int32),
+        "d_dow": dow.astype(np.int32),
+        "d_day_name": day_names[dow],
         "d_week_seq": week_seq.astype(np.int32),
+        "d_month_seq": month_seq.astype(np.int32),
+        "d_qoy": ((months - 1) // 3 + 1).astype(np.int32),
     })
 
 
@@ -71,6 +82,12 @@ def gen_item(sf: float, seed: int = 32) -> pa.Table:
         "i_item_id": np.array([f"AAAAAAAA{i:08d}" for i in range(1, n + 1)],
                               dtype=object),
         "i_current_price": np.round(0.5 + rng.random(n) * 2.0, 2),
+        "i_wholesale_cost": np.round(0.2 + rng.random(n) * 1.5, 2),
+        "i_manufact": np.array(
+            [f"manufact{m % 200}" for m in rng.integers(1, 1000, n)],
+            dtype=object),
+        "i_class": np.array(
+            [f"class{c}" for c in rng.integers(1, 9, n)], dtype=object),
         "i_item_desc": np.array([f"item description {i % 997}"
                                  for i in range(n)], dtype=object),
     })
@@ -100,9 +117,13 @@ def gen_store_sales(sf: float, seed: int = 33) -> pa.Table:
                                     ).astype(np.int64),
         "ss_ticket_number": rng.integers(1, max(n // 3, 2), n
                                          ).astype(np.int64),
+        "ss_addr_sk": rng.integers(1, max(int(50_000 * sf), 15) + 1, n
+                                   ).astype(np.int64),
         "ss_quantity": rng.integers(1, 101, n).astype(np.int32),
         "ss_sales_price": np.round(rng.random(n) * 200, 2),
         "ss_net_paid": np.round(rng.random(n) * 250, 2),
+        "ss_ext_tax": np.round(rng.random(n) * 20, 2),
+        "ss_wholesale_cost": np.round(rng.random(n) * 100, 2),
         "ss_list_price": np.round(rng.random(n) * 250, 2),
         "ss_coupon_amt": np.round(rng.random(n) * 50, 2),
         "ss_ext_list_price": np.round(rng.random(n) * 25_000, 2),
@@ -113,15 +134,28 @@ def gen_store_sales(sf: float, seed: int = 33) -> pa.Table:
     })
 
 
+@functools.lru_cache(maxsize=2)  # returns sample it
 def gen_catalog_sales(sf: float, seed: int = 34) -> pa.Table:
     rng = np.random.default_rng(seed)
     n = max(int(1_440_000 * sf), 150)
     n_item = max(int(18_000 * sf), 50)
+    n_cust = max(int(100_000 * sf), 20)
+    n_addr = max(int(50_000 * sf), 15)
+    n_wh = max(int(5 * sf), 2)
     return pa.table({
         "cs_sold_date_sk": _date_sks(rng, n),
         "cs_ship_date_sk": _date_sks(rng, n) + rng.integers(1, 30, n),
         "cs_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
+        "cs_bill_customer_sk": rng.integers(1, n_cust + 1, n
+                                            ).astype(np.int64),
+        "cs_bill_addr_sk": rng.integers(1, n_addr + 1, n
+                                        ).astype(np.int64),
+        "cs_order_number": rng.integers(1, max(n // 3, 2), n
+                                        ).astype(np.int64),
+        "cs_warehouse_sk": rng.integers(1, n_wh + 1, n).astype(np.int64),
         "cs_quantity": rng.integers(1, 101, n).astype(np.int32),
+        "cs_sales_price": np.round(rng.random(n) * 200, 2),
+        "cs_net_profit": np.round(rng.random(n) * 4_000 - 2_000, 2),
         "cs_ext_sales_price": np.round(rng.random(n) * 20_000, 2),
     })
 
@@ -178,6 +212,8 @@ def gen_store_returns(sf: float, seed: int = 48) -> pa.Table:
         "sr_returned_date_sk": sold + rng.integers(1, 90, n),
         "sr_return_quantity": rng.integers(1, 20, n).astype(np.int32),
         "sr_return_amt": np.round(rng.random(n) * 150, 2),
+        "sr_net_loss": np.round(rng.random(n) * 80, 2),
+        "sr_reason_sk": rng.integers(1, 36, n).astype(np.int64),
     })
 
 
@@ -225,9 +261,13 @@ def gen_promotion(sf: float, seed: int = 38) -> pa.Table:
 def gen_household_demographics(sf: float, seed: int = 39) -> pa.Table:
     rng = np.random.default_rng(seed)
     n = 7200  # fixed-size dim in TPC-DS
+    pots = np.array([">10000", "5001-10000", "1001-5000", "unknown"],
+                    dtype=object)
     return pa.table({
         "hd_demo_sk": np.arange(1, n + 1, dtype=np.int64),
         "hd_dep_count": rng.integers(0, 10, n).astype(np.int32),
+        "hd_vehicle_count": rng.integers(0, 6, n).astype(np.int32),
+        "hd_buy_potential": pots[rng.integers(0, 4, n)],
     })
 
 
@@ -243,6 +283,12 @@ def gen_time_dim(sf: float, seed: int = 40) -> pa.Table:
 def gen_store(sf: float, seed: int = 41) -> pa.Table:
     n = max(int(12 * sf), 2)
     rng = np.random.default_rng(seed)
+    cities = np.array(["Midway", "Fairview", "Oakdale", "Riverside"],
+                      dtype=object)
+    counties = np.array(["Williamson County", "Franklin Parish",
+                         "Bronx County", "Orange County"], dtype=object)
+    states = np.array(["TN", "TX", "OH", "CA"], dtype=object)
+    stypes = np.array(["Ave", "St", "Blvd"], dtype=object)
     return pa.table({
         "s_store_sk": np.arange(1, n + 1, dtype=np.int64),
         "s_store_id": np.array([f"AAAAAAAA{i:04d}" for i in range(1, n + 1)],
@@ -250,6 +296,145 @@ def gen_store(sf: float, seed: int = 41) -> pa.Table:
         "s_store_name": np.array([f"ese{i}" for i in range(1, n + 1)],
                                  dtype=object),
         "s_gmt_offset": np.where(rng.random(n) < 0.7, -5.0, -6.0),
+        "s_city": cities[rng.integers(0, 4, n)],
+        "s_county": counties[rng.integers(0, 4, n)],
+        "s_state": states[rng.integers(0, 4, n)],
+        "s_zip": np.array([f"{z:05d}" for z in
+                           rng.integers(10000, 99999, n)], dtype=object),
+        "s_street_number": np.array([str(i * 10) for i in range(1, n + 1)],
+                                    dtype=object),
+        "s_street_name": np.array([f"Main {i}" for i in range(1, n + 1)],
+                                  dtype=object),
+        "s_street_type": stypes[rng.integers(0, 3, n)],
+        "s_suite_number": np.array([f"Suite {i}" for i in range(1, n + 1)],
+                                   dtype=object),
+        "s_number_employees": rng.integers(200, 300, n).astype(np.int32),
+        "s_company_id": rng.integers(1, 3, n).astype(np.int32),
+    })
+
+
+
+
+def gen_reason(sf: float, seed: int = 50) -> pa.Table:
+    n = 35
+    return pa.table({
+        "r_reason_sk": np.arange(1, n + 1, dtype=np.int64),
+        "r_reason_desc": np.array([f"reason {i}" for i in range(1, n + 1)],
+                                  dtype=object),
+    })
+
+
+def gen_catalog_returns(sf: float, seed: int = 51) -> pa.Table:
+    """~8% of catalog_sales return; keys sampled so (order, item) joins
+    hit (q40)."""
+    rng = np.random.default_rng(seed)
+    sales = gen_catalog_sales(sf)
+    n_s = sales.num_rows
+    n = max(n_s // 12, 20)
+    idx = rng.choice(n_s, n, replace=False)
+    return pa.table({
+        "cr_item_sk": sales["cs_item_sk"].to_numpy()[idx],
+        "cr_order_number": sales["cs_order_number"].to_numpy()[idx],
+        "cr_refunded_cash": np.round(rng.random(n) * 100, 2),
+    })
+
+
+def gen_customer(sf: float, seed: int = 42) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(100_000 * sf), 20)
+    n_demo = max(int(1_000 * sf), 10)
+    n_addr = max(int(50_000 * sf), 15)
+    firsts = np.array(["James", "Mary", "John", "Ana", "Wei", "Olu",
+                       "Kei", "Lena"], dtype=object)
+    lasts = np.array(["Smith", "Garcia", "Chen", "Okafor", "Sato",
+                      "Novak"], dtype=object)
+    sals = np.array(["Mr.", "Ms.", "Dr.", "Sir"], dtype=object)
+    return pa.table({
+        "c_customer_sk": np.arange(1, n + 1, dtype=np.int64),
+        "c_customer_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(1, n + 1)], dtype=object),
+        "c_current_cdemo_sk": rng.integers(1, n_demo + 1, n
+                                           ).astype(np.int64),
+        "c_current_addr_sk": rng.integers(1, n_addr + 1, n
+                                          ).astype(np.int64),
+        "c_first_name": firsts[rng.integers(0, len(firsts), n)],
+        "c_last_name": lasts[rng.integers(0, len(lasts), n)],
+        "c_salutation": sals[rng.integers(0, 4, n)],
+        "c_preferred_cust_flag": np.array(["Y", "N"], dtype=object)[
+            rng.integers(0, 2, n)],
+    })
+
+
+_CA_STATES = np.array(["KY", "GA", "NM", "MT", "OR", "IN", "WI", "MO",
+                       "WV", "CA", "TX", "NY"], dtype=object)
+_CA_ZIP_POOL = np.array(
+    ["85669", "86197", "88274", "83405", "86475", "85392", "85460",
+     "80348", "81792", "10001", "94103", "73301", "30301", "98101",
+     "60601", "33101"], dtype=object)
+
+
+def gen_customer_address(sf: float, seed: int = 44) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(50_000 * sf), 15)
+    countries = np.array(["United States", "Canada", "Mexico"],
+                         dtype=object)
+    cities = np.array(["Midway", "Fairview", "Oakdale", "Riverside",
+                       "Pleasant Hill"], dtype=object)
+    return pa.table({
+        "ca_address_sk": np.arange(1, n + 1, dtype=np.int64),
+        "ca_country": countries[rng.integers(0, 3, n)],
+        "ca_state": _CA_STATES[rng.integers(0, 12, n)],
+        "ca_city": cities[rng.integers(0, 5, n)],
+        "ca_zip": _CA_ZIP_POOL[rng.integers(0, len(_CA_ZIP_POOL), n)],
+        "ca_gmt_offset": np.where(rng.random(n) < 0.6, -5.0, -7.0),
+    })
+
+
+@functools.lru_cache(maxsize=2)  # returns generators re-sample it
+def gen_web_sales(sf: float, seed: int = 46) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = max(int(700_000 * sf), 200)
+    n_cust = max(int(100_000 * sf), 20)
+    n_item = max(int(18_000 * sf), 50)
+    n_addr = max(int(50_000 * sf), 15)
+    n_wp = max(int(60 * sf), 5)
+    n_wh = max(int(5 * sf), 2)
+    return pa.table({
+        "ws_sold_date_sk": rng.integers(2450815, 2450815 + 5 * 365, n
+                                        ).astype(np.int64),
+        "ws_sold_time_sk": rng.integers(0, 86_400, n).astype(np.int64),
+        "ws_bill_customer_sk": rng.integers(1, n_cust + 1, n
+                                            ).astype(np.int64),
+        "ws_bill_addr_sk": rng.integers(1, n_addr + 1, n
+                                        ).astype(np.int64),
+        "ws_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
+        "ws_order_number": rng.integers(1, max(n // 3, 2), n
+                                        ).astype(np.int64),
+        "ws_quantity": rng.integers(1, 101, n).astype(np.int32),
+        "ws_warehouse_sk": rng.integers(1, n_wh + 1, n).astype(np.int64),
+        "ws_web_page_sk": rng.integers(1, n_wp + 1, n).astype(np.int64),
+        "ws_ship_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
+        "ws_sales_price": np.round(rng.random(n) * 200, 2),
+        "ws_net_paid": np.round(rng.random(n) * 300, 2),
+        "ws_ext_list_price": np.round(rng.random(n) * 250, 2),
+        "ws_ext_wholesale_cost": np.round(rng.random(n) * 100, 2),
+        "ws_ext_discount_amt": np.round(rng.random(n) * 40, 2),
+        "ws_ext_sales_price": np.round(rng.random(n) * 200, 2),
+    })
+
+
+def gen_web_returns(sf: float, seed: int = 48) -> pa.Table:
+    """~10% of web_sales return; keys sampled from the sales so the
+    (order, item) two-key left join hits."""
+    rng = np.random.default_rng(seed)
+    sales = gen_web_sales(sf)
+    n_s = sales.num_rows
+    n = max(n_s // 10, 20)
+    idx = rng.choice(n_s, n, replace=False)
+    return pa.table({
+        "wr_order_number": sales["ws_order_number"].to_numpy()[idx],
+        "wr_item_sk": sales["ws_item_sk"].to_numpy()[idx],
+        "wr_refunded_cash": np.round(rng.random(n) * 100, 2),
     })
 
 
@@ -267,6 +452,12 @@ GENERATORS = {
     "store": gen_store,
     "store_returns": gen_store_returns,
     "web_page": gen_web_page,
+    "reason": gen_reason,
+    "catalog_returns": gen_catalog_returns,
+    "customer": gen_customer,
+    "customer_address": gen_customer_address,
+    "web_sales": gen_web_sales,
+    "web_returns": gen_web_returns,
 }
 
 
@@ -534,3 +725,600 @@ def q98(data_dir: str) -> pn.PlanNode:
 QUERIES = {"tpcds_q3": q3, "tpcds_q7": q7, "tpcds_q42": q42,
            "tpcds_q52": q52, "tpcds_q55": q55, "tpcds_q72": q72,
            "tpcds_q96": q96, "tpcds_q98": q98}
+
+# ---------------------------------------------------------------------------
+# SQL-text queries (TpcdsLikeSpark.scala embeds the public TPC-DS SQL; here
+# the same spec queries run through the engine's own SQL front end).
+# Literals are adapted to the generated data's ranges: dates 1998-2002
+# (d_month_seq 0-59 from 1998-01), item prices 0.5-2.5, coupon amounts
+# 0-50, store names "ese<i>"; q13/q48 hoist the equi-join conjuncts every
+# OR branch repeats (semantics-preserving factoring the Spark optimizer
+# performs); q50's backtick aliases and q90's decimal casts use portable
+# spellings.
+# ---------------------------------------------------------------------------
+
+
+def _session(data_dir: str):
+    from spark_rapids_tpu.api import Session
+
+    s = Session()
+    for t in GENERATORS:
+        s.register_parquet(t, os.path.join(data_dir, t))
+    return s
+
+
+def _sql_query(final_sql: str):
+    def factory(data_dir: str) -> pn.PlanNode:
+        return _session(data_dir).sql(final_sql)._plan
+
+    return factory
+
+
+TPCDS_SQL = {
+    "q6": """
+SELECT a.ca_state state, count(*) cnt
+FROM customer_address a, customer c, store_sales s, date_dim d, item i,
+  (SELECT i_category cat, avg(i_current_price) * 1.2 AS thresh
+   FROM item GROUP BY i_category) avgp
+WHERE a.ca_address_sk = c.c_current_addr_sk
+AND c.c_customer_sk = s.ss_customer_sk
+AND s.ss_sold_date_sk = d.d_date_sk
+AND s.ss_item_sk = i.i_item_sk
+AND d.d_month_seq = (SELECT min(d_month_seq) FROM date_dim
+                     WHERE d_year = 2001 AND d_moy = 1)
+AND avgp.cat = i.i_category
+AND i.i_current_price > avgp.thresh
+GROUP BY a.ca_state HAVING count(*) >= 10
+ORDER BY cnt, state LIMIT 100
+""",
+    "q9": """
+SELECT CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) > 409
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) END bucket1,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) > 512
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) END bucket2,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) > 622
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) END bucket3
+FROM reason WHERE r_reason_sk = 1
+""",
+    "q13": """
+SELECT avg(ss_quantity), avg(ss_ext_sales_price),
+       avg(ss_ext_wholesale_cost), sum(ss_ext_wholesale_cost)
+FROM store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+AND ss_hdemo_sk = hd_demo_sk
+AND cd_demo_sk = ss_cdemo_sk
+AND ss_addr_sk = ca_address_sk
+AND ((cd_marital_status = 'M' AND cd_education_status = 'Advanced Degree'
+      AND ss_sales_price BETWEEN 100.0 AND 150.0 AND hd_dep_count = 3)
+  OR (cd_marital_status = 'S' AND cd_education_status = 'College'
+      AND ss_sales_price BETWEEN 50.0 AND 100.0 AND hd_dep_count = 1)
+  OR (cd_marital_status = 'W' AND cd_education_status = '2 yr Degree'
+      AND ss_sales_price BETWEEN 150.0 AND 200.0 AND hd_dep_count = 1))
+AND ((ca_country = 'United States' AND ca_state IN ('TX', 'OR', 'KY')
+      AND ss_net_profit BETWEEN 100 AND 200)
+  OR (ca_country = 'United States' AND ca_state IN ('OR', 'NM', 'KY')
+      AND ss_net_profit BETWEEN 150 AND 300)
+  OR (ca_country = 'United States' AND ca_state IN ('CA', 'TX', 'MO')
+      AND ss_net_profit BETWEEN 50 AND 250))
+""",
+    "q15": """
+SELECT ca_zip, sum(cs_sales_price) AS total
+FROM catalog_sales, customer, customer_address, date_dim
+WHERE cs_bill_customer_sk = c_customer_sk
+AND c_current_addr_sk = ca_address_sk
+AND (substring(ca_zip, 1, 5) IN ('85669', '86197', '88274', '83405',
+                                 '86475', '85392', '85460', '80348',
+                                 '81792')
+     OR ca_state IN ('CA', 'WI', 'GA')
+     OR cs_sales_price > 180)
+AND cs_sold_date_sk = d_date_sk
+AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip ORDER BY ca_zip LIMIT 100
+""",
+    "q19": """
+SELECT i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) ext_price
+FROM date_dim, store_sales, item, customer, customer_address, store
+WHERE d_date_sk = ss_sold_date_sk
+AND ss_item_sk = i_item_sk
+AND i_manager_id = 8
+AND d_moy = 11 AND d_year = 1998
+AND ss_customer_sk = c_customer_sk
+AND c_current_addr_sk = ca_address_sk
+AND substring(ca_zip, 1, 5) <> substring(s_zip, 1, 5)
+AND ss_store_sk = s_store_sk
+GROUP BY i_brand, i_brand_id, i_manufact_id, i_manufact
+ORDER BY ext_price DESC, brand, brand_id, i_manufact_id, i_manufact
+LIMIT 100
+""",
+    "q25": """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) AS store_sales_profit,
+       sum(sr_net_loss) AS store_returns_loss,
+       sum(cs_net_profit) AS catalog_sales_profit
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+WHERE d1.d_moy = 4 AND d1.d_year = 2001
+AND d1.d_date_sk = ss_sold_date_sk
+AND i_item_sk = ss_item_sk
+AND s_store_sk = ss_store_sk
+AND ss_customer_sk = sr_customer_sk
+AND ss_item_sk = sr_item_sk
+AND ss_ticket_number = sr_ticket_number
+AND sr_returned_date_sk = d2.d_date_sk
+AND d2.d_moy BETWEEN 4 AND 10 AND d2.d_year = 2001
+AND sr_customer_sk = cs_bill_customer_sk
+AND sr_item_sk = cs_item_sk
+AND cs_sold_date_sk = d3.d_date_sk
+AND d3.d_moy BETWEEN 4 AND 10 AND d3.d_year = 2001
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+LIMIT 100
+""",
+    "q28": """
+SELECT * FROM
+(SELECT avg(ss_list_price) B1_LP, count(ss_list_price) B1_CNT,
+        count(DISTINCT ss_list_price) B1_CNTD
+ FROM store_sales WHERE ss_quantity BETWEEN 0 AND 5
+ AND (ss_list_price BETWEEN 8 AND 18
+      OR ss_coupon_amt BETWEEN 10 AND 20
+      OR ss_wholesale_cost BETWEEN 57 AND 77)) B1 CROSS JOIN
+(SELECT avg(ss_list_price) B2_LP, count(ss_list_price) B2_CNT,
+        count(DISTINCT ss_list_price) B2_CNTD
+ FROM store_sales WHERE ss_quantity BETWEEN 6 AND 10
+ AND (ss_list_price BETWEEN 90 AND 100
+      OR ss_coupon_amt BETWEEN 20 AND 30
+      OR ss_wholesale_cost BETWEEN 31 AND 51)) B2 CROSS JOIN
+(SELECT avg(ss_list_price) B3_LP, count(ss_list_price) B3_CNT,
+        count(DISTINCT ss_list_price) B3_CNTD
+ FROM store_sales WHERE ss_quantity BETWEEN 11 AND 15
+ AND (ss_list_price BETWEEN 142 AND 152
+      OR ss_coupon_amt BETWEEN 30 AND 40
+      OR ss_wholesale_cost BETWEEN 79 AND 99)) B3
+LIMIT 100
+""",
+    "q33": """
+WITH ss AS (
+  SELECT i_manufact_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category IN ('Electronics'))
+  AND ss_item_sk = i_item_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 5
+  AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  GROUP BY i_manufact_id),
+cs AS (
+  SELECT i_manufact_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category IN ('Electronics'))
+  AND cs_item_sk = i_item_sk
+  AND cs_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 5
+  AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  GROUP BY i_manufact_id),
+ws AS (
+  SELECT i_manufact_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category IN ('Electronics'))
+  AND ws_item_sk = i_item_sk
+  AND ws_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 5
+  AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  GROUP BY i_manufact_id)
+SELECT i_manufact_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL
+      SELECT * FROM cs UNION ALL
+      SELECT * FROM ws) tmp1
+GROUP BY i_manufact_id
+ORDER BY total_sales, i_manufact_id
+LIMIT 100
+""",
+    "q37": """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 1.0 AND 1.8
+AND inv_item_sk = i_item_sk
+AND d_date_sk = inv_date_sk
+AND d_date BETWEEN cast('2000-02-01' AS date)
+              AND (cast('2000-02-01' AS date) + INTERVAL '60' day)
+AND i_manufact_id IN (677, 940, 694, 808)
+AND inv_quantity_on_hand BETWEEN 100 AND 500
+AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id LIMIT 100
+""",
+    "q40": """
+SELECT w_state, i_item_id,
+  sum(CASE WHEN (d_date < cast('2000-03-11' AS date))
+      THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+      ELSE 0 END) AS sales_before,
+  sum(CASE WHEN (d_date >= cast('2000-03-11' AS date))
+      THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+      ELSE 0 END) AS sales_after
+FROM catalog_sales LEFT OUTER JOIN catalog_returns ON
+  (cs_order_number = cr_order_number AND cs_item_sk = cr_item_sk),
+  warehouse, item, date_dim
+WHERE i_current_price BETWEEN 0.99 AND 1.49
+AND i_item_sk = cs_item_sk
+AND cs_warehouse_sk = w_warehouse_sk
+AND cs_sold_date_sk = d_date_sk
+AND d_date BETWEEN (cast('2000-03-11' AS date) - INTERVAL '30' day)
+              AND (cast('2000-03-11' AS date) + INTERVAL '30' day)
+GROUP BY w_state, i_item_id
+ORDER BY w_state, i_item_id
+LIMIT 100
+""",
+    "q43": """
+SELECT s_store_name, s_store_id,
+  sum(CASE WHEN (d_day_name = 'Sunday') THEN ss_sales_price
+      ELSE null END) sun_sales,
+  sum(CASE WHEN (d_day_name = 'Monday') THEN ss_sales_price
+      ELSE null END) mon_sales,
+  sum(CASE WHEN (d_day_name = 'Tuesday') THEN ss_sales_price
+      ELSE null END) tue_sales,
+  sum(CASE WHEN (d_day_name = 'Wednesday') THEN ss_sales_price
+      ELSE null END) wed_sales,
+  sum(CASE WHEN (d_day_name = 'Thursday') THEN ss_sales_price
+      ELSE null END) thu_sales,
+  sum(CASE WHEN (d_day_name = 'Friday') THEN ss_sales_price
+      ELSE null END) fri_sales,
+  sum(CASE WHEN (d_day_name = 'Saturday') THEN ss_sales_price
+      ELSE null END) sat_sales
+FROM date_dim, store_sales, store
+WHERE d_date_sk = ss_sold_date_sk
+AND s_store_sk = ss_store_sk
+AND s_gmt_offset = -5.0
+AND d_year = 2000
+GROUP BY s_store_name, s_store_id
+ORDER BY s_store_name, s_store_id, sun_sales, mon_sales, tue_sales,
+         wed_sales, thu_sales, fri_sales, sat_sales
+LIMIT 100
+""",
+    "q46": """
+SELECT c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, amt, profit
+FROM (SELECT ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      AND store_sales.ss_store_sk = store.s_store_sk
+      AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+      AND store_sales.ss_addr_sk = customer_address.ca_address_sk
+      AND (household_demographics.hd_dep_count = 4 OR
+           household_demographics.hd_vehicle_count = 3)
+      AND date_dim.d_dow IN (6, 0)
+      AND date_dim.d_year IN (1999, 2000, 2001)
+      AND store.s_city IN ('Fairview', 'Midway')
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               ca_city) dn, customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+AND customer.c_current_addr_sk = current_addr.ca_address_sk
+AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name, c_first_name, ca_city, bought_city,
+         ss_ticket_number
+LIMIT 100
+""",
+    "q48": """
+SELECT sum(ss_quantity) AS q
+FROM store_sales, store, customer_demographics, customer_address,
+     date_dim
+WHERE s_store_sk = ss_store_sk
+AND ss_sold_date_sk = d_date_sk AND d_year = 2000
+AND cd_demo_sk = ss_cdemo_sk
+AND ss_addr_sk = ca_address_sk
+AND ((cd_marital_status = 'M' AND cd_education_status = '4 yr Degree'
+      AND ss_sales_price BETWEEN 100.0 AND 150.0)
+  OR (cd_marital_status = 'D' AND cd_education_status = '2 yr Degree'
+      AND ss_sales_price BETWEEN 50.0 AND 100.0)
+  OR (cd_marital_status = 'S' AND cd_education_status = 'College'
+      AND ss_sales_price BETWEEN 150.0 AND 200.0))
+AND ((ca_country = 'United States' AND ca_state IN ('CA', 'OR', 'TX')
+      AND ss_net_profit BETWEEN 0 AND 2000)
+  OR (ca_country = 'United States' AND ca_state IN ('OR', 'NM', 'KY')
+      AND ss_net_profit BETWEEN 150 AND 3000)
+  OR (ca_country = 'United States' AND ca_state IN ('GA', 'TX', 'MO')
+      AND ss_net_profit BETWEEN 50 AND 25000))
+""",
+    "q50": """
+SELECT s_store_name, s_company_id, s_street_number, s_street_name,
+       s_street_type, s_suite_number, s_city, s_county, s_state, s_zip,
+  sum(CASE WHEN (sr_returned_date_sk - ss_sold_date_sk <= 30)
+      THEN 1 ELSE 0 END) AS d30,
+  sum(CASE WHEN (sr_returned_date_sk - ss_sold_date_sk > 30) AND
+           (sr_returned_date_sk - ss_sold_date_sk <= 60)
+      THEN 1 ELSE 0 END) AS d31_60,
+  sum(CASE WHEN (sr_returned_date_sk - ss_sold_date_sk > 60) AND
+           (sr_returned_date_sk - ss_sold_date_sk <= 90)
+      THEN 1 ELSE 0 END) AS d61_90,
+  sum(CASE WHEN (sr_returned_date_sk - ss_sold_date_sk > 90)
+      THEN 1 ELSE 0 END) AS d_over_90
+FROM store_sales, store_returns, store, date_dim d1, date_dim d2
+WHERE d2.d_year = 2001 AND d2.d_moy = 8
+AND ss_ticket_number = sr_ticket_number
+AND ss_item_sk = sr_item_sk
+AND ss_sold_date_sk = d1.d_date_sk
+AND sr_returned_date_sk = d2.d_date_sk
+AND ss_customer_sk = sr_customer_sk
+AND ss_store_sk = s_store_sk
+GROUP BY s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state,
+         s_zip
+ORDER BY s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state,
+         s_zip
+LIMIT 100
+""",
+    "q59": """
+WITH wss AS (
+  SELECT d_week_seq, ss_store_sk,
+    sum(CASE WHEN (d_day_name = 'Sunday') THEN ss_sales_price
+        ELSE null END) sun_sales,
+    sum(CASE WHEN (d_day_name = 'Monday') THEN ss_sales_price
+        ELSE null END) mon_sales,
+    sum(CASE WHEN (d_day_name = 'Friday') THEN ss_sales_price
+        ELSE null END) fri_sales,
+    sum(CASE WHEN (d_day_name = 'Saturday') THEN ss_sales_price
+        ELSE null END) sat_sales
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk
+  GROUP BY d_week_seq, ss_store_sk)
+SELECT s_store_name1, s_store_id1, d_week_seq1,
+       sun_sales1 / sun_sales2, mon_sales1 / mon_sales2,
+       fri_sales1 / fri_sales2, sat_sales1 / sat_sales2
+FROM
+(SELECT s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+        s_store_id s_store_id1, sun_sales sun_sales1,
+        mon_sales mon_sales1, fri_sales fri_sales1,
+        sat_sales sat_sales1
+ FROM wss, store, date_dim d
+ WHERE d.d_week_seq = wss.d_week_seq AND ss_store_sk = s_store_sk
+ AND d_month_seq BETWEEN 24 AND 35) y,
+(SELECT s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+        s_store_id s_store_id2, sun_sales sun_sales2,
+        mon_sales mon_sales2, fri_sales fri_sales2,
+        sat_sales sat_sales2
+ FROM wss, store, date_dim d
+ WHERE d.d_week_seq = wss.d_week_seq AND ss_store_sk = s_store_sk
+ AND d_month_seq BETWEEN 36 AND 47) x
+WHERE s_store_id1 = s_store_id2
+AND d_week_seq1 = d_week_seq2 - 52
+ORDER BY s_store_name1, s_store_id1, d_week_seq1
+LIMIT 100
+""",
+    "q65": """
+SELECT s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+FROM store, item,
+  (SELECT ss_store_sk, avg(revenue) AS ave
+   FROM (SELECT ss_store_sk, ss_item_sk,
+                sum(ss_sales_price) AS revenue
+         FROM store_sales, date_dim
+         WHERE ss_sold_date_sk = d_date_sk
+         AND d_month_seq BETWEEN 24 AND 35
+         GROUP BY ss_store_sk, ss_item_sk) sa
+   GROUP BY ss_store_sk) sb,
+  (SELECT ss_store_sk, ss_item_sk, sum(ss_sales_price) AS revenue
+   FROM store_sales, date_dim
+   WHERE ss_sold_date_sk = d_date_sk
+   AND d_month_seq BETWEEN 24 AND 35
+   GROUP BY ss_store_sk, ss_item_sk) sc
+WHERE sb.ss_store_sk = sc.ss_store_sk
+AND sc.revenue <= 0.1 * sb.ave
+AND s_store_sk = sc.ss_store_sk
+AND i_item_sk = sc.ss_item_sk
+ORDER BY s_store_name, i_item_desc, sc.revenue
+LIMIT 100
+""",
+    "q68": """
+SELECT c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+FROM (SELECT ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      AND store_sales.ss_store_sk = store.s_store_sk
+      AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+      AND store_sales.ss_addr_sk = customer_address.ca_address_sk
+      AND date_dim.d_dom BETWEEN 1 AND 2
+      AND (household_demographics.hd_dep_count = 4 OR
+           household_demographics.hd_vehicle_count = 3)
+      AND date_dim.d_year IN (1999, 2000, 2001)
+      AND store.s_city IN ('Midway', 'Fairview')
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               ca_city) dn, customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+AND customer.c_current_addr_sk = current_addr.ca_address_sk
+AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name, ss_ticket_number
+LIMIT 100
+""",
+    "q73": """
+SELECT c_last_name, c_first_name, c_salutation,
+       c_preferred_cust_flag, ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      AND store_sales.ss_store_sk = store.s_store_sk
+      AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+      AND date_dim.d_dom BETWEEN 1 AND 2
+      AND (household_demographics.hd_buy_potential = '>10000' OR
+           household_demographics.hd_buy_potential = 'unknown')
+      AND household_demographics.hd_vehicle_count > 0
+      AND CASE WHEN household_demographics.hd_vehicle_count > 0
+          THEN household_demographics.hd_dep_count /
+               household_demographics.hd_vehicle_count
+          ELSE null END > 1
+      AND date_dim.d_year IN (1999, 2000, 2001)
+      AND store.s_county IN ('Williamson County', 'Franklin Parish',
+                             'Bronx County', 'Orange County')
+      GROUP BY ss_ticket_number, ss_customer_sk) dj, customer
+WHERE ss_customer_sk = c_customer_sk
+AND cnt BETWEEN 1 AND 5
+ORDER BY cnt DESC, c_last_name ASC, ss_ticket_number
+LIMIT 1000
+""",
+    "q79": """
+SELECT c_last_name, c_first_name,
+       substring(s_city, 1, 30) AS city30, ss_ticket_number, amt,
+       profit
+FROM (SELECT ss_ticket_number, ss_customer_sk, store.s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      AND store_sales.ss_store_sk = store.s_store_sk
+      AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+      AND (household_demographics.hd_dep_count = 6 OR
+           household_demographics.hd_vehicle_count > 2)
+      AND date_dim.d_dow = 1
+      AND date_dim.d_year IN (1999, 2000, 2001)
+      AND store.s_number_employees BETWEEN 200 AND 295
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               store.s_city) ms, customer
+WHERE ss_customer_sk = c_customer_sk
+ORDER BY c_last_name, c_first_name, city30, profit, ss_ticket_number
+LIMIT 100
+""",
+    "q82": """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN 1.0 AND 1.8
+AND inv_item_sk = i_item_sk
+AND d_date_sk = inv_date_sk
+AND d_date BETWEEN cast('2000-05-25' AS date)
+              AND (cast('2000-05-25' AS date) + INTERVAL '60' day)
+AND i_manufact_id IN (129, 270, 821, 423)
+AND inv_quantity_on_hand BETWEEN 100 AND 500
+AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id LIMIT 100
+""",
+    "q88": """
+SELECT * FROM
+(SELECT count(*) h8_30_to_9 FROM store_sales, household_demographics,
+       time_dim, store
+ WHERE ss_sold_time_sk = time_dim.t_time_sk
+ AND ss_hdemo_sk = household_demographics.hd_demo_sk
+ AND ss_store_sk = s_store_sk
+ AND time_dim.t_hour = 8 AND time_dim.t_minute >= 30
+ AND ((household_demographics.hd_dep_count = 4 AND
+       household_demographics.hd_vehicle_count <= 6) OR
+      (household_demographics.hd_dep_count = 2 AND
+       household_demographics.hd_vehicle_count <= 4) OR
+      (household_demographics.hd_dep_count = 0 AND
+       household_demographics.hd_vehicle_count <= 2))
+ AND store.s_store_name = 'ese1') s1 CROSS JOIN
+(SELECT count(*) h9_to_9_30 FROM store_sales, household_demographics,
+       time_dim, store
+ WHERE ss_sold_time_sk = time_dim.t_time_sk
+ AND ss_hdemo_sk = household_demographics.hd_demo_sk
+ AND ss_store_sk = s_store_sk
+ AND time_dim.t_hour = 9 AND time_dim.t_minute < 30
+ AND ((household_demographics.hd_dep_count = 4 AND
+       household_demographics.hd_vehicle_count <= 6) OR
+      (household_demographics.hd_dep_count = 2 AND
+       household_demographics.hd_vehicle_count <= 4) OR
+      (household_demographics.hd_dep_count = 0 AND
+       household_demographics.hd_vehicle_count <= 2))
+ AND store.s_store_name = 'ese1') s2 CROSS JOIN
+(SELECT count(*) h9_30_to_10 FROM store_sales,
+       household_demographics, time_dim, store
+ WHERE ss_sold_time_sk = time_dim.t_time_sk
+ AND ss_hdemo_sk = household_demographics.hd_demo_sk
+ AND ss_store_sk = s_store_sk
+ AND time_dim.t_hour = 9 AND time_dim.t_minute >= 30
+ AND ((household_demographics.hd_dep_count = 4 AND
+       household_demographics.hd_vehicle_count <= 6) OR
+      (household_demographics.hd_dep_count = 2 AND
+       household_demographics.hd_vehicle_count <= 4) OR
+      (household_demographics.hd_dep_count = 0 AND
+       household_demographics.hd_vehicle_count <= 2))
+ AND store.s_store_name = 'ese1') s3
+""",
+    "q90": """
+SELECT cast(amc AS double) / cast(pmc AS double) am_pm_ratio
+FROM (SELECT count(*) amc FROM web_sales, household_demographics,
+            time_dim, web_page
+      WHERE ws_sold_time_sk = time_dim.t_time_sk
+      AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+      AND ws_web_page_sk = web_page.wp_web_page_sk
+      AND time_dim.t_hour BETWEEN 8 AND 9
+      AND household_demographics.hd_dep_count = 6
+      AND web_page.wp_char_count BETWEEN 5000 AND 5200) at CROSS JOIN
+     (SELECT count(*) pmc FROM web_sales, household_demographics,
+            time_dim, web_page
+      WHERE ws_sold_time_sk = time_dim.t_time_sk
+      AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+      AND ws_web_page_sk = web_page.wp_web_page_sk
+      AND time_dim.t_hour BETWEEN 19 AND 20
+      AND household_demographics.hd_dep_count = 6
+      AND web_page.wp_char_count BETWEEN 5000 AND 5200) pt
+ORDER BY am_pm_ratio
+LIMIT 100
+""",
+    "q93": """
+SELECT ss_customer_sk, sum(act_sales) sumsales
+FROM (SELECT ss_item_sk, ss_ticket_number, ss_customer_sk,
+             CASE WHEN sr_return_quantity IS NOT NULL
+             THEN (ss_quantity - sr_return_quantity) * ss_sales_price
+             ELSE (ss_quantity * ss_sales_price) END act_sales
+      FROM store_sales LEFT OUTER JOIN store_returns
+        ON (sr_item_sk = ss_item_sk AND
+            sr_ticket_number = ss_ticket_number), reason
+      WHERE sr_reason_sk = r_reason_sk
+      AND r_reason_desc = 'reason 28') t
+GROUP BY ss_customer_sk
+ORDER BY sumsales, ss_customer_sk
+LIMIT 100
+""",
+    "q97": """
+WITH ssci AS (
+  SELECT ss_customer_sk customer_sk, ss_item_sk item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk
+  AND d_month_seq BETWEEN 24 AND 35
+  GROUP BY ss_customer_sk, ss_item_sk),
+csci AS (
+  SELECT cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+  AND d_month_seq BETWEEN 24 AND 35
+  GROUP BY cs_bill_customer_sk, cs_item_sk)
+SELECT sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                AND csci.customer_sk IS NULL
+           THEN 1 ELSE 0 END) store_only,
+       sum(CASE WHEN ssci.customer_sk IS NULL
+                AND csci.customer_sk IS NOT NULL
+           THEN 1 ELSE 0 END) catalog_only,
+       sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                AND csci.customer_sk IS NOT NULL
+           THEN 1 ELSE 0 END) store_and_catalog
+FROM ssci FULL OUTER JOIN csci
+  ON (ssci.customer_sk = csci.customer_sk
+      AND ssci.item_sk = csci.item_sk)
+LIMIT 100
+""",
+}
+
+for _name, _sql in TPCDS_SQL.items():
+    QUERIES[f"tpcds_{_name}"] = _sql_query(_sql)

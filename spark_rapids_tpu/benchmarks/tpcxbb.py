@@ -71,26 +71,6 @@ def gen_web_clickstreams(sf: float, seed: int = 41) -> pa.Table:
     })
 
 
-def gen_customer(sf: float, seed: int = 42) -> pa.Table:
-    rng = np.random.default_rng(seed)
-    n = max(int(100_000 * sf), 20)
-    n_demo = max(int(1_000 * sf), 10)
-    n_addr = max(int(50_000 * sf), 15)
-    firsts = np.array(["James", "Mary", "John", "Ana", "Wei", "Olu",
-                       "Kei", "Lena"], dtype=object)
-    lasts = np.array(["Smith", "Garcia", "Chen", "Okafor", "Sato",
-                      "Novak"], dtype=object)
-    return pa.table({
-        "c_customer_sk": np.arange(1, n + 1, dtype=np.int64),
-        "c_current_cdemo_sk": rng.integers(1, n_demo + 1, n
-                                           ).astype(np.int64),
-        "c_current_addr_sk": rng.integers(1, n_addr + 1, n
-                                          ).astype(np.int64),
-        "c_first_name": firsts[rng.integers(0, len(firsts), n)],
-        "c_last_name": lasts[rng.integers(0, len(lasts), n)],
-    })
-
-
 def gen_customer_demographics(sf: float, seed: int = 43) -> pa.Table:
     rng = np.random.default_rng(seed)
     n = max(int(1_000 * sf), 10)
@@ -100,47 +80,6 @@ def gen_customer_demographics(sf: float, seed: int = 43) -> pa.Table:
             rng.integers(0, 2, n)],
         "cd_education_status": EDUCATION[rng.integers(0, 7, n)],
         "cd_marital_status": MARITAL[rng.integers(0, 5, n)],
-    })
-
-
-def gen_customer_address(sf: float, seed: int = 44) -> pa.Table:
-    rng = np.random.default_rng(seed)
-    n = max(int(50_000 * sf), 15)
-    return pa.table({
-        "ca_address_sk": np.arange(1, n + 1, dtype=np.int64),
-        "ca_country": COUNTRIES[rng.integers(0, 3, n)],
-        "ca_state": STATES[rng.integers(0, 12, n)],
-        "ca_gmt_offset": np.where(rng.random(n) < 0.6, -5.0, -7.0),
-    })
-
-
-@functools.lru_cache(maxsize=2)  # returns generators re-sample the same fact table
-def gen_web_sales(sf: float, seed: int = 46) -> pa.Table:
-    rng = np.random.default_rng(seed)
-    n = max(int(700_000 * sf), 200)
-    n_cust = max(int(100_000 * sf), 20)
-    n_item = max(int(18_000 * sf), 50)
-    n_wp = max(int(60 * sf), 5)
-    n_wh = max(int(5 * sf), 2)
-    return pa.table({
-        "ws_sold_date_sk": rng.integers(2450815, 2450815 + 5 * 365, n
-                                        ).astype(np.int64),
-        "ws_sold_time_sk": rng.integers(0, 86_400, n).astype(np.int64),
-        "ws_bill_customer_sk": rng.integers(1, n_cust + 1, n
-                                            ).astype(np.int64),
-        "ws_item_sk": rng.integers(1, n_item + 1, n).astype(np.int64),
-        "ws_order_number": rng.integers(1, max(n // 3, 2), n
-                                        ).astype(np.int64),
-        "ws_quantity": rng.integers(1, 101, n).astype(np.int32),
-        "ws_warehouse_sk": rng.integers(1, n_wh + 1, n).astype(np.int64),
-        "ws_web_page_sk": rng.integers(1, n_wp + 1, n).astype(np.int64),
-        "ws_ship_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
-        "ws_sales_price": np.round(rng.random(n) * 200, 2),
-        "ws_net_paid": np.round(rng.random(n) * 300, 2),
-        "ws_ext_list_price": np.round(rng.random(n) * 250, 2),
-        "ws_ext_wholesale_cost": np.round(rng.random(n) * 100, 2),
-        "ws_ext_discount_amt": np.round(rng.random(n) * 40, 2),
-        "ws_ext_sales_price": np.round(rng.random(n) * 200, 2),
     })
 
 
@@ -164,21 +103,6 @@ def gen_product_reviews(sf: float, seed: int = 47) -> pa.Table:
     })
 
 
-def gen_web_returns(sf: float, seed: int = 48) -> pa.Table:
-    """~10% of web_sales return; keys sampled from the sales so the
-    (order, item) two-key left join hits (q16)."""
-    rng = np.random.default_rng(seed)
-    sales = gen_web_sales(sf)
-    n_s = sales.num_rows
-    n = max(n_s // 10, 20)
-    idx = rng.choice(n_s, n, replace=False)
-    return pa.table({
-        "wr_order_number": sales["ws_order_number"].to_numpy()[idx],
-        "wr_item_sk": sales["ws_item_sk"].to_numpy()[idx],
-        "wr_refunded_cash": np.round(rng.random(n) * 100, 2),
-    })
-
-
 def gen_item_marketprices(sf: float, seed: int = 49) -> pa.Table:
     rng = np.random.default_rng(seed)
     n_item = max(int(18_000 * sf), 50)
@@ -197,12 +121,8 @@ def gen_item_marketprices(sf: float, seed: int = 49) -> pa.Table:
 
 GENERATORS = {
     "web_clickstreams": gen_web_clickstreams,
-    "customer": gen_customer,
     "customer_demographics": gen_customer_demographics,
-    "customer_address": gen_customer_address,
-    "web_sales": gen_web_sales,
     "product_reviews": gen_product_reviews,
-    "web_returns": gen_web_returns,
     "item_marketprices": gen_item_marketprices,
 }
 
@@ -210,7 +130,8 @@ GENERATORS = {
 # (the reference's TpcxbbLikeSpark schema reuses them the same way)
 TPCDS_TABLES = ["store_sales", "item", "date_dim", "store", "warehouse",
                 "inventory", "promotion", "household_demographics",
-                "time_dim", "store_returns", "web_page"]
+                "time_dim", "store_returns", "web_page", "customer",
+                "customer_address", "web_sales", "web_returns"]
 
 
 def write_tables(data_dir: str, sf: float, files_per_table: int = 4
