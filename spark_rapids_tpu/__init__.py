@@ -36,10 +36,17 @@ if not os.environ.get("SPARK_RAPIDS_TPU_NO_X64"):
 if not os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
     import jax
 
+    # SEPARATE cache dirs per platform env: CPU executables compiled in
+    # a TPU-attached (axon) process carry that platform's XLA target
+    # features (+prefer-no-scatter etc.); a plain-CPU process loading
+    # such an entry SIGSEGVs inside the AOT loader. Processes forced to
+    # CPU (tests, dryrun) therefore use their own cache.
+    _suffix = "_cpu" if "cpu" in os.environ.get("JAX_PLATFORMS", "") \
+        else ""
     _cache_dir = os.environ.get(
         "SPARK_RAPIDS_TPU_COMPILE_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                     ".jax_cache"))
+                     f".jax_cache{_suffix}"))
     try:
         jax.config.update("jax_compilation_cache_dir",
                           os.path.abspath(_cache_dir))
