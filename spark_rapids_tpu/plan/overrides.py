@@ -488,8 +488,9 @@ class _SortRule(NodeRule):
                 child = exchange.ShuffleExchangeExec(
                     ("single",), 1, child,
                     task_threads=meta.conf.get(cfg.TASK_THREADS))
-        return sort.SortExec(node.specs, child,
-                             global_sort=node.global_sort)
+        return sort.SortExec(
+            node.specs, child, global_sort=node.global_sort,
+            batch_bytes=meta.conf.get(cfg.BATCH_SIZE_BYTES))
 
 
 class _LimitRule(NodeRule):

@@ -553,3 +553,40 @@ def test_api_explode():
         assert list(out["x"]) == [10.0, 0.5, 20.0, 1.5, 30.0, 2.5]
     finally:
         s.stop()
+
+
+def test_out_of_core_global_sort_spills():
+    """A global sort whose input exceeds the sort budget takes the
+    range-bucketed out-of-core path: staged chunks + bucket slices are
+    spillable, no single resident batch exceeds the budget, and the
+    yielded bucket stream is globally ordered (SURVEY §5.7 — no
+    RequireSingleBatch cliff). Multi-key with nulls + descending."""
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.execs.basic import ScanExec
+    from spark_rapids_tpu.execs.sort import SortExec
+    from spark_rapids_tpu.columnar.batch import Schema
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+    from tests.compare import assert_frames_equal
+
+    rng = np.random.default_rng(6)
+    n = 50_000
+    data = {"a": rng.integers(0, 1000, n).astype(np.int64),
+            "b": rng.normal(size=n)}
+    validity = {"b": rng.random(n) > 0.05}
+    plan = pn.SortNode([SortKeySpec.spark_default(0),
+                        SortKeySpec.spark_default(1, ascending=False)],
+                       scan(data, validity))
+    cpu = execute_cpu(plan).to_pandas()
+
+    node = scan(data, validity)
+    exec_ = SortExec([SortKeySpec.spark_default(0),
+                      SortKeySpec.spark_default(1, ascending=False)],
+                     ScanExec(pn.InMemorySource(data, validity=validity),
+                              node.output_schema()),
+                     global_sort=True, sort_budget_rows=6000)
+    batches = [b for b in exec_.execute(0)
+               if b.realized_num_rows() > 0]
+    assert len(batches) > 4, "out-of-core path must yield many buckets"
+    assert max(b.realized_num_rows() for b in batches) < 50_000
+    tpu = collect(exec_)
+    assert_frames_equal(cpu, tpu, sort=False)
