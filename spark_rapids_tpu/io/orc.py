@@ -1,10 +1,12 @@
-"""ORC scan: stripe-split host decode (GpuOrcScan.scala analogue).
+"""ORC scan: stripe statistics pushdown + stripe-split host decode.
 
 The reference filters ORC stripes with search arguments on the CPU then
-decodes on device (GpuOrcScan.scala, OrcFilters.scala:206). pyarrow's ORC
-reader exposes stripe-granular reads but not stripe statistics, so splits
-are stripes (scan parallelism is preserved) and pruning conjuncts are
-applied only as a whole-file row-count shortcut.
+decodes on device (GpuOrcScan.scala, OrcFilters.scala:206). pyarrow's
+ORC reader exposes stripe-granular reads but not stripe statistics, so
+the engine reads the ORC tail itself (io/orc_meta.py): pruning filters
+drop stripes whose min/max cannot match, and surviving stripes' stats
+feed ``Column.stats`` (the packed-key groupby path) — the same two
+consumers the parquet footer serves.
 """
 from __future__ import annotations
 
@@ -12,13 +14,15 @@ import dataclasses
 
 from spark_rapids_tpu.columnar.batch import Schema
 from spark_rapids_tpu.io import arrow_conv
-from spark_rapids_tpu.io.filesrc import FileSourceBase
+from spark_rapids_tpu.io.filesrc import FileSourceBase, filter_may_match
 
 
 @dataclasses.dataclass(frozen=True)
 class _StripeSplit:
     path: str
     stripes: tuple  # () = whole file
+    # ((col, lo, hi), ...) from stripe statistics — Column.stats feed
+    stats: tuple = ()
 
 
 class OrcSource(FileSourceBase):
@@ -31,16 +35,53 @@ class OrcSource(FileSourceBase):
     def _build_splits(self) -> list:
         from pyarrow import orc
 
+        from spark_rapids_tpu.io.orc_meta import stripe_statistics
+
+        schema = self.schema()
+        types = dict(zip(schema.names, schema.types))
         splits = []
         for path in self.paths:
             f = orc.ORCFile(path)
             n = f.nstripes
             self.chunks_total += max(n, 1)
-            if n <= 1:
-                splits.append(_StripeSplit(path, ()))
-            else:
-                splits.extend(_StripeSplit(path, (i,)) for i in range(n))
+            # statistics map by the FILE schema's field order — a column
+            # projection must not shift which physical column a name's
+            # stats come from (parquet resolves by name the same way)
+            per_stripe = stripe_statistics(path, list(f.schema.names)) \
+                if n >= 1 else None
+            if per_stripe is not None and len(per_stripe) != n:
+                per_stripe = None  # tail/stripe mismatch: trust reads
+            for i in range(max(n, 1)):
+                sid = () if n <= 1 else (i,)
+                if per_stripe is not None and self.filters and \
+                        not filter_may_match(self.filters,
+                                             per_stripe[i]):
+                    self.chunks_pruned += 1
+                    continue
+                st = self._split_stats(per_stripe[i], types) \
+                    if per_stripe else ()
+                splits.append(_StripeSplit(path, sid, st))
         return splits
+
+    @staticmethod
+    def _split_stats(stats: dict, types) -> tuple:
+        from spark_rapids_tpu.columnar import dtypes as dt
+
+        out = []
+        for name, (lo, hi, _has_null) in stats.items():
+            typ = types.get(name)
+            # orc_meta decodes int/double/date statistics; only the
+            # discrete kinds feed packed keys (no timestampStatistics)
+            if typ is not None and (typ.is_integral or typ is dt.DATE):
+                out.append((name, int(lo), int(hi)))
+        return tuple(out)
+
+    def split_stats(self, split: int):
+        descs = self.splits()
+        if not descs:
+            return None
+        return dict((c, (lo, hi))
+                    for c, lo, hi in descs[split].stats) or None
 
     def _read_split(self, desc: _StripeSplit):
         import pyarrow as pa

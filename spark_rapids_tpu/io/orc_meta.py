@@ -1,0 +1,184 @@
+"""Minimal ORC tail reader: per-STRIPE column statistics.
+
+The reference prunes ORC stripes with search arguments before device
+decode (OrcFilters.scala:206, GpuOrcScan.scala); pyarrow's ORC binding
+exposes stripe READS but not stripe statistics, so this module walks the
+ORC file tail directly:
+
+    [metadata][footer][postscript][psLen: 1 byte]
+
+- postscript (uncompressed protobuf): footerLength=1,
+  compression=2 (0 none / 1 zlib / 5 zstd), compressionBlockSize=3,
+  metadataLength=5
+- the metadata section is an ORC compressed stream (3-byte block
+  headers, (len << 1) | isOriginal) holding the Metadata protobuf:
+  repeated StripeStatistics stripeStats=1, each a repeated
+  ColumnStatistics colStats=1 with intStatistics=2 (sint64 min=1/max=2),
+  doubleStatistics=3 (double min=1/max=2), dateStatistics=7
+  (sint32 days min=1/max=2) and hasNull=10.
+
+Only the statistic kinds the pruning filters consume are decoded; any
+unknown compression or malformed tail degrades to "no stats" (scan
+correctness never depends on pruning). Column index: colStats[0] is the
+whole-struct column, flat schema field i sits at colStats[i + 1].
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+
+def _varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _fields(buf: bytes):
+    """Iterate (field_number, wire_type, value) over a protobuf buffer.
+    value: int for varint, bytes for length-delimited, raw 8/4 bytes for
+    fixed."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _varint(buf, pos)
+        fnum, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = _varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:  # pragma: no cover - groups unused by ORC
+            return
+        yield fnum, wt, v
+
+
+def _decompress_stream(data: bytes, kind: int) -> Optional[bytes]:
+    """ORC compressed stream: series of 3-byte-header blocks."""
+    if kind == 0:
+        return data
+    out = bytearray()
+    pos = 0
+    while pos + 3 <= len(data):
+        header = data[pos] | (data[pos + 1] << 8) | (data[pos + 2] << 16)
+        pos += 3
+        ln = header >> 1
+        original = header & 1
+        chunk = data[pos:pos + ln]
+        pos += ln
+        if original:
+            out += chunk
+        elif kind == 1:  # zlib (raw deflate)
+            out += zlib.decompress(chunk, -15)
+        elif kind == 5:  # zstd
+            try:
+                import zstandard
+
+                out += zstandard.ZstdDecompressor().decompress(
+                    chunk, max_output_size=1 << 26)
+            except Exception:
+                return None
+        else:  # snappy/lzo: no codec available
+            return None
+    return bytes(out)
+
+
+def _column_stats(buf: bytes) -> Tuple[Optional[Tuple], bool]:
+    """ColumnStatistics -> ((min, max) or None, has_null)."""
+    mn = mx = None
+    has_null = False
+    for fnum, wt, v in _fields(buf):
+        if fnum == 2 and wt == 2:            # intStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    mn = _zigzag(v2)
+                elif f2 == 2 and w2 == 0:
+                    mx = _zigzag(v2)
+        elif fnum == 3 and wt == 2:          # doubleStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 1:
+                    mn = struct.unpack("<d", v2)[0]
+                elif f2 == 2 and w2 == 1:
+                    mx = struct.unpack("<d", v2)[0]
+        elif fnum == 7 and wt == 2:          # dateStatistics (days)
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    mn = _zigzag(v2)
+                elif f2 == 2 and w2 == 0:
+                    mx = _zigzag(v2)
+        elif fnum == 10 and wt == 0:         # hasNull
+            has_null = bool(v)
+    if mn is None or mx is None:
+        return None, has_null
+    return (mn, mx), has_null
+
+
+def stripe_statistics(path: str, column_names: List[str]
+                      ) -> Optional[List[Dict[str, tuple]]]:
+    """Per-stripe {column: (min, max, has_null)} for a FLAT schema, or
+    None when the tail can't be decoded (unknown codec, nested schema,
+    old writer). Shape matches parquet's row-group stats consumer
+    (io/filesrc.filter_may_match)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            tail_len = min(size, 1 << 20)
+            f.seek(size - tail_len)
+            tail = f.read(tail_len)
+        ps_len = tail[-1]
+        ps = tail[-1 - ps_len:-1]
+        footer_len = metadata_len = 0
+        compression = 0
+        for fnum, wt, v in _fields(ps):
+            if fnum == 1 and wt == 0:
+                footer_len = v
+            elif fnum == 2 and wt == 0:
+                compression = v
+            elif fnum == 5 and wt == 0:
+                metadata_len = v
+        if metadata_len == 0:
+            return None
+        meta_end = len(tail) - 1 - ps_len - footer_len
+        meta_raw = tail[meta_end - metadata_len:meta_end]
+        if len(meta_raw) != metadata_len:
+            return None  # tail window too small (huge footer)
+        meta = _decompress_stream(meta_raw, compression)
+        if meta is None:
+            return None
+        out: List[Dict[str, tuple]] = []
+        for fnum, wt, v in _fields(meta):
+            if fnum != 1 or wt != 2:
+                continue
+            cols = [v2 for f2, w2, v2 in _fields(v)
+                    if f2 == 1 and w2 == 2]
+            stats: Dict[str, tuple] = {}
+            # cols[0] = struct root; flat field i at cols[i + 1]
+            for i, name in enumerate(column_names):
+                if i + 1 >= len(cols):
+                    break
+                rng, has_null = _column_stats(cols[i + 1])
+                if rng is not None:
+                    stats[name] = (rng[0], rng[1], has_null)
+            out.append(stats)
+        return out or None
+    except Exception:
+        return None

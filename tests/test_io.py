@@ -329,3 +329,69 @@ def test_parquet_debug_dump(tmp_path, pq_file):
         {"rapids.tpu.sql.parquet.debug.dumpPrefix": str(dump)}))
     src.read_host()
     assert os.listdir(dump) == ["data.parquet"]
+
+
+def test_orc_stripe_statistics_pushdown(tmp_path):
+    """Stripe-level min/max pruning (OrcFilters.scala:206 analogue, read
+    from the ORC tail directly): a filter outside a stripe's range drops
+    the stripe before any read; surviving stripes feed Column.stats."""
+    import pyarrow as pa
+    from pyarrow import orc
+
+    from spark_rapids_tpu.io.orc_meta import stripe_statistics
+
+    path = str(tmp_path / "t.orc")
+    # 4 stripes with disjoint k ranges (tiny stripe size forces splits)
+    ks = np.arange(0, 40_000, dtype=np.int64)
+    vs = (ks % 97).astype(np.float64)
+    orc.write_table(pa.table({"k": ks, "v": vs}), path,
+                    stripe_size=64 << 10)
+    f = orc.ORCFile(path)
+    assert f.nstripes > 2, f.nstripes
+
+    stats = stripe_statistics(path, ["k", "v"])
+    assert stats is not None and len(stats) == f.nstripes
+    lo0, hi0, _ = stats[0]["k"]
+    assert lo0 == 0 and hi0 < 40_000
+
+    # filter selecting only the LAST stripe's range
+    lo_last = stats[-1]["k"][0]
+    src = OrcSource(str(path), filters=[("k", ">=", int(lo_last))])
+    src.splits()
+    assert src.chunks_pruned >= f.nstripes - 1
+    got = pn.ScanNode(src)
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    df = collect(apply_overrides(got))
+    assert sorted(df["k"].tolist()) == list(range(int(lo_last), 40_000))
+
+    # surviving stripes feed Column.stats (packed-key groupby path)
+    st = src.split_stats(0)
+    assert st is not None and st["k"][0] >= int(lo_last)
+
+
+def test_orc_stats_map_by_file_schema_under_projection(tmp_path):
+    """Column projection must not shift which physical column a name's
+    stats come from (r3 review: positional mapping attributed k's range
+    to v and pruned stripes that DID match)."""
+    import pyarrow as pa
+    from pyarrow import orc
+
+    path = str(tmp_path / "p.orc")
+    ks = np.arange(0, 40_000, dtype=np.int64)
+    vs = (ks % 97).astype(np.float64)
+    orc.write_table(pa.table({"k": ks, "v": vs}), path,
+                    stripe_size=64 << 10)
+    src = OrcSource(str(path), columns=["v"],
+                    filters=[("v", "<", 0.5)])
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    # v spans 0..96 in EVERY stripe, so nothing may be pruned (the
+    # positional-mapping bug attributed k's disjoint ranges to v and
+    # pruned all but the first stripe); source filters prune chunks
+    # only — row filtering is the Filter node's job
+    df = collect(apply_overrides(pn.ScanNode(src)))
+    assert src.chunks_pruned == 0
+    assert len(df) == 40_000
