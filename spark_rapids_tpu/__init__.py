@@ -41,8 +41,10 @@ if not os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
     # features (+prefer-no-scatter etc.); a plain-CPU process loading
     # such an entry SIGSEGVs inside the AOT loader. Processes forced to
     # CPU (tests, dryrun) therefore use their own cache.
-    _suffix = "_cpu" if "cpu" in os.environ.get("JAX_PLATFORMS", "") \
-        else ""
+    # the SELECTED platform is the first entry of the priority list —
+    # "tpu,cpu" is a TPU process and must NOT write into the CPU cache
+    _first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    _suffix = "_cpu" if _first == "cpu" else ""
     _cache_dir = os.environ.get(
         "SPARK_RAPIDS_TPU_COMPILE_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
