@@ -153,8 +153,10 @@ def gen_catalog_sales(sf: float, seed: int = 34) -> pa.Table:
         "cs_order_number": rng.integers(1, max(n // 3, 2), n
                                         ).astype(np.int64),
         "cs_warehouse_sk": rng.integers(1, n_wh + 1, n).astype(np.int64),
+        "cs_sold_time_sk": rng.integers(0, 86_400, n).astype(np.int64),
         "cs_quantity": rng.integers(1, 101, n).astype(np.int32),
         "cs_sales_price": np.round(rng.random(n) * 200, 2),
+        "cs_ext_discount_amt": np.round(rng.random(n) * 4_000, 2),
         "cs_net_profit": np.round(rng.random(n) * 4_000 - 2_000, 2),
         "cs_ext_sales_price": np.round(rng.random(n) * 20_000, 2),
     })
@@ -273,10 +275,16 @@ def gen_household_demographics(sf: float, seed: int = 39) -> pa.Table:
 
 def gen_time_dim(sf: float, seed: int = 40) -> pa.Table:
     secs = np.arange(86_400, dtype=np.int64)
+    hours = secs // 3600
+    meal = np.where(
+        (hours >= 6) & (hours <= 9), "breakfast",
+        np.where((hours >= 11) & (hours <= 13), "lunch",
+                 np.where((hours >= 17) & (hours <= 20), "dinner", "")))
     return pa.table({
         "t_time_sk": secs,
-        "t_hour": (secs // 3600).astype(np.int32),
+        "t_hour": hours.astype(np.int32),
         "t_minute": (secs // 60 % 60).astype(np.int32),
+        "t_meal_time": meal.astype(object),
     })
 
 
@@ -1322,3 +1330,333 @@ LIMIT 100
 
 for _name, _sql in TPCDS_SQL.items():
     QUERIES[f"tpcds_{_name}"] = _sql_query(_sql)
+TPCDS_SQL["q1"] = """
+WITH customer_total_return AS
+  (SELECT sr_customer_sk AS ctr_customer_sk,
+          ss_store_sk AS ctr_store_sk,
+          sum(sr_return_amt) AS ctr_total_return
+   FROM store_returns, store_sales, date_dim
+   WHERE sr_ticket_number = ss_ticket_number
+   AND sr_item_sk = ss_item_sk
+   AND sr_returned_date_sk = d_date_sk AND d_year = 2000
+   GROUP BY sr_customer_sk, ss_store_sk),
+store_avg AS
+  (SELECT ctr_store_sk AS avg_store_sk,
+          avg(ctr_total_return) * 1.2 AS thresh
+   FROM customer_total_return GROUP BY ctr_store_sk)
+SELECT c_customer_id
+FROM customer_total_return ctr1, store_avg, store, customer
+WHERE ctr1.ctr_store_sk = store_avg.avg_store_sk
+AND ctr1.ctr_total_return > store_avg.thresh
+AND s_store_sk = ctr1.ctr_store_sk
+AND s_state = 'TN'
+AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id LIMIT 100
+"""
+
+TPCDS_SQL["q12"] = """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+  sum(ws_ext_sales_price) AS itemrevenue,
+  sum(ws_ext_sales_price) * 100 / sum(sum(ws_ext_sales_price)) OVER
+    (PARTITION BY i_class) AS revenueratio
+FROM web_sales, item, date_dim
+WHERE ws_item_sk = i_item_sk
+AND i_category IN ('Sports', 'Books', 'Home')
+AND ws_sold_date_sk = d_date_sk
+AND d_date BETWEEN cast('1999-02-22' AS date)
+              AND (cast('1999-02-22' AS date) + INTERVAL '30' day)
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100
+"""
+
+TPCDS_SQL["q20"] = """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+  sum(cs_ext_sales_price) AS itemrevenue,
+  sum(cs_ext_sales_price) * 100 / sum(sum(cs_ext_sales_price)) OVER
+    (PARTITION BY i_class) AS revenueratio
+FROM catalog_sales, item, date_dim
+WHERE cs_item_sk = i_item_sk
+AND i_category IN ('Sports', 'Books', 'Home')
+AND cs_sold_date_sk = d_date_sk
+AND d_date BETWEEN cast('1999-02-22' AS date)
+              AND (cast('1999-02-22' AS date) + INTERVAL '30' day)
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100
+"""
+
+TPCDS_SQL["q21"] = """
+SELECT * FROM (
+  SELECT w_warehouse_name, i_item_id,
+    sum(CASE WHEN d_date < cast('2000-03-11' AS date)
+        THEN inv_quantity_on_hand ELSE 0 END) AS inv_before,
+    sum(CASE WHEN d_date >= cast('2000-03-11' AS date)
+        THEN inv_quantity_on_hand ELSE 0 END) AS inv_after
+  FROM inventory, warehouse, item, date_dim
+  WHERE i_current_price BETWEEN 0.99 AND 1.49
+  AND i_item_sk = inv_item_sk
+  AND inv_warehouse_sk = w_warehouse_sk
+  AND inv_date_sk = d_date_sk
+  AND d_date BETWEEN (cast('2000-03-11' AS date) - INTERVAL '30' day)
+                AND (cast('2000-03-11' AS date) + INTERVAL '30' day)
+  GROUP BY w_warehouse_name, i_item_id) x
+WHERE (CASE WHEN inv_before > 0 THEN inv_after / inv_before
+       ELSE null END) BETWEEN 2.0 / 3.0 AND 3.0 / 2.0
+ORDER BY w_warehouse_name, i_item_id
+LIMIT 100
+"""
+
+TPCDS_SQL["q29"] = """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+  sum(ss_quantity) AS store_sales_quantity,
+  sum(sr_return_quantity) AS store_returns_quantity,
+  sum(cs_quantity) AS catalog_sales_quantity
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+WHERE d1.d_moy = 4 AND d1.d_year = 1999
+AND d1.d_date_sk = ss_sold_date_sk
+AND i_item_sk = ss_item_sk
+AND s_store_sk = ss_store_sk
+AND ss_customer_sk = sr_customer_sk
+AND ss_item_sk = sr_item_sk
+AND ss_ticket_number = sr_ticket_number
+AND sr_returned_date_sk = d2.d_date_sk
+AND d2.d_moy BETWEEN 4 AND 7 AND d2.d_year = 1999
+AND sr_customer_sk = cs_bill_customer_sk
+AND sr_item_sk = cs_item_sk
+AND cs_sold_date_sk = d3.d_date_sk
+AND d3.d_year IN (1999, 2000, 2001)
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+LIMIT 100
+"""
+
+# q32/q92: the spec's correlated per-item scalar subquery decorrelates
+# into a grouped-average join (the rewrite Spark's optimizer performs)
+TPCDS_SQL["q32"] = """
+SELECT sum(cs_ext_discount_amt) AS excess_discount_amount
+FROM catalog_sales, item, date_dim,
+  (SELECT cs_item_sk AS t_item_sk,
+          1.3 * avg(cs_ext_discount_amt) AS thresh
+   FROM catalog_sales, date_dim
+   WHERE d_date BETWEEN cast('2000-01-27' AS date)
+                   AND (cast('2000-01-27' AS date) + INTERVAL '90' day)
+   AND d_date_sk = cs_sold_date_sk
+   GROUP BY cs_item_sk) t
+WHERE i_manufact_id = 977
+AND i_item_sk = cs_item_sk
+AND t.t_item_sk = cs_item_sk
+AND d_date BETWEEN cast('2000-01-27' AS date)
+              AND (cast('2000-01-27' AS date) + INTERVAL '90' day)
+AND d_date_sk = cs_sold_date_sk
+AND cs_ext_discount_amt > t.thresh
+LIMIT 100
+"""
+
+TPCDS_SQL["q34"] = """
+SELECT c_last_name, c_first_name, c_salutation,
+       c_preferred_cust_flag, ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      AND store_sales.ss_store_sk = store.s_store_sk
+      AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+      AND (date_dim.d_dom BETWEEN 1 AND 3 OR
+           date_dim.d_dom BETWEEN 25 AND 28)
+      AND (household_demographics.hd_buy_potential = '>10000' OR
+           household_demographics.hd_buy_potential = 'unknown')
+      AND household_demographics.hd_vehicle_count > 0
+      AND (CASE WHEN household_demographics.hd_vehicle_count > 0
+           THEN household_demographics.hd_dep_count /
+                household_demographics.hd_vehicle_count
+           ELSE null END) > 1.2
+      AND date_dim.d_year IN (1999, 2000, 2001)
+      AND store.s_county IN ('Williamson County')
+      GROUP BY ss_ticket_number, ss_customer_sk) dn, customer
+WHERE ss_customer_sk = c_customer_sk
+AND cnt BETWEEN 2 AND 20
+ORDER BY c_last_name, c_first_name, c_salutation,
+         c_preferred_cust_flag DESC, ss_ticket_number
+LIMIT 1000
+"""
+
+# q39: the spec's simple-CASE (case mean when 0 ...) spelled searched
+TPCDS_SQL["q39"] = """
+WITH inv AS
+  (SELECT w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+          stdev, mean,
+          CASE WHEN mean = 0 THEN null ELSE stdev / mean END cov
+   FROM (SELECT w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+                stddev_samp(inv_quantity_on_hand) stdev,
+                avg(inv_quantity_on_hand) mean
+         FROM inventory, item, warehouse, date_dim
+         WHERE inv_item_sk = i_item_sk
+         AND inv_warehouse_sk = w_warehouse_sk
+         AND inv_date_sk = d_date_sk
+         AND d_year = 2001
+         GROUP BY w_warehouse_name, w_warehouse_sk, i_item_sk,
+                  d_moy) foo
+   WHERE CASE WHEN mean = 0 THEN 0 ELSE stdev / mean END > 1)
+SELECT inv1.w_warehouse_sk AS w1, inv1.i_item_sk AS i1,
+       inv1.d_moy AS moy1, inv1.mean AS mean1, inv1.cov AS cov1,
+       inv2.w_warehouse_sk AS w2, inv2.i_item_sk AS i2,
+       inv2.d_moy AS moy2, inv2.mean AS mean2, inv2.cov AS cov2
+FROM inv inv1, inv inv2
+WHERE inv1.i_item_sk = inv2.i_item_sk
+AND inv1.w_warehouse_sk = inv2.w_warehouse_sk
+AND inv1.d_moy = 1 AND inv2.d_moy = 2
+ORDER BY w1, i1, moy1, mean1, cov1, moy2, mean2, cov2
+"""
+
+# q53/q89: brand-literal pools adapted to the generated category/class
+# values (brands are random; the plan shape — OR'd pools + windowed
+# average deviation — is what the query exercises)
+TPCDS_SQL["q53"] = """
+SELECT * FROM
+  (SELECT i_manufact_id, sum(ss_sales_price) sum_sales,
+          avg(sum(ss_sales_price)) OVER
+            (PARTITION BY i_manufact_id) avg_quarterly_sales
+   FROM item, store_sales, date_dim, store
+   WHERE ss_item_sk = i_item_sk AND
+   ss_sold_date_sk = d_date_sk AND
+   ss_store_sk = s_store_sk AND
+   d_month_seq IN (24, 25, 26, 27, 28, 29, 30, 31, 32, 33, 34, 35) AND
+   ((i_category IN ('Books', 'Children', 'Electronics') AND
+     i_class IN ('class1', 'class2', 'class3', 'class4'))
+    OR (i_category IN ('Women', 'Music', 'Men') AND
+        i_class IN ('class5', 'class6', 'class7', 'class8')))
+   GROUP BY i_manufact_id, d_qoy) tmp1
+WHERE CASE WHEN avg_quarterly_sales > 0
+      THEN abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+      ELSE null END > 0.1
+ORDER BY avg_quarterly_sales, sum_sales, i_manufact_id
+LIMIT 100
+"""
+
+TPCDS_SQL["q60"] = """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category IN ('Music'))
+  AND ss_item_sk = i_item_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 9
+  AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category IN ('Music'))
+  AND cs_item_sk = i_item_sk
+  AND cs_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 9
+  AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category IN ('Music'))
+  AND ws_item_sk = i_item_sk
+  AND ws_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 9
+  AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5.0
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL
+      SELECT * FROM cs UNION ALL
+      SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY i_item_id, total_sales
+LIMIT 100
+"""
+
+TPCDS_SQL["q71"] = """
+SELECT i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+       sum(ext_price) ext_price
+FROM item,
+  (SELECT ws_ext_sales_price AS ext_price,
+          ws_sold_date_sk AS sold_date_sk,
+          ws_item_sk AS sold_item_sk,
+          ws_sold_time_sk AS time_sk
+   FROM web_sales, date_dim
+   WHERE d_date_sk = ws_sold_date_sk AND d_moy = 11 AND d_year = 1999
+   UNION ALL
+   SELECT cs_ext_sales_price AS ext_price,
+          cs_sold_date_sk AS sold_date_sk,
+          cs_item_sk AS sold_item_sk,
+          cs_sold_time_sk AS time_sk
+   FROM catalog_sales, date_dim
+   WHERE d_date_sk = cs_sold_date_sk AND d_moy = 11 AND d_year = 1999
+   UNION ALL
+   SELECT ss_ext_sales_price AS ext_price,
+          ss_sold_date_sk AS sold_date_sk,
+          ss_item_sk AS sold_item_sk,
+          ss_sold_time_sk AS time_sk
+   FROM store_sales, date_dim
+   WHERE d_date_sk = ss_sold_date_sk AND d_moy = 11 AND d_year = 1999
+  ) tmp, time_dim
+WHERE sold_item_sk = i_item_sk
+AND i_manager_id = 1
+AND time_sk = t_time_sk
+AND (t_meal_time = 'breakfast' OR t_meal_time = 'dinner')
+GROUP BY i_brand, i_brand_id, t_hour, t_minute
+ORDER BY ext_price DESC, i_brand_id, t_hour, t_minute
+LIMIT 1000
+"""
+
+TPCDS_SQL["q89"] = """
+SELECT * FROM (
+  SELECT i_category, i_class, i_brand, s_store_name, s_store_id,
+         d_moy, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) OVER
+           (PARTITION BY i_category, i_brand, s_store_name, s_store_id)
+         avg_monthly_sales
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND
+  ss_sold_date_sk = d_date_sk AND
+  ss_store_sk = s_store_sk AND
+  d_year IN (1999) AND
+  ((i_category IN ('Books', 'Electronics', 'Sports') AND
+    i_class IN ('class1', 'class2', 'class3'))
+   OR (i_category IN ('Men', 'Jewelry', 'Women') AND
+       i_class IN ('class4', 'class5', 'class6')))
+  GROUP BY i_category, i_class, i_brand, s_store_name, s_store_id,
+           d_moy) tmp1
+WHERE CASE WHEN avg_monthly_sales <> 0
+      THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+      ELSE null END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, s_store_name, s_store_id,
+         i_category, i_class, i_brand, d_moy
+LIMIT 100
+"""
+
+TPCDS_SQL["q92"] = """
+SELECT sum(ws_ext_discount_amt) AS excess_discount_amount
+FROM web_sales, item, date_dim,
+  (SELECT ws_item_sk AS t_item_sk,
+          1.3 * avg(ws_ext_discount_amt) AS thresh
+   FROM web_sales, date_dim
+   WHERE d_date BETWEEN cast('2000-01-27' AS date)
+                   AND (cast('2000-01-27' AS date) + INTERVAL '90' day)
+   AND d_date_sk = ws_sold_date_sk
+   GROUP BY ws_item_sk) t
+WHERE i_manufact_id = 350
+AND i_item_sk = ws_item_sk
+AND t.t_item_sk = ws_item_sk
+AND d_date BETWEEN cast('2000-01-27' AS date)
+              AND (cast('2000-01-27' AS date) + INTERVAL '90' day)
+AND d_date_sk = ws_sold_date_sk
+AND ws_ext_discount_amt > t.thresh
+ORDER BY excess_discount_amount
+LIMIT 100
+"""
+
+# re-iterate the dict: every TPCDS_SQL entry registers, so a query
+# added anywhere above cannot silently skip oracle testing
+for _name, _sql in TPCDS_SQL.items():
+    QUERIES[f"tpcds_{_name}"] = _sql_query(_sql)
+

@@ -311,12 +311,24 @@ class _ExprPlanner:
             return st.Like(e, pat.value)
         if kind == "case":
             _, whens, els = ast
-            pairs = [(self.plan(c), self.plan(v)) for c, v in whens]
-            if els is None or els == ("lit", None, "null"):
-                # explicit ELSE NULL types from the THEN branches
-                els_e = Literal(None, pairs[0][1].dtype)
-            else:
-                els_e = self.plan(els)
+            null_ast = ("lit", None, "null")
+            pairs = [(self.plan(c),
+                      None if v == null_ast else self.plan(v))
+                     for c, v in whens]
+            els_e = None if (els is None or els == null_ast) \
+                else self.plan(els)
+            # bare NULL branches type from the first typed branch
+            # ("CASE WHEN m = 0 THEN null ELSE s/m END")
+            typed = [v for _c, v in pairs if v is not None]
+            if els_e is not None:
+                typed.append(els_e)
+            if not typed:
+                raise SqlError("CASE with all-NULL branches is untyped")
+            nt = typed[0].dtype
+            pairs = [(c, Literal(None, nt) if v is None else v)
+                     for c, v in pairs]
+            if els_e is None:
+                els_e = Literal(None, nt)
             return cond.CaseWhen(pairs, els_e)
         if kind == "cast":
             to = _CAST_TYPES.get(ast[2])
@@ -1030,7 +1042,9 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
     for wast in winfns:
         node, scope, env = _plan_window(wast, node, scope, env)
 
-    # final projection
+    # final projection. ORDER BY expressions that are not select items
+    # ride as HIDDEN projection columns, sorted on, then projected away
+    # (Spark's planner appends the same hidden sort attributes)
     out_exprs: List[Expression] = []
     out_names: List[str] = []
     for i, (e, alias) in enumerate(sels):
@@ -1038,35 +1052,50 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
         name = alias or (e[2] if e[0] == "col" else f"col{i}")
         out_exprs.append(Alias(expr, name))
         out_names.append(name)
-    node = pn.ProjectNode(out_exprs, node, out_names)
 
+    sel_keys = {repr(e): i for i, (e, _a) in enumerate(sels)}
+    specs = []
+    hidden: List[Expression] = []
+    for e, asc, nulls_first in order_items:
+        if e[0] == "lit" and isinstance(e[1], int):
+            ordinal = e[1] - 1  # ORDER BY position
+            if not 0 <= ordinal < len(sels):
+                raise SqlError(f"ORDER BY position {e[1]} out of range")
+        elif repr(e) in sel_keys:
+            ordinal = sel_keys[repr(e)]
+        elif e[0] == "col" and e[1] is None and e[2] in out_names:
+            ordinal = out_names.index(e[2])
+        else:
+            if q["distinct"]:
+                raise SqlError("ORDER BY over a non-selected expression "
+                               "cannot combine with DISTINCT")
+            ordinal = len(sels) + len(hidden)
+            hidden.append(_ExprPlanner(scope, env).plan(e))
+        specs.append(SortKeySpec(ordinal, asc, nulls_first))
+
+    if hidden:
+        node = pn.ProjectNode(
+            out_exprs + [Alias(h, f"_ord{j}")
+                         for j, h in enumerate(hidden)],
+            node, out_names + [f"_ord{j}"
+                               for j in range(len(hidden))])
+        node = pn.SortNode(specs, node)
+        schema = node.output_schema()
+        node = pn.ProjectNode(
+            [Alias(BoundReference(i, schema.types[i]), out_names[i])
+             for i in range(len(sels))], node, list(out_names))
+        if q["limit"] is not None:
+            node = pn.LimitNode(q["limit"], node)
+        return node
+
+    node = pn.ProjectNode(out_exprs, node, out_names)
     if q["distinct"]:
         schema = node.output_schema()
         node = pn.AggregateNode(
             [BoundReference(i, t) for i, t in enumerate(schema.types)],
             [], node, grouping_names=list(schema.names))
-
-    if order_items:
-        schema = node.output_schema()
-        sel_keys = {repr(e): i for i, (e, _a) in enumerate(sels)}
-        specs = []
-        for e, asc, nulls_first in order_items:
-            if e[0] == "lit" and isinstance(e[1], int):
-                ordinal = e[1] - 1  # ORDER BY position
-                if not 0 <= ordinal < len(schema.names):
-                    raise SqlError(f"ORDER BY position {e[1]} out of "
-                                   "range")
-            elif repr(e) in sel_keys:
-                ordinal = sel_keys[repr(e)]
-            elif e[0] == "col" and e[1] is None and \
-                    e[2] in schema.names:
-                ordinal = schema.names.index(e[2])
-            else:
-                raise SqlError("ORDER BY must reference a select item, "
-                               "its alias, or a position")
-            specs.append(SortKeySpec(ordinal, asc, nulls_first))
+    if specs:
         node = pn.SortNode(specs, node)
-
     if q["limit"] is not None:
         node = pn.LimitNode(q["limit"], node)
     return node
