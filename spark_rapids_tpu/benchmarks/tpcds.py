@@ -61,6 +61,9 @@ def gen_date_dim(sf: float, seed: int = 31) -> pa.Table:
         "d_week_seq": week_seq.astype(np.int32),
         "d_month_seq": month_seq.astype(np.int32),
         "d_qoy": ((months - 1) // 3 + 1).astype(np.int32),
+        "d_quarter_name": np.array(
+            [f"{y}Q{q}" for y, q in
+             zip(years, (months - 1) // 3 + 1)], dtype=object),
     })
 
 
@@ -90,6 +93,18 @@ def gen_item(sf: float, seed: int = 32) -> pa.Table:
             [f"class{c}" for c in rng.integers(1, 9, n)], dtype=object),
         "i_item_desc": np.array([f"item description {i % 997}"
                                  for i in range(n)], dtype=object),
+        "i_product_name": np.array([f"product{i}" for i in range(1, n + 1)],
+                                   dtype=object),
+        "i_color": np.array(
+            ["red", "blue", "green", "yellow", "white", "black",
+             "orange", "purple", "beige", "slate"],
+            dtype=object)[rng.integers(0, 10, n)],
+        "i_size": np.array(
+            ["small", "medium", "large", "extra large", "petite",
+             "economy"], dtype=object)[rng.integers(0, 6, n)],
+        "i_units": np.array(
+            ["Each", "Dozen", "Case", "Pallet", "Gross", "Ounce"],
+            dtype=object)[rng.integers(0, 6, n)],
     })
 
 
@@ -110,11 +125,16 @@ def gen_store_sales(sf: float, seed: int = 33) -> pa.Table:
                                        ).astype(np.int64),
         "ss_cdemo_sk": rng.integers(1, max(int(1_000 * sf), 20) + 1, n
                                     ).astype(np.int64),
-        "ss_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
+        # ~2% nulls: dsdgen fact FKs are nullable, and q44 aggregates
+        # exactly the ss_hdemo_sk IS NULL slice
+        "ss_hdemo_sk": pa.array(rng.integers(1, 7201, n).astype(np.int64),
+                                mask=rng.random(n) < 0.02),
         "ss_promo_sk": rng.integers(1, max(int(300 * sf), 10) + 1, n
                                     ).astype(np.int64),
-        "ss_store_sk": rng.integers(1, max(int(12 * sf), 2) + 1, n
-                                    ).astype(np.int64),
+        # ~2% nulls: q76 aggregates exactly the IS NULL slice
+        "ss_store_sk": pa.array(
+            rng.integers(1, max(int(12 * sf), 2) + 1, n).astype(np.int64),
+            mask=rng.random(n) < 0.02),
         "ss_ticket_number": rng.integers(1, max(n // 3, 2), n
                                          ).astype(np.int64),
         "ss_addr_sk": rng.integers(1, max(int(50_000 * sf), 15) + 1, n
@@ -159,6 +179,28 @@ def gen_catalog_sales(sf: float, seed: int = 34) -> pa.Table:
         "cs_ext_discount_amt": np.round(rng.random(n) * 4_000, 2),
         "cs_net_profit": np.round(rng.random(n) * 4_000 - 2_000, 2),
         "cs_ext_sales_price": np.round(rng.random(n) * 20_000, 2),
+        "cs_bill_cdemo_sk": rng.integers(
+            1, max(int(1_000 * sf), 20) + 1, n).astype(np.int64),
+        "cs_bill_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
+        "cs_promo_sk": rng.integers(
+            1, max(int(300 * sf), 10) + 1, n).astype(np.int64),
+        "cs_ship_customer_sk": rng.integers(1, n_cust + 1, n
+                                            ).astype(np.int64),
+        # ~2% nulls: q76 aggregates exactly the IS NULL slice
+        "cs_ship_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, n).astype(np.int64),
+            mask=rng.random(n) < 0.02),
+        "cs_call_center_sk": rng.integers(1, 7, n).astype(np.int64),
+        "cs_ship_mode_sk": rng.integers(1, 21, n).astype(np.int64),
+        "cs_catalog_page_sk": rng.integers(
+            1, max(int(100 * sf), 10) + 1, n).astype(np.int64),
+        "cs_net_paid": np.round(rng.random(n) * 300, 2),
+        "cs_ext_ship_cost": np.round(rng.random(n) * 100, 2),
+        "cs_ext_wholesale_cost": np.round(rng.random(n) * 100, 2),
+        "cs_ext_list_price": np.round(rng.random(n) * 250, 2),
+        "cs_list_price": np.round(0.5 + rng.random(n) * 200, 2),
+        "cs_wholesale_cost": np.round(0.2 + rng.random(n) * 80, 2),
+        "cs_coupon_amt": np.round(rng.random(n) * 50, 2),
     })
 
 
@@ -192,6 +234,81 @@ def gen_warehouse(sf: float, seed: int = 36) -> pa.Table:
                                       for i in range(1, n + 1)],
                                      dtype=object),
         "w_state": states[rng.integers(0, 5, n)],
+        "w_warehouse_sq_ft": rng.integers(50_000, 1_000_000, n
+                                          ).astype(np.int32),
+        "w_city": np.array(["Midway", "Fairview", "Oakdale"],
+                           dtype=object)[rng.integers(0, 3, n)],
+        "w_county": np.array(["Williamson County", "Bronx County"],
+                             dtype=object)[rng.integers(0, 2, n)],
+        "w_country": np.array(["United States"] * n, dtype=object),
+    })
+
+
+def gen_web_site(sf: float, seed: int = 52) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = 30
+    return pa.table({
+        "web_site_sk": np.arange(1, n + 1, dtype=np.int64),
+        "web_site_id": np.array([f"AAAAAAAA{i:04d}"
+                                 for i in range(1, n + 1)], dtype=object),
+        "web_name": np.array([f"site_{i % 10}" for i in range(n)],
+                             dtype=object),
+        "web_company_name": np.array(["pri", "able", "ought", "eing"],
+                                     dtype=object)[rng.integers(0, 4, n)],
+    })
+
+
+def gen_ship_mode(sf: float, seed: int = 53) -> pa.Table:
+    n = 20
+    types = np.array(["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR",
+                      "TWO DAY"], dtype=object)
+    carriers = np.array(["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL",
+                         "TBS", "ZHOU", "LATVIAN", "MSC", "ORIENTAL",
+                         "BARIAN", "BOXBUNDLES", "ALLIANCE", "HARMSTORF",
+                         "PRIVATECARRIER", "DIAMOND", "RUPEKSA",
+                         "GERMA", "GREAT EASTERN", "VALUE"], dtype=object)
+    return pa.table({
+        "sm_ship_mode_sk": np.arange(1, n + 1, dtype=np.int64),
+        "sm_type": types[np.arange(n) % 5],
+        "sm_carrier": carriers[:n],
+        "sm_code": np.array(["AIR", "SURFACE", "SEA", "LIBRARY"],
+                            dtype=object)[np.arange(n) % 4],
+    })
+
+
+def gen_call_center(sf: float, seed: int = 54) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    n = 6
+    return pa.table({
+        "cc_call_center_sk": np.arange(1, n + 1, dtype=np.int64),
+        "cc_call_center_id": np.array(
+            [f"AAAAAAAA{i:04d}" for i in range(1, n + 1)], dtype=object),
+        "cc_name": np.array([f"call center {i}"
+                             for i in range(1, n + 1)], dtype=object),
+        "cc_county": np.array(["Williamson County", "Franklin Parish",
+                               "Bronx County"], dtype=object)[
+            rng.integers(0, 3, n)],
+        "cc_manager": np.array([f"Manager {i}" for i in range(1, n + 1)],
+                               dtype=object),
+    })
+
+
+def gen_income_band(sf: float, seed: int = 55) -> pa.Table:
+    n = 20
+    lo = np.arange(n, dtype=np.int32) * 10_000
+    return pa.table({
+        "ib_income_band_sk": np.arange(1, n + 1, dtype=np.int64),
+        "ib_lower_bound": lo,
+        "ib_upper_bound": lo + 10_000,
+    })
+
+
+def gen_catalog_page(sf: float, seed: int = 56) -> pa.Table:
+    n = max(int(100 * sf), 10)
+    return pa.table({
+        "cp_catalog_page_sk": np.arange(1, n + 1, dtype=np.int64),
+        "cp_catalog_page_id": np.array(
+            [f"AAAAAAAA{i:04d}" for i in range(1, n + 1)], dtype=object),
     })
 
 
@@ -207,6 +324,12 @@ def gen_store_returns(sf: float, seed: int = 48) -> pa.Table:
     cust = sales["ss_customer_sk"].to_numpy()[idx]
     ticket = sales["ss_ticket_number"].to_numpy()[idx]
     sold = sales["ss_sold_date_sk"].to_numpy()[idx]
+    # not sampled from sales: ss_store_sk is nullable there
+    store_sk = rng.integers(1, max(int(12 * sf), 2) + 1, n
+                            ).astype(np.int64)
+    cdemo = sales["ss_cdemo_sk"].to_numpy()[idx]
+    # not sampled from sales: ss_hdemo_sk is nullable there
+    hdemo = rng.integers(1, 7201, n).astype(np.int64)
     return pa.table({
         "sr_item_sk": item,
         "sr_customer_sk": cust,
@@ -216,6 +339,16 @@ def gen_store_returns(sf: float, seed: int = 48) -> pa.Table:
         "sr_return_amt": np.round(rng.random(n) * 150, 2),
         "sr_net_loss": np.round(rng.random(n) * 80, 2),
         "sr_reason_sk": rng.integers(1, 36, n).astype(np.int64),
+        "sr_store_sk": store_sk,
+        "sr_cdemo_sk": cdemo,
+        "sr_hdemo_sk": hdemo,
+        "sr_fee": np.round(rng.random(n) * 100, 2),
+        "sr_refunded_cash": np.round(rng.random(n) * 100, 2),
+        "sr_reversed_charge": np.round(rng.random(n) * 50, 2),
+        "sr_store_credit": np.round(rng.random(n) * 50, 2),
+        "sr_return_ship_cost": np.round(rng.random(n) * 30, 2),
+        "sr_return_amt_inc_tax": np.round(rng.random(n) * 160, 2),
+        "sr_return_tax": np.round(rng.random(n) * 12, 2),
     })
 
 
@@ -241,6 +374,14 @@ def gen_customer_demographics(sf: float, seed: int = 37) -> pa.Table:
             ["Primary", "Secondary", "College", "2 yr Degree",
              "4 yr Degree", "Advanced Degree", "Unknown"],
             dtype=object)[rng.integers(0, 7, n)],
+        "cd_purchase_estimate": (rng.integers(1, 21, n) * 500
+                                 ).astype(np.int32),
+        "cd_credit_rating": np.array(
+            ["Low Risk", "Good", "High Risk", "Unknown"],
+            dtype=object)[rng.integers(0, 4, n)],
+        "cd_dep_count": rng.integers(0, 7, n).astype(np.int32),
+        "cd_dep_employed_count": rng.integers(0, 7, n).astype(np.int32),
+        "cd_dep_college_count": rng.integers(0, 7, n).astype(np.int32),
     })
 
 
@@ -270,6 +411,7 @@ def gen_household_demographics(sf: float, seed: int = 39) -> pa.Table:
         "hd_dep_count": rng.integers(0, 10, n).astype(np.int32),
         "hd_vehicle_count": rng.integers(0, 6, n).astype(np.int32),
         "hd_buy_potential": pots[rng.integers(0, 4, n)],
+        "hd_income_band_sk": rng.integers(1, 21, n).astype(np.int64),
     })
 
 
@@ -282,9 +424,11 @@ def gen_time_dim(sf: float, seed: int = 40) -> pa.Table:
                  np.where((hours >= 17) & (hours <= 20), "dinner", "")))
     return pa.table({
         "t_time_sk": secs,
+        "t_time": secs.astype(np.int32),
         "t_hour": hours.astype(np.int32),
         "t_minute": (secs // 60 % 60).astype(np.int32),
         "t_meal_time": meal.astype(object),
+        "t_am_pm": np.where(hours < 12, "AM", "PM").astype(object),
     })
 
 
@@ -318,6 +462,11 @@ def gen_store(sf: float, seed: int = 41) -> pa.Table:
                                    dtype=object),
         "s_number_employees": rng.integers(200, 300, n).astype(np.int32),
         "s_company_id": rng.integers(1, 3, n).astype(np.int32),
+        "s_company_name": np.array(["Unknown", "ought"], dtype=object)[
+            rng.integers(0, 2, n)],
+        "s_market_id": rng.integers(1, 11, n).astype(np.int32),
+        "s_floor_space": rng.integers(5_000_000, 10_000_000, n
+                                      ).astype(np.int32),
     })
 
 
@@ -344,6 +493,22 @@ def gen_catalog_returns(sf: float, seed: int = 51) -> pa.Table:
         "cr_item_sk": sales["cs_item_sk"].to_numpy()[idx],
         "cr_order_number": sales["cs_order_number"].to_numpy()[idx],
         "cr_refunded_cash": np.round(rng.random(n) * 100, 2),
+        "cr_returned_date_sk": (sales["cs_sold_date_sk"].to_numpy()[idx]
+                                + rng.integers(1, 90, n)),
+        "cr_returning_customer_sk":
+            sales["cs_bill_customer_sk"].to_numpy()[idx],
+        "cr_refunded_customer_sk":
+            sales["cs_bill_customer_sk"].to_numpy()[idx],
+        "cr_returning_addr_sk": sales["cs_bill_addr_sk"].to_numpy()[idx],
+        "cr_call_center_sk": sales["cs_call_center_sk"].to_numpy()[idx],
+        "cr_catalog_page_sk":
+            sales["cs_catalog_page_sk"].to_numpy()[idx],
+        "cr_return_quantity": rng.integers(1, 20, n).astype(np.int32),
+        "cr_return_amount": np.round(rng.random(n) * 150, 2),
+        "cr_return_amt_inc_tax": np.round(rng.random(n) * 160, 2),
+        "cr_net_loss": np.round(rng.random(n) * 80, 2),
+        "cr_fee": np.round(rng.random(n) * 100, 2),
+        "cr_reason_sk": rng.integers(1, 36, n).astype(np.int64),
     })
 
 
@@ -363,6 +528,7 @@ def gen_customer(sf: float, seed: int = 42) -> pa.Table:
             [f"AAAAAAAA{i:08d}" for i in range(1, n + 1)], dtype=object),
         "c_current_cdemo_sk": rng.integers(1, n_demo + 1, n
                                            ).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
         "c_current_addr_sk": rng.integers(1, n_addr + 1, n
                                           ).astype(np.int64),
         "c_first_name": firsts[rng.integers(0, len(firsts), n)],
@@ -370,6 +536,20 @@ def gen_customer(sf: float, seed: int = 42) -> pa.Table:
         "c_salutation": sals[rng.integers(0, 4, n)],
         "c_preferred_cust_flag": np.array(["Y", "N"], dtype=object)[
             rng.integers(0, 2, n)],
+        "c_birth_country": np.array(
+            ["UNITED STATES", "CANADA", "MEXICO", "JAPAN", "GERMANY"],
+            dtype=object)[rng.integers(0, 5, n)],
+        "c_birth_year": rng.integers(1930, 1993, n).astype(np.int32),
+        "c_birth_month": rng.integers(1, 13, n).astype(np.int32),
+        "c_birth_day": rng.integers(1, 29, n).astype(np.int32),
+        "c_login": np.array([f"login{i}" for i in range(1, n + 1)],
+                            dtype=object),
+        "c_email_address": np.array(
+            [f"c{i}@example.com" for i in range(1, n + 1)], dtype=object),
+        "c_first_sales_date_sk": rng.integers(
+            2450815, 2450815 + 5 * 365, n).astype(np.int64),
+        "c_first_shipto_date_sk": rng.integers(
+            2450815, 2450815 + 5 * 365, n).astype(np.int64),
     })
 
 
@@ -395,6 +575,24 @@ def gen_customer_address(sf: float, seed: int = 44) -> pa.Table:
         "ca_city": cities[rng.integers(0, 5, n)],
         "ca_zip": _CA_ZIP_POOL[rng.integers(0, len(_CA_ZIP_POOL), n)],
         "ca_gmt_offset": np.where(rng.random(n) < 0.6, -5.0, -7.0),
+        "ca_county": np.array(
+            ["Williamson County", "Franklin Parish", "Bronx County",
+             "Orange County", "Walker County", "Ziebach County"],
+            dtype=object)[rng.integers(0, 6, n)],
+        "ca_street_number": np.array([str(i) for i in
+                                      rng.integers(1, 1000, n)],
+                                     dtype=object),
+        "ca_street_name": np.array(
+            [f"street {i % 40}" for i in rng.integers(0, 1000, n)],
+            dtype=object),
+        "ca_street_type": np.array(["Ave", "St", "Blvd", "Ct"],
+                                   dtype=object)[rng.integers(0, 4, n)],
+        "ca_suite_number": np.array(
+            [f"Suite {i % 90}" for i in rng.integers(0, 1000, n)],
+            dtype=object),
+        "ca_location_type": np.array(["apartment", "condo",
+                                      "single family"], dtype=object)[
+            rng.integers(0, 3, n)],
     })
 
 
@@ -428,6 +626,23 @@ def gen_web_sales(sf: float, seed: int = 46) -> pa.Table:
         "ws_ext_wholesale_cost": np.round(rng.random(n) * 100, 2),
         "ws_ext_discount_amt": np.round(rng.random(n) * 40, 2),
         "ws_ext_sales_price": np.round(rng.random(n) * 200, 2),
+        "ws_net_profit": np.round(rng.random(n) * 300 - 150, 2),
+        "ws_web_site_sk": rng.integers(1, 31, n).astype(np.int64),
+        "ws_ship_date_sk": (rng.integers(2450815, 2450815 + 5 * 365, n) +
+                            rng.integers(1, 30, n)).astype(np.int64),
+        "ws_ship_addr_sk": rng.integers(1, n_addr + 1, n
+                                        ).astype(np.int64),
+        # ~2% nulls: q76 aggregates exactly the IS NULL slice
+        "ws_ship_customer_sk": pa.array(
+            rng.integers(1, n_cust + 1, n).astype(np.int64),
+            mask=rng.random(n) < 0.02),
+        "ws_ship_mode_sk": rng.integers(1, 21, n).astype(np.int64),
+        "ws_ext_ship_cost": np.round(rng.random(n) * 100, 2),
+        "ws_wholesale_cost": np.round(0.2 + rng.random(n) * 80, 2),
+        "ws_list_price": np.round(0.5 + rng.random(n) * 200, 2),
+        "ws_promo_sk": rng.integers(
+            1, max(int(300 * sf), 10) + 1, n).astype(np.int64),
+        "ws_coupon_amt": np.round(rng.random(n) * 50, 2),
     })
 
 
@@ -443,6 +658,24 @@ def gen_web_returns(sf: float, seed: int = 48) -> pa.Table:
         "wr_order_number": sales["ws_order_number"].to_numpy()[idx],
         "wr_item_sk": sales["ws_item_sk"].to_numpy()[idx],
         "wr_refunded_cash": np.round(rng.random(n) * 100, 2),
+        "wr_returned_date_sk": (sales["ws_sold_date_sk"].to_numpy()[idx]
+                                + rng.integers(1, 90, n)),
+        "wr_returning_customer_sk":
+            sales["ws_bill_customer_sk"].to_numpy()[idx],
+        "wr_refunded_customer_sk":
+            sales["ws_bill_customer_sk"].to_numpy()[idx],
+        "wr_returning_addr_sk": sales["ws_bill_addr_sk"].to_numpy()[idx],
+        "wr_refunded_addr_sk": sales["ws_bill_addr_sk"].to_numpy()[idx],
+        "wr_refunded_cdemo_sk": rng.integers(
+            1, max(int(1_000 * sf), 20) + 1, n).astype(np.int64),
+        "wr_returning_cdemo_sk": rng.integers(
+            1, max(int(1_000 * sf), 20) + 1, n).astype(np.int64),
+        "wr_web_page_sk": sales["ws_web_page_sk"].to_numpy()[idx],
+        "wr_reason_sk": rng.integers(1, 36, n).astype(np.int64),
+        "wr_return_quantity": rng.integers(1, 20, n).astype(np.int32),
+        "wr_return_amt": np.round(rng.random(n) * 150, 2),
+        "wr_net_loss": np.round(rng.random(n) * 80, 2),
+        "wr_fee": np.round(rng.random(n) * 100, 2),
     })
 
 
@@ -466,6 +699,11 @@ GENERATORS = {
     "customer_address": gen_customer_address,
     "web_sales": gen_web_sales,
     "web_returns": gen_web_returns,
+    "web_site": gen_web_site,
+    "ship_mode": gen_ship_mode,
+    "call_center": gen_call_center,
+    "income_band": gen_income_band,
+    "catalog_page": gen_catalog_page,
 }
 
 
@@ -1653,6 +1891,1228 @@ AND d_date_sk = ws_sold_date_sk
 AND ws_ext_discount_amt > t.thresh
 ORDER BY excess_discount_amount
 LIMIT 100
+"""
+
+# ---------------------------------------------------------------------------
+# round-3 breadth batch A: set operations (INTERSECT/EXCEPT), ROLLUP +
+# grouping(), cross-joined single-row aggregates, simple CASE. Spelling
+# adaptations (semantics-preserving, noted per query): set-op cores are
+# flat (no parenthesized SELECTs), and expression equi-joins pre-project
+# their key (substr'd zips in q8, the week_seq offset in q2) because the
+# planner joins on columns — the rewrite Spark's optimizer performs with
+# ProjectExec before the join.
+
+TPCDS_SQL["q2"] = """
+WITH wscs AS (
+  SELECT ws_sold_date_sk AS sold_date_sk,
+         ws_ext_sales_price AS sales_price FROM web_sales
+  UNION ALL
+  SELECT cs_sold_date_sk AS sold_date_sk,
+         cs_ext_sales_price AS sales_price FROM catalog_sales),
+wswscs AS (
+  SELECT d_week_seq,
+    sum(CASE WHEN d_day_name = 'Sunday' THEN sales_price ELSE null END)
+      AS sun_sales,
+    sum(CASE WHEN d_day_name = 'Monday' THEN sales_price ELSE null END)
+      AS mon_sales,
+    sum(CASE WHEN d_day_name = 'Tuesday' THEN sales_price ELSE null END)
+      AS tue_sales,
+    sum(CASE WHEN d_day_name = 'Wednesday' THEN sales_price ELSE null
+        END) AS wed_sales,
+    sum(CASE WHEN d_day_name = 'Thursday' THEN sales_price ELSE null
+        END) AS thu_sales,
+    sum(CASE WHEN d_day_name = 'Friday' THEN sales_price ELSE null END)
+      AS fri_sales,
+    sum(CASE WHEN d_day_name = 'Saturday' THEN sales_price ELSE null
+        END) AS sat_sales
+  FROM wscs, date_dim WHERE d_date_sk = sold_date_sk
+  GROUP BY d_week_seq)
+SELECT d_week_seq1, round(sun_sales1 / sun_sales2, 2) AS r_sun,
+  round(mon_sales1 / mon_sales2, 2) AS r_mon,
+  round(tue_sales1 / tue_sales2, 2) AS r_tue,
+  round(wed_sales1 / wed_sales2, 2) AS r_wed,
+  round(thu_sales1 / thu_sales2, 2) AS r_thu,
+  round(fri_sales1 / fri_sales2, 2) AS r_fri,
+  round(sat_sales1 / sat_sales2, 2) AS r_sat
+FROM
+  (SELECT wswscs.d_week_seq AS d_week_seq1, sun_sales AS sun_sales1,
+     mon_sales AS mon_sales1, tue_sales AS tue_sales1,
+     wed_sales AS wed_sales1, thu_sales AS thu_sales1,
+     fri_sales AS fri_sales1, sat_sales AS sat_sales1
+   FROM wswscs, date_dim
+   WHERE date_dim.d_week_seq = wswscs.d_week_seq AND d_year = 2001) y,
+  (SELECT wswscs.d_week_seq - 53 AS d_week_seq2, sun_sales AS sun_sales2,
+     mon_sales AS mon_sales2, tue_sales AS tue_sales2,
+     wed_sales AS wed_sales2, thu_sales AS thu_sales2,
+     fri_sales AS fri_sales2, sat_sales AS sat_sales2
+   FROM wswscs, date_dim
+   WHERE date_dim.d_week_seq = wswscs.d_week_seq AND d_year = 2002) z
+WHERE d_week_seq1 = d_week_seq2
+ORDER BY d_week_seq1
+"""
+
+TPCDS_SQL["q8"] = """
+SELECT s_store_name, sum(ss_net_profit) AS total
+FROM store_sales, date_dim,
+  (SELECT s_store_sk, s_store_name, substr(s_zip, 1, 2) AS s_zip2
+   FROM store) s,
+  (SELECT substr(ca_zip5, 1, 2) AS ca_zip2 FROM
+    (SELECT substr(ca_zip, 1, 5) AS ca_zip5 FROM customer_address
+     WHERE substr(ca_zip, 1, 5) IN ('85669', '86197', '88274', '83405',
+       '86475', '85392', '85460', '80348', '81792')
+     INTERSECT
+     SELECT substr(ca_zip, 1, 5) AS ca_zip5
+     FROM customer_address, customer
+     WHERE ca_address_sk = c_current_addr_sk
+       AND c_preferred_cust_flag = 'Y'
+     GROUP BY substr(ca_zip, 1, 5) HAVING count(*) > 10) A2) v1
+WHERE ss_store_sk = s_store_sk AND ss_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 1998 AND s_zip2 = ca_zip2
+GROUP BY s_store_name ORDER BY s_store_name LIMIT 100
+"""
+
+TPCDS_SQL["q27"] = """
+SELECT i_item_id, s_state, grouping(s_state) AS g_state,
+  avg(ss_quantity) AS agg1, avg(ss_list_price) AS agg2,
+  avg(ss_coupon_amt) AS agg3, avg(ss_sales_price) AS agg4
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College' AND d_year = 2002
+  AND s_state = 'TN'
+GROUP BY ROLLUP(i_item_id, s_state)
+ORDER BY i_item_id, s_state LIMIT 100
+"""
+
+TPCDS_SQL["q36"] = """
+SELECT sum(ss_net_profit) / sum(ss_ext_sales_price) AS gross_margin,
+  i_category, i_class,
+  grouping(i_category) + grouping(i_class) AS lochierarchy,
+  rank() OVER (
+    PARTITION BY grouping(i_category) + grouping(i_class),
+      CASE WHEN grouping(i_class) = 0 THEN i_category END
+    ORDER BY sum(ss_net_profit) / sum(ss_ext_sales_price) ASC)
+    AS rank_within_parent
+FROM store_sales, date_dim d1, item, store
+WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND s_state IN ('TN', 'TX', 'OH', 'CA')
+GROUP BY ROLLUP(i_category, i_class)
+ORDER BY lochierarchy DESC,
+  CASE WHEN lochierarchy = 0 THEN i_category END,
+  rank_within_parent LIMIT 100
+"""
+
+TPCDS_SQL["q38"] = """
+SELECT count(*) AS num_hot FROM (
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM store_sales, date_dim, customer
+  WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    AND store_sales.ss_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 36 AND 47
+  INTERSECT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM catalog_sales, date_dim, customer
+  WHERE catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    AND catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 36 AND 47
+  INTERSECT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM web_sales, date_dim, customer
+  WHERE web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    AND web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 36 AND 47) hot_cust
+LIMIT 100
+"""
+
+TPCDS_SQL["q58"] = """
+WITH ss_items AS (
+  SELECT i_item_id AS item_id, sum(ss_ext_sales_price) AS ss_item_rev
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = '2000-01-03'))
+    AND ss_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+cs_items AS (
+  SELECT i_item_id AS item_id, sum(cs_ext_sales_price) AS cs_item_rev
+  FROM catalog_sales, item, date_dim
+  WHERE cs_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = '2000-01-03'))
+    AND cs_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+ws_items AS (
+  SELECT i_item_id AS item_id, sum(ws_ext_sales_price) AS ws_item_rev
+  FROM web_sales, item, date_dim
+  WHERE ws_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = '2000-01-03'))
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY i_item_id)
+SELECT ss_items.item_id, ss_item_rev,
+  ss_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100
+    AS ss_dev,
+  cs_item_rev,
+  cs_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100
+    AS cs_dev,
+  ws_item_rev,
+  ws_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3) * 100
+    AS ws_dev,
+  (ss_item_rev + cs_item_rev + ws_item_rev) / 3 AS average
+FROM ss_items, cs_items, ws_items
+WHERE ss_items.item_id = cs_items.item_id
+  AND ss_items.item_id = ws_items.item_id
+  AND ss_item_rev BETWEEN 0.9 * cs_item_rev AND 1.1 * cs_item_rev
+  AND ss_item_rev BETWEEN 0.9 * ws_item_rev AND 1.1 * ws_item_rev
+  AND cs_item_rev BETWEEN 0.9 * ss_item_rev AND 1.1 * ss_item_rev
+  AND cs_item_rev BETWEEN 0.9 * ws_item_rev AND 1.1 * ws_item_rev
+  AND ws_item_rev BETWEEN 0.9 * ss_item_rev AND 1.1 * ss_item_rev
+  AND ws_item_rev BETWEEN 0.9 * cs_item_rev AND 1.1 * cs_item_rev
+ORDER BY item_id, ss_item_rev LIMIT 100
+"""
+
+TPCDS_SQL["q61"] = """
+SELECT promotions, total, promotions / total * 100 AS pct
+FROM
+  (SELECT sum(ss_ext_sales_price) AS promotions
+   FROM store_sales, store, promotion, date_dim, customer,
+     customer_address, item
+   WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+     AND ss_promo_sk = p_promo_sk AND ss_customer_sk = c_customer_sk
+     AND ca_address_sk = c_current_addr_sk AND ss_item_sk = i_item_sk
+     AND ca_gmt_offset = -5.0 AND i_category = 'Jewelry'
+     AND (p_channel_dmail = 'Y' OR p_channel_email = 'Y'
+          OR p_channel_tv = 'Y')
+     AND s_gmt_offset = -5.0 AND d_year = 1998 AND d_moy = 11)
+   promotional_sales,
+  (SELECT sum(ss_ext_sales_price) AS total
+   FROM store_sales, store, date_dim, customer, customer_address, item
+   WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+     AND ss_customer_sk = c_customer_sk
+     AND ca_address_sk = c_current_addr_sk AND ss_item_sk = i_item_sk
+     AND ca_gmt_offset = -5.0 AND i_category = 'Jewelry'
+     AND s_gmt_offset = -5.0 AND d_year = 1998 AND d_moy = 11)
+   all_sales
+ORDER BY promotions, total LIMIT 100
+"""
+
+TPCDS_SQL["q63"] = """
+SELECT * FROM
+  (SELECT i_manager_id, sum(ss_sales_price) AS sum_sales,
+     avg(sum(ss_sales_price)) OVER (PARTITION BY i_manager_id)
+       AS avg_monthly_sales
+   FROM item, store_sales, date_dim, store
+   WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+     AND ss_store_sk = s_store_sk
+     AND d_month_seq IN (36, 37, 38, 39, 40, 41, 42, 43, 44, 45, 46, 47)
+     AND (i_category IN ('Books', 'Children', 'Electronics')
+            AND i_class IN ('class1', 'class2', 'class3')
+          OR i_category IN ('Women', 'Music', 'Men')
+            AND i_class IN ('class4', 'class5', 'class6'))
+   GROUP BY i_manager_id, d_moy) tmp1
+WHERE CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE null END > 0.1
+ORDER BY i_manager_id, avg_monthly_sales, sum_sales LIMIT 100
+"""
+
+TPCDS_SQL["q70"] = """
+SELECT sum(ss_net_profit) AS total_sum, s_state, s_county,
+  grouping(s_state) + grouping(s_county) AS lochierarchy,
+  rank() OVER (
+    PARTITION BY grouping(s_state) + grouping(s_county),
+      CASE WHEN grouping(s_county) = 0 THEN s_state END
+    ORDER BY sum(ss_net_profit) DESC) AS rank_within_parent
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 36 AND 47
+  AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_state IN (SELECT s_state FROM
+    (SELECT s_state, rank() OVER (PARTITION BY s_state
+       ORDER BY sum(ss_net_profit) DESC) AS ranking
+     FROM store_sales, store, date_dim
+     WHERE d_month_seq BETWEEN 36 AND 47
+       AND d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+     GROUP BY s_state, s_county) tmp1
+    WHERE ranking <= 5)
+GROUP BY ROLLUP(s_state, s_county)
+ORDER BY lochierarchy DESC,
+  CASE WHEN lochierarchy = 0 THEN s_state END,
+  rank_within_parent LIMIT 100
+"""
+
+TPCDS_SQL["q87"] = """
+SELECT count(*) AS num_cool FROM (
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM store_sales, date_dim, customer
+  WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    AND store_sales.ss_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 36 AND 47
+  EXCEPT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM catalog_sales, date_dim, customer
+  WHERE catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    AND catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 36 AND 47
+  EXCEPT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM web_sales, date_dim, customer
+  WHERE web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    AND web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 36 AND 47) cool_cust
+"""
+
+# ---------------------------------------------------------------------------
+# round-3 breadth batch B: correlated [NOT] EXISTS, year-over-year CTE
+# self-joins, deep ROLLUPs, HAVING-level scalar subqueries. Adaptations:
+# "OR EXISTS"/OR'd IN-subqueries become IN over a UNION ALL of the two
+# channels (q10/q35 — same rows, Spark plans an ExistenceJoin);
+# correlated scalar subqueries are hand-decorrelated through a grouped
+# CTE + join (q30/q81, the q1 precedent); q41's correlated count(*) > 0
+# is spelled as IN; q45's OR'd item subquery is spelled over i_item_sk
+# (ids are unique per sk in this datagen).
+
+TPCDS_SQL["q4"] = """
+WITH year_total AS (
+  SELECT c_customer_id AS customer_id, c_first_name AS customer_first_name,
+    c_last_name AS customer_last_name,
+    c_preferred_cust_flag AS customer_preferred_cust_flag,
+    c_birth_country AS customer_birth_country,
+    c_login AS customer_login, c_email_address AS customer_email_address,
+    d_year AS dyear,
+    sum(((ss_ext_list_price - ss_ext_wholesale_cost - ss_ext_discount_amt)
+         + ss_ext_sales_price) / 2) AS year_total, 's' AS sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+    c_preferred_cust_flag, c_birth_country, c_login, c_email_address,
+    d_year
+  UNION ALL
+  SELECT c_customer_id AS customer_id, c_first_name AS customer_first_name,
+    c_last_name AS customer_last_name,
+    c_preferred_cust_flag AS customer_preferred_cust_flag,
+    c_birth_country AS customer_birth_country,
+    c_login AS customer_login, c_email_address AS customer_email_address,
+    d_year AS dyear,
+    sum(((cs_ext_list_price - cs_ext_wholesale_cost - cs_ext_discount_amt)
+         + cs_ext_sales_price) / 2) AS year_total, 'c' AS sale_type
+  FROM customer, catalog_sales, date_dim
+  WHERE c_customer_sk = cs_bill_customer_sk
+    AND cs_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+    c_preferred_cust_flag, c_birth_country, c_login, c_email_address,
+    d_year
+  UNION ALL
+  SELECT c_customer_id AS customer_id, c_first_name AS customer_first_name,
+    c_last_name AS customer_last_name,
+    c_preferred_cust_flag AS customer_preferred_cust_flag,
+    c_birth_country AS customer_birth_country,
+    c_login AS customer_login, c_email_address AS customer_email_address,
+    d_year AS dyear,
+    sum(((ws_ext_list_price - ws_ext_wholesale_cost - ws_ext_discount_amt)
+         + ws_ext_sales_price) / 2) AS year_total, 'w' AS sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+    c_preferred_cust_flag, c_birth_country, c_login, c_email_address,
+    d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+  t_s_secyear.customer_last_name, t_s_secyear.customer_email_address
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+  year_total t_c_firstyear, year_total t_c_secyear,
+  year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_c_firstyear.sale_type = 'c'
+  AND t_w_firstyear.sale_type = 'w' AND t_s_secyear.sale_type = 's'
+  AND t_c_secyear.sale_type = 'c' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2001 + 1
+  AND t_c_firstyear.dyear = 2001 AND t_c_secyear.dyear = 2001 + 1
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2001 + 1
+  AND t_s_firstyear.year_total > 0 AND t_c_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE null END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE null END
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE null END
+      > CASE WHEN t_w_firstyear.year_total > 0
+             THEN t_w_secyear.year_total / t_w_firstyear.year_total
+             ELSE null END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+  t_s_secyear.customer_last_name, t_s_secyear.customer_email_address
+LIMIT 100
+"""
+
+TPCDS_SQL["q10"] = """
+SELECT cd_gender, cd_marital_status, cd_education_status,
+  count(*) AS cnt1, cd_purchase_estimate, count(*) AS cnt2,
+  cd_credit_rating, count(*) AS cnt3, cd_dep_count, count(*) AS cnt4,
+  cd_dep_employed_count, count(*) AS cnt5, cd_dep_college_count,
+  count(*) AS cnt6
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_county IN ('Williamson County', 'Franklin Parish',
+                    'Bronx County')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2002
+                AND d_moy BETWEEN 1 AND 4)
+  AND c.c_customer_sk IN
+    (SELECT ws_bill_customer_sk FROM web_sales, date_dim
+     WHERE ws_sold_date_sk = d_date_sk AND d_year = 2002
+       AND d_moy BETWEEN 1 AND 4
+     UNION ALL
+     SELECT cs_ship_customer_sk FROM catalog_sales, date_dim
+     WHERE cs_sold_date_sk = d_date_sk AND d_year = 2002
+       AND d_moy BETWEEN 1 AND 4)
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+  cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+  cd_dep_employed_count, cd_dep_college_count
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+  cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+  cd_dep_employed_count, cd_dep_college_count
+LIMIT 100
+"""
+
+TPCDS_SQL["q11"] = """
+WITH year_total AS (
+  SELECT c_customer_id AS customer_id, c_first_name AS customer_first_name,
+    c_last_name AS customer_last_name,
+    c_preferred_cust_flag AS customer_preferred_cust_flag,
+    c_birth_country AS customer_birth_country,
+    c_login AS customer_login, c_email_address AS customer_email_address,
+    d_year AS dyear,
+    sum(ss_ext_list_price - ss_ext_discount_amt) AS year_total,
+    's' AS sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+    c_preferred_cust_flag, c_birth_country, c_login, c_email_address,
+    d_year
+  UNION ALL
+  SELECT c_customer_id AS customer_id, c_first_name AS customer_first_name,
+    c_last_name AS customer_last_name,
+    c_preferred_cust_flag AS customer_preferred_cust_flag,
+    c_birth_country AS customer_birth_country,
+    c_login AS customer_login, c_email_address AS customer_email_address,
+    d_year AS dyear,
+    sum(ws_ext_list_price - ws_ext_discount_amt) AS year_total,
+    'w' AS sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+    c_preferred_cust_flag, c_birth_country, c_login, c_email_address,
+    d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+  t_s_secyear.customer_last_name,
+  t_s_secyear.customer_preferred_cust_flag
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+  year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2001 + 1
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2001 + 1
+  AND t_s_firstyear.year_total > 0 AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE 0.0 END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE 0.0 END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+  t_s_secyear.customer_last_name,
+  t_s_secyear.customer_preferred_cust_flag
+LIMIT 100
+"""
+
+TPCDS_SQL["q17"] = """
+SELECT i_item_id, i_item_desc, s_state,
+  count(ss_quantity) AS store_sales_quantitycount,
+  avg(ss_quantity) AS store_sales_quantityave,
+  stddev_samp(ss_quantity) AS store_sales_quantitystdev,
+  count(sr_return_quantity) AS store_returns_quantitycount,
+  avg(sr_return_quantity) AS store_returns_quantityave,
+  stddev_samp(sr_return_quantity) AS store_returns_quantitystdev,
+  count(cs_quantity) AS catalog_sales_quantitycount,
+  avg(cs_quantity) AS catalog_sales_quantityave,
+  stddev_samp(cs_quantity) AS catalog_sales_quantitystdev
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+  date_dim d2, date_dim d3, store, item
+WHERE d1.d_quarter_name = '2001Q1' AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_quarter_name IN ('2001Q1', '2001Q2', '2001Q3')
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_quarter_name IN ('2001Q1', '2001Q2', '2001Q3')
+GROUP BY i_item_id, i_item_desc, s_state
+ORDER BY i_item_id, i_item_desc, s_state LIMIT 100
+"""
+
+TPCDS_SQL["q18"] = """
+SELECT i_item_id, ca_country, ca_state, ca_county,
+  avg(cast(cs_quantity AS double)) AS agg1,
+  avg(cast(cs_list_price AS double)) AS agg2,
+  avg(cast(cs_coupon_amt AS double)) AS agg3,
+  avg(cast(cs_sales_price AS double)) AS agg4,
+  avg(cast(cs_net_profit AS double)) AS agg5,
+  avg(cast(c_birth_year AS double)) AS agg6,
+  avg(cast(cd1.cd_dep_count AS double)) AS agg7
+FROM catalog_sales, customer_demographics cd1,
+  customer_demographics cd2, customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = 'F' AND cd1.cd_education_status = 'Unknown'
+  AND c_current_cdemo_sk = cd2.cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND c_birth_month IN (1, 6, 8, 9, 12, 2) AND d_year = 1998
+  AND ca_state IN ('KY', 'GA', 'NM', 'MT', 'OR', 'IN', 'WI')
+GROUP BY ROLLUP(i_item_id, ca_country, ca_state, ca_county)
+ORDER BY ca_country, ca_state, ca_county, i_item_id LIMIT 100
+"""
+
+TPCDS_SQL["q22"] = """
+SELECT i_product_name, i_brand, i_class, i_category,
+  avg(inv_quantity_on_hand) AS qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 36 AND 47
+GROUP BY ROLLUP(i_product_name, i_brand, i_class, i_category)
+ORDER BY qoh, i_product_name, i_brand, i_class, i_category LIMIT 100
+"""
+
+TPCDS_SQL["q26"] = """
+SELECT i_item_id, avg(cs_quantity) AS agg1, avg(cs_list_price) AS agg2,
+  avg(cs_coupon_amt) AS agg3, avg(cs_sales_price) AS agg4
+FROM catalog_sales, customer_demographics, date_dim, item, promotion
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk AND cs_promo_sk = p_promo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND (p_channel_email = 'N' OR p_channel_event = 'N')
+  AND d_year = 2000
+GROUP BY i_item_id ORDER BY i_item_id LIMIT 100
+"""
+
+TPCDS_SQL["q30"] = """
+WITH customer_total_return AS (
+  SELECT wr_returning_customer_sk AS ctr_customer_sk,
+    ca_state AS ctr_state, sum(wr_return_amt) AS ctr_total_return
+  FROM web_returns, date_dim, customer_address
+  WHERE wr_returned_date_sk = d_date_sk AND d_year = 2002
+    AND wr_returning_addr_sk = ca_address_sk
+  GROUP BY wr_returning_customer_sk, ca_state),
+state_avg AS (
+  SELECT ctr_state AS avg_state, avg(ctr_total_return) * 1.2 AS thresh
+  FROM customer_total_return GROUP BY ctr_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+  c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+  c_birth_country, c_login, c_email_address, ctr_total_return
+FROM customer_total_return ctr1, state_avg, customer, customer_address
+WHERE ctr1.ctr_state = state_avg.avg_state
+  AND ctr1.ctr_total_return > state_avg.thresh
+  AND ca_state = 'GA' AND ca_address_sk = c_current_addr_sk
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, c_salutation, c_first_name, c_last_name,
+  c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+  c_birth_country, c_login, c_email_address, ctr_total_return
+LIMIT 100
+"""
+
+TPCDS_SQL["q35"] = """
+SELECT ca_state, cd_gender, cd_marital_status, cd_dep_count,
+  count(*) AS cnt1, avg(cd_dep_count) AS a1, max(cd_dep_count) AS m1,
+  sum(cd_dep_count) AS s1, cd_dep_employed_count, count(*) AS cnt2,
+  avg(cd_dep_employed_count) AS a2, max(cd_dep_employed_count) AS m2,
+  sum(cd_dep_employed_count) AS s2, cd_dep_college_count,
+  count(*) AS cnt3, avg(cd_dep_college_count) AS a3,
+  max(cd_dep_college_count) AS m3, sum(cd_dep_college_count) AS s3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2002
+                AND d_qoy < 4)
+  AND c.c_customer_sk IN
+    (SELECT ws_bill_customer_sk FROM web_sales, date_dim
+     WHERE ws_sold_date_sk = d_date_sk AND d_year = 2002 AND d_qoy < 4
+     UNION ALL
+     SELECT cs_ship_customer_sk FROM catalog_sales, date_dim
+     WHERE cs_sold_date_sk = d_date_sk AND d_year = 2002 AND d_qoy < 4)
+GROUP BY ca_state, cd_gender, cd_marital_status, cd_dep_count,
+  cd_dep_employed_count, cd_dep_college_count
+ORDER BY ca_state, cd_gender, cd_marital_status, cd_dep_count,
+  cd_dep_employed_count, cd_dep_college_count
+LIMIT 100
+"""
+
+TPCDS_SQL["q41"] = """
+SELECT DISTINCT i_product_name
+FROM item i1
+WHERE i_manufact_id BETWEEN 200 AND 800
+  AND i_manufact IN
+    (SELECT i_manufact FROM item
+     WHERE (i_category = 'Women' AND i_color IN ('red', 'blue')
+            AND i_units IN ('Each', 'Dozen')
+            AND i_size IN ('small', 'petite'))
+        OR (i_category = 'Men' AND i_color IN ('green', 'black')
+            AND i_units IN ('Case', 'Gross')
+            AND i_size IN ('large', 'economy')))
+ORDER BY i_product_name LIMIT 100
+"""
+
+TPCDS_SQL["q44"] = """
+SELECT asceding.rnk, i1.i_product_name AS best_performing,
+  i2.i_product_name AS worst_performing
+FROM
+  (SELECT * FROM
+    (SELECT item_sk, rank() OVER (ORDER BY rank_col ASC) AS rnk FROM
+      (SELECT ss_item_sk AS item_sk, avg(ss_net_profit) AS rank_col
+       FROM store_sales ss1 WHERE ss_store_sk = 1 GROUP BY ss_item_sk
+       HAVING avg(ss_net_profit) > 0.9 *
+         (SELECT avg(ss_net_profit) AS rank_col FROM store_sales
+          WHERE ss_store_sk = 1 AND ss_hdemo_sk IS NULL
+          GROUP BY ss_store_sk)) V1) V11
+   WHERE rnk < 11) asceding,
+  (SELECT * FROM
+    (SELECT item_sk, rank() OVER (ORDER BY rank_col DESC) AS rnk FROM
+      (SELECT ss_item_sk AS item_sk, avg(ss_net_profit) AS rank_col
+       FROM store_sales ss1 WHERE ss_store_sk = 1 GROUP BY ss_item_sk
+       HAVING avg(ss_net_profit) > 0.9 *
+         (SELECT avg(ss_net_profit) AS rank_col FROM store_sales
+          WHERE ss_store_sk = 1 AND ss_hdemo_sk IS NULL
+          GROUP BY ss_store_sk)) V2) V21
+   WHERE rnk < 11) descending, item i1, item i2
+WHERE asceding.rnk = descending.rnk
+  AND i1.i_item_sk = asceding.item_sk
+  AND i2.i_item_sk = descending.item_sk
+ORDER BY asceding.rnk LIMIT 100
+"""
+
+TPCDS_SQL["q45"] = """
+SELECT ca_zip, ca_city, sum(ws_sales_price) AS total
+FROM web_sales, customer, customer_address, date_dim, item
+WHERE ws_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk AND ws_item_sk = i_item_sk
+  AND ws_sold_date_sk = d_date_sk
+  AND (substr(ca_zip, 1, 5) IN ('85669', '86197', '88274', '83405',
+         '86475', '85392', '85460', '80348', '81792')
+       OR i_item_sk IN (2, 3, 5, 7, 11, 13, 17, 19, 23, 29))
+  AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip, ca_city ORDER BY ca_zip, ca_city LIMIT 100
+"""
+
+TPCDS_SQL["q47"] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, s_store_name, s_company_name, d_year,
+    d_moy, sum(ss_sales_price) AS sum_sales,
+    avg(sum(ss_sales_price)) OVER (PARTITION BY i_category, i_brand,
+      s_store_name, s_company_name, d_year) AS avg_monthly_sales,
+    rank() OVER (PARTITION BY i_category, i_brand, s_store_name,
+      s_company_name ORDER BY d_year, d_moy) AS rn
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND (d_year = 2000 OR (d_year = 1999 AND d_moy = 12)
+         OR (d_year = 2001 AND d_moy = 1))
+  GROUP BY i_category, i_brand, s_store_name, s_company_name, d_year,
+    d_moy),
+v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.s_store_name, v1.s_company_name,
+    v1.d_year, v1.d_moy, v1.avg_monthly_sales, v1.sum_sales,
+    v1_lag.sum_sales AS psum, v1_lead.sum_sales AS nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_brand = v1_lag.i_brand
+    AND v1.s_store_name = v1_lag.s_store_name
+    AND v1.s_company_name = v1_lag.s_company_name
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lead.i_brand
+    AND v1.s_store_name = v1_lead.s_store_name
+    AND v1.s_company_name = v1_lead.s_company_name
+    AND v1.rn = v1_lag.rn + 1 AND v1.rn = v1_lead.rn - 1)
+SELECT * FROM v2
+WHERE d_year = 2000 AND avg_monthly_sales > 0
+  AND CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE null END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, s_store_name LIMIT 100
+"""
+
+TPCDS_SQL["q56"] = """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'blue', 'red'))
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2 AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.0
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) AS total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'blue', 'red'))
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2 AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.0
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) AS total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'blue', 'red'))
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2 AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.0
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) AS total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id ORDER BY total_sales, i_item_id LIMIT 100
+"""
+
+TPCDS_SQL["q57"] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, cc_name, d_year, d_moy,
+    sum(cs_sales_price) AS sum_sales,
+    avg(sum(cs_sales_price)) OVER (PARTITION BY i_category, i_brand,
+      cc_name, d_year) AS avg_monthly_sales,
+    rank() OVER (PARTITION BY i_category, i_brand, cc_name
+      ORDER BY d_year, d_moy) AS rn
+  FROM item, catalog_sales, date_dim, call_center
+  WHERE cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND cc_call_center_sk = cs_call_center_sk
+    AND (d_year = 2000 OR (d_year = 1999 AND d_moy = 12)
+         OR (d_year = 2001 AND d_moy = 1))
+  GROUP BY i_category, i_brand, cc_name, d_year, d_moy),
+v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+    v1.avg_monthly_sales, v1.sum_sales, v1_lag.sum_sales AS psum,
+    v1_lead.sum_sales AS nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_brand = v1_lag.i_brand AND v1.cc_name = v1_lag.cc_name
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lead.i_brand AND v1.cc_name = v1_lead.cc_name
+    AND v1.rn = v1_lag.rn + 1 AND v1.rn = v1_lead.rn - 1)
+SELECT * FROM v2
+WHERE d_year = 2000 AND avg_monthly_sales > 0
+  AND CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE null END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, cc_name LIMIT 100
+"""
+
+TPCDS_SQL["q67"] = """
+SELECT * FROM
+  (SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+     d_moy, s_store_id, sumsales,
+     rank() OVER (PARTITION BY i_category
+       ORDER BY sumsales DESC) AS rk
+   FROM
+    (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+       d_qoy, d_moy, s_store_id,
+       sum(coalesce(ss_sales_price * ss_quantity, 0)) AS sumsales
+     FROM store_sales, date_dim, store, item
+     WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+       AND ss_store_sk = s_store_sk AND d_month_seq BETWEEN 36 AND 47
+     GROUP BY ROLLUP(i_category, i_class, i_brand, i_product_name,
+       d_year, d_qoy, d_moy, s_store_id)) dw1) dw2
+WHERE rk <= 100
+ORDER BY i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+  d_moy, s_store_id, sumsales, rk
+LIMIT 100
+"""
+
+TPCDS_SQL["q69"] = """
+SELECT cd_gender, cd_marital_status, cd_education_status,
+  count(*) AS cnt1, cd_purchase_estimate, count(*) AS cnt2,
+  cd_credit_rating, count(*) AS cnt3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_state IN ('KY', 'GA', 'NM')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+                AND d_moy BETWEEN 4 AND 6)
+  AND NOT EXISTS (SELECT * FROM web_sales, date_dim
+                  WHERE c.c_customer_sk = ws_bill_customer_sk
+                    AND ws_sold_date_sk = d_date_sk AND d_year = 2001
+                    AND d_moy BETWEEN 4 AND 6)
+  AND NOT EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk AND d_year = 2001
+                    AND d_moy BETWEEN 4 AND 6)
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+  cd_purchase_estimate, cd_credit_rating
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+  cd_purchase_estimate, cd_credit_rating
+LIMIT 100
+"""
+
+TPCDS_SQL["q74"] = """
+WITH year_total AS (
+  SELECT c_customer_id AS customer_id,
+    c_first_name AS customer_first_name,
+    c_last_name AS customer_last_name, d_year AS dyear,
+    sum(ss_net_paid) AS year_total, 's' AS sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year IN (2001, 2002)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id AS customer_id,
+    c_first_name AS customer_first_name,
+    c_last_name AS customer_last_name, d_year AS dyear,
+    sum(ws_net_paid) AS year_total, 'w' AS sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk AND d_year IN (2001, 2002)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+  t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+  year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2002
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2002
+  AND t_s_firstyear.year_total > 0 AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE null END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE null END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+  t_s_secyear.customer_last_name
+LIMIT 100
+"""
+
+TPCDS_SQL["q81"] = """
+WITH customer_total_return AS (
+  SELECT cr_returning_customer_sk AS ctr_customer_sk,
+    ca_state AS ctr_state, sum(cr_return_amt_inc_tax) AS ctr_total_return
+  FROM catalog_returns, date_dim, customer_address
+  WHERE cr_returned_date_sk = d_date_sk AND d_year = 2000
+    AND cr_returning_addr_sk = ca_address_sk
+  GROUP BY cr_returning_customer_sk, ca_state),
+state_avg AS (
+  SELECT ctr_state AS avg_state, avg(ctr_total_return) * 1.2 AS thresh
+  FROM customer_total_return GROUP BY ctr_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+  ca_street_number, ca_street_name, ca_street_type, ca_suite_number,
+  ca_city, ca_county, ca_state, ca_zip, ca_country, ca_gmt_offset,
+  ca_location_type, ctr_total_return
+FROM customer_total_return ctr1, state_avg, customer, customer_address
+WHERE ctr1.ctr_state = state_avg.avg_state
+  AND ctr1.ctr_total_return > state_avg.thresh
+  AND ca_state = 'GA' AND ca_address_sk = c_current_addr_sk
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, c_salutation, c_first_name, c_last_name,
+  ca_street_number, ca_street_name, ca_street_type, ca_suite_number,
+  ca_city, ca_county, ca_state, ca_zip, ca_country, ca_gmt_offset,
+  ca_location_type, ctr_total_return
+LIMIT 100
+"""
+
+# ---------------------------------------------------------------------------
+# round-3 breadth batch C: ship/return chains over the new dimension
+# tables (web_site, ship_mode, call_center, income_band), NULL-FK
+# slices (q76), channel unions with literal tags, LEFT OUTER returns
+# joins. Adaptations: q16/q94's correlated "<>" EXISTS is spelled as IN
+# over a HAVING count(DISTINCT warehouse) > 1 group (same order set);
+# q95 keeps the spec's ws_wh self-join CTE verbatim.
+
+TPCDS_SQL["q16"] = """
+SELECT count(DISTINCT cs_order_number) AS order_count,
+  sum(cs_ext_ship_cost) AS total_shipping_cost,
+  sum(cs_net_profit) AS total_net_profit
+FROM catalog_sales cs1, date_dim, customer_address, call_center
+WHERE d_date BETWEEN cast('2002-02-01' AS date)
+                 AND (cast('2002-02-01' AS date) + interval '60' day)
+  AND cs1.cs_ship_date_sk = d_date_sk
+  AND cs1.cs_ship_addr_sk = ca_address_sk AND ca_state = 'GA'
+  AND cs1.cs_call_center_sk = cc_call_center_sk
+  AND cc_county = 'Williamson County'
+  AND cs1.cs_order_number IN
+    (SELECT cs_order_number FROM catalog_sales
+     GROUP BY cs_order_number
+     HAVING count(DISTINCT cs_warehouse_sk) > 1)
+  AND NOT EXISTS (SELECT * FROM catalog_returns cr1
+                  WHERE cs1.cs_order_number = cr1.cr_order_number)
+ORDER BY count(DISTINCT cs_order_number) LIMIT 100
+"""
+
+TPCDS_SQL["q31"] = """
+WITH ss AS (
+  SELECT ca_county, d_qoy, d_year, sum(ss_ext_sales_price) AS store_sales
+  FROM store_sales, date_dim, customer_address
+  WHERE ss_sold_date_sk = d_date_sk AND ss_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year),
+ws AS (
+  SELECT ca_county, d_qoy, d_year, sum(ws_ext_sales_price) AS web_sales
+  FROM web_sales, date_dim, customer_address
+  WHERE ws_sold_date_sk = d_date_sk AND ws_bill_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year)
+SELECT ss1.ca_county, ss1.d_year,
+  ws2.web_sales / ws1.web_sales AS web_q1_q2_increase,
+  ss2.store_sales / ss1.store_sales AS store_q1_q2_increase,
+  ws3.web_sales / ws2.web_sales AS web_q2_q3_increase,
+  ss3.store_sales / ss2.store_sales AS store_q2_q3_increase
+FROM ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+WHERE ss1.d_qoy = 1 AND ss1.d_year = 2000
+  AND ss1.ca_county = ss2.ca_county
+  AND ss2.d_qoy = 2 AND ss2.d_year = 2000
+  AND ss2.ca_county = ss3.ca_county
+  AND ss3.d_qoy = 3 AND ss3.d_year = 2000
+  AND ss1.ca_county = ws1.ca_county
+  AND ws1.d_qoy = 1 AND ws1.d_year = 2000
+  AND ws1.ca_county = ws2.ca_county
+  AND ws2.d_qoy = 2 AND ws2.d_year = 2000
+  AND ws1.ca_county = ws3.ca_county
+  AND ws3.d_qoy = 3 AND ws3.d_year = 2000
+  AND CASE WHEN ws1.web_sales > 0
+           THEN ws2.web_sales / ws1.web_sales ELSE null END
+      > CASE WHEN ss1.store_sales > 0
+             THEN ss2.store_sales / ss1.store_sales ELSE null END
+  AND CASE WHEN ws2.web_sales > 0
+           THEN ws3.web_sales / ws2.web_sales ELSE null END
+      > CASE WHEN ss2.store_sales > 0
+             THEN ss3.store_sales / ss2.store_sales ELSE null END
+ORDER BY ss1.ca_county
+"""
+
+TPCDS_SQL["q49"] = """
+SELECT 'web' AS channel, item, return_ratio, return_rank, currency_rank
+FROM
+ (SELECT item, return_ratio, currency_ratio,
+    rank() OVER (ORDER BY return_ratio) AS return_rank,
+    rank() OVER (ORDER BY currency_ratio) AS currency_rank
+  FROM
+   (SELECT ws_item_sk AS item,
+      cast(sum(coalesce(wr_return_quantity, 0)) AS double) /
+        cast(sum(coalesce(ws_quantity, 0)) AS double) AS return_ratio,
+      cast(sum(coalesce(wr_return_amt, 0)) AS double) /
+        cast(sum(coalesce(ws_net_paid, 0)) AS double) AS currency_ratio
+    FROM web_sales ws LEFT OUTER JOIN web_returns wr
+      ON (ws.ws_order_number = wr.wr_order_number
+          AND ws.ws_item_sk = wr.wr_item_sk), date_dim
+    WHERE wr_return_amt > 10 AND ws_net_profit > 1
+      AND ws_net_paid > 0 AND ws_quantity > 25
+      AND ws_sold_date_sk = d_date_sk AND d_year = 2001 AND d_moy = 12
+    GROUP BY ws_item_sk) in_web) w
+WHERE return_rank <= 10 OR currency_rank <= 10
+UNION
+SELECT 'catalog' AS channel, item, return_ratio, return_rank,
+  currency_rank
+FROM
+ (SELECT item, return_ratio, currency_ratio,
+    rank() OVER (ORDER BY return_ratio) AS return_rank,
+    rank() OVER (ORDER BY currency_ratio) AS currency_rank
+  FROM
+   (SELECT cs_item_sk AS item,
+      cast(sum(coalesce(cr_return_quantity, 0)) AS double) /
+        cast(sum(coalesce(cs_quantity, 0)) AS double) AS return_ratio,
+      cast(sum(coalesce(cr_return_amount, 0)) AS double) /
+        cast(sum(coalesce(cs_net_paid, 0)) AS double) AS currency_ratio
+    FROM catalog_sales cs LEFT OUTER JOIN catalog_returns cr
+      ON (cs.cs_order_number = cr.cr_order_number
+          AND cs.cs_item_sk = cr.cr_item_sk), date_dim
+    WHERE cr_return_amount > 10 AND cs_net_profit > 1
+      AND cs_net_paid > 0 AND cs_quantity > 25
+      AND cs_sold_date_sk = d_date_sk AND d_year = 2001 AND d_moy = 12
+    GROUP BY cs_item_sk) in_cat) c
+WHERE return_rank <= 10 OR currency_rank <= 10
+UNION
+SELECT 'store' AS channel, item, return_ratio, return_rank,
+  currency_rank
+FROM
+ (SELECT item, return_ratio, currency_ratio,
+    rank() OVER (ORDER BY return_ratio) AS return_rank,
+    rank() OVER (ORDER BY currency_ratio) AS currency_rank
+  FROM
+   (SELECT ss_item_sk AS item,
+      cast(sum(coalesce(sr_return_quantity, 0)) AS double) /
+        cast(sum(coalesce(ss_quantity, 0)) AS double) AS return_ratio,
+      cast(sum(coalesce(sr_return_amt, 0)) AS double) /
+        cast(sum(coalesce(ss_net_paid, 0)) AS double) AS currency_ratio
+    FROM store_sales ss LEFT OUTER JOIN store_returns sr
+      ON (ss.ss_ticket_number = sr.sr_ticket_number
+          AND ss.ss_item_sk = sr.sr_item_sk), date_dim
+    WHERE sr_return_amt > 10 AND ss_net_profit > 1
+      AND ss_net_paid > 0 AND ss_quantity > 25
+      AND ss_sold_date_sk = d_date_sk AND d_year = 2001 AND d_moy = 12
+    GROUP BY ss_item_sk) in_store) s
+WHERE return_rank <= 10 OR currency_rank <= 10
+ORDER BY 1, 4, 5, item LIMIT 100
+"""
+
+TPCDS_SQL["q62"] = """
+SELECT substr(w_warehouse_name, 1, 20) AS wname, sm_type, web_name,
+  sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk <= 30
+      THEN 1 ELSE 0 END) AS d30,
+  sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 30
+       AND ws_ship_date_sk - ws_sold_date_sk <= 60
+      THEN 1 ELSE 0 END) AS d60,
+  sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 60
+       AND ws_ship_date_sk - ws_sold_date_sk <= 90
+      THEN 1 ELSE 0 END) AS d90,
+  sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 90
+       AND ws_ship_date_sk - ws_sold_date_sk <= 120
+      THEN 1 ELSE 0 END) AS d120,
+  sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 120
+      THEN 1 ELSE 0 END) AS dmore
+FROM web_sales, warehouse, ship_mode, web_site, date_dim
+WHERE d_month_seq BETWEEN 36 AND 47 AND ws_ship_date_sk = d_date_sk
+  AND ws_warehouse_sk = w_warehouse_sk
+  AND ws_ship_mode_sk = sm_ship_mode_sk
+  AND ws_web_site_sk = web_site_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, web_name
+ORDER BY wname, sm_type, web_name LIMIT 100
+"""
+
+TPCDS_SQL["q75"] = """
+WITH all_sales AS (
+  SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+    sum(sales_cnt) AS sales_cnt, sum(sales_amt) AS sales_amt
+  FROM (
+    SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+      cs_quantity - coalesce(cr_return_quantity, 0) AS sales_cnt,
+      cs_ext_sales_price - coalesce(cr_return_amount, 0.0) AS sales_amt
+    FROM catalog_sales JOIN item ON i_item_sk = cs_item_sk
+      JOIN date_dim ON d_date_sk = cs_sold_date_sk
+      LEFT JOIN catalog_returns
+        ON (cs_order_number = cr_order_number
+            AND cs_item_sk = cr_item_sk)
+    WHERE i_category = 'Books'
+    UNION
+    SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+      ss_quantity - coalesce(sr_return_quantity, 0) AS sales_cnt,
+      ss_ext_sales_price - coalesce(sr_return_amt, 0.0) AS sales_amt
+    FROM store_sales JOIN item ON i_item_sk = ss_item_sk
+      JOIN date_dim ON d_date_sk = ss_sold_date_sk
+      LEFT JOIN store_returns
+        ON (ss_ticket_number = sr_ticket_number
+            AND ss_item_sk = sr_item_sk)
+    WHERE i_category = 'Books'
+    UNION
+    SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+      ws_quantity - coalesce(wr_return_quantity, 0) AS sales_cnt,
+      ws_ext_sales_price - coalesce(wr_return_amt, 0.0) AS sales_amt
+    FROM web_sales JOIN item ON i_item_sk = ws_item_sk
+      JOIN date_dim ON d_date_sk = ws_sold_date_sk
+      LEFT JOIN web_returns
+        ON (ws_order_number = wr_order_number
+            AND ws_item_sk = wr_item_sk)
+    WHERE i_category = 'Books') sales_detail
+  GROUP BY d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)
+SELECT prev_yr.d_year AS prev_year, curr_yr.d_year AS year,
+  curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+  curr_yr.i_manufact_id, prev_yr.sales_cnt AS prev_yr_cnt,
+  curr_yr.sales_cnt AS curr_yr_cnt,
+  curr_yr.sales_cnt - prev_yr.sales_cnt AS sales_cnt_diff,
+  curr_yr.sales_amt - prev_yr.sales_amt AS sales_amt_diff
+FROM all_sales curr_yr, all_sales prev_yr
+WHERE curr_yr.i_brand_id = prev_yr.i_brand_id
+  AND curr_yr.i_class_id = prev_yr.i_class_id
+  AND curr_yr.i_category_id = prev_yr.i_category_id
+  AND curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  AND curr_yr.d_year = 2002 AND prev_yr.d_year = 2001
+  AND cast(curr_yr.sales_cnt AS double) /
+      cast(prev_yr.sales_cnt AS double) < 0.9
+ORDER BY sales_cnt_diff, sales_amt_diff LIMIT 100
+"""
+
+TPCDS_SQL["q76"] = """
+SELECT channel, col_name, d_year, d_qoy, i_category,
+  count(*) AS sales_cnt, sum(ext_sales_price) AS sales_amt FROM (
+  SELECT 'store' AS channel, 'ss_store_sk' AS col_name, d_year, d_qoy,
+    i_category, ss_ext_sales_price AS ext_sales_price
+  FROM store_sales, item, date_dim
+  WHERE ss_store_sk IS NULL AND ss_sold_date_sk = d_date_sk
+    AND ss_item_sk = i_item_sk
+  UNION ALL
+  SELECT 'web' AS channel, 'ws_ship_customer_sk' AS col_name, d_year,
+    d_qoy, i_category, ws_ext_sales_price AS ext_sales_price
+  FROM web_sales, item, date_dim
+  WHERE ws_ship_customer_sk IS NULL AND ws_sold_date_sk = d_date_sk
+    AND ws_item_sk = i_item_sk
+  UNION ALL
+  SELECT 'catalog' AS channel, 'cs_ship_addr_sk' AS col_name, d_year,
+    d_qoy, i_category, cs_ext_sales_price AS ext_sales_price
+  FROM catalog_sales, item, date_dim
+  WHERE cs_ship_addr_sk IS NULL AND cs_sold_date_sk = d_date_sk
+    AND cs_item_sk = i_item_sk) foo
+GROUP BY channel, col_name, d_year, d_qoy, i_category
+ORDER BY channel, col_name, d_year, d_qoy, i_category LIMIT 100
+"""
+
+TPCDS_SQL["q84"] = """
+SELECT c_customer_id AS customer_id,
+  coalesce(c_last_name, '') || ', ' || coalesce(c_first_name, '')
+    AS customername
+FROM customer, customer_address, customer_demographics,
+  household_demographics, income_band, store_returns
+WHERE ca_city = 'Fairview' AND c_current_addr_sk = ca_address_sk
+  AND ib_lower_bound >= 30000 AND ib_upper_bound <= 50000
+  AND ib_income_band_sk = hd_income_band_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk AND sr_cdemo_sk = cd_demo_sk
+ORDER BY c_customer_id LIMIT 100
+"""
+
+TPCDS_SQL["q85"] = """
+SELECT substr(r_reason_desc, 1, 20) AS rdesc, avg(ws_quantity) AS aq,
+  avg(wr_refunded_cash) AS arc, avg(wr_fee) AS af
+FROM web_sales, web_returns, web_page, customer_demographics cd1,
+  customer_demographics cd2, customer_address, date_dim, reason
+WHERE ws_web_page_sk = wp_web_page_sk AND ws_item_sk = wr_item_sk
+  AND ws_order_number = wr_order_number AND ws_sold_date_sk = d_date_sk
+  AND d_year = 2000 AND cd1.cd_demo_sk = wr_refunded_cdemo_sk
+  AND cd2.cd_demo_sk = wr_returning_cdemo_sk
+  AND ca_address_sk = wr_refunded_addr_sk AND r_reason_sk = wr_reason_sk
+  AND ((cd1.cd_marital_status = 'M'
+        AND cd1.cd_marital_status = cd2.cd_marital_status
+        AND cd1.cd_education_status = 'Advanced Degree'
+        AND cd1.cd_education_status = cd2.cd_education_status
+        AND ws_sales_price BETWEEN 100.0 AND 150.0)
+    OR (cd1.cd_marital_status = 'S'
+        AND cd1.cd_marital_status = cd2.cd_marital_status
+        AND cd1.cd_education_status = 'College'
+        AND cd1.cd_education_status = cd2.cd_education_status
+        AND ws_sales_price BETWEEN 50.0 AND 100.0)
+    OR (cd1.cd_marital_status = 'W'
+        AND cd1.cd_marital_status = cd2.cd_marital_status
+        AND cd1.cd_education_status = '2 yr Degree'
+        AND cd1.cd_education_status = cd2.cd_education_status
+        AND ws_sales_price BETWEEN 150.0 AND 200.0))
+  AND ((ca_country = 'United States'
+        AND ca_state IN ('IN', 'OH', 'NM')
+        AND ws_net_profit BETWEEN 100 AND 200)
+    OR (ca_country = 'United States'
+        AND ca_state IN ('WI', 'CA', 'TX')
+        AND ws_net_profit BETWEEN 50 AND 120)
+    OR (ca_country = 'United States'
+        AND ca_state IN ('KY', 'GA', 'NY')
+        AND ws_net_profit BETWEEN 0 AND 150))
+GROUP BY r_reason_desc
+ORDER BY rdesc, aq, arc, af LIMIT 100
+"""
+
+TPCDS_SQL["q91"] = """
+SELECT cc_call_center_id AS call_center, cc_name AS call_center_name,
+  cc_manager AS manager, sum(cr_net_loss) AS returns_loss
+FROM call_center, catalog_returns, date_dim, customer,
+  customer_address, customer_demographics, household_demographics
+WHERE cr_call_center_sk = cc_call_center_sk
+  AND cr_returned_date_sk = d_date_sk
+  AND cr_returning_customer_sk = c_customer_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND ca_address_sk = c_current_addr_sk
+  AND d_year = 1998 AND d_moy = 11
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'Unknown')
+    OR (cd_marital_status = 'W'
+        AND cd_education_status = 'Advanced Degree'))
+  AND hd_buy_potential LIKE 'unknown%' AND ca_gmt_offset = -7.0
+GROUP BY cc_call_center_id, cc_name, cc_manager, cd_marital_status,
+  cd_education_status
+ORDER BY returns_loss DESC
+"""
+
+TPCDS_SQL["q94"] = """
+SELECT count(DISTINCT ws_order_number) AS order_count,
+  sum(ws_ext_ship_cost) AS total_shipping_cost,
+  sum(ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN cast('1999-02-01' AS date)
+                 AND (cast('1999-02-01' AS date) + interval '60' day)
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk AND ca_state = 'CA'
+  AND ws1.ws_web_site_sk = web_site_sk AND web_company_name = 'pri'
+  AND ws1.ws_order_number IN
+    (SELECT ws_order_number FROM web_sales
+     GROUP BY ws_order_number
+     HAVING count(DISTINCT ws_warehouse_sk) > 1)
+  AND NOT EXISTS (SELECT * FROM web_returns wr1
+                  WHERE ws1.ws_order_number = wr1.wr_order_number)
+ORDER BY count(DISTINCT ws_order_number) LIMIT 100
+"""
+
+TPCDS_SQL["q95"] = """
+WITH ws_wh AS (
+  SELECT ws1.ws_order_number, ws1.ws_warehouse_sk AS wh1,
+    ws2.ws_warehouse_sk AS wh2
+  FROM web_sales ws1, web_sales ws2
+  WHERE ws1.ws_order_number = ws2.ws_order_number
+    AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+SELECT count(DISTINCT ws_order_number) AS order_count,
+  sum(ws_ext_ship_cost) AS total_shipping_cost,
+  sum(ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN cast('1999-02-01' AS date)
+                 AND (cast('1999-02-01' AS date) + interval '60' day)
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk AND ca_state = 'CA'
+  AND ws1.ws_web_site_sk = web_site_sk AND web_company_name = 'pri'
+  AND ws1.ws_order_number IN (SELECT ws_order_number FROM ws_wh)
+  AND ws1.ws_order_number IN
+    (SELECT wr_order_number FROM web_returns, ws_wh
+     WHERE wr_order_number = ws_wh.ws_order_number)
+ORDER BY count(DISTINCT ws_order_number) LIMIT 100
+"""
+
+TPCDS_SQL["q99"] = """
+SELECT substr(w_warehouse_name, 1, 20) AS wname, sm_type, cc_name,
+  sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+      THEN 1 ELSE 0 END) AS d30,
+  sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+       AND cs_ship_date_sk - cs_sold_date_sk <= 60
+      THEN 1 ELSE 0 END) AS d60,
+  sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 60
+       AND cs_ship_date_sk - cs_sold_date_sk <= 90
+      THEN 1 ELSE 0 END) AS d90,
+  sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 90
+       AND cs_ship_date_sk - cs_sold_date_sk <= 120
+      THEN 1 ELSE 0 END) AS d120,
+  sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 120
+      THEN 1 ELSE 0 END) AS dmore
+FROM catalog_sales, warehouse, ship_mode, call_center, date_dim
+WHERE d_month_seq BETWEEN 36 AND 47 AND cs_ship_date_sk = d_date_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_ship_mode_sk = sm_ship_mode_sk
+  AND cs_call_center_sk = cc_call_center_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
+ORDER BY wname, sm_type, cc_name LIMIT 100
 """
 
 # re-iterate the dict: every TPCDS_SQL entry registers, so a query

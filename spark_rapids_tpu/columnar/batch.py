@@ -165,7 +165,13 @@ class ColumnarBatch:
                 # preserve SQL NULLs: use pandas nullable / object via mask
                 values = values.astype(object)
                 values[~validity] = None
-            data[name] = values
+            if values.dtype == object:
+                # explicit object Series: pandas 3's frame constructor
+                # infers a string dtype from object arrays and coerces
+                # None->NaN, losing SQL NULL-ness
+                data[name] = pd.Series(values, dtype=object)
+            else:
+                data[name] = values
         df = pd.DataFrame(data)
         return df
 

@@ -58,7 +58,11 @@ class CpuFrame:
             if c.dtype is dt.STRING:
                 vals = [c.data[i] if valid[i] else None
                         for i in range(self.num_rows)]
-                data[name] = pd.array(vals, dtype="object")
+                # explicit object Series: pandas 3's frame constructor
+                # infers a string dtype from bare object arrays and
+                # coerces None->NaN, turning SQL NULL strings into
+                # float NaN (visible in ROLLUP null group keys)
+                data[name] = pd.Series(vals, dtype=object)
             elif c.dtype is dt.BOOLEAN:
                 data[name] = pd.array(
                     [bool(c.data[i]) if valid[i] else None
@@ -71,7 +75,7 @@ class CpuFrame:
                 # object dtype so SQL NULL (None) stays distinct from NaN
                 vals = c.data.astype(np.float64).astype(object)
                 vals[~valid] = None
-                data[name] = pd.array(vals, dtype="object")
+                data[name] = pd.Series(vals, dtype=object)
         return pd.DataFrame(data)
 
 

@@ -2,9 +2,12 @@
 
 Grammar (case-insensitive keywords):
 
-    query     := SELECT [DISTINCT] sel (',' sel)* FROM relation
-                 [WHERE expr] [GROUP BY expr (',' expr)*] [HAVING expr]
+    query     := [WITH ctes] core ((UNION [ALL]|INTERSECT|EXCEPT) core)*
                  [ORDER BY order (',' order)*] [LIMIT int]
+    core      := SELECT [DISTINCT] sel (',' sel)* FROM relation
+                 [WHERE expr]
+                 [GROUP BY (expr (',' expr)* | ROLLUP '(' exprs ')')]
+                 [HAVING expr]
     sel       := expr [[AS] ident] | '*'
     relation  := table_or_sub ([INNER|LEFT [OUTER]|RIGHT [OUTER]|
                  FULL [OUTER]|LEFT SEMI|LEFT ANTI|CROSS] JOIN
@@ -12,10 +15,11 @@ Grammar (case-insensitive keywords):
     table_or_sub := ident [[AS] ident] | '(' query ')' [AS] ident
     order     := expr [ASC|DESC] [NULLS FIRST|NULLS LAST]
     expr      := OR-precedence expression grammar with NOT, comparison,
-                 BETWEEN, IN (list | subquery-free), LIKE, IS [NOT] NULL,
-                 additive/multiplicative arithmetic, unary -, literals,
-                 CASE WHEN, CAST(e AS type), DATE 'lit', function calls,
-                 [table.]column
+                 BETWEEN, IN (list | subquery), [NOT] EXISTS (subquery),
+                 LIKE, IS [NOT] NULL, additive/multiplicative arithmetic,
+                 '||' concatenation, unary -, literals, CASE (searched
+                 and simple), CAST(e AS type), DATE 'lit', function
+                 calls, [table.]column
 
 AST nodes are plain tuples: ('select', {...}), ('col', tab, name),
 ('lit', value, kind), ('call', name, distinct, args), ('case', whens,
@@ -38,7 +42,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<num>\d+\.\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|\d+([eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
-  | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|/|%|\+|-|\.)
+  | (?P<op>\|\||<=|>=|<>|!=|=|<|>|\(|\)|,|\*|/|%|\+|-|\.)
 """, re.VERBOSE)
 
 _KEYWORDS = {
@@ -49,7 +53,7 @@ _KEYWORDS = {
     "on", "asc", "desc", "nulls", "first", "last", "date", "timestamp",
     "true", "false", "interval", "with", "union", "all", "over",
     "partition", "rows", "unbounded", "preceding", "following",
-    "current", "row",
+    "current", "row", "exists", "intersect", "except", "rollup",
 }
 
 
@@ -136,18 +140,49 @@ class _Parser:
                     break
         core = self.parse_select_core()
         cores = [core]
-        alls = []
-        while self.accept_kw("union"):
-            alls.append(bool(self.accept_kw("all")))
+        setops = []  # ("union", all?) | ("intersect",) | ("except",)
+        while True:
+            if self.accept_kw("union"):
+                setops.append(("union", bool(self.accept_kw("all"))))
+            elif self.accept_kw("intersect"):
+                if self.accept_kw("all"):
+                    raise SqlError("INTERSECT ALL (multiset) unsupported")
+                setops.append(("intersect",))
+            elif self.accept_kw("except"):
+                if self.accept_kw("all"):
+                    raise SqlError("EXCEPT ALL (multiset) unsupported")
+                setops.append(("except",))
+            else:
+                break
             cores.append(self.parse_select_core())
         order, limit = self.parse_order_limit()
-        if len(cores) == 1:
-            core[1]["order"] = order
-            core[1]["limit"] = limit
-            core[1]["ctes"] = ctes
-            return core
-        return ("union", {"cores": cores, "alls": alls, "order": order,
-                          "limit": limit, "ctes": ctes})
+        # INTERSECT binds tighter than UNION/EXCEPT (SQL standard;
+        # Spark AstBuilder): fold runs of INTERSECT into nested set-op
+        # nodes before the left-associative UNION/EXCEPT chain
+        g_cores = [cores[0]]
+        g_ops = []
+        for op, c in zip(setops, cores[1:]):
+            if op[0] == "intersect":
+                prev = g_cores[-1]
+                if prev[0] == "union" and prev[1].get("ichain"):
+                    prev[1]["cores"].append(c)
+                    prev[1]["setops"].append(op)
+                else:
+                    g_cores[-1] = ("union", {
+                        "cores": [prev, c], "setops": [op],
+                        "order": [], "limit": None, "ctes": [],
+                        "ichain": True})
+            else:
+                g_ops.append(op)
+                g_cores.append(c)
+        if len(g_cores) == 1:
+            out = g_cores[0]
+            out[1]["order"] = order
+            out[1]["limit"] = limit
+            out[1]["ctes"] = ctes
+            return out
+        return ("union", {"cores": g_cores, "setops": g_ops,
+                          "order": order, "limit": limit, "ctes": ctes})
 
     def parse_order_limit(self):
         order = []
@@ -176,18 +211,27 @@ class _Parser:
         if self.accept_kw("where"):
             where = self.parse_expr()
         group = []
+        rollup = False
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group = [self.parse_expr()]
-            while self.accept_op(","):
-                group.append(self.parse_expr())
+            if self.accept_kw("rollup"):
+                rollup = True
+                self.expect_op("(")
+                group = [self.parse_expr()]
+                while self.accept_op(","):
+                    group.append(self.parse_expr())
+                self.expect_op(")")
+            else:
+                group = [self.parse_expr()]
+                while self.accept_op(","):
+                    group.append(self.parse_expr())
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()
         return ("select", {"distinct": distinct, "sels": sels,
                            "from": rel, "where": where, "group": group,
-                           "having": having, "order": [],
-                           "limit": None, "ctes": []})
+                           "rollup": rollup, "having": having,
+                           "order": [], "limit": None, "ctes": []})
 
     def parse_select_item(self):
         if self.accept_op("*"):
@@ -339,10 +383,13 @@ class _Parser:
     def parse_additive(self):
         e = self.parse_multiplicative()
         while True:
-            op = self.accept_op("+", "-")
+            op = self.accept_op("+", "-", "||")
             if not op:
                 return e
-            e = ("arith", op, e, self.parse_multiplicative())
+            if op == "||":
+                e = ("concat", e, self.parse_multiplicative())
+            else:
+                e = ("arith", op, e, self.parse_multiplicative())
 
     def parse_multiplicative(self):
         e = self.parse_unary()
@@ -410,6 +457,12 @@ class _Parser:
                 return ("lit", text == "true", "bool")
             if text == "case":
                 return self.parse_case()
+            if text == "exists":
+                self.next()
+                self.expect_op("(")
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ("exists", sub)
             if text == "cast":
                 self.next()
                 self.expect_op("(")
@@ -493,10 +546,17 @@ class _Parser:
         return ("winfn", call, partition, order, frame)
 
     def parse_case(self):
+        """Searched CASE, plus simple CASE (``CASE e WHEN v THEN r``)
+        desugared to ``CASE WHEN e = v THEN r`` (base AST shared)."""
         self.expect_kw("case")
+        base = None
+        if self.peek() != ("kw", "when"):
+            base = self.parse_expr()
         whens = []
         while self.accept_kw("when"):
             c = self.parse_expr()
+            if base is not None:
+                c = ("cmp", "=", base, c)
             self.expect_kw("then")
             v = self.parse_expr()
             whens.append((c, v))

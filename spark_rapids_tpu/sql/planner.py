@@ -278,6 +278,18 @@ class _ExprPlanner:
             klass = {"+": ar.Add, "-": ar.Subtract, "*": ar.Multiply,
                      "/": ar.Divide, "%": ar.Remainder}[op]
             return klass(lhs, rhs)
+        if kind == "concat":
+            lhs, rhs = self.plan(ast[1]), self.plan(ast[2])
+            if isinstance(lhs, Literal) and isinstance(rhs, Literal) \
+                    and isinstance(lhs.value, str) \
+                    and isinstance(rhs.value, str):
+                return Literal(lhs.value + rhs.value)
+            # flatten chains into one ConcatStrings (a || b || c)
+            parts = []
+            for e in (lhs, rhs):
+                parts.extend(e.children if isinstance(e, st.ConcatStrings)
+                             else [e])
+            return st.ConcatStrings(parts)
         if kind == "cmp":
             _, op, l, r = ast
             return _cmp(op, self.plan(l), self.plan(r))
@@ -636,12 +648,64 @@ def _equi_pair(c, lscope: _Scope, rscope: _Scope):
     return None
 
 
+def _resolves(scope_: _Scope, tab, name) -> bool:
+    try:
+        scope_.resolve(tab, name)
+        return True
+    except SqlError:
+        return False
+
+
+def _has_subquery(ast) -> bool:
+    if not isinstance(ast, tuple):
+        return False
+    if ast[0] in ("in_sub", "scalar_sub", "exists", "select", "union"):
+        return True
+    for p in ast:
+        if isinstance(p, tuple) and _has_subquery(p):
+            return True
+        if isinstance(p, list) and any(
+                isinstance(x, tuple) and _has_subquery(x) for x in p):
+            return True
+    return False
+
+
+def _is_single_row(node: pn.PlanNode) -> bool:
+    """True when the plan provably yields exactly one row: a global
+    aggregate (no grouping), possibly under projections/LIMIT>=1."""
+    while isinstance(node, pn.ProjectNode) or \
+            (isinstance(node, pn.LimitNode) and node.n >= 1):
+        node = node.children[0]
+    return isinstance(node, pn.AggregateNode) and not node.grouping
+
+
 def _plan_implicit_joins(rels, where_ast, catalog):
     """Comma-FROM planning: hoist WHERE equi-conjuncts into inner-join
     keys, folding relations left-to-right (the analysis step Spark's
     optimizer performs for the classic TPC join syntax)."""
     planned = [_plan_relation(r, catalog) for r in rels]
     conjuncts = _conjuncts(where_ast)
+    # push single-relation conjuncts below the joins (Spark's
+    # PushDownPredicate): without this, self-joins of a filtered CTE
+    # (TPC-DS q4/q11/q74: six instances of year_total) build the full
+    # cross-product of every year and channel before filtering
+    kept: List = []
+    for c in conjuncts:
+        refs: List = []
+        _col_refs(c, refs)
+        homes = []
+        for i, (_n, s_i) in enumerate(planned):
+            if refs and all(_resolves(s_i, tab, name)
+                            for _, tab, name in refs):
+                homes.append(i)
+        if len(homes) == 1 and not _has_subquery(c):
+            i = homes[0]
+            n_i, s_i = planned[i]
+            planned[i] = (pn.FilterNode(_ExprPlanner(s_i).plan(c), n_i),
+                          s_i)
+        else:
+            kept.append(c)
+    conjuncts = kept
     node, scope = planned[0]
     remaining = list(planned[1:])
     while remaining:
@@ -662,6 +726,19 @@ def _plan_implicit_joins(rels, where_ast, catalog):
                 remaining.pop(idx)
                 progress = True
                 break
+        if not progress:
+            # provably single-row relations (global aggregates) may
+            # cross-join without an equi link — the TPC-DS q61/q90
+            # numerator/denominator shape. Anything else stays an
+            # error: an unlinked multi-row table is almost always a
+            # query bug, and the product would explode
+            for idx, (n2, s2) in enumerate(remaining):
+                if _is_single_row(n2):
+                    node = pn.JoinNode("cross", node, n2, [], [])
+                    scope = _Scope(scope.entries + s2.entries)
+                    remaining.pop(idx)
+                    progress = True
+                    break
         if not progress:
             names = [r[0] for r in rels]
             raise SqlError(
@@ -702,17 +779,103 @@ def _subst_aliases(ast, alias_map, scope):
 
 
 def _extract_in_subs(where_ast):
-    """Pull top-level ``x IN (SELECT ...)`` conjuncts out of WHERE; they
-    become semi/anti joins (the rewrite Spark's optimizer performs —
-    RewritePredicateSubquery)."""
+    """Pull top-level ``x IN (SELECT ...)`` and ``[NOT] EXISTS (...)``
+    conjuncts out of WHERE; they become semi/anti joins (the rewrite
+    Spark's optimizer performs — RewritePredicateSubquery)."""
     subs = []
+    exists = []
     rest = None
     for c in _conjuncts(where_ast):
         if isinstance(c, tuple) and c[0] == "in_sub":
             subs.append((c[1], c[2], c[3]))
+        elif isinstance(c, tuple) and c[0] == "exists":
+            exists.append((c[1], False))
+        elif isinstance(c, tuple) and c[0] == "not" and \
+                isinstance(c[1], tuple) and c[1][0] == "exists":
+            exists.append((c[1][1], True))
         else:
             rest = c if rest is None else ("and", rest, c)
-    return rest, subs
+    return rest, subs, exists
+
+
+def _apply_exists(node, scope: _Scope, exists_subs, catalog):
+    """Decorrelate [NOT] EXISTS into a left semi/anti join. The
+    subquery's WHERE conjuncts that reference outer columns must be
+    ``outer_col = inner_col`` equalities; they become the join keys,
+    everything else stays inside the subquery (Spark's
+    RewritePredicateSubquery + pullOutCorrelatedPredicates)."""
+    for sub, negated in exists_subs:
+        if sub[0] != "select":
+            raise SqlError("EXISTS subquery cannot be a set operation")
+        q = sub[1]
+        if q["group"] or q["having"] is not None:
+            raise SqlError("EXISTS over a grouped subquery is "
+                           "unsupported")
+        if q["limit"] is not None or q["order"]:
+            # LIMIT changes EXISTS semantics (LIMIT 0 = always false);
+            # refuse loudly rather than silently dropping it
+            raise SqlError("EXISTS subquery cannot carry ORDER BY/LIMIT")
+        # the inner FROM scope, planned without WHERE, classifies refs.
+        # (These plan trees are discarded — plan_statement(keys_q)
+        # re-plans the FROM; accepted planning-time cost to keep the
+        # rewrite at the AST layer.)
+        inner_scope_entries: List[Tuple[Optional[str], str, dt.DType]] = []
+        for r in _flatten_implicit(q["from"]):
+            _n, s = _plan_relation(r, catalog)
+            inner_scope_entries.extend(s.entries)
+        inner_scope = _Scope(inner_scope_entries)
+
+        def is_correlated(c) -> bool:
+            refs: List = []
+            _col_refs(c, refs)
+            return any(not _resolves(inner_scope, tab, name) and
+                       _resolves(scope, tab, name)
+                       for _, tab, name in refs)
+
+        inner_where = None
+        outer_keys: List[tuple] = []
+        inner_keys: List[tuple] = []
+        for c in _conjuncts(q["where"]):
+            if not is_correlated(c):
+                inner_where = c if inner_where is None \
+                    else ("and", inner_where, c)
+                continue
+            ok = (isinstance(c, tuple) and c[0] == "cmp" and
+                  c[1] == "=" and c[2][0] == "col" and c[3][0] == "col")
+            if ok:
+                sides = []
+                for colast in (c[2], c[3]):
+                    _, tab, name = colast
+                    inner_ok = _resolves(inner_scope, tab, name)
+                    sides.append("i" if inner_ok else "o")
+                if set(sides) == {"i", "o"}:
+                    outer_keys.append(c[2] if sides[0] == "o" else c[3])
+                    inner_keys.append(c[2] if sides[0] == "i" else c[3])
+                    continue
+            raise SqlError(
+                "EXISTS correlation must be outer_col = inner_col "
+                f"equalities; cannot decorrelate {c!r}")
+        if not outer_keys:
+            raise SqlError("uncorrelated EXISTS is unsupported; use a "
+                           "cross join against the aggregated subquery")
+        keys_q = ("select", {
+            "distinct": False,
+            "sels": [(k, f"_exk{i}") for i, k in enumerate(inner_keys)],
+            "from": q["from"], "where": inner_where, "group": [],
+            "rollup": False, "having": None, "order": [],
+            "limit": None, "ctes": q.get("ctes", []),
+        })
+        subnode = plan_statement(keys_q, catalog)
+        ords = []
+        for k in outer_keys:
+            e = _ExprPlanner(scope).plan(k)
+            if not isinstance(e, BoundReference):
+                raise SqlError("EXISTS outer key must be a plain column")
+            ords.append(e.ordinal)
+        node = pn.JoinNode("left_anti" if negated else "left_semi",
+                           node, subnode, ords,
+                           list(range(len(inner_keys))))
+    return node
 
 
 def _apply_in_subs(node, scope, subs, catalog):
@@ -878,19 +1041,66 @@ def _plan_window(wast, node, scope: _Scope, env):
     return node, scope, env
 
 
+def _dedup(node: pn.PlanNode) -> pn.PlanNode:
+    schema = node.output_schema()
+    return pn.AggregateNode(
+        [BoundReference(j, t) for j, t in enumerate(schema.types)],
+        [], node, grouping_names=list(schema.names))
+
+
+def _nullsafe_keys(node: pn.PlanNode) -> Tuple[pn.PlanNode, int]:
+    """Append, per column, a NULL-coalesced copy and an is-null flag —
+    joining on (coalesced, flag) pairs gives null-SAFE equality (SQL set
+    ops treat NULLs as equal; Spark's <=> inside
+    ReplaceIntersectWithSemiJoin / ReplaceExceptWithAntiJoin)."""
+    schema = node.output_schema()
+    width = len(schema)
+    exprs: List[Expression] = [
+        Alias(BoundReference(i, t), schema.names[i])
+        for i, t in enumerate(schema.types)]
+    names = list(schema.names)
+    zeros = {dt.STRING: "", dt.BOOLEAN: False,
+             dt.FLOAT32: 0.0, dt.FLOAT64: 0.0}
+    for i, t in enumerate(schema.types):
+        ref = BoundReference(i, t)
+        exprs.append(Alias(cond.Coalesce([ref, Literal(zeros.get(t, 0),
+                                                       t)]), f"_k{i}"))
+        names.append(f"_k{i}")
+        exprs.append(Alias(pr.IsNull(ref), f"_n{i}"))
+        names.append(f"_n{i}")
+    return pn.ProjectNode(exprs, node, names), width
+
+
 def _plan_union(q, catalog) -> pn.PlanNode:
-    """UNION [ALL] chain: left-associative UnionNode; plain UNION wraps
-    a dedup group-by after each merge (SQL set semantics)."""
+    """Set-op chain with SQL precedence (INTERSECT folded tighter by the
+    parser): UNION [ALL] -> UnionNode (+ dedup for plain UNION);
+    INTERSECT -> dedup + semi join; EXCEPT -> dedup + anti join (Spark's
+    ReplaceIntersectWithSemiJoin / ReplaceExceptWithAntiJoin). The joins
+    run on null-coalesced keys plus is-null flags so NULL rows compare
+    EQUAL, matching the set-op <=> semantics."""
     nodes = [plan_statement(c, catalog) for c in q["cores"]]
     node = nodes[0]
     for i, rhs in enumerate(nodes[1:]):
-        node = pn.UnionNode([node, rhs])
-        if not q["alls"][i]:
+        op = q["setops"][i]
+        if op[0] == "union":
+            node = pn.UnionNode([node, rhs])
+            if not op[1]:
+                node = _dedup(node)
+        else:
+            width = len(node.output_schema())
+            if len(rhs.output_schema()) != width:
+                raise SqlError("set-op sides must have equal width")
+            lk, _w = _nullsafe_keys(_dedup(node))
+            rk, _w = _nullsafe_keys(rhs)
+            keys = list(range(width, 3 * width))
+            joined = pn.JoinNode(
+                "left_semi" if op[0] == "intersect" else "left_anti",
+                lk, rk, keys, keys)
             schema = node.output_schema()
-            node = pn.AggregateNode(
-                [BoundReference(j, t)
-                 for j, t in enumerate(schema.types)],
-                [], node, grouping_names=list(schema.names))
+            node = pn.ProjectNode(
+                [Alias(BoundReference(j, schema.types[j]),
+                       schema.names[j]) for j in range(width)],
+                joined, list(schema.names))
     if q["order"]:
         schema = node.output_schema()
         specs = []
@@ -910,6 +1120,58 @@ def _plan_union(q, catalog) -> pn.PlanNode:
     return node
 
 
+def _plan_rollup(q, node, scope: _Scope, agg_calls):
+    """GROUP BY ROLLUP(g1..gn): n+1 grouping-set branches, each a
+    normal AggregateNode over the shared child with dropped keys
+    projected as typed NULLs, unioned (Spark's Expand+Aggregate plan
+    produces the same rows; here each branch re-aggregates the child,
+    which XLA dedups less but keeps every node a plain aggregate).
+    ``grouping(col)`` resolves via per-branch 0/1 literal columns."""
+    group = q["group"]
+    n = len(group)
+    grouping = [_ExprPlanner(scope).plan(g) for g in group]
+    gnames = [g[2] if g[0] == "col" else f"_g{i}"
+              for i, g in enumerate(group)]
+    m = len(agg_calls)
+    branches = []
+    agg_types = None
+    for k in range(n, -1, -1):
+        calls = [pn.AggCall(_plan_agg_call(c, scope), f"_a{i}")
+                 for i, c in enumerate(agg_calls)]
+        agg_b = pn.AggregateNode(grouping[:k], calls, node,
+                                 grouping_names=gnames[:k])
+        schema_b = agg_b.output_schema()
+        agg_types = list(schema_b.types)[k:]
+        exprs: List[Expression] = []
+        names: List[str] = []
+        for i in range(n):
+            e = BoundReference(i, grouping[i].dtype) if i < k \
+                else Literal(None, grouping[i].dtype)
+            exprs.append(Alias(e, gnames[i]))
+            names.append(gnames[i])
+        for j in range(m):
+            exprs.append(Alias(BoundReference(k + j, agg_types[j]),
+                               f"_a{j}"))
+            names.append(f"_a{j}")
+        for i in range(n):
+            exprs.append(Alias(Literal(0 if i < k else 1, dt.INT32),
+                               f"_grouping{i}"))
+            names.append(f"_grouping{i}")
+        branches.append(pn.ProjectNode(exprs, agg_b, names))
+    node = pn.UnionNode(branches)
+    env: Dict[str, Tuple[int, dt.DType]] = {}
+    for i, g in enumerate(group):
+        env[repr(g)] = (i, grouping[i].dtype)
+        gcall = ("call", "grouping", False, [g])
+        env[repr(gcall)] = (n + m + i, dt.INT32)
+    for j, c in enumerate(agg_calls):
+        env[repr(c)] = (n + j, agg_types[j])
+    schema = node.output_schema()
+    scope = _Scope([(None, nm, t)
+                    for nm, t in zip(schema.names, schema.types)])
+    return node, scope, env
+
+
 def plan_statement(ast, catalog) -> pn.PlanNode:
     q = ast[1]
     if q.get("ctes"):
@@ -922,7 +1184,7 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
     if ast[0] == "union":
         return _plan_union(q, catalog)
     assert ast[0] == "select"
-    where_ast, in_subs = _extract_in_subs(q["where"])
+    where_ast, in_subs, exists_subs = _extract_in_subs(q["where"])
 
     # uncorrelated scalar subqueries: each becomes a generated column
     # fed by a 1-row cross join (Spark's ScalarSubquery via subquery
@@ -972,6 +1234,7 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
             node = pn.FilterNode(_ExprPlanner(scope).plan(where_ast),
                                  node)
     node = _apply_in_subs(node, scope, in_subs, catalog)
+    node = _apply_exists(node, scope, exists_subs, catalog)
 
     node, scope = _attach_scalar_subs(node, scope, ssq_pre, catalog)
     for c in deferred_where:
@@ -1001,7 +1264,9 @@ def plan_statement(ast, catalog) -> pn.PlanNode:
         _collect_agg_calls(e, agg_calls)
 
     env: Dict[str, Tuple[int, dt.DType]] = {}
-    if q["group"] or agg_calls:
+    if q.get("rollup") and q["group"]:
+        node, scope, env = _plan_rollup(q, node, scope, agg_calls)
+    elif q["group"] or agg_calls:
         grouping = [_ExprPlanner(scope).plan(g) for g in q["group"]]
         calls = [pn.AggCall(_plan_agg_call(c, scope), f"_a{i}")
                  for i, c in enumerate(agg_calls)]

@@ -235,3 +235,153 @@ def test_scalar_subquery_with_outer_aggregate():
     got3 = s.sql("SELECT k, SUM(x - (SELECT AVG(y) FROM b) / 15.0) "
                  "AS s FROM a GROUP BY k ORDER BY k").collect()
     np.testing.assert_allclose(got3["s"], [1.0, 9.0])
+
+
+# ---------------------------------------------------------------------------
+# round-3 TPC-DS breadth features: ROLLUP, EXISTS, INTERSECT/EXCEPT,
+# simple CASE, || concatenation
+
+
+def test_rollup_levels_and_grouping(sess):
+    got = sess.sql(
+        "SELECT k, t, SUM(v) AS s, grouping(k) AS gk, grouping(t) AS gt "
+        "FROM sales GROUP BY ROLLUP(k, t) "
+        "ORDER BY gk, gt, k, t").collect()
+    base = sess.sql("SELECT k, t, v FROM sales").collect()
+    detail = base.groupby(["k", "t"])["v"].sum()
+    subtot = base.groupby("k")["v"].sum()
+    total = base["v"].sum()
+    assert len(got) == len(detail) + len(subtot) + 1
+    # detail rows first (gk=gt=0), then per-k subtotals (gt=1 only),
+    # then the grand total (gk=gt=1)
+    d = got[(got["gk"] == 0) & (got["gt"] == 0)]
+    np.testing.assert_allclose(
+        sorted(d["s"]), sorted(detail.values), rtol=1e-9)
+    sub = got[(got["gk"] == 0) & (got["gt"] == 1)]
+    assert sub["t"].isna().all()
+    np.testing.assert_allclose(
+        sub.sort_values("k")["s"].values,
+        subtot.sort_index().values, rtol=1e-9)
+    g = got[got["gk"] == 1]
+    assert len(g) == 1 and g["k"].isna().all()
+    np.testing.assert_allclose(g["s"].values[0], total, rtol=1e-9)
+
+
+def test_rollup_grouping_in_expressions(sess):
+    # TPC-DS q36/q86 shape: grouping() inside CASE and arithmetic
+    got = sess.sql(
+        "SELECT grouping(k) + grouping(t) AS lvl, "
+        "CASE WHEN grouping(t) = 0 THEN k END AS pk, SUM(v) AS s "
+        "FROM sales GROUP BY ROLLUP(k, t) ORDER BY lvl, pk, s").collect()
+    assert set(got["lvl"]) == {0, 1, 2}
+    assert got[got["lvl"] == 2]["pk"].isna().all()
+
+
+def test_exists_correlated_semi(sess):
+    got = sess.sql(
+        "SELECT count(*) AS n FROM dim WHERE EXISTS "
+        "(SELECT * FROM sales WHERE k = id AND v > 90)").collect()
+    hit = sess.sql("SELECT k FROM sales WHERE v > 90").collect()
+    dim = sess.sql("SELECT id FROM dim").collect()
+    want = int(dim["id"].isin(hit["k"]).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_not_exists_correlated_anti(sess):
+    got = sess.sql(
+        "SELECT count(*) AS n FROM dim WHERE NOT EXISTS "
+        "(SELECT * FROM sales WHERE sales.k = dim.id)").collect()
+    ks = sess.sql("SELECT k FROM sales").collect()
+    dim = sess.sql("SELECT id FROM dim").collect()
+    want = int((~dim["id"].isin(ks["k"])).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_exists_inner_join_in_subquery(sess):
+    # TPC-DS q10/q35 shape: the EXISTS subquery itself comma-joins
+    # tables; only the correlated conjunct becomes the join key
+    got = sess.sql(
+        "SELECT count(*) AS n FROM dim WHERE EXISTS "
+        "(SELECT * FROM sales, dim d2 WHERE k = dim.id "
+        "AND t = d2.id AND d2.cat = 1)").collect()
+    import pandas as pd_
+    sales = sess.sql("SELECT k, t FROM sales").collect()
+    dim = sess.sql("SELECT id, cat FROM dim").collect()
+    inner = sales.merge(dim[dim["cat"] == 1], left_on="t",
+                        right_on="id")
+    want = int(dim["id"].isin(inner["k"]).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_uncorrelated_exists_rejected(sess):
+    from spark_rapids_tpu.sql.parser import SqlError
+    with pytest.raises(SqlError, match="uncorrelated EXISTS"):
+        sess.sql("SELECT * FROM dim WHERE EXISTS "
+                 "(SELECT * FROM sales WHERE v > 1)")
+
+
+def test_intersect_and_except(sess):
+    inter = sess.sql("SELECT k FROM sales WHERE v > 50 INTERSECT "
+                     "SELECT k FROM sales WHERE t > 20").collect()
+    a = set(sess.sql("SELECT DISTINCT k FROM sales WHERE v > 50"
+                     ).collect()["k"])
+    b = set(sess.sql("SELECT DISTINCT k FROM sales WHERE t > 20"
+                     ).collect()["k"])
+    assert set(inter["k"]) == (a & b)
+    exc = sess.sql("SELECT k FROM sales WHERE v > 50 EXCEPT "
+                   "SELECT k FROM sales WHERE t > 20").collect()
+    assert set(exc["k"]) == (a - b)
+    # chained: (A INTERSECT B) EXCEPT C, left-associative
+    c = set(sess.sql("SELECT DISTINCT k FROM sales WHERE v < 5"
+                     ).collect()["k"])
+    chain = sess.sql(
+        "SELECT k FROM sales WHERE v > 50 INTERSECT "
+        "SELECT k FROM sales WHERE t > 20 EXCEPT "
+        "SELECT k FROM sales WHERE v < 5").collect()
+    assert set(chain["k"]) == (a & b) - c
+
+
+def test_simple_case(sess):
+    got = sess.sql(
+        "SELECT CASE k WHEN 1 THEN 'one' WHEN 2 THEN 'two' "
+        "ELSE 'many' END AS w, count(*) AS n FROM sales "
+        "GROUP BY CASE k WHEN 1 THEN 'one' WHEN 2 THEN 'two' "
+        "ELSE 'many' END ORDER BY w").collect()
+    base = sess.sql("SELECT k FROM sales").collect()["k"]
+    want = {"one": int((base == 1).sum()), "two": int((base == 2).sum()),
+            "many": int((base > 2).sum())}
+    assert dict(zip(got["w"], got["n"])) == \
+        {k: v for k, v in want.items() if v}
+
+
+def test_concat_operator():
+    s = Session()
+    s.create_temp_view("t", s.create_dataframe(pd.DataFrame(
+        {"a": ["x", "y"], "b": ["1", "2"]})))
+    got = s.sql("SELECT a || ', ' || b AS c FROM t ORDER BY c").collect()
+    assert got["c"].tolist() == ["x, 1", "y, 2"]
+
+
+def test_setops_null_safe():
+    """SQL set ops treat NULLs as EQUAL (Spark's <=> in the semi/anti
+    rewrite): A EXCEPT A must be empty even with NULL rows, and a NULL
+    row intersects with a NULL row."""
+    s = Session()
+    s.create_temp_view("a", s.create_dataframe(pd.DataFrame(
+        {"x": pd.array([1, 2, None], dtype="Int64")})))
+    s.create_temp_view("b", s.create_dataframe(pd.DataFrame(
+        {"x": pd.array([2, None], dtype="Int64")})))
+    got = s.sql("SELECT x FROM a EXCEPT SELECT x FROM a").collect()
+    assert len(got) == 0
+    got = s.sql("SELECT x FROM a EXCEPT SELECT x FROM b").collect()
+    assert got["x"].tolist() == [1]
+    got = s.sql("SELECT x FROM a INTERSECT SELECT x FROM b").collect()
+    vals = set(None if pd.isna(v) else int(v) for v in got["x"])
+    assert vals == {2, None}
+
+
+def test_exists_limit_rejected(sess):
+    from spark_rapids_tpu.sql.parser import SqlError
+    with pytest.raises(SqlError, match="ORDER BY/LIMIT"):
+        sess.sql("SELECT * FROM dim WHERE EXISTS "
+                 "(SELECT * FROM sales WHERE k = id LIMIT 0)")
