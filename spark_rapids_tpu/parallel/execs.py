@@ -32,6 +32,8 @@ runs as a single-device projection after the gather).
 from __future__ import annotations
 
 import dataclasses
+import time
+import types
 from typing import Dict, Iterator, List, Optional, Tuple, Union
 
 import jax
@@ -192,7 +194,20 @@ def _eval_source(child: TpuExec
     if ms is None:
         return None
     node, ords = ms
+    # record into the mesh child's own metrics: this path bypasses the
+    # timed() iterator of execute(), and without it the child's runtime
+    # would be misattributed to the consuming exec's self time
+    child0 = sum(c.metrics.pipeline_time_ns for c in node.children)
+    t0 = time.perf_counter_ns()
     r = node.execute_any()
+    elapsed = time.perf_counter_ns() - t0
+    child_ns = sum(c.metrics.pipeline_time_ns
+                   for c in node.children) - child0
+    if isinstance(r, DistributedBatch):
+        rows = types.SimpleNamespace(num_rows=r.counts.sum())
+        node.metrics.record(rows, elapsed, child_ns)
+    else:
+        node.metrics.record(r, elapsed, child_ns)
     # identity requires FULL width: a strict-prefix projection must
     # still select, or the consumer sees the mesh exec's extra columns
     identity = ords == list(range(len(node.schema.types)))

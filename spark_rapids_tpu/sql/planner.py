@@ -347,13 +347,19 @@ class _ExprPlanner:
             if to is None:
                 raise SqlError(f"unknown cast type {ast[2]!r}")
             e = self.plan(ast[1])
-            # fold literal string->date/timestamp casts (scalar-only
-            # subtrees must not reach the jit tracer)
+            # fold literal casts (scalar-only subtrees must not reach
+            # the jit tracer — Cast evaluates scalars with float()/int())
             if isinstance(e, Literal) and isinstance(e.value, str):
                 if to is dt.DATE:
                     return Literal(_date_days(e.value), dt.DATE)
                 if to is dt.TIMESTAMP:
                     return Literal(_ts_us(e.value), dt.TIMESTAMP)
+            if isinstance(e, Literal) and \
+                    isinstance(e.value, (int, float, bool)):
+                if to.is_floating:
+                    return Literal(float(e.value), to)
+                if to.is_integral:
+                    return Literal(int(e.value), to)
             return Cast(e, to)
         if kind == "call":
             _, name, distinct, args = ast
@@ -671,9 +677,11 @@ def _has_subquery(ast) -> bool:
 
 
 def _is_single_row(node: pn.PlanNode) -> bool:
-    """True when the plan provably yields exactly one row: a global
-    aggregate (no grouping), possibly under projections/LIMIT>=1."""
-    while isinstance(node, pn.ProjectNode) or \
+    """True when the plan provably yields AT MOST one row: a global
+    aggregate (no grouping), possibly under projections, filters (the
+    predicate-pushdown pass wraps pushed conjuncts around it; an empty
+    side just gives an empty cross product), or LIMIT>=1."""
+    while isinstance(node, (pn.ProjectNode, pn.FilterNode)) or \
             (isinstance(node, pn.LimitNode) and node.n >= 1):
         node = node.children[0]
     return isinstance(node, pn.AggregateNode) and not node.grouping
