@@ -605,7 +605,12 @@ class _JoinRule(NodeRule):
     def _plan(meta, kind, left, right, lk, rk, cond, out_schema):
         mesh = _session_mesh(meta.conf)
         if mesh is not None and lk and kind in ("inner", "left",
-                                                "left_semi", "left_anti"):
+                                                "left_semi", "left_anti",
+                                                "full"):
+            # right joins arrive here already flipped to "left" (convert()
+            # above); "full" composes left + null-extended anti halves with
+            # a sharded union (GpuHashJoin.scala:302-318 emits FullOuter
+            # from one kernel; the mesh shape is two programs + a union)
             from spark_rapids_tpu.parallel.execs import MeshShuffledJoinExec
 
             return MeshShuffledJoinExec(kind, left, right, lk, rk,

@@ -148,6 +148,132 @@ def test_mesh_join_kinds_match_plain():
         _assert_frames_equal(got, want, sort_by=sort_cols[:2])
 
 
+def test_mesh_full_outer_asymmetric_ordinals_matches_plain():
+    """FULL OUTER lowers to the mesh (left half UNION null-extended anti
+    half, sharded union). Key ordinals deliberately DIFFER between the
+    sides (left key at ordinal 1, right key at ordinal 0) — the r3
+    advisor found the anti half would apply left-side ordinals to the
+    right relation if _compute_kind read self.left_keys."""
+    rng = np.random.default_rng(17)
+    left = pd.DataFrame({
+        "v": rng.random(260),
+        "k": rng.integers(0, 50, 260).astype(np.int64),
+    })
+    right = pd.DataFrame({
+        "k2": rng.integers(20, 70, 90).astype(np.int64),
+        "w": rng.random(90),
+        "x": rng.integers(0, 5, 90).astype(np.int64),
+    })
+    ms = _mesh_session()
+    got_df = ms.create_dataframe(left).join(
+        ms.create_dataframe(right), on=[("k", "k2")], how="full")
+    plan = got_df._exec().tree_string()
+    assert "MeshShuffledJoinExec" in plan, plan
+    got = got_df.collect()
+
+    ps = _plain_session()
+    want = ps.create_dataframe(left).join(
+        ps.create_dataframe(right), on=[("k", "k2")], how="full").collect()
+    assert len(got) == len(want)
+    key = ["k", "k2", "v", "w"]
+    gs = got.sort_values(key, na_position="last").reset_index(drop=True)
+    ws = want.sort_values(key, na_position="last").reset_index(drop=True)
+    for c in got.columns:
+        g = gs[c].to_numpy(np.float64)
+        w = ws[c].to_numpy(np.float64)
+        np.testing.assert_allclose(g, w, rtol=1e-9, equal_nan=True)
+
+
+def test_mesh_full_outer_union_stays_sharded(monkeypatch):
+    """The full-outer union must not gather either half to the host:
+    exactly ONE _gather_db fires (the final collect), never per-half
+    (round-3 verdict: _full_union _gather_db-ed both halves)."""
+    from spark_rapids_tpu.parallel import execs as pex
+
+    rng = np.random.default_rng(23)
+    left = pd.DataFrame({
+        "k": rng.integers(0, 30, 200).astype(np.int64),
+        "v": np.arange(200, dtype=np.int64)})
+    right = pd.DataFrame({
+        "k2": rng.integers(10, 40, 80).astype(np.int64),
+        "w": np.arange(80, dtype=np.int64)})
+    calls = []
+    real = pex._gather_db
+
+    def counting(db, n_dev):
+        calls.append(len(db.dtypes))
+        return real(db, n_dev)
+
+    monkeypatch.setattr(pex, "_gather_db", counting)
+    ms = _mesh_session()
+    got = ms.create_dataframe(left).join(
+        ms.create_dataframe(right), on=[("k", "k2")], how="full").collect()
+    assert len(calls) == 1, calls
+
+    want = left.merge(right, left_on="k", right_on="k2", how="outer")
+    assert len(got) == len(want)
+
+
+def test_mesh_full_outer_string_keys_matches_plain():
+    """String-keyed FULL OUTER: dictionaries unify ONCE in the full
+    branch (keys_unified), and both halves' codes stay consistent for
+    the union."""
+    rng = np.random.default_rng(41)
+    lk = rng.choice(["ash", "birch", "cedar", "oak", "pine"], 120)
+    rk = rng.choice(["cedar", "oak", "pine", "sequoia", "yew"], 70)
+    left = pd.DataFrame({"k": lk, "v": np.arange(120, dtype=np.int64)})
+    right = pd.DataFrame({"k2": rk, "w": np.arange(70, dtype=np.int64)})
+    ms = _mesh_session()
+    got_df = ms.create_dataframe(left).join(
+        ms.create_dataframe(right), on=[("k", "k2")], how="full")
+    assert "MeshShuffledJoinExec" in got_df._exec().tree_string()
+    got = got_df.collect()
+
+    want = left.merge(right, left_on="k", right_on="k2", how="outer")
+    assert len(got) == len(want)
+    key = ["k", "k2", "v", "w"]
+    gs = got.sort_values(key, na_position="last").reset_index(drop=True)
+    ws = want.sort_values(key, na_position="last").reset_index(drop=True)
+    for c in ("v", "w"):
+        np.testing.assert_allclose(
+            gs[c].to_numpy(np.float64), ws[c].to_numpy(np.float64),
+            rtol=0, equal_nan=True)
+    for c in ("k", "k2"):
+        assert [x if isinstance(x, str) else None
+                for x in gs[c]] == \
+            [x if isinstance(x, str) else None for x in ws[c]], c
+
+
+def test_mesh_right_outer_matches_plain():
+    """RIGHT joins flip to left + column reorder before the mesh branch;
+    the reordering projection must stay consumable by chained parents."""
+    rng = np.random.default_rng(29)
+    left = pd.DataFrame({
+        "k": rng.integers(0, 25, 150).astype(np.int64),
+        "v": rng.random(150)})
+    right = pd.DataFrame({
+        "k2": rng.integers(10, 45, 60).astype(np.int64),
+        "w": rng.random(60)})
+    ms = _mesh_session()
+    got_df = ms.create_dataframe(left).join(
+        ms.create_dataframe(right), on=[("k", "k2")], how="right")
+    plan = got_df._exec().tree_string()
+    assert "MeshShuffledJoinExec" in plan, plan
+    got = got_df.collect()
+
+    ps = _plain_session()
+    want = ps.create_dataframe(left).join(
+        ps.create_dataframe(right), on=[("k", "k2")], how="right").collect()
+    assert len(got) == len(want)
+    key = ["k2", "w", "k"]
+    gs = got.sort_values(key, na_position="last").reset_index(drop=True)
+    ws = want.sort_values(key, na_position="last").reset_index(drop=True)
+    for c in got.columns:
+        np.testing.assert_allclose(
+            gs[c].to_numpy(np.float64), ws[c].to_numpy(np.float64),
+            rtol=1e-9, equal_nan=True)
+
+
 def test_mesh_join_many_to_many_stays_on_mesh():
     # both sides carry duplicate keys -> many-to-many; the single-key
     # EXPANSION step handles arbitrary fan-out ON the mesh (round 3 —
