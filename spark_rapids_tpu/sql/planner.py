@@ -826,10 +826,14 @@ def _apply_exists(node, scope: _Scope, exists_subs, catalog):
         # the inner FROM scope, planned without WHERE, classifies refs.
         # (These plan trees are discarded — plan_statement(keys_q)
         # re-plans the FROM; accepted planning-time cost to keep the
-        # rewrite at the AST layer.)
+        # rewrite at the AST layer.) The subquery's own CTEs must be
+        # visible to this classification pass, not just to the keys_q
+        # re-plan (r3 advisor finding); planning them ONCE here and
+        # handing sub_catalog to the keys_q plan avoids a second pass
+        sub_catalog = _register_ctes(q.get("ctes"), catalog)
         inner_scope_entries: List[Tuple[Optional[str], str, dt.DType]] = []
         for r in _flatten_implicit(q["from"]):
-            _n, s = _plan_relation(r, catalog)
+            _n, s = _plan_relation(r, sub_catalog)
             inner_scope_entries.extend(s.entries)
         inner_scope = _Scope(inner_scope_entries)
 
@@ -871,9 +875,9 @@ def _apply_exists(node, scope: _Scope, exists_subs, catalog):
             "sels": [(k, f"_exk{i}") for i, k in enumerate(inner_keys)],
             "from": q["from"], "where": inner_where, "group": [],
             "rollup": False, "having": None, "order": [],
-            "limit": None, "ctes": q.get("ctes", []),
+            "limit": None, "ctes": [],  # already in sub_catalog
         })
-        subnode = plan_statement(keys_q, catalog)
+        subnode = plan_statement(keys_q, sub_catalog)
         ords = []
         for k in outer_keys:
             e = _ExprPlanner(scope).plan(k)
@@ -1060,7 +1064,13 @@ def _nullsafe_keys(node: pn.PlanNode) -> Tuple[pn.PlanNode, int]:
     """Append, per column, a NULL-coalesced copy and an is-null flag —
     joining on (coalesced, flag) pairs gives null-SAFE equality (SQL set
     ops treat NULLs as equal; Spark's <=> inside
-    ReplaceIntersectWithSemiJoin / ReplaceExceptWithAntiJoin)."""
+    ReplaceIntersectWithSemiJoin / ReplaceExceptWithAntiJoin).
+
+    NaN = NaN and -0.0 = 0.0 need NO planner-side normalization: every
+    join key is canonicalized in the executor (ops/sortkeys.py
+    ``canonicalize_floats`` feeds both the hash images and the
+    exact-equality lanes), the engine-level analogue of Spark's
+    NormalizeNaNAndZero — pinned by test_setops_nan_and_negzero_normalized."""
     schema = node.output_schema()
     width = len(schema)
     exprs: List[Expression] = [
@@ -1095,9 +1105,18 @@ def _plan_union(q, catalog) -> pn.PlanNode:
             if not op[1]:
                 node = _dedup(node)
         else:
-            width = len(node.output_schema())
-            if len(rhs.output_schema()) != width:
+            lhs_schema = node.output_schema()
+            width = len(lhs_schema)
+            rhs_schema = rhs.output_schema()
+            if len(rhs_schema) != width:
                 raise SqlError("set-op sides must have equal width")
+            if list(lhs_schema.types) != list(rhs_schema.types):
+                # no implicit set-op type coercion: misaligned key
+                # dtypes would compare garbage lanes, so error loudly
+                raise SqlError(
+                    "set-op sides must have matching column types; got "
+                    f"{[t.name for t in lhs_schema.types]} vs "
+                    f"{[t.name for t in rhs_schema.types]}")
             lk, _w = _nullsafe_keys(_dedup(node))
             rk, _w = _nullsafe_keys(rhs)
             keys = list(range(width, 3 * width))
@@ -1180,15 +1199,21 @@ def _plan_rollup(q, node, scope: _Scope, agg_calls):
     return node, scope, env
 
 
+def _register_ctes(ctes, catalog):
+    """Plan each CTE once into a catalog copy (Spark's CTESubstitution);
+    self-references across branches share the plan node, like temp
+    views. Returns the original catalog untouched when there are none."""
+    if not ctes:
+        return catalog
+    catalog = dict(catalog)
+    for name, sub in ctes:
+        catalog[name] = plan_statement(sub, catalog)
+    return catalog
+
+
 def plan_statement(ast, catalog) -> pn.PlanNode:
     q = ast[1]
-    if q.get("ctes"):
-        # CTEs: plan each once into a catalog copy (Spark's
-        # CTESubstitution); self-references across branches share the
-        # plan node, like temp views
-        catalog = dict(catalog)
-        for name, sub in q["ctes"]:
-            catalog[name] = plan_statement(sub, catalog)
+    catalog = _register_ctes(q.get("ctes"), catalog)
     if ast[0] == "union":
         return _plan_union(q, catalog)
     assert ast[0] == "select"

@@ -380,6 +380,52 @@ def test_setops_null_safe():
     assert vals == {2, None}
 
 
+def test_setops_nan_and_negzero_normalized():
+    """Set ops treat NaN = NaN and -0.0 = 0.0 (Spark's
+    NormalizeNaNAndZero): A EXCEPT A over a NaN-bearing float column
+    cancels the NaN rows, and NaN never collides with true 0.0.
+
+    NaN enters COMPUTATIONALLY (SQRT of a negative) — pandas ingest
+    conflates NaN with NULL, so raw NaN inputs become nulls upstream."""
+    s = Session()
+    # SQRT(x): [-1 -> NaN, 2.25 -> 1.5, 0 -> 0.0, -0.0 -> -0.0]
+    s.create_temp_view("raw_a", s.create_dataframe(pd.DataFrame(
+        {"x": np.array([-1.0, 2.25, 0.0], dtype=np.float64)})))
+    s.create_temp_view("raw_b", s.create_dataframe(pd.DataFrame(
+        {"x": np.array([-1.0], dtype=np.float64),
+         "z": np.array([-0.0], dtype=np.float64)})))
+    a = "SELECT SQRT(x) AS y FROM raw_a"
+    got = s.sql(f"{a} EXCEPT {a}").collect()
+    assert len(got) == 0
+    # b carries {NaN (sqrt -1), -0.0}: 0.0 == -0.0 cancels, 1.5 survives
+    s.create_temp_view("b_view", s.sql(
+        "SELECT SQRT(x) AS y FROM raw_b UNION ALL SELECT z FROM raw_b"))
+    b = "SELECT y FROM b_view"
+    got = s.sql(f"{a} EXCEPT {b}").collect()
+    assert got["y"].tolist() == [1.5]
+    got = s.sql(f"{a} INTERSECT {b}").collect()
+    vals = sorted(got["y"], key=lambda v: (not np.isnan(v), v))
+    assert len(vals) == 2 and np.isnan(vals[0]) and vals[1] == 0.0
+    # NaN must NOT equal a true 0.0 row
+    got = s.sql(f"SELECT z AS y FROM raw_b INTERSECT {b}").collect()
+    assert got["y"].tolist() == [0.0]  # matches b's -0.0, not its NaN
+
+
+def test_exists_subquery_with_local_cte(sess):
+    """EXISTS over a subquery that defines its own CTE: the correlation
+    classifier must register the subquery's WITH clause before planning
+    its FROM relations (r3 advisor finding)."""
+    got = sess.sql(
+        "SELECT count(*) AS n FROM dim WHERE EXISTS "
+        "(WITH big AS (SELECT k FROM sales WHERE v > 50) "
+        " SELECT * FROM big WHERE big.k = id)").collect()
+    want = sess.sql(
+        "SELECT count(*) AS n FROM dim WHERE EXISTS "
+        "(SELECT * FROM sales WHERE v > 50 AND k = id)").collect()
+    assert got["n"].tolist() == want["n"].tolist()
+    assert int(got["n"].iloc[0]) > 0
+
+
 def test_exists_limit_rejected(sess):
     from spark_rapids_tpu.sql.parser import SqlError
     with pytest.raises(SqlError, match="ORDER BY/LIMIT"):
