@@ -39,6 +39,12 @@ class DType:
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return self.name
 
+    def __reduce__(self):
+        # types are compared by IDENTITY throughout (``typ is STRING``);
+        # pickling by value would mint lookalike instances on the far
+        # side of a remote-task boundary, so unpickle to the singleton
+        return (by_name, (self.name,))
+
     @property
     def np_dtype(self):
         return np.dtype(self.kernel_dtype)

@@ -249,6 +249,26 @@ MESH_DEVICES = conf("rapids.tpu.mesh.devices").doc(
     "Device count for the mesh data axis; 0 = all visible devices."
 ).int_conf.create_with_default(0)
 
+CLUSTER_ENABLED = conf("rapids.tpu.cluster.enabled").doc(
+    "Execute shuffle exchanges through the multi-process cluster runtime: "
+    "map tasks write partitioned output into per-executor shuffle catalogs "
+    "(spillable, priority 0) and reduce tasks read through the transport "
+    "over real sockets — the reference's shuffle manager wired into query "
+    "execution (RapidsShuffleInternalManager.scala:200-305, "
+    "RapidsCachingReader.scala:59-145)."
+).boolean_conf.create_with_default(False)
+
+CLUSTER_EXECUTORS = conf("rapids.tpu.cluster.executors").doc(
+    "In-process executors in the cluster runtime (each owns a spill "
+    "catalog + TCP-served shuffle server)."
+).int_conf.create_with_default(2)
+
+CLUSTER_WORKERS = conf("rapids.tpu.cluster.workers").doc(
+    "Remote worker processes: each is a separate OS process hosting an "
+    "executor (shuffle/remote_worker.py) that RUNS map tasks and serves "
+    "their output over TCP — the separate-executor-JVM model."
+).int_conf.create_with_default(1)
+
 SHUFFLE_COMPRESSION_CODEC = conf("rapids.tpu.shuffle.compression.codec").doc(
     "Compression for host-path shuffle payloads: none, lz4 (native C++ "
     "codec; the nvcomp-LZ4 analogue, RapidsConf.scala:685) or zlib."

@@ -60,6 +60,20 @@ class Metrics:
             self._pending_rows.clear()
         return self._rows
 
+    # exec trees ship to remote executors as task closures (the cluster
+    # runtime's map tasks, like Spark serializing RDD lineage); locks and
+    # unrealized device scalars stay behind
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lock", None)
+        state["_rows"] = self.num_output_rows  # realizes pending
+        state["_pending_rows"] = []
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
 
 class TpuExec:
     """Base physical operator."""

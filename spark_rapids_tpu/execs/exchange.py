@@ -27,6 +27,29 @@ from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 from spark_rapids_tpu.utils.tracing import TraceRange
 
 
+def partition_batch(b: ColumnarBatch, partitioning: Tuple, types,
+                    num_out: int) -> Tuple[ColumnarBatch, np.ndarray]:
+    """Partition one batch: returns (destination-sorted batch, per-
+    partition counts). Shared by the in-process exchange and the cluster
+    runtime's map tasks (local and remote-worker alike)."""
+    kind = partitioning[0]
+    if kind == "hash":
+        return part_ops.hash_partition(b, list(partitioning[1]), types,
+                                       num_out)
+    if kind == "round_robin":
+        return part_ops.round_robin_partition(b, num_out)
+    if kind == "range":
+        specs: List[SortKeySpec] = list(partitioning[1])
+        bounds = partitioning[2]
+        if len(specs) > 1:
+            return part_ops.range_partition_multi(b, specs, types,
+                                                  bounds, num_out)
+        return part_ops.range_partition(b, specs, types, bounds, num_out)
+    if kind == "single":
+        return part_ops.single_partition(b)
+    raise ValueError(kind)
+
+
 class ShuffleExchangeExec(TpuExec):
     """partitioning: ('hash', key_ordinals) | ('range', specs) |
     ('round_robin',) | ('single',)."""
@@ -48,31 +71,28 @@ class ShuffleExchangeExec(TpuExec):
         # boundaries — here a lock is the stage barrier)
         self._mat_lock = threading.Lock()
 
+    # an exchange shipping inside a remote task closure restarts clean:
+    # blocks are per-process state (the receiving executor re-runs or
+    # cluster-reads; cluster exchanges are stubbed out before pickling)
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_mat_lock", None)
+        state["_blocks"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._mat_lock = threading.Lock()
+
     @property
     def num_partitions(self) -> int:
         return self.num_out_partitions
 
     def _partition_batch(self, b: ColumnarBatch
                          ) -> Tuple[ColumnarBatch, np.ndarray]:
-        kind = self.partitioning[0]
-        types = list(self.schema.types)
-        if kind == "hash":
-            return part_ops.hash_partition(b, list(self.partitioning[1]),
-                                           types, self.num_out_partitions)
-        if kind == "round_robin":
-            return part_ops.round_robin_partition(b,
-                                                  self.num_out_partitions)
-        if kind == "range":
-            specs: List[SortKeySpec] = list(self.partitioning[1])
-            bounds = self.partitioning[2]
-            if len(specs) > 1:
-                return part_ops.range_partition_multi(
-                    b, specs, types, bounds, self.num_out_partitions)
-            return part_ops.range_partition(b, specs, types, bounds,
-                                            self.num_out_partitions)
-        if kind == "single":
-            return part_ops.single_partition(b)
-        raise ValueError(kind)
+        return partition_batch(b, self.partitioning,
+                               list(self.schema.types),
+                               self.num_out_partitions)
 
     def _materialize(self) -> None:
         """Map-side write: run the child once, cache partitioned blocks
@@ -183,6 +203,16 @@ class BroadcastExchangeExec(TpuExec):
     def __init__(self, child: TpuExec):
         super().__init__([child], child.schema)
         self._cached: Optional[SpillableBatch] = None
+        self._mat_lock = threading.Lock()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_mat_lock", None)
+        state["_cached"] = None  # re-materializes on the receiving side
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
         self._mat_lock = threading.Lock()
 
     @property

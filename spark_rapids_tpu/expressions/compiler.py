@@ -207,6 +207,15 @@ class CompiledProjection:
 
         return run
 
+    # ships inside remote map-task closures; the jitted program is
+    # process-local state and rebuilds (or re-hits the fused cache) on
+    # the receiving executor
+    def __getstate__(self):
+        return {"exprs": self.exprs, "conf": self.conf}
+
+    def __setstate__(self, state):
+        self.__init__(state["exprs"], state["conf"])
+
     def __call__(self, batch: ColumnarBatch,
                  task_info=None) -> ColumnarBatch:
         from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
@@ -289,6 +298,12 @@ class CompiledFilter:
 
             self._mask = run_mask
             _fused_cache_put(key, run_mask)
+
+    def __getstate__(self):
+        return {"condition": self.condition, "conf": self.conf}
+
+    def __setstate__(self, state):
+        self.__init__(state["condition"], state["conf"])
 
     def mask(self, batch: ColumnarBatch, task_info=None):
         """Keep-mask only (no compaction): downstream sorts/groupbys fuse

@@ -99,6 +99,17 @@ class FileSourceBase(DataSource):
         self.chunks_total = 0
         self.chunks_pruned = 0
 
+    # scans ship inside remote map-task closures (cluster runtime): the
+    # lock is process-local; splits re-derive from paths on arrival
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.RLock()
+
     # -- subclass surface --------------------------------------------------
 
     def _file_schema(self) -> Schema:

@@ -40,6 +40,10 @@ def _session_mesh(conf):
 
     return session_mesh(conf)
 
+
+def _cluster_mode(conf) -> bool:
+    return conf is not None and conf.get(cfg.CLUSTER_ENABLED)
+
 # ---------------------------------------------------------------------------
 # Expression rule registry (ExprRule analogue, GpuOverrides.scala:536-1621)
 # ---------------------------------------------------------------------------
@@ -279,8 +283,11 @@ class NodeRule:
 def _adaptive_read(ex: exchange.ShuffleExchangeExec,
                    conf: RapidsConf) -> TpuExec:
     """Wrap a multi-partition exchange in an adaptive coalescing reader
-    (AQE's coalesce-shuffle-partitions applied with exact statistics)."""
-    if not conf.get(cfg.ADAPTIVE_ENABLED) or ex.num_out_partitions <= 1:
+    (AQE's coalesce-shuffle-partitions applied with exact statistics).
+    Cluster mode bypasses AQE: the group provider captures the exchange's
+    in-process block store, which cluster exchanges don't populate."""
+    if not conf.get(cfg.ADAPTIVE_ENABLED) or ex.num_out_partitions <= 1 \
+            or _cluster_mode(conf):
         return ex
     return adaptive_exec.AdaptiveShuffleReaderExec(
         ex, conf.get(cfg.ADVISORY_PARTITION_SIZE))
@@ -643,7 +650,8 @@ class _JoinRule(NodeRule):
                                                task_threads=tt)
             rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right,
                                                task_threads=tt)
-            if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1:
+            if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1 and \
+                    not _cluster_mode(meta.conf):
                 # one shared group spec keeps the sides partition-aligned
                 left, right = adaptive_exec.paired_adaptive_readers(
                     lex, rex,
@@ -1081,6 +1089,13 @@ def apply_overrides(plan: pn.PlanNode,
         print(meta.explain(only_not_on_tpu=explain_mode == "NOT_ON_TPU"))
     exec_ = meta.convert()
     exec_ = insert_coalesce(exec_)
+    if _cluster_mode(conf):
+        from spark_rapids_tpu.runtime.cluster import (
+            install_cluster_exchanges, session_cluster)
+
+        runtime = session_cluster(conf)
+        if runtime is not None:
+            exec_ = install_cluster_exchanges(exec_, runtime)
     if conf.get(cfg.TEST_ENABLED):
         allowed = {s.strip() for s in
                    conf.get(cfg.TEST_ALLOWED_NON_TPU).split(",")

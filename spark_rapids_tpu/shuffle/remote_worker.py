@@ -44,6 +44,58 @@ def make_block_batch(lo: int, n: int):
         [Column.from_numpy(vals, dtype=dt.INT64, validity=valid)], n)
 
 
+def run_task_loop(ex, ts) -> None:
+    """Task-server mode: the worker EXECUTES map tasks shipped as pickled
+    closures (the cluster runtime's remote executors — Spark's
+    serialized-lineage model), registers the partitioned output in its
+    own catalog, and serves it through the already-listening TCP server.
+    Nested shuffle reads in the closure fetch from peer executors via
+    this process's own transport client (ExecutorContext)."""
+    import base64
+    import pickle
+    import traceback
+
+    from spark_rapids_tpu.runtime.cluster import (ExecutorContext,
+                                                  run_map_partitions,
+                                                  set_executor_context)
+    from spark_rapids_tpu.shuffle.meta import BlockId
+    from spark_rapids_tpu.shuffle.tcp import TcpTransport
+
+    transport = TcpTransport()
+    set_executor_context(ExecutorContext(ex, transport))
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        cmd = json.loads(line)
+        if cmd.get("cmd") == "exit":
+            break
+        try:
+            assert cmd.get("cmd") == "run_map", cmd
+            payload = pickle.loads(
+                base64.b64decode(cmd["payload_b64"]))
+            for eid, addr in payload["addresses"].items():
+                if eid != ex.executor_id:
+                    transport.register_remote(eid, *addr)
+            subtree = payload["subtree"]
+            parts = run_map_partitions(
+                subtree.execute(payload["map_id"]),
+                payload["partitioning"], payload["types"],
+                payload["num_out"])
+            for p, batch in parts.items():
+                ex.shuffle_catalog.register(
+                    BlockId(payload["shuffle_id"], payload["map_id"], p),
+                    batch)
+            print(json.dumps({"ok": True,
+                              "map_id": payload["map_id"],
+                              "partitions": sorted(parts)}),
+                  flush=True)
+        except Exception:
+            print(json.dumps({"ok": False,
+                              "error": traceback.format_exc()}),
+                  flush=True)
+
+
 def main() -> None:
     import spark_rapids_tpu  # noqa: F401
     from spark_rapids_tpu.shuffle.cluster import Executor
@@ -52,6 +104,12 @@ def main() -> None:
 
     config = json.loads(sys.stdin.readline())
     ex = Executor(config.get("executor_id", "exec-remote"))
+    if config.get("mode") == "task":
+        ts = TcpShuffleServer(ex.server)
+        print(f"READY {ts.host} {ts.port}", flush=True)
+        run_task_loop(ex, ts)
+        ts.close()
+        return
     for sid, mid, part, lo, n in config.get("blocks", []):
         ex.shuffle_catalog.register(BlockId(sid, mid, part),
                                     make_block_batch(lo, n))

@@ -1,0 +1,137 @@
+"""End-to-end SQL over the multi-process cluster runtime.
+
+The round-4 structural item: in the reference, the shuffle transport
+lives INSIDE the shuffle manager real queries use — map tasks write
+partitioned batches into their executor's catalog and MapStatus names
+the owner (RapidsShuffleInternalManager.scala:90-191); reduce tasks
+read local hits zero-copy plus remote blocks through the transport
+(RapidsCachingReader.scala:59-145); a fetch failure drives stage retry
+(RapidsShuffleIterator.scala:242-300). Here ``Session.sql`` executes a
+join+groupby whose shuffles cross a REAL process boundary: at least one
+map task runs inside a second OS process (shuffle/remote_worker.py task
+mode), serves its output over TCP, and a killed worker surfaces as a
+fetch failure that re-runs its map tasks on survivors."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from compare import assert_frames_equal
+from spark_rapids_tpu.api import Session
+from spark_rapids_tpu.runtime.cluster import (ClusterShuffleExchangeExec,
+                                              session_cluster,
+                                              shutdown_session_cluster)
+
+CONF = {
+    "rapids.tpu.cluster.enabled": True,
+    "rapids.tpu.cluster.executors": 2,
+    "rapids.tpu.cluster.workers": 1,
+    "rapids.tpu.sql.shuffle.partitions": 4,
+}
+
+QUERY = ("SELECT d.name AS name, sum(s.v) AS total, count(*) AS n "
+         "FROM sales s JOIN dim d ON s.k = d.id "
+         "GROUP BY d.name ORDER BY name")
+
+
+@pytest.fixture(scope="module")
+def cluster_teardown():
+    yield
+    shutdown_session_cluster()
+
+
+def _views(s: Session, n=400) -> None:
+    """Multi-partition inputs: a single-partition source makes the
+    planner broadcast the join and skip the aggregate exchange, leaving
+    nothing for the cluster runtime to do."""
+    rng = np.random.default_rng(7)
+    s.create_temp_view("sales", s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "v": rng.integers(0, 100, n).astype(np.int64)}))
+        .repartition(3, "k"))
+    s.create_temp_view("dim", s.create_dataframe(pd.DataFrame({
+        "id": np.arange(20, dtype=np.int64),
+        "name": np.array([f"g{i % 5}" for i in range(20)],
+                         dtype=object)}))
+        .repartition(2, "id"))
+
+
+def _expected() -> pd.DataFrame:
+    plain = Session()
+    _views(plain)
+    return plain.sql(QUERY).collect()
+
+
+def _cluster_exchanges(node, out=None):
+    out = [] if out is None else out
+    if isinstance(node, ClusterShuffleExchangeExec):
+        out.append(node)
+    for c in node.children:
+        _cluster_exchanges(c, out)
+    return out
+
+
+def _worker_assignments(runtime):
+    return [eid for maps in runtime.assignments.values()
+            for eid in maps.values() if eid.startswith("exec-worker")]
+
+
+def test_cluster_sql_two_processes(cluster_teardown):
+    """Session.sql join+groupby: every hash/single exchange runs through
+    per-executor shuffle catalogs over TCP, with >=1 map task executed
+    by a separate worker process (which itself FETCHES its nested
+    shuffle inputs from the driver process's executors)."""
+    s = Session(CONF)
+    _views(s)
+    df = s.sql(QUERY)
+    got = df.collect()
+    assert_frames_equal(_expected(), got, sort=False)
+
+    # the plan really was cluster-lowered, not silently single-process
+    exchanges = _cluster_exchanges(df._last_exec)
+    assert len(exchanges) >= 3  # join sides + final aggregate
+    assert all(ex.shuffle_id is not None for ex in exchanges)
+
+    # at least one map task ran in the second OS process and its output
+    # came back over real sockets (correctness above proves the read:
+    # those blocks exist nowhere else)
+    runtime = session_cluster(s.conf)
+    assert runtime is not None and len(runtime.workers) == 1
+    assert runtime.workers[0].alive
+    assert _worker_assignments(runtime), \
+        "no map task was placed on the worker process"
+
+
+def test_cluster_worker_death_stage_retry(cluster_teardown):
+    """Kill the worker AFTER its map outputs registered: the reduce read
+    hits a dead TCP peer, converts to a fetch failure, the tracker
+    invalidates the dead executor's outputs, and its map tasks re-run on
+    the surviving in-process executors (Spark's recovery model)."""
+    s = Session(CONF)
+    _views(s, n=350)
+    df = s.sql(QUERY)
+    exec_ = df._exec()
+
+    # map side first: materialize every cluster shuffle, so the worker
+    # holds real output when it dies
+    for ex in _cluster_exchanges(exec_):
+        ex._materialize()
+    runtime = session_cluster(s.conf)
+    owned = _worker_assignments(runtime)
+    assert owned, "worker owned no map output before the kill"
+    runtime.workers[0].kill()
+
+    from spark_rapids_tpu.execs.base import collect
+    got = collect(exec_, conf=s.conf)
+
+    plain = Session()
+    _views(plain, n=350)
+    assert_frames_equal(plain.sql(QUERY).collect(), got, sort=False)
+
+    # recovery really rewrote the tracker for every shuffle the reduce
+    # pass read: the re-runs landed on survivors. (Shuffles whose maps
+    # the dead worker held but which were never re-read keep their stale
+    # entries — recovery is lazy, as in Spark.)
+    dead = runtime.workers[0].executor_id
+    top_sid = _cluster_exchanges(exec_)[0].shuffle_id
+    maps = runtime.cluster._map_outputs[top_sid]
+    assert maps and all(eid != dead for eid, _parts in maps.values())
