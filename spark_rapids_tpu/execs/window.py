@@ -56,72 +56,34 @@ def _neq_prev(data: jax.Array, validity, dtype: dt.DType) -> jax.Array:
     return neq.at[0].set(True)
 
 
-class WindowExec(TpuExec):
-    def __init__(self, partition_ordinals: List[int],
+class WindowKernel:
+    """The post-sort window math over raw device columns: segment
+    derivation + one output column per call. Pure function of traced
+    arrays, so it runs identically under the single-device exec (below)
+    and inside a per-chip ``shard_map`` body
+    (parallel/window_step.py) — the mesh path is the same kernel after
+    an all_to_all partition-key route."""
+
+    def __init__(self, pre_types: List[dt.DType],
+                 partition_ordinals: List[int],
                  order_specs: List[SortKeySpec], calls: List[WindowCall],
-                 child: TpuExec, schema: Schema, conf=None):
-        super().__init__([child], schema)
-        self.partition_ordinals = partition_ordinals
-        self.order_specs = order_specs
-        self.calls = calls
-        self.conf = conf
-        # pre-projection: child columns + each call's input expression
-        nchild = len(child.schema)
-        exprs: List[Expression] = [
-            BoundReference(i, t) for i, t in enumerate(child.schema.types)]
-        self._input_ordinal: List[int] = []
-        for c in calls:
-            inp = self._call_input(c)
-            if inp is None:
-                self._input_ordinal.append(-1)
-            else:
-                self._input_ordinal.append(len(exprs))
-                exprs.append(inp)
-        self.pre_proj = CompiledProjection(exprs, conf)
-        self.pre_types = [e.dtype for e in exprs]
-        self.n_child = nchild
+                 input_ordinals: List[int]):
+        self.pre_types = list(pre_types)
+        self.partition_ordinals = list(partition_ordinals)
+        self.order_specs = list(order_specs)
+        self.calls = list(calls)
+        self._input_ordinal = list(input_ordinals)
 
-    @staticmethod
-    def _call_input(c: WindowCall):
-        if isinstance(c.fn, AggregateFunction):
-            return c.fn.input
-        if isinstance(c.fn, tuple):
-            return c.fn[1]
-        return None
-
-    @property
-    def children_coalesce_goal(self):
-        return [RequireSingleBatch]
-
-    # ------------------------------------------------------------------
-
-    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
-        def it():
-            from spark_rapids_tpu.execs.batching import \
-                drain_to_single_batch
-
-            b = drain_to_single_batch(
-                self.children[0].execute(partition), self.schema)
-            if b.realized_num_rows() == 0:
-                yield b
-                return
-            with TraceRange("WindowExec"):
-                yield self._run(b)
-        return timed(self, it())
-
-    def _run(self, batch: ColumnarBatch) -> ColumnarBatch:
-        ext = self.pre_proj(batch)
-        sort_specs = [SortKeySpec(o, True, True)
-                      for o in self.partition_ordinals] + self.order_specs
-        s = sort_batch(ext, sort_specs, self.pre_types) if sort_specs \
-            else ext
-        cap = s.capacity
-        num_rows = s.num_rows_device()
+    def __call__(self, cols: List[Column], num_rows) -> List[Column]:
+        """``cols``: the pre-projected columns ALREADY sorted by
+        (partition keys, order keys) with padding last; ``num_rows`` a
+        device scalar. Returns one column per window call."""
+        cap = cols[0].capacity
         live = jnp.arange(cap, dtype=jnp.int32) < num_rows
 
-        part_b = self._boundary(s, self.partition_ordinals, live)
+        part_b = self._boundary(cols, self.partition_ordinals, num_rows)
         order_cols = [spec.ordinal for spec in self.order_specs]
-        order_b = part_b | self._boundary(s, order_cols, live) \
+        order_b = part_b | self._boundary(cols, order_cols, num_rows) \
             if order_cols else part_b
 
         seg_id = jnp.cumsum(part_b.astype(jnp.int32)) - 1
@@ -134,32 +96,31 @@ class WindowExec(TpuExec):
                                       indices_are_sorted=True) + 1
         end_of_row = jnp.take(seg_end, seg_id)
 
-        out_cols = list(s.columns[:self.n_child])
+        out: List[Column] = []
         for c, inp_ord in zip(self.calls, self._input_ordinal):
-            col = self._one_call(c, s, inp_ord, seg_id, idx, start_of_row,
-                                 end_of_row, order_b, live)
-            out_cols.append(col)
-        return ColumnarBatch(out_cols, s.num_rows)
+            out.append(self._one_call(c, cols, inp_ord, seg_id, idx,
+                                      start_of_row, end_of_row, order_b,
+                                      live))
+        return out
 
-    def _boundary(self, s: ColumnarBatch, ordinals: List[int],
-                  live) -> jax.Array:
-        cap = s.capacity
+    def _boundary(self, cols: List[Column], ordinals: List[int],
+                  num_rows) -> jax.Array:
+        cap = cols[0].capacity
         boundary = jnp.zeros(cap, dtype=bool).at[0].set(True)
         for o in ordinals:
-            c = s.columns[o]
+            c = cols[o]
             boundary = boundary | _neq_prev(c.data, c.validity,
                                             self.pre_types[o])
         # first padding row opens its own segment
-        num_rows = s.num_rows_device()
         is_first_pad = jnp.arange(cap, dtype=jnp.int32) == num_rows
         return boundary | is_first_pad
 
     # ------------------------------------------------------------------
 
-    def _one_call(self, c: WindowCall, s: ColumnarBatch, inp_ord: int,
+    def _one_call(self, c: WindowCall, cols: List[Column], inp_ord: int,
                   seg_id, idx, start_of_row, end_of_row, order_b,
                   live) -> Column:
-        cap = s.capacity
+        cap = cols[0].capacity
         if c.fn == "row_number":
             data = (idx - start_of_row + 1).astype(jnp.int32)
             return Column(dt.INT32, data, None)
@@ -181,7 +142,7 @@ class WindowExec(TpuExec):
             src_c = jnp.clip(src, 0, cap - 1)
             same = jnp.take(seg_id, src_c) == seg_id
             ok = ok & same & jnp.take(live, src_c)
-            inp = s.columns[inp_ord]
+            inp = cols[inp_ord]
             data = jnp.take(inp.data, src_c)
             src_valid = jnp.take(inp.validity, src_c) \
                 if inp.validity is not None else None
@@ -195,17 +156,17 @@ class WindowExec(TpuExec):
                 valid = ok if src_valid is None else (ok & src_valid)
             return inp._like(data, valid)
         assert isinstance(c.fn, AggregateFunction)
-        return self._window_agg(c, s, inp_ord, seg_id, idx, start_of_row,
-                                end_of_row, live)
+        return self._window_agg(c, cols, inp_ord, seg_id, idx,
+                                start_of_row, end_of_row, live)
 
-    def _range_bounds(self, s: ColumnarBatch, seg_id, start_of_row,
+    def _range_bounds(self, cols: List[Column], seg_id, start_of_row,
                       end_of_row, frame, live):
         """Per-row [lo, hi] row-index bounds of a RANGE frame over the
         single ascending order key. Null keys sort first and are all
         'equal': a null row's frame is exactly the null run."""
         okey_ord = self.order_specs[0].ordinal
-        kcol = s.columns[okey_ord]
-        cap = s.capacity
+        kcol = cols[okey_ord]
+        cap = kcol.capacity
         key = kcol.data
         kvalid = (kcol.validity if kcol.validity is not None
                   else jnp.ones(cap, dtype=bool)) & live
@@ -240,23 +201,25 @@ class WindowExec(TpuExec):
                                start_of_row + nulls_in_seg - 1)
         return lo_arr, hi_arr
 
-    def _window_agg(self, c: WindowCall, s: ColumnarBatch, inp_ord: int,
-                    seg_id, idx, start_of_row, end_of_row, live) -> Column:
+    def _window_agg(self, c: WindowCall, cols: List[Column],
+                    inp_ord: int, seg_id, idx, start_of_row, end_of_row,
+                    live) -> Column:
         fn = c.fn
-        cap = s.capacity
+        cap = cols[0].capacity
         frame = c.frame
         if isinstance(fn, Count) and fn.input is None:
             vals = jnp.ones(cap, dtype=jnp.int64)
             valid_in = live
         else:
-            inp = s.columns[inp_ord]
+            inp = cols[inp_ord]
             vals = inp.data
             valid_in = live if inp.validity is None else \
                 (live & inp.validity)
 
         if frame.kind == "range":
-            lo_arr, hi_arr = self._range_bounds(s, seg_id, start_of_row,
-                                                end_of_row, frame, live)
+            lo_arr, hi_arr = self._range_bounds(cols, seg_id,
+                                                start_of_row, end_of_row,
+                                                frame, live)
         else:
             lo_arr = start_of_row if frame.lower is None else \
                 jnp.maximum(idx + frame.lower, start_of_row)
@@ -279,7 +242,7 @@ class WindowExec(TpuExec):
             # validity), NULL when the frame is empty
             pos = lo_arr if isinstance(fn, First) else hi_arr
             posc = jnp.clip(pos, 0, cap - 1)
-            inp = s.columns[inp_ord]
+            inp = cols[inp_ord]
             data = jnp.take(inp.data, posc)
             src_valid = jnp.take(inp.validity, posc) \
                 if inp.validity is not None else jnp.ones(cap, dtype=bool)
@@ -325,6 +288,79 @@ class WindowExec(TpuExec):
             raise NotImplementedError(
                 "bounded min/max window frames fall back to CPU")
         raise NotImplementedError(f"window aggregate {type(fn).__name__}")
+
+
+def window_pre_projection(child_types: List[dt.DType],
+                          calls: List[WindowCall], conf
+                          ) -> Tuple[CompiledProjection, List[dt.DType],
+                                     List[int]]:
+    """Child columns + each call's input expression; returns the
+    projection, its output types, and each call's input ordinal (-1 for
+    input-free calls like row_number/count(*))."""
+    exprs: List[Expression] = [
+        BoundReference(i, t) for i, t in enumerate(child_types)]
+    input_ordinals: List[int] = []
+    for c in calls:
+        if isinstance(c.fn, AggregateFunction):
+            inp = c.fn.input
+        elif isinstance(c.fn, tuple):
+            inp = c.fn[1]
+        else:
+            inp = None
+        if inp is None:
+            input_ordinals.append(-1)
+        else:
+            input_ordinals.append(len(exprs))
+            exprs.append(inp)
+    return (CompiledProjection(exprs, conf), [e.dtype for e in exprs],
+            input_ordinals)
+
+
+class WindowExec(TpuExec):
+    def __init__(self, partition_ordinals: List[int],
+                 order_specs: List[SortKeySpec], calls: List[WindowCall],
+                 child: TpuExec, schema: Schema, conf=None):
+        super().__init__([child], schema)
+        self.partition_ordinals = partition_ordinals
+        self.order_specs = order_specs
+        self.calls = calls
+        self.conf = conf
+        self.n_child = len(child.schema)
+        self.pre_proj, self.pre_types, self._input_ordinal = \
+            window_pre_projection(list(child.schema.types), calls, conf)
+        self.kernel = WindowKernel(self.pre_types, partition_ordinals,
+                                   order_specs, calls,
+                                   self._input_ordinal)
+
+    @property
+    def children_coalesce_goal(self):
+        return [RequireSingleBatch]
+
+    # ------------------------------------------------------------------
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            from spark_rapids_tpu.execs.batching import \
+                drain_to_single_batch
+
+            b = drain_to_single_batch(
+                self.children[0].execute(partition), self.schema)
+            if b.realized_num_rows() == 0:
+                yield b
+                return
+            with TraceRange("WindowExec"):
+                yield self._run(b)
+        return timed(self, it())
+
+    def _run(self, batch: ColumnarBatch) -> ColumnarBatch:
+        ext = self.pre_proj(batch)
+        sort_specs = [SortKeySpec(o, True, True)
+                      for o in self.partition_ordinals] + self.order_specs
+        s = sort_batch(ext, sort_specs, self.pre_types) if sort_specs \
+            else ext
+        call_cols = self.kernel(list(s.columns), s.num_rows_device())
+        out_cols = list(s.columns[:self.n_child]) + call_cols
+        return ColumnarBatch(out_cols, s.num_rows)
 
 
 def _range_lower_upper_bound(seg_id, kvalid, key, tseg, tkey, cap: int,

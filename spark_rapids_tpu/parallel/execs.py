@@ -44,6 +44,7 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
 from spark_rapids_tpu.columnar.column import Column, StringColumn
 from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.execs.aggregate import HashAggregateExec
+from spark_rapids_tpu.execs.window import WindowExec
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression)
 from spark_rapids_tpu.expressions.compiler import CompiledFilter
@@ -179,7 +180,7 @@ def _mesh_source(child: TpuExec):
         ords = [inner[o] for o in ords]
         node = node.children[0]
     if isinstance(node, (MeshGroupByExec, MeshShuffledJoinExec,
-                         MeshSortExec)):
+                         MeshSortExec, MeshWindowExec)):
         return node, ords
     return None
 
@@ -571,6 +572,94 @@ class MeshShuffledJoinExec(TpuExec):
         if self.condition is not None:
             r = self.condition(r)
         return r
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            r = self.execute_any()
+            if isinstance(r, DistributedBatch):
+                r = _gather_db(r, self.mesh.shape[DATA_AXIS])
+            yield r
+        return timed(self, it())
+
+
+class MeshWindowExec(WindowExec):
+    """Window functions lowered onto the mesh: the planner's hash
+    exchange on PARTITION BY keys + per-partition window
+    (GpuWindowExec.scala:92) fuse into one all_to_all + per-chip
+    sort + segmented-scan program (parallel/window_step.py). Hash
+    routing puts each partition-by group wholly on one chip, so results
+    are exact with no merge. Consumes sharded child chains when the
+    pre-projection is pure column selection; emits a DistributedBatch
+    for chained mesh parents (rank-filter-join pipelines stay
+    device-resident)."""
+
+    def __init__(self, partition_ordinals, order_specs, calls,
+                 child: TpuExec, schema: Schema, conf, mesh):
+        super().__init__(partition_ordinals, order_specs, calls, child,
+                         schema, conf)
+        assert partition_ordinals, \
+            "un-partitioned windows stay single-device"
+        self.mesh = mesh
+        self._dstep = None
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    @property
+    def children_coalesce_goal(self):
+        # the single-device exec demands one batch; the mesh exec drains
+        # and stages its own input — a coalesce here would sever the
+        # sharded hand-off from a mesh child (the inserted
+        # CoalesceBatchesExec hides the child from _mesh_source)
+        return [None]
+
+    def _step(self):
+        from spark_rapids_tpu.parallel.window_step import \
+            DistributedWindowStep
+
+        if self._dstep is None:
+            self._dstep = DistributedWindowStep(
+                self.mesh, tuple(self.pre_types),
+                tuple(self.partition_ordinals), tuple(self.order_specs),
+                tuple(self.calls), tuple(self._input_ordinal),
+                self.n_child)
+        return self._dstep
+
+    def execute_any(self) -> Union[DistributedBatch, ColumnarBatch]:
+        ords = _ref_only_ordinals(self.pre_proj.exprs)
+        src = _eval_source(self.children[0])
+        db_in: Optional[DistributedBatch] = None
+        if src is not None and isinstance(src, DistributedBatch) and \
+                ords is not None:
+            if src.total_rows() == 0:
+                return ColumnarBatch.empty(self.schema)
+            db_in = src.select(ords)
+        else:
+            b = _drain_exec(self.children[0]) if src is None else src
+            if isinstance(b, DistributedBatch):
+                # sharded child but a computing pre-projection: the
+                # projection is host-orchestrated, so stage through it
+                b = _gather_db(b, self.mesh.shape[DATA_AXIS])
+            if b.realized_num_rows() == 0:
+                return ColumnarBatch.empty(self.schema)
+            db_in = _to_sharded(self.mesh, self.pre_proj(b),
+                                self.pre_types)
+        n_dev = self.mesh.shape[DATA_AXIS]
+        with TraceRange("MeshWindowExec.step"):
+            step = self._step()
+            od, ov, ns = step(db_in.datas, db_in.valids, db_in.counts)
+        templates: List[Optional[Column]] = \
+            list(db_in.templates[:self.n_child])
+        for c, io in zip(self.calls, self._input_ordinal):
+            # lead/lag/first/last over strings reuse the input column's
+            # dictionary; numeric calls carry no template
+            templates.append(db_in.templates[io]
+                             if io >= 0 and
+                             self.pre_types[io] is dt.STRING else None)
+        out_cap = od[0].shape[0] // n_dev
+        return DistributedBatch(list(od), list(ov), ns, out_cap,
+                                step.output_dtypes(), templates)
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():

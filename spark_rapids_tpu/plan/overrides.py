@@ -777,6 +777,16 @@ class _WindowRule(NodeRule):
     def convert(self, meta, children):
         node: pn.WindowNode = meta.node
         child = children[0]
+        mesh = _session_mesh(meta.conf)
+        if mesh is not None and node.partition_ordinals:
+            # partition-by windows lower onto the mesh: the hash
+            # exchange + per-partition window (GpuWindowExec.scala:92)
+            # fuse into one all_to_all + per-chip kernel program
+            from spark_rapids_tpu.parallel.execs import MeshWindowExec
+
+            return MeshWindowExec(node.partition_ordinals,
+                                  node.order_specs, node.calls, child,
+                                  node.output_schema(), meta.conf, mesh)
         if child.num_partitions > 1:
             if node.partition_ordinals:
                 parts = cfg.resolve_shuffle_partitions(meta.conf)
