@@ -33,12 +33,20 @@ _KIND_MAP = {"inner": "inner", "left": "left", "left_semi": "leftsemi",
 class HashJoinExec(TpuExec):
     """Build-side = children[1] (right); streams children[0] (left).
     ``right`` joins are planned as flipped ``left`` joins by the planner
-    (Spark310-style buildSide handling lives there too)."""
+    (Spark310-style buildSide handling lives there too).
+
+    Out-of-core (SURVEY §5.7): a build side that exceeds the batch
+    budget is NOT funneled into one device batch (the reference's
+    RequireSingleBatch cliff, GpuCoalesceBatches.scala:91-127). Both
+    sides hash-bucket by join key into spillable slices (matching rows
+    share a bucket by construction) and each bucket joins independently
+    at a bounded size — the sort exec's range-bucket pattern applied to
+    the join build."""
 
     def __init__(self, kind: str, left: TpuExec, right: TpuExec,
                  left_keys: List[int], right_keys: List[int],
                  schema: Schema, condition: Optional[Expression] = None,
-                 conf=None):
+                 conf=None, join_budget_rows: Optional[int] = None):
         super().__init__([left, right], schema)
         assert kind in _KIND_MAP, kind  # cross -> nested-loop/cartesian
         if condition is not None:
@@ -49,6 +57,12 @@ class HashJoinExec(TpuExec):
         self.right_keys = right_keys
         self.condition = CompiledFilter(condition, conf) \
             if condition is not None else None
+        self.join_budget_rows = join_budget_rows
+        self._batch_bytes = None
+        if conf is not None:
+            from spark_rapids_tpu import config as cfg
+
+            self._batch_bytes = conf.get(cfg.BATCH_SIZE_BYTES)
 
     @property
     def num_partitions(self) -> int:
@@ -56,32 +70,79 @@ class HashJoinExec(TpuExec):
 
     @property
     def children_coalesce_goal(self):
-        # build side must arrive whole; full joins also need the stream
-        # side whole (unmatched-build emission happens once)
-        stream_goal = RequireSingleBatch if self.kind == "full" else None
-        return [stream_goal, RequireSingleBatch]
+        # neither side needs a single batch any more: the exec stages
+        # incoming batches spillably and buckets them itself
+        return [None, None]
 
-    def _build_side(self, partition: int) -> ColumnarBatch:
-        from spark_rapids_tpu.execs.batching import drain_to_single_batch
+    def _budget_rows(self) -> int:
+        """Rows of ONE side the in-core path may hold resident (the
+        sort exec's budget formula over the build schema)."""
+        if self.join_budget_rows is not None:
+            return max(self.join_budget_rows, 1)
+        from spark_rapids_tpu import config as cfg
 
-        return drain_to_single_batch(self.children[1].execute(partition),
-                                     self.children[1].schema)
+        bb = self._batch_bytes if self._batch_bytes is not None \
+            else cfg.BATCH_SIZE_BYTES.default
+        row_bytes = max(sum(t.byte_width
+                            for t in self.children[1].schema.types), 1)
+        return max(bb // row_bytes, 1 << 16)
+
+    def _stage(self, child_index: int, partition: int):
+        """Drain one child into spillable chunks (staged chunks can
+        leave HBM while later child batches still compute)."""
+        from spark_rapids_tpu.memory import priorities
+        from spark_rapids_tpu.memory.spillable import SpillableBatch
+
+        staged: List = []
+        total = 0
+        for b in self.children[child_index].execute(partition):
+            n = b.realized_num_rows()
+            if n == 0:
+                continue
+            total += n
+            staged.append(SpillableBatch(
+                b, priorities.INPUT_FROM_SHUFFLE_PRIORITY))
+        return staged, total
+
+    @staticmethod
+    def _concat_staged(staged, schema) -> ColumnarBatch:
+        from contextlib import ExitStack
+
+        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.ops.concat import concat_batches
+
+        if not staged:
+            return ColumnarBatch.empty(schema)
+        with ExitStack() as stack:
+            parts = [stack.enter_context(sb.acquired()) for sb in staged]
+            merged = parts[0] if len(parts) == 1 else \
+                with_oom_retry(lambda: concat_batches(parts))
+        for sb in staged:
+            sb.close()
+        return merged
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         left_types = list(self.children[0].schema.types)
         right_types = list(self.children[1].schema.types)
 
         def it():
-            build = self._build_side(partition)
+            from spark_rapids_tpu.memory.oom import with_oom_retry
+
+            build_staged, build_total = self._stage(1, partition)
+            budget = self._budget_rows()
+            if build_total > budget:
+                yield from self._out_of_core(partition, build_staged,
+                                             build_total, budget,
+                                             left_types, right_types)
+                return
+            build = self._concat_staged(build_staged,
+                                        self.children[1].schema)
             if self.kind == "full":
                 # unmatched-build rows are emitted exactly once, so the
                 # stream side must arrive as one batch
-                from spark_rapids_tpu.execs.batching import \
-                    drain_to_single_batch
-
-                stream_batches = [drain_to_single_batch(
-                    self.children[0].execute(partition),
-                    self.children[0].schema)]
+                stream_staged, _n = self._stage(0, partition)
+                stream_batches = [self._concat_staged(
+                    stream_staged, self.children[0].schema)]
             else:
                 stream_batches = self.children[0].execute(partition)
             saw = False
@@ -89,8 +150,6 @@ class HashJoinExec(TpuExec):
                 if b.realized_num_rows() == 0 and saw:
                     continue
                 saw = True
-                from spark_rapids_tpu.memory.oom import with_oom_retry
-
                 with TraceRange(f"HashJoinExec.{self.kind}"):
                     out, _ = with_oom_retry(
                         lambda b=b: equi_join(
@@ -102,6 +161,73 @@ class HashJoinExec(TpuExec):
                     out = self.condition(out)
                 yield out
         return timed(self, it())
+
+    def _bucket(self, staged, keys: List[int], types, n_buckets: int,
+                trace: str):
+        """Hash-partition each staged chunk by join key, regrouping
+        slices per bucket (slices stay spillable until their bucket
+        runs). The partitioner is the exchange's own hash kernel, so
+        both sides agree on bucket placement."""
+        from spark_rapids_tpu.memory import priorities
+        from spark_rapids_tpu.memory.spillable import SpillableBatch
+        from spark_rapids_tpu.ops import partition as part_ops
+
+        per_bucket: List[List] = [[] for _ in range(n_buckets)]
+        for sb in staged:
+            with sb.acquired() as b:
+                with TraceRange(trace):
+                    sorted_b, counts = part_ops.hash_partition(
+                        b, keys, types, n_buckets)
+                    slices = part_ops.slice_partitions(sorted_b, counts)
+                for p, sl in enumerate(slices):
+                    if sl is not None:
+                        per_bucket[p].append(SpillableBatch(
+                            sl, priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
+            sb.close()
+        return per_bucket
+
+    def _out_of_core(self, partition: int, build_staged,
+                     build_total: int, budget: int, left_types,
+                     right_types) -> Iterator[ColumnarBatch]:
+        """Bucket-by-bucket join at bounded resident size. Hash
+        co-bucketing keeps every join kind exact: matches share a
+        bucket; left/full unmatched rows surface from their own bucket,
+        each build row is in exactly one bucket so full-outer emits its
+        unmatched rows exactly once."""
+        from spark_rapids_tpu.memory.oom import with_oom_retry
+
+        # 2x headroom over the mean bucket absorbs hash skew
+        n_buckets = max(-(-build_total // budget) * 2, 2)
+        build_buckets = self._bucket(build_staged, self.right_keys,
+                                     right_types, n_buckets,
+                                     "HashJoinExec.oob.build")
+        stream_staged, _n = self._stage(0, partition)
+        stream_buckets = self._bucket(stream_staged, self.left_keys,
+                                      left_types, n_buckets,
+                                      "HashJoinExec.oob.stream")
+        emitted = False
+        for p in range(n_buckets):
+            stream_b = self._concat_staged(stream_buckets[p],
+                                           self.children[0].schema)
+            if stream_b.realized_num_rows() == 0 and \
+                    (self.kind != "full" or not build_buckets[p]):
+                for h in build_buckets[p]:
+                    h.close()
+                continue
+            build_b = self._concat_staged(build_buckets[p],
+                                          self.children[1].schema)
+            with TraceRange(f"HashJoinExec.oob.{self.kind}"):
+                out, _ = with_oom_retry(
+                    lambda s=stream_b, b=build_b: equi_join(
+                        s, b, self.left_keys, self.right_keys,
+                        left_types, right_types,
+                        join_type=_KIND_MAP[self.kind]))
+            if self.condition is not None:
+                out = self.condition(out)
+            emitted = True
+            yield out
+        if not emitted:
+            yield ColumnarBatch.empty(self.schema)
 
 
 class BroadcastHashJoinExec(HashJoinExec):

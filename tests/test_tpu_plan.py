@@ -590,3 +590,46 @@ def test_out_of_core_global_sort_spills():
     assert max(b.realized_num_rows() for b in batches) < 50_000
     tpu = collect(exec_)
     assert_frames_equal(cpu, tpu, sort=False)
+
+
+@pytest.mark.parametrize("kind", ["inner", "left", "left_semi",
+                                  "left_anti", "full"])
+def test_out_of_core_join_build_exceeds_budget(kind):
+    """A join whose build side exceeds the batch budget takes the
+    hash-bucketed out-of-core path (SURVEY §5.7: no RequireSingleBatch
+    cliff, the sort exec's treatment applied to joins — r3 verdict #5):
+    both sides bucket by key into spillable slices, each bucket joins at
+    a bounded size, and every join kind stays exact (unmatched left/full
+    rows surface from their own bucket; build rows emit exactly once)."""
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.execs.basic import ScanExec
+    from spark_rapids_tpu.execs.joins import ShuffledHashJoinExec
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+    from tests.compare import assert_frames_equal
+
+    rng = np.random.default_rng(11)
+    nl, nr = 9000, 24_000
+    # dangling keys on both sides: unmatched stream rows (left/full) and
+    # unmatched build rows (full) both cross bucket boundaries
+    ldata = {"k": rng.integers(0, 4000, nl).astype(np.int64),
+             "v": rng.normal(size=nl)}
+    lvalid = {"k": rng.random(nl) > 0.03}
+    rdata = {"k2": rng.integers(2000, 6000, nr).astype(np.int64),
+             "w": rng.integers(0, 100, nr).astype(np.int64)}
+    plan = pn.JoinNode(kind, scan(ldata, lvalid), scan(rdata), [0], [0])
+    cpu = execute_cpu(plan).to_pandas()
+
+    lnode, rnode = scan(ldata, lvalid), scan(rdata)
+    exec_ = ShuffledHashJoinExec(
+        kind, ScanExec(pn.InMemorySource(ldata, validity=lvalid),
+                       lnode.output_schema()),
+        ScanExec(pn.InMemorySource(rdata), rnode.output_schema()),
+        [0], [0], plan.output_schema(), join_budget_rows=5000)
+    batches = [b for b in exec_.execute(0)
+               if b.realized_num_rows() > 0]
+    assert len(batches) > 4, \
+        "build 24k rows over a 5k budget must run many buckets"
+    assert max(b.realized_num_rows() for b in batches) < cpu.shape[0] \
+        or cpu.shape[0] == 0
+    tpu = collect(exec_)
+    assert_frames_equal(cpu, tpu, sort=True)
