@@ -31,7 +31,10 @@ def seed_compile_cache() -> None:
 
     NOTE (builder discipline): after ANY change to ops/groupby.py or the
     entry pipeline, re-run `python bench.py` once without a timeout and
-    refresh scripts/bench_cache/ with the new jit_step-* entry."""
+    refresh scripts/bench_cache/ with the new jit_step-* entry.
+    `python scripts/check_bench_cache.py` verifies the seed still
+    matches (trace + cache probe, no compile) — run it before every
+    commit that touches the kernel."""
     root = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(root, "scripts", "bench_cache")
     dst = os.path.join(root, ".jax_cache")

@@ -1,0 +1,71 @@
+"""Fail when scripts/bench_cache/ no longer matches the bench kernel.
+
+The driver bench (bench.py) absorbs the ~30-minute cold XLA compile of
+the 4M-row fused pipeline by seeding .jax_cache from a tracked
+executable (scripts/bench_cache/). Any edit to ops/groupby.py or the
+entry pipeline changes the cache key and silently invalidates the seed —
+the next driver bench then times out (r2's rc 124). This check makes the
+staleness loud IN-ROUND: it traces the exact bench program against the
+attached TPU backend, then asks jax's compile path for it with the
+actual backend compile FORBIDDEN. A persistent-cache hit proves the
+tracked entry still matches; a miss means "refresh the seed":
+
+    rm -rf .jax_cache && python bench.py   # one cold compile (~30 min)
+    cp .jax_cache/jit_step-*-cache scripts/bench_cache/  # + git add
+
+Requires the TPU backend (the cache key includes the target platform),
+so it runs on the axon-attached build box, not in CPU-only CI.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class _WouldCompile(Exception):
+    pass
+
+
+def main() -> int:
+    import bench
+
+    bench.seed_compile_cache()
+
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        print("SKIP: no TPU backend attached (cache keys are "
+              "platform-specific; run this on the TPU box)")
+        return 0
+
+    from __graft_entry__ import entry
+
+    step, args = entry()
+    lowered = jax.jit(step).lower(*args)
+
+    from jax._src import compiler
+
+    def _forbid(*a, **k):
+        raise _WouldCompile()
+
+    orig = compiler.backend_compile_and_load
+    compiler.backend_compile_and_load = _forbid
+    try:
+        lowered.compile()
+    except _WouldCompile:
+        print("STALE: the bench kernel no longer matches "
+              "scripts/bench_cache/ — the next driver bench will eat a "
+              "~30-min cold compile. Refresh the seed (see module "
+              "docstring).")
+        return 1
+    finally:
+        compiler.backend_compile_and_load = orig
+    print("OK: scripts/bench_cache/ matches the current bench kernel")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

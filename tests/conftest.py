@@ -31,3 +31,12 @@ assert len(jax.devices()) >= 8, \
 @pytest.fixture(scope="session")
 def n_virtual_devices():
     return len(jax.devices())
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two-tier suite: anything not explicitly `full` (the 140-query TPC
+    oracle matrices) is the `smoke` tier — `pytest -m smoke` stays under
+    the per-push CI window; plain `pytest tests/` is the nightly run."""
+    for item in items:
+        if "full" not in item.keywords:
+            item.add_marker(pytest.mark.smoke)
