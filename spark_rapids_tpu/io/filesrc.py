@@ -98,6 +98,7 @@ class FileSourceBase(DataSource):
         # observability for tests / explain (pruning effectiveness)
         self.chunks_total = 0
         self.chunks_pruned = 0
+        self._est_rows: Optional[int] = None
 
     # scans ship inside remote map-task closures (cluster runtime): the
     # lock is process-local; splits re-derive from paths on arrival

@@ -72,6 +72,26 @@ def _merge_rg_stats(per_rg: List[dict], types) -> tuple:
     return tuple(out)
 
 
+_FOOTER_ROWS: dict = {}
+
+
+def _footer_row_count(path: str) -> int:
+    """num_rows from the footer, cached by (path, mtime, size)."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    st = os.stat(path)
+    key = (path, st.st_mtime_ns, st.st_size)
+    n = _FOOTER_ROWS.get(key)
+    if n is None:
+        if len(_FOOTER_ROWS) >= 4096:
+            _FOOTER_ROWS.clear()
+        n = pq.read_metadata(path).num_rows
+        _FOOTER_ROWS[key] = n
+    return n
+
+
 class ParquetSource(FileSourceBase):
     """Columnar parquet reader with row-group statistics pruning."""
 
@@ -85,6 +105,21 @@ class ParquetSource(FileSourceBase):
 
         return arrow_conv.schema_from_arrow(
             pq.read_schema(self.paths[0]), self.columns)
+
+    def estimated_row_count(self):
+        """Footer num_rows across files (pre-pruning): the plan-time
+        size signal for greedy join reordering — footer metadata only,
+        no data read (the reference gets this from Spark's relation
+        statistics upstream). Counts cache per path PROCESS-wide:
+        every fresh plan over the same files (the benchmark loop's
+        plan-per-iteration) must not re-open every footer."""
+        if self._est_rows is None:
+            try:
+                self._est_rows = sum(_footer_row_count(p)
+                                     for p in self.paths)
+            except Exception:  # pragma: no cover - corrupt footer
+                self._est_rows = -1
+        return None if self._est_rows < 0 else self._est_rows
 
     def _build_splits(self) -> list:
         import pyarrow.parquet as pq

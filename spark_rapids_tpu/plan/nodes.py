@@ -113,6 +113,12 @@ class DataSource:
         host min/max pass."""
         return None
 
+    def estimated_row_count(self):
+        """Plan-time row-count estimate (file footer metadata / host
+        array length), or None when unknown. Feeds the optimizer's
+        greedy join reordering — never correctness."""
+        return None
+
 
 class InMemorySource(DataSource):
     """Host-resident columns (dict name -> numpy array / list), the analogue
@@ -129,6 +135,11 @@ class InMemorySource(DataSource):
 
     def read_host(self):
         return self.data, self.validity
+
+    def estimated_row_count(self):
+        for v in self.data.values():
+            return len(v)
+        return 0
 
 
 def _infer_schema(data: dict) -> Schema:

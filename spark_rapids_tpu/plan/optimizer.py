@@ -230,5 +230,157 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
     return pn.ProjectNode(exprs, out, names)
 
 
+# ---------------------------------------------------------------------------
+# Greedy join reordering (r3 verdict #6). The reference inherits join
+# order from Spark's cost-based optimizer upstream; standalone, this
+# planner owns the job. Scan-statistics row counts (parquet footer
+# metadata / host array lengths) drive a classic greedy heuristic:
+# start from the LARGEST relation (the fact table stays the stream
+# side) and repeatedly join the smallest connected relation — small
+# dimensions become early, cheap build sides and intermediate results
+# shrink as early as possible (q64's 17-table chain no longer depends
+# on the hand-written query order).
+# ---------------------------------------------------------------------------
+
+_FILTER_SELECTIVITY = 0.3
+
+
+def estimate_rows(node: pn.PlanNode) -> Optional[int]:
+    """Plan-time cardinality estimate; None = unknown (no reordering)."""
+    if isinstance(node, pn.ScanNode):
+        est = node.source.estimated_row_count()
+        if est is not None and isinstance(node.source, pn.DataSource) \
+                and getattr(node.source, "filters", None):
+            est = max(int(est * _FILTER_SELECTIVITY), 1)
+        return est
+    if isinstance(node, pn.FilterNode):
+        c = estimate_rows(node.children[0])
+        return None if c is None else max(int(c * _FILTER_SELECTIVITY), 1)
+    if isinstance(node, pn.JoinNode):
+        le = estimate_rows(node.children[0])
+        if node.kind in ("left_semi", "left_anti"):
+            return le
+        re = estimate_rows(node.children[1])
+        if le is None or re is None:
+            return None
+        if node.kind == "inner":
+            return max(le, re)  # FK->PK: output tracks the fact side
+        return le if node.kind == "left" else le + re
+    if isinstance(node, pn.AggregateNode):
+        c = estimate_rows(node.children[0])
+        # grouped outputs shrink; keep a conservative fraction
+        return None if c is None else max(c // 3, 1)
+    if isinstance(node, pn.UnionNode):
+        parts = [estimate_rows(c) for c in node.children]
+        return None if any(p is None for p in parts) else sum(parts)
+    if isinstance(node, pn.LimitNode):
+        c = estimate_rows(node.children[0])
+        return node.n if c is None else min(node.n, c)
+    if len(node.children) == 1:  # project/sort/window/exchange/...
+        return estimate_rows(node.children[0])
+    return None
+
+
+def _flatten_inner_joins(node: pn.PlanNode):
+    """Maximal chain of condition-free inner equi-joins.
+    Returns (rels, colmap, edges): base relations, a map from this
+    subtree's output ordinal to (rel_index, rel_ordinal), and key
+    equalities as ((ri, ci), (rj, cj)) pairs."""
+    if isinstance(node, pn.JoinNode) and node.kind == "inner" and \
+            node.condition is None and node.left_keys:
+        lrels, lmap, ledges = _flatten_inner_joins(node.children[0])
+        rrels, rmap, redges = _flatten_inner_joins(node.children[1])
+        off = len(lrels)
+        rmap = [(ri + off, ci) for ri, ci in rmap]
+        redges = [((a + off, b), (c + off, d))
+                  for (a, b), (c, d) in redges]
+        edges = ledges + redges
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            edges.append((lmap[lk], rmap[rk]))
+        return lrels + rrels, lmap + rmap, edges
+    width = len(node.output_schema())
+    return [node], [(0, i) for i in range(width)], []
+
+
+def _greedy_order(n: int, edges, est) -> Optional[List[int]]:
+    adj = {i: set() for i in range(n)}
+    for (ri, _), (rj, _) in edges:
+        adj[ri].add(rj)
+        adj[rj].add(ri)
+    start = max(range(n), key=lambda i: est[i])
+    order, placed = [start], {start}
+    while len(order) < n:
+        cand = [i for i in range(n)
+                if i not in placed and adj[i] & placed]
+        if not cand:
+            return None  # disconnected graph: keep the written order
+        nxt = min(cand, key=lambda i: est[i])
+        order.append(nxt)
+        placed.add(nxt)
+    return order
+
+
+def reorder_joins(node: pn.PlanNode) -> pn.PlanNode:
+    # TOP-DOWN: the chain must flatten before any sub-chain wraps
+    # itself in a restore-projection (which would hide it)
+    if not (isinstance(node, pn.JoinNode) and node.kind == "inner" and
+            node.condition is None and node.left_keys):
+        if node.children:
+            return node.with_children([reorder_joins(c)
+                                       for c in node.children])
+        return node
+
+    def keep_written_order():
+        return node.with_children([reorder_joins(c)
+                                   for c in node.children])
+
+    rels, colmap, edges = _flatten_inner_joins(node)
+    if len(rels) < 3:
+        return keep_written_order()
+    est = [estimate_rows(r) for r in rels]
+    if any(e is None for e in est):
+        return keep_written_order()
+    order = _greedy_order(len(rels), edges, est)
+    if order is None or order == list(range(len(rels))):
+        return keep_written_order()
+    rels = [reorder_joins(r) for r in rels]  # recurse below the chain
+    # rebuild left-deep in greedy order; when a relation joins, every
+    # key equality linking it to already-placed relations applies (so
+    # no edge constraint is ever dropped — an edge activates when its
+    # later-placed endpoint arrives)
+    offsets = {order[0]: 0}
+    cur = rels[order[0]]
+    width = len(cur.output_schema())
+    placed = {order[0]}
+    for idx in order[1:]:
+        r = rels[idx]
+        pairs = []
+        for (ri, ci), (rj, cj) in edges:
+            if ri in placed and rj == idx:
+                pairs.append((offsets[ri] + ci, cj))
+            elif rj in placed and ri == idx:
+                pairs.append((offsets[rj] + cj, ci))
+        pairs = list(dict.fromkeys(pairs))
+        cur = pn.JoinNode("inner", cur, r,
+                          [p[0] for p in pairs], [p[1] for p in pairs])
+        offsets[idx] = width
+        width += len(r.output_schema())
+        placed.add(idx)
+    # a projection restores the original column order on top
+    out_schema = node.output_schema()
+    exprs: List[Expression] = []
+    for ri, rel in enumerate(rels):
+        rtypes = rel.output_schema().types
+        for ci in range(len(rtypes)):
+            exprs.append(Alias(
+                BoundReference(offsets[ri] + ci, rtypes[ci]),
+                out_schema.names[len(exprs)]))
+    return pn.ProjectNode(exprs, cur, names=list(out_schema.names))
+
+
 def optimize(plan: pn.PlanNode) -> pn.PlanNode:
-    return rewrite_distinct_aggregates(collapse_project(plan))
+    plan = collapse_project(plan)
+    plan = reorder_joins(plan)
+    # the reorder's restore-projection may now collapse with outer ones
+    plan = collapse_project(plan)
+    return rewrite_distinct_aggregates(plan)

@@ -123,3 +123,88 @@ def test_distinct_aggregate_rewrite():
         grouping_names=["k"])
     conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
     assert_cpu_and_tpu_equal(plan, conf=conf)
+
+
+def _star_tables(seed=3):
+    """A star schema written in a BAD join order: fact joined to the
+    BIGGEST dim first, smallest last."""
+    rng = np.random.default_rng(seed)
+    fact = pn.ScanNode(pn.InMemorySource({
+        "f_d1": rng.integers(0, 50, 5000).astype(np.int64),
+        "f_d2": rng.integers(0, 800, 5000).astype(np.int64),
+        "f_d3": rng.integers(0, 8, 5000).astype(np.int64),
+        "f_v": rng.random(5000)}))
+    d_big = pn.ScanNode(pn.InMemorySource({
+        "b_k": np.arange(800, dtype=np.int64),
+        "b_w": rng.integers(0, 9, 800).astype(np.int64)}))
+    d_mid = pn.ScanNode(pn.InMemorySource({
+        "m_k": np.arange(50, dtype=np.int64),
+        "m_w": rng.integers(0, 9, 50).astype(np.int64)}))
+    d_small = pn.ScanNode(pn.InMemorySource({
+        "s_k": np.arange(8, dtype=np.int64),
+        "s_w": rng.integers(0, 9, 8).astype(np.int64)}))
+    return fact, d_big, d_mid, d_small
+
+
+def _chain_sizes(node):
+    """Build-side estimated sizes down the left-deep inner-join chain."""
+    from spark_rapids_tpu.plan.optimizer import estimate_rows
+
+    sizes = []
+    while isinstance(node, pn.JoinNode) and node.kind == "inner":
+        sizes.append(estimate_rows(node.children[1]))
+        node = node.children[0]
+    return list(reversed(sizes))
+
+
+def test_greedy_join_reorder_star_schema():
+    """Scan-stats reordering (r3 verdict #6): a fact-first greedy order
+    joins the smallest dimension earliest regardless of the written
+    order, and results stay oracle-exact."""
+    fact, d_big, d_mid, d_small = _star_tables()
+    # written order: fact x big x mid x small (worst-first)
+    j1 = pn.JoinNode("inner", fact, d_big, [1], [0])
+    j2 = pn.JoinNode("inner", j1, d_mid, [0], [0])
+    j3 = pn.JoinNode("inner", j2, d_small, [2], [0])
+    out = optimize(j3)
+    # the restore-projection keeps the original column order
+    assert out.output_schema().names == j3.output_schema().names
+    node = out
+    while not isinstance(node, pn.JoinNode):
+        node = node.children[0]
+    sizes = _chain_sizes(node)
+    assert sizes == sorted(sizes), sizes
+    assert sizes[0] == 8 and sizes[-1] == 800
+    assert_cpu_and_tpu_equal(j3, sort=True)
+
+
+def test_join_reorder_keeps_transitive_edges():
+    """Every key equality applies when its later-placed endpoint
+    arrives: reordering may change WHICH join enforces an edge but can
+    never drop one."""
+    rng = np.random.default_rng(9)
+    a = pn.ScanNode(pn.InMemorySource({
+        "a_k": rng.integers(0, 30, 2000).astype(np.int64),
+        "a_v": rng.random(2000)}))
+    b = pn.ScanNode(pn.InMemorySource({
+        "b_k": rng.integers(0, 30, 400).astype(np.int64)}))
+    c = pn.ScanNode(pn.InMemorySource({
+        "c_k": rng.integers(0, 30, 25).astype(np.int64)}))
+    # a.k = b.k and b.k = c.k (c only reachable through b)
+    j = pn.JoinNode("inner", pn.JoinNode("inner", a, b, [0], [0]),
+                    c, [2], [0])
+    out = optimize(j)
+    assert out.output_schema().names == j.output_schema().names
+    assert_cpu_and_tpu_equal(j, sort=True)
+
+
+def test_join_reorder_leaves_outer_and_conditioned_joins():
+    """Only condition-free inner chains reorder; outer joins and
+    residual conditions pin the written order."""
+    fact, d_big, d_mid, _ = _star_tables()
+    j1 = pn.JoinNode("left", fact, d_big, [1], [0])
+    j2 = pn.JoinNode("inner", j1, d_mid, [0], [0])
+    out = optimize(j2)
+    assert isinstance(out, pn.JoinNode)
+    assert out.children[1] is d_mid  # untouched
+    assert_cpu_and_tpu_equal(j2, sort=True)
