@@ -166,23 +166,71 @@ def _ref_only_ordinals(exprs: List[Expression]) -> Optional[List[int]]:
 
 
 def _mesh_source(child: TpuExec):
-    """(mesh_exec, ordinals) when ``child`` is a mesh exec, possibly
-    wrapped in reference-only ProjectExecs; None otherwise. ``ordinals``
-    maps child-schema positions to the mesh exec's output positions."""
-    from spark_rapids_tpu.execs.basic import ProjectExec
+    """(mesh_exec, ops) when ``child`` is a mesh exec wrapped only in
+    chain-preserving operators; None otherwise. ``ops`` is the TOP-DOWN
+    list of operations to replay bottom-up on the mesh result:
+    ("select", ordinals) for reference-only projections, ("filter",
+    filter_exec) for deterministic device-only filters (applied per
+    chip — parallel/filter_step.py — so the chain never gathers).
+    Single-batch coalesces are transparent over a mesh child (there is
+    nothing to re-batch)."""
+    from spark_rapids_tpu.execs.basic import FilterExec, ProjectExec
+    from spark_rapids_tpu.execs.batching import CoalesceBatchesExec
 
-    ords = list(range(len(child.schema.types)))
+    ops: List[Tuple[str, object]] = []
     node = child
-    while isinstance(node, ProjectExec):
-        inner = _ref_only_ordinals(node.projection.exprs)
-        if inner is None:
-            return None
-        ords = [inner[o] for o in ords]
-        node = node.children[0]
+    while True:
+        if isinstance(node, ProjectExec):
+            inner = _ref_only_ordinals(node.projection.exprs)
+            if inner is None:
+                return None
+            ops.append(("select", inner))
+            node = node.children[0]
+        elif isinstance(node, FilterExec) and node.filter.fused and \
+                node.filter.condition.deterministic:
+            ops.append(("filter", node))
+            node = node.children[0]
+        elif isinstance(node, CoalesceBatchesExec):
+            node = node.children[0]
+        else:
+            break
     if isinstance(node, (MeshGroupByExec, MeshShuffledJoinExec,
                          MeshSortExec, MeshWindowExec)):
-        return node, ords
+        return node, ops
     return None
+
+
+_FILTER_STEPS: Dict[Tuple, object] = {}
+
+
+def _apply_mesh_filter(fexec, r: "DistributedBatch",
+                       mesh) -> "DistributedBatch":
+    from spark_rapids_tpu.parallel.filter_step import DistributedFilterStep
+
+    cond = fexec.filter.condition
+    ckey = cond.tree_key()
+    if ckey is None:
+        # un-keyable condition: never share (an id()-based key can be
+        # reused by a new exec after GC and run the WRONG condition)
+        step = getattr(fexec, "_mesh_filter_step", None)
+        if step is None or step.mesh is not mesh or \
+                step.dtypes != tuple(r.dtypes):
+            step = DistributedFilterStep(mesh, r.dtypes, cond)
+            fexec._mesh_filter_step = step
+    else:
+        # mesh identity is part of the key: session_mesh rebuilds the
+        # mesh when the device count changes, and a step compiled for
+        # the old mesh must not see the new sharding
+        key = (id(mesh), ckey, tuple(r.dtypes))
+        step = _FILTER_STEPS.get(key)
+        if step is None:
+            if len(_FILTER_STEPS) >= 256:  # bound like _FUSED_CACHE
+                _FILTER_STEPS.clear()
+            step = DistributedFilterStep(mesh, r.dtypes, cond)
+            _FILTER_STEPS[key] = step
+    od, ov, counts = step(r.datas, r.valids, r.counts)
+    return DistributedBatch(list(od), list(ov), counts, r.cap,
+                            list(r.dtypes), list(r.templates))
 
 
 def _eval_source(child: TpuExec
@@ -194,7 +242,7 @@ def _eval_source(child: TpuExec
     ms = _mesh_source(child)
     if ms is None:
         return None
-    node, ords = ms
+    node, ops = ms
     # record into the mesh child's own metrics: this path bypasses the
     # timed() iterator of execute(), and without it the child's runtime
     # would be misattributed to the consuming exec's self time
@@ -209,10 +257,19 @@ def _eval_source(child: TpuExec
         node.metrics.record(rows, elapsed, child_ns)
     else:
         node.metrics.record(r, elapsed, child_ns)
-    # identity requires FULL width: a strict-prefix projection must
-    # still select, or the consumer sees the mesh exec's extra columns
-    identity = ords == list(range(len(node.schema.types)))
-    return r if identity else r.select(ords)
+    for kind, arg in reversed(ops):
+        if kind == "select":
+            # identity requires FULL width: a strict-prefix projection
+            # must still select, or the consumer sees extra columns
+            width = len(r.dtypes) if isinstance(r, DistributedBatch) \
+                else len(r.columns)
+            if arg != list(range(width)):
+                r = r.select(arg)
+        elif isinstance(r, DistributedBatch):
+            r = _apply_mesh_filter(arg, r, node.mesh)
+        else:
+            r = arg.filter(r)
+    return r
 
 
 def _drain_exec(child: TpuExec) -> ColumnarBatch:

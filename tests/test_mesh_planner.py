@@ -523,3 +523,44 @@ LIMIT 80
     # leaf staging only (join's two scan inputs): the window consumed
     # the join's DistributedBatch without a host round trip
     assert len(calls) == 2, calls
+
+
+def test_mesh_filter_between_mesh_execs_stays_sharded(monkeypatch):
+    """A FilterExec between mesh execs applies per chip (mask + local
+    compaction, parallel/filter_step.py) instead of gathering the chain
+    to host — the explicit-JOIN form plans exactly this shape (the
+    planner keeps the WHERE above the join)."""
+    from spark_rapids_tpu.parallel import execs as pex
+
+    sql = """
+SELECT o_orderkey, l_quantity,
+       ROW_NUMBER() OVER (PARTITION BY o_orderkey
+                          ORDER BY l_quantity DESC, l_extendedprice) AS rn
+FROM lineitem JOIN orders ON l_orderkey = o_orderkey
+WHERE o_orderdate < 9500
+ORDER BY o_orderkey, rn
+LIMIT 80
+"""
+    rng = np.random.default_rng(31)
+    tables = _tpch_tables(rng)
+    mesh_sess = _mesh_session()
+    _register_all(mesh_sess, *tables)
+    calls = []
+    real = pex._shard_batch
+
+    def counting(mesh, batch, dtypes):
+        calls.append(len(dtypes))
+        return real(mesh, batch, dtypes)
+
+    monkeypatch.setattr(pex, "_shard_batch", counting)
+    mesh_df = mesh_sess.sql(sql)
+    plan = mesh_df._exec().tree_string()
+    assert "FilterExec" in plan, plan
+    assert "MeshWindowExec" in plan, plan
+    got = mesh_df.collect()
+
+    plain = _plain_session()
+    _register_all(plain, *tables)
+    want = plain.sql(sql).collect()
+    _assert_frames_equal(got, want)
+    assert len(calls) == 2, calls  # join leaves only; filter ran sharded
