@@ -11,8 +11,17 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 from typing import Optional
+
+# dispatch telemetry must wrap jax.jit BEFORE the compute modules
+# import (module-level @jit decorators capture the binding) — hence
+# this pre-parse ahead of the framework imports below
+if "--dispatch-telemetry" in sys.argv:  # pragma: no cover - CLI path
+    from spark_rapids_tpu.utils import dispatch as _dispatch
+
+    _dispatch.install()
 
 from spark_rapids_tpu.benchmarks import (datagen, mortgage, tpcds, tpch,
                                          tpcxbb)
@@ -84,15 +93,36 @@ class BenchmarkRunner:
             "env": self._env(),
             "iterations": [],
         }
+        from spark_rapids_tpu.utils import dispatch as disp
+
+        telemetry = disp.installed()
         df = None
         for i in range(warmup + iterations):
             plan = plan_fn(self.data_dir)  # fresh plan: no cached blocks
             exec_ = apply_overrides(plan, self.conf)
+            pre = disp.snapshot() if telemetry else None
             t0 = time.perf_counter()
             df = collect(exec_)
             elapsed = time.perf_counter() - t0
             if i >= warmup:
-                result["iterations"].append({"time_sec": elapsed})
+                it_rec = {"time_sec": elapsed}
+                if telemetry:
+                    it_rec["dispatch"] = disp.delta(pre)
+                result["iterations"].append(it_rec)
+        if telemetry and result["iterations"]:
+            # the BASELINE.md-promised split: dispatch_count x RTT vs
+            # time actually spent computing on the device
+            rtt = disp.measure_rtt()
+            last = result["iterations"][-1]
+            count = last["dispatch"]["dispatch_count"]
+            result["dispatch_telemetry"] = {
+                "executable_count": disp.executable_count(),
+                "dispatch_count": count,
+                "dispatch_rtt_s": round(rtt, 4),
+                "est_dispatch_overhead_s": round(count * rtt, 3),
+                "est_on_device_s": round(
+                    max(last["time_sec"] - count * rtt, 0.0), 3),
+            }
         result["query_plan"] = exec_.tree_string()
         result["metrics"] = {
             name: {"rows": m.num_output_rows,
@@ -140,9 +170,27 @@ def main(argv=None):
     p.add_argument("--iterations", type=int, default=3)
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--compare", action="store_true")
+    p.add_argument("--dispatch-telemetry", action="store_true",
+                   help="count jit/eager/transfer dispatches per "
+                        "iteration and report the dispatch-RTT vs "
+                        "on-device split (install happens at module "
+                        "import, before the compute modules load)")
     p.add_argument("--data-dir", default="/tmp/rapids_tpu_tpch")
     p.add_argument("--output", default=None)
     args = p.parse_args(argv)
+    if args.dispatch_telemetry:
+        from spark_rapids_tpu.utils import dispatch as disp
+
+        if not disp.installed():
+            # too late: the compute modules already imported with the
+            # real jax.jit (module-level @jit decorators captured it).
+            # The flag only works as a literal CLI token, which the
+            # import-time pre-parse above matched before the imports.
+            p.error("--dispatch-telemetry must appear verbatim in "
+                    "sys.argv before module import (no abbreviations; "
+                    "for programmatic use call "
+                    "spark_rapids_tpu.utils.dispatch.install() before "
+                    "importing the runner)")
     runner = BenchmarkRunner(args.data_dir, args.sf)
     result = runner.run(args.benchmark, iterations=args.iterations,
                         compare=args.compare, warmup=args.warmup)

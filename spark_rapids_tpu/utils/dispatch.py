@@ -1,0 +1,134 @@
+"""Dispatch telemetry: how many device round trips a query costs.
+
+Under the axon tunnel every dispatch pays ~105 ms fixed overhead
+(BASELINE.md's measured cost model), so full-query wall clock divides
+into ``dispatch_count x RTT`` plus true on-device time — the split the
+reference's per-query methodology reports (docs/benchmarks.md:26-169)
+and BASELINE.md promised. This module counts the three dispatch
+sources:
+
+- executions of framework-jitted programs (``jax.jit`` is wrapped
+  BEFORE the framework modules import, so module-level ``@jit``
+  decorators capture the counting binding),
+- eager op-by-op primitive applications (host-orchestrated glue
+  between jitted kernels — each one is its own tiny executable),
+- explicit device->host transfers (``jax.device_get``).
+
+``install()`` must run before importing any ``spark_rapids_tpu``
+compute module; the benchmark runner does this when
+``--dispatch-telemetry`` is passed. Zero overhead when not installed.
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+_installed = False
+_jit_calls = 0
+_eager_calls = 0
+_transfers = 0
+_compiled_fns: list = []
+
+
+def install() -> None:
+    """Wrap jax.jit / eager primitive application / device_get with
+    counters. Idempotent; affects only this process."""
+    global _installed
+    if _installed:
+        return
+    import jax
+
+    real_jit = jax.jit
+
+    def counting_jit(fn=None, **kw):
+        if fn is None:
+            return lambda f: counting_jit(f, **kw)
+        compiled = real_jit(fn, **kw)
+        _compiled_fns.append(compiled)
+
+        class _Counted:
+            def __call__(self, *a, **k):
+                global _jit_calls
+                _jit_calls += 1
+                return compiled(*a, **k)
+
+            def __getattr__(self, name):
+                return getattr(compiled, name)
+
+        w = _Counted()
+        try:
+            functools.update_wrapper(w, fn)
+        except Exception:
+            pass
+        return w
+
+    jax.jit = counting_jit
+
+    try:
+        from jax._src import dispatch as jdispatch
+
+        real_apply = jdispatch.apply_primitive
+
+        def counting_apply(prim, *a, **k):
+            global _eager_calls
+            _eager_calls += 1
+            return real_apply(prim, *a, **k)
+
+        jdispatch.apply_primitive = counting_apply
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+
+    real_get = jax.device_get
+
+    def counting_get(x):
+        global _transfers
+        _transfers += 1
+        return real_get(x)
+
+    jax.device_get = counting_get
+    _installed = True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def snapshot() -> dict:
+    return {"jit_calls": _jit_calls, "eager_op_calls": _eager_calls,
+            "transfers": _transfers}
+
+
+def delta(before: dict) -> dict:
+    now = snapshot()
+    d = {k: now[k] - before[k] for k in now}
+    d["dispatch_count"] = sum(d.values())
+    return d
+
+
+def executable_count() -> int:
+    """Distinct compiled executables across all jitted entry points
+    (one jit fn compiles once per argument-shape signature)."""
+    total = 0
+    for f in _compiled_fns:
+        try:
+            total += f._cache_size()
+        except Exception:
+            total += 1
+    return total
+
+
+def measure_rtt(samples: int = 5) -> float:
+    """Median wall time of a trivial dispatch — the fixed per-dispatch
+    overhead on this backend (~105 ms over the axon tunnel, ~0 local)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(8)
+    times = []
+    for _ in range(samples + 1):
+        t0 = time.perf_counter()
+        jax.block_until_ready(x + 1)
+        times.append(time.perf_counter() - t0)
+    # MIN, not median: the fixed overhead is a floor; host scheduling
+    # noise only ever inflates a sample
+    return min(times[1:])  # drop the compile
