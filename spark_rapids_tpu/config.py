@@ -390,6 +390,13 @@ PARQUET_DEBUG_DUMP_PREFIX = conf(
     "for offline repro (RapidsConf.scala:575-581 debug dump analogue)."
 ).string_conf.create_with_default("")
 
+ORC_DEBUG_DUMP_PREFIX = conf(
+    "rapids.tpu.sql.orc.debug.dumpPrefix").doc(
+    "When set, copy every ORC file a scan reads under this directory "
+    "for offline repro (the ORC half of the reference's debug dump, "
+    "RapidsConf.scala:583-589)."
+).string_conf.create_with_default("")
+
 FILTER_PUSHDOWN_ENABLED = conf(
     "rapids.tpu.sql.format.pushDownFilters.enabled").doc(
     "Push comparison conjuncts from a Filter above a file scan into the "

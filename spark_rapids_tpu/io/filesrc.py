@@ -111,6 +111,27 @@ class FileSourceBase(DataSource):
         self.__dict__.update(state)
         self._lock = threading.RLock()
 
+    # conf key naming the debug-dump directory for this format (None =
+    # no dump support); subclasses point at their format's key
+    _dump_prefix_conf = None
+
+    def _maybe_debug_dump(self, path: str) -> None:
+        """Copy read inputs for offline repro when the format's
+        debug.dumpPrefix conf is set (the reference's dump-on-read,
+        RapidsConf.scala:575-589)."""
+        import os
+        import shutil
+
+        if self._dump_prefix_conf is None:
+            return
+        prefix = self.conf.get(self._dump_prefix_conf)
+        if not prefix:
+            return
+        os.makedirs(prefix, exist_ok=True)
+        dest = os.path.join(prefix, os.path.basename(path))
+        if not os.path.exists(dest):
+            shutil.copyfile(path, dest)
+
     # -- subclass surface --------------------------------------------------
 
     def _file_schema(self) -> Schema:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from spark_rapids_tpu import config as cfg
 from spark_rapids_tpu.columnar.batch import Schema
 from spark_rapids_tpu.io import arrow_conv
 from spark_rapids_tpu.io.filesrc import FileSourceBase, filter_may_match
@@ -26,11 +27,26 @@ class _StripeSplit:
 
 
 class OrcSource(FileSourceBase):
+    _dump_prefix_conf = cfg.ORC_DEBUG_DUMP_PREFIX
+
     def _file_schema(self) -> Schema:
         from pyarrow import orc
 
         return arrow_conv.schema_from_arrow(
             orc.ORCFile(self.paths[0]).schema, self.columns)
+
+    def estimated_row_count(self):
+        """Tail-metadata row counts (the ORC side of the join-reorder
+        size signal)."""
+        from pyarrow import orc
+
+        if self._est_rows is None:
+            try:
+                self._est_rows = sum(int(orc.ORCFile(p).nrows)
+                                     for p in self.paths)
+            except Exception:  # pragma: no cover - corrupt tail
+                self._est_rows = -1
+        return None if self._est_rows < 0 else self._est_rows
 
     def _build_splits(self) -> list:
         from pyarrow import orc
@@ -87,6 +103,7 @@ class OrcSource(FileSourceBase):
         import pyarrow as pa
         from pyarrow import orc
 
+        self._maybe_debug_dump(desc.path)
         f = orc.ORCFile(desc.path)
         names = list(self.schema().names)
         if not desc.stripes:

@@ -222,17 +222,4 @@ class ParquetSource(FileSourceBase):
         except Exception:  # pragma: no cover - odd footers
             return super().split_origin(split)
 
-    def _maybe_debug_dump(self, path: str) -> None:
-        """Copy read inputs for offline repro when
-        rapids.tpu.sql.parquet.debug.dumpPrefix is set
-        (GpuParquetScan dumpPrefix analogue)."""
-        import os
-        import shutil
-
-        prefix = self.conf.get(cfg.PARQUET_DEBUG_DUMP_PREFIX)
-        if not prefix:
-            return
-        os.makedirs(prefix, exist_ok=True)
-        dest = os.path.join(prefix, os.path.basename(path))
-        if not os.path.exists(dest):
-            shutil.copyfile(path, dest)
+    _dump_prefix_conf = cfg.PARQUET_DEBUG_DUMP_PREFIX

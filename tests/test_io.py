@@ -395,3 +395,24 @@ def test_orc_stats_map_by_file_schema_under_projection(tmp_path):
     df = collect(apply_overrides(pn.ScanNode(src)))
     assert src.chunks_pruned == 0
     assert len(df) == 40_000
+
+
+def test_orc_debug_dump_and_row_estimate(tmp_path):
+    import os
+
+    from pyarrow import orc
+
+    path = tmp_path / "data.orc"
+    orc.write_table(_mixed_table(400), str(path))
+    dump = tmp_path / "dump"
+    src = OrcSource(str(path), conf=RapidsConf(
+        {"rapids.tpu.sql.orc.debug.dumpPrefix": str(dump)}))
+    assert src.estimated_row_count() == 400
+    src.read_host()
+    assert os.listdir(dump) == ["data.orc"]
+
+
+def test_parquet_row_estimate(pq_file):
+    src = ParquetSource(pq_file)
+    est = src.estimated_row_count()
+    assert est is not None and est > 0
