@@ -103,6 +103,17 @@ def key_range_of(col: Column, dtype: dt.DType) -> Optional[Tuple[int, int]]:
     return None
 
 
+# libtpu AOT workaround (2026-07, v5e remote compile): the composite
+# groupby program SEGFAULTS the tpu_compile_helper when it carries >= 7
+# aggregate columns at capacities >= 32768 (the variadic sort and the
+# segmented reductions each compile fine in isolation — only the fused
+# module trips the compiler). Wide aggregate lists split into chunks of
+# <= 6 below this shape boundary; chunks re-sort but are deterministic,
+# so every chunk produces identical group order and the outputs zip.
+_AOT_MAX_AGGS = 6
+_AOT_CHUNK_MIN_CAP = 1 << 15
+
+
 def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
                       aggs: List[AggSpec], dtypes: List[dt.DType],
                       live_mask=None
@@ -112,10 +123,25 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
     cols = [(c.data, c.validity) for c in batch.columns]
     key_ranges = tuple(key_range_of(batch.columns[o], dtypes[o])
                        for o in key_ordinals)
-    out = _groupby(cols, tuple(dtypes), tuple(key_ordinals), tuple(aggs),
-                   batch.num_rows_device(), live_mask=live_mask,
-                   key_ranges=key_ranges)
-    (key_d, key_v), (agg_d, agg_v), num_groups = out
+    if len(aggs) > _AOT_MAX_AGGS and \
+            batch.capacity >= _AOT_CHUNK_MIN_CAP:
+        agg_d, agg_v = [], []
+        key_d = key_v = num_groups = None
+        for lo in range(0, len(aggs), _AOT_MAX_AGGS):
+            chunk = tuple(aggs[lo:lo + _AOT_MAX_AGGS])
+            out = _groupby(cols, tuple(dtypes), tuple(key_ordinals),
+                           chunk, batch.num_rows_device(),
+                           live_mask=live_mask, key_ranges=key_ranges)
+            (ck_d, ck_v), (ca_d, ca_v), ng = out
+            if key_d is None:
+                key_d, key_v, num_groups = ck_d, ck_v, ng
+            agg_d.extend(ca_d)
+            agg_v.extend(ca_v)
+    else:
+        out = _groupby(cols, tuple(dtypes), tuple(key_ordinals),
+                       tuple(aggs), batch.num_rows_device(),
+                       live_mask=live_mask, key_ranges=key_ranges)
+        (key_d, key_v), (agg_d, agg_v), num_groups = out
     out_cols: List[Column] = []
     out_types: List[dt.DType] = []
     for i, ord_ in enumerate(key_ordinals):

@@ -584,3 +584,41 @@ def test_parquet_footer_stats_feed_packed_keys(tmp_path):
     want = (pd.DataFrame({"k": ks, "v": rng.random(500) * 0 + 1})
             .groupby("k").size())
     assert got["k"].tolist() == sorted(set(ks.tolist()))
+
+
+def test_groupby_wide_agg_list_chunks():
+    """>=7 aggregate columns at capacity >=32768 split into chunks of 6
+    (the libtpu AOT segfault workaround, ops/groupby.py _AOT_MAX_AGGS):
+    chunked results must be identical to the oracle — every chunk
+    re-sorts deterministically so group order matches across chunks."""
+    import jax
+    import pandas as pd
+
+    from spark_rapids_tpu.ops import groupby as gb
+
+    rng = np.random.default_rng(13)
+    cap, n, nagg = 1 << 15, 30_000, 8
+    keys = rng.integers(0, 700, cap).astype(np.int64)
+    live = np.arange(cap) < n
+    cols = [Column(dt.INT64, jnp.asarray(keys), jnp.asarray(live))]
+    vals = []
+    for i in range(nagg):
+        v = rng.integers(-50, 100, cap).astype(np.int64)
+        vals.append(v)
+        cols.append(Column(dt.INT64, jnp.asarray(v), None))
+    b = ColumnarBatch(cols, n)
+    aggs = [gb.AggSpec("sum", i + 1) for i in range(nagg)]
+    assert nagg > gb._AOT_MAX_AGGS and cap >= gb._AOT_CHUNK_MIN_CAP
+    out, _types = gb.groupby_aggregate(b, [0], aggs,
+                                       [dt.INT64] * (nagg + 1))
+    ng = out.realized_num_rows()
+    pdf = pd.DataFrame({"k": keys[:n],
+                        **{f"a{i}": vals[i][:n] for i in range(nagg)}})
+    want = pdf.groupby("k").sum().sort_index()
+    assert ng == len(want)
+    k = np.asarray(jax.device_get(out.columns[0].data))[:ng]
+    order = np.argsort(k)
+    for i in range(nagg):
+        got = np.asarray(jax.device_get(out.columns[1 + i].data))[:ng]
+        np.testing.assert_array_equal(got[order],
+                                      want[f"a{i}"].to_numpy())
