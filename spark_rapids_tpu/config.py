@@ -390,6 +390,23 @@ PARQUET_DEBUG_DUMP_PREFIX = conf(
     "for offline repro (RapidsConf.scala:575-581 debug dump analogue)."
 ).string_conf.create_with_default("")
 
+PYTHON_WORKER_PROCESS = conf(
+    "rapids.tpu.python.worker.process.enabled").doc(
+    "Run pandas UDFs (mapInPandas / applyInPandas / cogroup / "
+    "window-in-pandas / pandas aggregates / scalar pandas UDFs) in "
+    "POOLED SEPARATE worker processes instead of in-process — the "
+    "reference's worker/daemon model (python/rapids/worker.py:22-50, "
+    "daemon.py:36-60): on the accelerated execs a crashing or leaking "
+    "UDF can no longer take the engine with it, and workers are pinned "
+    "off the TPU. (CPU-fallback pandas execs still run in-process.)"
+).boolean_conf.create_with_default(False)
+
+PYTHON_WORKER_SLOTS = conf(
+    "rapids.tpu.python.worker.processes").doc(
+    "Worker processes in the pandas-UDF pool (checkout blocks, the "
+    "process-level PythonWorkerSemaphore)."
+).int_conf.create_with_default(2)
+
 ORC_DEBUG_DUMP_PREFIX = conf(
     "rapids.tpu.sql.orc.debug.dumpPrefix").doc(
     "When set, copy every ORC file a scan reads under this directory "

@@ -10,6 +10,7 @@ Python evaluation when partitions run in parallel threads).
 """
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Callable, Iterator, Optional
 
@@ -21,6 +22,14 @@ from spark_rapids_tpu.execs import interop
 from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.plan.nodes import PlanNode
 from spark_rapids_tpu.utils.tracing import TraceRange
+
+
+def run_udf(conf, fn, *args):
+    # lazy: the udf package pulls the CPU engine, which imports back
+    # into this module for the pandas plan nodes (circular at top level)
+    from spark_rapids_tpu.udf.pyworker import run_udf as _run
+
+    return _run(conf, fn, *args)
 
 
 class MapInPandasNode(PlanNode):
@@ -84,9 +93,11 @@ def _pandas_to_host(df, schema: Schema):
 
 
 class MapInPandasExec(TpuExec):
-    def __init__(self, node: MapInPandasNode, child: TpuExec):
+    def __init__(self, node: MapInPandasNode, child: TpuExec,
+                 conf=None):
         super().__init__([child], node.output_schema())
         self.node = node
+        self.conf = conf
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         child_schema = self.node.children[0].output_schema()
@@ -100,7 +111,7 @@ class MapInPandasExec(TpuExec):
                 try:
                     with TraceRange("MapInPandasExec.python"):
                         pdf = b.to_pandas(child_schema)
-                        out = self.node.fn(pdf)
+                        out = run_udf(self.conf, self.node.fn, pdf)
                         data, validity = _pandas_to_host(out, out_schema)
                 finally:
                     PythonWorkerSemaphore.release()
@@ -148,9 +159,11 @@ class GroupedMapInPandasExec(TpuExec):
     """Consumes a hash-exchanged child (the planner co-partitions by the
     grouping keys, so each group lives wholly in one partition)."""
 
-    def __init__(self, node: GroupedMapInPandasNode, child: TpuExec):
+    def __init__(self, node: GroupedMapInPandasNode, child: TpuExec,
+                 conf=None):
         super().__init__([child], node.output_schema())
         self.node = node
+        self.conf = conf
 
     @property
     def children_coalesce_goal(self):
@@ -176,8 +189,9 @@ class GroupedMapInPandasExec(TpuExec):
             try:
                 with TraceRange("GroupedMapInPandasExec.python"):
                     pdf = b.to_pandas(child_schema)
-                    out = _apply_grouped(pdf, key_names, self.node.fn,
-                                         out_schema)
+                    out = run_udf(self.conf, functools.partial(
+                        _apply_grouped, key_names=key_names,
+                        fn=self.node.fn, out_schema=out_schema), pdf)
                     data, validity = _pandas_to_host(out, out_schema)
             finally:
                 PythonWorkerSemaphore.release()
@@ -250,9 +264,10 @@ class CoGroupedMapInPandasExec(TpuExec):
     planner, so matching groups meet in the same partition."""
 
     def __init__(self, node: CoGroupedMapInPandasNode, left: TpuExec,
-                 right: TpuExec):
+                 right: TpuExec, conf=None):
         super().__init__([left, right], node.output_schema())
         self.node = node
+        self.conf = conf
 
     @property
     def children_coalesce_goal(self):
@@ -281,9 +296,11 @@ class CoGroupedMapInPandasExec(TpuExec):
             PythonWorkerSemaphore.acquire()
             try:
                 with TraceRange("CoGroupedMapInPandasExec.python"):
-                    out = _apply_cogrouped(
-                        lb.to_pandas(lschema), rb.to_pandas(rschema),
-                        lkeys, rkeys, self.node.fn, out_schema)
+                    out = run_udf(
+                        self.conf, functools.partial(
+                            _apply_cogrouped, lkeys=lkeys, rkeys=rkeys,
+                            fn=self.node.fn, out_schema=out_schema),
+                        lb.to_pandas(lschema), rb.to_pandas(rschema))
                     data, validity = _pandas_to_host(out, out_schema)
             finally:
                 PythonWorkerSemaphore.release()
@@ -342,16 +359,18 @@ def _sort_group_by_specs(g, child_schema: Schema, order_specs):
     return out[g.columns]
 
 
-def _apply_window_in_pandas(pdf, node: "WindowInPandasNode",
-                            child_schema: Schema):
-    """Shared TPU/CPU body: group -> sort -> fn -> align back by index."""
+def _apply_window_in_pandas(pdf, partition_ordinals, order_specs, fn,
+                            out_name, child_schema: Schema):
+    """Shared TPU/CPU body: group -> sort -> fn -> align back by index.
+    Takes plain fields (not the plan node) so a worker process never
+    deserializes the child plan subtree."""
     import pandas as pd
 
-    key_names = [child_schema.names[o] for o in node.partition_ordinals]
+    key_names = [child_schema.names[o] for o in partition_ordinals]
     out = pd.Series([None] * len(pdf), index=pdf.index, dtype=object)
     for _, g in pdf.groupby(key_names, dropna=False, sort=False):
-        g = _sort_group_by_specs(g, child_schema, node.order_specs)
-        vals = node.fn(g.reset_index(drop=True))
+        g = _sort_group_by_specs(g, child_schema, order_specs)
+        vals = fn(g.reset_index(drop=True))
         vals = list(vals)
         if len(vals) != len(g):
             raise ValueError(
@@ -359,7 +378,7 @@ def _apply_window_in_pandas(pdf, node: "WindowInPandasNode",
                 f"{len(g)}-row partition")
         out.loc[g.index] = vals
     result = pdf.copy()
-    result[node.out_name] = out
+    result[out_name] = out
     return result
 
 
@@ -367,9 +386,11 @@ class WindowInPandasExec(TpuExec):
     """Child is hash-co-partitioned on the partition keys by the planner
     (each window partition lives wholly in one task partition)."""
 
-    def __init__(self, node: WindowInPandasNode, child: TpuExec):
+    def __init__(self, node: WindowInPandasNode, child: TpuExec,
+                 conf=None):
         super().__init__([child], node.output_schema())
         self.node = node
+        self.conf = conf
 
     @property
     def children_coalesce_goal(self):
@@ -393,8 +414,12 @@ class WindowInPandasExec(TpuExec):
             try:
                 with TraceRange("WindowInPandasExec.python"):
                     pdf = b.to_pandas(child_schema)
-                    out = _apply_window_in_pandas(pdf, self.node,
-                                                  child_schema)
+                    out = run_udf(self.conf, functools.partial(
+                        _apply_window_in_pandas,
+                        partition_ordinals=self.node.partition_ordinals,
+                        order_specs=self.node.order_specs,
+                        fn=self.node.fn, out_name=self.node.out_name,
+                        child_schema=child_schema), pdf)
                     data, validity = _pandas_to_host(out, out_schema)
             finally:
                 PythonWorkerSemaphore.release()
@@ -407,7 +432,9 @@ def execute_window_in_pandas_cpu(node: WindowInPandasNode):
 
     child = execute_cpu(node.children[0])
     child_schema = node.children[0].output_schema()
-    out = _apply_window_in_pandas(child.to_pandas(), node, child_schema)
+    out = _apply_window_in_pandas(
+        child.to_pandas(), node.partition_ordinals, node.order_specs,
+        node.fn, node.out_name, child_schema)
     return _cpu_frame_from_pandas(out, node.output_schema())
 
 
@@ -433,12 +460,11 @@ class ArrowEvalPythonNode(PlanNode):
         return f"ArrowEvalPython[{len(self.udfs)} udfs]"
 
 
-def _apply_scalar_udfs(pdf, node: "ArrowEvalPythonNode",
-                       child_schema: Schema):
+def _apply_scalar_udfs(pdf, udfs, child_schema: Schema):
     import pandas as pd
 
     out = pdf.copy()
-    for fn, ordinals, name, _dtype in node.udfs:
+    for fn, ordinals, name, _dtype in udfs:
         args = [pdf[child_schema.names[o]] for o in ordinals]
         r = pd.Series(fn(*args))
         if len(r) != len(pdf):
@@ -450,9 +476,11 @@ def _apply_scalar_udfs(pdf, node: "ArrowEvalPythonNode",
 
 
 class ArrowEvalPythonExec(TpuExec):
-    def __init__(self, node: ArrowEvalPythonNode, child: TpuExec):
+    def __init__(self, node: ArrowEvalPythonNode, child: TpuExec,
+                 conf=None):
         super().__init__([child], node.output_schema())
         self.node = node
+        self.conf = conf
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         child_schema = self.node.children[0].output_schema()
@@ -466,8 +494,9 @@ class ArrowEvalPythonExec(TpuExec):
                 try:
                     with TraceRange("ArrowEvalPythonExec.python"):
                         pdf = b.to_pandas(child_schema)
-                        out = _apply_scalar_udfs(pdf, self.node,
-                                                 child_schema)
+                        out = run_udf(self.conf, functools.partial(
+                            _apply_scalar_udfs, udfs=self.node.udfs,
+                            child_schema=child_schema), pdf)
                         data, validity = _pandas_to_host(out, out_schema)
                 finally:
                     PythonWorkerSemaphore.release()
@@ -481,7 +510,7 @@ def execute_arrow_eval_python_cpu(node: ArrowEvalPythonNode):
 
     child = execute_cpu(node.children[0])
     child_schema = node.children[0].output_schema()
-    out = _apply_scalar_udfs(child.to_pandas(), node, child_schema)
+    out = _apply_scalar_udfs(child.to_pandas(), node.udfs, child_schema)
     return _cpu_frame_from_pandas(out, node.output_schema())
 
 
@@ -506,16 +535,15 @@ class AggregateInPandasNode(PlanNode):
                 f"{getattr(self.fn, '__name__', 'fn')}]")
 
 
-def _apply_agg_in_pandas(pdf, node: "AggregateInPandasNode",
-                         child_schema: Schema):
+def _apply_agg_in_pandas(pdf, grouping_ordinals, fn,
+                         out_schema: Schema, child_schema: Schema):
     import pandas as pd
 
-    key_names = [child_schema.names[o] for o in node.grouping_ordinals]
-    out_schema = node.output_schema()
+    key_names = [child_schema.names[o] for o in grouping_ordinals]
     rows = []
     for key, g in pdf.groupby(key_names, dropna=False, sort=False):
         key = key if isinstance(key, tuple) else (key,)
-        vals = node.fn(g.reset_index(drop=True))
+        vals = fn(g.reset_index(drop=True))
         if not isinstance(vals, (tuple, list)):
             vals = (vals,)
         rows.append(tuple(key) + tuple(vals))
@@ -528,9 +556,11 @@ def _apply_agg_in_pandas(pdf, node: "AggregateInPandasNode",
 class AggregateInPandasExec(TpuExec):
     """Child hash-co-partitioned on the keys by the planner."""
 
-    def __init__(self, node: AggregateInPandasNode, child: TpuExec):
+    def __init__(self, node: AggregateInPandasNode, child: TpuExec,
+                 conf=None):
         super().__init__([child], node.output_schema())
         self.node = node
+        self.conf = conf
 
     @property
     def children_coalesce_goal(self):
@@ -553,9 +583,13 @@ class AggregateInPandasExec(TpuExec):
             PythonWorkerSemaphore.acquire()
             try:
                 with TraceRange("AggregateInPandasExec.python"):
-                    out = _apply_agg_in_pandas(
-                        b.to_pandas(child_schema), self.node,
-                        child_schema)
+                    out = run_udf(
+                        self.conf, functools.partial(
+                            _apply_agg_in_pandas,
+                            grouping_ordinals=self.node.grouping_ordinals,
+                            fn=self.node.fn, out_schema=out_schema,
+                            child_schema=child_schema),
+                        b.to_pandas(child_schema))
                     data, validity = _pandas_to_host(out, out_schema)
             finally:
                 PythonWorkerSemaphore.release()
@@ -568,7 +602,9 @@ def execute_agg_in_pandas_cpu(node: AggregateInPandasNode):
 
     child = execute_cpu(node.children[0])
     child_schema = node.children[0].output_schema()
-    out = _apply_agg_in_pandas(child.to_pandas(), node, child_schema)
+    out = _apply_agg_in_pandas(
+        child.to_pandas(), node.grouping_ordinals, node.fn,
+        node.output_schema(), child_schema)
     return _cpu_frame_from_pandas(out, node.output_schema())
 
 

@@ -833,7 +833,8 @@ class _MapInPandasRule(NodeRule):
     def convert(self, meta, children):
         from spark_rapids_tpu.execs.python_exec import MapInPandasExec
 
-        return MapInPandasExec(meta.node, children[0])
+        return MapInPandasExec(meta.node, children[0],
+                               conf=meta.conf)
 
 
 class _CoGroupedMapRule(NodeRule):
@@ -852,7 +853,8 @@ class _CoGroupedMapRule(NodeRule):
             right = exchange.ShuffleExchangeExec(
                 ("hash", list(node.right_ordinals)), parts, right,
                 task_threads=tt)
-        return CoGroupedMapInPandasExec(node, left, right)
+        return CoGroupedMapInPandasExec(node, left, right,
+                                        conf=meta.conf)
 
 
 class _GroupedMapRule(NodeRule):
@@ -867,14 +869,16 @@ class _GroupedMapRule(NodeRule):
             child = _adaptive_read(exchange.ShuffleExchangeExec(
                 ("hash", list(node.grouping_ordinals)), parts, child),
                 meta.conf)
-        return GroupedMapInPandasExec(node, child)
+        return GroupedMapInPandasExec(node, child,
+                                      conf=meta.conf)
 
 
 class _ArrowEvalPythonRule(NodeRule):
     def convert(self, meta, children):
         from spark_rapids_tpu.execs.python_exec import ArrowEvalPythonExec
 
-        return ArrowEvalPythonExec(meta.node, children[0])
+        return ArrowEvalPythonExec(meta.node, children[0],
+                                   conf=meta.conf)
 
 
 class _AggInPandasRule(NodeRule):
@@ -888,7 +892,8 @@ class _AggInPandasRule(NodeRule):
             child = _adaptive_read(exchange.ShuffleExchangeExec(
                 ("hash", list(node.grouping_ordinals)), parts, child),
                 meta.conf)
-        return AggregateInPandasExec(node, child)
+        return AggregateInPandasExec(node, child,
+                                     conf=meta.conf)
 
 
 class _WindowInPandasRule(NodeRule):
@@ -902,7 +907,7 @@ class _WindowInPandasRule(NodeRule):
             child = _adaptive_read(exchange.ShuffleExchangeExec(
                 ("hash", list(node.partition_ordinals)), parts, child),
                 meta.conf)
-        return WindowInPandasExec(node, child)
+        return WindowInPandasExec(node, child, conf=meta.conf)
 
 
 def _register_io_rules():

@@ -1,0 +1,210 @@
+"""Out-of-process pandas-UDF workers.
+
+The reference bootstraps SEPARATE Python worker processes with device
+pools pre-initialized and streams Arrow batches to them
+(python/rapids/worker.py:22-50 patches the worker main; daemon.py:36-60
+pre-forks them); the in-process default here is faster for small UDFs
+but shares the interpreter — a UDF that leaks, crashes, or holds the
+GIL hurts the engine. With ``rapids.tpu.python.worker.process.enabled``
+the pandas function runs in a pooled worker process instead:
+
+- workers are persistent subprocesses running this module's loop,
+  speaking length-prefixed cloudpickle frames over stdin/stdout (the
+  pipe is the Arrow-stream analogue; pandas frames pickle efficiently),
+- a function ships ONCE per worker, cached by content digest (the
+  serialized-lineage model: later calls send only the payload),
+- checkout from the pool bounds concurrency exactly like
+  PythonWorkerSemaphore bounds the in-process path,
+- a worker that dies mid-call surfaces the error and is replaced on
+  the next checkout; the engine process never crashes with it.
+
+Workers force ``JAX_PLATFORMS=cpu`` so they can never contend for the
+attached TPU (the reference's workers get their own memory pool slice
+for the same reason).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+_HDR = struct.Struct("<I")
+_FN_CACHE_MAX = 64  # distinct UDFs cached per worker before reset
+
+
+def _send(pipe, payload: bytes) -> None:
+    pipe.write(_HDR.pack(len(payload)))
+    pipe.write(payload)
+    pipe.flush()
+
+
+def _recv(pipe) -> Optional[bytes]:
+    hdr = pipe.read(_HDR.size)
+    if len(hdr) < _HDR.size:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    return pipe.read(n)
+
+
+class _Worker:
+    def __init__(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_tpu.udf.pyworker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+        # digests of functions this worker already holds; BOTH sides
+        # bound this cache with the same clear-on-add-when-full rule, so
+        # contents stay in lockstep (see _worker_main)
+        self._shipped = set()
+        # pipe EOF can be observed BEFORE waitpid sees the exit: a dead
+        # worker must never pass an `alive` check in that window
+        self._dead = False
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead and self.proc.poll() is None
+
+    def run(self, fn, args):
+        import hashlib
+
+        import cloudpickle
+
+        # keyed by CONTENT, not id(): CPython reuses ids of collected
+        # functions, which would make the worker run a stale cached fn
+        blob = cloudpickle.dumps(fn)
+        fn_id = hashlib.sha1(blob).hexdigest()
+        fn_bytes = None if fn_id in self._shipped else blob
+        try:
+            _send(self.proc.stdin,
+                  cloudpickle.dumps((fn_id, fn_bytes, args)))
+        except (BrokenPipeError, OSError) as e:
+            self._dead = True
+            raise RuntimeError(f"python worker died: {e}")
+        if fn_bytes is not None and len(self._shipped) >= _FN_CACHE_MAX:
+            self._shipped.clear()
+        self._shipped.add(fn_id)
+        reply = _recv(self.proc.stdout)
+        if reply is None:
+            self._dead = True
+            raise RuntimeError(
+                "python worker died mid-call (exit "
+                f"{self.proc.poll()})")
+        import pickle
+
+        status, payload = pickle.loads(reply)
+        if status != "ok":
+            raise RuntimeError(f"python worker UDF failed:\n{payload}")
+        return payload
+
+    def close(self):
+        try:
+            self.proc.stdin.close()
+            self.proc.wait(timeout=5)
+        except Exception:
+            self.proc.kill()
+
+
+class PythonWorkerPool:
+    """Fixed-size pool; checkout blocks (the process-level analogue of
+    PythonWorkerSemaphore.scala:144's slot bound)."""
+
+    def __init__(self, n: int):
+        self.n = max(n, 1)  # 0/negative would hang every checkout
+        self._q: "queue.Queue[_Worker]" = queue.Queue()
+        for _ in range(self.n):
+            self._q.put(_Worker())
+
+    def run(self, fn, *args):
+        w = self._q.get()
+        if not w.alive:  # replace a worker that crashed last call
+            w.close()
+            w = _Worker()
+        try:
+            return w.run(fn, args)
+        finally:
+            if not w.alive:
+                w.close()
+                w = _Worker()  # keep the pool at size even on failure
+            self._q.put(w)
+
+    def shutdown(self):
+        while True:
+            try:
+                self._q.get_nowait().close()
+            except queue.Empty:
+                break
+
+
+_POOL: Optional[PythonWorkerPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def run_udf(conf, fn, *args):
+    """The single UDF seam: in-process call by default; through the
+    worker-process pool when the session enables it. Wrap per-query
+    constants (the user fn, schemas, key names) into ``fn`` via
+    functools.partial so they ship ONCE per worker — only the pandas
+    payload should travel in ``args`` per batch."""
+    from spark_rapids_tpu import config as cfg
+
+    if conf is None or not conf.get(cfg.PYTHON_WORKER_PROCESS):
+        return fn(*args)
+    global _POOL
+    want = max(conf.get(cfg.PYTHON_WORKER_SLOTS), 1)
+    with _POOL_LOCK:
+        if _POOL is None or _POOL.n != want:
+            if _POOL is not None:  # a later session resized the pool
+                _POOL.shutdown()
+            _POOL = PythonWorkerPool(want)
+            import atexit
+
+            atexit.register(shutdown_pool)
+    return _POOL.run(fn, *args)
+
+
+def shutdown_pool() -> None:
+    global _POOL
+    if _POOL is not None:
+        _POOL.shutdown()
+        _POOL = None
+
+
+def _worker_main() -> None:  # pragma: no cover - subprocess body
+    import pickle
+
+    import cloudpickle
+
+    fns = {}
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    # stray prints from user UDFs must not corrupt the frame protocol
+    sys.stdout = sys.stderr
+    while True:
+        msg = _recv(stdin)
+        if msg is None:
+            return
+        try:
+            fn_id, fn_bytes, args = cloudpickle.loads(msg)
+            if fn_bytes is not None:
+                # same clear-on-add-when-full rule as _Worker._shipped:
+                # identical add sequences keep both caches in lockstep
+                if len(fns) >= _FN_CACHE_MAX:
+                    fns.clear()
+                fns[fn_id] = cloudpickle.loads(fn_bytes)
+            result = fns[fn_id](*args)
+            out = pickle.dumps(("ok", result))
+        except Exception:
+            import traceback
+
+            out = pickle.dumps(("err", traceback.format_exc()))
+        _send(stdout, out)
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    _worker_main()

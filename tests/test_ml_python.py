@@ -380,3 +380,72 @@ def test_window_in_pandas_nulls_first_ordering():
         record, "pos", dt.INT64, plan.children[0])
     execute_cpu(plan2)
     assert seen == [[9.0, 5.0, 3.0, None]]
+
+
+def test_pandas_udf_in_worker_process():
+    """rapids.tpu.python.worker.process.enabled runs the UDF in a pooled
+    SEPARATE process (python/rapids/worker.py + daemon.py model): the
+    UDF observes a different pid, closures ship via cloudpickle, and
+    results match the in-process path."""
+    import os
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu.api import Session
+
+    parent = os.getpid()
+    bias = 3.5  # closure capture crosses the process boundary
+
+    def fn(pdf):
+        return pd.DataFrame({"y": pdf["x"] * 2 + bias,
+                             "pid": [os.getpid()] * len(pdf)})
+
+    from spark_rapids_tpu.columnar.batch import Schema
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    schema = Schema(["y", "pid"], [dt.FLOAT64, dt.INT64])
+    s = Session({"rapids.tpu.python.worker.process.enabled": True,
+                 "rapids.tpu.python.worker.processes": 1,
+                 "rapids.tpu.sql.exec.MapInPandasNode": True})
+    df = s.create_dataframe(pd.DataFrame(
+        {"x": np.arange(50, dtype=np.float64)}))
+    out = df.map_in_pandas(fn, schema).collect()
+    assert (out["y"].to_numpy() ==
+            np.arange(50, dtype=np.float64) * 2 + bias).all()
+    pids = set(out["pid"])
+    assert len(pids) == 1 and parent not in pids, \
+        "UDF must have run in a separate worker process"
+
+
+def test_pandas_udf_worker_crash_isolated():
+    """A UDF that kills its interpreter surfaces as an error — the
+    ENGINE process survives, the pool replaces the dead worker, and the
+    next query succeeds."""
+    import os
+
+    import numpy as np
+    import pandas as pd
+    import pytest
+
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.columnar.batch import Schema
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    schema = Schema(["y"], [dt.FLOAT64])
+
+    def boom(pdf):
+        os._exit(17)
+
+    def fine(pdf):
+        return pd.DataFrame({"y": pdf["x"] + 1})
+
+    s = Session({"rapids.tpu.python.worker.process.enabled": True,
+                 "rapids.tpu.python.worker.processes": 1,
+                 "rapids.tpu.sql.exec.MapInPandasNode": True})
+    df = s.create_dataframe(pd.DataFrame(
+        {"x": np.arange(10, dtype=np.float64)}))
+    with pytest.raises(RuntimeError, match="worker died"):
+        df.map_in_pandas(boom, schema).collect()
+    out = df.map_in_pandas(fine, schema).collect()
+    assert out["y"].tolist() == [float(i + 1) for i in range(10)]
