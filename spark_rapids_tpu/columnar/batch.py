@@ -95,6 +95,18 @@ class ColumnarBatch:
             self._num_rows = int(jax.device_get(self._num_rows))
         return self._num_rows
 
+    @staticmethod
+    def realize_counts(batches: "List[ColumnarBatch]") -> List[int]:
+        """Realize MANY batches' lazy counts in ONE device_get — N
+        separate syncs each pay the full tunnel RTT (~105 ms)."""
+        lazy = [b for b in batches
+                if not isinstance(b._num_rows, int)]
+        if lazy:
+            vals = jax.device_get([b._num_rows for b in lazy])
+            for b, v in zip(lazy, vals):
+                b._num_rows = int(v)
+        return [b._num_rows for b in batches]
+
     def row_mask(self) -> jax.Array:
         """lane-mask of live rows: iota < num_rows."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < \
@@ -156,11 +168,24 @@ class ColumnarBatch:
     def to_pandas(self, schema: Optional[Schema] = None):
         import pandas as pd
 
-        n = self.realized_num_rows()
+        # ONE device->host transfer for the whole batch: every column's
+        # data + validity and the (possibly lazy) row count ride a
+        # single device_get — per-column fetches each pay the full
+        # tunnel RTT (~105 ms on the axon backend)
+        import jax
+
+        fetched = jax.device_get((
+            [c.data for c in self.columns],
+            [c.validity for c in self.columns],
+            None if isinstance(self._num_rows, int) else self._num_rows))
+        datas, valids, n_dev = fetched
+        if n_dev is not None:
+            self._num_rows = int(n_dev)
+        n = self._num_rows
         data = {}
         for i, c in enumerate(self.columns):
             name = schema.names[i] if schema else f"c{i}"
-            values, validity = c.to_numpy(n)
+            values, validity = c._decode_host(datas[i], valids[i], n)
             if validity is not None and not isinstance(c, StringColumn):
                 # preserve SQL NULLs: use pandas nullable / object via mask
                 values = values.astype(object)

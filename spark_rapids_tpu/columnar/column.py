@@ -175,10 +175,17 @@ class Column:
     def to_numpy(self, num_rows: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Returns (values, validity) trimmed to num_rows; validity None if
         all-valid. String columns return an object array of str/None."""
-        data = np.asarray(jax.device_get(self.data[:num_rows] if num_rows <= self.capacity else self.data))[:num_rows]
-        validity = None
-        if self.validity is not None:
-            validity = np.asarray(jax.device_get(self.validity))[:num_rows]
+        data, validity = jax.device_get((self.data, self.validity))
+        return self._decode_host(data, validity, num_rows)
+
+    def _decode_host(self, data, validity, num_rows: int
+                     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Host-side tail of to_numpy over ALREADY-FETCHED arrays —
+        batch.to_pandas prefetches every column in ONE device_get (each
+        separate fetch pays the full tunnel RTT)."""
+        data = np.asarray(data)[:num_rows]
+        if validity is not None:
+            validity = np.asarray(validity)[:num_rows]
             if bool(validity.all()):
                 validity = None
         return data, validity
@@ -232,8 +239,9 @@ class StringColumn(Column):
         return StringColumn(jnp.asarray(codes),
                             dictionary.astype(object), validity)
 
-    def to_numpy(self, num_rows: int):
-        codes, validity = super().to_numpy(num_rows)
+    def _decode_host(self, data, validity, num_rows: int):
+        codes, validity = Column._decode_host(self, data, validity,
+                                              num_rows)
         if len(self.dictionary):
             out = self.dictionary[np.clip(codes, 0, len(self.dictionary) - 1)]
         else:

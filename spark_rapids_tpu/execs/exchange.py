@@ -136,9 +136,10 @@ class ShuffleExchangeExec(TpuExec):
                 from spark_rapids_tpu.execs.base import run_partitions
 
                 def map_task(in_p: int):
+                    bs = list(self.children[0].execute(in_p))
+                    ColumnarBatch.realize_counts(bs)  # one sync per task
                     return self._write_blocks(
-                        b for b in self.children[0].execute(in_p)
-                        if b.realized_num_rows() > 0)
+                        b for b in bs if b.realized_num_rows() > 0)
 
                 # merge per-map outputs in PARTITION order, not thread
                 # completion order: float aggregates downstream must see

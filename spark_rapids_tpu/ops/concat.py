@@ -25,37 +25,76 @@ def concat_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
     if len(batches) == 1:
         return batches[0]
     ncols = batches[0].num_columns
-    counts = [b.realized_num_rows() for b in batches]
+    counts = ColumnarBatch.realize_counts(batches)  # one sync, not N
     total = sum(counts)
     out_cap = bucket_capacity(total)
 
-    out_cols: List[Column] = []
+    # strings first: dictionary unification is host-side and replaces
+    # the code arrays the kernel consumes
+    per_col_cols: List[List[Column]] = []
+    dictionaries: List = []
     for ci in range(ncols):
         cols = [b.columns[ci] for b in batches]
         if isinstance(cols[0], StringColumn):
             cols = unify_dictionaries(cols)  # type: ignore[arg-type]
-            dictionary = cols[0].dictionary
+            dictionaries.append(cols[0].dictionary)
         else:
-            dictionary = None
-        any_validity = any(c.validity is not None for c in cols)
-        data = jnp.zeros(out_cap, dtype=cols[0].data.dtype)
-        validity = jnp.zeros(out_cap, dtype=bool) if any_validity else None
-        off = 0
-        for c, n in zip(cols, counts):
-            if n == 0:
-                continue
-            src = c.with_capacity(out_cap)
-            data = _place(data, src.data, off, n)
-            if any_validity:
-                v = src.validity if src.validity is not None else \
-                    jnp.ones(out_cap, dtype=bool)
-                validity = _place(validity, v, off, n)
-            off += n
-        if dictionary is not None:
-            out_cols.append(StringColumn(data, dictionary, validity))
+            dictionaries.append(None)
+        per_col_cols.append(cols)
+
+    # ONE jitted program assembles every column (the per-placement
+    # eager dispatches - capacity slices + dynamic_update_slices - each
+    # paid a device round trip; offsets/counts ride as traced scalars
+    # so one compilation serves every count pattern at this signature)
+    datas = tuple(tuple(c.data for c in cols) for cols in per_col_cols)
+    valids = tuple(tuple(c.validity for c in cols)
+                   for cols in per_col_cols)
+    import numpy as np
+
+    offs = np.zeros(len(batches), dtype=np.int64)
+    np.cumsum(counts[:-1], out=offs[1:])
+    out_d, out_v = _concat_kernel(datas, valids,
+                                  jnp.asarray(offs),
+                                  jnp.asarray(np.asarray(counts,
+                                                         dtype=np.int64)),
+                                  out_cap)
+    out_cols: List[Column] = []
+    for ci in range(ncols):
+        if dictionaries[ci] is not None:
+            out_cols.append(StringColumn(out_d[ci], dictionaries[ci],
+                                         out_v[ci]))
         else:
-            out_cols.append(Column(cols[0].dtype, data, validity))
+            out_cols.append(Column(per_col_cols[ci][0].dtype, out_d[ci],
+                                   out_v[ci]))
     return ColumnarBatch(out_cols, total)
+
+
+def _fit(x: jax.Array, cap: int) -> jax.Array:
+    """Static resize to ``cap`` inside a trace (slice or zero-pad)."""
+    n = x.shape[0]
+    if n == cap:
+        return x
+    if n > cap:
+        return x[:cap]
+    return jnp.concatenate([x, jnp.zeros(cap - n, dtype=x.dtype)])
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _concat_kernel(datas, valids, offs, ns, out_cap: int):
+    out_d, out_v = [], []
+    for col_datas, col_valids in zip(datas, valids):
+        any_v = any(v is not None for v in col_valids)
+        acc = jnp.zeros(out_cap, dtype=col_datas[0].dtype)
+        accv = jnp.zeros(out_cap, dtype=bool) if any_v else None
+        for bi, (d, v) in enumerate(zip(col_datas, col_valids)):
+            acc = _place_traced(acc, _fit(d, out_cap), offs[bi], ns[bi])
+            if any_v:
+                vv = jnp.ones(out_cap, dtype=bool) if v is None \
+                    else _fit(v, out_cap)
+                accv = _place_traced(accv, vv, offs[bi], ns[bi])
+        out_d.append(acc)
+        out_v.append(accv)
+    return out_d, out_v
 
 
 def interleave_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
@@ -102,11 +141,10 @@ def _interleave(arrs: List[jax.Array]) -> jax.Array:
     return jnp.stack(arrs, axis=1).reshape(-1)
 
 
-@jax.jit
-def _place(dst: jax.Array, src: jax.Array, offset, n):
+def _place_traced(dst: jax.Array, src: jax.Array, offset, n):
     """Write src[0:n] into dst[offset:offset+n]. ``offset``/``n`` are traced
-    scalars, so one compilation serves every placement at a given capacity
-    (a single shifted gather + select — no dynamic shapes)."""
+    scalars (a single shifted gather + select — no dynamic shapes);
+    runs INSIDE _concat_kernel's trace."""
     cap = dst.shape[0]
     idx = jnp.arange(cap, dtype=jnp.int64) - offset
     vals = jnp.take(src, jnp.clip(idx, 0, cap - 1))
