@@ -231,6 +231,89 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
 
 
 # ---------------------------------------------------------------------------
+# Filter pushdown through joins (PushPredicateThroughJoin subset): the
+# SQL planner distributes WHERE conjuncts for the implicit-join form,
+# but explicit JOIN ... ON and DataFrame .join().filter() leave the
+# whole WHERE above the join — severing scan pruning, inflating join
+# inputs, and breaking sharded mesh hand-off chains.
+# ---------------------------------------------------------------------------
+
+
+def _expr_conjuncts(e: Expression) -> List[Expression]:
+    from spark_rapids_tpu.expressions.predicates import And
+
+    if isinstance(e, And):
+        return _expr_conjuncts(e.children[0]) + \
+            _expr_conjuncts(e.children[1])
+    return [e]
+
+
+def _and_all(exprs: List[Expression]) -> Expression:
+    from spark_rapids_tpu.expressions.predicates import And
+
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = And(out, e)
+    return out
+
+
+def _shift_refs(e: Expression, delta: int) -> Expression:
+    def fn(n):
+        if isinstance(n, BoundReference):
+            return BoundReference(n.ordinal + delta, n.dtype)
+        return n
+    return e.transform(fn)
+
+
+def push_filters_below_joins(node: pn.PlanNode) -> pn.PlanNode:
+    if node.children:
+        node = node.with_children([push_filters_below_joins(c)
+                                   for c in node.children])
+    if not (isinstance(node, pn.FilterNode) and
+            isinstance(node.children[0], pn.JoinNode)):
+        return node
+    join: pn.JoinNode = node.children[0]
+    kind = join.kind
+    lw = len(join.children[0].output_schema())
+    # which sides may see a pre-join filter without changing results:
+    # a LEFT join's right side must NOT pre-filter (a filtered-out
+    # match becomes a null-extended row instead of a dropped one);
+    # FULL pushes nothing; semi/anti output only left columns
+    push_left = kind in ("inner", "cross", "left", "left_semi",
+                         "left_anti")
+    push_right = kind in ("inner", "cross", "right")
+    keep: List[Expression] = []
+    lpush: List[Expression] = []
+    rpush: List[Expression] = []
+    for c in _expr_conjuncts(node.condition):
+        ords = [r.ordinal for r in
+                c.collect(lambda n: isinstance(n, BoundReference))]
+        if not c.deterministic or not ords:
+            keep.append(c)
+        elif max(ords) < lw and push_left:
+            lpush.append(c)
+        elif min(ords) >= lw and push_right:
+            rpush.append(_shift_refs(c, -lw))
+        else:
+            keep.append(c)
+    if not lpush and not rpush:
+        return node
+    left, right = join.children
+    if lpush:
+        left = push_filters_below_joins(
+            pn.FilterNode(_and_all(lpush), left))
+    if rpush:
+        right = push_filters_below_joins(
+            pn.FilterNode(_and_all(rpush), right))
+    out: pn.PlanNode = pn.JoinNode(kind, left, right, join.left_keys,
+                                   join.right_keys,
+                                   condition=join.condition)
+    if keep:
+        out = pn.FilterNode(_and_all(keep), out)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Greedy join reordering (r3 verdict #6). The reference inherits join
 # order from Spark's cost-based optimizer upstream; standalone, this
 # planner owns the job. Scan-statistics row counts (parquet footer
@@ -380,6 +463,12 @@ def reorder_joins(node: pn.PlanNode) -> pn.PlanNode:
 
 def optimize(plan: pn.PlanNode) -> pn.PlanNode:
     plan = collapse_project(plan)
+    # collapse first (filters drop through projections), then push
+    # through joins, then collapse again (a pushed filter may meet
+    # another filter/projection), then push the combined form once more
+    plan = push_filters_below_joins(plan)
+    plan = collapse_project(plan)
+    plan = push_filters_below_joins(plan)
     plan = reorder_joins(plan)
     # the reorder's restore-projection may now collapse with outer ones
     plan = collapse_project(plan)

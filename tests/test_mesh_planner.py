@@ -532,12 +532,14 @@ def test_mesh_filter_between_mesh_execs_stays_sharded(monkeypatch):
     planner keeps the WHERE above the join)."""
     from spark_rapids_tpu.parallel import execs as pex
 
+    # the predicate references BOTH sides, so no pushdown rule can move
+    # it below the join - it must run as a sharded mesh filter
     sql = """
 SELECT o_orderkey, l_quantity,
        ROW_NUMBER() OVER (PARTITION BY o_orderkey
                           ORDER BY l_quantity DESC, l_extendedprice) AS rn
 FROM lineitem JOIN orders ON l_orderkey = o_orderkey
-WHERE o_orderdate < 9500
+WHERE o_orderdate < l_shipdate
 ORDER BY o_orderkey, rn
 LIMIT 80
 """

@@ -208,3 +208,39 @@ def test_join_reorder_leaves_outer_and_conditioned_joins():
     assert isinstance(out, pn.JoinNode)
     assert out.children[1] is d_mid  # untouched
     assert_cpu_and_tpu_equal(j2, sort=True)
+
+
+def test_filter_pushes_below_join():
+    """WHERE conjuncts referencing one join side push below the join
+    (PushPredicateThroughJoin subset): the explicit-JOIN / DataFrame
+    .join().filter() form gets the same plans as the implicit form."""
+    from spark_rapids_tpu.expressions.predicates import And, GreaterThan, LessThan
+
+    fact, d_big, _m, _s = _star_tables()
+    j = pn.JoinNode("inner", fact, d_big, [1], [0])
+    cond = And(LessThan(ref(3, dt.FLOAT64), Literal(0.5)),   # fact.f_v
+               GreaterThan(ref(5), Literal(2)))              # big.b_w
+    plan = pn.FilterNode(cond, j)
+    out = optimize(plan)
+    node = out
+    while isinstance(node, pn.ProjectNode):
+        node = node.children[0]
+    assert isinstance(node, pn.JoinNode), type(node)
+    assert isinstance(node.children[0], pn.FilterNode)
+    assert isinstance(node.children[1], pn.FilterNode)
+    assert_cpu_and_tpu_equal(plan, sort=True)
+
+
+def test_filter_does_not_push_into_left_join_right_side():
+    """A right-side conjunct above a LEFT join must stay above it:
+    pre-filtering the right side turns dropped rows into null-extended
+    ones."""
+    from spark_rapids_tpu.expressions.predicates import GreaterThan
+
+    fact, d_big, _m, _s = _star_tables()
+    j = pn.JoinNode("left", fact, d_big, [1], [0])
+    plan = pn.FilterNode(GreaterThan(ref(5), Literal(2)), j)
+    out = optimize(plan)
+    assert isinstance(out, pn.FilterNode)
+    assert isinstance(out.children[0], pn.JoinNode)
+    assert_cpu_and_tpu_equal(plan, sort=True)
