@@ -68,9 +68,13 @@ class Column:
     # -- construction -----------------------------------------------------
 
     @staticmethod
-    def from_numpy(values: np.ndarray, dtype: Optional[dt.DType] = None,
-                   validity: Optional[np.ndarray] = None,
-                   capacity: Optional[int] = None) -> "Column":
+    def host_buffer(values: np.ndarray,
+                    dtype: Optional[dt.DType] = None,
+                    validity: Optional[np.ndarray] = None,
+                    capacity: Optional[int] = None):
+        """The host half of from_numpy: (np_buf, np_vmask|None, dtype).
+        Callers with many columns batch the buffers into ONE device_put
+        (per-column uploads each occupy a tunnel round trip)."""
         values = np.asarray(values)
         if dtype is None:
             dtype = _infer_dtype(values.dtype)
@@ -86,8 +90,17 @@ class Column:
             # normalize null slots to the sentinel so padded garbage can't
             # leak through kernels that forget to mask (defense in depth)
             buf[:n][~np.asarray(validity, dtype=bool)] = dt.null_sentinel(dtype)
-            vmask = jnp.asarray(vm)
-        return Column(dtype, jnp.asarray(buf), vmask)
+            vmask = vm
+        return buf, vmask, dtype
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: Optional[dt.DType] = None,
+                   validity: Optional[np.ndarray] = None,
+                   capacity: Optional[int] = None) -> "Column":
+        buf, vmask, dtype = Column.host_buffer(values, dtype, validity,
+                                               capacity)
+        return Column(dtype, jnp.asarray(buf),
+                      None if vmask is None else jnp.asarray(vmask))
 
     @staticmethod
     def all_null(dtype: dt.DType, capacity: int) -> "Column":
@@ -216,8 +229,11 @@ class StringColumn(Column):
         self._dict_hashes = None
 
     @staticmethod
-    def from_strings(values: Sequence[Optional[str]],
-                     capacity: Optional[int] = None) -> "StringColumn":
+    @staticmethod
+    def host_codes(values: Sequence[Optional[str]],
+                   capacity: Optional[int] = None):
+        """Host half of from_strings: (codes_np, vmask_np|None,
+        dictionary) for batched uploads."""
         n = len(values)
         cap = capacity or bucket_capacity(n)
         arr = np.asarray(values, dtype=object)
@@ -231,11 +247,18 @@ class StringColumn(Column):
         codes_valid = np.zeros(n, dtype=np.int32)
         codes_valid[~null_mask] = inv.astype(np.int32)
         codes[:n] = codes_valid
-        validity = None
+        vmask = None
         if null_mask.any():
-            vm = np.zeros(cap, dtype=bool)
-            vm[:n] = ~null_mask
-            validity = jnp.asarray(vm)
+            vmask = np.zeros(cap, dtype=bool)
+            vmask[:n] = ~null_mask
+        return codes, vmask, np.asarray(dictionary, dtype=object)
+
+    @staticmethod
+    def from_strings(values: Sequence[Optional[str]],
+                     capacity: Optional[int] = None) -> "StringColumn":
+        codes, vmask, dictionary = StringColumn.host_codes(values,
+                                                           capacity)
+        validity = None if vmask is None else jnp.asarray(vmask)
         return StringColumn(jnp.asarray(codes),
                             dictionary.astype(object), validity)
 

@@ -390,6 +390,17 @@ PARQUET_DEBUG_DUMP_PREFIX = conf(
     "for offline repro (RapidsConf.scala:575-581 debug dump analogue)."
 ).string_conf.create_with_default("")
 
+AUTO_BROADCAST_THRESHOLD = conf(
+    "rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
+    "Equi-joins whose build side is ESTIMATED (scan statistics: parquet "
+    "footer num_rows / host array lengths) at or below this many bytes "
+    "broadcast instead of shuffling both sides - Spark's "
+    "autoBroadcastJoinThreshold, which the reference inherits from the "
+    "upstream optimizer. 0 disables (always shuffle when partitioned). "
+    "Each skipped exchange pair saves partition/transfer dispatches, "
+    "which dominate small-dimension joins behind the compile tunnel."
+).bytes_conf.create_with_default(10 << 20)
+
 PYTHON_WORKER_PROCESS = conf(
     "rapids.tpu.python.worker.process.enabled").doc(
     "Run pandas UDFs (mapInPandas / applyInPandas / cogroup / "

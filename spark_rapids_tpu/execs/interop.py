@@ -24,7 +24,13 @@ def host_to_batch(data: Dict[str, np.ndarray],
     ``stats``: footer-derived {col: (min, max)} — when provided the
     upload-time host min/max pass is skipped entirely (the footer already
     paid for those numbers during pruning)."""
-    cols = []
+    import jax
+
+    # build every column's host buffer first, then upload the whole
+    # batch in ONE device_put (per-column jnp.asarray each occupies a
+    # tunnel round trip; one batched transfer pipelines them)
+    host_bufs = []  # flat upload list
+    specs = []      # (kind, buf_idx, vmask_idx|None, dtype, dict, stats)
     n = None
     for name, typ in zip(schema.names, schema.types):
         arr = np.asarray(data[name])
@@ -36,29 +42,52 @@ def host_to_batch(data: Dict[str, np.ndarray],
         if typ is dt.STRING:
             vals = [None if (v is not None and not v[i]) or arr[i] is None
                     else str(arr[i]) for i in range(n)]
-            cols.append(StringColumn.from_strings(vals))
+            codes, vmask, dictionary = StringColumn.host_codes(vals)
+            bi = len(host_bufs)
+            host_bufs.append(codes)
+            vi = None
+            if vmask is not None:
+                vi = len(host_bufs)
+                host_bufs.append(vmask)
+            specs.append(("str", bi, vi, typ, dictionary, None))
         else:
             if arr.dtype.kind == "M":
                 unit = np.datetime_data(arr.dtype)[0]
                 arr = (arr.astype("datetime64[D]").astype(np.int32)
                        if typ is dt.DATE else
                        arr.astype("datetime64[us]").astype(np.int64))
-            col = Column.from_numpy(arr.astype(typ.np_dtype),
-                                    dtype=typ, validity=v)
+            arr = arr.astype(typ.np_dtype)
+            col_stats = None
             if typ.is_integral or typ in (dt.DATE, dt.TIMESTAMP):
                 s = stats.get(name) if stats is not None else None
                 if s is not None:
                     # footer statistics: free bounds, no host pass
-                    col.stats = (int(s[0]), int(s[1]))
+                    col_stats = (int(s[0]), int(s[1]))
                 else:
                     # upload-time (min, max): one vectorized host pass
                     # that lets the groupby kernel pick its packed-key
                     # sort lane (Column.stats). Also the per-column
                     # fallback when a footer omitted this column's stats
-                    vals = arr if v is None else arr[v]
-                    if len(vals):
-                        col.stats = (int(vals.min()), int(vals.max()))
-            cols.append(col)
+                    sv = arr if v is None else arr[v]
+                    if len(sv):
+                        col_stats = (int(sv.min()), int(sv.max()))
+            buf, vmask, typ = Column.host_buffer(arr, typ, v)
+            bi = len(host_bufs)
+            host_bufs.append(buf)
+            vi = None
+            if vmask is not None:
+                vi = len(host_bufs)
+                host_bufs.append(vmask)
+            specs.append(("num", bi, vi, typ, None, col_stats))
+    uploaded = jax.device_put(host_bufs)
+    cols = []
+    for kind, bi, vi, typ, dictionary, col_stats in specs:
+        valid = None if vi is None else uploaded[vi]
+        if kind == "str":
+            cols.append(StringColumn(uploaded[bi], dictionary, valid))
+        else:
+            cols.append(Column(typ, uploaded[bi], valid,
+                               stats=col_stats))
     return ColumnarBatch(cols, n or 0)
 
 

@@ -595,7 +595,8 @@ class _JoinRule(NodeRule):
             # Conditioned right joins were rejected at tag time.
             inner_schema = _concat_schema(right.schema, left.schema)
             flipped = self._plan(meta, "left", right, left, rk, lk, None,
-                                 inner_schema)
+                                 inner_schema,
+                                 build_node=node.children[0])
             nr = len(right.schema)
             reorder = [BoundReference(nr + i, t)
                        for i, t in enumerate(left.schema.types)] + \
@@ -606,10 +607,11 @@ class _JoinRule(NodeRule):
             return basic.ProjectExec(reorder, flipped, out_schema,
                                      meta.conf)
         return self._plan(meta, kind, left, right, lk, rk, cond,
-                          out_schema)
+                          out_schema, build_node=node.children[1])
 
     @staticmethod
-    def _plan(meta, kind, left, right, lk, rk, cond, out_schema):
+    def _plan(meta, kind, left, right, lk, rk, cond, out_schema,
+              build_node=None):
         mesh = _session_mesh(meta.conf)
         if mesh is not None and lk and kind in ("inner", "left",
                                                 "left_semi", "left_anti",
@@ -623,6 +625,24 @@ class _JoinRule(NodeRule):
             return MeshShuffledJoinExec(kind, left, right, lk, rk,
                                         out_schema, cond, meta.conf, mesh)
         multi = left.num_partitions > 1 or right.num_partitions > 1
+        if multi and lk and kind in ("inner", "left", "left_semi",
+                                     "left_anti") and \
+                build_node is not None:
+            # Spark's autoBroadcastJoinThreshold: a small ESTIMATED
+            # build side broadcasts instead of shuffling both sides -
+            # two exchange pipelines (partition + split + concat
+            # dispatches per batch) collapse into one materialize
+            from spark_rapids_tpu.plan.optimizer import estimate_rows
+
+            thr = meta.conf.get(cfg.AUTO_BROADCAST_THRESHOLD)
+            est = estimate_rows(build_node) if thr > 0 else None
+            row_bytes = max(sum(t.byte_width
+                                for t in right.schema.types), 1)
+            if est is not None and est * row_bytes <= thr:
+                build = exchange.BroadcastExchangeExec(right)
+                return joins.BroadcastHashJoinExec(
+                    kind, left, _ReplayExec(build, left.num_partitions),
+                    lk, rk, out_schema, cond, meta.conf)
         if kind == "cross":
             # brute-force joins: nested-loop when the right side is already
             # a single partition (broadcast is then free) or when the

@@ -102,7 +102,10 @@ def test_adaptive_join_sides_stay_aligned(tmp_path, multifile_scan):
     right = pn.ScanNode(ParquetSource(
         [str(tmp_path / "right.parquet"), str(tmp_path / "right2.parquet")]))
     plan = pn.JoinNode("inner", multifile_scan, right, [0], [0])
-    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
+    # the shuffled path is the scenario under test: keep the small
+    # build side from taking the broadcast-threshold shortcut
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True,
+                       "rapids.tpu.sql.autoBroadcastJoinThreshold": 0})
     exec_ = assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
     readers = _find(exec_, AdaptiveShuffleReaderExec)
     assert len(readers) == 2

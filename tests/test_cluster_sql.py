@@ -26,6 +26,9 @@ CONF = {
     "rapids.tpu.cluster.executors": 2,
     "rapids.tpu.cluster.workers": 1,
     "rapids.tpu.sql.shuffle.partitions": 4,
+    # tiny test tables must SHUFFLE (the scenario under test), not
+    # take the small-build broadcast shortcut
+    "rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
 }
 
 QUERY = ("SELECT d.name AS name, sum(s.v) AS total, count(*) AS n "

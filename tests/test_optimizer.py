@@ -244,3 +244,36 @@ def test_filter_does_not_push_into_left_join_right_side():
     assert isinstance(out, pn.FilterNode)
     assert isinstance(out.children[0], pn.JoinNode)
     assert_cpu_and_tpu_equal(plan, sort=True)
+
+
+def test_small_build_side_broadcasts_instead_of_shuffling():
+    """Spark's autoBroadcastJoinThreshold from scan statistics: a
+    multi-partition join whose build side is estimated under the
+    threshold plans as broadcast (no exchange pair); 0 disables."""
+    from spark_rapids_tpu.execs.joins import (BroadcastHashJoinExec,
+                                              ShuffledHashJoinExec)
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    rng = np.random.default_rng(2)
+    big = {"k": rng.integers(0, 50, 3000).astype(np.int64),
+           "v": rng.random(3000)}
+    small = {"k2": np.arange(50, dtype=np.int64),
+             "w": rng.random(50)}
+    plan = pn.JoinNode(
+        "inner",
+        pn.ShuffleExchangeNode(("round_robin",), 3,
+                               pn.ScanNode(pn.InMemorySource(big))),
+        pn.ScanNode(pn.InMemorySource(small)), [0], [0])
+
+    def top_join(e):
+        while not isinstance(e, (BroadcastHashJoinExec,
+                                 ShuffledHashJoinExec)):
+            e = e.children[0]
+        return e
+
+    exec_ = apply_overrides(plan, RapidsConf())
+    assert isinstance(top_join(exec_), BroadcastHashJoinExec)
+    exec_ = apply_overrides(plan, RapidsConf(
+        {"rapids.tpu.sql.autoBroadcastJoinThreshold": 0}))
+    assert isinstance(top_join(exec_), ShuffledHashJoinExec)
+    assert_cpu_and_tpu_equal(plan, sort=True)
