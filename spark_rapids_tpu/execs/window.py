@@ -317,14 +317,24 @@ def window_pre_projection(child_types: List[dt.DType],
 
 
 class WindowExec(TpuExec):
+    """Out-of-core (SURVEY §5.7): a partitioned-window input exceeding
+    the batch budget hash-buckets by PARTITION BY keys (every window
+    group lands wholly in one bucket by construction) and runs the
+    kernel bucket-by-bucket at a bounded resident size — the join
+    build's treatment applied to windows. Un-partitioned windows have
+    no such split and keep the single-batch requirement (the reference
+    has the same constraint, GpuWindowExec.scala:92)."""
+
     def __init__(self, partition_ordinals: List[int],
                  order_specs: List[SortKeySpec], calls: List[WindowCall],
-                 child: TpuExec, schema: Schema, conf=None):
+                 child: TpuExec, schema: Schema, conf=None,
+                 window_budget_rows=None):
         super().__init__([child], schema)
         self.partition_ordinals = partition_ordinals
         self.order_specs = order_specs
         self.calls = calls
         self.conf = conf
+        self.window_budget_rows = window_budget_rows
         self.n_child = len(child.schema)
         self.pre_proj, self.pre_types, self._input_ordinal = \
             window_pre_projection(list(child.schema.types), calls, conf)
@@ -334,23 +344,101 @@ class WindowExec(TpuExec):
 
     @property
     def children_coalesce_goal(self):
-        return [RequireSingleBatch]
+        return [None if self.partition_ordinals else RequireSingleBatch]
+
+    def _budget_rows(self) -> int:
+        if self.window_budget_rows is not None:
+            return max(self.window_budget_rows, 1)
+        from spark_rapids_tpu import config as cfg
+
+        bb = cfg.BATCH_SIZE_BYTES.default if self.conf is None \
+            else self.conf.get(cfg.BATCH_SIZE_BYTES)
+        row_bytes = max(sum(t.byte_width for t in self.pre_types), 1)
+        return max(bb // row_bytes, 1 << 16)
 
     # ------------------------------------------------------------------
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():
-            from spark_rapids_tpu.execs.batching import \
-                drain_to_single_batch
+            from spark_rapids_tpu.memory import priorities
+            from spark_rapids_tpu.memory.spillable import SpillableBatch
 
-            b = drain_to_single_batch(
-                self.children[0].execute(partition), self.schema)
-            if b.realized_num_rows() == 0:
-                yield b
+            staged: List[SpillableBatch] = []
+            total = 0
+            for b in self.children[0].execute(partition):
+                n = b.realized_num_rows()
+                if n == 0:
+                    continue
+                total += n
+                staged.append(SpillableBatch(
+                    b, priorities.INPUT_FROM_SHUFFLE_PRIORITY))
+            if not staged:
+                yield ColumnarBatch.empty(self.schema)
                 return
+            budget = self._budget_rows()
+            if total > budget and self.partition_ordinals:
+                yield from self._out_of_core(staged, total, budget)
+                return
+            b = self._concat_staged(staged)
             with TraceRange("WindowExec"):
                 yield self._run(b)
         return timed(self, it())
+
+    @staticmethod
+    def _concat_staged(staged) -> ColumnarBatch:
+        from contextlib import ExitStack
+
+        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.ops.concat import concat_batches
+
+        with ExitStack() as stack:
+            parts = [stack.enter_context(sb.acquired()) for sb in staged]
+            merged = parts[0] if len(parts) == 1 else \
+                with_oom_retry(lambda: concat_batches(parts))
+        for sb in staged:
+            sb.close()
+        return merged
+
+    def _out_of_core(self, staged, total: int,
+                     budget: int) -> Iterator[ColumnarBatch]:
+        """Hash-bucket by PARTITION BY keys, window each bucket
+        independently (groups never span buckets, so results are
+        exact; output order is per-bucket, same contract as the
+        post-shuffle window)."""
+        from spark_rapids_tpu.memory import priorities
+        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.memory.spillable import SpillableBatch
+        from spark_rapids_tpu.ops import partition as part_ops
+
+        n_buckets = max(-(-total // budget) * 2, 2)
+        child_types = list(self.children[0].schema.types)
+        per_bucket: List[List[SpillableBatch]] = \
+            [[] for _ in range(n_buckets)]
+        for sb in staged:
+            with sb.acquired() as b:
+                with TraceRange("WindowExec.oob.partition"):
+                    sorted_b, counts = part_ops.hash_partition(
+                        b, list(self.partition_ordinals), child_types,
+                        n_buckets)
+                    slices = part_ops.slice_partitions(sorted_b, counts)
+                for p, sl in enumerate(slices):
+                    if sl is not None:
+                        per_bucket[p].append(SpillableBatch(
+                            sl, priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
+            sb.close()
+        emitted = False
+        for p in range(n_buckets):
+            if not per_bucket[p]:
+                continue
+            b = self._concat_staged(per_bucket[p])
+            if b.realized_num_rows() == 0:
+                continue
+            with TraceRange("WindowExec.oob.bucket"):
+                out = with_oom_retry(lambda b=b: self._run(b))
+            emitted = True
+            yield out
+        if not emitted:
+            yield ColumnarBatch.empty(self.schema)
 
     def _run(self, batch: ColumnarBatch) -> ColumnarBatch:
         ext = self.pre_proj(batch)
