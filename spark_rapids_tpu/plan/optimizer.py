@@ -51,9 +51,25 @@ def _reference_counts(exprs: List[Expression], width: int) -> List[int]:
     return counts
 
 
-def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
-    """Bottom-up single pass collapsing Project/Filter chains."""
-    new_children = [collapse_project(c) for c in node.children]
+def collapse_project(node: pn.PlanNode, _memo=None) -> pn.PlanNode:
+    """Bottom-up single pass collapsing Project/Filter chains.
+
+    ``_memo`` (id -> (node, result), the node ref pins the id) keeps
+    SHARED subtrees shared: CTE references reuse one plan node, and a
+    rebuild that copied it per reference would make the exec layer
+    materialize the common stage once per consumer."""
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(node))
+    if hit is not None:
+        return hit[1]
+    result = _collapse_project_one(node, _memo)
+    _memo[id(node)] = (node, result)
+    return result
+
+
+def _collapse_project_one(node: pn.PlanNode, _memo) -> pn.PlanNode:
+    new_children = [collapse_project(c, _memo) for c in node.children]
     node = node.with_children(new_children) if node.children else node
 
     if isinstance(node, pn.ProjectNode) and \
@@ -74,7 +90,8 @@ def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
                 exprs = [_substitute(e, inner.exprs)
                          for e in node.exprs]
                 return collapse_project(pn.ProjectNode(
-                    exprs, inner.children[0], names=list(node.names)))
+                    exprs, inner.children[0], names=list(node.names)),
+                    _memo)
 
     if isinstance(node, pn.FilterNode) and \
             isinstance(node.children[0], pn.FilterNode):
@@ -83,7 +100,7 @@ def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
         inner_f: pn.FilterNode = node.children[0]
         return collapse_project(pn.FilterNode(
             pr.And(inner_f.condition, node.condition),
-            inner_f.children[0]))
+            inner_f.children[0]), _memo)
 
     if isinstance(node, pn.FilterNode) and \
             isinstance(node.children[0], pn.ProjectNode):
@@ -94,12 +111,13 @@ def collapse_project(node: pn.PlanNode) -> pn.PlanNode:
             return collapse_project(pn.ProjectNode(
                 list(proj.exprs),
                 pn.FilterNode(pushed, proj.children[0]),
-                names=list(proj.names)))
+                names=list(proj.names)), _memo)
 
     return node
 
 
-def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
+def rewrite_distinct_aggregates(node: pn.PlanNode,
+                                _memo=None) -> pn.PlanNode:
     """count/sum(DISTINCT x) -> dedup-then-aggregate: an inner group-by
     over (keys..., x) removes duplicates, then the outer aggregate runs
     the plain (non-distinct) function. This is the planner-level role of
@@ -114,9 +132,24 @@ def rewrite_distinct_aggregates(node: pn.PlanNode) -> pn.PlanNode:
     reference."""
     from spark_rapids_tpu.expressions import aggregates as aggfn
 
-    new_children = [rewrite_distinct_aggregates(c)
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(node))
+    if hit is not None:
+        return hit[1]
+    orig = node
+    new_children = [rewrite_distinct_aggregates(c, _memo)
                     for c in node.children]
-    node = node.with_children(new_children) if node.children else node
+    if node.children and any(n is not o for n, o in
+                             zip(new_children, node.children)):
+        node = node.with_children(new_children)
+    result = _rewrite_distinct_one(node)
+    _memo[id(orig)] = (orig, result)
+    return result
+
+
+def _rewrite_distinct_one(node: pn.PlanNode) -> pn.PlanNode:
+    from spark_rapids_tpu.expressions import aggregates as aggfn
 
     if not isinstance(node, pn.AggregateNode) or node.mode != "complete":
         return node
@@ -265,10 +298,25 @@ def _shift_refs(e: Expression, delta: int) -> Expression:
     return e.transform(fn)
 
 
-def push_filters_below_joins(node: pn.PlanNode) -> pn.PlanNode:
+def push_filters_below_joins(node: pn.PlanNode,
+                             _memo=None) -> pn.PlanNode:
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(node))
+    if hit is not None:
+        return hit[1]
+    orig = node
+    result = _push_filters_one(node, _memo)
+    _memo[id(orig)] = (orig, result)
+    return result
+
+
+def _push_filters_one(node: pn.PlanNode, _memo) -> pn.PlanNode:
     if node.children:
-        node = node.with_children([push_filters_below_joins(c)
-                                   for c in node.children])
+        new_children = [push_filters_below_joins(c, _memo)
+                        for c in node.children]
+        if any(n is not o for n, o in zip(new_children, node.children)):
+            node = node.with_children(new_children)
     if not (isinstance(node, pn.FilterNode) and
             isinstance(node.children[0], pn.JoinNode)):
         return node
@@ -301,10 +349,10 @@ def push_filters_below_joins(node: pn.PlanNode) -> pn.PlanNode:
     left, right = join.children
     if lpush:
         left = push_filters_below_joins(
-            pn.FilterNode(_and_all(lpush), left))
+            pn.FilterNode(_and_all(lpush), left), _memo)
     if rpush:
         right = push_filters_below_joins(
-            pn.FilterNode(_and_all(rpush), right))
+            pn.FilterNode(_and_all(rpush), right), _memo)
     out: pn.PlanNode = pn.JoinNode(kind, left, right, join.left_keys,
                                    join.right_keys,
                                    condition=join.condition)
@@ -403,19 +451,37 @@ def _greedy_order(n: int, edges, est) -> Optional[List[int]]:
     return order
 
 
-def reorder_joins(node: pn.PlanNode) -> pn.PlanNode:
+def reorder_joins(node: pn.PlanNode, _memo=None) -> pn.PlanNode:
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(node))
+    if hit is not None:
+        return hit[1]
+    orig = node
+    result = _reorder_joins_one(node, _memo)
+    _memo[id(orig)] = (orig, result)
+    return result
+
+
+def _reorder_joins_one(node: pn.PlanNode, _memo) -> pn.PlanNode:
     # TOP-DOWN: the chain must flatten before any sub-chain wraps
     # itself in a restore-projection (which would hide it)
     if not (isinstance(node, pn.JoinNode) and node.kind == "inner" and
             node.condition is None and node.left_keys):
         if node.children:
-            return node.with_children([reorder_joins(c)
-                                       for c in node.children])
+            new_children = [reorder_joins(c, _memo)
+                            for c in node.children]
+            if any(n is not o for n, o in
+                   zip(new_children, node.children)):
+                return node.with_children(new_children)
         return node
 
     def keep_written_order():
-        return node.with_children([reorder_joins(c)
-                                   for c in node.children])
+        new_children = [reorder_joins(c, _memo)
+                        for c in node.children]
+        if any(n is not o for n, o in zip(new_children, node.children)):
+            return node.with_children(new_children)
+        return node
 
     rels, colmap, edges = _flatten_inner_joins(node)
     if len(rels) < 3:
@@ -426,7 +492,7 @@ def reorder_joins(node: pn.PlanNode) -> pn.PlanNode:
     order = _greedy_order(len(rels), edges, est)
     if order is None or order == list(range(len(rels))):
         return keep_written_order()
-    rels = [reorder_joins(r) for r in rels]  # recurse below the chain
+    rels = [reorder_joins(r, _memo) for r in rels]  # recurse below
     # rebuild left-deep in greedy order; when a relation joins, every
     # key equality linking it to already-placed relations applies (so
     # no edge constraint is ever dropped — an edge activates when its

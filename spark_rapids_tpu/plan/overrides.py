@@ -142,14 +142,33 @@ def tag_expression(e: Expression, meta: "NodeMeta", conf: RapidsConf):
 
 
 class NodeMeta:
-    """SparkPlanMeta analogue (RapidsMeta.scala:418): per-node tag state."""
+    """SparkPlanMeta analogue (RapidsMeta.scala:418): per-node tag state.
 
-    def __init__(self, node: pn.PlanNode, conf: RapidsConf):
+    A plan node OBJECT referenced from several tree positions (CTE
+    reuse — plan_statement shares each CTE's plan node across its
+    references) gets ONE meta and converts to ONE exec: exchanges and
+    broadcasts under the shared subtree then materialize once for every
+    consumer (Spark's ReuseExchange/ReuseSubquery role)."""
+
+    def __init__(self, node: pn.PlanNode, conf: RapidsConf, _memo=None):
         self.node = node
         self.conf = conf
-        self.children = [NodeMeta(c, conf) for c in node.children]
+        _memo = {} if _memo is None else _memo
+        self.children = [NodeMeta._shared(c, conf, _memo)
+                         for c in node.children]
         self.reasons: List[str] = []
         self.rule = _NODE_RULES.get(type(node))
+        self._converted: Optional[TpuExec] = None
+        self._tagged = False
+
+    @staticmethod
+    def _shared(node: pn.PlanNode, conf: RapidsConf,
+                memo: dict) -> "NodeMeta":
+        hit = memo.get(id(node))
+        if hit is None:
+            hit = NodeMeta(node, conf, memo)
+            memo[id(node)] = hit  # meta holds node: id stays pinned
+        return hit
 
     def will_not_work(self, reason: str):
         if reason not in self.reasons:
@@ -160,6 +179,9 @@ class NodeMeta:
         return not self.reasons
 
     def tag_for_tpu(self):
+        if self._tagged:
+            return
+        self._tagged = True
         for c in self.children:
             c.tag_for_tpu()
         if not self.conf.get(cfg.SQL_ENABLED):
@@ -192,10 +214,14 @@ class NodeMeta:
     # -- conversion ----------------------------------------------------
 
     def convert(self) -> TpuExec:
+        if self._converted is not None:
+            return self._converted
         if self.can_run:
             tpu_children = [c.convert() for c in self.children]
-            return self.rule.convert(self, tpu_children)
-        return self._convert_fallback()
+            self._converted = self.rule.convert(self, tpu_children)
+        else:
+            self._converted = self._convert_fallback()
+        return self._converted
 
     def _convert_fallback(self) -> TpuExec:
         """Run this node on the CPU engine. TPU-able children still
