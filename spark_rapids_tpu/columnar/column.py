@@ -229,7 +229,6 @@ class StringColumn(Column):
         self._dict_hashes = None
 
     @staticmethod
-    @staticmethod
     def host_codes(values: Sequence[Optional[str]],
                    capacity: Optional[int] = None):
         """Host half of from_strings: (codes_np, vmask_np|None,

@@ -136,10 +136,37 @@ class ShuffleExchangeExec(TpuExec):
                 from spark_rapids_tpu.execs.base import run_partitions
 
                 def map_task(in_p: int):
-                    bs = list(self.children[0].execute(in_p))
-                    ColumnarBatch.realize_counts(bs)  # one sync per task
-                    return self._write_blocks(
-                        b for b in bs if b.realized_num_rows() > 0)
+                    # realize lazy counts in bounded chunks so an
+                    # out-of-core child never has its whole partition
+                    # resident at once — each chunk's batches move into
+                    # spillable blocks before the next is read. The
+                    # chunk boundary is a BYTE budget estimated from
+                    # host-known capacities (no sync to compute), so an
+                    # in-core partition of many small batches still pays
+                    # its single realize_counts round trip
+                    out: Dict[int, List[SpillableBatch]] = {
+                        p: [] for p in range(self.num_out_partitions)}
+                    chunk: List[ColumnarBatch] = []
+                    chunk_bytes = 0
+
+                    def flush():
+                        nonlocal chunk_bytes
+                        ColumnarBatch.realize_counts(chunk)
+                        self._write_blocks(
+                            (b for b in chunk
+                             if b.realized_num_rows() > 0), into=out)
+                        chunk.clear()
+                        chunk_bytes = 0
+
+                    for b in self.children[0].execute(in_p):
+                        chunk.append(b)
+                        chunk_bytes += \
+                            b.capacity * max(b.num_columns, 1) * 8
+                        if chunk_bytes >= self.CHUNK_BYTE_BUDGET:
+                            flush()
+                    if chunk:
+                        flush()
+                    return out
 
                 # merge per-map outputs in PARTITION order, not thread
                 # completion order: float aggregates downstream must see
@@ -154,10 +181,14 @@ class ShuffleExchangeExec(TpuExec):
                         blocks[p].extend(subs)
             self._blocks = blocks
 
-    def _write_blocks(self, source
+    # estimated resident bytes a map task may stage before realizing
+    # counts and moving the chunk into spillable blocks
+    CHUNK_BYTE_BUDGET = 256 << 20
+
+    def _write_blocks(self, source, into=None
                       ) -> Dict[int, List[SpillableBatch]]:
-        blocks: Dict[int, List[SpillableBatch]] = {
-            p: [] for p in range(self.num_out_partitions)}
+        blocks: Dict[int, List[SpillableBatch]] = into if into is not None \
+            else {p: [] for p in range(self.num_out_partitions)}
         for b in source:
             with TraceRange("ShuffleExchangeExec.partition"):
                 sorted_b, counts = self._partition_batch(b)

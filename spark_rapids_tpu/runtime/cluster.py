@@ -171,6 +171,13 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
                          task_threads=task_threads)
         self.runtime = runtime
         self.shuffle_id: Optional[int] = None
+        # set by ClusterRuntime.new_shuffle_id before map tasks run, so
+        # make_read_stub can name the shuffle mid-materialization
+        self._pending_sid: Optional[int] = None
+        # reasons a map task was re-placed in-process instead of on its
+        # assigned remote worker — surfaced in explain (tree_string) so
+        # cluster-mode degradation is visible, never silent
+        self.local_fallbacks: List[str] = []
         self._read_stub: Optional[ClusterShuffleReadExec] = None
 
     @classmethod
@@ -178,6 +185,16 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
              ) -> "ClusterShuffleExchangeExec":
         return cls(ex.partitioning, ex.num_out_partitions,
                    ex.children[0], runtime, task_threads=ex.task_threads)
+
+    def tree_string(self, indent: int = 0) -> str:
+        label = "  " * indent + self.name
+        if self.local_fallbacks:
+            label += (f" [local fallback x{len(self.local_fallbacks)}:"
+                      f" {self.local_fallbacks[0]}]")
+        lines = [label]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
 
     # -- map side ---------------------------------------------------------
 
@@ -221,6 +238,8 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
     def make_read_stub(self) -> ClusterShuffleReadExec:
         sid = self.shuffle_id if self.shuffle_id is not None \
             else self._pending_sid
+        assert sid is not None, \
+            "make_read_stub before new_shuffle_id registered this exchange"
         maps = self.runtime.map_outputs_snapshot(sid)
         return ClusterShuffleReadExec(
             self.schema, sid, self.num_out_partitions,
@@ -261,6 +280,13 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
                     yield b
                 sb.close()
         return timed(self, it())
+
+
+class RemoteTaskError(RuntimeError):
+    """A task shipped to a remote worker RAN there and failed (the
+    worker reported an error reply). Distinct from RuntimeError so the
+    scheduler's local re-placement never triggers on driver-side
+    failures that merely share the base class."""
 
 
 class RemoteWorkerHandle:
@@ -314,7 +340,7 @@ class RemoteWorkerHandle:
                 f"worker {self.executor_id} died")
         reply = json.loads(line)
         if not reply.get("ok"):
-            raise RuntimeError(
+            raise RemoteTaskError(
                 f"worker {self.executor_id} task failed: "
                 f"{reply.get('error')}")
         return reply
@@ -399,9 +425,12 @@ class ClusterRuntime:
         worker = next((w for w in self.workers
                        if w.executor_id == target), None)
         if worker is not None:
+            # build the payload OUTSIDE the placement try: task_tree()
+            # materializes nested upstream stages driver-side, and a
+            # failure there is a query failure, not a placement problem
+            payload = exchange.task_payload(shuffle_id, map_id)
             try:
-                reply = worker.run_map(
-                    exchange.task_payload(shuffle_id, map_id))
+                reply = worker.run_map(payload)
                 self.cluster.register_remote_map_output(
                     shuffle_id, map_id, worker.executor_id,
                     reply["partitions"])
@@ -409,14 +438,25 @@ class ClusterRuntime:
                     self.assignments[shuffle_id][map_id] = \
                         worker.executor_id
                 return
-            except (ConnectionError, BrokenPipeError, OSError):
+            except (ConnectionError, BrokenPipeError, OSError) as e:
                 # dead worker at SUBMIT time: place locally instead
-                pass
-            except (pickle.PicklingError, TypeError, AttributeError):
-                # unpicklable task subtree (cached relations hold locks,
-                # mesh execs hold Device objects): this task can only
-                # run in-process — local placement, not a query failure
-                pass
+                exchange.local_fallbacks.append(
+                    f"worker {target} dead at submit: {e}")
+            except (pickle.PicklingError, TypeError, AttributeError) as e:
+                # unpicklable task subtree (cached relations hold locks):
+                # this task can only run in-process — local placement,
+                # not a query failure
+                exchange.local_fallbacks.append(
+                    f"unpicklable task subtree: {type(e).__name__}: {e}")
+            except RemoteTaskError as e:
+                # the worker RAN the task and it failed remotely — e.g. a
+                # nested ClusterShuffleReadExec in the shipped subtree hit
+                # a fetch failure against a dead peer. Re-place locally
+                # (the driver process can recover through its own
+                # exchange objects) instead of failing the whole query.
+                exchange.local_fallbacks.append(
+                    f"remote task failed on {target}, re-placed locally: "
+                    f"{e}")
         idx = self._local_index(target)
         exchange.run_map_locally(shuffle_id, map_id, idx)
         with self._lock:
@@ -513,20 +553,32 @@ def shutdown_session_cluster() -> None:
         _RUNTIME_KEY = None
 
 
-def install_cluster_exchanges(exec_: TpuExec,
-                              runtime: ClusterRuntime) -> TpuExec:
+def install_cluster_exchanges(exec_: TpuExec, runtime: ClusterRuntime,
+                              _memo: Optional[dict] = None) -> TpuExec:
     """Post-planning pass: swap hash/single exchanges for cluster-backed
     ones (the reference swaps the shuffle manager underneath the same
-    exec; here the exec itself is the seam). Range exchanges keep the
-    single-process path (bounds sampling is driver-side). Adaptive
+    exec; here the exec itself is the seam). The rewrite is memoized by
+    node identity so a shared exchange (CTE/ReuseExchange) stays ONE
+    cluster exchange — every parent reads the same materialized shuffle
+    instead of each re-shuffling the shared stage. Range exchanges keep
+    the single-process path (bounds sampling is driver-side). Adaptive
     shuffle reads are disabled under cluster mode by the planner —
     their group providers capture exchange block stores directly
     (execs/adaptive.py:148-153); making AQE cluster-aware is future
     work, matching the reference v0.3 which also scoped AQE narrowly."""
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(exec_))
+    if hit is not None:
+        return hit[1]
+    orig = exec_
     if isinstance(exec_, ShuffleExchangeExec) and \
             not isinstance(exec_, ClusterShuffleExchangeExec) and \
             exec_.partitioning[0] in ("hash", "single"):
         exec_ = ClusterShuffleExchangeExec.wrap(exec_, runtime)
-    exec_.children = [install_cluster_exchanges(c, runtime)
+    exec_.children = [install_cluster_exchanges(c, runtime, _memo)
                       for c in exec_.children]
+    # pin the original node in the memo value: id() reuse after GC is a
+    # known landmine (see memory build-env-quirks)
+    _memo[id(orig)] = (orig, exec_)
     return exec_
