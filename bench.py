@@ -114,11 +114,51 @@ def bench_cpu(keys, key_valid, vals):
     return dt, out
 
 
+def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1):
+    """One REAL TPC query end-to-end through the engine (round-5
+    verdict: the driver-visible bench must capture a full query whose
+    number moves with engine work, not only the q5lite microbench).
+    Reports wall, dispatch split, measured on-device seconds, and the
+    CPU-oracle comparison — the reference's per-query JSON record shape
+    (docs/benchmarks.md:26-169, BenchmarkRunner.scala)."""
+    from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner
+
+    r = BenchmarkRunner(os.path.join("/tmp", "srt_bench_tpcxbb"), sf)
+    res = r.run(benchmark, iterations=2, warmup=1, compare=True)
+    wall = res["min_time_sec"]
+    dt = res.get("dispatch_telemetry", {})
+    devt = res.get("device_timing", {})
+    cmp_ = res.get("compare", {})
+    cpu_s = cmp_.get("cpu_time_sec", 0.0)
+    return {
+        "benchmark": benchmark,
+        "sf": sf,
+        "wall_s": round(wall, 3),
+        "dispatch_count": dt.get("dispatch_count"),
+        "rtt_share": round(
+            min(dt.get("est_dispatch_overhead_s", 0.0) / wall, 1.0), 3)
+        if wall else None,
+        "on_device_s_measured": devt.get("on_device_s"),
+        "cpu_oracle_s": round(cpu_s, 3),
+        "vs_cpu_oracle": round(cpu_s / wall, 3) if wall else None,
+        "matches_cpu": cmp_.get("matches_cpu"),
+    }
+
+
 def main():
+    # telemetry wraps jax.jit; must precede every compute-module import
+    from spark_rapids_tpu.utils import dispatch as disp
+
+    disp.install()
     seed_compile_cache()
     keys, key_valid, vals = gen_data()
     tpu_dt, tpu_out = bench_tpu(keys, key_valid, vals)
     cpu_dt, cpu_out = bench_cpu(keys, key_valid, vals)
+    full = None
+    try:
+        full = bench_full_query()
+    except Exception as e:  # the headline line must still print
+        full = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     # cross-check: group count and total sum must agree
     import jax
@@ -136,6 +176,7 @@ def main():
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
         "vs_baseline": round(speedup, 3),
+        "full_query": full,
     }))
 
 

@@ -249,6 +249,17 @@ MESH_DEVICES = conf("rapids.tpu.mesh.devices").doc(
     "Device count for the mesh data axis; 0 = all visible devices."
 ).int_conf.create_with_default(0)
 
+FUSION_ENABLED = conf("rapids.tpu.sql.fusion.enabled").doc(
+    "Fuse filter/project/broadcast-join-probe chains into ONE compiled "
+    "XLA program per batch (and feed the surviving-row mask straight "
+    "into the groupby kernel when the chain ends at an aggregate). "
+    "Each fused step removes its own dispatch round trip; behind a "
+    "remote device attachment a dispatch costs ~100 ms, so a "
+    "scan->filter->join->agg pipeline collapses from ~8 dispatches per "
+    "batch to 2. Joins whose broadcast build side has duplicate key "
+    "hashes fall back to the general expansion kernel automatically."
+).boolean_conf.create_with_default(True)
+
 CLUSTER_ENABLED = conf("rapids.tpu.cluster.enabled").doc(
     "Execute shuffle exchanges through the multi-process cluster runtime: "
     "map tasks write partitioned output into per-executor shuffle catalogs "

@@ -112,6 +112,9 @@ def execute_cpu(plan: pn.PlanNode) -> CpuFrame:
     if root:
         _ORIGINS_STATE.active = True
         _ORIGINS_STATE.needed = _plan_needs_origins(plan)
+        # same gating the TPU planner applies: file identity exprs
+        # forbid multi-file split packing
+        pn.gate_split_packing(plan)
     try:
         fn = _NODES.get(type(plan))
         if fn is None:

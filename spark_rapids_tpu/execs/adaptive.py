@@ -16,11 +16,38 @@ groups from the summed per-partition sizes and share the spec
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Iterator, List, Optional, Sequence
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+
+#: while active IN THIS THREAD, AdaptiveShuffleReaderExec.num_partitions
+#: answers with the exchange's STATIC partition count instead of
+#: computing groups. The groups computation materializes the whole map
+#: stage (AQE's materialize-then-replan order — intended when the first
+#: CONSUMER pulls at execute time), but planner rules also ask
+#: num_partitions while building the plan, which used to run the entire
+#: partial stage mid-planning — before downstream rules (fusion,
+#: coalesce insertion) had rewritten the subtree. Spark's planner
+#: likewise plans against static shuffle partitioning; only execution
+#: replans adaptively. Thread-LOCAL: one session thread planning must
+#: not suppress another thread's execute-time materialization.
+_PLANNING = __import__("threading").local()
+
+
+def planning_active() -> bool:
+    return getattr(_PLANNING, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def planning_mode():
+    _PLANNING.depth = getattr(_PLANNING, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _PLANNING.depth -= 1
 
 
 class MapOutputStatistics:
@@ -113,6 +140,8 @@ class AdaptiveShuffleReaderExec(TpuExec):
 
     @property
     def num_partitions(self) -> int:
+        if self._groups is None and planning_active():
+            return self.exchange.num_out_partitions
         return len(self.groups)
 
     @property

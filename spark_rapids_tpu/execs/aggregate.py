@@ -118,6 +118,22 @@ class HashAggregateExec(TpuExec):
 
         return RequireSingleBatch
 
+    @property
+    def children_coalesce_goal(self):
+        # final mode reads pre-reduced partials (often many tiny
+        # shuffle blocks): coalescing them first turns N update+merge
+        # kernel dispatches into one concat + one update, while the
+        # TargetSize bound keeps memory behavior identical to the
+        # streaming loop (which concats running+part at the same scale)
+        if self.mode != "final":
+            return [None]
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.execs.batching import TargetSize
+
+        bb = self.conf.get(cfg.BATCH_SIZE_BYTES) if self.conf is not None \
+            else cfg.BATCH_SIZE_BYTES.default
+        return [TargetSize(bb)]
+
     # ------------------------------------------------------------------
 
     def _agg_batch(self, batch: ColumnarBatch, specs: List[AggSpec],
@@ -139,6 +155,18 @@ class HashAggregateExec(TpuExec):
     def _merge_types(self) -> List[dt.DType]:
         return [e.dtype for e in self.grouping] + self.partial_types
 
+    def _update_inputs(self, b: ColumnarBatch):
+        """Per-batch update-side inputs: (projected batch, live-mask).
+        FusedAggregateExec overrides this with its one-program chain."""
+        mask = None
+        if self.fused_filter is not None:
+            # keep-mask over the RAW batch (condition binds to
+            # the child schema), row-aligned through projection
+            mask = self.fused_filter.mask(b)
+        if self.input_proj is not None:
+            b = self.input_proj(b)
+        return b, mask
+
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():
             running: Optional[ColumnarBatch] = None
@@ -147,13 +175,7 @@ class HashAggregateExec(TpuExec):
                 if b.realized_num_rows() == 0:
                     continue
                 saw_input = True
-                mask = None
-                if self.fused_filter is not None:
-                    # keep-mask over the RAW batch (condition binds to
-                    # the child schema), row-aligned through projection
-                    mask = self.fused_filter.mask(b)
-                if self.input_proj is not None:
-                    b = self.input_proj(b)
+                b, mask = self._update_inputs(b)
                 with TraceRange("HashAggregateExec.updateAgg"):
                     part = self._agg_batch(b, self.first_specs,
                                            self.input_types, mask)

@@ -86,6 +86,18 @@ class ShuffleExchangeExec(TpuExec):
 
     @property
     def num_partitions(self) -> int:
+        # range exchanges replan adaptively: the first partition-count
+        # query OUTSIDE planning (collect's pre-execution walk)
+        # materializes the map side, and _materialize collapses to ONE
+        # partition when the staged input fits a single batch budget —
+        # a global sort over a final aggregate's handful of rows must
+        # not pay bounds sampling + range partitioning + N sort tasks
+        # (AQE's materialize-then-replan, applied to the sort stage).
+        if self.partitioning[0] == "range" and self._blocks is None:
+            from spark_rapids_tpu.execs import adaptive as adaptive_exec
+
+            if not adaptive_exec.planning_active():
+                self._materialize()
         return self.num_out_partitions
 
     def _partition_batch(self, b: ColumnarBatch
@@ -119,6 +131,16 @@ class ShuffleExchangeExec(TpuExec):
                 staged = [sb for part in run_partitions(
                     self.children[0].num_partitions, stage_task,
                     self.task_threads) for sb in part]
+                total_rows = sum(sb.num_rows for sb in staged)
+                row_bytes = max(sum(t.byte_width
+                                    for t in self.schema.types), 1)
+                if self.num_out_partitions > 1 and \
+                        total_rows * row_bytes <= self.CHUNK_BYTE_BUDGET:
+                    # adaptive collapse: tiny staged input -> single
+                    # partition, no bounds sampling, no partition kernel
+                    self.num_out_partitions = 1
+                    self._blocks = {0: staged}
+                    return
                 specs = list(self.partitioning[1])
                 if len(specs) > 1:
                     bounds = part_ops.sample_range_bounds_rows(

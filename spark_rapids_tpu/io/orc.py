@@ -92,12 +92,8 @@ class OrcSource(FileSourceBase):
                 out.append((name, int(lo), int(hi)))
         return tuple(out)
 
-    def split_stats(self, split: int):
-        descs = self.splits()
-        if not descs:
-            return None
-        return dict((c, (lo, hi))
-                    for c, lo, hi in descs[split].stats) or None
+    # split_stats: FileSourceBase merges per-desc stats, incl. packed
+    # multi-file partitions
 
     def _read_split(self, desc: _StripeSplit):
         import pyarrow as pa

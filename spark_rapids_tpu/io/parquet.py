@@ -170,12 +170,8 @@ class ParquetSource(FileSourceBase):
                 emit(kept, kept_stats)
         return splits
 
-    def split_stats(self, split: int):
-        descs = self.splits()
-        if not descs:
-            return None
-        return dict((c, (lo, hi))
-                    for c, lo, hi in descs[split].stats) or None
+    # split_stats: FileSourceBase merges per-desc stats, incl. packed
+    # multi-file partitions
 
     def _read_split(self, desc: _RgSplit):
         import pyarrow.parquet as pq

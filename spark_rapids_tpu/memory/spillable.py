@@ -24,7 +24,7 @@ class SpillableBatch:
         self._catalog = catalog if catalog is not None else get_catalog()
         # realize the row count before the batch can spill: host metadata
         # must survive tier changes (the reference stores it in TableMeta)
-        batch.realized_num_rows()
+        self.num_rows = batch.realized_num_rows()
         self._size = batch.device_memory_size()
         self._id = self._catalog.register(batch, priority)
         self._closed = False

@@ -205,7 +205,10 @@ def _equality_lanes(d, v, dtype):
     plus an isnan flag so NaN==NaN without bitcasts)."""
     valid = v if v is not None else None
     if dtype.is_floating:
-        x = d + jnp.zeros((), d.dtype)  # -0.0 -> +0.0
+        # NOT d + 0: XLA folds add-zero inside fused programs, keeping
+        # -0.0's sign (see sortkeys.canonicalize_floats)
+        zero = jnp.zeros((), d.dtype)
+        x = jnp.where(d == zero, zero, d)
         isn = jnp.isnan(x)
         if valid is not None:
             isn = isn & valid

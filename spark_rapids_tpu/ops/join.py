@@ -42,8 +42,37 @@ JOIN_TYPES = ("inner", "left", "right", "leftsemi", "leftanti", "full",
               "cross")
 
 
+def common_key_type(a: dt.DType, b: dt.DType) -> Optional[dt.DType]:
+    """Comparison type for a mixed-type equi-key pair (Spark's implicit
+    cast: bigint = double compares as double). None = no numeric
+    common type (date/timestamp/string mixes stay unsupported)."""
+    if a is b:
+        return a
+    def _num(t):
+        return t.is_floating or t.is_integral or t is dt.BOOLEAN
+    if _num(a) and _num(b):
+        return dt.FLOAT64 if (a.is_floating or b.is_floating) \
+            else dt.INT64
+    return None
+
+
 def _key_hashes(batch: ColumnarBatch, ordinals: List[int],
-                dtypes: List[dt.DType], null_sentinel) -> jax.Array:
+                dtypes: List[dt.DType], null_sentinel,
+                target_types: Optional[List[dt.DType]] = None
+                ) -> jax.Array:
+    """``target_types``: per-key comparison type — mismatched sides are
+    cast so both sides hash identical values identically."""
+    if target_types is not None and any(
+            t is not dtypes[o] for t, o in zip(target_types, ordinals)):
+        cols = list(batch.columns)
+        for t, o in zip(target_types, ordinals):
+            if t is not dtypes[o] and not isinstance(cols[o], StringColumn):
+                cols[o] = Column(t, cols[o].data.astype(t.kernel_dtype),
+                                 cols[o].validity)
+        batch = ColumnarBatch(cols, batch.num_rows)
+        dtypes = list(dtypes)
+        for t, o in zip(target_types, ordinals):
+            dtypes[o] = t
     h = hashing.hash_columns(batch, ordinals, dtypes)
     any_null = None
     for o in ordinals:
@@ -82,8 +111,16 @@ def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
     assert join_type in ("inner", "left", "leftsemi", "leftanti", "full")
     stream, build = unify_join_strings(stream, build, stream_keys, build_keys)
 
-    h_b = _key_hashes(build, build_keys, build_types, _BUILD_NULL)
-    h_p = _key_hashes(stream, stream_keys, stream_types, _PROBE_NULL)
+    commons = [common_key_type(stream_types[so], build_types[bo])
+               for so, bo in zip(stream_keys, build_keys)]
+    assert all(c is not None for c in commons), (
+        "no common comparison type for join keys",
+        [stream_types[o] for o in stream_keys],
+        [build_types[o] for o in build_keys])
+    h_b = _key_hashes(build, build_keys, build_types, _BUILD_NULL,
+                      target_types=commons)
+    h_p = _key_hashes(stream, stream_keys, stream_types, _PROBE_NULL,
+                      target_types=commons)
 
     # ---- phase 1 (device): sort build, bound-search, count matches
     b_datas = [c.data for c in build.columns]
@@ -100,14 +137,18 @@ def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
                          zip(build.columns, sb_datas, sb_vals)]
     sorted_build = ColumnarBatch(sorted_build_cols, build.num_rows)
 
-    # ---- phase 2 (device): expand pairs, verify exact equality
+    # ---- phase 2 (device): expand pairs, verify exact equality (on the
+    # per-pair common comparison type)
+    def _cast(d, t, c):
+        return d if t is c else d.astype(c.kernel_dtype)
+
     key_pairs = tuple(
-        (stream.columns[so].data,
+        (_cast(stream.columns[so].data, stream_types[so], c),
          stream.columns[so].validity,
-         sorted_build.columns[bo].data,
+         _cast(sorted_build.columns[bo].data, build_types[bo], c),
          sorted_build.columns[bo].validity)
-        for so, bo in zip(stream_keys, build_keys))
-    key_types = tuple(stream_types[so] for so in stream_keys)
+        for so, bo, c in zip(stream_keys, build_keys, commons))
+    key_types = tuple(commons)
     pi, bi, match = _expand_verify(lo, hi, counts, total, key_pairs,
                                    key_types, out_cap)
 

@@ -49,8 +49,15 @@ class SortKeySpec:
 def canonicalize_floats(x: jax.Array) -> jax.Array:
     """-0.0 -> +0.0 and all NaNs -> one canonical quiet NaN
     (NormalizeFloatingNumbers analogue, reference
-    sql-plugin/.../NormalizeFloatingNumbers.scala)."""
-    x = x + jnp.zeros((), dtype=x.dtype)  # -0.0 + 0.0 == +0.0
+    sql-plugin/.../NormalizeFloatingNumbers.scala).
+
+    NOT ``x + 0``: XLA's algebraic simplifier folds add-zero away inside
+    larger fused programs (observed on the CPU backend), silently
+    keeping -0.0's sign bit. The select below survives optimization
+    because IEEE ``-0.0 == 0.0`` is true, so both zeros take the +0.0
+    branch."""
+    zero = jnp.zeros((), dtype=x.dtype)
+    x = jnp.where(x == zero, zero, x)
     return jnp.where(jnp.isnan(x), jnp.asarray(jnp.nan, dtype=x.dtype), x)
 
 

@@ -565,3 +565,52 @@ def walk(node: PlanNode):
     yield node
     for c in node.children:
         yield from walk(c)
+
+
+_INPUT_FILE_EXPRS = ("InputFileName", "InputFileBlockStart",
+                     "InputFileBlockLength")
+
+
+def gate_split_packing(plan: PlanNode) -> None:
+    """input_file_name/block exprs need per-file batch identity, which a
+    packed multi-file scan partition cannot provide — disable packing on
+    every file source when the plan reads them (the reference likewise
+    gates its small-file optimization off under these expressions,
+    GpuFileSourceScanExec's canUseSmallFileOpt). Engine-neutral (both
+    the CPU oracle and the TPU planner call it), so detection is by
+    class name, not import."""
+
+    def expr_has(e) -> bool:
+        if type(e).__name__ in _INPUT_FILE_EXPRS:
+            return True
+        return any(expr_has(c) for c in getattr(e, "children", ()))
+
+    def node_has(n) -> bool:
+        for v in vars(n).values():
+            items = v if isinstance(v, (list, tuple)) else [v]
+            for x in items:
+                if hasattr(x, "children") and hasattr(x, "dtype") and \
+                        expr_has(x):
+                    return True
+                fn = getattr(x, "fn", None)  # AggCall
+                if fn is not None and getattr(fn, "input", None) \
+                        is not None and expr_has(fn.input):
+                    return True
+        return any(node_has(c) for c in n.children)
+
+    if not node_has(plan):
+        return
+    for n in walk(plan):
+        src = getattr(n, "source", None)
+        if src is not None and getattr(src, "pack_splits", False):
+            # the source may be shared with a concurrently executing
+            # scan — mutate split state only under its own lock so a
+            # reader never sees pack_splits flipped mid-read
+            lock = getattr(src, "_lock", None)
+            if lock is not None:
+                with lock:
+                    src.pack_splits = False
+                    src._splits = None  # re-derive unpacked
+            else:
+                src.pack_splits = False
+                src._splits = None

@@ -1143,13 +1143,23 @@ def apply_overrides(plan: pn.PlanNode,
 
         plan = optimize(plan)
     plan = push_down_file_filters(plan, conf)
+    pn.gate_split_packing(plan)
     meta = NodeMeta(plan, conf)
     meta.tag_for_tpu()
     explain_mode = conf.get(cfg.EXPLAIN).upper()
     if explain_mode in ("ALL", "NOT_ON_TPU"):
         print(meta.explain(only_not_on_tpu=explain_mode == "NOT_ON_TPU"))
-    exec_ = meta.convert()
-    exec_ = insert_coalesce(exec_)
+    # plan-time partition-count queries must see STATIC shuffle counts:
+    # without this, a rule asking an adaptive reader for num_partitions
+    # materializes (executes!) the whole map stage mid-planning, before
+    # fusion/coalesce have rewritten the subtree
+    with adaptive_exec.planning_mode():
+        exec_ = meta.convert()
+        if conf.get(cfg.FUSION_ENABLED):
+            from spark_rapids_tpu.execs.fused import fuse_pipelines
+
+            exec_ = fuse_pipelines(exec_, conf)
+        exec_ = insert_coalesce(exec_)
     if _cluster_mode(conf):
         from spark_rapids_tpu.runtime.cluster import (
             install_cluster_exchanges, session_cluster)

@@ -29,6 +29,21 @@ _eager_calls = 0
 _transfers = 0
 _compiled_fns: list = []
 
+# -- measured device timing (serialized mode) -------------------------------
+# When enabled, every counted jit call BLOCKS until its result is ready
+# and records (elapsed - RTT floor) as that kernel's measured device
+# time, attributed per function name. This measures rather than infers
+# on-device time (round-4 verdict: "is the chip actually busy" was
+# inferred from dispatch counts). Serializing kills dispatch pipelining,
+# so wall clock inflates — run it as a separate measurement pass, never
+# during the timed iterations. Caveat: on relay backends where
+# block_until_ready can return before remote execution completes the
+# per-kernel split undercounts; the runner cross-checks the sum against
+# the wall-based estimate and reports both.
+_device_timing = False
+_rtt_floor = 0.0
+_kernel_times: dict = {}
+
 
 def install() -> None:
     """Wrap jax.jit / eager primitive application / device_get with
@@ -46,14 +61,25 @@ def install() -> None:
         compiled = real_jit(fn, **kw)
         _compiled_fns.append(compiled)
 
+        name = getattr(fn, "__qualname__", None) or \
+            getattr(fn, "__name__", repr(fn))
+
         class _Counted:
             def __call__(self, *a, **k):
                 global _jit_calls
                 _jit_calls += 1
-                return compiled(*a, **k)
+                if not _device_timing:
+                    return compiled(*a, **k)
+                t0 = time.perf_counter()
+                out = compiled(*a, **k)
+                jax.block_until_ready(out)
+                dt = max(time.perf_counter() - t0 - _rtt_floor, 0.0)
+                calls, secs = _kernel_times.get(name, (0, 0.0))
+                _kernel_times[name] = (calls + 1, secs + dt)
+                return out
 
-            def __getattr__(self, name):
-                return getattr(compiled, name)
+            def __getattr__(self, name_):
+                return getattr(compiled, name_)
 
         w = _Counted()
         try:
@@ -115,6 +141,29 @@ def executable_count() -> int:
         except Exception:
             total += 1
     return total
+
+
+def enable_device_timing() -> None:
+    """Start serialized per-kernel device-time measurement (requires
+    install()). Measures the RTT floor once so each sample subtracts
+    the fixed dispatch overhead."""
+    global _device_timing, _rtt_floor, _kernel_times
+    assert _installed, "dispatch.install() must run first"
+    _rtt_floor = measure_rtt()
+    _kernel_times = {}
+    _device_timing = True
+
+
+def disable_device_timing() -> dict:
+    """Stop measuring; returns {kernel_name: (calls, device_seconds)}
+    plus the totals under the '__total__' key."""
+    global _device_timing
+    _device_timing = False
+    out = dict(_kernel_times)
+    total_calls = sum(c for c, _ in out.values())
+    total_s = sum(s for _, s in out.values())
+    out["__total__"] = (total_calls, total_s)
+    return out
 
 
 def measure_rtt(samples: int = 5) -> float:

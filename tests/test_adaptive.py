@@ -51,7 +51,12 @@ def multifile_scan(tmp_path):
             "v": rng.random(n),
         })
         pq.write_table(t, tmp_path / f"f{k}.parquet")
-    return pn.ScanNode(ParquetSource(str(tmp_path)))
+    src = ParquetSource(str(tmp_path))
+    # these tests exercise multi-partition shuffle structure: keep the
+    # tiny files as separate scan partitions (packing would collapse
+    # the plan to a single partition and erase the exchanges under test)
+    src.pack_splits = False
+    return pn.ScanNode(src)
 
 
 def _agg_plan(scan):
@@ -129,7 +134,9 @@ def test_distributed_global_sort_range_partitioned(tmp_path):
             {"v": rng.random(400) * 1000,
              "tag": rng.integers(0, 5, 400).astype(np.int64)}),
             tmp_path / f"s{k}.parquet")
-    scan = pn.ScanNode(ParquetSource(str(tmp_path)))
+    src = ParquetSource(str(tmp_path))
+    src.pack_splits = False  # multi-partition structure under test
+    scan = pn.ScanNode(src)
     plan = pn.SortNode([SortKeySpec.spark_default(0)], scan)
     conf = RapidsConf({"rapids.tpu.sql.test.enabled": True})
     exec_ = apply_overrides(plan, conf)
@@ -158,7 +165,9 @@ def test_distributed_sort_descending_strings(tmp_path):
                          for x in rng.integers(0, 40, 200)], dtype=object)
         pq.write_table(pa.table({"s": pa.array(strs, type=pa.string())}),
                        tmp_path / f"p{k}.parquet")
-    scan = pn.ScanNode(ParquetSource(str(tmp_path)))
+    src = ParquetSource(str(tmp_path))
+    src.pack_splits = False  # multi-partition structure under test
+    scan = pn.ScanNode(src)
     plan = pn.SortNode([SortKeySpec.spark_default(0, ascending=False)],
                        scan)
     from spark_rapids_tpu.cpu.engine import execute_cpu
@@ -188,7 +197,9 @@ def test_distributed_multikey_global_sort(tmp_path):
     from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
     from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 
-    scan = pn.ScanNode(ParquetSource(str(tmp_path)))
+    src = ParquetSource(str(tmp_path))
+    src.pack_splits = False  # multi-partition structure under test
+    scan = pn.ScanNode(src)
     plan = pn.SortNode(
         [SortKeySpec.spark_default(0),
          SortKeySpec.spark_default(2, ascending=False),

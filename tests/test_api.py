@@ -213,10 +213,16 @@ def test_repartition_roundtrip(session, pdf):
 
 
 def test_coalesce_partitions(session, tmp_path, pdf):
+    from spark_rapids_tpu.api import Session
+
     for k in range(6):
         pq.write_table(pa.Table.from_pandas(pdf.iloc[k * 60:(k + 1) * 60]),
                        tmp_path / f"f{k}.parquet")
-    df = session.read.parquet(str(tmp_path))
+    # a tiny reader byte target keeps the six small files as six scan
+    # partitions (FilePartition packing would fold them into one,
+    # leaving coalesce(2) nothing to do)
+    s = Session(conf={"rapids.tpu.sql.reader.batchSizeBytes": 1024})
+    df = s.read.parquet(str(tmp_path))
     c = df.coalesce(2)
     exec_ = c._exec()
     assert exec_.num_partitions == 2

@@ -77,10 +77,14 @@ def test_parquet_multifile_threadpool(tmp_path):
         pq.write_table(_mixed_table(200, seed=k),
                        tmp_path / f"part-{k}.parquet")
     src = ParquetSource(str(tmp_path))
-    assert src.num_splits() == 6
+    # six tiny files PACK into one scan partition (Spark's
+    # FilePartition packing under maxPartitionBytes)
+    assert src.num_splits() == 1
+    unpacked = ParquetSource(str(tmp_path))
+    unpacked.pack_splits = False
+    assert unpacked.num_splits() == 6
     plan = pn.ScanNode(src)
     exec_ = assert_cpu_and_tpu_equal(plan)
-    # splits surfaced as scan partitions (FilePartition model)
     assert exec_ is not None
     data, _ = src.read_host()  # threaded whole-read path
     assert len(data["i"]) == 1200
@@ -278,7 +282,11 @@ def test_csv_delimiter_and_multifile(tmp_path):
                 f.write(f"{k * 10 + i}|x{i}\n")
     schema = Schema(["a", "b"], [dt.INT64, dt.STRING])
     src = CsvSource(str(tmp_path), schema=schema, delimiter="|")
-    assert src.num_splits() == 3
+    # tiny files pack into one partition; raw splits stay per-file
+    assert src.num_splits() == 1
+    unpacked = CsvSource(str(tmp_path), schema=schema, delimiter="|")
+    unpacked.pack_splits = False
+    assert unpacked.num_splits() == 3
     plan = pn.ScanNode(src)
     assert_cpu_and_tpu_equal(plan)
 

@@ -45,10 +45,14 @@ def test_multi_partition_structure(tmp_path):
         pq.write_table(pa.table(
             {"x": np.arange(k * 100, k * 100 + 100, dtype=np.int64)}),
             tmp_path / f"f{k}.parquet")
+    # per-file partitions: disable FilePartition packing so the three
+    # tiny files stay three scan partitions (the structure under test)
+    src = ParquetSource(str(tmp_path))
+    src.pack_splits = False
     plan = _proj(
         [BoundReference(0, dt.INT64), nd.SparkPartitionID(),
          nd.MonotonicallyIncreasingID(), nd.Rand(seed=3)],
-        ["x", "pid", "mid", "r"], pn.ScanNode(ParquetSource(str(tmp_path))))
+        ["x", "pid", "mid", "r"], pn.ScanNode(src))
     df = collect(apply_overrides(plan, CONF))
     pids = df["pid"].astype(int)
     mids = df["mid"].astype(int)

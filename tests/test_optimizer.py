@@ -266,8 +266,15 @@ def test_small_build_side_broadcasts_instead_of_shuffling():
         pn.ScanNode(pn.InMemorySource(small)), [0], [0])
 
     def top_join(e):
+        from spark_rapids_tpu.execs.fused import FusedChainExec
+
         while not isinstance(e, (BroadcastHashJoinExec,
                                  ShuffledHashJoinExec)):
+            if isinstance(e, FusedChainExec):
+                # the broadcast join was absorbed into a fused chain;
+                # its unfused form is preserved as the fallback subtree
+                e = e.fallback
+                continue
             e = e.children[0]
         return e
 

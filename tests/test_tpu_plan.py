@@ -404,7 +404,15 @@ def test_filter_fuses_into_aggregate():
 
     assert not find(ex, FilterExec), "filter must fuse into the agg"
     aggs = find(ex, HashAggregateExec)
-    assert any(a.fused_filter is not None for a in aggs)
+    # the filter mask must ride into the groupby sort: either as the
+    # agg's fused_filter or absorbed as a FilterStep of a fused chain
+    from spark_rapids_tpu.execs.fused import FilterStep, FusedAggregateExec
+
+    assert any(
+        a.fused_filter is not None or
+        (isinstance(a, FusedAggregateExec) and
+         any(isinstance(st, FilterStep) for st in a.chain.steps))
+        for a in aggs)
     assert_cpu_and_tpu_equal(agg, approx_float=1e-9)
 
     # filter that drops everything: grouped -> zero rows
