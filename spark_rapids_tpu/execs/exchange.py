@@ -55,10 +55,19 @@ class ShuffleExchangeExec(TpuExec):
     ('round_robin',) | ('single',)."""
 
     def __init__(self, partitioning: Tuple, num_out_partitions: int,
-                 child: TpuExec, task_threads: int = 1):
+                 child: TpuExec, task_threads: int = 1,
+                 batch_bytes: Optional[int] = None):
         super().__init__([child], child.schema)
         self.partitioning = partitioning
         self.num_out_partitions = num_out_partitions
+        # bound for the range-exchange tiny-input collapse: the staged
+        # input must fit ONE configured batch for a single sort task to
+        # be the right plan (conf batchSizeBytes when the planner wires
+        # it; capped by the spill chunk budget either way)
+        self.collapse_bytes = min(
+            self.CHUNK_BYTE_BUDGET,
+            batch_bytes if batch_bytes is not None
+            else self.CHUNK_BYTE_BUDGET)
         # default 1 (serial): concurrency is an OPT-IN the planner wires
         # from rapids.tpu.sql.taskThreads — unplumbed construction sites
         # (python-UDF exchanges running arbitrary user code, tests) must
@@ -135,7 +144,7 @@ class ShuffleExchangeExec(TpuExec):
                 row_bytes = max(sum(t.byte_width
                                     for t in self.schema.types), 1)
                 if self.num_out_partitions > 1 and \
-                        total_rows * row_bytes <= self.CHUNK_BYTE_BUDGET:
+                        total_rows * row_bytes <= self.collapse_bytes:
                     # adaptive collapse: tiny staged input -> single
                     # partition, no bounds sampling, no partition kernel
                     self.num_out_partitions = 1

@@ -335,10 +335,17 @@ class FusedChain:
 
         # distinct per-chain names so dispatch telemetry attributes each
         # chain program separately (every chain would otherwise report
-        # as one 'run' bucket)
+        # as one 'run' bucket). The crc tag separates chains that share
+        # a step-type shape but compile different expressions (q9's five
+        # filter+project branches)
+        import zlib
+
+        key = self.chain_key(compact_out)
+        tag = zlib.crc32(repr(key if key is not None
+                              else id(self)).encode()) & 0xFFFF
         label = "fused_chain[" + "+".join(
             type(s).__name__.replace("Step", "").lower()
-            for s in steps) + "]"
+            for s in steps) + f"]@{tag:04x}"
         run.__name__ = run.__qualname__ = label
         return partial(jax.jit, static_argnames=("types",))(run)
 
@@ -441,6 +448,14 @@ def _apply_join(step: JoinStep, cols: List[ColV], live,
 # ---------------------------------------------------------------------------
 
 
+def _build_key_specs(steps) -> list:
+    """(build_keys, build_types, key_common) per JoinStep — the inputs
+    prepare_build needs, shared by both fused execs."""
+    return [(tuple(s.build_keys), tuple(s.build_types),
+             tuple(s.key_common))
+            for s in steps if isinstance(s, JoinStep)]
+
+
 class FusedChainExec(TpuExec):
     """Standalone fused segment: filters/projections/broadcast probes in
     one program per batch, compacted once at the end (lazy row count).
@@ -454,10 +469,7 @@ class FusedChainExec(TpuExec):
         self.chain = chain
         self.builds = builds
         self.fallback = fallback
-        self.build_key_specs = [
-            (tuple(s.build_keys), tuple(s.build_types),
-             tuple(s.key_common))
-            for s in chain.steps if isinstance(s, JoinStep)]
+        self.build_key_specs = _build_key_specs(chain.steps)
         self._preps: Optional[List[PreparedBuild]] = None
         self._preps_ok: Optional[bool] = None
         self._prep_lock = threading.Lock()
@@ -515,12 +527,37 @@ class FusedChainExec(TpuExec):
         return timed(self, it())
 
     def tree_string(self, indent: int = 0) -> str:
-        label = "  " * indent + self.name
-        label += f" [{len(self.chain.steps)} fused steps]"
-        lines = [label]
-        for c in self.children:
-            lines.append(c.tree_string(indent + 1))
-        return "\n".join(lines)
+        return _fused_tree_string(self, indent,
+                                  f"[{len(self.chain.steps)} fused steps]")
+
+    def all_metrics(self):
+        return _fused_all_metrics(self)
+
+
+def _fused_tree_string(exec_, indent: int, note: str) -> str:
+    """Explain output for a fused exec — when the duplicate-build
+    fallback ran, the UNfused subtree did the work and must be what
+    explain shows (degradation is never silent, same rule as cluster
+    local-placement)."""
+    label = "  " * indent + exec_.name + " " + note
+    if exec_._preps_ok is False:
+        label += " [FELL BACK: duplicate build key hashes]"
+        return "\n".join([label,
+                          exec_.fallback.tree_string(indent + 1)])
+    lines = [label]
+    for c in exec_.children:
+        lines.append(c.tree_string(indent + 1))
+    return "\n".join(lines)
+
+
+def _fused_all_metrics(exec_):
+    out = {exec_.name: exec_.metrics}
+    if exec_._preps_ok is False:
+        out.update(exec_.fallback.all_metrics())
+    else:
+        for c in exec_.children:
+            out.update(c.all_metrics())
+    return out
 
 
 class FusedAggregateExec(agg_exec.HashAggregateExec):
@@ -540,15 +577,19 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
         if fallback.fused_filter is not None:
             steps.append(FilterStep(fallback.fused_filter.condition))
         assert self.input_proj is not None
-        steps.append(ProjectStep(self.input_proj.exprs))
+        # absorb the input projection only when it can trace: dictionary-
+        # dependent string expressions must keep CompiledProjection's
+        # eager path (it carries the source StringColumn; the chain's
+        # ColVs don't)
+        self._proj_in_chain = self.input_proj.fused and all(
+            e.deterministic for e in self.input_proj.exprs)
+        if self._proj_in_chain:
+            steps.append(ProjectStep(self.input_proj.exprs))
         self.chain = FusedChain(steps, list(source.schema.types),
                                 len(builds))
         self.builds = builds
         self.fallback = fallback
-        self.build_key_specs = [
-            (tuple(s.build_keys), tuple(s.build_types),
-             tuple(s.key_common))
-            for s in self.chain.steps if isinstance(s, JoinStep)]
+        self.build_key_specs = _build_key_specs(self.chain.steps)
         self._preps: Optional[List[PreparedBuild]] = None
         self._preps_ok: Optional[bool] = None
         self._prep_lock = threading.Lock()
@@ -562,7 +603,12 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
             outs, live = self.chain.run(b, self._preps,
                                         compact_out=False)
         ghosts = self.chain.ghost_walk(b, self._preps)
-        return self.chain.wrap(outs, ghosts, b.num_rows), live
+        out = self.chain.wrap(outs, ghosts, b.num_rows)
+        if not self._proj_in_chain:
+            # eager projection outside the chain (string dictionary
+            # ops); row-aligned, so the live-mask stays valid
+            out = self.input_proj(out)
+        return out, live
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         if not self._ensure_preps():
@@ -570,12 +616,12 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
         return super().execute(partition)
 
     def tree_string(self, indent: int = 0) -> str:
-        label = "  " * indent + self.name
-        label += f" [{len(self.chain.steps)} fused steps, {self.mode}]"
-        lines = [label]
-        for c in self.children:
-            lines.append(c.tree_string(indent + 1))
-        return "\n".join(lines)
+        return _fused_tree_string(
+            self, indent,
+            f"[{len(self.chain.steps)} fused steps, {self.mode}]")
+
+    def all_metrics(self):
+        return _fused_all_metrics(self)
 
 
 # ---------------------------------------------------------------------------

@@ -516,7 +516,8 @@ class _SortRule(NodeRule):
                 # GpuSortExec, avoiding the SURVEY §5.7 cliff)
                 child = exchange.ShuffleExchangeExec(
                     ("range", list(node.specs), None), parts, child,
-                    task_threads=meta.conf.get(cfg.TASK_THREADS))
+                    task_threads=meta.conf.get(cfg.TASK_THREADS),
+                    batch_bytes=meta.conf.get(cfg.BATCH_SIZE_BYTES))
             else:
                 child = exchange.ShuffleExchangeExec(
                     ("single",), 1, child,
