@@ -60,11 +60,7 @@ class MapOutputStatistics:
     @staticmethod
     def of(exchange: ShuffleExchangeExec) -> "MapOutputStatistics":
         exchange._materialize()
-        sizes = []
-        for p in range(exchange.num_out_partitions):
-            sizes.append(sum(h.device_memory_size()
-                             for h in exchange._blocks[p]))
-        return MapOutputStatistics(sizes)
+        return MapOutputStatistics(exchange.map_output_sizes())
 
     def skewed_partitions(self, factor: float = 5.0,
                           threshold: int = 256 << 20) -> List[int]:
@@ -128,6 +124,15 @@ class AdaptiveShuffleReaderExec(TpuExec):
     def exchange(self) -> ShuffleExchangeExec:
         return self.children[0]
 
+    # group providers are closures over live exchange objects; shipping
+    # inside a cluster task closure resolves groups first (the cluster
+    # runtime's task_tree forces self.groups before pickling) and drops
+    # the provider — the worker reads the frozen spec
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_groups_provider"] = None
+        return state
+
     @property
     def groups(self) -> List[List[int]]:
         if self._groups is None:
@@ -171,16 +176,24 @@ def paired_adaptive_readers(left: ShuffleExchangeExec,
     contract survives coalescing."""
     assert left.num_out_partitions == right.num_out_partitions
     cache: List[Optional[List[List[int]]]] = [None]
+    readers: List[AdaptiveShuffleReaderExec] = []
 
     def provider():
+        # read through the READERS' current children, not the captured
+        # exchanges: a post-planning pass (cluster mode) may swap the
+        # exchange object underneath, and stats must come from the one
+        # that actually materializes
         if cache[0] is None:
-            ls = MapOutputStatistics.of(left)
-            rs = MapOutputStatistics.of(right)
+            ls = MapOutputStatistics.of(readers[0].exchange)
+            rs = MapOutputStatistics.of(readers[1].exchange)
             combined = MapOutputStatistics(
                 [a + b for a, b in zip(ls.bytes_by_partition,
                                        rs.bytes_by_partition)])
             cache[0] = coalesce_groups(combined, advisory_bytes)
         return cache[0]
 
-    return (AdaptiveShuffleReaderExec(left, advisory_bytes, provider),
-            AdaptiveShuffleReaderExec(right, advisory_bytes, provider))
+    readers.append(AdaptiveShuffleReaderExec(left, advisory_bytes,
+                                             provider))
+    readers.append(AdaptiveShuffleReaderExec(right, advisory_bytes,
+                                             provider))
+    return readers[0], readers[1]

@@ -113,6 +113,14 @@ class FilterExec(TpuExec):
         super().__init__([child], child.schema)
         self.filter = CompiledFilter(condition, conf)
 
+    def __getstate__(self):
+        # the mesh layer may cache a compiled sharded filter step on
+        # this exec (parallel/execs._apply_mesh_filter); it holds live
+        # Device handles and must not ship in cluster task closures
+        state = dict(self.__dict__)
+        state.pop("_mesh_filter_step", None)
+        return state
+
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.expressions.nondeterministic import TaskInfo
 

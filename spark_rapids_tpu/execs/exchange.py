@@ -231,6 +231,13 @@ class ShuffleExchangeExec(TpuExec):
                     sub, priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
         return blocks
 
+    def map_output_sizes(self) -> List[int]:
+        """Per-reduce-partition byte sizes of the materialized map output
+        (MapStatus sizes; cluster exchanges answer from the tracker)."""
+        assert self._blocks is not None, "materialize first"
+        return [sum(h.device_memory_size() for h in self._blocks[p])
+                for p in range(self.num_out_partitions)]
+
     def _input_batches(self):
         for in_p in range(self.children[0].num_partitions):
             for b in self.children[0].execute(in_p):

@@ -282,7 +282,35 @@ def _drain_exec(child: TpuExec) -> ColumnarBatch:
     return batches[0] if len(batches) == 1 else concat_batches(batches)
 
 
-class MeshGroupByExec(HashAggregateExec):
+class _MeshShippable:
+    """Cluster map-task pickling for mesh execs: the live Mesh (Device
+    handles) and compiled step caches stay behind; only the axis SIZE
+    ships, and the receiving executor reconstructs an equivalent mesh
+    over its own devices (parallel/mesh.py reconstruct_mesh) — the
+    round-4 verdict's mesh-inside-cluster composition. Workers must
+    boot with enough (virtual) devices; the cluster runtime passes the
+    session mesh size to every spawned worker."""
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        mesh = state.pop("mesh", None)
+        state.pop("_steps", None)
+        state.pop("_dstep", None)
+        state["_mesh_n"] = None if mesh is None else \
+            int(mesh.shape[DATA_AXIS])
+        return state
+
+    def __setstate__(self, state):
+        from spark_rapids_tpu.parallel.mesh import reconstruct_mesh
+
+        n = state.pop("_mesh_n", None)
+        self.__dict__.update(state)
+        self._steps = {}
+        self._dstep = None
+        self.mesh = None if n is None else reconstruct_mesh(n)
+
+
+class MeshGroupByExec(_MeshShippable, HashAggregateExec):
     """Complete-mode aggregation lowered onto the mesh: the partial/
     exchange/final pipeline collapses into one all_to_all + local-groupby
     program per chip (hash routing gives each chip a disjoint key space,
@@ -363,7 +391,7 @@ class MeshGroupByExec(HashAggregateExec):
         return timed(self, it())
 
 
-class MeshShuffledJoinExec(TpuExec):
+class MeshShuffledJoinExec(_MeshShippable, TpuExec):
     """Equi-join lowered onto the mesh. Build side is chosen at execute
     time by realized row counts (the AQE-style smallest-side heuristic);
     the unique-build contract is checked in-program and violations fall
@@ -639,7 +667,7 @@ class MeshShuffledJoinExec(TpuExec):
         return timed(self, it())
 
 
-class MeshWindowExec(WindowExec):
+class MeshWindowExec(_MeshShippable, WindowExec):
     """Window functions lowered onto the mesh: the planner's hash
     exchange on PARTITION BY keys + per-partition window
     (GpuWindowExec.scala:92) fuse into one all_to_all + per-chip
@@ -727,7 +755,7 @@ class MeshWindowExec(WindowExec):
         return timed(self, it())
 
 
-class MeshSortExec(TpuExec):
+class MeshSortExec(_MeshShippable, TpuExec):
     """Global ORDER BY lowered onto the mesh: sampled range bounds +
     all_to_all routing + per-chip lexicographic sort in ONE program
     (parallel/sort_step.py) — the multi-chip answer to the reference's

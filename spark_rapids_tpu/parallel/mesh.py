@@ -56,6 +56,29 @@ def session_mesh(conf) -> Optional[Mesh]:
     return _SESSION_MESH
 
 
+_RECONSTRUCTED: dict = {}
+
+
+def reconstruct_mesh(n: int) -> Mesh:
+    """Worker-side mesh reconstruction from a shipped spec (axis size):
+    cluster map tasks carry mesh subtrees as specs, never live Device
+    handles — the receiving process builds an equivalent mesh over its
+    OWN devices (the reference ships GPU ids and re-opens handles
+    per-executor the same way, GpuDeviceManager.scala:72-118). Cached
+    per size: identity-stable meshes keep shard_map caches warm."""
+    got = _RECONSTRUCTED.get(n)
+    if got is not None:
+        return got
+    devs = jax.devices()
+    assert len(devs) >= n, (
+        f"shipped mesh subtree needs {n} devices; this process has "
+        f"{len(devs)} — spawn executors with "
+        f"xla_force_host_platform_device_count >= {n}")
+    m = data_mesh(n)
+    _RECONSTRUCTED[n] = m
+    return m
+
+
 def force_cpu_mesh(n_devices: int) -> None:
     """Ensure at least ``n_devices`` devices exist, falling back to a
     virtual CPU mesh when the attached backend has fewer (e.g. one real

@@ -310,10 +310,11 @@ def _adaptive_read(ex: exchange.ShuffleExchangeExec,
                    conf: RapidsConf) -> TpuExec:
     """Wrap a multi-partition exchange in an adaptive coalescing reader
     (AQE's coalesce-shuffle-partitions applied with exact statistics).
-    Cluster mode bypasses AQE: the group provider captures the exchange's
-    in-process block store, which cluster exchanges don't populate."""
-    if not conf.get(cfg.ADAPTIVE_ENABLED) or ex.num_out_partitions <= 1 \
-            or _cluster_mode(conf):
+    Works under cluster mode too: statistics come from the exchange's
+    ``map_output_sizes`` — the cluster subclass answers from the
+    MapOutputTracker's MapStatus sizes instead of an in-process block
+    store (GpuShuffleExchangeExec.scala:95-101 map stats future)."""
+    if not conf.get(cfg.ADAPTIVE_ENABLED) or ex.num_out_partitions <= 1:
         return ex
     return adaptive_exec.AdaptiveShuffleReaderExec(
         ex, conf.get(cfg.ADVISORY_PARTITION_SIZE))
@@ -697,9 +698,9 @@ class _JoinRule(NodeRule):
                                                task_threads=tt)
             rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right,
                                                task_threads=tt)
-            if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1 and \
-                    not _cluster_mode(meta.conf):
+            if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1:
                 # one shared group spec keeps the sides partition-aligned
+                # (cluster mode included: stats come from the tracker)
                 left, right = adaptive_exec.paired_adaptive_readers(
                     lex, rex,
                     meta.conf.get(cfg.ADVISORY_PARTITION_SIZE))

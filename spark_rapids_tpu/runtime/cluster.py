@@ -68,6 +68,62 @@ def run_map_partitions(batches, partitioning, types, num_out: int
     return parts
 
 
+def sample_rows_host(batches, schema: Schema, k: int, seed: int = 0x5EED):
+    """Uniform row sample of executed batches as HOST arrays (raw kernel
+    values — dates stay day counts, strings decode to objects) plus the
+    TOTAL row count — the map-side half of cluster range-bounds
+    sampling (GpuRangePartitioner.scala:42-95's sampling job; the total
+    lets the driver weight each map's contribution by its size)."""
+    import numpy as np
+
+    live = [b for b in batches if b.realized_num_rows() > 0]
+    rng = np.random.default_rng(seed)
+    per_batch = max(k // max(len(live), 1), 1)
+    datas = {n: [] for n in schema.names}
+    valids = {n: [] for n in schema.names}
+    total = 0
+    for b in live:
+        n = b.realized_num_rows()
+        total += n
+        idx = np.arange(n) if n <= per_batch else \
+            rng.choice(n, per_batch, replace=False)
+        for name, col in zip(schema.names, b.columns):
+            vals, valid = col.to_numpy(n)
+            datas[name].append(np.asarray(vals)[idx])
+            valids[name].append(
+                np.asarray(valid)[idx] if valid is not None
+                else np.ones(len(idx), dtype=bool))
+    out_d = {n: (np.concatenate(v) if v else np.array([]))
+             for n, v in datas.items()}
+    out_v = {n: (np.concatenate(v) if v else np.array([], dtype=bool))
+             for n, v in valids.items()}
+    return out_d, out_v, total
+
+
+def host_sample_to_batch(data: dict, validity: dict,
+                         schema: Schema) -> ColumnarBatch:
+    """Rebuild one device batch from host sample arrays (driver side)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.column import Column, StringColumn
+
+    n = len(next(iter(data.values()))) if data else 0
+    cols = []
+    for name, t in zip(schema.names, schema.types):
+        vals = np.asarray(data[name])
+        valid = np.asarray(validity[name], dtype=bool)
+        if t is dt.STRING:
+            svals = [v if valid[i] else None
+                     for i, v in enumerate(vals)]
+            cols.append(StringColumn.from_strings(svals))
+        else:
+            cols.append(Column.from_numpy(
+                vals, dtype=t,
+                validity=None if valid.all() else valid))
+    return ColumnarBatch(cols, n)
+
+
 class ExecutorContext:
     """The process-local executor identity a ``ClusterShuffleReadExec``
     reads through: its catalog (local hits), its transport (peer
@@ -112,7 +168,7 @@ class ClusterShuffleReadExec(TpuExec):
 
     def __init__(self, schema: Schema, shuffle_id: int, num_out: int,
                  num_maps: int,
-                 map_outputs: Dict[int, Tuple[str, frozenset]],
+                 map_outputs: Dict[int, Tuple[str, dict]],
                  addresses: Dict[str, Tuple[str, int]]):
         super().__init__([], schema)
         self.shuffle_id = shuffle_id
@@ -166,9 +222,11 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
     per-process block dict."""
 
     def __init__(self, partitioning, num_out: int, child: TpuExec,
-                 runtime: "ClusterRuntime", task_threads: int = 1):
+                 runtime: "ClusterRuntime", task_threads: int = 1,
+                 batch_bytes: Optional[int] = None):
         super().__init__(partitioning, num_out, child,
-                         task_threads=task_threads)
+                         task_threads=task_threads,
+                         batch_bytes=batch_bytes)
         self.runtime = runtime
         self.shuffle_id: Optional[int] = None
         # set by ClusterRuntime.new_shuffle_id before map tasks run, so
@@ -184,7 +242,8 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
     def wrap(cls, ex: ShuffleExchangeExec, runtime: "ClusterRuntime"
              ) -> "ClusterShuffleExchangeExec":
         return cls(ex.partitioning, ex.num_out_partitions,
-                   ex.children[0], runtime, task_threads=ex.task_threads)
+                   ex.children[0], runtime, task_threads=ex.task_threads,
+                   batch_bytes=ex.collapse_bytes)
 
     def tree_string(self, indent: int = 0) -> str:
         label = "  " * indent + self.name
@@ -204,11 +263,85 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
                 return
             sid = self.runtime.new_shuffle_id(self)
             child = self.children[0]
+            if self.partitioning[0] == "range" and \
+                    (len(self.partitioning) < 3 or
+                     self.partitioning[2] is None):
+                self._resolve_range_bounds(sid)
             with TraceRange("ClusterShuffleExchangeExec.map"):
                 for map_id in range(child.num_partitions):
                     self.runtime.run_map_task(self, sid, map_id)
             self.shuffle_id = sid
             self._read_stub = self.make_read_stub()
+
+    #: rows each map task contributes to the bounds sample
+    SAMPLE_ROWS_PER_MAP = 4096
+
+    def _resolve_range_bounds(self, sid: int) -> None:
+        """Cluster range partitioning, the reference's two-job split
+        (GpuRangePartitioner.scala:42-95): a SAMPLING pass runs the
+        child on every executor and returns host key samples, the
+        driver aggregates them into bounds, then the normal map phase
+        ships tasks with bounds attached."""
+        import numpy as np
+
+        from spark_rapids_tpu.memory import priorities
+        from spark_rapids_tpu.memory.spillable import SpillableBatch
+        from spark_rapids_tpu.ops import partition as part_ops
+
+        child = self.children[0]
+        per_map = []  # (data, validity, total_rows)
+        with TraceRange("ClusterShuffleExchangeExec.sampleBounds"):
+            for map_id in range(child.num_partitions):
+                per_map.append(self.runtime.run_sample_task(
+                    self, sid, map_id, self.SAMPLE_ROWS_PER_MAP))
+            total_rows = sum(t for _d, _v, t in per_map)
+            if self.num_out_partitions > 1 and total_rows * max(
+                    sum(t.byte_width for t in self.schema.types), 1) \
+                    <= self.collapse_bytes:
+                # adaptive collapse, cluster edition: a tiny staged
+                # input takes ONE partition — no bounds, no range
+                # kernel in any map task
+                self.num_out_partitions = 1
+                self.partitioning = ("single",)
+                return
+            # weight each map's contribution by its share of the total
+            # rows: unweighted merging over-represents small maps and
+            # skews the quantile bounds (Spark's RangePartitioner
+            # weights per-partition samples the same way)
+            merged_d: dict = {n: [] for n in self.schema.names}
+            merged_v: dict = {n: [] for n in self.schema.names}
+            rng = np.random.default_rng(0x5EED)
+            budget = self.SAMPLE_ROWS_PER_MAP * max(len(per_map), 1)
+            for d, v, t in per_map:
+                have = len(next(iter(d.values()))) if d else 0
+                if have == 0:
+                    continue
+                want = max(int(round(budget * t / max(total_rows, 1))),
+                           1)
+                idx = np.arange(have) if have <= want else \
+                    rng.choice(have, want, replace=False)
+                for n in self.schema.names:
+                    merged_d[n].append(np.asarray(d[n])[idx])
+                    merged_v[n].append(
+                        np.asarray(v[n], dtype=bool)[idx])
+            data = {n: np.concatenate(a) if a else np.array([])
+                    for n, a in merged_d.items()}
+            val = {n: np.concatenate(a) if a else np.array([], bool)
+                   for n, a in merged_v.items()}
+            batch = host_sample_to_batch(data, val, self.schema)
+            staged = [SpillableBatch(
+                batch, priorities.INPUT_FROM_SHUFFLE_PRIORITY)]
+            specs = list(self.partitioning[1])
+            types = list(self.schema.types)
+            if len(specs) > 1:
+                bounds = part_ops.sample_range_bounds_rows(
+                    staged, specs, types, self.num_out_partitions)
+            else:
+                bounds = part_ops.sample_range_bounds_multi(
+                    staged, specs, types, self.num_out_partitions)
+            for sb in staged:
+                sb.close()
+        self.partitioning = ("range", specs, bounds)
 
     def run_map_locally(self, shuffle_id: int, map_id: int,
                         executor_index: int) -> None:
@@ -234,6 +367,20 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
             "types": list(self.schema.types),
             "addresses": self.runtime.addresses(),
         }
+
+    def map_output_sizes(self) -> List[int]:
+        """Per-reduce-partition bytes from the cluster tracker's
+        MapStatus sizes (the in-process exchange reads its block dict;
+        here blocks live in per-executor catalogs across processes) —
+        feeds AQE's coalesced reads in cluster mode."""
+        sid = self.shuffle_id if self.shuffle_id is not None \
+            else self._pending_sid
+        sizes = [0] * self.num_out_partitions
+        for _mid, (_eid, partitions) in \
+                self.runtime.map_outputs_snapshot(sid).items():
+            for p, s in partitions.items():
+                sizes[int(p)] += int(s)
+        return sizes
 
     def make_read_stub(self) -> ClusterShuffleReadExec:
         sid = self.shuffle_id if self.shuffle_id is not None \
@@ -301,7 +448,8 @@ class RemoteWorkerHandle:
         self._lock = threading.Lock()
 
     @classmethod
-    def spawn(cls, executor_id: str) -> "RemoteWorkerHandle":
+    def spawn(cls, executor_id: str,
+              mesh_devices: int = 0) -> "RemoteWorkerHandle":
         import os
         import subprocess
         import sys
@@ -311,6 +459,18 @@ class RemoteWorkerHandle:
         # attached TPU (a real deployment gives each its own chip)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
+        if mesh_devices >= 2:
+            # shipped mesh subtrees reconstruct their mesh from THIS
+            # process's devices (parallel/mesh.reconstruct_mesh): give
+            # the worker the session's mesh width in virtual devices —
+            # ICI collectives inside the task, TCP shuffle between
+            # executors (SURVEY §5.8 ICI+DCN composition)
+            env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count"
+                                f"={mesh_devices}")
+            # the worker applies this explicitly at startup (the axon
+            # sitecustomize overrides jax config at interpreter start,
+            # so env flags alone don't stick — remote_worker.main)
+            env["SRT_WORKER_MESH_DEVICES"] = str(mesh_devices)
         proc = subprocess.Popen(
             [sys.executable, "-m",
              "spark_rapids_tpu.shuffle.remote_worker"],
@@ -368,12 +528,15 @@ class ClusterRuntime:
     the stage scheduler hooks the cluster exchange calls into."""
 
     def __init__(self, n_executors: int = 2, n_workers: int = 1,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 mesh_devices: int = 0):
         self.cluster = LocalCluster(max(n_executors, 1), transport="tcp",
                                     spill_dir=spill_dir)
+        self.mesh_devices = mesh_devices
         self.workers: List[RemoteWorkerHandle] = []
         for i in range(n_workers):
-            w = RemoteWorkerHandle.spawn(f"exec-worker-{i}")
+            w = RemoteWorkerHandle.spawn(f"exec-worker-{i}",
+                                         mesh_devices=mesh_devices)
             self.workers.append(w)
             self.cluster.register_remote_executor(w.executor_id, w.host,
                                                   w.port)
@@ -463,6 +626,32 @@ class ClusterRuntime:
             self.assignments[shuffle_id][map_id] = \
                 self.cluster.executors[idx].executor_id
 
+    def run_sample_task(self, exchange: "ClusterShuffleExchangeExec",
+                        shuffle_id: int, map_id: int, k: int):
+        """Bounds-sampling pass for one map partition: run it remotely
+        when its round-robin slot is a worker, else locally; either way
+        return host sample arrays (data, validity)."""
+        targets = self.executor_ids()
+        target = targets[next(self._rr) % len(targets)]
+        worker = next((w for w in self.workers
+                       if w.executor_id == target), None)
+        if worker is not None:
+            payload = exchange.task_payload(shuffle_id, map_id)
+            payload["mode"] = "sample"
+            payload["sample_rows"] = k
+            try:
+                reply = worker.run_map(payload)
+                return pickle.loads(
+                    base64.b64decode(reply["sample_b64"]))
+            except (ConnectionError, BrokenPipeError, OSError,
+                    pickle.PicklingError, TypeError, AttributeError,
+                    RemoteTaskError) as e:
+                exchange.local_fallbacks.append(
+                    f"sample task on {target} failed, ran locally: "
+                    f"{type(e).__name__}")
+        child = exchange.children[0]
+        return sample_rows_host(child.execute(map_id), exchange.schema, k)
+
     def _local_index(self, target: str) -> int:
         for i, ex in enumerate(self.cluster.executors):
             if ex.executor_id == target:
@@ -475,9 +664,16 @@ class ClusterRuntime:
         FETCHES upstream stages instead of recomputing them."""
         import copy
 
+        from spark_rapids_tpu.execs.adaptive import \
+            AdaptiveShuffleReaderExec
+
         if isinstance(node, ClusterShuffleExchangeExec):
             node._materialize()
             return node.make_read_stub()
+        if isinstance(node, AdaptiveShuffleReaderExec):
+            # resolve the group spec against the LIVE exchange before
+            # its child becomes a read stub (stats need the tracker)
+            node.groups
         clone = copy.copy(node)
         clone.children = [self.task_tree(c) for c in node.children]
         return clone
@@ -485,7 +681,7 @@ class ClusterRuntime:
     # -- failure recovery (fetch-failure -> stage retry) ------------------
 
     def map_outputs_snapshot(self, shuffle_id: int
-                             ) -> Dict[int, Tuple[str, frozenset]]:
+                             ) -> Dict[int, Tuple[str, dict]]:
         """Tracker snapshot for stub building, serialized against
         recovery so it can never observe a half-recovered shuffle."""
         with self._recover_lock:
@@ -529,12 +725,21 @@ def session_cluster(conf) -> Optional[ClusterRuntime]:
     if conf is None or not conf.get(cfg.CLUSTER_ENABLED):
         return None
     global _SESSION_RUNTIME, _RUNTIME_KEY
-    key = (conf.get(cfg.CLUSTER_EXECUTORS), conf.get(cfg.CLUSTER_WORKERS))
+    mesh_devices = 0
+    if conf.get(cfg.MESH_ENABLED):
+        from spark_rapids_tpu.parallel.mesh import DATA_AXIS, session_mesh
+
+        m = session_mesh(conf)
+        if m is not None:
+            mesh_devices = int(m.shape[DATA_AXIS])
+    key = (conf.get(cfg.CLUSTER_EXECUTORS),
+           conf.get(cfg.CLUSTER_WORKERS), mesh_devices)
     if _SESSION_RUNTIME is None or _RUNTIME_KEY != key:
         if _SESSION_RUNTIME is not None:
             _SESSION_RUNTIME.shutdown()
         _SESSION_RUNTIME = ClusterRuntime(n_executors=key[0],
-                                          n_workers=key[1])
+                                          n_workers=key[1],
+                                          mesh_devices=mesh_devices)
         _RUNTIME_KEY = key
         set_executor_context(ExecutorContext(
             _SESSION_RUNTIME.cluster.executors[0],
@@ -560,12 +765,14 @@ def install_cluster_exchanges(exec_: TpuExec, runtime: ClusterRuntime,
     exec; here the exec itself is the seam). The rewrite is memoized by
     node identity so a shared exchange (CTE/ReuseExchange) stays ONE
     cluster exchange — every parent reads the same materialized shuffle
-    instead of each re-shuffling the shared stage. Range exchanges keep
-    the single-process path (bounds sampling is driver-side). Adaptive
-    shuffle reads are disabled under cluster mode by the planner —
-    their group providers capture exchange block stores directly
-    (execs/adaptive.py:148-153); making AQE cluster-aware is future
-    work, matching the reference v0.3 which also scoped AQE narrowly."""
+    instead of each re-shuffling the shared stage. Adaptive readers work
+    ABOVE cluster exchanges: statistics come from ``map_output_sizes``
+    (tracker MapStatus sizes) and paired join readers resolve through
+    the readers' CURRENT children, so this rewrite flows straight
+    through them (GpuOverrides.scala:1874-1887 role). Range exchanges
+    run cluster-wide too: the driver aggregates per-map key samples,
+    resolves bounds, then ships partition tasks with bounds attached
+    (GpuRangePartitioner.scala:42-95's sample-then-partition split)."""
     if _memo is None:
         _memo = {}
     hit = _memo.get(id(exec_))
@@ -574,7 +781,7 @@ def install_cluster_exchanges(exec_: TpuExec, runtime: ClusterRuntime,
     orig = exec_
     if isinstance(exec_, ShuffleExchangeExec) and \
             not isinstance(exec_, ClusterShuffleExchangeExec) and \
-            exec_.partitioning[0] in ("hash", "single"):
+            exec_.partitioning[0] in ("hash", "single", "range"):
         exec_ = ClusterShuffleExchangeExec.wrap(exec_, runtime)
     exec_.children = [install_cluster_exchanges(c, runtime, _memo)
                       for c in exec_.children]

@@ -97,11 +97,15 @@ class LocalCluster:
             ex.shuffle_catalog.register(
                 BlockId(shuffle_id, map_id, partition), batch)
         with self._lock:
-            # MapStatus: executor + the partitions this map produced.
+            # MapStatus: executor + this map's {partition: byte size}.
             # Reads trust THIS record — a tracked block the owner lost is
-            # a fetch failure, never a silent skip.
+            # a fetch failure, never a silent skip. Sizes feed AQE's
+            # coalesced reads (Spark's MapStatus carries them the same
+            # way, GpuShuffleExchangeExec.scala:95-101 map stats future).
             self._map_outputs.setdefault(shuffle_id, {})[map_id] = (
-                ex.executor_id, frozenset(partition_batches))
+                ex.executor_id,
+                {p: b.device_memory_size()
+                 for p, b in partition_batches.items()})
 
     # -- cross-process peers (tcp transport only) -------------------------
 
@@ -116,10 +120,15 @@ class LocalCluster:
                                    executor_id: str,
                                    partitions) -> None:
         """MapStatus entry for a map task whose output lives on a remote
-        (cross-process) executor."""
+        (cross-process) executor. ``partitions``: {partition: bytes}
+        (a bare iterable of ids is accepted with unknown sizes)."""
+        if not isinstance(partitions, dict):
+            partitions = {int(p): 0 for p in partitions}
+        else:
+            partitions = {int(p): int(s) for p, s in partitions.items()}
         with self._lock:
             self._map_outputs.setdefault(shuffle_id, {})[map_id] = (
-                executor_id, frozenset(partitions))
+                executor_id, partitions)
 
     # -- reduce side ------------------------------------------------------
 

@@ -78,6 +78,20 @@ def run_task_loop(ex, ts) -> None:
                 if eid != ex.executor_id:
                     transport.register_remote(eid, *addr)
             subtree = payload["subtree"]
+            if payload.get("mode") == "sample":
+                # range-bounds sampling pass: run the subtree, return a
+                # host row sample (the driver aggregates into bounds)
+                from spark_rapids_tpu.runtime.cluster import \
+                    sample_rows_host
+
+                sample = sample_rows_host(
+                    subtree.execute(payload["map_id"]),
+                    subtree.schema, payload["sample_rows"])
+                print(json.dumps({
+                    "ok": True, "map_id": payload["map_id"],
+                    "sample_b64": base64.b64encode(
+                        pickle.dumps(sample)).decode()}), flush=True)
+                continue
             parts = run_map_partitions(
                 subtree.execute(payload["map_id"]),
                 payload["partitioning"], payload["types"],
@@ -88,7 +102,11 @@ def run_task_loop(ex, ts) -> None:
                     batch)
             print(json.dumps({"ok": True,
                               "map_id": payload["map_id"],
-                              "partitions": sorted(parts)}),
+                              # MapStatus sizes ride back with the ids
+                              # (AQE coalesced reads need them)
+                              "partitions": {
+                                  str(p): b.device_memory_size()
+                                  for p, b in parts.items()}}),
                   flush=True)
         except Exception:
             print(json.dumps({"ok": False,
@@ -97,7 +115,24 @@ def run_task_loop(ex, ts) -> None:
 
 
 def main() -> None:
+    import os
+
     import spark_rapids_tpu  # noqa: F401
+
+    # the axon sitecustomize forces jax_platforms at interpreter start,
+    # so spawn-time env vars alone don't stick (same workaround as
+    # tests/conftest.py); shipped mesh subtrees additionally need the
+    # session's mesh width in virtual CPU devices
+    import jax
+
+    # FIRST pin the CPU backend (before any device probe): workers must
+    # never compute on — or even initialize — the shared attached TPU
+    jax.config.update("jax_platforms", "cpu")
+    mesh_n = int(os.environ.get("SRT_WORKER_MESH_DEVICES", "0") or 0)
+    if mesh_n >= 2:
+        from spark_rapids_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(mesh_n)
     from spark_rapids_tpu.shuffle.cluster import Executor
     from spark_rapids_tpu.shuffle.meta import BlockId
     from spark_rapids_tpu.shuffle.tcp import Hangup, TcpShuffleServer

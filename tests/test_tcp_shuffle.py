@@ -180,3 +180,36 @@ def test_two_process_hangup_fetch_failure_then_stage_retry(worker_cluster):
     for b in c.read_partition(9, 0, reader_executor_index=0):
         got.extend(v for v in batch_values(b) if v is not None)
     assert sorted(got) == expect_values([(0, 2000)])
+
+
+def test_serving_executor_spills_then_unspills_on_serve(tmp_path):
+    """Round-5 (round-4 weak #3): a serving executor under memory
+    pressure SPILLS its cached shuffle blocks (device -> host/disk) and
+    transparently unspills them when a remote reduce task fetches —
+    the reference's RapidsShuffleInternalManager.scala:249-269
+    catalog-backed unspill-on-serve."""
+    from spark_rapids_tpu.memory.catalog import StorageTier
+
+    # budget far below one map output's bytes forces immediate spill
+    c = LocalCluster(2, spill_dir=str(tmp_path), transport="tcp",
+                     device_budget=4096)
+    try:
+        n = 4000  # int64 data + validity >> 4096 bytes
+        for map_id in range(3):
+            c.write_map_output(7, map_id, 0,
+                               {0: make_block_batch(map_id * 10_000, n)})
+        ex0 = c.executors[0]
+        tiers = [ex0.buffer_catalog.tier_of(sb.buffer_id)
+                 for sb in ex0.shuffle_catalog._blocks.values()]
+        assert any(t != StorageTier.DEVICE for t in tiers), tiers
+
+        # remote read from executor 1: the serving side must unspill
+        got = []
+        for b in c.read_partition(7, 0, reader_executor_index=1):
+            got.extend(v for v in batch_values(b) if v is not None)
+        want = expect_values([(0, n), (10_000, n), (20_000, n)])
+        assert sorted(got) == want
+        it = c.last_iterator
+        assert it.remote_blocks_read == 3  # all served cross-executor
+    finally:
+        c.shutdown()
