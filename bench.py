@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import sys
 import time
 
 import numpy as np
@@ -45,6 +46,43 @@ def seed_compile_cache() -> None:
         target = os.path.join(dst, name)
         if not os.path.exists(target):
             shutil.copy2(os.path.join(src, name), target)
+
+
+def refresh_cache_seed() -> None:
+    """After a TPU bench run, sync the tracked seed with the live
+    cache: new jit_step entries (a kernel edit happened) are copied in
+    and superseded ones pruned, so the driver's end-of-round commit
+    carries the fresh seed automatically — a stale seed costs ONE cold
+    compile on this box instead of a manual refresh ritual (round-4
+    verdict #9)."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return  # cache keys are platform-specific; only seed TPU entries
+    root = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(root, ".jax_cache")
+    dst = os.path.join(root, "scripts", "bench_cache")
+    if not os.path.isdir(src) or not os.path.isdir(dst):
+        return
+    live = {f for f in os.listdir(src) if f.startswith("jit_step-")}
+    if not live:
+        return
+    tracked = set(os.listdir(dst))
+    for f in sorted(live - tracked):
+        shutil.copy2(os.path.join(src, f), os.path.join(dst, f))
+        print(f"bench: refreshed cache seed {f}", file=sys.stderr)
+    # bound the tracked seed: keep the newest few entries (the live
+    # plain + telemetry-wrapped variants); older kernels' multi-MB
+    # binaries age out instead of accumulating. (A set-difference prune
+    # can't work here — seed_compile_cache copies every tracked entry
+    # into .jax_cache at startup, so tracked is always a subset of
+    # live.)
+    seeds = sorted(
+        (f for f in os.listdir(dst) if f.startswith("jit_step-")),
+        key=lambda f: os.path.getmtime(os.path.join(dst, f)),
+        reverse=True)
+    for f in seeds[3:]:
+        os.remove(os.path.join(dst, f))
 
 
 N_ROWS = 4_000_000
@@ -153,6 +191,7 @@ def main():
     seed_compile_cache()
     keys, key_valid, vals = gen_data()
     tpu_dt, tpu_out = bench_tpu(keys, key_valid, vals)
+    refresh_cache_seed()
     cpu_dt, cpu_out = bench_cpu(keys, key_valid, vals)
     full = None
     try:

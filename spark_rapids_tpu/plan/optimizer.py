@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression)
 from spark_rapids_tpu.plan import nodes as pn
@@ -376,6 +377,51 @@ def _push_filters_one(node: pn.PlanNode, _memo) -> pn.PlanNode:
 _FILTER_SELECTIVITY = 0.3
 
 
+def estimate_key_ndv(node: pn.PlanNode, ordinal: int) -> Optional[int]:
+    """Distinct-value estimate for a join key column, derived from file
+    footer statistics where the column traces back to a scan: an
+    integral key with host-known (lo, hi) bounds has NDV <= hi-lo+1,
+    capped by the relation's row estimate. Replaces part of the fixed
+    heuristic cardinality model (round-4 weak #5) with data-driven
+    numbers when footers provide them."""
+    if isinstance(node, pn.FilterNode):
+        return estimate_key_ndv(node.children[0], ordinal)
+    if isinstance(node, pn.ProjectNode):
+        e = node.exprs[ordinal]
+        while isinstance(e, Alias):
+            e = e.children[0]
+        if isinstance(e, BoundReference):
+            return estimate_key_ndv(node.children[0], e.ordinal)
+        return None
+    if isinstance(node, pn.ScanNode):
+        src = node.source
+        try:
+            schema = src.schema()
+            t = schema.types[ordinal]
+            if not (t.is_integral or t in (dt.DATE, dt.TIMESTAMP)):
+                return None
+            name = schema.names[ordinal]
+            splits = getattr(src, "splits", None)
+            if splits is None:
+                return None
+            lo = hi = None
+            for i in range(len(splits())):
+                s = src.split_stats(i)
+                if not s or name not in s:
+                    return None
+                slo, shi = s[name]
+                lo = slo if lo is None else min(lo, slo)
+                hi = shi if hi is None else max(hi, shi)
+            if lo is None:
+                return None
+            span = int(hi) - int(lo) + 1
+            rows = src.estimated_row_count()
+            return max(min(span, rows) if rows is not None else span, 1)
+        except Exception:
+            return None
+    return None
+
+
 def estimate_rows(node: pn.PlanNode) -> Optional[int]:
     """Plan-time cardinality estimate; None = unknown (no reordering)."""
     if isinstance(node, pn.ScanNode):
@@ -395,6 +441,24 @@ def estimate_rows(node: pn.PlanNode) -> Optional[int]:
         if le is None or re is None:
             return None
         if node.kind == "inner":
+            # |A join B| = |A|*|B| / ndv(k) when footer stats identify a
+            # KEY-LIKE side (ndv close to that side's row count — the
+            # PK of a fact->dim join). Span-based NDV is only an upper
+            # bound on true NDV, so applying it to a non-key side under
+            # skew would systematically UNDER-estimate and mislead the
+            # broadcast threshold; restricting to key-like sides keeps
+            # the estimate at/above the fact side's size.
+            if node.left_keys:
+                cands = []
+                for side, ord_, rows in (
+                        (node.children[0], node.left_keys[0], le),
+                        (node.children[1], node.right_keys[0], re)):
+                    ndv = estimate_key_ndv(side, ord_)
+                    if ndv is not None and ndv >= int(rows * 0.7):
+                        cands.append(ndv)
+                if cands:
+                    est = (le * re) // max(max(cands), 1)
+                    return max(min(est, le * re), 1)
             return max(le, re)  # FK->PK: output tracks the fact side
         return le if node.kind == "left" else le + re
     if isinstance(node, pn.AggregateNode):
@@ -539,3 +603,67 @@ def optimize(plan: pn.PlanNode) -> pn.PlanNode:
     # the reorder's restore-projection may now collapse with outer ones
     plan = collapse_project(plan)
     return rewrite_distinct_aggregates(plan)
+
+
+# ---------------------------------------------------------------------------
+# Plan-cost model (round-5): a static dispatch-count estimate over the
+# PHYSICAL tree, so tests can assert optimizer decisions (join reorder,
+# broadcast selection) never make a plan costlier than the written
+# order — the plan-quality guard the semantics fuzz can't provide.
+# Weights are the measured per-exec dispatch shapes from BASELINE.md's
+# telemetry, not wall-clock claims.
+# ---------------------------------------------------------------------------
+
+
+def plan_cost(exec_) -> int:
+    """Estimated dispatch count of a physical exec tree. Runs under
+    planning_mode so adaptive/range partition-count queries never
+    materialize anything."""
+    from spark_rapids_tpu.execs import adaptive as adaptive_exec
+
+    with adaptive_exec.planning_mode():
+        return _cost(exec_)
+
+
+def _cost(e) -> int:
+    from spark_rapids_tpu.execs import basic, joins
+    from spark_rapids_tpu.execs.adaptive import AdaptiveShuffleReaderExec
+    from spark_rapids_tpu.execs.aggregate import HashAggregateExec
+    from spark_rapids_tpu.execs.batching import CoalesceBatchesExec
+    from spark_rapids_tpu.execs.exchange import (BroadcastExchangeExec,
+                                                 ShuffleExchangeExec)
+    from spark_rapids_tpu.execs.fused import (FusedAggregateExec,
+                                              FusedChainExec)
+    from spark_rapids_tpu.execs.sort import SortExec
+
+    parts = max(getattr(e, "num_partitions", 1), 1)
+    if isinstance(e, FusedAggregateExec):
+        own = 3 * parts + 1  # chain + (chunked) groupby per partition
+    elif isinstance(e, FusedChainExec):
+        own = 1 * parts + len(e.builds)
+    elif isinstance(e, HashAggregateExec):
+        own = 3 * parts
+    elif isinstance(e, joins.HashJoinExec):
+        own = 6 * parts  # probe/expand/emit chain + count sync
+    elif isinstance(e, (joins.BroadcastNestedLoopJoinExec,
+                        joins.CartesianProductExec)):
+        # full pair-grid materialization: the guard must never score a
+        # hash->nested-loop degradation as an improvement
+        own = 50 * parts
+    elif isinstance(e, AdaptiveShuffleReaderExec):
+        own = 0  # a view over its exchange; the exchange carries cost
+    elif isinstance(e, ShuffleExchangeExec):
+        own = 2 * max(e.children[0].num_partitions, 1) + parts
+    elif isinstance(e, BroadcastExchangeExec):
+        own = 2
+    elif isinstance(e, basic.FilterExec):
+        own = 2 * parts
+    elif isinstance(e, (basic.ProjectExec, CoalesceBatchesExec)):
+        own = 1 * parts
+    elif isinstance(e, SortExec):
+        own = 2 * parts
+    elif isinstance(e, basic.ScanExec):
+        own = 1 * parts
+    else:
+        own = 2 * parts  # unknown execs are not free
+    return own + sum(_cost(c) for c in e.children)
