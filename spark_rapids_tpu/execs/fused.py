@@ -74,9 +74,139 @@ _MAXH = jnp.iinfo(jnp.int64).max
 # ---------------------------------------------------------------------------
 
 
+class _AuxStringPred(Expression):
+    """Trace-time stand-in for a string-vs-literal predicate inside a
+    fused chain. Dictionaries are SORTED (code order == string order,
+    columnar/column.py), so every comparison against a literal is a
+    code-range test whose boundaries are that batch's dictionary
+    searchsorted positions — delivered to the cached program as scalar
+    OPERANDS (``ctx.aux``), never baked in as constants. This is what
+    lets string filters (category = 'Books', marital_status = 'M', IN
+    lists) ride INSIDE one fused program instead of breaking the chain
+    into eager dictionary evaluation + a separate compaction pass.
+
+    ``op``: 'eq_any' (EqualTo / IN — one [lo, hi) pair per literal),
+    'lt' | 'le' (codes < bound), 'gt' | 'ge' (codes >= bound)."""
+
+    def __init__(self, ref, op: str, literals: List[str],
+                 base_slot: int = -1):
+        super().__init__([ref])
+        self.op = op
+        self.literals = [str(v) for v in literals]
+        self.base_slot = base_slot
+
+    @property
+    def dtype(self):
+        return dt.BOOLEAN
+
+    @property
+    def device_only(self) -> bool:
+        return True
+
+    @property
+    def deterministic(self) -> bool:
+        return True
+
+    def n_slots(self) -> int:
+        return 2 * len(self.literals) if self.op == "eq_any" else 1
+
+    def aux_values(self, dictionary) -> List[int]:
+        """Per-batch dictionary positions for this predicate's slots."""
+        d = dictionary.astype(str) if dictionary is not None and \
+            len(dictionary) else np.array([], dtype=str)
+        if self.op == "eq_any":
+            out = []
+            for lit in self.literals:
+                out.append(int(np.searchsorted(d, lit, side="left")))
+                out.append(int(np.searchsorted(d, lit, side="right")))
+            return out
+        lit = self.literals[0]
+        side = "left" if self.op in ("lt", "ge") else "right"
+        return [int(np.searchsorted(d, lit, side=side))]
+
+    def eval(self, ctx):
+        v = self.children[0].eval(ctx)
+        v = broadcast(v, ctx)
+        codes = v.data
+        aux = ctx.aux
+        b = self.base_slot
+        if self.op == "eq_any":
+            keep = jnp.zeros(codes.shape, dtype=bool)
+            for i in range(len(self.literals)):
+                keep = keep | ((codes >= aux[b + 2 * i]) &
+                               (codes < aux[b + 2 * i + 1]))
+        elif self.op in ("lt", "le"):
+            keep = codes < aux[b]
+        else:  # gt / ge
+            keep = codes >= aux[b]
+        return ColV(dt.BOOLEAN, keep, v.validity)
+
+
+def _flip_cmp(op: str) -> str:
+    return {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}[op]
+
+
+def _as_string_pred(node) -> Optional[_AuxStringPred]:
+    """The aux-operand replacement for ``node`` when it is a string-vs-
+    literal predicate on a plain column reference; None otherwise."""
+    from spark_rapids_tpu.expressions import predicates as pr
+
+    _CMP = {pr.EqualTo: "eq_any", pr.LessThan: "lt",
+            pr.LessThanOrEqual: "le", pr.GreaterThan: "gt",
+            pr.GreaterThanOrEqual: "ge"}
+    if isinstance(node, pr.In):
+        ref = node.children[0]
+        if isinstance(ref, BoundReference) and ref.dtype is dt.STRING \
+                and node.values and all(
+                    isinstance(v, str) for v in node.values):
+            return _AuxStringPred(ref, "eq_any", list(node.values))
+        return None
+    op = _CMP.get(type(node))
+    if op is None:
+        return None
+    a, b = node.children
+    if isinstance(a, BoundReference) and a.dtype is dt.STRING and \
+            isinstance(b, Literal) and isinstance(b.value, str):
+        return _AuxStringPred(a, op, [b.value])
+    if isinstance(b, BoundReference) and b.dtype is dt.STRING and \
+            isinstance(a, Literal) and isinstance(a.value, str):
+        return _AuxStringPred(
+            b, op if op == "eq_any" else _flip_cmp(op), [a.value])
+    return None
+
+
+def chain_transform(e: Expression) -> Tuple[Expression,
+                                            List[_AuxStringPred]]:
+    """Rewrite string-literal predicates into aux-operand nodes; the
+    result is chain-traceable iff it ends up device_only."""
+    preds: List[_AuxStringPred] = []
+
+    def fn(node):
+        repl = _as_string_pred(node)
+        if repl is not None:
+            preds.append(repl)
+            return repl
+        return node
+
+    return e.transform(fn), preds
+
+
+def chain_traceable(e: Expression) -> bool:
+    """Can this expression run inside a fused chain program (directly or
+    after the string-predicate transform)?"""
+    if not e.deterministic:
+        return False
+    if e.device_only:
+        return True
+    t, _ = chain_transform(e)
+    return t.device_only
+
+
 @dataclasses.dataclass
 class FilterStep:
     condition: Expression
+    aux_preds: List[_AuxStringPred] = dataclasses.field(
+        default_factory=list)
 
     def key(self):
         k = self.condition.tree_key()
@@ -86,10 +216,26 @@ class FilterStep:
 @dataclasses.dataclass
 class ProjectStep:
     exprs: List[Expression]
+    aux_preds: List[_AuxStringPred] = dataclasses.field(
+        default_factory=list)
 
     def key(self):
         ks = tuple(_unwrap_alias(e).tree_key() for e in self.exprs)
         return None if any(k is None for k in ks) else ("P", ks)
+
+
+def make_filter_step(condition: Expression) -> FilterStep:
+    t, preds = chain_transform(condition)
+    return FilterStep(t, preds)
+
+
+def make_project_step(exprs: Sequence[Expression]) -> ProjectStep:
+    out, preds = [], []
+    for e in exprs:
+        t, p = chain_transform(e)
+        out.append(t)
+        preds.extend(p)
+    return ProjectStep(out, preds)
 
 
 @dataclasses.dataclass
@@ -269,7 +415,19 @@ class FusedChain:
         self.steps = list(steps)
         self.source_types = list(source_types)
         self.n_builds = n_builds
+        self._number_aux_slots()
         self._programs: dict = {}
+
+    def _number_aux_slots(self) -> None:
+        # aux operand slots for string predicates: number sequentially
+        # in (step, pred) order — run() collects per-batch values in
+        # the same order
+        slot = 0
+        for s in self.steps:
+            for p in getattr(s, "aux_preds", ()):
+                p.base_slot = slot
+                slot += p.n_slots()
+        self.n_aux = slot
 
     # jit closures and compiled programs never ship to remote executors
     def __getstate__(self):
@@ -278,6 +436,7 @@ class FusedChain:
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self._number_aux_slots()
         self._programs = {}
 
     def chain_key(self, compact_out: bool):
@@ -301,7 +460,7 @@ class FusedChain:
     def _build_program(self, compact_out: bool):
         steps = self.steps
 
-        def run(datas, vals, num_rows, builds, types):
+        def run(datas, vals, num_rows, builds, aux, types):
             capacity = datas[0].shape[0] if datas else 128
             cols = [ColV(t, d, v)
                     for t, d, v in zip(types, datas, vals)]
@@ -310,6 +469,7 @@ class FusedChain:
                 if isinstance(step, FilterStep):
                     ctx = EvalContext(cols, capacity, num_rows,
                                       in_jit=True)
+                    ctx.aux = aux
                     v = broadcast(step.condition.eval(ctx), ctx)
                     keep = v.data
                     if v.validity is not None:
@@ -318,6 +478,7 @@ class FusedChain:
                 elif isinstance(step, ProjectStep):
                     ctx = EvalContext(cols, capacity, num_rows,
                                       in_jit=True)
+                    ctx.aux = aux
                     cols = [broadcast(e.eval(ctx), ctx)
                             for e in step.exprs]
                 else:
@@ -351,20 +512,29 @@ class FusedChain:
 
     def run(self, batch: ColumnarBatch, preps: List[PreparedBuild],
             compact_out: bool):
+        """-> (outs, live_mask | new_count, final output ghosts). The
+        ghost walk runs ONCE per batch, serving both the aux operand
+        collection and the caller's output wrapping."""
+        states, final_ghosts = self._ghost_states(batch, preps)
         build_ops = tuple(
             (p.h_sorted, p.datas, p.vals, p.n_valid) for p in preps)
-        return self._program(compact_out)(
+        aux = self._aux_from_states(states)
+        outs, live = self._program(compact_out)(
             [c.data for c in batch.columns],
             [c.validity for c in batch.columns],
-            batch.num_rows_device(), build_ops,
+            batch.num_rows_device(), build_ops, aux,
             types=tuple(self.source_types))
+        return outs, live, final_ghosts
 
     # -- host mirror --------------------------------------------------------
 
-    def ghost_walk(self, batch: ColumnarBatch,
-                   preps: List[PreparedBuild]) -> List[_Ghost]:
+    def _ghost_states(self, batch: ColumnarBatch,
+                      preps: List[PreparedBuild]):
+        """Per-step INPUT ghost lists, plus the final output ghosts."""
         ghosts = [_ghost_of(c) for c in batch.columns]
+        states = []
         for step in self.steps:
+            states.append(ghosts)
             if isinstance(step, FilterStep):
                 continue
             if isinstance(step, ProjectStep):
@@ -374,7 +544,23 @@ class FusedChain:
             if step.kind in ("left_semi", "left_anti"):
                 continue
             ghosts = ghosts + list(preps[step.build_index].ghosts)
-        return ghosts
+        return states, ghosts
+
+    def _aux_from_states(self, states) -> tuple:
+        """Per-batch scalar operands for string predicates: dictionary
+        searchsorted positions of each predicate's literals, in slot
+        order (matching the numbering done at construction)."""
+        if self.n_aux == 0:
+            return ()
+        aux: List[int] = []
+        for step, ghosts in zip(self.steps, states):
+            for p in getattr(step, "aux_preds", ()):
+                g = ghosts[p.children[0].ordinal]
+                aux.extend(p.aux_values(g.dictionary))
+        assert len(aux) == self.n_aux, (len(aux), self.n_aux)
+        # plain ints: jit traces them as scalar operands shipped with
+        # the call (a jnp.int32() per value would be its own transfer)
+        return tuple(aux)
 
     @staticmethod
     def _project_ghost(e: Expression, ghosts: List[_Ghost]) -> _Ghost:
@@ -520,9 +706,8 @@ class FusedChainExec(TpuExec):
                     continue
                 saw = True
                 with TraceRange("FusedChainExec"):
-                    outs, n = self.chain.run(b, self._preps,
-                                             compact_out=True)
-                ghosts = self.chain.ghost_walk(b, self._preps)
+                    outs, n, ghosts = self.chain.run(b, self._preps,
+                                                     compact_out=True)
                 yield self.chain.wrap(outs, ghosts, n)
         return timed(self, it())
 
@@ -575,16 +760,18 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
                          conf=conf, fused_filter=None)
         steps = list(steps)
         if fallback.fused_filter is not None:
-            steps.append(FilterStep(fallback.fused_filter.condition))
+            steps.append(make_filter_step(
+                fallback.fused_filter.condition))
         assert self.input_proj is not None
-        # absorb the input projection only when it can trace: dictionary-
-        # dependent string expressions must keep CompiledProjection's
-        # eager path (it carries the source StringColumn; the chain's
-        # ColVs don't)
-        self._proj_in_chain = self.input_proj.fused and all(
-            e.deterministic for e in self.input_proj.exprs)
+        # absorb the input projection only when it can trace (directly
+        # or via the string-predicate transform); remaining dictionary-
+        # dependent string expressions keep CompiledProjection's eager
+        # path (it carries the source StringColumn; the chain's ColVs
+        # don't)
+        self._proj_in_chain = all(chain_traceable(e)
+                                  for e in self.input_proj.exprs)
         if self._proj_in_chain:
-            steps.append(ProjectStep(self.input_proj.exprs))
+            steps.append(make_project_step(self.input_proj.exprs))
         self.chain = FusedChain(steps, list(source.schema.types),
                                 len(builds))
         self.builds = builds
@@ -600,9 +787,8 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
 
     def _update_inputs(self, b: ColumnarBatch):
         with TraceRange("FusedAggregateExec.chain"):
-            outs, live = self.chain.run(b, self._preps,
-                                        compact_out=False)
-        ghosts = self.chain.ghost_walk(b, self._preps)
+            outs, live, ghosts = self.chain.run(b, self._preps,
+                                                compact_out=False)
         out = self.chain.wrap(outs, ghosts, b.num_rows)
         if not self._proj_in_chain:
             # eager projection outside the chain (string dictionary
@@ -668,18 +854,18 @@ def _extract(node: TpuExec):
     builds: List[BroadcastExchangeExec] = []
     cur = node
     while True:
-        if isinstance(cur, basic.FilterExec) and cur.filter.fused and \
-                cur.filter.condition.deterministic:
-            steps.append(FilterStep(cur.filter.condition))
+        if isinstance(cur, basic.FilterExec) and \
+                chain_traceable(cur.filter.condition):
+            steps.append(make_filter_step(cur.filter.condition))
             cur = cur.children[0]
         elif isinstance(cur, basic.ProjectExec) and \
-                cur.projection.fused and \
-                all(e.deterministic for e in cur.projection.exprs):
-            steps.append(ProjectStep(cur.projection.exprs))
+                all(chain_traceable(e)
+                    for e in cur.projection.exprs):
+            steps.append(make_project_step(cur.projection.exprs))
             cur = cur.children[0]
         elif _fusable_join(cur):
             if cur.condition is not None:
-                steps.append(FilterStep(cur.condition.condition))
+                steps.append(make_filter_step(cur.condition.condition))
             stream_types = cur.children[0].schema.types
             build_types = list(cur.children[1].schema.types)
             commons = [join_ops.common_key_type(stream_types[so],

@@ -441,24 +441,25 @@ def estimate_rows(node: pn.PlanNode) -> Optional[int]:
         if le is None or re is None:
             return None
         if node.kind == "inner":
-            # |A join B| = |A|*|B| / ndv(k) when footer stats identify a
-            # KEY-LIKE side (ndv close to that side's row count — the
-            # PK of a fact->dim join). Span-based NDV is only an upper
-            # bound on true NDV, so applying it to a non-key side under
-            # skew would systematically UNDER-estimate and mislead the
-            # broadcast threshold; restricting to key-like sides keeps
-            # the estimate at/above the fact side's size.
+            # |A join B| = |A|*|B| / ndv(k), FLOORED at max(le, re):
+            # span-based NDV is only an upper bound on true NDV (sparse
+            # key domains like lineitem.l_orderkey can make span ~ rows
+            # while true NDV is rows/4), so an unfloored estimate would
+            # systematically UNDER-estimate and mislead the broadcast
+            # threshold. With the floor, the refinement can only detect
+            # many-to-many EXPANSION (est above both sides) — the
+            # direction span stats CAN bound soundly.
             if node.left_keys:
                 cands = []
-                for side, ord_, rows in (
-                        (node.children[0], node.left_keys[0], le),
-                        (node.children[1], node.right_keys[0], re)):
+                for side, ord_ in (
+                        (node.children[0], node.left_keys[0]),
+                        (node.children[1], node.right_keys[0])):
                     ndv = estimate_key_ndv(side, ord_)
-                    if ndv is not None and ndv >= int(rows * 0.7):
+                    if ndv is not None:
                         cands.append(ndv)
                 if cands:
                     est = (le * re) // max(max(cands), 1)
-                    return max(min(est, le * re), 1)
+                    return max(min(est, le * re), max(le, re), 1)
             return max(le, re)  # FK->PK: output tracks the fact side
         return le if node.kind == "left" else le + re
     if isinstance(node, pn.AggregateNode):

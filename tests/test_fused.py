@@ -193,3 +193,49 @@ def test_nan_and_negzero_key_semantics_in_fused_probe():
     want = off.sql(sql).collect()
     assert_frames_equal(got, want)
     assert got["y"].tolist() == [1.5, 7.25]  # 0.0 cancels against -0.0
+
+
+def test_string_predicates_fuse_into_chain():
+    """String-vs-literal predicates (=, IN, <, >=) ride INSIDE the
+    chain program as per-batch code-range operands — no FilterExec, no
+    eager dictionary pass — and match the unfused engine exactly,
+    including nulls and literals absent from the dictionary."""
+    rng = np.random.default_rng(31)
+    n = 900
+    fact = pd.DataFrame({
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "v": rng.normal(size=n),
+        "c": rng.choice(["web", "store", "catalog", "zzz"], n),
+        "m": rng.choice(["M", "S", "D"], n)})
+    fact.loc[rng.integers(0, n, 60), "c"] = None
+    dim = pd.DataFrame({"id": np.arange(20, dtype=np.int64),
+                        "w": np.arange(20) * 2.0})
+    sql = ("SELECT f.k AS k, count(*) AS n, "
+           "sum(CASE WHEN f.c = 'web' THEN f.v ELSE 0.0 END) AS wv "
+           "FROM f JOIN d ON f.k = d.id "
+           "WHERE f.m IN ('M', 'S') AND f.c >= 'catalog' "
+           "AND f.c < 'x' AND f.c <> 'nope' "
+           "GROUP BY f.k ORDER BY k")
+    on, got = _both(sql, fact, dim)
+    ex = on.sql(sql)._exec()
+    from spark_rapids_tpu.execs.basic import FilterExec
+
+    assert not find(ex, FilterExec), ex.tree_string()
+    fused = find(ex, FusedAggregateExec)
+    assert fused, ex.tree_string()
+    assert fused[0].chain.n_aux > 0  # string preds became aux operands
+
+
+def test_string_pred_literal_absent_from_dictionary():
+    """A literal that never occurs in a batch's dictionary must match
+    nothing (equality) / split correctly (range) — searchsorted gives a
+    lo==hi empty range, not a false positive."""
+    fact = pd.DataFrame({"k": np.arange(50, dtype=np.int64),
+                         "c": np.array(
+                             ["aa", "bb", "cc", "dd", "ee"] * 10,
+                             dtype=object)})
+    dim = pd.DataFrame({"id": np.arange(50, dtype=np.int64),
+                        "w": np.arange(50) * 1.0})
+    sql = ("SELECT count(*) AS n FROM f JOIN d ON f.k = d.id "
+           "WHERE f.c = 'bbb' OR f.c > 'dd'")
+    _both(sql, fact, dim)
