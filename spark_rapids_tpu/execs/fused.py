@@ -635,11 +635,17 @@ def _apply_join(step: JoinStep, cols: List[ColV], live,
 
 
 def _build_key_specs(steps) -> list:
-    """(build_keys, build_types, key_common) per JoinStep — the inputs
-    prepare_build needs, shared by both fused execs."""
+    """(build_keys, build_types, key_common) per JoinStep, ordered by
+    ``build_index`` — the inputs prepare_build needs, shared by both
+    fused execs. ORDER MATTERS: the builds list is in extraction
+    (reverse-execution) order while steps run in execution order;
+    indexing by build_index keeps spec[i] paired with builds[i] (a
+    mismatch cross-hashes the wrong key columns: loud IndexError when
+    widths differ, silently empty probes when they coincide)."""
+    joins = sorted((s for s in steps if isinstance(s, JoinStep)),
+                   key=lambda s: s.build_index)
     return [(tuple(s.build_keys), tuple(s.build_types),
-             tuple(s.key_common))
-            for s in steps if isinstance(s, JoinStep)]
+             tuple(s.key_common)) for s in joins]
 
 
 class FusedChainExec(TpuExec):

@@ -239,3 +239,41 @@ def test_string_pred_literal_absent_from_dictionary():
     sql = ("SELECT count(*) AS n FROM f JOIN d ON f.k = d.id "
            "WHERE f.c = 'bbb' OR f.c > 'dd'")
     _both(sql, fact, dim)
+
+
+def test_multi_join_distinct_key_shapes_pair_preps_correctly():
+    """Regression (TPC-DS q83/q93): a chain with joins whose build
+    sides have DIFFERENT widths and key ordinals must pair each
+    prepared build with its own key spec — the builds list is in
+    extraction order while steps run in execution order."""
+    rng = np.random.default_rng(83)
+    n = 600
+    fact = pd.DataFrame({
+        "k": rng.integers(0, 25, n).astype(np.int64),
+        "s": rng.integers(0, 40, n).astype(np.int64),
+        "v": rng.normal(size=n)})
+    wide = pd.DataFrame({
+        "pad0": np.arange(25) * 7.0,
+        "pad1": np.arange(25) * 3.0,
+        "id": np.arange(25, dtype=np.int64),     # key at ordinal 2
+        "w": np.arange(25) * 1.5})
+    narrow = pd.DataFrame({"sid": rng.choice(40, 15, replace=False)
+                           .astype(np.int64)})   # 1-col semi build
+    on, off = _sessions()
+    for s in (on, off):
+        s.create_temp_view("f", s.create_dataframe(fact))
+        s.create_temp_view("wide", s.create_dataframe(wide))
+        s.create_temp_view("narrow", s.create_dataframe(narrow))
+    sql = ("SELECT f.k AS k, sum(f.v) AS sv, count(*) AS n "
+           "FROM f JOIN wide ON f.k = wide.id "
+           "WHERE f.s IN (SELECT sid FROM narrow) "
+           "GROUP BY f.k ORDER BY k")
+    got = on.sql(sql).collect()
+    want = off.sql(sql).collect()
+    assert_frames_equal(got, want)
+    ex = on.sql(sql)._exec()
+    fused = find(ex, FusedAggregateExec)
+    assert fused, ex.tree_string()
+    widths = sorted(len(s.build_types) for s in fused[0].chain.steps
+                    if isinstance(s, JoinStep))
+    assert len(widths) == 2 and widths[0] != widths[1], widths
