@@ -123,7 +123,13 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
     cols = [(c.data, c.validity) for c in batch.columns]
     key_ranges = tuple(key_range_of(batch.columns[o], dtypes[o])
                        for o in key_ordinals)
-    if len(aggs) > _AOT_MAX_AGGS and \
+    key_has_v = tuple(batch.columns[o].validity is not None
+                      for o in key_ordinals)
+    # the dense path never builds the fused sort module the AOT
+    # segfault workaround guards against — wide agg lists stay whole
+    dense_ok = _dense_layout(list(dtypes), key_ordinals, key_ranges,
+                             key_has_v) is not None
+    if len(aggs) > _AOT_MAX_AGGS and not dense_ok and \
             batch.capacity >= _AOT_CHUNK_MIN_CAP:
         agg_d, agg_v = [], []
         key_d = key_v = num_groups = None
@@ -199,6 +205,200 @@ def _pack_plan(dtypes, key_ordinals, key_ranges):
     return key_ranges
 
 
+# ---------------------------------------------------------------------------
+# dense path: tiny host-known key spaces need no sort at all
+# ---------------------------------------------------------------------------
+
+# Above this slot count the masked-reduction sweep (total x capacity work
+# per aggregate lane) loses to the sort kernel; below it the sweep wins
+# by a wide margin — it deletes BOTH variadic sorts and every cumsum.
+_DENSE_MAX_GROUPS = 128
+
+
+def _dense_layout(dtypes, key_ordinals, key_ranges, key_has_v):
+    """Static layout for the sort-free dense groupby: validated ranges
+    plus cards/strides/total when every key packs into at most
+    ``_DENSE_MAX_GROUPS`` slots (the TPC-H q1 returnflag x linestatus
+    shape). None when the packed space is too large or unpackable."""
+    ranges = _pack_plan(dtypes, key_ordinals, key_ranges)
+    if ranges is None:
+        return None
+    cards = []
+    for (lo, hi), hv in zip(ranges, key_has_v):
+        cards.append((hi - lo + 1) + (1 if hv else 0))
+    total = 1
+    for card in cards:
+        total *= max(card, 1)
+    if total > _DENSE_MAX_GROUPS:
+        return None
+    strides = []
+    s = 1
+    for card in reversed(cards):
+        strides.append(s)
+        s *= max(card, 1)
+    strides.reverse()
+    return ranges, tuple(cards), tuple(strides), total
+
+
+def _dense_groupby(cols, dtypes, key_ordinals, aggs, live, layout):
+    """Sort-free groupby for tiny host-known key spaces: rows map to a
+    packed slot code, and each aggregate is ONE masked reduction over a
+    [slots, capacity] broadcast compare that XLA fuses into a single
+    sweep — no variadic sort, no cumsum, and no AOT-segfault chunking
+    (the >= 7-agg boundary above applies to the fused sort module, which
+    this path never builds). The slot axis compacts with an argsort over
+    <= 128 elements. Matches the semantics of the sort path exactly:
+    same null-first slot encoding, same validity rules per op.
+
+    The reference reaches the same shapes through cuDF's hash groupby
+    (aggregate.scala:810-890); a TPU has no device hash table, but for a
+    known-tiny key space the dense sweep is the natural MXU/VPU-friendly
+    replacement — pure vectorized compare+reduce, no data movement."""
+    ranges, cards, strides, total = layout
+    capacity = cols[0][0].shape[0]
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+
+    pack = jnp.zeros(capacity, dtype=jnp.int32)
+    for (lo, hi), strd, o in zip(ranges, strides, key_ordinals):
+        d, v = cols[o]
+        dd = d.astype(jnp.int32) if dtypes[o] is dt.BOOLEAN else d
+        code = (dd - jnp.asarray(lo, dd.dtype)).astype(jnp.int32)
+        if v is not None:
+            code = jnp.where(v, code + 1, jnp.zeros((), jnp.int32))
+        pack = pack + code * jnp.int32(strd)
+    codes = jnp.where(live, pack, jnp.int32(total))  # dead -> sentinel
+
+    slots = jnp.arange(total, dtype=jnp.int32)
+    eq = codes[None, :] == slots[:, None]            # [total, capacity]
+    sizes = jnp.sum(eq, axis=1).astype(jnp.int32)
+    exists = sizes > 0
+
+    def rowmask(o):
+        v = cols[o][1]
+        return live if v is None else (v & live)
+
+    def nvalid_of(o):
+        if cols[o][1] is None:
+            return sizes
+        return jnp.sum(eq & cols[o][1][None, :], axis=1).astype(jnp.int32)
+
+    def first_idx(mask):
+        return jnp.min(jnp.where(mask, iota[None, :], capacity), axis=1)
+
+    agg_d, agg_v = [], []
+    for spec in aggs:
+        if spec.op == "count_star":
+            agg_d.append(sizes.astype(jnp.int64))
+            agg_v.append(exists)
+            continue
+        o = spec.ordinal
+        d, v = cols[o]
+        if spec.op == "count":
+            agg_d.append(nvalid_of(o).astype(jnp.int64))
+            agg_v.append(exists)
+        elif spec.op == "sum" and not dtypes[o].is_floating:
+            x = jnp.where(rowmask(o), d.astype(jnp.int64),
+                          jnp.zeros((), jnp.int64))
+            agg_d.append(jnp.sum(jnp.where(eq, x[None, :],
+                                           jnp.zeros((), jnp.int64)),
+                                 axis=1))
+            agg_v.append(nvalid_of(o) > 0)
+        elif spec.op in ("sum", "sum_of_squares"):
+            x = d.astype(jnp.float64)
+            if spec.op == "sum_of_squares":
+                x = x * x
+            xm = jnp.where(rowmask(o), x, 0.0)
+            agg_d.append(jnp.sum(jnp.where(eq, xm[None, :], 0.0), axis=1))
+            agg_v.append(nvalid_of(o) > 0)
+        elif spec.op == "rterm":
+            xm = jnp.where(rowmask(o), d.astype(jnp.float64), 0.0)
+            s = jnp.sum(jnp.where(eq, xm[None, :], 0.0), axis=1)
+            nf = jnp.maximum(nvalid_of(o), 1).astype(jnp.float64)
+            agg_d.append((s * s) / nf)
+            agg_v.append(nvalid_of(o) > 0)
+        elif spec.op == "m2":
+            x = d.astype(jnp.float64)
+            contrib = rowmask(o)
+            m = eq & contrib[None, :]
+            fi = jnp.clip(first_idx(m), 0, capacity - 1)
+            xf_row = jnp.take(jnp.take(x, fi),
+                              jnp.clip(codes, 0, total - 1))
+            dd = jnp.where(contrib, x - xf_row, 0.0)
+            sd = jnp.sum(jnp.where(eq, dd[None, :], 0.0), axis=1)
+            sd2 = jnp.sum(jnp.where(eq, (dd * dd)[None, :], 0.0), axis=1)
+            n = nvalid_of(o)
+            nf = jnp.maximum(n, 1).astype(jnp.float64)
+            agg_d.append(jnp.maximum(sd2 - (sd * sd) / nf, 0.0))
+            agg_v.append(n > 0)
+        elif spec.op in ("min", "max"):
+            in_t = dtypes[o]
+            dd = d.astype(jnp.int8) if in_t is dt.BOOLEAN else d
+            kd = dd.dtype
+            if in_t.is_floating:
+                big = jnp.asarray(jnp.inf, kd)
+                small = jnp.asarray(-jnp.inf, kd)
+            elif in_t is dt.BOOLEAN:
+                big, small = jnp.asarray(1, kd), jnp.asarray(0, kd)
+            else:
+                big = jnp.asarray(jnp.iinfo(kd).max, kd)
+                small = jnp.asarray(jnp.iinfo(kd).min, kd)
+            fill = big if spec.op == "min" else small
+            xm = jnp.where(rowmask(o), dd, fill)
+            red = jnp.min if spec.op == "min" else jnp.max
+            vals = red(jnp.where(eq, xm[None, :], fill), axis=1)
+            if in_t is dt.BOOLEAN:
+                vals = vals.astype(jnp.bool_)
+            agg_d.append(vals)
+            agg_v.append(nvalid_of(o) > 0)
+        elif spec.op in ("first", "any_valid"):
+            m = eq & rowmask(o)[None, :] if spec.op == "any_valid" else eq
+            fi = jnp.clip(first_idx(m), 0, capacity - 1)
+            agg_d.append(jnp.take(d, fi))
+            if spec.op == "any_valid":
+                agg_v.append(nvalid_of(o) > 0)
+            else:
+                agg_v.append(exists if v is None
+                             else (jnp.take(v, fi) & exists))
+        elif spec.op == "last":
+            li = jnp.clip(jnp.max(jnp.where(eq, iota[None, :], -1),
+                                  axis=1), 0, capacity - 1)
+            agg_d.append(jnp.take(d, li))
+            agg_v.append(exists if v is None
+                         else (jnp.take(v, li) & exists))
+        else:
+            raise ValueError(f"unknown aggregate op {spec.op}")
+
+    key_d, key_v_arr = [], []
+    for ki, o in enumerate(key_ordinals):
+        card = max(cards[ki], 1)
+        code = (slots // jnp.int32(strides[ki])) % jnp.int32(card)
+        wide = jnp.int32 if dtypes[o] is dt.BOOLEAN else cols[o][0].dtype
+        if cols[o][1] is not None:
+            kv = (code > 0) & exists
+            kd = (code - 1).astype(wide) + jnp.asarray(ranges[ki][0], wide)
+        else:
+            kv = exists
+            kd = code.astype(wide) + jnp.asarray(ranges[ki][0], wide)
+        if dtypes[o] is dt.BOOLEAN:
+            kd = kd.astype(jnp.bool_)
+        key_d.append(kd)
+        key_v_arr.append(kv)
+
+    order = jnp.argsort(~exists, stable=True)
+    num_groups = jnp.sum(exists).astype(jnp.int32)
+
+    def take(x):
+        return jnp.take(x, order)
+
+    key_has_v = tuple(cols[o][1] is not None for o in key_ordinals)
+    key_v = [take(key_v_arr[i]) if key_has_v[i] else None
+             for i in range(len(key_ordinals))]
+    agg_vo = [None if spec.op in ("count", "count_star")
+              else take(agg_v[i]) for i, spec in enumerate(aggs)]
+    return ([take(x) for x in key_d], key_v), \
+        ([take(x) for x in agg_d], agg_vo), num_groups
+
+
 def _equality_lanes(d, v, dtype):
     """Sort-key lanes for one UNPACKED key column, every lane directly
     equality-comparable row-to-row (floats contribute a NaN-zeroed value
@@ -251,8 +451,13 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
         live = live & live_mask
         num_rows = jnp.sum(live).astype(jnp.int32)
 
-    ranges = _pack_plan(dtypes, key_ordinals, key_ranges)
     key_has_v = tuple(cols[o][1] is not None for o in key_ordinals)
+    dense = _dense_layout(dtypes, key_ordinals, key_ranges, key_has_v)
+    if dense is not None:
+        return _dense_groupby(cols, dtypes, key_ordinals, aggs, live,
+                              dense)
+
+    ranges = _pack_plan(dtypes, key_ordinals, key_ranges)
 
     # ---- 1. sort-key lanes ------------------------------------------------
     packed = None
@@ -529,12 +734,22 @@ def _segments_tail(sorted_cols, dtypes, key_ordinals, aggs, boundary,
             cidx, ctot = ensure_count_lane(o)
             lane_specs.append(("scan", sidx, last, cidx, ctot,
                                dtypes[o] is dt.BOOLEAN))
-        elif spec.op in ("first", "any_valid"):
+        elif spec.op == "first":
             didx = add_lane(d)
             vidx = add_lane(valid_arr)
-            cidx, ctot = ensure_count_lane(o) if spec.op == "any_valid" \
-                else (None, None)
-            lane_specs.append(("first", didx, vidx, spec.op, cidx, ctot))
+            lane_specs.append(("first", didx, vidx, "first", None, None))
+        elif spec.op == "any_valid":
+            # first VALID value per group (Spark first(ignoreNulls=true);
+            # the CPU oracle takes rows[valid] — cpu/engine.py:384-389).
+            # The boundary row's raw value is NOT it when that row is
+            # null, so ride the segmented first-valid scan and read it at
+            # each segment's LAST row (the scan-decode shape min/max use)
+            fv = _seg_first_valid(d, contrib, boundary)
+            sidx = add_lane(_shift1(fv))
+            last = jax.lax.dynamic_index_in_dim(
+                fv, jnp.maximum(num_rows - 1, 0), keepdims=False)
+            cidx, ctot = ensure_count_lane(o)
+            lane_specs.append(("anyv", sidx, last, cidx, ctot))
         elif spec.op == "last":
             didx = add_lane(_shift1(d))
             vidx = add_lane(_shift1(valid_arr))
@@ -635,11 +850,13 @@ def _segments_tail(sorted_cols, dtypes, key_ordinals, aggs, boundary,
         if kind == "first":
             _, didx, vidx, op, cidx, ctot = ls
             agg_d.append(c[didx])
-            if op == "any_valid":
-                nvalid = nvalid_of(cidx, ctot)
-                agg_v.append(glive & (nvalid > 0))
-            else:
-                agg_v.append(glive & c[vidx] & (seg_sizes > 0))
+            agg_v.append(glive & c[vidx] & (seg_sizes > 0))
+            continue
+        if kind == "anyv":
+            _, sidx, last, cidx, ctot = ls
+            nvalid = nvalid_of(cidx, ctot)
+            agg_d.append(roll_next(c[sidx], last))
+            agg_v.append(glive & (nvalid > 0))
             continue
         if kind == "last":
             _, didx, vidx, dlast, vlast = ls

@@ -622,3 +622,138 @@ def test_groupby_wide_agg_list_chunks():
         got = np.asarray(jax.device_get(out.columns[1 + i].data))[:ng]
         np.testing.assert_array_equal(got[order],
                                       want[f"a{i}"].to_numpy())
+
+
+# -------------------------------------------------- dense (sort-free) path
+
+def _run_groupby_path(cols, dtypes, key_ords, aggs, n, key_ranges,
+                      live_mask=None):
+    from spark_rapids_tpu.ops import groupby as gb
+
+    (kd, kv), (ad, av), ng = gb._groupby(
+        cols, tuple(dtypes), tuple(key_ords), tuple(aggs), jnp.int32(n),
+        live_mask=live_mask, key_ranges=key_ranges)
+    ng = int(ng)
+    out = {}
+    for i in range(len(key_ords)):
+        d = np.asarray(kd[i])[:ng].astype(object)
+        if kv[i] is not None:
+            d[~np.asarray(kv[i])[:ng]] = None
+        out[f"k{i}"] = d
+    for i in range(len(aggs)):
+        d = np.asarray(ad[i])[:ng].astype(object)
+        if av[i] is not None:
+            d[~np.asarray(av[i])[:ng]] = None
+        out[f"a{i}"] = d
+    return pd.DataFrame(out), ng
+
+
+def test_groupby_dense_matches_sort_path_all_ops():
+    """The sort-free dense path (host-known key space <= 128 slots) must
+    agree with the sort path op-for-op, including null keys, null
+    inputs, bool keys, and a fused live-mask. Differential: same inputs
+    through both kernels (key_ranges present vs absent), results
+    compared after a key sort."""
+    from spark_rapids_tpu.ops import groupby as gb
+
+    rng = np.random.default_rng(17)
+    cap, n = 2048, 1900
+    k1 = rng.integers(10, 15, cap).astype(np.int64)
+    k1v = rng.random(cap) > 0.15
+    k2 = rng.integers(0, 2, cap).astype(bool)
+    x = rng.normal(3.0, 50.0, cap)
+    xv = rng.random(cap) > 0.25
+    iy = rng.integers(-40, 90, cap).astype(np.int64)
+    bz = rng.integers(0, 2, cap).astype(bool)
+    bzv = rng.random(cap) > 0.5
+    cols = [(jnp.asarray(k1), jnp.asarray(k1v)),
+            (jnp.asarray(k2), None),
+            (jnp.asarray(x), jnp.asarray(xv)),
+            (jnp.asarray(iy), None),
+            (jnp.asarray(bz), jnp.asarray(bzv))]
+    dtypes = [dt.INT64, dt.BOOLEAN, dt.FLOAT64, dt.INT64, dt.BOOLEAN]
+    aggs = [gb.AggSpec("sum", 2), gb.AggSpec("sum", 3),
+            gb.AggSpec("sum_of_squares", 2), gb.AggSpec("count", 2),
+            gb.AggSpec("count_star"), gb.AggSpec("min", 2),
+            gb.AggSpec("max", 3), gb.AggSpec("min", 4),
+            gb.AggSpec("max", 4), gb.AggSpec("first", 2),
+            gb.AggSpec("last", 3), gb.AggSpec("any_valid", 2),
+            gb.AggSpec("m2", 2), gb.AggSpec("rterm", 2)]
+    ranges = (gb.quantize_range(10, 14), (0, 1))
+    assert gb._dense_layout(dtypes, (0, 1), ranges,
+                            (True, False)) is not None
+    live = jnp.asarray(rng.random(cap) > 0.2)
+    for mask in (None, live):
+        dense, ng_d = _run_groupby_path(cols, dtypes, (0, 1), aggs, n,
+                                        ranges, live_mask=mask)
+        sortp, ng_s = _run_groupby_path(cols, dtypes, (0, 1), aggs, n,
+                                        None, live_mask=mask)
+        assert ng_d == ng_s and ng_d > 0
+        key = ["k0", "k1"]
+        dense = dense.sort_values(key, na_position="first",
+                                  ignore_index=True)
+        sortp = sortp.sort_values(key, na_position="first",
+                                  ignore_index=True)
+        for c in dense.columns:
+            a, b = dense[c].to_numpy(), sortp[c].to_numpy()
+            an = np.array([v is None for v in a])
+            bn = np.array([v is None for v in b])
+            np.testing.assert_array_equal(an, bn, err_msg=c)
+            af = np.array([0.0 if v is None else float(v) for v in a])
+            bf = np.array([0.0 if v is None else float(v) for v in b])
+            np.testing.assert_allclose(af, bf, rtol=1e-9, err_msg=c)
+
+
+def test_groupby_dense_wide_agg_list_skips_chunking():
+    """A wide agg list over a dense-eligible key space must NOT chunk
+    (the dense kernel never builds the module the AOT workaround guards
+    against) and must match pandas."""
+    from spark_rapids_tpu.ops import groupby as gb
+
+    rng = np.random.default_rng(23)
+    cap, n, nagg = 1 << 15, 30_000, 9
+    keys = rng.integers(0, 5, cap).astype(np.int64)
+    cols = [Column(dt.INT64, jnp.asarray(keys), None,
+                   stats=(0, 4))]
+    vals = []
+    for i in range(nagg):
+        v = rng.normal(0, 10, cap)
+        vals.append(v)
+        cols.append(Column(dt.FLOAT64, jnp.asarray(v), None))
+    b = ColumnarBatch(cols, n)
+    aggs = [gb.AggSpec("sum", i + 1) for i in range(nagg)]
+    out, _types = gb.groupby_aggregate(b, [0], aggs,
+                                       [dt.INT64] + [dt.FLOAT64] * nagg)
+    ng = out.realized_num_rows()
+    pdf = pd.DataFrame({"k": keys[:n],
+                        **{f"a{i}": vals[i][:n] for i in range(nagg)}})
+    want = pdf.groupby("k").sum().sort_index()
+    assert ng == len(want)
+    import jax
+
+    k = np.asarray(jax.device_get(out.columns[0].data))[:ng]
+    order = np.argsort(k)
+    for i in range(nagg):
+        got = np.asarray(jax.device_get(out.columns[1 + i].data))[:ng]
+        np.testing.assert_allclose(got[order], want[f"a{i}"].to_numpy(),
+                                   rtol=1e-9)
+
+
+def test_groupby_dense_string_keys_and_empty():
+    """String keys ride the dense path through their dictionary range;
+    an all-dead batch yields zero groups."""
+    s = ["b", "a", "b", None, "c", "a"]
+    v = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    batch = make_batch(np.asarray(s, dtype=object), v)
+    out, _ = groupby.groupby_aggregate(batch, [0], [AggSpec("sum", 1)],
+                                       [dt.STRING, dt.FLOAT64])
+    df = out.to_pandas()
+    df.columns = ["k", "s"]
+    df = df.sort_values("k", na_position="first").reset_index(drop=True)
+    assert df["s"].tolist() == [4.0, 8.0, 4.0, 5.0]
+    assert df["k"].tolist()[1:] == ["a", "b", "c"]
+    empty = make_batch(np.asarray(["x", "y"], dtype=object),
+                       np.array([1.0, 2.0]), n=0)
+    out2, _ = groupby.groupby_aggregate(empty, [0], [AggSpec("sum", 1)],
+                                        [dt.STRING, dt.FLOAT64])
+    assert out2.realized_num_rows() == 0
