@@ -131,10 +131,26 @@ def execute_cpu(plan: pn.PlanNode) -> CpuFrame:
 
 
 def _host_to_frame(schema: Schema, data, validity) -> CpuFrame:
+    from spark_rapids_tpu.io.hoststrings import HostStrings
+
     cols = []
     n = None
     for name, typ in zip(schema.names, schema.types):
-        arr = np.asarray(data[name])
+        raw = data[name]
+        if isinstance(raw, HostStrings):
+            # decode through the dictionary (vectorized take); nulls
+            # are exactly the validity dict's falses (plus the empty-
+            # dictionary all-null case) — no row-wise rescan needed
+            v = validity.get(name)
+            if v is not None:
+                v = np.asarray(v, dtype=bool)
+            if len(raw.dictionary) == 0 and len(raw):
+                v = np.zeros(len(raw), dtype=bool)
+            arr = raw.to_objects(v)
+            cols.append(CV(typ, arr, v))
+            n = len(arr)
+            continue
+        arr = np.asarray(raw)
         if typ is dt.STRING:
             arr = arr.astype(object)
             auto_null = np.array([x is not None for x in arr], dtype=bool)

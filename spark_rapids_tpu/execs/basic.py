@@ -43,21 +43,80 @@ class ScanExec(TpuExec):
         def it():
             data, validity = self.source.read_host_split(partition)
             first = self.schema.names[0] if len(self.schema) else None
-            n = len(np.asarray(data[first])) if first else 0
+            n = len(data[first]) if first else 0
             if n == 0:
                 yield ColumnarBatch.empty(self.schema)
                 return
             origin = self.source.split_origin(partition)
             stats = self.source.split_stats(partition)
+            starts = list(range(0, n, self.batch_rows))
             with semaphore.get():
-                for start in range(0, n, self.batch_rows):
-                    end = min(start + self.batch_rows, n)
+                if len(starts) == 1:
                     with TraceRange("ScanExec.upload"):
                         b = interop.host_to_batch(data, validity,
-                                                  self.schema, start, end,
+                                                  self.schema, 0, n,
                                                   stats=stats)
                         b.origin = origin
                         yield b
+                    return
+                # multi-slice scans pipeline: a producer thread encodes
+                # and enqueues slice k+1's (packed) host buffers while
+                # slice k's device transfer drains the tunnel — host
+                # encode time hides behind the transfer wall
+                import queue as _queue
+                import threading
+
+                q: "_queue.Queue" = _queue.Queue(maxsize=1)
+                stop = threading.Event()
+
+                def put(item) -> bool:
+                    """Bounded put that re-checks ``stop`` — a consumer
+                    that abandons the scan (limit, downstream error)
+                    must not leave this thread blocked forever pinning
+                    the host split + an encoded batch."""
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            return True
+                        except _queue.Full:
+                            continue
+                    return False
+
+                def produce():
+                    try:
+                        for start in starts:
+                            if stop.is_set():
+                                return
+                            end = min(start + self.batch_rows, n)
+                            with TraceRange("ScanExec.upload"):
+                                b = interop.host_to_batch(
+                                    data, validity, self.schema, start,
+                                    end, stats=stats)
+                            b.origin = origin
+                            if not put(("batch", b)):
+                                return
+                        put(("done", None))
+                    except BaseException as e:  # surface in consumer
+                        put(("error", e))
+
+                t = threading.Thread(target=produce, daemon=True,
+                                     name="scan-upload")
+                t.start()
+                try:
+                    while True:
+                        kind, val = q.get()
+                        if kind == "done":
+                            return
+                        if kind == "error":
+                            raise val
+                        yield val
+                finally:
+                    stop.set()
+                    while True:  # unblock a mid-put producer
+                        try:
+                            q.get_nowait()
+                        except _queue.Empty:
+                            break
         return timed(self, it())
 
 

@@ -12,6 +12,7 @@ import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.io.hoststrings import HostStrings
 
 
 def schema_from_arrow(arrow_schema, columns: Optional[List[str]] = None
@@ -33,18 +34,46 @@ def schema_from_arrow(arrow_schema, columns: Optional[List[str]] = None
 
 
 def column_to_host(col, typ: dt.DType) -> Tuple[np.ndarray, np.ndarray]:
-    """One arrow ChunkedArray/Array -> (data ndarray, validity ndarray)."""
+    """One arrow ChunkedArray/Array -> (data ndarray, validity ndarray
+    or None when the column has no nulls — skipping the is_valid pass,
+    the fill_null pass, AND the validity upload)."""
     import pyarrow as pa
     import pyarrow.compute as pc
 
-    valid = pc.is_valid(col)
-    valid = valid.to_numpy(zero_copy_only=False).astype(bool)
+    if col.null_count == 0:
+        valid = None
+    else:
+        valid = pc.is_valid(col).to_numpy(
+            zero_copy_only=False).astype(bool)
     if typ is dt.STRING:
-        data = np.array(col.to_pylist(), dtype=object)
-        return data, valid
+        # stay dictionary-encoded end to end: arrow's C++ encode gives
+        # codes + unique values; sort the (small) dictionary and remap
+        # so code order == string order (StringColumn's invariant).
+        # Only the dictionary ever becomes Python objects.
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        if not pa.types.is_dictionary(col.type):
+            col = pc.dictionary_encode(col)
+        idx = col.indices if valid is None else pc.fill_null(col.indices, 0)
+        codes = idx.to_numpy(zero_copy_only=False).astype(
+            np.int32, copy=False)
+        dvals = col.dictionary.to_numpy(zero_copy_only=False)
+        if len(dvals):
+            ds = dvals.astype(str)
+            order = np.argsort(ds, kind="stable")
+            rank = np.empty(len(order), dtype=np.int32)
+            rank[order] = np.arange(len(order), dtype=np.int32)
+            codes = rank[codes]
+            dictionary = np.asarray(ds[order], dtype=object)
+        else:
+            dictionary = np.array([], dtype=object)
+        return HostStrings(codes, dictionary), valid
     if typ is dt.DATE:
-        ints = pc.fill_null(col.cast(pa.int32()), 0)
-        return ints.to_numpy(zero_copy_only=False).astype(np.int32), valid
+        ints = col.cast(pa.int32())
+        if valid is not None:
+            ints = pc.fill_null(ints, 0)
+        return ints.to_numpy(zero_copy_only=False).astype(
+            np.int32, copy=False), valid
     if typ is dt.TIMESTAMP:
         # normalize to UTC microseconds (the engine is UTC-only, like the
         # reference: GpuOverrides.scala:341)
@@ -53,14 +82,18 @@ def column_to_host(col, typ: dt.DType) -> Tuple[np.ndarray, np.ndarray]:
             ts = ts.combine_chunks()
         ts = ts.cast(pa.timestamp("us", tz="UTC")) \
             if ts.type.tz is not None else ts.cast(pa.timestamp("us"))
-        ints = pc.fill_null(ts.cast(pa.int64()), 0)
-        return ints.to_numpy(zero_copy_only=False).astype(np.int64), valid
+        ints = ts.cast(pa.int64())
+        if valid is not None:
+            ints = pc.fill_null(ints, 0)
+        return ints.to_numpy(zero_copy_only=False).astype(
+            np.int64, copy=False), valid
     if typ is dt.BOOLEAN:
-        filled = pc.fill_null(col, False)
-        return (filled.to_numpy(zero_copy_only=False).astype(bool), valid)
-    sentinel = 0
-    filled = pc.fill_null(col, sentinel)
-    arr = filled.to_numpy(zero_copy_only=False).astype(typ.np_dtype)
+        filled = col if valid is None else pc.fill_null(col, False)
+        return (filled.to_numpy(zero_copy_only=False).astype(
+            bool, copy=False), valid)
+    filled = col if valid is None else pc.fill_null(col, 0)
+    arr = filled.to_numpy(zero_copy_only=False).astype(
+        typ.np_dtype, copy=False)
     return arr, valid
 
 
@@ -92,8 +125,20 @@ def concat_host(parts, schema: Schema):
         return empty_host(schema)
     data, validity = {}, {}
     for name in schema.names:
-        data[name] = np.concatenate([p[0][name] for p in parts])
-        validity[name] = np.concatenate([p[1][name] for p in parts])
+        vals = [p[0][name] for p in parts]
+        if any(isinstance(v, HostStrings) for v in vals):
+            data[name] = HostStrings.concat(
+                [v if isinstance(v, HostStrings)
+                 else HostStrings.from_objects(v) for v in vals])
+        else:
+            data[name] = np.concatenate(vals)
+        vparts = [p[1][name] for p in parts]
+        if all(v is None for v in vparts):
+            validity[name] = None
+        else:
+            validity[name] = np.concatenate(
+                [v if v is not None else np.ones(len(d), dtype=bool)
+                 for v, d in zip(vparts, vals)])
     return data, validity
 
 

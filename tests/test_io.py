@@ -424,3 +424,72 @@ def test_parquet_row_estimate(pq_file):
     src = ParquetSource(pq_file)
     est = src.estimated_row_count()
     assert est is not None and est > 0
+
+
+# ----------------------------------------------- transfer packing round-trip
+
+def test_transfer_packing_roundtrip_exact():
+    """Packed uploads (narrow string codes, offset-narrowed ints,
+    scaled-decimal f64, bit-packed validity) must decode on device to
+    EXACTLY the full-width upload's values — bit-identical f64, same
+    nulls. Mixed with a non-packable f64 column (NaN + irrational) that
+    must fall back to raw."""
+    import jax
+    import numpy as np
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.batch import Schema
+    from spark_rapids_tpu.execs.interop import (_PACK_MIN_ROWS,
+                                                host_to_batch)
+    from spark_rapids_tpu.io.hoststrings import HostStrings
+
+    rng = np.random.default_rng(3)
+    n = _PACK_MIN_ROWS
+    money = np.round(rng.uniform(0, 5000, n), 2)       # ~all distinct: raw
+    qty = rng.integers(1, 51, n).astype(np.float64)    # 50 values: fdict
+    raw_f = rng.normal(0, 1, n)                        # not packable
+    raw_f[7] = np.nan
+    ints = (rng.integers(0, 1200, n) + 2_450_000).astype(np.int64)
+    iv = rng.random(n) > 0.3
+    scodes = rng.integers(0, 3, n).astype(np.int32)
+    sdict = np.asarray(["a", "bb", "ccc"], dtype=object)
+    sv = rng.random(n) > 0.1
+    schema = Schema(["m", "q", "r", "i", "s"],
+                    [dt.FLOAT64, dt.FLOAT64, dt.FLOAT64, dt.INT64,
+                     dt.STRING])
+    data = {"m": money, "q": qty, "r": raw_f, "i": ints,
+            "s": HostStrings(scodes, sdict)}
+    validity = {"m": None, "q": None, "r": None, "i": iv, "s": sv}
+    stats = {"i": (2_450_000, 2_451_199)}
+
+    packed = host_to_batch(data, validity, schema, stats=stats,
+                           pack=True)
+    full = host_to_batch(data, validity, schema, stats=stats,
+                         pack=False)
+    for cp, cf, name in zip(packed.columns, full.columns, schema.names):
+        dp = np.asarray(jax.device_get(cp.data))[:n]
+        df_ = np.asarray(jax.device_get(cf.data))[:n]
+        if name == "r":
+            np.testing.assert_array_equal(
+                dp.view(np.uint64), df_.view(np.uint64), err_msg=name)
+        elif name in ("m", "q"):
+            # bit-identical decode is the contract
+            np.testing.assert_array_equal(
+                dp.view(np.uint64), df_.view(np.uint64), err_msg=name)
+        else:
+            np.testing.assert_array_equal(dp, df_, err_msg=name)
+        vp = None if cp.validity is None else \
+            np.asarray(jax.device_get(cp.validity))[:n]
+        vf = None if cf.validity is None else \
+            np.asarray(jax.device_get(cf.validity))[:n]
+        assert (vp is None) == (vf is None), name
+        if vp is not None:
+            np.testing.assert_array_equal(vp, vf, err_msg=name)
+    # the narrow columns really were narrow on the wire: int span 1200
+    # fits u16, money span <= 500000 fits u32, qty fits u8, codes u8
+    from spark_rapids_tpu.execs import interop as it
+
+    assert it._narrow_uint(1199) is np.uint16
+    assert it._narrow_uint(50) is np.uint8
+    assert it._pack_fdict(qty, None) is not None      # 50 distinct values
+    assert it._pack_fdict(raw_f, None) is None        # ~all distinct
