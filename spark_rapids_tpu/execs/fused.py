@@ -68,6 +68,11 @@ from spark_rapids_tpu.utils.tracing import TraceRange
 
 _MAXH = jnp.iinfo(jnp.int64).max
 
+# dense-probe table ceiling: 4M i32 slots = 16 MB HBM per build. TPC
+# dim surrogate keys are 1..|dim| so even sf 1000 date/time/store/
+# household dims fit; above it the hash+searchsorted path stands.
+_DENSE_SPAN_MAX = 1 << 22
+
 
 # ---------------------------------------------------------------------------
 # step descriptors (host-side, picklable)
@@ -263,7 +268,16 @@ class JoinStep:
 class PreparedBuild:
     """Hash-sorted broadcast build table. ``ok`` False means duplicate
     matchable key hashes were found — the chain must fall back to the
-    general join kernel for exact multi-match expansion."""
+    general join kernel for exact multi-match expansion.
+
+    ``table`` (when set) is a dense inverse index over the build key's
+    value range: ``table[key - dense_lo]`` = sorted build row, -1 =
+    absent. Single integral keys whose span fits ``_DENSE_SPAN_MAX``
+    (every TPC fact->dim surrogate key) probe with ONE gather instead
+    of an int64 hash + searchsorted — the searchsorted lowers to a
+    ~17-step binary-search loop whose per-step gather costs ~100 ms at
+    multi-million-row probe widths on a v5e, which made the probe THE
+    on-device cost of TPCx-BB q9 at sf 1."""
 
     ok: bool
     h_sorted: Optional[jax.Array] = None
@@ -271,6 +285,8 @@ class PreparedBuild:
     vals: Optional[tuple] = None
     n_valid: Optional[jax.Array] = None   # device scalar
     ghosts: Optional[list] = None         # host wrap info per column
+    table: Optional[jax.Array] = None     # dense inverse index
+    dense_lo: int = 0
 
 
 def _hash_keys(key_cols: Sequence[ColV], types: Sequence[dt.DType],
@@ -297,11 +313,16 @@ def _hash_keys(key_cols: Sequence[ColV], types: Sequence[dt.DType],
     return h
 
 
-@partial(jax.jit, static_argnames=("key_ords", "types", "hash_types"))
-def _prep_build(datas, vals, num_rows, key_ords, types, hash_types):
+@partial(jax.jit, static_argnames=("key_ords", "types", "hash_types",
+                                   "key_range"))
+def _prep_build(datas, vals, num_rows, key_ords, types, hash_types,
+                key_range=False):
     """Sort the build by key hash; null-key and padding rows park at the
     +inf sentinel (they can never match). Returns the duplicate flag the
-    host checks once per query."""
+    host checks once per query, plus (when ``key_range``) the single
+    key's valid-row (min, max) in its comparison type — fetched in the
+    same sync as the dup flag so the host can build the dense probe
+    table without another round trip."""
     cols = [ColV(t, d, v) for t, d, v in zip(types, datas, vals)]
     h = _hash_keys([cols[o] for o in key_ords],
                    [types[o] for o in key_ords], hash_types, _BUILD_NULL)
@@ -317,7 +338,33 @@ def _prep_build(datas, vals, num_rows, key_ords, types, hash_types):
     else:
         dup = jnp.zeros((), dtype=bool)
     n_valid = jnp.sum(sh != _MAXH).astype(jnp.int32)
-    return sh, sdatas, svals, dup, n_valid
+    if key_range:
+        o = key_ords[0]
+        kd = cols[o].data.astype(hash_types[0].kernel_dtype).astype(
+            jnp.int64)
+        matchable = live & (h != _BUILD_NULL)
+        kmin = jnp.min(jnp.where(matchable, kd, jnp.int64(2**62)))
+        kmax = jnp.max(jnp.where(matchable, kd, jnp.int64(-2**62)))
+    else:
+        kmin = jnp.int64(0)
+        kmax = jnp.int64(-1)
+    return sh, sdatas, svals, dup, n_valid, kmin, kmax
+
+
+@partial(jax.jit, static_argnames=("span",))
+def _prep_dense_table(keys_sorted, n_valid, lo, span):
+    """Dense inverse index over the hash-sorted build: valid (live,
+    non-null-key) rows occupy the sorted prefix [0, n_valid), so
+    scatter their key positions once; absent values stay -1. One small
+    scatter per query per build — prep-time only."""
+    cap = keys_sorted.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    pos = (keys_sorted.astype(jnp.int64) - lo).astype(jnp.int32)
+    pos = jnp.where(iota < n_valid, pos, jnp.int32(span))
+    pos = jnp.clip(pos, 0, span)          # sentinel slot = span
+    table = jnp.full(span + 1, -1, dtype=jnp.int32)
+    table = table.at[pos].set(iota)
+    return table[:span]
 
 
 def _ghost_of(col: Column) -> "_Ghost":
@@ -338,58 +385,139 @@ _PREP_CACHE: "weakref.WeakKeyDictionary" = None
 _PREP_LOCK = threading.Lock()
 
 
-def prepare_build(exch: BroadcastExchangeExec, build_keys: Sequence[int],
-                  build_types: Sequence[dt.DType],
-                  hash_types: Sequence[dt.DType]) -> PreparedBuild:
-    """Materialize + hash-sort one broadcast build side; cached per
-    exchange object so every consumer partition and every chain sharing
-    the broadcast pays the one dispatch + one sync only once."""
+def _finalize_entries_locked(entries) -> None:
+    """Caller holds _PREP_LOCK. Fetch the dup/key-range flags for every
+    launched-but-unfinished entry in ONE device_get and build their
+    PreparedBuilds (dense tables launch async). Safe under the global
+    lock: finalization never materializes a subtree, so it cannot
+    recurse into the prep machinery."""
+    todo = [e for e in entries
+            if not e["done"].is_set() and e.get("pending") is not None]
+    if not todo:
+        return
+    try:
+        flags = jax.device_get(
+            [(e["pending"][0][3], e["pending"][0][5],
+              e["pending"][0][6]) for e in todo])
+    except BaseException as exc:
+        for e in todo:
+            e["error"] = exc
+            e["done"].set()
+        raise
+    for e, (dup_h, kmin_h, kmax_h) in zip(todo, flags):
+        (sh, sdatas, svals, _d, n_valid, _kn, _kx), ghosts, \
+            want_range, build_keys = e.pop("pending")
+        if bool(dup_h):
+            prep = PreparedBuild(ok=False)
+        else:
+            prep = PreparedBuild(
+                ok=True, h_sorted=sh, datas=tuple(sdatas),
+                vals=tuple(svals), n_valid=n_valid, ghosts=ghosts)
+            if want_range and int(kmin_h) <= int(kmax_h):
+                from spark_rapids_tpu.ops.groupby import quantize_range
+
+                qlo, qhi = quantize_range(int(kmin_h), int(kmax_h))
+                span = qhi - qlo + 1
+                if span <= _DENSE_SPAN_MAX:
+                    with TraceRange("FusedChain.denseTable"):
+                        prep.table = _prep_dense_table(
+                            sdatas[build_keys[0]], n_valid,
+                            jnp.int64(qlo), span=span)
+                    prep.dense_lo = qlo
+        e["prep"] = prep
+        e["done"].set()
+
+
+def prepare_builds(specs) -> List[PreparedBuild]:
+    """Materialize + hash-sort MANY broadcast build sides with (at
+    most) ONE host sync. ``specs``: [(exchange, build_keys,
+    build_types, hash_types)].
+
+    Per-build prep costs a dispatch (+1 for a dense table) but the dup/
+    key-range flags need a blocking device_get; done per build that is
+    4 round trips on a q9-class 4-dim join chain. Builds are claimed
+    and LAUNCHED one at a time (a build's materialization can recurse
+    into prepare_builds for a fused chain nested in its subtree — a
+    sibling claimed later is then simply unowned and the nested call
+    owns it; a sibling launched earlier is finalizable by ANY caller,
+    so no claim is ever held un-launched while waiting). The flag sync
+    itself batches over every still-pending launch. Cached per
+    exchange object so every consumer partition and every chain
+    sharing the broadcast pays its prep only once."""
     import weakref
 
     global _PREP_CACHE
-    key = (tuple(build_keys), tuple(hash_types))
+    entries = []   # (cache, key, entry, owner) per spec
+    for exch, build_keys, build_types, hash_types in specs:
+        key = (tuple(build_keys), tuple(hash_types))
+        with _PREP_LOCK:
+            if _PREP_CACHE is None:
+                _PREP_CACHE = weakref.WeakKeyDictionary()
+            cache = _PREP_CACHE.get(exch)
+            if cache is None:
+                cache = _PREP_CACHE[exch] = {}
+            entry = cache.get(key)
+            if entry is None:
+                entry = cache[key] = {"done": threading.Event(),
+                                      "prep": None, "error": None,
+                                      "pending": None}
+                owner = True
+            else:
+                owner = False
+        entries.append((cache, key, entry, owner))
+        if not owner:
+            continue
+        # launch this build's prep now (async, no sync); materialize
+        # may recurse into prepare_builds for nested chains
+        try:
+            want_range = len(build_keys) == 1 and (
+                hash_types[0].is_integral or
+                hash_types[0] in (dt.DATE, dt.TIMESTAMP, dt.BOOLEAN))
+            with exch._materialize().acquired() as b:
+                with TraceRange("FusedChain.prepareBuild"):
+                    out = _prep_build(
+                        [c.data for c in b.columns],
+                        [c.validity for c in b.columns],
+                        b.num_rows_device(), tuple(build_keys),
+                        tuple(build_types), tuple(hash_types),
+                        key_range=want_range)
+                ghosts = [_ghost_of(c) for c in b.columns]
+            with _PREP_LOCK:
+                entry["pending"] = (out, ghosts, want_range,
+                                    tuple(build_keys))
+        except BaseException as e:
+            entry["error"] = e
+            with _PREP_LOCK:
+                cache.pop(key, None)  # a later caller may retry
+            entry["done"].set()
+            raise
+
+    # one sync finalizes every build this call launched
     with _PREP_LOCK:
-        if _PREP_CACHE is None:
-            _PREP_CACHE = weakref.WeakKeyDictionary()
-        cache = _PREP_CACHE.get(exch)
-        if cache is None:
-            cache = _PREP_CACHE[exch] = {}
-        entry = cache.get(key)
-        if entry is None:
-            entry = cache[key] = {"done": threading.Event(),
-                                  "prep": None, "error": None}
-            owner = True
-        else:
-            owner = False
-    if not owner:
-        entry["done"].wait()
+        _finalize_entries_locked([e for _c, _k, e, own in entries
+                                  if own])
+    out: List[PreparedBuild] = []
+    for _cache, _key, entry, _own in entries:
+        if not entry["done"].is_set():
+            # someone else launched it: finalize if launched, else wait
+            # for their launch to post (short — the launcher is inside
+            # materialize+dispatch, never inside a wait on us)
+            with _PREP_LOCK:
+                _finalize_entries_locked([entry])
+            if not entry["done"].is_set():
+                entry["done"].wait()
         if entry["error"] is not None:
             raise entry["error"]
-        return entry["prep"]
-    try:
-        with exch._materialize().acquired() as b:
-            with TraceRange("FusedChain.prepareBuild"):
-                sh, sdatas, svals, dup, n_valid = _prep_build(
-                    [c.data for c in b.columns],
-                    [c.validity for c in b.columns],
-                    b.num_rows_device(), tuple(build_keys),
-                    tuple(build_types), tuple(hash_types))
-            if bool(jax.device_get(dup)):
-                prep = PreparedBuild(ok=False)
-            else:
-                prep = PreparedBuild(
-                    ok=True, h_sorted=sh, datas=tuple(sdatas),
-                    vals=tuple(svals), n_valid=n_valid,
-                    ghosts=[_ghost_of(c) for c in b.columns])
-        entry["prep"] = prep
-        return prep
-    except BaseException as e:
-        entry["error"] = e
-        with _PREP_LOCK:
-            cache.pop(key, None)  # a later caller may retry
-        raise
-    finally:
-        entry["done"].set()
+        out.append(entry["prep"])
+    return out
+
+
+def prepare_build(exch: BroadcastExchangeExec, build_keys: Sequence[int],
+                  build_types: Sequence[dt.DType],
+                  hash_types: Sequence[dt.DType]) -> PreparedBuild:
+    """Single-build convenience wrapper over prepare_builds."""
+    return prepare_builds([(exch, build_keys, build_types,
+                            hash_types)])[0]
 
 
 # ---------------------------------------------------------------------------
@@ -439,22 +567,23 @@ class FusedChain:
         self._number_aux_slots()
         self._programs = {}
 
-    def chain_key(self, compact_out: bool):
+    def chain_key(self, compact_out: bool, modes: tuple = ()):
         ks = tuple(s.key() for s in self.steps)
         if any(k is None for k in ks):
             return None
-        return ("fused_chain", ks, tuple(self.source_types), compact_out)
+        return ("fused_chain", ks, tuple(self.source_types), compact_out,
+                modes)
 
-    def _program(self, compact_out: bool):
-        prog = self._programs.get(compact_out)
+    def _program(self, compact_out: bool, modes: tuple = ()):
+        prog = self._programs.get((compact_out, modes))
         if prog is not None:
             return prog
-        key = self.chain_key(compact_out)
+        key = self.chain_key(compact_out, modes)
         prog = _fused_cache_get(key)
         if prog is None:
             prog = self._build_program(compact_out)
             _fused_cache_put(key, prog)
-        self._programs[compact_out] = prog
+        self._programs[(compact_out, modes)] = prog
         return prog
 
     def _build_program(self, compact_out: bool):
@@ -517,9 +646,14 @@ class FusedChain:
         collection and the caller's output wrapping."""
         states, final_ghosts = self._ghost_states(batch, preps)
         build_ops = tuple(
-            (p.h_sorted, p.datas, p.vals, p.n_valid) for p in preps)
+            (p.h_sorted, p.datas, p.vals, p.n_valid, p.table,
+             None if p.table is None else p.dense_lo)
+            for p in preps)
+        # dense/hash probe mode is per-build runtime information (key
+        # stats), so it keys the compiled program separately
+        modes = tuple(p.table is not None for p in preps)
         aux = self._aux_from_states(states)
-        outs, live = self._program(compact_out)(
+        outs, live = self._program(compact_out, modes)(
             [c.data for c in batch.columns],
             [c.validity for c in batch.columns],
             batch.num_rows_device(), build_ops, aux,
@@ -589,32 +723,48 @@ class FusedChain:
 
 def _apply_join(step: JoinStep, cols: List[ColV], live,
                 b: Tuple) -> Tuple[List[ColV], jax.Array]:
-    """Unique-build probe: searchsorted into the hash-sorted build, one
-    candidate per probe row, exact key verification; matches fold into
-    the live-mask (inner/semi/anti) or gathered validity (left)."""
-    sh, datas, vals, n_valid = b
-    key_cols = [cols[o] for o in step.stream_keys]
-    h_p = _hash_keys(key_cols, [c.dtype for c in key_cols],
-                     step.key_common, _PROBE_NULL)
+    """Unique-build probe. Dense mode (fact->dim surrogate keys): ONE
+    gather into the prep-time inverse table — exact by construction, no
+    hashing, no verification. Hash mode: searchsorted into the
+    hash-sorted build + exact key verification. Either way each probe
+    row has at most one candidate; matches fold into the live-mask
+    (inner/semi/anti) or gathered validity (left)."""
+    sh, datas, vals, n_valid, table, dense_lo = b
     b_cap = sh.shape[0]
-    lo = jnp.searchsorted(sh, h_p, side="left").astype(jnp.int32)
-    lo_c = jnp.clip(lo, 0, b_cap - 1)
-    found = (jnp.take(sh, lo_c) == h_p) & (lo < n_valid)
-    for so, bo, ct in zip(step.stream_keys, step.build_keys,
-                          step.key_common):
-        sc = cols[so]
-        sd = sc.data if sc.dtype is ct else \
-            sc.data.astype(ct.kernel_dtype)
-        bd = jnp.take(datas[bo], lo_c)
-        if step.build_types[bo] is not ct:
-            bd = bd.astype(ct.kernel_dtype)
-        bv = vals[bo]
-        bv = None if bv is None else jnp.take(bv, lo_c)
-        s_comps, s_valid = sortkeys.equality_parts(sd, sc.validity, ct)
-        b_comps, b_valid = sortkeys.equality_parts(bd, bv, ct)
-        found = found & s_valid & b_valid
-        for scp, bcp in zip(s_comps, b_comps):
-            found = found & (scp == bcp)
+    if table is not None:
+        span = table.shape[0]
+        sc = cols[step.stream_keys[0]]
+        pos = sc.data.astype(jnp.int64) - dense_lo
+        inb = (pos >= 0) & (pos < span)
+        idx = jnp.take(table,
+                       jnp.clip(pos, 0, span - 1).astype(jnp.int32))
+        found = inb & (idx >= 0)
+        if sc.validity is not None:
+            found = found & sc.validity
+        lo_c = jnp.clip(idx, 0, b_cap - 1)
+    else:
+        key_cols = [cols[o] for o in step.stream_keys]
+        h_p = _hash_keys(key_cols, [c.dtype for c in key_cols],
+                         step.key_common, _PROBE_NULL)
+        lo = jnp.searchsorted(sh, h_p, side="left").astype(jnp.int32)
+        lo_c = jnp.clip(lo, 0, b_cap - 1)
+        found = (jnp.take(sh, lo_c) == h_p) & (lo < n_valid)
+        for so, bo, ct in zip(step.stream_keys, step.build_keys,
+                              step.key_common):
+            sc = cols[so]
+            sd = sc.data if sc.dtype is ct else \
+                sc.data.astype(ct.kernel_dtype)
+            bd = jnp.take(datas[bo], lo_c)
+            if step.build_types[bo] is not ct:
+                bd = bd.astype(ct.kernel_dtype)
+            bv = vals[bo]
+            bv = None if bv is None else jnp.take(bv, lo_c)
+            s_comps, s_valid = sortkeys.equality_parts(sd, sc.validity,
+                                                       ct)
+            b_comps, b_valid = sortkeys.equality_parts(bd, bv, ct)
+            found = found & s_valid & b_valid
+            for scp, bcp in zip(s_comps, b_comps):
+                found = found & (scp == bcp)
     if step.kind == "left_semi":
         return cols, live & found
     if step.kind == "left_anti":
@@ -684,15 +834,11 @@ class FusedChainExec(TpuExec):
     def _ensure_preps(self) -> bool:
         with self._prep_lock:
             if self._preps_ok is None:
-                preps = []
-                ok = True
-                for exch, (keys, types, commons) in zip(
-                        self.builds, self.build_key_specs):
-                    p = prepare_build(exch, keys, types, commons)
-                    preps.append(p)
-                    if not p.ok:
-                        ok = False
-                        break
+                preps = prepare_builds(
+                    [(exch, keys, types, commons)
+                     for exch, (keys, types, commons) in zip(
+                         self.builds, self.build_key_specs)])
+                ok = all(p.ok for p in preps)
                 self._preps = preps if ok else None
                 self._preps_ok = ok
             return self._preps_ok

@@ -277,3 +277,72 @@ def test_multi_join_distinct_key_shapes_pair_preps_correctly():
     widths = sorted(len(s.build_types) for s in fused[0].chain.steps
                     if isinstance(s, JoinStep))
     assert len(widths) == 2 and widths[0] != widths[1], widths
+
+
+# --------------------------------------------------- dense probe tables
+
+def test_dense_probe_selected_and_matches_hash_path():
+    """Single integral build keys probe through the dense inverse table
+    (PreparedBuild.table); results must equal both the hash-probe path
+    (span forced above _DENSE_SPAN_MAX via monkeypatch) and fusion-off,
+    including negative keys, out-of-range probes, and null keys on both
+    sides."""
+    import spark_rapids_tpu.execs.fused as fu
+
+    rng = np.random.default_rng(29)
+    n = 600
+    fact = pd.DataFrame({
+        "k": rng.integers(-40, 60, n).astype(np.int64),  # out-of-range
+        "v": rng.normal(size=n)})                        # probes incl.
+    fact.loc[rng.integers(0, n, 25), "v"] = None
+    dim = pd.DataFrame({
+        "id": np.arange(-30, 25, dtype=np.int64),    # negative base
+        "w": rng.normal(size=55)})
+    sql = ("SELECT f.k AS k, f.v AS v, d.w AS w FROM f JOIN d "
+           "ON f.k = d.id ORDER BY k, v")
+    on, _ = _both(sql, fact, dim)
+    ex = on.sql(sql)._exec()
+    fused = find(ex, (FusedAggregateExec, FusedChainExec))
+    assert fused
+    list(fused[0].execute(0))
+    assert fused[0]._preps is not None
+    assert fused[0]._preps[0].table is not None      # dense mode chosen
+
+    # force the hash path and compare exactly
+    old = fu._DENSE_SPAN_MAX
+    fu._DENSE_SPAN_MAX = 0
+    try:
+        on2, off2 = _sessions()
+        _register(on2, fact, dim)
+        _register(off2, fact, dim)
+        got_hash = on2.sql(sql).collect()
+        want = off2.sql(sql).collect()
+        assert_frames_equal(want, got_hash)
+        ex2 = on2.sql(sql)._exec()
+        fused2 = find(ex2, (FusedAggregateExec, FusedChainExec))
+        list(fused2[0].execute(0))
+        assert fused2[0]._preps[0].table is None     # hash mode forced
+    finally:
+        fu._DENSE_SPAN_MAX = old
+
+
+def test_dense_probe_multi_key_stays_hash():
+    """Composite join keys keep the hash+searchsorted probe."""
+    rng = np.random.default_rng(31)
+    n = 400
+    fact = pd.DataFrame({
+        "a": rng.integers(0, 8, n).astype(np.int64),
+        "b": rng.integers(0, 7, n).astype(np.int64),
+        "v": rng.normal(size=n)})
+    dim = pd.DataFrame({
+        "x": np.repeat(np.arange(8, dtype=np.int64), 7),
+        "y": np.tile(np.arange(7, dtype=np.int64), 8),
+        "w": rng.normal(size=56)})
+    sql = ("SELECT f.a AS a, f.b AS b, f.v AS v, d.w AS w FROM f "
+           "JOIN d ON f.a = d.x AND f.b = d.y ORDER BY a, b, v")
+    on, _ = _both(sql, fact, dim)
+    ex = on.sql(sql)._exec()
+    fused = find(ex, (FusedAggregateExec, FusedChainExec))
+    assert fused
+    list(fused[0].execute(0))
+    assert fused[0]._preps[0].table is None
