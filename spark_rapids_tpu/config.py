@@ -260,6 +260,29 @@ FUSION_ENABLED = conf("rapids.tpu.sql.fusion.enabled").doc(
     "hashes fall back to the general expansion kernel automatically."
 ).boolean_conf.create_with_default(True)
 
+SCAN_PACK_TRANSFERS = conf("rapids.tpu.scan.packTransfers").doc(
+    "Pack scan uploads before they cross the host->device link: string "
+    "codes ship at the dictionary's width, integers offset-narrow to "
+    "their footer-stat span, repeated-value doubles ship as codes plus "
+    "a value table, validity masks bit-pack 8x; one jitted program per "
+    "batch decodes on device, bit-exactly (verified host-side per "
+    "column before each encoding is chosen). The TPU-native analogue "
+    "of the reference's nvcomp-compressed transfers "
+    "(GpuCompressedColumnVector) — a TPU cannot LZ4-decode, but it can "
+    "widen and gather. Matters whenever the link is thin: the axon "
+    "tunnel measures ~20-45 MB/s, so TPC-H q1 @ sf 1 drops from ~264 "
+    "to ~70 uploaded MB. Applies to scans of >= 65536 rows."
+).boolean_conf.create_with_default(True)
+
+FUSION_DENSE_PROBE_MAX_SPAN = conf(
+    "rapids.tpu.sql.fusion.denseProbe.maxSpan").doc(
+    "Ceiling on the build-key value span (table slots, 4 bytes each) "
+    "for the fused chain's dense inverse-table join probe: "
+    "table[key - lo] = build row, ONE gather per join. Spans above it "
+    "use the int64 hash + searchsorted probe (a ~17-step binary-search "
+    "gather loop). Single integral keys only; 0 disables."
+).int_conf.create_with_default(1 << 22)
+
 CLUSTER_ENABLED = conf("rapids.tpu.cluster.enabled").doc(
     "Execute shuffle exchanges through the multi-process cluster runtime: "
     "map tasks write partitioned output into per-executor shuffle catalogs "

@@ -30,10 +30,11 @@ class ScanExec(TpuExec):
     FilePartition -> task mapping)."""
 
     def __init__(self, source: DataSource, schema: Schema,
-                 batch_rows: int = 1 << 20):
+                 batch_rows: int = 1 << 20, pack: bool = True):
         super().__init__([], schema)
         self.source = source
         self.batch_rows = batch_rows
+        self.pack = pack
 
     @property
     def num_partitions(self) -> int:
@@ -55,7 +56,8 @@ class ScanExec(TpuExec):
                     with TraceRange("ScanExec.upload"):
                         b = interop.host_to_batch(data, validity,
                                                   self.schema, 0, n,
-                                                  stats=stats)
+                                                  stats=stats,
+                                                  pack=self.pack)
                         b.origin = origin
                         yield b
                     return
@@ -91,7 +93,7 @@ class ScanExec(TpuExec):
                             with TraceRange("ScanExec.upload"):
                                 b = interop.host_to_batch(
                                     data, validity, self.schema, start,
-                                    end, stats=stats)
+                                    end, stats=stats, pack=self.pack)
                             b.origin = origin
                             if not put(("batch", b)):
                                 return

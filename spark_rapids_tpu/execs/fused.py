@@ -406,7 +406,7 @@ def _finalize_entries_locked(entries) -> None:
         raise
     for e, (dup_h, kmin_h, kmax_h) in zip(todo, flags):
         (sh, sdatas, svals, _d, n_valid, _kn, _kx), ghosts, \
-            want_range, build_keys = e.pop("pending")
+            want_range, build_keys, span_max = e.pop("pending")
         if bool(dup_h):
             prep = PreparedBuild(ok=False)
         else:
@@ -418,7 +418,7 @@ def _finalize_entries_locked(entries) -> None:
 
                 qlo, qhi = quantize_range(int(kmin_h), int(kmax_h))
                 span = qhi - qlo + 1
-                if span <= _DENSE_SPAN_MAX:
+                if span <= span_max:
                     with TraceRange("FusedChain.denseTable"):
                         prep.table = _prep_dense_table(
                             sdatas[build_keys[0]], n_valid,
@@ -448,8 +448,8 @@ def prepare_builds(specs) -> List[PreparedBuild]:
 
     global _PREP_CACHE
     entries = []   # (cache, key, entry, owner) per spec
-    for exch, build_keys, build_types, hash_types in specs:
-        key = (tuple(build_keys), tuple(hash_types))
+    for exch, build_keys, build_types, hash_types, span_max in specs:
+        key = (tuple(build_keys), tuple(hash_types), span_max)
         with _PREP_LOCK:
             if _PREP_CACHE is None:
                 _PREP_CACHE = weakref.WeakKeyDictionary()
@@ -470,7 +470,7 @@ def prepare_builds(specs) -> List[PreparedBuild]:
         # launch this build's prep now (async, no sync); materialize
         # may recurse into prepare_builds for nested chains
         try:
-            want_range = len(build_keys) == 1 and (
+            want_range = span_max > 0 and len(build_keys) == 1 and (
                 hash_types[0].is_integral or
                 hash_types[0] in (dt.DATE, dt.TIMESTAMP, dt.BOOLEAN))
             with exch._materialize().acquired() as b:
@@ -484,7 +484,7 @@ def prepare_builds(specs) -> List[PreparedBuild]:
                 ghosts = [_ghost_of(c) for c in b.columns]
             with _PREP_LOCK:
                 entry["pending"] = (out, ghosts, want_range,
-                                    tuple(build_keys))
+                                    tuple(build_keys), span_max)
         except BaseException as e:
             entry["error"] = e
             with _PREP_LOCK:
@@ -517,7 +517,7 @@ def prepare_build(exch: BroadcastExchangeExec, build_keys: Sequence[int],
                   hash_types: Sequence[dt.DType]) -> PreparedBuild:
     """Single-build convenience wrapper over prepare_builds."""
     return prepare_builds([(exch, build_keys, build_types,
-                            hash_types)])[0]
+                            hash_types, _DENSE_SPAN_MAX)])[0]
 
 
 # ---------------------------------------------------------------------------
@@ -806,11 +806,12 @@ class FusedChainExec(TpuExec):
 
     def __init__(self, source: TpuExec, chain: FusedChain,
                  builds: List[BroadcastExchangeExec], schema: Schema,
-                 fallback: TpuExec):
+                 fallback: TpuExec, conf=None):
         super().__init__([source], schema)
         self.chain = chain
         self.builds = builds
         self.fallback = fallback
+        self.conf = conf
         self.build_key_specs = _build_key_specs(chain.steps)
         self._preps: Optional[List[PreparedBuild]] = None
         self._preps_ok: Optional[bool] = None
@@ -834,8 +835,13 @@ class FusedChainExec(TpuExec):
     def _ensure_preps(self) -> bool:
         with self._prep_lock:
             if self._preps_ok is None:
+                from spark_rapids_tpu import config as cfg
+
+                conf = getattr(self, "conf", None)
+                span_max = conf.get(cfg.FUSION_DENSE_PROBE_MAX_SPAN) \
+                    if conf is not None else _DENSE_SPAN_MAX
                 preps = prepare_builds(
-                    [(exch, keys, types, commons)
+                    [(exch, keys, types, commons, span_max)
                      for exch, (keys, types, commons) in zip(
                          self.builds, self.build_key_specs)])
                 ok = all(p.ok for p in preps)
@@ -1100,7 +1106,8 @@ def _fuse_node(node: TpuExec, conf, memo: dict) -> TpuExec:
                 chain = FusedChain(steps, list(new_source.schema.types),
                                    len(builds))
                 out = FusedChainExec(new_source, chain, builds,
-                                     node.schema, fallback=node)
+                                     node.schema, fallback=node,
+                                     conf=conf)
     if out is None:
         node.children = [_fuse_node(c, conf, memo) for c in node.children]
         out = node

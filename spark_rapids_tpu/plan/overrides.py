@@ -357,7 +357,8 @@ class _ScanRule(NodeRule):
                                            node.output_schema())
         rows = meta.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)
         return basic.ScanExec(node.source, node.output_schema(),
-                              batch_rows=rows)
+                              batch_rows=rows,
+                              pack=meta.conf.get(cfg.SCAN_PACK_TRANSFERS))
 
 
 class _WriteRule(NodeRule):

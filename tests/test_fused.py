@@ -308,22 +308,19 @@ def test_dense_probe_selected_and_matches_hash_path():
     assert fused[0]._preps is not None
     assert fused[0]._preps[0].table is not None      # dense mode chosen
 
-    # force the hash path and compare exactly
-    old = fu._DENSE_SPAN_MAX
-    fu._DENSE_SPAN_MAX = 0
-    try:
-        on2, off2 = _sessions()
-        _register(on2, fact, dim)
-        _register(off2, fact, dim)
-        got_hash = on2.sql(sql).collect()
-        want = off2.sql(sql).collect()
-        assert_frames_equal(want, got_hash)
-        ex2 = on2.sql(sql)._exec()
-        fused2 = find(ex2, (FusedAggregateExec, FusedChainExec))
-        list(fused2[0].execute(0))
-        assert fused2[0]._preps[0].table is None     # hash mode forced
-    finally:
-        fu._DENSE_SPAN_MAX = old
+    # force the hash path via the config knob and compare exactly
+    on2 = Session(conf={"rapids.tpu.sql.fusion.enabled": True,
+                        "rapids.tpu.sql.fusion.denseProbe.maxSpan": 0})
+    off2 = Session(conf={"rapids.tpu.sql.fusion.enabled": False})
+    _register(on2, fact, dim)
+    _register(off2, fact, dim)
+    got_hash = on2.sql(sql).collect()
+    want = off2.sql(sql).collect()
+    assert_frames_equal(want, got_hash)
+    ex2 = on2.sql(sql)._exec()
+    fused2 = find(ex2, (FusedAggregateExec, FusedChainExec))
+    list(fused2[0].execute(0))
+    assert fused2[0]._preps[0].table is None         # hash mode forced
 
 
 def test_dense_probe_multi_key_stays_hash():
