@@ -48,6 +48,11 @@ class HashAggregateExec(TpuExec):
         # a live_mask — the planner fuses Filter(child) pairs here, saving
         # the per-batch compaction pass (argsort + per-column gathers)
         self.fused_filter = fused_filter
+        # resolve the grouping-sets dense guard NOW, while the full
+        # in-process subtree is visible: a cluster rewrite may later
+        # swap it for a shuffle-read stub (runtime/cluster.py), and the
+        # pickled exec must carry the already-resolved flag
+        self._dense_ok()
         self._build()
 
     def _build(self):
@@ -136,6 +141,33 @@ class HashAggregateExec(TpuExec):
 
     # ------------------------------------------------------------------
 
+    def _dense_ok(self) -> bool:
+        """Grouping-set aggregates (an ExpandExec anywhere below) must
+        not take the sort-free dense groupby for FLOAT sums: expand
+        places each level's copy of the same rows at different
+        positions, and the dense sweep's position-dependent reduction
+        tree would break the cross-level bit-equality of float sums
+        that rank()-over-sum ties rely on (TPC-DS q67). The kernel
+        itself re-enables dense when no order-sensitive aggregate is
+        present (ints/counts/min/max are order-invariant). Computed
+        EAGERLY on first call in-process and cached on the exec, so a
+        cluster rewrite that later replaces the subtree with a
+        shuffle-read stub ships the already-resolved flag."""
+        ok = getattr(self, "_dense_ok_cached", None)
+        if ok is None:
+            from spark_rapids_tpu.execs.basic import ExpandExec
+
+            stack: list = [self]
+            ok = True
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ExpandExec):
+                    ok = False
+                    break
+                stack.extend(getattr(n, "children", ()))
+            self._dense_ok_cached = ok
+        return ok
+
     def _agg_batch(self, batch: ColumnarBatch, specs: List[AggSpec],
                    types: List[dt.DType], live_mask=None
                    ) -> ColumnarBatch:
@@ -150,7 +182,8 @@ class HashAggregateExec(TpuExec):
         # handler's spill-and-retry, DeviceMemoryEventHandler.scala:42)
         return with_oom_retry(
             lambda: groupby_aggregate(batch, list(range(nkeys)), specs,
-                                      types, live_mask))[0]
+                                      types, live_mask,
+                                      dense_ok=self._dense_ok()))[0]
 
     def _merge_types(self) -> List[dt.DType]:
         return [e.dtype for e in self.grouping] + self.partial_types

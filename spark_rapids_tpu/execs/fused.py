@@ -431,7 +431,7 @@ def _finalize_entries_locked(entries) -> None:
 def prepare_builds(specs) -> List[PreparedBuild]:
     """Materialize + hash-sort MANY broadcast build sides with (at
     most) ONE host sync. ``specs``: [(exchange, build_keys,
-    build_types, hash_types)].
+    build_types, hash_types, dense_span_max)].
 
     Per-build prep costs a dispatch (+1 for a dense table) but the dup/
     key-range flags need a blocking device_get; done per build that is
@@ -514,10 +514,12 @@ def prepare_builds(specs) -> List[PreparedBuild]:
 
 def prepare_build(exch: BroadcastExchangeExec, build_keys: Sequence[int],
                   build_types: Sequence[dt.DType],
-                  hash_types: Sequence[dt.DType]) -> PreparedBuild:
+                  hash_types: Sequence[dt.DType],
+                  dense_span_max: int = _DENSE_SPAN_MAX
+                  ) -> PreparedBuild:
     """Single-build convenience wrapper over prepare_builds."""
     return prepare_builds([(exch, build_keys, build_types,
-                            hash_types, _DENSE_SPAN_MAX)])[0]
+                            hash_types, dense_span_max)])[0]
 
 
 # ---------------------------------------------------------------------------

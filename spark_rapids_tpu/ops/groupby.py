@@ -116,20 +116,37 @@ _AOT_CHUNK_MIN_CAP = 1 << 15
 
 def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
                       aggs: List[AggSpec], dtypes: List[dt.DType],
-                      live_mask=None
+                      live_mask=None, dense_ok: bool = True
                       ) -> Tuple[ColumnarBatch, List[dt.DType]]:
     """Returns (result batch [keys..., agg results...], result dtypes).
-    ``live_mask`` fuses an upstream filter into the sort pass."""
+    ``live_mask`` fuses an upstream filter into the sort pass.
+    ``dense_ok`` False forces the sort path even for tiny key spaces:
+    grouping-set (ROLLUP/CUBE) aggregates need it, because the expand
+    step places each level's copy of the same rows at different
+    positions and the dense sweep's reduction tree is position-
+    dependent — levels summing the SAME value set would differ in the
+    last ulp, splitting rank()-over-sum ties the sort path (segment-
+    relative scan order) keeps exact."""
     cols = [(c.data, c.validity) for c in batch.columns]
     key_ranges = tuple(key_range_of(batch.columns[o], dtypes[o])
                        for o in key_ordinals)
     key_has_v = tuple(batch.columns[o].validity is not None
                       for o in key_ordinals)
+    # dense_ok=False only needs to suppress ORDER-SENSITIVE float
+    # reductions; integer sums/counts/min/max are exact regardless of
+    # reduction-tree shape, so a grouping-set aggregate over those
+    # keeps the dense path
+    if not dense_ok and not any(
+            spec.op in ("sum_of_squares", "m2", "rterm") or
+            (spec.op == "sum" and spec.ordinal >= 0 and
+             dtypes[spec.ordinal].is_floating)
+            for spec in aggs):
+        dense_ok = True
     # the dense path never builds the fused sort module the AOT
     # segfault workaround guards against — wide agg lists stay whole
-    dense_ok = _dense_layout(list(dtypes), key_ordinals, key_ranges,
-                             key_has_v) is not None
-    if len(aggs) > _AOT_MAX_AGGS and not dense_ok and \
+    will_dense = dense_ok and _dense_layout(
+        list(dtypes), key_ordinals, key_ranges, key_has_v) is not None
+    if len(aggs) > _AOT_MAX_AGGS and not will_dense and \
             batch.capacity >= _AOT_CHUNK_MIN_CAP:
         agg_d, agg_v = [], []
         key_d = key_v = num_groups = None
@@ -137,7 +154,8 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
             chunk = tuple(aggs[lo:lo + _AOT_MAX_AGGS])
             out = _groupby(cols, tuple(dtypes), tuple(key_ordinals),
                            chunk, batch.num_rows_device(),
-                           live_mask=live_mask, key_ranges=key_ranges)
+                           live_mask=live_mask, key_ranges=key_ranges,
+                           dense_ok=dense_ok)
             (ck_d, ck_v), (ca_d, ca_v), ng = out
             if key_d is None:
                 key_d, key_v, num_groups = ck_d, ck_v, ng
@@ -146,7 +164,8 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
     else:
         out = _groupby(cols, tuple(dtypes), tuple(key_ordinals),
                        tuple(aggs), batch.num_rows_device(),
-                       live_mask=live_mask, key_ranges=key_ranges)
+                       live_mask=live_mask, key_ranges=key_ranges,
+                       dense_ok=dense_ok)
         (key_d, key_v), (agg_d, agg_v), num_groups = out
     out_cols: List[Column] = []
     out_types: List[dt.DType] = []
@@ -439,9 +458,9 @@ def _cumsum_isolated(x):
 
 
 @partial(jax.jit, static_argnames=("dtypes", "key_ordinals", "aggs",
-                                   "key_ranges"))
+                                   "key_ranges", "dense_ok"))
 def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
-             live_mask=None, key_ranges=None):
+             live_mask=None, key_ranges=None, dense_ok=True):
     """``live_mask``: optional fused filter — masked-out rows are dead
     (they sort last with the padding and never reach a segment)."""
     capacity = cols[0][0].shape[0]
@@ -452,7 +471,8 @@ def _groupby(cols, dtypes, key_ordinals, aggs, num_rows,
         num_rows = jnp.sum(live).astype(jnp.int32)
 
     key_has_v = tuple(cols[o][1] is not None for o in key_ordinals)
-    dense = _dense_layout(dtypes, key_ordinals, key_ranges, key_has_v)
+    dense = _dense_layout(dtypes, key_ordinals, key_ranges, key_has_v) \
+        if dense_ok else None
     if dense is not None:
         return _dense_groupby(cols, dtypes, key_ordinals, aggs, live,
                               dense)

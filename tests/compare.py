@@ -14,7 +14,8 @@ from spark_rapids_tpu.execs.base import collect
 from spark_rapids_tpu.plan.overrides import apply_overrides
 
 
-def _normalize(df: pd.DataFrame, sort: bool) -> pd.DataFrame:
+def _normalize(df: pd.DataFrame, sort: bool,
+               approx_float: float = 1e-9) -> pd.DataFrame:
     out = {}
     for c in df.columns:
         s = df[c]
@@ -40,11 +41,31 @@ def _normalize(df: pd.DataFrame, sort: bool) -> pd.DataFrame:
         columns=list(df.columns))
     if sort and len(norm):
         rows = list(zip(*[out[c] for c in df.columns])) if out else []
+        # floats sort by a tolerance-rounded key: two engines may
+        # legally differ in the last ulps (within approx_float), and
+        # raw-value sorting would then align DIFFERENT rows of frames
+        # holding the same row set (q67's rank-over-near-tied-sums
+        # shape). Ties in the rounded key are broken by the row's
+        # other columns as usual.
+        sig = max(3, int(round(-np.log10(max(approx_float,
+                                             1e-15)))) - 1)
+
+        def fkey(v):
+            if not isinstance(v, float) or np.isnan(v) or \
+                    not np.isfinite(v):
+                return v
+            return float(f"{v:.{sig}g}")
 
         def row_key(i):
+            # rounded key first (aligns legal last-ulp divergence),
+            # RAW value second (rows whose floats genuinely differ by
+            # more than the tolerance still order consistently in both
+            # frames instead of falling back to frame order)
             return tuple(
                 (v is None, "" if v is None else type(v).__name__,
                  isinstance(v, float) and np.isnan(v),
+                 0 if v is None or (isinstance(v, float) and np.isnan(v))
+                 else fkey(v),
                  0 if v is None or (isinstance(v, float) and np.isnan(v))
                  else v) for v in rows[i])
 
@@ -53,12 +74,58 @@ def _normalize(df: pd.DataFrame, sort: bool) -> pd.DataFrame:
     return norm.reset_index(drop=True)
 
 
+def _check_rank_semantics(df: pd.DataFrame, rank_col: str,
+                          part_cols, float_col: str,
+                          approx_float: float) -> None:
+    """Within each partition, the rank column must order the float
+    column monotonically (DESC, within tolerance) and tie consistently:
+    equal ranks imply equal floats. Used instead of cross-engine rank
+    equality for rank()-over-float-aggregate queries, where two engines
+    may legally round same-set sums to different last ulps and so break
+    ties differently (the reference documents the same float-agg
+    nondeterminism — its variableFloatAgg opt-in exists for this)."""
+    for _, g in df.groupby(part_cols, dropna=False):
+        g = g.sort_values(rank_col)
+        rk = g[rank_col].to_numpy()
+        fv = g[float_col].astype(float).to_numpy()
+        assert (rk >= 1).all(), f"{rank_col}: rank < 1"
+        for i in range(1, len(g)):
+            tol = approx_float * max(abs(fv[i - 1]), abs(fv[i]), 1.0)
+            if rk[i] == rk[i - 1]:
+                assert abs(fv[i] - fv[i - 1]) <= tol, \
+                    f"{rank_col}: tied ranks with different {float_col}"
+            else:
+                assert rk[i] > rk[i - 1]
+                assert fv[i] <= fv[i - 1] + tol, \
+                    f"{rank_col}: rank order violates {float_col} DESC"
+        # bit-identical floats within THIS engine's frame must share a
+        # rank — catches a kernel regressing rank() to row_number()
+        # (ties always split) without needing the cross-engine bits
+        seen = {}
+        for r, v in zip(rk, fv):
+            bits = np.float64(v).tobytes()
+            if bits in seen:
+                assert seen[bits] == r, \
+                    f"{rank_col}: equal {float_col} bits, ranks " \
+                    f"{seen[bits]} != {r} (rank() should tie)"
+            else:
+                seen[bits] = r
+
+
 def assert_frames_equal(cpu: pd.DataFrame, tpu: pd.DataFrame,
-                        sort: bool = True, approx_float: float = 1e-9):
+                        sort: bool = True, approx_float: float = 1e-9,
+                        rank_over: dict = None):
     assert list(cpu.columns) == list(tpu.columns), \
         f"column mismatch: {list(cpu.columns)} vs {list(tpu.columns)}"
-    a = _normalize(cpu, sort)
-    b = _normalize(tpu, sort)
+    if rank_over:
+        for rcol, (pcols, fcol) in rank_over.items():
+            _check_rank_semantics(cpu, rcol, pcols, fcol, approx_float)
+            _check_rank_semantics(tpu, rcol, pcols, fcol, approx_float)
+        drop = list(rank_over)
+        cpu = cpu.drop(columns=drop)
+        tpu = tpu.drop(columns=drop)
+    a = _normalize(cpu, sort, approx_float)
+    b = _normalize(tpu, sort, approx_float)
     assert len(a) == len(b), f"row count: cpu={len(a)} tpu={len(b)}"
     for col in a.columns:
         av, bv = list(a[col]), list(b[col])
@@ -81,7 +148,8 @@ def assert_frames_equal(cpu: pd.DataFrame, tpu: pd.DataFrame,
 
 def assert_cpu_and_tpu_equal(plan, conf: RapidsConf = None,
                              sort: bool = True, approx_float: float = 1e-9,
-                             require_on_tpu: bool = True):
+                             require_on_tpu: bool = True,
+                             rank_over: dict = None):
     """The testSparkResultsAreEqual analogue. ``require_on_tpu`` enables
     the test-mode whole-plan-on-TPU assertion
     (GpuTransitionOverrides.scala:270-326)."""
@@ -92,5 +160,5 @@ def assert_cpu_and_tpu_equal(plan, conf: RapidsConf = None,
     exec_ = apply_overrides(plan, conf)
     tpu_df = collect(exec_)
     assert_frames_equal(cpu_df, tpu_df, sort=sort,
-                        approx_float=approx_float)
+                        approx_float=approx_float, rank_over=rank_over)
     return exec_

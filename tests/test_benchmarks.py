@@ -59,6 +59,18 @@ def test_query_on_tpu_matches_oracle(data_dir, query):
     assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
 
 
+# rank() over FLOAT aggregates: tie-breaks are implementation-defined
+# (engines may round same-set sums to different last ulps — the rollup
+# levels each re-aggregate the same rows). For these queries the rank
+# column is checked SEMANTICALLY per engine (ordering + tie
+# consistency vs its own sums) instead of bit-compared across engines;
+# the reference documents the same float-agg nondeterminism
+# (its variableFloatAgg opt-in exists for exactly this).
+_RANK_OVER_FLOAT = {
+    "tpcds_q67": {"rk": (["i_category"], "sumsales")},
+}
+
+
 @pytest.mark.parametrize("query", _tiered(tpcds.QUERIES, "q3"))
 def test_tpcds_query_on_tpu_matches_oracle(tpcds_dir, query):
     plan = tpcds.QUERIES[query](tpcds_dir)
@@ -71,7 +83,8 @@ def test_tpcds_query_on_tpu_matches_oracle(tpcds_dir, query):
         "rapids.tpu.sql.exec.BroadcastNestedLoopJoinExec": True,
         "rapids.tpu.sql.exec.CartesianProductExec": True,
     })
-    assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6)
+    assert_cpu_and_tpu_equal(plan, conf=conf, approx_float=1e-6,
+                             rank_over=_RANK_OVER_FLOAT.get(query))
 
 
 @pytest.fixture(scope="module")

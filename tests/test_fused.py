@@ -284,11 +284,8 @@ def test_multi_join_distinct_key_shapes_pair_preps_correctly():
 def test_dense_probe_selected_and_matches_hash_path():
     """Single integral build keys probe through the dense inverse table
     (PreparedBuild.table); results must equal both the hash-probe path
-    (span forced above _DENSE_SPAN_MAX via monkeypatch) and fusion-off,
-    including negative keys, out-of-range probes, and null keys on both
-    sides."""
-    import spark_rapids_tpu.execs.fused as fu
-
+    (forced via the denseProbe.maxSpan=0 config knob) and fusion-off,
+    including negative keys, out-of-range probes, and null values."""
     rng = np.random.default_rng(29)
     n = 600
     fact = pd.DataFrame({
