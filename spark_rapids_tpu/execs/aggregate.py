@@ -200,6 +200,35 @@ class HashAggregateExec(TpuExec):
             b = self.input_proj(b)
         return b, mask
 
+    # above this capacity a WIDE (chunked) sort-path aggregate over a
+    # filtered batch first compacts the survivors: the 2^23-capacity
+    # 9-agg chunked groupby shape costs a multi-ten-minute remote XLA
+    # compile (TPCx-BB q26 @ sf 1), while compact + count-sync +
+    # re-bucket turns it into an already-cached small-capacity shape.
+    # Dense-eligible aggregates skip this (no sort module to blow up).
+    _COMPACT_WIDE_MIN_CAP = 1 << 22
+
+    def _maybe_compact_wide(self, b: ColumnarBatch, mask):
+        from spark_rapids_tpu.ops import filter as filt
+        from spark_rapids_tpu.ops import groupby as gb
+
+        if mask is None or b.capacity < self._COMPACT_WIDE_MIN_CAP or \
+                len(self.first_specs) <= gb._AOT_MAX_AGGS or \
+                not self.grouping:
+            return b, mask
+        key_ords = list(range(len(self.grouping)))
+        kr = tuple(gb.key_range_of(b.columns[o], self.input_types[o])
+                   for o in key_ords)
+        khv = tuple(b.columns[o].validity is not None for o in key_ords)
+        if self._dense_ok() and gb._dense_layout(
+                list(self.input_types), key_ords, kr, khv) is not None:
+            return b, mask   # dense path: no sort module to blow up
+        with TraceRange("HashAggregateExec.compactWide"):
+            small = rebucket(filt.compact_batch(b, mask))
+        if small.capacity < b.capacity:
+            return small, None
+        return b, mask
+
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():
             running: Optional[ColumnarBatch] = None
@@ -209,6 +238,7 @@ class HashAggregateExec(TpuExec):
                     continue
                 saw_input = True
                 b, mask = self._update_inputs(b)
+                b, mask = self._maybe_compact_wide(b, mask)
                 with TraceRange("HashAggregateExec.updateAgg"):
                     part = self._agg_batch(b, self.first_specs,
                                            self.input_types, mask)

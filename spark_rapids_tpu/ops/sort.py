@@ -20,10 +20,30 @@ from spark_rapids_tpu.ops import sortkeys
 from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 
 
+# Above this many payload lanes the variadic sort switches to
+# argsort + per-column gathers: XLA's compile time for a sort network
+# carrying many 64-bit (= emulated 32-bit-pair) operands explodes —
+# measured: TPCx-BB q26's ORDER BY at 131k rows with 9 int64 + 8 bool
+# payload lanes sat in XLA for >20 MINUTES, while the gathers it avoids
+# cost ~75-150 ms/column only at multi-million-row widths.
+_CARRY_MAX_LANES = 6
+
+
 @partial(jax.jit, static_argnames=("dtypes", "specs"))
 def _sort_carry(datas, validities, dtypes, specs, num_rows):
-    """One stable variadic sort: [pad_rank, spec keys..., payloads...]."""
+    """One stable variadic sort: [pad_rank, spec keys..., payloads...].
+    Wide payload sets sort an iota lane instead and gather."""
     payloads = list(datas) + [v for v in validities if v is not None]
+    if len(payloads) > _CARRY_MAX_LANES:
+        cap = datas[0].shape[0] if datas else 0
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        (order,) = sortkeys.sort_with_payloads(
+            list(zip(datas, validities)), list(dtypes), list(specs),
+            num_rows, [iota])
+        out_d = [jnp.take(d, order) for d in datas]
+        out_v = [None if v is None else jnp.take(v, order)
+                 for v in validities]
+        return out_d, out_v
     out = sortkeys.sort_with_payloads(
         list(zip(datas, validities)), list(dtypes), list(specs),
         num_rows, payloads)

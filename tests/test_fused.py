@@ -340,3 +340,29 @@ def test_dense_probe_multi_key_stays_hash():
     assert fused
     list(fused[0].execute(0))
     assert fused[0]._preps[0].table is None
+
+
+def test_wide_agg_compacts_before_sort_path(monkeypatch):
+    """A wide (chunk-forcing) aggregate over a fused filter compacts
+    survivors first when the batch is large: the 2^23-capacity chunked
+    groupby shape costs a multi-ten-minute remote compile (q26 @ sf 1).
+    Forced here via a tiny threshold; results must match fusion-off."""
+    from spark_rapids_tpu.execs.aggregate import HashAggregateExec
+
+    monkeypatch.setattr(HashAggregateExec, "_COMPACT_WIDE_MIN_CAP", 256)
+    rng = np.random.default_rng(41)
+    n = 3000
+    fact = pd.DataFrame({
+        # high-cardinality float key: defeats the dense path so the
+        # compaction branch (sort path) is the one under test
+        "k": rng.normal(0, 1000, n).round(3),
+        **{f"v{i}": rng.normal(size=n) for i in range(8)}})
+    sql = ("SELECT k, " +
+           ", ".join(f"sum(v{i}) AS s{i}" for i in range(8)) +
+           " FROM f WHERE v0 > 0 GROUP BY k ORDER BY k LIMIT 50")
+    on, off = _sessions()
+    on.create_temp_view("f", on.create_dataframe(fact))
+    off.create_temp_view("f", off.create_dataframe(fact))
+    got = on.sql(sql).collect()
+    want = off.sql(sql).collect()
+    assert_frames_equal(got, want)
