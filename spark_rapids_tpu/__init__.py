@@ -40,11 +40,12 @@ if not os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
     # a TPU-attached (axon) process carry that platform's XLA target
     # features (+prefer-no-scatter etc.); a plain-CPU process loading
     # such an entry SIGSEGVs inside the AOT loader. Processes forced to
-    # CPU (tests, dryrun) therefore use their own cache.
-    # the SELECTED platform is the first entry of the priority list —
-    # "tpu,cpu" is a TPU process and must NOT write into the CPU cache
-    _first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
-    _suffix = "_cpu" if _first == "cpu" else ""
+    # CPU (tests, dryrun) therefore use their own cache. The rule lives
+    # in ONE place (utils/progcache, which also resolves explicit
+    # compileCacheDir settings) so the two sites can never drift.
+    from spark_rapids_tpu.utils.progcache import _platform_suffix
+
+    _suffix = _platform_suffix()
     _cache_dir = os.environ.get(
         "SPARK_RAPIDS_TPU_COMPILE_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",

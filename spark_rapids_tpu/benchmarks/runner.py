@@ -97,10 +97,12 @@ class BenchmarkRunner:
 
         telemetry = disp.installed()
         df = None
+        pre_stage = None
         for i in range(warmup + iterations):
             plan = plan_fn(self.data_dir)  # fresh plan: no cached blocks
             exec_ = apply_overrides(plan, self.conf)
             pre = disp.snapshot() if telemetry else None
+            pre_stage = disp.stage_snapshot() if telemetry else None
             t0 = time.perf_counter()
             df = collect(exec_)
             elapsed = time.perf_counter() - t0
@@ -112,6 +114,9 @@ class BenchmarkRunner:
         if telemetry and result["iterations"]:
             # the BASELINE.md-promised split: dispatch_count x RTT vs
             # time actually spent computing on the device
+            from spark_rapids_tpu.plan.optimizer import cut_stages
+            from spark_rapids_tpu.utils import progcache
+
             rtt = disp.measure_rtt()
             last = result["iterations"][-1]
             count = last["dispatch"]["dispatch_count"]
@@ -122,6 +127,16 @@ class BenchmarkRunner:
                 "est_dispatch_overhead_s": round(count * rtt, 3),
                 "est_on_device_s": round(
                     max(last["time_sec"] - count * rtt, 0.0), 3),
+                # measured per-stage round trips of the LAST iteration,
+                # next to the plan's static per-stage estimate — the
+                # split that shows WHERE the dispatch budget sits
+                "per_stage": disp.stage_delta(pre_stage),
+                "stages": [
+                    {"stage": s["stage"],
+                     "ops": "+".join(s["ops"]),
+                     "est_dispatches": s["est_dispatches"]}
+                    for s in cut_stages(exec_)],
+                "compile_cache": progcache.stats(),
             }
             # MEASURED on-device time (round-5): one extra serialized
             # pass where every jit call blocks and records its own

@@ -260,6 +260,33 @@ FUSION_ENABLED = conf("rapids.tpu.sql.fusion.enabled").doc(
     "hashes fall back to the general expansion kernel automatically."
 ).boolean_conf.create_with_default(True)
 
+FUSION_SORT_TAIL = conf("rapids.tpu.sql.fusion.sortTail").doc(
+    "Absorb a global ORDER BY into the post-aggregate chain program "
+    "(SortStep): final projection + HAVING + project + variadic sort "
+    "run as ONE dispatch over the aggregate's raw partials, and the "
+    "aggregate skips its own final-project dispatch and rebucket host "
+    "sync. Disable if the fused sort module misbehaves on a backend "
+    "(the unfused SortExec path remains fully supported)."
+).boolean_conf.create_with_default(True)
+
+FUSION_DEFER_DECODE = conf("rapids.tpu.sql.fusion.deferScanDecode").doc(
+    "Hand transfer-packed scan uploads to the consuming fused chain "
+    "UNDECODED; the chain inlines the decode as its first traced steps "
+    "so the scan stage pays zero decode dispatch. Disable to restore "
+    "the standalone per-batch decode program."
+).boolean_conf.create_with_default(True)
+
+COMPILE_CACHE_DIR = conf("rapids.tpu.sql.compileCacheDir").doc(
+    "Directory for the persistent compile cache (utils/progcache): XLA "
+    "executables of jitted programs — including the stable-named fused "
+    "chain programs — persist across processes, so a repeated plan "
+    "over the same schema skips compilation AND the warm-up dispatch "
+    "it would cost. Empty = in-process program sharing only. Behind "
+    "the remote-compile tunnel a cold compile of a big fused kernel "
+    "costs minutes (BASELINE.md), so long-lived deployments should "
+    "always set this."
+).string_conf.create_with_default("")
+
 SCAN_PACK_TRANSFERS = conf("rapids.tpu.scan.packTransfers").doc(
     "Pack scan uploads before they cross the host->device link: string "
     "codes ship at the dictionary's width, integers offset-narrow to "

@@ -35,6 +35,17 @@ class HashAggregateExec(TpuExec):
     - final:    partials -> merged + evaluated results
     """
 
+    #: planner-set (fused.py): yield raw (keys..., partials...) batches
+    #: with a LAZY row count — the downstream fused chain absorbed the
+    #: final projection, the HAVING filter and the compaction, so this
+    #: exec's final-project dispatch and rebucket sync disappear
+    defer_final = False
+    #: deferred-final outputs above this capacity rebucket anyway: the
+    #: consuming chain's in-program sort is a full-capacity variadic
+    #: sort network, so the dispatch saving must not buy a multi-
+    #: million-lane sort (group counts overwhelmingly fit far below)
+    _DEFER_FINAL_MAX_CAP = 1 << 20
+
     def __init__(self, grouping: List[Expression], aggs: List[AggCall],
                  child: TpuExec, schema: Schema, mode: str = "complete",
                  conf=None, fused_filter=None):
@@ -252,15 +263,36 @@ class HashAggregateExec(TpuExec):
                                                   self._merge_types())
             if running is None:
                 if self.grouping or (self.mode == "final" and not saw_input):
-                    # grouped agg over empty input -> no rows
-                    yield ColumnarBatch.empty(self.schema)
+                    # grouped agg over empty input -> no rows (in the
+                    # deferred-final shape the consumer chain expects
+                    # the merge schema, not the final one)
+                    yield ColumnarBatch.empty(
+                        self._merge_schema() if self.defer_final
+                        else self.schema)
                     return
                 running = self._empty_global_partials()
+            if self.defer_final:
+                # the consuming fused chain applies the final
+                # projection, HAVING and compaction in ITS program;
+                # the count stays a lazy device scalar. Above the
+                # capacity bound, rebucket anyway (one sync + shrink):
+                # the chain's variadic SORT runs at this batch's
+                # capacity, and a multi-million-lane sort network to
+                # save two round trips is a net loss at large scale
+                # factors
+                if running.capacity > self._DEFER_FINAL_MAX_CAP:
+                    running = rebucket(running)
+                yield running
+                return
             if self.final_proj is not None:
                 with TraceRange("HashAggregateExec.finalProject"):
                     running = self.final_proj(running)
             yield rebucket(running)
         return timed(self, it())
+
+    def _merge_schema(self) -> Schema:
+        types = self._merge_types()
+        return Schema([f"_m{i}" for i in range(len(types))], types)
 
     def _empty_global_partials(self) -> ColumnarBatch:
         """Default partials for a global aggregate over zero rows: count=0,

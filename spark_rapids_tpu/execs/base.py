@@ -126,17 +126,27 @@ def timed(owner, it: Iterator[ColumnarBatch]
     """Wrap an exec's output iterator with metric recording. ``owner`` is
     the TpuExec (self time = pull time minus children's pipeline time); a
     bare Metrics is accepted for exec-less iterators."""
+    from spark_rapids_tpu.utils import dispatch as _disp
+
     if isinstance(owner, Metrics):
         metrics, children = owner, ()
+        stage = None
     else:
         metrics, children = owner.metrics, owner.children
+        # stage-cutting label (plan/optimizer.cut_stages): dispatches
+        # issued while this exec's iterator advances attribute to its
+        # pipeline stage in the telemetry
+        stage = getattr(owner, "_stage_label", None)
     while True:
         child0 = sum(c.metrics.pipeline_time_ns for c in children)
         t0 = time.perf_counter_ns()
+        tok = _disp.enter_stage(stage)
         try:
             batch = next(it)
         except StopIteration:
             return
+        finally:
+            _disp.exit_stage(tok)
         elapsed = time.perf_counter_ns() - t0
         child_ns = sum(c.metrics.pipeline_time_ns
                        for c in children) - child0
@@ -176,9 +186,12 @@ def collect(exec_: TpuExec, conf=None):
                else cfg.TASK_THREADS.default)
 
     def one(p: int):
-        return [batch.to_pandas(exec_.schema)
-                for batch in exec_.execute(p)
-                if batch.realized_num_rows() > 0]
+        # to_pandas fetches data + (possibly lazy) row count in ONE
+        # device_get; a realized_num_rows() pre-filter here would pay a
+        # separate round trip per batch just to skip empties
+        frames = [batch.to_pandas(exec_.schema)
+                  for batch in exec_.execute(p)]
+        return [f for f in frames if len(f)]
 
     frames = [f for fs in
               run_partitions(exec_.num_partitions, one, threads)

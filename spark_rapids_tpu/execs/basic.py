@@ -27,7 +27,18 @@ class ScanExec(TpuExec):
     the semaphore acquire before first device touch, GpuSemaphore.scala:106).
     Rows per upload slice come from the batch-size config. File sources
     with multiple splits expose them as scan partitions (the reference's
-    FilePartition -> task mapping)."""
+    FilePartition -> task mapping).
+
+    Multi-slice scans run a two-stage async upload pipeline: a worker
+    thread does the pure-host pack (interop.pack_host) for slice k+1
+    while the consumer thread has already ISSUED slice k+1's device_put
+    before downstream programs of slice k are even pulled — so the
+    20-45 MB/s tunnel transfer of the next batch hides behind the
+    current batch's compute instead of serializing after it."""
+
+    #: planner-set (fused.py): hand packed uploads to the consuming
+    #: fused chain undecoded; the chain inlines the decode in-program
+    defer_decode = False
 
     def __init__(self, source: DataSource, schema: Schema,
                  batch_rows: int = 1 << 20, pack: bool = True):
@@ -54,17 +65,18 @@ class ScanExec(TpuExec):
             with semaphore.get():
                 if len(starts) == 1:
                     with TraceRange("ScanExec.upload"):
-                        b = interop.host_to_batch(data, validity,
-                                                  self.schema, 0, n,
-                                                  stats=stats,
-                                                  pack=self.pack)
+                        b = interop.host_to_batch(
+                            data, validity, self.schema, 0, n,
+                            stats=stats, pack=self.pack,
+                            defer_decode=self.defer_decode)
                         b.origin = origin
                         yield b
                     return
-                # multi-slice scans pipeline: a producer thread encodes
-                # and enqueues slice k+1's (packed) host buffers while
-                # slice k's device transfer drains the tunnel — host
-                # encode time hides behind the transfer wall
+                # double-buffered upload pipeline: producer thread packs
+                # (host-only), consumer issues the async device_put the
+                # moment a packed slice arrives and only THEN yields the
+                # previously uploaded slice — slice k+1's transfer is in
+                # flight while the caller computes on slice k
                 import queue as _queue
                 import threading
 
@@ -90,28 +102,36 @@ class ScanExec(TpuExec):
                             if stop.is_set():
                                 return
                             end = min(start + self.batch_rows, n)
-                            with TraceRange("ScanExec.upload"):
-                                b = interop.host_to_batch(
+                            with TraceRange("ScanExec.pack"):
+                                p = interop.pack_host(
                                     data, validity, self.schema, start,
                                     end, stats=stats, pack=self.pack)
-                            b.origin = origin
-                            if not put(("batch", b)):
+                            if not put(("packed", p)):
                                 return
                         put(("done", None))
                     except BaseException as e:  # surface in consumer
                         put(("error", e))
 
                 t = threading.Thread(target=produce, daemon=True,
-                                     name="scan-upload")
+                                     name="scan-pack")
                 t.start()
+                pending = None
                 try:
                     while True:
                         kind, val = q.get()
                         if kind == "done":
+                            if pending is not None:
+                                yield pending
                             return
                         if kind == "error":
                             raise val
-                        yield val
+                        with TraceRange("ScanExec.upload"):
+                            b = interop.upload_packed(
+                                val, defer_decode=self.defer_decode)
+                        b.origin = origin
+                        if pending is not None:
+                            yield pending
+                        pending = b
                 finally:
                     stop.set()
                     while True:  # unblock a mid-put producer

@@ -303,14 +303,26 @@ class BroadcastExchangeExec(TpuExec):
         if self._cached is None:
             batches = []
             for p in range(self.children[0].num_partitions):
-                batches.extend(b for b in self.children[0].execute(p)
-                               if b.realized_num_rows() > 0)
-            if batches:
+                batches.extend(self.children[0].execute(p))
+            if len(batches) > 1:
+                # one batched realize for ALL counts (was one host sync
+                # per child batch), then drop empties before the concat
+                ColumnarBatch.realize_counts(batches)
+                batches = [b for b in batches
+                           if b.realized_num_rows() > 0]
+            if len(batches) == 1:
+                # single batch: no concat, and the count can stay a
+                # lazy device scalar — build prep consumes it as a
+                # traced operand, so the whole broadcast+prep path
+                # runs without a host sync of its own
+                merged = batches[0]
+            elif batches:
                 merged = concat_batches(batches)
             else:
                 merged = ColumnarBatch.empty(self.schema)
             self._cached = SpillableBatch(
-                merged, priorities.INPUT_FROM_SHUFFLE_PRIORITY)
+                merged, priorities.INPUT_FROM_SHUFFLE_PRIORITY,
+                defer_count=True)
         return self._cached
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:

@@ -244,6 +244,25 @@ def make_project_step(exprs: Sequence[Expression]) -> ProjectStep:
 
 
 @dataclasses.dataclass
+class SortStep:
+    """Terminal ORDER BY inside a chain program: one variadic
+    ``lax.sort`` carries every column through the sort network, dead
+    lanes (filtered rows, padding) sink to the end, and the live count
+    comes out as a lazy device scalar — so a post-aggregate
+    HAVING/project/sort tail runs as ONE compiled program instead of
+    compaction + rebucket + a separate sort dispatch. Only the planner
+    may append one, and only over a source that emits exactly one batch
+    on one partition (a hash aggregate): a per-batch sort of a
+    multi-batch stream would NOT be a global sort."""
+
+    specs: tuple  # Tuple[SortKeySpec, ...] (frozen, hashable)
+
+    def key(self):
+        return ("S", tuple((s.ordinal, s.ascending, s.nulls_first)
+                           for s in self.specs))
+
+
+@dataclasses.dataclass
 class JoinStep:
     kind: str                  # inner | left | left_semi | left_anti
     stream_keys: List[int]     # ordinals into the working columns
@@ -314,15 +333,19 @@ def _hash_keys(key_cols: Sequence[ColV], types: Sequence[dt.DType],
 
 
 @partial(jax.jit, static_argnames=("key_ords", "types", "hash_types",
-                                   "key_range"))
+                                   "key_range", "dense_span"))
 def _prep_build(datas, vals, num_rows, key_ords, types, hash_types,
-                key_range=False):
+                key_range=False, dense_span=0, dense_lo=0):
     """Sort the build by key hash; null-key and padding rows park at the
     +inf sentinel (they can never match). Returns the duplicate flag the
     host checks once per query, plus (when ``key_range``) the single
     key's valid-row (min, max) in its comparison type — fetched in the
     same sync as the dup flag so the host can build the dense probe
-    table without another round trip."""
+    table without another round trip. When the key's range is already
+    HOST-known (footer/upload stats survived the build subtree),
+    ``dense_span``/``dense_lo`` fold the dense inverse-table build into
+    THIS program — no flag round trip feeds it and the separate
+    _prep_dense_table dispatch disappears."""
     cols = [ColV(t, d, v) for t, d, v in zip(types, datas, vals)]
     h = _hash_keys([cols[o] for o in key_ords],
                    [types[o] for o in key_ords], hash_types, _BUILD_NULL)
@@ -348,15 +371,19 @@ def _prep_build(datas, vals, num_rows, key_ords, types, hash_types,
     else:
         kmin = jnp.int64(0)
         kmax = jnp.int64(-1)
-    return sh, sdatas, svals, dup, n_valid, kmin, kmax
+    if dense_span > 0:
+        table = _dense_table_arrays(sdatas[key_ords[0]], n_valid,
+                                    dense_lo, dense_span)
+    else:
+        table = jnp.zeros(0, dtype=jnp.int32)
+    return sh, sdatas, svals, dup, n_valid, kmin, kmax, table
 
 
-@partial(jax.jit, static_argnames=("span",))
-def _prep_dense_table(keys_sorted, n_valid, lo, span):
-    """Dense inverse index over the hash-sorted build: valid (live,
-    non-null-key) rows occupy the sorted prefix [0, n_valid), so
-    scatter their key positions once; absent values stay -1. One small
-    scatter per query per build — prep-time only."""
+def _dense_table_arrays(keys_sorted, n_valid, lo, span):
+    """Traceable core of the dense inverse index over the hash-sorted
+    build: valid (live, non-null-key) rows occupy the sorted prefix
+    [0, n_valid), so scatter their key positions once; absent values
+    stay -1."""
     cap = keys_sorted.shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
     pos = (keys_sorted.astype(jnp.int64) - lo).astype(jnp.int32)
@@ -365,6 +392,14 @@ def _prep_dense_table(keys_sorted, n_valid, lo, span):
     table = jnp.full(span + 1, -1, dtype=jnp.int32)
     table = table.at[pos].set(iota)
     return table[:span]
+
+
+@partial(jax.jit, static_argnames=("span",))
+def _prep_dense_table(keys_sorted, n_valid, lo, span):
+    """Dense inverse index as its own program — the runtime-range path,
+    used when the key bounds only became host-known via the flag sync.
+    One small scatter per query per build — prep-time only."""
+    return _dense_table_arrays(keys_sorted, n_valid, lo, span)
 
 
 def _ghost_of(col: Column) -> "_Ghost":
@@ -402,18 +437,29 @@ def _finalize_entries_locked(entries) -> None:
     except BaseException as exc:
         for e in todo:
             e["error"] = exc
+            # drop the poisoned entry like the launch-failure path: a
+            # transient tunnel error during the flag sync must not
+            # permanently fail every later consumer of this exchange
+            cache, key = e["slot"]
+            if cache.get(key) is e:
+                cache.pop(key, None)
             e["done"].set()
         raise
     for e, (dup_h, kmin_h, kmax_h) in zip(todo, flags):
-        (sh, sdatas, svals, _d, n_valid, _kn, _kx), ghosts, \
-            want_range, build_keys, span_max = e.pop("pending")
+        (sh, sdatas, svals, _d, n_valid, _kn, _kx, table), ghosts, \
+            want_range, build_keys, span_max, dense_span, dense_lo = \
+            e.pop("pending")
         if bool(dup_h):
             prep = PreparedBuild(ok=False)
         else:
             prep = PreparedBuild(
                 ok=True, h_sorted=sh, datas=tuple(sdatas),
                 vals=tuple(svals), n_valid=n_valid, ghosts=ghosts)
-            if want_range and int(kmin_h) <= int(kmax_h):
+            if dense_span > 0:
+                # stats-known range: the table came out of _prep_build
+                prep.table = table
+                prep.dense_lo = dense_lo
+            elif want_range and int(kmin_h) <= int(kmax_h):
                 from spark_rapids_tpu.ops.groupby import quantize_range
 
                 qlo, qhi = quantize_range(int(kmin_h), int(kmax_h))
@@ -460,7 +506,8 @@ def prepare_builds(specs) -> List[PreparedBuild]:
             if entry is None:
                 entry = cache[key] = {"done": threading.Event(),
                                       "prep": None, "error": None,
-                                      "pending": None}
+                                      "pending": None,
+                                      "slot": (cache, key)}
                 owner = True
             else:
                 owner = False
@@ -474,17 +521,39 @@ def prepare_builds(specs) -> List[PreparedBuild]:
                 hash_types[0].is_integral or
                 hash_types[0] in (dt.DATE, dt.TIMESTAMP, dt.BOOLEAN))
             with exch._materialize().acquired() as b:
+                # when footer/upload stats survived the build subtree
+                # the key range is host-known NOW: fold the dense table
+                # into the prep program and skip the runtime-range
+                # machinery (stats are bounds, possibly loose — the
+                # table just covers a wider span)
+                dense_span = 0
+                dense_lo = 0
+                if want_range and b.columns:
+                    st = getattr(b.columns[build_keys[0]], "stats",
+                                 None)
+                    if st is not None:
+                        from spark_rapids_tpu.ops.groupby import \
+                            quantize_range
+
+                        qlo, qhi = quantize_range(int(st[0]),
+                                                  int(st[1]))
+                        if qhi - qlo + 1 <= span_max:
+                            dense_span = qhi - qlo + 1
+                            dense_lo = qlo
                 with TraceRange("FusedChain.prepareBuild"):
                     out = _prep_build(
                         [c.data for c in b.columns],
                         [c.validity for c in b.columns],
                         b.num_rows_device(), tuple(build_keys),
                         tuple(build_types), tuple(hash_types),
-                        key_range=want_range)
+                        key_range=want_range and not dense_span,
+                        dense_span=dense_span,
+                        dense_lo=np.int64(dense_lo))
                 ghosts = [_ghost_of(c) for c in b.columns]
             with _PREP_LOCK:
                 entry["pending"] = (out, ghosts, want_range,
-                                    tuple(build_keys), span_max)
+                                    tuple(build_keys), span_max,
+                                    dense_span, dense_lo)
         except BaseException as e:
             entry["error"] = e
             with _PREP_LOCK:
@@ -569,33 +638,34 @@ class FusedChain:
         self._number_aux_slots()
         self._programs = {}
 
-    def chain_key(self, compact_out: bool, modes: tuple = ()):
+    def chain_key(self, compact_out: bool, modes: tuple = (),
+                  decode: tuple = ()):
         ks = tuple(s.key() for s in self.steps)
         if any(k is None for k in ks):
             return None
         return ("fused_chain", ks, tuple(self.source_types), compact_out,
-                modes)
+                modes, decode)
 
-    def _program(self, compact_out: bool, modes: tuple = ()):
-        prog = self._programs.get((compact_out, modes))
+    def _program(self, compact_out: bool, modes: tuple = (),
+                 decode: tuple = ()):
+        prog = self._programs.get((compact_out, modes, decode))
         if prog is not None:
             return prog
-        key = self.chain_key(compact_out, modes)
+        key = self.chain_key(compact_out, modes, decode)
         prog = _fused_cache_get(key)
         if prog is None:
-            prog = self._build_program(compact_out)
+            prog = self._build_program(compact_out, modes, decode)
             _fused_cache_put(key, prog)
-        self._programs[(compact_out, modes)] = prog
+        self._programs[(compact_out, modes, decode)] = prog
         return prog
 
-    def _build_program(self, compact_out: bool):
+    def _build_program(self, compact_out: bool, modes: tuple = (),
+                       decode: tuple = ()):
         steps = self.steps
+        sort_step = steps[-1] if steps and \
+            isinstance(steps[-1], SortStep) else None
 
-        def run(datas, vals, num_rows, builds, aux, types):
-            capacity = datas[0].shape[0] if datas else 128
-            cols = [ColV(t, d, v)
-                    for t, d, v in zip(types, datas, vals)]
-            live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        def run_steps(cols, live, num_rows, builds, aux, capacity):
             for step in steps:
                 if isinstance(step, FilterStep):
                     ctx = EvalContext(cols, capacity, num_rows,
@@ -612,9 +682,33 @@ class FusedChain:
                     ctx.aux = aux
                     cols = [broadcast(e.eval(ctx), ctx)
                             for e in step.exprs]
+                elif isinstance(step, SortStep):
+                    continue  # terminal; handled below
                 else:
                     cols, live = _apply_join(step, cols, live,
                                              builds[step.build_index])
+            if sort_step is not None:
+                # ONE variadic sort carries every column; dead lanes
+                # (padding + filtered rows) sink last via the live mask
+                pairs = [(c.data, c.validity) for c in cols]
+                dts = [c.dtype for c in cols]
+                payloads = []
+                layout = []
+                for c in cols:
+                    di = len(payloads)
+                    payloads.append(c.data)
+                    vi = -1
+                    if c.validity is not None:
+                        vi = len(payloads)
+                        payloads.append(c.validity)
+                    layout.append((di, vi))
+                sorted_pl = sortkeys.sort_with_payloads(
+                    pairs, dts, list(sort_step.specs), num_rows,
+                    payloads, live_mask=live)
+                outs = [(sorted_pl[di],
+                         None if vi < 0 else sorted_pl[vi])
+                        for di, vi in layout]
+                return outs, jnp.sum(live).astype(jnp.int32)
             outs = [(c.data, c.validity) for c in cols]
             if not compact_out:
                 return outs, live
@@ -625,27 +719,60 @@ class FusedChain:
                     for d, v in outs]
             return outs, n
 
+        if decode:
+            # scan-decode prelude: the chain starts from the PACKED
+            # upload buffers and inlines the transfer decode, so the
+            # scan->filter->join->project stage pays zero decode
+            # dispatch (see interop.PackedBatch)
+            from spark_rapids_tpu.execs import interop as _interop
+
+            dec_specs, col_map, cap = decode
+
+            def run(bufs, bases, num_rows, builds, aux, types):
+                decoded = _interop.unpack_arrays(list(bufs), bases,
+                                                 dec_specs, cap)
+                cols = [ColV(t, decoded[bi],
+                             None if vi < 0 else decoded[vi])
+                        for t, (_k, bi, vi) in zip(types, col_map)]
+                live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                return run_steps(cols, live, num_rows, builds, aux,
+                                 cap)
+        else:
+            def run(datas, vals, num_rows, builds, aux, types):
+                capacity = datas[0].shape[0] if datas else 128
+                cols = [ColV(t, d, v)
+                        for t, d, v in zip(types, datas, vals)]
+                live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+                return run_steps(cols, live, num_rows, builds, aux,
+                                 capacity)
+
         # distinct per-chain names so dispatch telemetry attributes each
         # chain program separately (every chain would otherwise report
         # as one 'run' bucket). The crc tag separates chains that share
         # a step-type shape but compile different expressions (q9's five
-        # filter+project branches)
+        # filter+project branches); it keys on the SAME (compact_out,
+        # modes) tuple as the program cache so dense-probe and
+        # hash-probe variants of one chain attribute separately
         import zlib
 
-        key = self.chain_key(compact_out)
+        key = self.chain_key(compact_out, modes, decode)
         tag = zlib.crc32(repr(key if key is not None
                               else id(self)).encode()) & 0xFFFF
-        label = "fused_chain[" + "+".join(
-            type(s).__name__.replace("Step", "").lower()
-            for s in steps) + f"]@{tag:04x}"
+        label = "fused_chain[" + ("decode+" if decode else "") + \
+            "+".join(type(s).__name__.replace("Step", "").lower()
+                     for s in steps) + f"]@{tag:04x}"
         run.__name__ = run.__qualname__ = label
         return partial(jax.jit, static_argnames=("types",))(run)
 
-    def run(self, batch: ColumnarBatch, preps: List[PreparedBuild],
+    def run(self, batch, preps: List[PreparedBuild],
             compact_out: bool):
         """-> (outs, live_mask | new_count, final output ghosts). The
         ghost walk runs ONCE per batch, serving both the aux operand
-        collection and the caller's output wrapping."""
+        collection and the caller's output wrapping. ``batch`` may be a
+        still-packed upload (interop.PackedBatch): the program then
+        inlines the transfer decode as its first traced steps."""
+        from spark_rapids_tpu.execs import interop as _interop
+
         states, final_ghosts = self._ghost_states(batch, preps)
         build_ops = tuple(
             (p.h_sorted, p.datas, p.vals, p.n_valid, p.table,
@@ -655,23 +782,34 @@ class FusedChain:
         # stats), so it keys the compiled program separately
         modes = tuple(p.table is not None for p in preps)
         aux = self._aux_from_states(states)
-        outs, live = self._program(compact_out, modes)(
-            [c.data for c in batch.columns],
-            [c.validity for c in batch.columns],
-            batch.num_rows_device(), build_ops, aux,
-            types=tuple(self.source_types))
+        if isinstance(batch, _interop.PackedBatch):
+            decode = batch.decode_key()
+            outs, live = self._program(compact_out, modes, decode)(
+                tuple(batch.bufs), tuple(batch.dec_bases),
+                batch.num_rows_device(), build_ops, aux,
+                types=tuple(self.source_types))
+        else:
+            outs, live = self._program(compact_out, modes)(
+                [c.data for c in batch.columns],
+                [c.validity for c in batch.columns],
+                batch.num_rows_device(), build_ops, aux,
+                types=tuple(self.source_types))
         return outs, live, final_ghosts
 
     # -- host mirror --------------------------------------------------------
 
-    def _ghost_states(self, batch: ColumnarBatch,
-                      preps: List[PreparedBuild]):
+    def _ghost_states(self, batch, preps: List[PreparedBuild]):
         """Per-step INPUT ghost lists, plus the final output ghosts."""
-        ghosts = [_ghost_of(c) for c in batch.columns]
+        from spark_rapids_tpu.execs import interop as _interop
+
+        if isinstance(batch, _interop.PackedBatch):
+            ghosts = [_Ghost(t, d, s) for t, d, s in batch.ghost_info()]
+        else:
+            ghosts = [_ghost_of(c) for c in batch.columns]
         states = []
         for step in self.steps:
             states.append(ghosts)
-            if isinstance(step, FilterStep):
+            if isinstance(step, (FilterStep, SortStep)):
                 continue
             if isinstance(step, ProjectStep):
                 ghosts = [self._project_ghost(e, ghosts)
@@ -801,10 +939,15 @@ def _build_key_specs(steps) -> list:
 
 
 class FusedChainExec(TpuExec):
-    """Standalone fused segment: filters/projections/broadcast probes in
-    one program per batch, compacted once at the end (lazy row count).
-    Falls back to the preserved unfused subtree when a build side has
-    duplicate key hashes."""
+    """Standalone fused segment: filters/projections/broadcast probes
+    (and, for post-aggregate tails, the final ORDER BY) in one program
+    per batch, compacted once at the end (lazy row count). Falls back
+    to the preserved unfused subtree when a build side has duplicate
+    key hashes."""
+
+    #: planner-set: the packed scan feeding this chain (its decode runs
+    #: inside the chain program); reset to eager decode on fallback
+    _defer_scan = None
 
     def __init__(self, source: TpuExec, chain: FusedChain,
                  builds: List[BroadcastExchangeExec], schema: Schema,
@@ -847,6 +990,10 @@ class FusedChainExec(TpuExec):
                      for exch, (keys, types, commons) in zip(
                          self.builds, self.build_key_specs)])
                 ok = all(p.ok for p in preps)
+                if not ok and self._defer_scan is not None:
+                    # the fallback subtree re-executes the scan and is
+                    # not fusion-aware: restore eager decode first
+                    self._defer_scan.defer_decode = False
                 self._preps = preps if ok else None
                 self._preps_ok = ok
             return self._preps_ok
@@ -857,6 +1004,8 @@ class FusedChainExec(TpuExec):
 
         def it():
             saw = False
+            has_sort = any(isinstance(s, SortStep)
+                           for s in self.chain.steps)
             for b in self.children[0].execute(partition):
                 # skip empties only when the count is ALREADY host-side:
                 # forcing a lazy count here would cost the same round
@@ -864,6 +1013,14 @@ class FusedChainExec(TpuExec):
                 n = b.num_rows
                 if isinstance(n, int) and n == 0 and saw:
                     continue
+                if saw and has_sort:
+                    # not an assert: must survive python -O — a second
+                    # batch through a SortStep chain would silently
+                    # produce per-batch (non-global) order
+                    raise RuntimeError(
+                        "SortStep chain fed more than one batch "
+                        "(planner bug: source must be a single-batch "
+                        "aggregate)")
                 saw = True
                 with TraceRange("FusedChainExec"):
                     outs, n, ghosts = self.chain.run(b, self._preps,
@@ -911,6 +1068,8 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
     plus a live-mask that rides into the groupby sort — the reference's
     per-batch update pipeline (aggregate.scala:420-478) as two compiled
     programs instead of a dispatch per operator."""
+
+    _defer_scan = None  # see FusedChainExec
 
     def __init__(self, grouping, aggs, schema, mode, conf,
                  source: TpuExec, steps: List,
@@ -1009,19 +1168,27 @@ def _fusable_join(node) -> bool:
 
 def _extract(node: TpuExec):
     """Walk down a maximal fusable chain; returns (steps bottom-up,
-    source, build exchanges) or None."""
+    source, build exchanges, walked exec nodes) or None. ``walked`` is
+    every intermediate exec the chain absorbed — a stage-widening
+    rewrite that MUTATES the source (defer_final) must verify none of
+    them is shared, because a second parent of a shared intermediate
+    reaches the source through it and still expects the unmutated
+    output contract."""
     steps: List = []
     builds: List[BroadcastExchangeExec] = []
+    walked: List[TpuExec] = []
     cur = node
     while True:
         if isinstance(cur, basic.FilterExec) and \
                 chain_traceable(cur.filter.condition):
             steps.append(make_filter_step(cur.filter.condition))
+            walked.append(cur)
             cur = cur.children[0]
         elif isinstance(cur, basic.ProjectExec) and \
                 all(chain_traceable(e)
                     for e in cur.projection.exprs):
             steps.append(make_project_step(cur.projection.exprs))
+            walked.append(cur)
             cur = cur.children[0]
         elif _fusable_join(cur):
             if cur.condition is not None:
@@ -1035,13 +1202,14 @@ def _extract(node: TpuExec):
                 cur.kind, list(cur.left_keys), list(cur.right_keys),
                 len(builds), build_types, commons))
             builds.append(_broadcast_of(cur))
+            walked.append(cur)
             cur = cur.children[0]
         else:
             break
     if not steps:
         return None
     steps.reverse()
-    return steps, cur, builds
+    return steps, cur, builds, walked
 
 
 def _is_mesh(node: TpuExec) -> bool:
@@ -1064,16 +1232,118 @@ def _counts(steps) -> Tuple[int, int, int]:
 
 def fuse_pipelines(root: TpuExec, conf=None) -> TpuExec:
     """Post-conversion pass (before coalesce insertion): absorb fusable
-    chains into FusedAggregateExec / FusedChainExec. Memoized by node
-    identity so shared (CTE) subtrees stay shared."""
+    chains into FusedAggregateExec / FusedChainExec, widen post-
+    aggregate tails (final projection + HAVING + project + ORDER BY)
+    into one chain program, and hand packed scan uploads straight to
+    the chain that decodes them in-program. Memoized by node identity
+    so shared (CTE) subtrees stay shared; stage-widening rewrites that
+    MUTATE a source (defer_final, defer_decode) only apply to sources
+    with a single parent."""
     from spark_rapids_tpu import config as cfg
 
     if conf is not None and not conf.get(cfg.FUSION_ENABLED):
         return root
-    return _fuse_node(root, conf, {})
+    return _fuse_node(root, conf, {}, _multi_parent_ids(root))
 
 
-def _fuse_node(node: TpuExec, conf, memo: dict) -> TpuExec:
+def _multi_parent_ids(root: TpuExec) -> set:
+    """ids of exec nodes referenced by MORE than one parent (shared CTE
+    subtrees): stage-widening must not change their output contract."""
+    counts: dict = {}
+    seen: set = set()
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for c in n.children:
+            counts[id(c)] = counts.get(id(c), 0) + 1
+            stack.append(c)
+    return {i for i, c in counts.items() if c > 1}
+
+
+def _absorb_final(steps, fused_src):
+    """Pull an aggregate source's final projection into the consuming
+    chain: the aggregate then emits raw (keys..., partials...) with a
+    lazy count (defer_final) and the chain's program applies final-
+    project + HAVING + compaction — removing the aggregate's own
+    final-projection dispatch AND its rebucket host sync. Returns
+    (steps, source_types) with source_types None when not absorbed.
+    Only chains WITHOUT join steps qualify: a join chain can fall back
+    to its preserved subtree, which must then see the aggregate's
+    normal (finalized) output."""
+    if any(isinstance(s, JoinStep) for s in steps):
+        return steps, None
+    if not isinstance(fused_src, agg_exec.HashAggregateExec):
+        return steps, None
+    if fused_src.mode not in ("complete", "final") or \
+            fused_src.final_proj is None or fused_src.defer_final:
+        return steps, None
+    exprs = fused_src.final_proj.exprs
+    if not all(chain_traceable(e) for e in exprs):
+        return steps, None
+    new_steps = [make_project_step(exprs)] + list(steps)
+    src_types = [e.dtype for e in fused_src.grouping] + \
+        list(fused_src.partial_types)
+    fused_src.defer_final = True
+    fb = getattr(fused_src, "fallback", None)
+    if isinstance(fb, agg_exec.HashAggregateExec):
+        # the prep-failure fallback aggregate feeds the SAME chain, so
+        # it must emit the same deferred shape
+        fb.defer_final = True
+    return new_steps, src_types
+
+
+def _maybe_defer_scan(out, new_source, shared, conf) -> None:
+    """Hand a packed scan's upload buffers straight to the fused chain:
+    the chain's program inlines the transfer decode (zero decode
+    dispatch). Single-parent scans only — any other consumer would see
+    PackedBatches it cannot read."""
+    from spark_rapids_tpu import config as cfg
+
+    if conf is not None and not conf.get(cfg.FUSION_DEFER_DECODE):
+        return
+    if isinstance(new_source, basic.ScanExec) and new_source.pack and \
+            id(new_source) not in shared:
+        new_source.defer_decode = True
+        out._defer_scan = new_source
+
+
+def _fuse_sort_tail(node, conf, memo: dict, shared: set):
+    """Absorb a global ORDER BY into the post-aggregate chain below it:
+    Sort(Project(Filter(Agg))) becomes ONE chain program (final-project
+    + HAVING + project + in-program variadic sort) over the aggregate's
+    raw partials. Valid only when the source emits exactly one batch on
+    one partition — a hash aggregate — because a per-batch sort of a
+    multi-batch stream is not a global sort."""
+    ch = _extract(node.children[0])
+    steps, source, builds, walked = ch if ch \
+        else ([], node.children[0], [], [])
+    if _is_mesh(source) or id(source) in shared:
+        return None
+    new_source = _fuse_node(source, conf, memo, shared)
+    if not (isinstance(new_source, agg_exec.HashAggregateExec) and
+            new_source.mode in ("complete", "final") and
+            new_source.num_partitions == 1):
+        return None
+    src_types = None
+    if not any(id(w) in shared for w in walked):
+        # defer_final mutates the aggregate; a shared intermediate
+        # (CTE-reused Project/Filter) would expose the mutated output
+        # to a second consumer that expects finalized columns
+        steps, src_types = _absorb_final(steps, new_source)
+    steps = list(steps) + [SortStep(tuple(node.specs))]
+    for bx in builds:
+        bx.children = [_fuse_node(bx.children[0], conf, memo, shared)]
+    chain = FusedChain(steps,
+                       src_types or list(new_source.schema.types),
+                       len(builds))
+    return FusedChainExec(new_source, chain, builds, node.schema,
+                          fallback=node, conf=conf)
+
+
+def _fuse_node(node: TpuExec, conf, memo: dict, shared: set) -> TpuExec:
     hit = memo.get(id(node))
     if hit is not None:
         return hit[1]
@@ -1081,37 +1351,58 @@ def _fuse_node(node: TpuExec, conf, memo: dict) -> TpuExec:
     if type(node) is agg_exec.HashAggregateExec and \
             node.mode in ("partial", "complete"):
         ch = _extract(node.children[0])
-        steps, source, builds = ch if ch else ([], node.children[0], [])
+        steps, source, builds = ch[:3] if ch \
+            else ([], node.children[0], [])
         # an empty chain still pays off when the agg carries a fused
         # filter: mask+project collapse into one program
         if _is_mesh(source):
             steps = None
         if steps or (steps is not None and node.fused_filter is not None):
-            new_source = _fuse_node(source, conf, memo)
+            new_source = _fuse_node(source, conf, memo, shared)
             for bx in builds:
-                bx.children = [_fuse_node(bx.children[0], conf, memo)]
+                bx.children = [_fuse_node(bx.children[0], conf, memo,
+                                          shared)]
             out = FusedAggregateExec(
                 node.grouping, node.aggs, node.schema, node.mode,
                 node.conf, new_source, steps, builds, fallback=node)
+            _maybe_defer_scan(out, new_source, shared, conf)
+    if out is None:
+        from spark_rapids_tpu.execs.sort import SortExec
+
+        from spark_rapids_tpu import config as cfg
+
+        sort_tail_on = conf is None or conf.get(cfg.FUSION_SORT_TAIL)
+        if sort_tail_on and type(node) is SortExec and \
+                node.global_sort and node.specs:
+            out = _fuse_sort_tail(node, conf, memo, shared)
     if out is None:
         ch = _extract(node)
         if ch is not None and not _is_mesh(ch[1]):
-            steps, source, builds = ch
+            steps, source, builds, walked = ch
             nf, np_, nj = _counts(steps)
             # savings estimate: each filter ~2 dispatches, project 1,
             # join ~6; the chain costs 1. Skip a lone projection.
             if 2 * nf + np_ + 6 * nj - 1 >= 1:
-                new_source = _fuse_node(source, conf, memo)
+                new_source = _fuse_node(source, conf, memo, shared)
+                src_types = None
+                if id(source) not in shared and not any(
+                        id(w) in shared for w in walked):
+                    # see _fuse_sort_tail: defer_final must not leak
+                    # through a shared intermediate node
+                    steps, src_types = _absorb_final(steps, new_source)
                 for bx in builds:
                     bx.children = [_fuse_node(bx.children[0], conf,
-                                              memo)]
-                chain = FusedChain(steps, list(new_source.schema.types),
-                                   len(builds))
+                                              memo, shared)]
+                chain = FusedChain(
+                    steps, src_types or list(new_source.schema.types),
+                    len(builds))
                 out = FusedChainExec(new_source, chain, builds,
                                      node.schema, fallback=node,
                                      conf=conf)
+                _maybe_defer_scan(out, new_source, shared, conf)
     if out is None:
-        node.children = [_fuse_node(c, conf, memo) for c in node.children]
+        node.children = [_fuse_node(c, conf, memo, shared)
+                         for c in node.children]
         out = node
     memo[id(node)] = (node, out)
     return out

@@ -79,6 +79,14 @@ def _pack_fdict(arr: np.ndarray, v) -> Optional[tuple]:
     return enc, vals
 
 
+def unpack_arrays(bufs, bases, spec, cap):
+    """TRACEABLE decode core shared by the standalone unpack program and
+    fused chain programs that inline the decode as their first steps
+    (the scan->filter->... stage then starts from the packed buffers
+    and pays zero decode dispatch)."""
+    return _unpack_program(bufs, bases, spec=spec, cap=cap)
+
+
 def _unpack_program(bufs, bases, *, spec, cap):
     """One jitted device decode for a whole packed batch: widen + offset
     (ints — exact: integer ops are true 32-bit-pair arithmetic), f64
@@ -140,24 +148,114 @@ def _get_unpack_jit():
     return _UNPACK_JIT
 
 
-def host_to_batch(data: Dict[str, np.ndarray],
-                  validity: Dict[str, Optional[np.ndarray]],
-                  schema: Schema, start: int = 0,
-                  end: Optional[int] = None,
-                  stats: Optional[Dict[str, tuple]] = None,
-                  pack: bool = True) -> ColumnarBatch:
-    """Upload a row range of host columns (the device-upload half of the
-    reference's scan path, GpuParquetScan.scala host buffer -> readParquet).
-    ``stats``: footer-derived {col: (min, max)} — when provided the
-    upload-time host min/max pass is skipped entirely (the footer already
-    paid for those numbers during pruning). ``pack``: transfer packing
-    (see module comment above); packed buffers decode on device in one
-    jitted program per batch."""
-    import jax
+class PackedHost:
+    """Host-side result of ``pack_host``: everything needed to upload
+    and decode one batch, with NO device interaction yet. Produced on
+    scan worker threads so the (pure-CPU) encode overlaps the previous
+    batch's tunnel transfer and device compute."""
 
-    # build every column's host buffer first, then upload the whole
-    # batch in ONE device_put (per-column jnp.asarray each occupies a
-    # tunnel round trip; one batched transfer pipelines them)
+    __slots__ = ("host_bufs", "dec_specs", "dec_bases", "col_specs",
+                 "cap", "n")
+
+    def __init__(self, host_bufs, dec_specs, dec_bases, col_specs,
+                 cap, n):
+        self.host_bufs = host_bufs
+        self.dec_specs = dec_specs
+        self.dec_bases = dec_bases
+        self.col_specs = col_specs
+        self.cap = cap
+        self.n = n
+
+
+class PackedBatch:
+    """Device-resident but still PACKED scan batch: the upload happened
+    (one device_put) and the decode is deferred into the consumer's own
+    compiled program — a fused chain inlines ``unpack_arrays`` as its
+    first traced steps, so scan-decode + filter + join + project run as
+    ONE dispatch. Only fusion-aware consumers understand this type;
+    everything else must call ``decode()`` (one unpack dispatch, the
+    exact program the eager path would have run)."""
+
+    __slots__ = ("bufs", "dec_specs", "dec_bases", "col_specs",
+                 "capacity", "num_rows", "origin")
+
+    def __init__(self, bufs, dec_specs, dec_bases, col_specs, cap, n):
+        self.bufs = list(bufs)
+        self.dec_specs = tuple(dec_specs)
+        self.dec_bases = tuple(dec_bases)
+        self.col_specs = list(col_specs)
+        self.capacity = cap
+        self.num_rows = n
+        self.origin = None
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.col_specs)
+
+    def realized_num_rows(self) -> int:
+        return self.num_rows
+
+    def num_rows_device(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.num_rows, dtype=jnp.int32)
+
+    def decode_key(self):
+        """Static program-cache key component: which buffer decodes how
+        and which decoded slots form each output column."""
+        return (self.dec_specs,
+                tuple((kind, bi, -1 if vi is None else vi)
+                      for kind, bi, vi, _t, _d, _s in self.col_specs),
+                self.capacity)
+
+    def ghost_info(self):
+        """Per output column (dtype, dictionary, stats) — the host
+        mirror a fused chain's ghost walk starts from."""
+        return [(typ, dictionary, col_stats)
+                for _k, _bi, _vi, typ, dictionary, col_stats
+                in self.col_specs]
+
+    def column_arrays(self, decoded):
+        """Map decoded flat buffers to per-column (data, validity)
+        pairs, in col_specs order."""
+        out = []
+        for _kind, bi, vi, _typ, _d, _s in self.col_specs:
+            out.append((decoded[bi],
+                        None if vi is None else decoded[vi]))
+        return out
+
+    def decode(self) -> ColumnarBatch:
+        """Standalone decode (one dispatch) — the safety valve for any
+        consumer that is not fusion-aware."""
+        decoded = list(_get_unpack_jit()(
+            tuple(self.bufs), tuple(self.dec_bases),
+            spec=self.dec_specs, cap=self.capacity))
+        b = _wrap_uploaded(decoded, self.col_specs, self.num_rows)
+        b.origin = self.origin
+        return b
+
+
+def _wrap_uploaded(uploaded, col_specs, n) -> ColumnarBatch:
+    cols = []
+    for kind, bi, vi, typ, dictionary, col_stats in col_specs:
+        valid = None if vi is None else uploaded[vi]
+        if kind == "str":
+            cols.append(StringColumn(uploaded[bi], dictionary, valid))
+        else:
+            cols.append(Column(typ, uploaded[bi], valid,
+                               stats=col_stats))
+    return ColumnarBatch(cols, n)
+
+
+def pack_host(data: Dict[str, np.ndarray],
+              validity: Dict[str, Optional[np.ndarray]],
+              schema: Schema, start: int = 0,
+              end: Optional[int] = None,
+              stats: Optional[Dict[str, tuple]] = None,
+              pack: bool = True) -> PackedHost:
+    """Host half of the upload: slice, encode and (when it pays) pack
+    every column into flat transfer buffers. Pure CPU work — safe on a
+    worker thread, touches no device state."""
     from spark_rapids_tpu.io.hoststrings import HostStrings
     from spark_rapids_tpu.ops.buckets import bucket_capacity
 
@@ -210,7 +308,11 @@ def host_to_batch(data: Dict[str, np.ndarray],
                 # host_codes derives nulls from the None values too —
                 # its mask, not the caller's, is authoritative here
                 v_eff = vm32[:n] if vm32 is not None else None
-            width = _narrow_uint(len(dictionary)) if do_pack else None
+            # max code is len(dictionary)-1 (same convention as
+            # _pack_fdict), so exactly-256/65536-entry dictionaries
+            # still pack as u8/u16
+            width = _narrow_uint(max(len(dictionary) - 1, 0)) \
+                if do_pack else None
             if width is not None and width().itemsize < 4:
                 codes = np.zeros(cap, dtype=width)
                 codes[:n] = codes_n.astype(width)
@@ -283,20 +385,47 @@ def host_to_batch(data: Dict[str, np.ndarray],
                 bi = push(buf, "raw", kname)
             specs.append(("num", bi, vi, typ, None, col_stats))
 
-    uploaded = jax.device_put(host_bufs)
-    if any(s[0] != "raw" for s in dec_specs):
+    return PackedHost(host_bufs, tuple(dec_specs), tuple(dec_bases),
+                      specs, cap or 0, n or 0)
+
+
+def upload_packed(packed: PackedHost, defer_decode: bool = False):
+    """Device half of the upload: ONE device_put for the whole batch's
+    buffers (per-column jnp.asarray would each occupy a tunnel round
+    trip; one batched transfer pipelines them), then the jitted decode
+    — or, with ``defer_decode``, a PackedBatch that hands the decode to
+    a fusion-aware consumer's own program (zero decode dispatch)."""
+    import jax
+
+    uploaded = jax.device_put(packed.host_bufs)
+    if any(s[0] != "raw" for s in packed.dec_specs):
+        if defer_decode:
+            return PackedBatch(uploaded, packed.dec_specs,
+                               packed.dec_bases, packed.col_specs,
+                               packed.cap, packed.n)
         uploaded = list(_get_unpack_jit()(
-            tuple(uploaded), tuple(dec_bases),
-            spec=tuple(dec_specs), cap=cap or 0))
-    cols = []
-    for kind, bi, vi, typ, dictionary, col_stats in specs:
-        valid = None if vi is None else uploaded[vi]
-        if kind == "str":
-            cols.append(StringColumn(uploaded[bi], dictionary, valid))
-        else:
-            cols.append(Column(typ, uploaded[bi], valid,
-                               stats=col_stats))
-    return ColumnarBatch(cols, n or 0)
+            tuple(uploaded), tuple(packed.dec_bases),
+            spec=packed.dec_specs, cap=packed.cap))
+    return _wrap_uploaded(uploaded, packed.col_specs, packed.n)
+
+
+def host_to_batch(data: Dict[str, np.ndarray],
+                  validity: Dict[str, Optional[np.ndarray]],
+                  schema: Schema, start: int = 0,
+                  end: Optional[int] = None,
+                  stats: Optional[Dict[str, tuple]] = None,
+                  pack: bool = True, defer_decode: bool = False):
+    """Upload a row range of host columns (the device-upload half of the
+    reference's scan path, GpuParquetScan.scala host buffer ->
+    readParquet). ``stats``: footer-derived {col: (min, max)} — when
+    provided the upload-time host min/max pass is skipped entirely (the
+    footer already paid for those numbers during pruning). ``pack``:
+    transfer packing (see module comment above); packed buffers decode
+    on device in one jitted program per batch — or inside the consuming
+    fused chain's program when ``defer_decode``."""
+    return upload_packed(
+        pack_host(data, validity, schema, start, end, stats, pack),
+        defer_decode=defer_decode)
 
 
 def frame_to_batch(frame) -> ColumnarBatch:

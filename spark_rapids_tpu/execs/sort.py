@@ -70,36 +70,63 @@ class SortExec(TpuExec):
             budget = self._budget_rows()
             # stage AS batches arrive: everything drained so far can
             # spill while later child batches still compute — the input
-            # is never pinned whole in HBM
+            # is never pinned whole in HBM. Counts stay LAZY while
+            # staging (defer_count): when the whole input provably fits
+            # the in-core budget by CAPACITY (capacity >= rows), the
+            # single-batch fast path sorts without any host sync at
+            # all, and the multi-batch path realizes every count in the
+            # one batched get concat already pays — the per-batch
+            # realize here used to cost one ~105 ms round trip each
+            caps = 0
             staged: List[SpillableBatch] = []
-            total = 0
             for b in self.children[0].execute(partition):
-                n = b.realized_num_rows()
-                if n == 0:
-                    continue
-                total += n
+                caps += b.capacity
                 staged.append(SpillableBatch(
-                    b, priorities.INPUT_FROM_SHUFFLE_PRIORITY))
+                    b, priorities.INPUT_FROM_SHUFFLE_PRIORITY,
+                    defer_count=True))
             if not staged:
                 yield ColumnarBatch.empty(self.schema)
                 return
-            if total <= budget:
+            def sort_in_core(handles):
                 from contextlib import ExitStack
 
                 from spark_rapids_tpu.ops.concat import concat_batches
 
                 with ExitStack() as stack:
                     parts = [stack.enter_context(sb.acquired())
-                             for sb in staged]
+                             for sb in handles]
                     with TraceRange("SortExec.global"):
                         merged = parts[0] if len(parts) == 1 else \
                             with_oom_retry(lambda: concat_batches(parts))
                         out = with_oom_retry(
                             lambda: sort_batch(merged, self.specs,
                                                types))
-                for sb in staged:
+                for sb in handles:
                     sb.close()
-                yield out
+                return out
+
+            if caps <= budget:
+                yield sort_in_core(staged)
+                return
+            # above the capacity bound: realize every count in ONE
+            # batched transfer, drop empties, and re-check the real
+            # total (capacity over-estimates rows)
+            SpillableBatch.realize_counts(staged)
+            total = 0
+            live: List[SpillableBatch] = []
+            for sb in staged:
+                n = sb.num_rows
+                if n == 0:
+                    sb.close()
+                    continue
+                total += n
+                live.append(sb)
+            staged = live
+            if not staged:
+                yield ColumnarBatch.empty(self.schema)
+                return
+            if total <= budget:
+                yield sort_in_core(staged)
                 return
             yield from self._out_of_core(staged, total, budget, types)
 

@@ -36,12 +36,21 @@ from spark_rapids_tpu.expressions.base import (
 #: re-traces (and re-loads) every kernel. Keyed by Expression.tree_key.
 _FUSED_CACHE: dict = {}
 _FUSED_CACHE_MAX = 1024
+#: hit/miss telemetry surfaced by utils/progcache.stats(): a miss is a
+#: fresh trace (and, cold, an XLA compile); a None key can never cache
+_FUSED_CACHE_STATS = {"hits": 0, "misses": 0, "unkeyed": 0}
 
 
 def _fused_cache_get(key):
     if key is None:
+        _FUSED_CACHE_STATS["unkeyed"] += 1
         return None
-    return _FUSED_CACHE.get(key)
+    fn = _FUSED_CACHE.get(key)
+    if fn is not None:
+        _FUSED_CACHE_STATS["hits"] += 1
+    else:
+        _FUSED_CACHE_STATS["misses"] += 1
+    return fn
 
 
 def _fused_cache_put(key, fn):

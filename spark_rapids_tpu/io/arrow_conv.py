@@ -54,6 +54,25 @@ def column_to_host(col, typ: dt.DType) -> Tuple[np.ndarray, np.ndarray]:
             col = col.combine_chunks()
         if not pa.types.is_dictionary(col.type):
             col = pc.dictionary_encode(col)
+        if col.dictionary.null_count > 0:
+            # a null INSIDE the dictionary is legal arrow (never
+            # produced by parquet dictionary pages); is_valid/null_count
+            # above only see index-level nulls, so rows referencing the
+            # null slot would otherwise surface as the literal string
+            # 'None'. Fold them into the validity mask and repoint
+            # their codes at slot 0.
+            dict_valid = pc.is_valid(col.dictionary).to_numpy(
+                zero_copy_only=False).astype(bool)
+            idx0 = pc.fill_null(col.indices, 0).to_numpy(
+                zero_copy_only=False).astype(np.int64, copy=False)
+            row_hits_null = ~dict_valid[idx0]
+            if valid is None:
+                valid = np.ones(len(col), dtype=bool)
+            valid = valid & ~row_hits_null
+            col = pa.DictionaryArray.from_arrays(
+                pa.array(np.where(row_hits_null, 0, idx0),
+                         type=col.indices.type),
+                pc.fill_null(col.dictionary, ""))
         idx = col.indices if valid is None else pc.fill_null(col.indices, 0)
         codes = idx.to_numpy(zero_copy_only=False).astype(
             np.int32, copy=False)

@@ -17,17 +17,52 @@ class SpillableBatch:
     """Context-manager-friendly handle over a catalog-registered batch."""
 
     def __init__(self, batch: ColumnarBatch, priority: int,
-                 catalog: Optional[BufferCatalog] = None):
+                 catalog: Optional[BufferCatalog] = None,
+                 defer_count: bool = False):
         # explicit None-check: BufferCatalog defines __len__, so an EMPTY
         # catalog is falsy and `catalog or get_catalog()` would silently
         # route buffers to the global catalog
         self._catalog = catalog if catalog is not None else get_catalog()
-        # realize the row count before the batch can spill: host metadata
-        # must survive tier changes (the reference stores it in TableMeta)
-        self.num_rows = batch.realized_num_rows()
+        # row count: realized up front by default (host metadata must
+        # survive tier changes — the reference stores it in TableMeta).
+        # ``defer_count`` keeps only the 0-d device scalar instead: no
+        # host sync on the register path; consumers that truly need the
+        # int pay it via the property (and a device->host spill realizes
+        # it anyway inside its own sync, serde.batch_to_host)
+        if defer_count:
+            nr = batch.num_rows
+            self._rows: Optional[int] = nr if isinstance(nr, int) \
+                else None
+            self._rows_dev = None if isinstance(nr, int) else nr
+        else:
+            self._rows = batch.realized_num_rows()
+            self._rows_dev = None
         self._size = batch.device_memory_size()
         self._id = self._catalog.register(batch, priority)
         self._closed = False
+
+    @property
+    def num_rows(self) -> int:
+        if self._rows is None:
+            import jax
+
+            self._rows = int(jax.device_get(self._rows_dev))
+            self._rows_dev = None
+        return self._rows
+
+    @staticmethod
+    def realize_counts(handles: "list[SpillableBatch]") -> None:
+        """Realize MANY deferred counts in ONE device_get (each lazy
+        ``num_rows`` access would otherwise pay a full round trip)."""
+        import jax
+
+        lazy = [sb for sb in handles if sb._rows is None]
+        if not lazy:
+            return
+        vals = jax.device_get([sb._rows_dev for sb in lazy])
+        for sb, v in zip(lazy, vals):
+            sb._rows = int(v)
+            sb._rows_dev = None
 
     @property
     def buffer_id(self) -> int:

@@ -626,7 +626,8 @@ def plan_cost(exec_) -> int:
         return _cost(exec_)
 
 
-def _cost(e) -> int:
+def _own_cost(e) -> int:
+    """Estimated dispatch count of ONE exec (excluding children)."""
     from spark_rapids_tpu.execs import basic, joins
     from spark_rapids_tpu.execs.adaptive import AdaptiveShuffleReaderExec
     from spark_rapids_tpu.execs.aggregate import HashAggregateExec
@@ -667,4 +668,69 @@ def _cost(e) -> int:
         own = 1 * parts
     else:
         own = 2 * parts  # unknown execs are not free
-    return own + sum(_cost(c) for c in e.children)
+    return own
+
+
+def _cost(e) -> int:
+    return _own_cost(e) + sum(_cost(c) for c in e.children)
+
+
+# ---------------------------------------------------------------------------
+# Stage cutting (round-6): partition the PHYSICAL tree into pipeline
+# stages — maximal regions whose per-batch dispatches the fusion pass
+# coalesces toward one program — and label every exec with its stage so
+# dispatch telemetry attributes round trips per stage. Stage breakers
+# are the materialization points: exchanges (a broadcast/shuffle build
+# runs to completion before its consumer), aggregates (the merge loop
+# drains its input), and sorts (a global sort stages everything).
+# ---------------------------------------------------------------------------
+
+
+def _is_stage_breaker(e) -> bool:
+    from spark_rapids_tpu.execs.aggregate import HashAggregateExec
+    from spark_rapids_tpu.execs.exchange import (BroadcastExchangeExec,
+                                                 ShuffleExchangeExec)
+    from spark_rapids_tpu.execs.sort import SortExec
+
+    return isinstance(e, (HashAggregateExec, ShuffleExchangeExec,
+                          BroadcastExchangeExec, SortExec))
+
+
+def cut_stages(root) -> List[dict]:
+    """Assign ``_stage_label`` to every exec and return the stage list:
+    [{stage, ops, est_dispatches}] in discovery (top-down) order. A
+    stage starts at the root, below every breaker, and at every
+    broadcast build subtree (reached via ``.builds`` on fused execs —
+    those exchanges are not ``children``). ``est_dispatches`` is the
+    static per-stage dispatch estimate from the plan-cost model, so
+    bench output can show where a query's round-trip budget sits
+    BEFORE running it."""
+    from spark_rapids_tpu.execs import adaptive as adaptive_exec
+
+    stages: List[dict] = []
+    seen: set = set()
+
+    def new_stage() -> dict:
+        s = {"stage": f"stage{len(stages)}", "ops": [],
+             "est_dispatches": 0}
+        stages.append(s)
+        return s
+
+    def walk(node, stage) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        if stage is None:
+            stage = new_stage()
+        node._stage_label = stage["stage"]
+        stage["ops"].append(node.name)
+        stage["est_dispatches"] += _own_cost(node)
+        breaker = _is_stage_breaker(node)
+        for c in node.children:
+            walk(c, None if breaker else stage)
+        for bx in getattr(node, "builds", ()) or ():
+            walk(bx, None)
+
+    with adaptive_exec.planning_mode():
+        walk(root, None)
+    return stages

@@ -21,6 +21,7 @@ compute module; the benchmark runner does this when
 from __future__ import annotations
 
 import functools
+import threading
 import time
 
 _installed = False
@@ -28,6 +29,40 @@ _jit_calls = 0
 _eager_calls = 0
 _transfers = 0
 _compiled_fns: list = []
+
+# -- per-stage attribution --------------------------------------------------
+# The stage-cutting pass (plan/optimizer.cut_stages) labels every exec
+# with its pipeline stage; base.timed() brackets each batch pull with
+# enter_stage/exit_stage so every dispatch lands in the innermost
+# active stage's bucket. Thread-local: concurrent task threads each
+# carry their own stage.
+_tls = threading.local()
+_stage_counts: dict = {}
+_stage_lock = threading.Lock()
+
+
+def enter_stage(label):
+    """Set the current thread's stage; returns a token for exit_stage.
+    Near-zero cost when telemetry is not installed or label is None."""
+    if not _installed or label is None:
+        return None
+    prev = getattr(_tls, "stage", None)
+    _tls.stage = label
+    return (prev,)
+
+
+def exit_stage(token) -> None:
+    if token is not None:
+        _tls.stage = token[0]
+
+
+def _bump_stage(kind: str) -> None:
+    label = getattr(_tls, "stage", None) or "<unstaged>"
+    with _stage_lock:
+        d = _stage_counts.get(label)
+        if d is None:
+            d = _stage_counts[label] = {"jit": 0, "eager": 0, "get": 0}
+        d[kind] += 1
 
 # -- measured device timing (serialized mode) -------------------------------
 # When enabled, every counted jit call BLOCKS until its result is ready
@@ -68,6 +103,7 @@ def install() -> None:
             def __call__(self, *a, **k):
                 global _jit_calls
                 _jit_calls += 1
+                _bump_stage("jit")
                 if not _device_timing:
                     return compiled(*a, **k)
                 t0 = time.perf_counter()
@@ -98,6 +134,7 @@ def install() -> None:
         def counting_apply(prim, *a, **k):
             global _eager_calls
             _eager_calls += 1
+            _bump_stage("eager")
             return real_apply(prim, *a, **k)
 
         jdispatch.apply_primitive = counting_apply
@@ -109,6 +146,7 @@ def install() -> None:
     def counting_get(x):
         global _transfers
         _transfers += 1
+        _bump_stage("get")
         return real_get(x)
 
     jax.device_get = counting_get
@@ -129,6 +167,25 @@ def delta(before: dict) -> dict:
     d = {k: now[k] - before[k] for k in now}
     d["dispatch_count"] = sum(d.values())
     return d
+
+
+def stage_snapshot() -> dict:
+    """Per-stage {label: {jit, eager, get}} counts so far."""
+    with _stage_lock:
+        return {k: dict(v) for k, v in _stage_counts.items()}
+
+
+def stage_delta(before: dict) -> dict:
+    """Per-stage dispatch totals accumulated since ``before`` (a
+    stage_snapshot), empty buckets dropped."""
+    now = stage_snapshot()
+    out = {}
+    for label, counts in now.items():
+        prev = before.get(label, {})
+        n = sum(counts[k] - prev.get(k, 0) for k in counts)
+        if n:
+            out[label] = n
+    return out
 
 
 def executable_count() -> int:
