@@ -4,32 +4,106 @@ The driver bench (bench.py) absorbs the ~30-minute cold XLA compile of
 the 4M-row fused pipeline by seeding .jax_cache from a tracked
 executable (scripts/bench_cache/). Any edit to ops/groupby.py or the
 entry pipeline changes the cache key and silently invalidates the seed —
-the next driver bench then times out (r2's rc 124). This check makes the
-staleness loud IN-ROUND: it traces the exact bench program against the
-attached TPU backend, then asks jax's compile path for it with the
-actual backend compile FORBIDDEN. A persistent-cache hit proves the
-tracked entry still matches; a miss means "refresh the seed":
+the next driver bench then times out (r2's rc 124). This check makes
+the staleness loud IN-ROUND, and (round-6) WITHOUT needing the TPU box:
+
+**Key check (default, device-free).** The tracked
+``scripts/bench_cache/PROGRAM_KEY.json`` records a fingerprint of the
+bench program's *jaxpr* — the backend-independent trace whose change is
+what invalidates the platform cache key (the XLA key hashes the lowered
+module; a changed trace changes the module on every platform). CI under
+``JAX_PLATFORMS=cpu`` re-traces and compares: a mismatch means "refresh
+the seed". Conservative by construction: a fingerprint match with a
+stale seed is impossible for program edits (the only false alarms are
+trace-identical refactors of jax internals, which a --device run
+settles). The fingerprint also records the jax version, since the same
+program can print a different jaxpr across versions — a version
+mismatch is reported as SKIP, not STALE.
+
+**Device check (--device).** The original proof: trace against the
+attached TPU backend and ask jax's compile path for the executable with
+actual compilation FORBIDDEN — a persistent-cache hit proves the
+tracked entry matches. Requires the axon-attached build box.
+
+Refreshing the seed (on the TPU box):
 
     rm -rf .jax_cache && python bench.py   # one cold compile (~30 min)
     cp .jax_cache/jit_step-*-cache scripts/bench_cache/  # + git add
-
-Requires the TPU backend (the cache key includes the target platform),
-so it runs on the axon-attached build box, not in CPU-only CI.
+    python scripts/check_bench_cache.py --update-key     # + git add
 """
 from __future__ import annotations
 
+import argparse
+import hashlib
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+KEY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_cache", "PROGRAM_KEY.json")
+
 
 class _WouldCompile(Exception):
     pass
 
 
-def main() -> int:
+def program_fingerprint() -> dict:
+    """Backend-independent fingerprint of the bench entry program: a
+    hash of its jaxpr (abstract trace — no device, no compile)."""
+    import jax
+
+    from __graft_entry__ import entry
+
+    step, args = entry()
+    jaxpr = jax.make_jaxpr(step)(*args)
+    digest = hashlib.sha256(str(jaxpr).encode()).hexdigest()
+    return {"jaxpr_sha256": digest, "jax_version": jax.__version__,
+            "x64": bool(jax.config.jax_enable_x64)}
+
+
+def check_key() -> int:
+    if not os.path.exists(KEY_PATH):
+        print(f"SKIP: {os.path.relpath(KEY_PATH)} not tracked yet — "
+              "run with --update-key after refreshing the seed "
+              "(or --device on the TPU box)")
+        return 0
+    with open(KEY_PATH) as f:
+        tracked = json.load(f)
+    now = program_fingerprint()
+    if tracked.get("jax_version") != now["jax_version"] or \
+            tracked.get("x64") != now["x64"]:
+        print(f"SKIP: environment changed (tracked jax "
+              f"{tracked.get('jax_version')}/x64={tracked.get('x64')}, "
+              f"running {now['jax_version']}/x64={now['x64']}) — jaxpr "
+              "text is only comparable within one version; re-run "
+              "--update-key from the seed-refresh environment")
+        return 0
+    if tracked.get("jaxpr_sha256") != now["jaxpr_sha256"]:
+        print("STALE: the bench kernel's program changed since "
+              "scripts/bench_cache/ was seeded — the next driver bench "
+              "will eat a ~30-min cold compile. Refresh the seed (see "
+              "module docstring).")
+        return 1
+    print("OK: bench kernel matches the tracked program key "
+          f"({now['jaxpr_sha256'][:12]}...)")
+    return 0
+
+
+def update_key() -> int:
+    fp = program_fingerprint()
+    os.makedirs(os.path.dirname(KEY_PATH), exist_ok=True)
+    with open(KEY_PATH, "w") as f:
+        json.dump(fp, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {os.path.relpath(KEY_PATH)}: "
+          f"{fp['jaxpr_sha256'][:12]}... (jax {fp['jax_version']})")
+    return 0
+
+
+def check_device() -> int:
     import bench
 
     bench.seed_compile_cache()
@@ -38,7 +112,8 @@ def main() -> int:
 
     if jax.devices()[0].platform == "cpu":
         print("SKIP: no TPU backend attached (cache keys are "
-              "platform-specific; run this on the TPU box)")
+              "platform-specific; run --device on the TPU box, or use "
+              "the default key check)")
         return 0
 
     from __graft_entry__ import entry
@@ -65,6 +140,24 @@ def main() -> int:
         compiler.backend_compile_and_load = orig
     print("OK: scripts/bench_cache/ matches the current bench kernel")
     return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--device", action="store_true",
+                   help="prove the tracked executable loads via the "
+                        "persistent cache against the attached TPU "
+                        "(the original, device-requiring check)")
+    p.add_argument("--update-key", action="store_true",
+                   help="record the current program fingerprint as the "
+                        "tracked PROGRAM_KEY.json (run when refreshing "
+                        "the seed)")
+    args = p.parse_args()
+    if args.update_key:
+        return update_key()
+    if args.device:
+        return check_device()
+    return check_key()
 
 
 if __name__ == "__main__":

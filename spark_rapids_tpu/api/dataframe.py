@@ -323,6 +323,17 @@ class DataFrame:
 
         return collect(self._exec(), conf=self.session.conf)
 
+    def collect_async(self, tenant: str = "default", priority: int = 0,
+                      deadline=None):
+        """Submit through the session's QueryService (service/):
+        returns a QueryHandle immediately; ``handle.result()`` blocks.
+        Many collect_async() calls run concurrently under admission
+        control + fair stage scheduling instead of serializing."""
+        return self.session.service.submit(
+            self, tenant=tenant, priority=priority, deadline=deadline)
+
+    collectAsync = collect_async
+
     def last_metrics(self) -> dict:
         """Per-operator metrics of the most recent collect() — the SQL-UI
         SQLMetrics view (GpuExec.scala:90-96): rows/batches/self-time."""

@@ -40,11 +40,46 @@ class Session:
         else:
             self.runtime = None
         self._catalog: Dict = {}
+        self._service = None
+        import threading
+
+        self._service_init_lock = threading.Lock()
+
+    @property
+    def service(self):
+        """Lazily-started concurrent query service (service/) — the
+        multi-tenant front door. ``df.collect_async()`` and
+        ``sql_async()`` submit through it."""
+        with self._service_init_lock:
+            if self._service is None:
+                if getattr(self, "_service_stopped", False):
+                    # stop() tore the service (and runtime) down —
+                    # lazily resurrecting a fresh worker pool against
+                    # it would "succeed" into a dead engine and leak
+                    # threads
+                    raise RuntimeError(
+                        "Session is stopped; create a new Session")
+                from spark_rapids_tpu.service import QueryService
+
+                self._service = QueryService(self.conf, session=self)
+            return self._service
+
+    def sql_async(self, query: str, tenant: str = "default",
+                  priority: int = 0, deadline=None):
+        """Parse + plan + submit to the query service; returns a
+        QueryHandle (poll/result/cancel) instead of blocking."""
+        return self.service.submit(self.sql(query), tenant=tenant,
+                                   priority=priority, deadline=deadline)
 
     def stop(self) -> None:
         """Release the process-global runtime this Session initialized
-        (SparkSession.stop analogue). No-op for sessions that did not
-        initialize it."""
+        (SparkSession.stop analogue) and shut down the query service.
+        No-op for sessions that did not initialize them."""
+        with self._service_init_lock:
+            self._service_stopped = True
+            service, self._service = self._service, None
+        if service is not None:
+            service.shutdown()
         if self.runtime is None:
             return
         from spark_rapids_tpu import runtime

@@ -1,0 +1,111 @@
+"""Concurrent-service benchmark: replay N TPC-H instances through the
+QueryService and report queue-time vs run-time (runner-JSON shaped).
+
+The single-query runner measures how fast ONE query goes; this measures
+how the SERVICE multiplexes many — the numbers that matter for the
+ROADMAP's serve-heavy-traffic goal: per-query queue time vs run time,
+shed counts under a bounded queue, and the cross-query compile-cache
+hit rate (instance 2..N of the same shape should be ~all hits).
+
+    python -m spark_rapids_tpu.benchmarks.service_bench \
+        --queries 8 --mix tpch_q1,tpch_q6 --tenants 2 --sf 0.01 \
+        --data-dir /tmp/rapids_tpu_tpch --output service.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import List, Optional
+
+from spark_rapids_tpu.config import RapidsConf
+
+
+def run_service_bench(data_dir: str, sf: float, queries: int = 8,
+                      mix: Optional[List[str]] = None, tenants: int = 2,
+                      conf: Optional[RapidsConf] = None) -> dict:
+    """Submit ``queries`` instances round-robin over ``mix`` plans and
+    ``tenants`` submitter keys; returns the runner-style JSON record
+    with per-query queue/run splits and the ServiceStats snapshot."""
+    from spark_rapids_tpu.benchmarks.runner import (ALL_BENCHMARKS,
+                                                    BenchmarkRunner)
+    from spark_rapids_tpu.service import QueryService, ServiceOverloaded
+
+    mix = mix or ["tpch_q1", "tpch_q6"]
+    conf = conf or RapidsConf()
+    runner = BenchmarkRunner(data_dir, sf, conf=conf)
+    for name in dict.fromkeys(mix):  # every family in the mix
+        runner.ensure_data(name)
+
+    service = QueryService(conf)
+    t0 = time.perf_counter()
+    handles = []
+    shed = 0
+    for i in range(queries):
+        name = mix[i % len(mix)]
+        plan = ALL_BENCHMARKS[name](data_dir)  # fresh plan per instance
+        try:
+            h = service.submit(plan, tenant=f"tenant{i % tenants}")
+            handles.append((name, h))
+        except ServiceOverloaded:  # expected under tiny queue limits
+            shed += 1
+    per_query = []
+    for name, h in handles:
+        df = h.result(timeout=600)
+        info = h.info()
+        per_query.append({
+            "benchmark": name,
+            "tenant": info["tenant"],
+            "rows_returned": len(df),
+            "queue_time_s": round(info["queue_time_s"] or 0.0, 4),
+            "run_time_s": round(info["run_time_s"] or 0.0, 4),
+            "slices": info["slices_done"],
+        })
+    wall = time.perf_counter() - t0
+    stats = service.stats()
+    service.shutdown()
+    qt = [q["queue_time_s"] for q in per_query]
+    rt = [q["run_time_s"] for q in per_query]
+    return {
+        "benchmark": "service_bench",
+        "scale_factor": sf,
+        "env": BenchmarkRunner._env(),
+        "concurrent_queries": queries,
+        "mix": mix,
+        "tenants": tenants,
+        "wall_time_sec": round(wall, 3),
+        "queue_time_sec": {"max": max(qt, default=0.0),
+                           "mean": round(sum(qt) / len(qt), 4)
+                           if qt else 0.0},
+        "run_time_sec": {"max": max(rt, default=0.0),
+                         "mean": round(sum(rt) / len(rt), 4)
+                         if rt else 0.0},
+        "per_query": per_query,
+        "shed_at_submit": shed,
+        "service_stats": stats.to_dict(),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--queries", type=int, default=8)
+    p.add_argument("--mix", default="tpch_q1,tpch_q6",
+                   help="comma-separated benchmark names to cycle")
+    p.add_argument("--tenants", type=int, default=2)
+    p.add_argument("--sf", type=float, default=0.01)
+    p.add_argument("--data-dir", default="/tmp/rapids_tpu_tpch")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+    result = run_service_bench(args.data_dir, args.sf,
+                               queries=args.queries,
+                               mix=args.mix.split(","),
+                               tenants=args.tenants)
+    text = json.dumps(result, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -486,6 +486,68 @@ ORC_DEBUG_DUMP_PREFIX = conf(
     "RapidsConf.scala:583-589)."
 ).string_conf.create_with_default("")
 
+CSV_TIMESTAMPS_ENABLED = conf(
+    "rapids.tpu.sql.csv.read.timestamps.enabled").doc(
+    "Enable reading TIMESTAMP columns from CSV. Off by default: CSV "
+    "timestamp text admits many format/timezone spellings and only the "
+    "formats listed in rapids.tpu.sql.csv.timestampFormats parse "
+    "identically to Spark CPU (the reference gates cuDF's CSV "
+    "timestamp parsing the same way, RapidsConf.scala:482)."
+).boolean_conf.create_with_default(False)
+
+CSV_TIMESTAMP_FORMATS = conf(
+    "rapids.tpu.sql.csv.timestampFormats").doc(
+    "Comma-separated strptime patterns tried in order for CSV "
+    "TIMESTAMP columns when csv.read.timestamps.enabled is true. Text "
+    "matching none of them fails the scan (FAILFAST semantics)."
+).string_conf.create_with_default(
+    "%Y-%m-%dT%H:%M:%S,%Y-%m-%d %H:%M:%S,%Y-%m-%d")
+
+# -- concurrent query service (service/ subsystem) --------------------------
+
+SERVICE_QUEUE_LIMIT = conf("rapids.tpu.service.queueLimit").doc(
+    "Maximum queries waiting for admission (across all tenants). "
+    "Submissions beyond it are shed with a structured ServiceOverloaded "
+    "rejection instead of queueing unboundedly — load shedding is the "
+    "service's backpressure signal to callers."
+).int_conf.create_with_default(64)
+
+SERVICE_MAX_CONCURRENT = conf("rapids.tpu.service.maxConcurrent").doc(
+    "Queries admitted concurrently (each admitted query gets stage "
+    "slices interleaved on the dispatch path by the stage scheduler). "
+    "Within the admitted set, device entry is still bounded by "
+    "rapids.tpu.sql.concurrentTpuTasks semaphore permits."
+).int_conf.create_with_default(4)
+
+SERVICE_DEFAULT_DEADLINE = conf("rapids.tpu.service.defaultDeadlineSec").doc(
+    "Default per-query deadline in seconds (queue time + run time). "
+    "0 disables; submit(deadline=...) overrides per query. Expired "
+    "queries fail with DeadlineExceeded and release their admission, "
+    "semaphore permit and catalog buffers."
+).double_conf.create_with_default(0.0)
+
+SERVICE_FAIRNESS_WEIGHTS = conf("rapids.tpu.service.fairness.weights").doc(
+    "Weighted-round-robin admission weights per tenant as "
+    "'tenantA:2,tenantB:1'. Unlisted tenants weigh 1. A tenant's weight "
+    "is how many queries it may admit per WRR cycle while other tenants "
+    "have queued work — a flood from one tenant cannot starve another."
+).string_conf.create_with_default("")
+
+SERVICE_ADMISSION_BUDGET = conf("rapids.tpu.service.admission.hbmBudget").doc(
+    "Device-memory budget admission controls against, in bytes. 0 (the "
+    "default) uses the runtime's HBM budget (allocFraction * HBM - "
+    "reserve) when a device reports memory, else admission is bounded "
+    "only by maxConcurrent. A query whose estimated peak footprint "
+    "does not fit next to the in-flight queries WAITS in the queue."
+).bytes_conf.create_with_default(0)
+
+SERVICE_DEFAULT_ROW_ESTIMATE = conf(
+    "rapids.tpu.service.admission.defaultRowEstimate").doc(
+    "Row-count assumption for plan nodes whose cardinality the "
+    "optimizer cannot estimate (no footer stats); feeds the admission "
+    "footprint estimate."
+).int_conf.create_with_default(1 << 20)
+
 FILTER_PUSHDOWN_ENABLED = conf(
     "rapids.tpu.sql.format.pushDownFilters.enabled").doc(
     "Push comparison conjuncts from a Filter above a file scan into the "

@@ -167,9 +167,34 @@ def run_partitions(n_partitions: int, fn, task_threads: int = 4):
         return [fn(p) for p in range(n_partitions)]
     from concurrent.futures import ThreadPoolExecutor
 
+    from spark_rapids_tpu.memory.catalog import (current_buffer_owner,
+                                                 set_buffer_owner)
+    from spark_rapids_tpu.utils import dispatch as _disp
+
+    # propagate the caller's buffer-owner tag and dispatch query tag
+    # (both thread-local) onto the pool threads: a query-service slice
+    # that fans out here must have every batch the tasks register and
+    # every dispatch they issue attributed to its query, or
+    # cancel/deadline cleanup, stalled-query spill demotion, and
+    # ServiceStats per-query dispatch counts would miss pool work
+    owner = current_buffer_owner()
+    qid = _disp.current_query()
+    run = fn
+    if owner is not None or qid is not None:
+        def run(p, _fn=fn, _owner=owner, _qid=qid):
+            prev = set_buffer_owner(_owner) if _owner is not None \
+                else None
+            qtok = _disp.enter_query(_qid)
+            try:
+                return _fn(p)
+            finally:
+                _disp.exit_query(qtok)
+                if _owner is not None:
+                    set_buffer_owner(prev)
+
     with ThreadPoolExecutor(max_workers=min(task_threads, n_partitions),
                             thread_name_prefix="tpu-task") as pool:
-        return list(pool.map(fn, range(n_partitions)))
+        return list(pool.map(run, range(n_partitions)))
 
 
 def collect(exec_: TpuExec, conf=None):

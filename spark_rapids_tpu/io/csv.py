@@ -27,20 +27,51 @@ class CsvSource(FileSourceBase):
         self.header = header
         self.delimiter = delimiter
 
+    def timestamp_formats(self) -> List[str]:
+        """Accepted strptime patterns for TIMESTAMP columns
+        (rapids.tpu.sql.csv.timestampFormats), tried in order."""
+        return [f.strip() for f in str(
+            self.conf.get(cfg.CSV_TIMESTAMP_FORMATS)).split(",")
+            if f.strip()]
+
+    def timestamps_enabled(self) -> bool:
+        return bool(self.conf.get(cfg.CSV_TIMESTAMPS_ENABLED))
+
     def _read_options(self):
         from pyarrow import csv as pacsv
 
         ropts = {}
         copts = {}
         if self.declared_schema is not None:
-            col_types = {n: dt.to_arrow(t) for n, t in
-                         zip(self.declared_schema.names,
-                             self.declared_schema.types)}
-            copts["column_types"] = col_types
+            # TIMESTAMP columns parse tz-NAIVE (the configured formats
+            # carry no offsets; engine timestamps are UTC storage) —
+            # _read_file casts the parsed column up to the tz-aware
+            # engine type afterwards
+            import pyarrow as pa
+
+            copts["column_types"] = {
+                n: (pa.timestamp("us") if t is dt.TIMESTAMP
+                    else dt.to_arrow(t))
+                for n, t in zip(self.declared_schema.names,
+                                self.declared_schema.types)}
             if not self.header:
                 ropts["column_names"] = list(self.declared_schema.names)
         elif not self.header:
             raise ValueError("headerless CSV requires an explicit schema")
+        # timestamp compat gate (the reference gates cuDF CSV timestamp
+        # parsing behind spark.rapids.sql.csvTimestamps.enabled,
+        # RapidsConf.scala:482). The gate is enforced by the PLANNER
+        # (plan/overrides._ScanRule tags the scan will_not_work), so the
+        # accelerated path only ever reads timestamps under the
+        # configured formats; this reader must keep working with the
+        # gate off because the CPU-fallback engine reads through the
+        # same source (with arrow's permissive default parsers — the
+        # Spark-CPU-semantics stand-in).
+        if self.timestamps_enabled():
+            # configured formats govern INFERRED timestamp columns too,
+            # not just declared ones — otherwise arrow's built-in
+            # parsers would accept spellings outside the compat gate
+            copts["timestamp_parsers"] = self.timestamp_formats()
         return (pacsv.ReadOptions(**ropts),
                 pacsv.ParseOptions(delimiter=self.delimiter),
                 pacsv.ConvertOptions(**copts,
@@ -50,8 +81,20 @@ class CsvSource(FileSourceBase):
         from pyarrow import csv as pacsv
 
         ropts, popts, copts = self._read_options()
-        return pacsv.read_csv(path, read_options=ropts,
-                              parse_options=popts, convert_options=copts)
+        table = pacsv.read_csv(path, read_options=ropts,
+                               parse_options=popts,
+                               convert_options=copts)
+        if self.declared_schema is not None:
+            # naive-parsed timestamps -> the tz-aware engine type (the
+            # parsed wall time IS the UTC storage value)
+            for n, t in zip(self.declared_schema.names,
+                            self.declared_schema.types):
+                if t is not dt.TIMESTAMP or n not in table.column_names:
+                    continue
+                i = table.column_names.index(n)
+                table = table.set_column(
+                    i, n, table.column(n).cast(dt.to_arrow(t)))
+        return table
 
     def _file_schema(self) -> Schema:
         if self.declared_schema is not None and self.columns is None:

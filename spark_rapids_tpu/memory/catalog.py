@@ -47,10 +47,10 @@ class StorageTier(enum.IntEnum):
 class _Entry:
     __slots__ = ("buffer_id", "priority", "tier", "device_batch",
                  "host_batch", "disk_path", "size", "refcount", "seq",
-                 "pending_remove")
+                 "pending_remove", "owner", "bias")
 
     def __init__(self, buffer_id: int, priority: int, batch: ColumnarBatch,
-                 size: int, seq: int):
+                 size: int, seq: int, owner=None):
         self.buffer_id = buffer_id
         self.priority = priority
         self.tier = StorageTier.DEVICE
@@ -61,6 +61,33 @@ class _Entry:
         self.refcount = 0
         self.seq = seq
         self.pending_remove = False
+        # owner tag (query id) + spill-priority bias: the query service
+        # demotes buffers of queued/stalled queries so pressure evicts
+        # the tenant that is NOT running (SpillPriorities aging analogue)
+        self.owner = owner
+        self.bias = 0
+
+    def spill_key(self):
+        return (self.priority + self.bias, self.seq)
+
+
+# Thread-local buffer-ownership tag: the stage scheduler brackets each
+# query slice with set_buffer_owner(query_id) so every batch the slice
+# registers is attributable to its query — demotable while the query is
+# stalled, removable wholesale on cancel/deadline.
+_owner_tls = threading.local()
+
+
+def set_buffer_owner(owner) -> object:
+    """Set this thread's registration owner tag; returns the previous
+    tag for restore (None = untagged)."""
+    prev = getattr(_owner_tls, "owner", None)
+    _owner_tls.owner = owner
+    return prev
+
+
+def current_buffer_owner():
+    return getattr(_owner_tls, "owner", None)
 
 
 class BufferCatalog:
@@ -84,6 +111,10 @@ class BufferCatalog:
         # victim selection instead of full scans (HashedPriorityQueue.java
         # analogue). Entries are queued only while refcount == 0.
         self._queues = {t: HashedPriorityQueue() for t in StorageTier}
+        # owner tag -> live entries: the query service biases/removes a
+        # query's buffers once per stage slice, which must not scan the
+        # whole catalog
+        self._owners: Dict[object, set] = {}
         self.spilled_device_bytes = 0  # task-metric accounting
         self.spilled_host_bytes = 0
 
@@ -95,10 +126,13 @@ class BufferCatalog:
         size = batch.device_memory_size()
         with self._lock:
             bid = next(self._ids)
-            e = _Entry(bid, priority, batch, size, next(self._seq))
+            e = _Entry(bid, priority, batch, size, next(self._seq),
+                       owner=current_buffer_owner())
             self._entries[bid] = e
+            if e.owner is not None:
+                self._owners.setdefault(e.owner, set()).add(e)
             self._device_bytes += size
-            self._queues[StorageTier.DEVICE].push(e, (e.priority, e.seq))
+            self._queues[StorageTier.DEVICE].push(e, e.spill_key())
         self._maybe_spill_async()
         return bid
 
@@ -132,6 +166,7 @@ class BufferCatalog:
             assert e.refcount >= 0
             if e.pending_remove and e.refcount == 0:
                 self._entries.pop(buffer_id, None)
+                self._drop_owner_index(e)
                 self._drop_tier_bytes(e)
                 path = e.disk_path
             elif e.refcount == 0:
@@ -152,6 +187,7 @@ class BufferCatalog:
                 e.pending_remove = True
                 return
             self._entries.pop(buffer_id, None)
+            self._drop_owner_index(e)
             self._queues[e.tier].remove(e)
             self._drop_tier_bytes(e)
             path = e.disk_path
@@ -164,7 +200,57 @@ class BufferCatalog:
             if e is not None:
                 e.priority = priority
                 if e in self._queues[e.tier]:
-                    self._queues[e.tier].update(e, (priority, e.seq))
+                    self._queues[e.tier].update(e, e.spill_key())
+
+    # -- per-owner control (query service hooks) --------------------------
+
+    def _drop_owner_index(self, e: "_Entry") -> None:
+        """Called under lock when an entry leaves ``_entries``."""
+        if e.owner is not None:
+            peers = self._owners.get(e.owner)
+            if peers is not None:
+                peers.discard(e)
+                if not peers:
+                    self._owners.pop(e.owner, None)
+
+    def set_owner_bias(self, owner, bias: int) -> int:
+        """Re-bias the spill priority of every buffer registered under
+        ``owner`` (negative bias -> spills earlier). The stage scheduler
+        demotes stalled queries' batches with this so memory pressure
+        evicts the tenant that is NOT on the device. Returns the number
+        of entries touched."""
+        n = 0
+        with self._lock:
+            for e in self._owners.get(owner, ()):
+                if e.bias == bias:
+                    continue
+                e.bias = bias
+                if e in self._queues[e.tier]:
+                    self._queues[e.tier].update(e, e.spill_key())
+                n += 1
+        return n
+
+    def owner_refcounts(self, owner) -> Dict[int, int]:
+        """{buffer_id: refcount} of live entries registered under
+        ``owner`` — the leak probe cancel/deadline tests assert on."""
+        with self._lock:
+            return {e.buffer_id: e.refcount
+                    for e in self._owners.get(owner, ())}
+
+    def owner_bytes(self, owner) -> int:
+        with self._lock:
+            return sum(e.size for e in self._owners.get(owner, ()))
+
+    def remove_owner(self, owner) -> int:
+        """Drop every buffer registered under ``owner`` from all tiers
+        (deferred for entries currently acquired, like remove()). The
+        query service's cancel/deadline cleanup: an abandoned exec tree
+        must not leak its staged shuffle/broadcast batches."""
+        with self._lock:
+            ids = [e.buffer_id for e in self._owners.get(owner, ())]
+        for bid in ids:
+            self.remove(bid)
+        return len(ids)
 
     # -- introspection ----------------------------------------------------
 
@@ -233,7 +319,7 @@ class BufferCatalog:
         as a spill victim at its current tier."""
         q = self._queues[e.tier]
         if e not in q:
-            q.push(e, (e.priority, e.seq))
+            q.push(e, e.spill_key())
 
     def _spill_device_entry(self, e: _Entry) -> int:
         batch = e.device_batch

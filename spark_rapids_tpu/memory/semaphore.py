@@ -50,6 +50,17 @@ class TpuSemaphore:
                 self._permits += 1
                 self._cv.notify_all()
 
+    def available(self) -> int:
+        """Permits not currently held (query-service admission consults
+        this; it never reserves — the blocking acquire at device entry
+        is the true bound, so the read being racy is harmless)."""
+        with self._cv:
+            return self._permits
+
+    @property
+    def max_permits(self) -> int:
+        return self._max
+
     def holds(self, task_id: Optional[int] = None) -> bool:
         tid = task_id if task_id is not None else threading.get_ident()
         with self._cv:

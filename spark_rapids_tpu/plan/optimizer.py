@@ -607,6 +607,58 @@ def optimize(plan: pn.PlanNode) -> pn.PlanNode:
 
 
 # ---------------------------------------------------------------------------
+# Peak-footprint model (round-6, service admission): a static estimate
+# of how many device bytes a query may pin at once, from the same
+# footer-stat cardinalities the join reorder uses. The admission
+# controller charges this against the HBM budget before letting a query
+# onto the device (GpuSemaphore bounds WHO may enter; this bounds HOW
+# MUCH the admitted set is expected to ask for).
+# ---------------------------------------------------------------------------
+
+
+def _row_width(node: pn.PlanNode) -> int:
+    """Estimated device bytes per row of a node's output (kernel lane
+    width + validity byte; strings are dictionary codes on device)."""
+    schema = node.output_schema()
+    return sum(t.byte_width + 1 for t in schema.types) or 1
+
+
+def estimate_footprint_bytes(plan: pn.PlanNode,
+                             default_rows: int = 1 << 20) -> int:
+    """Estimated peak device bytes of executing ``plan``: the widest
+    single operator's working set (its output plus every input it holds
+    live) plus the broadcast/build sides and materialized exchanges that
+    stay resident across the pipeline. Nodes without a cardinality
+    estimate assume ``default_rows``. Deliberately coarse and
+    conservative — admission needs an upper-bound-shaped number, not a
+    point estimate; the spill catalog is the real enforcement."""
+    resident = 0  # exchange/aggregate materializations live across stages
+
+    def bytes_of(node: pn.PlanNode) -> int:
+        rows = estimate_rows(node)
+        return max(rows if rows is not None else default_rows, 1) * \
+            _row_width(node)
+
+    def walk(node: pn.PlanNode, seen) -> int:
+        """Peak transient bytes of the subtree rooted at node."""
+        nonlocal resident
+        if id(node) in seen:  # shared CTE subtree: one materialization
+            return 0
+        seen.add(id(node))
+        own = bytes_of(node)
+        if isinstance(node, (pn.JoinNode, pn.AggregateNode, pn.SortNode,
+                             pn.ShuffleExchangeNode)):
+            # materialization points hold their input batches staged
+            # (spillable, but device-first) while producing output
+            resident += own
+        child_peaks = [walk(c, seen) for c in node.children]
+        return own + max(child_peaks, default=0)
+
+    peak = walk(plan, set())
+    return peak + resident
+
+
+# ---------------------------------------------------------------------------
 # Plan-cost model (round-5): a static dispatch-count estimate over the
 # PHYSICAL tree, so tests can assert optimizer decisions (join reorder,
 # broadcast selection) never make a plan costlier than the written

@@ -346,6 +346,17 @@ class _ScanRule(NodeRule):
                         meta.will_not_work(
                             f"{klass.__name__} scan disabled by "
                             f"{flag.key}")
+        # CSV timestamp compat gate (RapidsConf.scala:482 analogue):
+        # timestamp text parses only under the configured formats, so
+        # scans producing TIMESTAMP columns need the explicit opt-in
+        if isinstance(src, CsvSource) and \
+                not meta.conf.get(cfg.CSV_TIMESTAMPS_ENABLED) and \
+                any(t is dt.TIMESTAMP
+                    for t in meta.node.output_schema().types):
+            meta.will_not_work(
+                "CSV TIMESTAMP columns disabled by "
+                f"{cfg.CSV_TIMESTAMPS_ENABLED.key} (formats gated by "
+                f"{cfg.CSV_TIMESTAMP_FORMATS.key})")
 
     def convert(self, meta, children):
         node: pn.ScanNode = meta.node

@@ -552,6 +552,11 @@ class ClusterRuntime:
         # shuffle_id -> map_id -> executor_id assignment
         self.assignments: Dict[int, Dict[int, str]] = {}
         self._rr = itertools.count()
+        # injectable task placement: fn(shuffle_id, map_id, targets) ->
+        # executor_id (or None = fall back to round-robin). Tests and
+        # alternative schedulers steer placement through this seam
+        # instead of coupling to the round-robin counter internals.
+        self.placement_hook = None
 
     # -- identity ---------------------------------------------------------
 
@@ -576,15 +581,26 @@ class ClusterRuntime:
 
     # -- task scheduling --------------------------------------------------
 
+    def _place(self, shuffle_id: int, map_id: int,
+               targets: List[str]) -> str:
+        """Pick the executor for one task: the placement hook decides
+        when set (and names a live target); round-robin otherwise —
+        the reference gets placement from Spark's scheduler."""
+        if self.placement_hook is not None:
+            chosen = self.placement_hook(shuffle_id, map_id,
+                                         list(targets))
+            if chosen is not None and chosen in targets:
+                return chosen
+        return targets[next(self._rr) % len(targets)]
+
     def run_map_task(self, exchange: ClusterShuffleExchangeExec,
                      shuffle_id: int, map_id: int,
                      exclude: Optional[set] = None) -> None:
-        """Assign + execute one map task (round-robin placement; the
-        reference gets placement from Spark's scheduler)."""
+        """Assign + execute one map task."""
         targets = [e for e in self.executor_ids()
                    if not exclude or e not in exclude]
         assert targets, "no live executors"
-        target = targets[next(self._rr) % len(targets)]
+        target = self._place(shuffle_id, map_id, targets)
         worker = next((w for w in self.workers
                        if w.executor_id == target), None)
         if worker is not None:
@@ -629,10 +645,10 @@ class ClusterRuntime:
     def run_sample_task(self, exchange: "ClusterShuffleExchangeExec",
                         shuffle_id: int, map_id: int, k: int):
         """Bounds-sampling pass for one map partition: run it remotely
-        when its round-robin slot is a worker, else locally; either way
+        when its placement slot is a worker, else locally; either way
         return host sample arrays (data, validity)."""
         targets = self.executor_ids()
-        target = targets[next(self._rr) % len(targets)]
+        target = self._place(shuffle_id, map_id, targets)
         worker = next((w for w in self.workers
                        if w.executor_id == target), None)
         if worker is not None:

@@ -1,0 +1,37 @@
+"""Concurrent query service: the subsystem that turns the engine from a
+library (one blocking ``collect()`` at a time) into a server.
+
+The reference's whole concurrency story is passive building blocks —
+GpuSemaphore bounds device entry (GpuSemaphore.scala:27-161), spill
+priorities age stalled tasks' buffers out (SpillPriorities.scala:32-60)
+— and relies on Spark's scheduler to drive them. Standalone, this
+package IS that scheduler:
+
+- ``QueryService`` (query_service.py): the front door. ``submit()``
+  returns a ``QueryHandle`` (poll/result/cancel, per-query deadline);
+  states QUEUED -> ADMITTED -> RUNNING -> DONE/FAILED/CANCELLED/SHED.
+- ``AdmissionController`` (admission.py): estimates each query's peak
+  HBM footprint from the optimizer's footer-stat cardinalities
+  (plan/optimizer.estimate_footprint_bytes) and admits against the
+  device budget plus TpuSemaphore permits, with a bounded priority
+  queue, weighted-round-robin tenant fairness, and load shedding
+  (``ServiceOverloaded``) once the queue limit is hit.
+- ``StageScheduler`` (scheduler.py): interleaves admitted queries'
+  per-stage programs on the single dispatch path — cooperative yields
+  at stage boundaries, cancellation/deadline checks between stages,
+  and spill-priority demotion for batches owned by stalled queries.
+- ``ServiceStats`` (stats.py): queue depth, queue/run-time histograms,
+  admitted/shed/cancelled counts, per-query dispatch counts, and the
+  cross-tenant compile-cache hit rate (shared programs are the
+  multi-tenant win: tenant B's q1 reuses tenant A's executables).
+"""
+from spark_rapids_tpu.service.types import (DeadlineExceeded,  # noqa: F401
+                                            QueryCancelled, QueryHandle,
+                                            QueryState, ServiceOverloaded)
+from spark_rapids_tpu.service.query_service import \
+    QueryService  # noqa: F401
+from spark_rapids_tpu.service.stats import ServiceStats  # noqa: F401
+
+__all__ = ["QueryService", "QueryHandle", "QueryState",
+           "ServiceOverloaded", "DeadlineExceeded", "QueryCancelled",
+           "ServiceStats"]

@@ -1,0 +1,237 @@
+"""Admission control: HBM-budget + permit gating with tenant fairness.
+
+The reference admits work onto the device with a counting semaphore
+(GpuSemaphore.scala:27-161) and trusts Spark's scheduler for fairness;
+standalone, the service needs the scheduler half too. This controller
+keeps a bounded priority queue per tenant and admits in weighted
+round-robin order, charging each query's estimated peak HBM footprint
+(plan/optimizer.estimate_footprint_bytes — footer-stat cardinalities x
+row widths) against the device budget, so the admitted set is expected
+to fit without thrashing the spill catalog. Shedding (not queueing)
+past the queue limit is the backpressure signal.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.service.types import Query, QueryState
+
+
+def parse_fairness_weights(spec: str) -> Dict[str, int]:
+    """'tenantA:2,tenantB:1' -> {tenantA: 2, tenantB: 1}; malformed
+    entries are ignored (a service must not crash on a bad knob)."""
+    out: Dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        name, _, w = part.rpartition(":")
+        try:
+            out[name.strip()] = max(int(w), 1)
+        except ValueError:
+            continue
+    return out
+
+
+class _TenantQueue:
+    """FIFO within a priority level; higher priority first. The sort
+    key list mirrors the entry list for bisect insertion."""
+
+    def __init__(self, weight: int):
+        self.weight = weight
+        self.credits = weight
+        self._keys: List[tuple] = []   # (-priority, seq)
+        self._items: List[Query] = []
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def push(self, q: Query) -> None:
+        key = (-q.priority, next(self._seq))
+        i = bisect.bisect_right(self._keys, key)
+        self._keys.insert(i, key)
+        self._items.insert(i, q)
+
+    def head(self) -> Optional[Query]:
+        return self._items[0] if self._items else None
+
+    def pop_head(self) -> Query:
+        self._keys.pop(0)
+        return self._items.pop(0)
+
+    def remove(self, q: Query) -> bool:
+        try:
+            i = self._items.index(q)
+        except ValueError:
+            return False
+        self._items.pop(i)
+        self._keys.pop(i)
+        return True
+
+
+class AdmissionController:
+    """NOT thread-safe by itself: every method runs under the service
+    lock (one lock for queue + admission + scheduler state keeps the
+    invariants simple; contention is per-stage-slice, not per-row)."""
+
+    def __init__(self, queue_limit: int, max_concurrent: int,
+                 budget_bytes: Optional[int], semaphore,
+                 weights: Optional[Dict[str, int]] = None):
+        self.queue_limit = max(queue_limit, 1)
+        self.max_concurrent = max(max_concurrent, 1)
+        self.budget_bytes = budget_bytes  # None = no HBM accounting
+        # None = resolve the process semaphore live at each check:
+        # runtime.initialize() REPLACES the global instance (a new
+        # concurrentTpuTasks value), and a captured reference would
+        # keep gating on the orphaned one forever. An explicit instance
+        # (tests) is honored as-is.
+        self.semaphore = semaphore
+        self._weights = dict(weights or {})
+        self._tenants: Dict[str, _TenantQueue] = {}
+        self._rr: List[str] = []   # WRR cycle order (arrival order)
+        self._rr_pos = 0
+        self.queued_count = 0
+        self.inflight: set = set()            # ADMITTED + RUNNING
+        self.inflight_bytes = 0
+
+    # -- queue side -------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return self.queued_count
+
+    def would_shed(self, tenant: str) -> bool:
+        """Backpressure with a fairness-aware band: below the queue
+        limit nobody sheds; at 2x the limit everybody does (overload is
+        overload); in between only tenants at/above their fair share of
+        the queue shed — a flooding tenant cannot fill every slot and
+        starve a light tenant at the front door."""
+        if self.queued_count < self.queue_limit:
+            return False
+        if self.queued_count >= 2 * self.queue_limit:
+            return True
+        tq = self._tenants.get(tenant)
+        mine = len(tq) if tq is not None else 0
+        share = max(self.queue_limit // max(len(self._tenants), 1), 1)
+        return mine >= share
+
+    def offer(self, q: Query) -> None:
+        """Enqueue for admission; caller has already checked
+        ``would_shed`` and raised ServiceOverloaded."""
+        tq = self._tenants.get(q.tenant)
+        if tq is None:
+            tq = _TenantQueue(self._weights.get(q.tenant, 1))
+            self._tenants[q.tenant] = tq
+            self._rr.append(q.tenant)
+        tq.push(q)
+        self.queued_count += 1
+
+    def remove_queued(self, q: Query) -> bool:
+        """Cancel/expiry of a still-queued query."""
+        tq = self._tenants.get(q.tenant)
+        if tq is not None and tq.remove(q):
+            self.queued_count -= 1
+            if len(tq) == 0:
+                self._prune_tenant(q.tenant)
+            return True
+        return False
+
+    def _prune_tenant(self, tenant: str) -> None:
+        """Drop a drained tenant from the WRR cycle: tenants are
+        per-submitter keys ('millions of users'), so empty queues must
+        not accumulate in the scan (they re-register on next offer)."""
+        self._tenants.pop(tenant, None)
+        try:
+            i = self._rr.index(tenant)
+        except ValueError:
+            return
+        self._rr.pop(i)
+        if self._rr_pos > i:
+            self._rr_pos -= 1
+        if self._rr:
+            self._rr_pos %= len(self._rr)
+        else:
+            self._rr_pos = 0
+
+    # -- admission side ---------------------------------------------------
+
+    def current_semaphore(self):
+        if self.semaphore is not None:
+            return self.semaphore
+        from spark_rapids_tpu.memory import semaphore as sem
+
+        return sem.get()
+
+    def current_budget(self) -> Optional[int]:
+        """Live HBM budget: an explicit configured budget wins; else the
+        runtime catalog's device budget AS OF NOW — the service may be
+        built before runtime.initialize(), and a budget captured then
+        (None, or a stale value) would disable/miscalibrate HBM
+        admission for the life of the service."""
+        if self.budget_bytes is not None:
+            return self.budget_bytes
+        from spark_rapids_tpu import runtime
+
+        env = runtime.get_env()
+        return env.catalog.device_budget if env is not None else None
+
+    def _fits(self, q: Query) -> bool:
+        if len(self.inflight) >= self.max_concurrent:
+            return False
+        if not self.inflight:
+            # an empty device admits anything: a query whose footprint
+            # exceeds the whole budget must eventually run solo (the
+            # spill catalog absorbs the estimate being wrong), and the
+            # service must never deadlock on its own estimate
+            return True
+        semaphore = self.current_semaphore()
+        if semaphore is not None and semaphore.available() <= 0:
+            # all device-entry permits busy: adding more admitted
+            # queries only builds a convoy at the semaphore
+            return False
+        budget = self.current_budget()
+        if budget is not None and \
+                self.inflight_bytes + q.footprint > budget:
+            return False
+        return True
+
+    def next_admissible(self) -> Optional[Query]:
+        """WRR pop: scan tenants from the cycle pointer, take the first
+        whose head query fits budget+permits. An unfit head does not
+        block other tenants (it re-checks every admission round and is
+        guaranteed in once the inflight set drains — see _fits)."""
+        n = len(self._rr)
+        for off in range(n):
+            i = (self._rr_pos + off) % n
+            tq = self._tenants[self._rr[i]]
+            head = tq.head()
+            if head is None or not self._fits(head):
+                continue
+            tq.pop_head()
+            self.queued_count -= 1
+            tq.credits -= 1
+            if tq.credits <= 0 or len(tq) == 0:
+                tq.credits = tq.weight
+                self._rr_pos = (i + 1) % n
+            else:
+                self._rr_pos = i  # weight remaining: stay on tenant
+            if len(tq) == 0:
+                self._prune_tenant(head.tenant)
+            return head
+        return None
+
+    def admit(self, q: Query) -> None:
+        q.state = QueryState.ADMITTED
+        q.admitted_at = time.perf_counter()
+        self.inflight.add(q)
+        self.inflight_bytes += q.footprint
+
+    def release(self, q: Query) -> None:
+        """Completion/cancel/expiry of an admitted query frees its
+        budget charge (the service then pumps admission again)."""
+        if q in self.inflight:
+            self.inflight.discard(q)
+            self.inflight_bytes -= q.footprint

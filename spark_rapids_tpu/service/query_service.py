@@ -1,0 +1,389 @@
+"""QueryService: the multi-tenant front door over the engine.
+
+``submit()`` plans the query on the caller thread (override planning +
+stage cutting + footprint estimation are cheap host work), then hands
+the physical tree to admission; scheduler workers drive admitted
+queries' stage slices cooperatively. One service per Session — it owns
+nothing global except through the runtime singletons the engine already
+uses (catalog, semaphore, program caches), which is precisely why
+concurrent queries compose: every shared structure below the service
+was already concurrent-safe for intra-query task threads.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, Optional
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory.catalog import get_catalog
+from spark_rapids_tpu.service.admission import (AdmissionController,
+                                                parse_fairness_weights)
+from spark_rapids_tpu.service.scheduler import StageScheduler
+from spark_rapids_tpu.service.stats import Histogram, ServiceStats
+from spark_rapids_tpu.service.types import (DeadlineExceeded, Query,
+                                            QueryCancelled, QueryHandle,
+                                            QueryState, ServiceOverloaded)
+
+# process-global id stream: query ids must be unique ACROSS services —
+# per-query dispatch telemetry (utils/dispatch._query_counts) and
+# catalog owner tags key on them, and two Sessions each numbering from
+# 1 would corrupt each other's buckets
+_GLOBAL_QUERY_IDS = itertools.count(1)
+
+#: terminal queries kept for stats()/per_query history; older ones are
+#: evicted from the registry (their handles keep working — a handle
+#: references the Query object directly)
+FINISHED_RETENTION = 256
+
+
+class QueryService:
+    def __init__(self, conf: Optional[RapidsConf] = None, session=None):
+        self.conf = conf if isinstance(conf, RapidsConf) else \
+            RapidsConf(conf)
+        self.session = session
+        self._lock = threading.RLock()
+        self._done_cv = threading.Condition(self._lock)   # result() waits
+        self._work_cv = threading.Condition(self._lock)   # workers wait
+        self._queries: Dict[int, Query] = {}
+        self._finished_order: list = []  # terminal qids, oldest first
+        self._counters = {"submitted": 0, "admitted": 0, "shed": 0,
+                          "done": 0, "failed": 0, "cancelled": 0,
+                          "deadline_expired": 0}
+        self._queue_time = Histogram()
+        self._run_time = Histogram()
+        self._shutdown = False
+        self._pumping = False
+        self.admission = AdmissionController(
+            queue_limit=self.conf.get(cfg.SERVICE_QUEUE_LIMIT),
+            max_concurrent=self.conf.get(cfg.SERVICE_MAX_CONCURRENT),
+            budget_bytes=self._resolve_budget(),
+            semaphore=None,  # resolve live: runtime init may replace it
+            weights=parse_fairness_weights(
+                self.conf.get(cfg.SERVICE_FAIRNESS_WEIGHTS)))
+        self.scheduler = StageScheduler(
+            self, n_workers=self.conf.get(cfg.SERVICE_MAX_CONCURRENT))
+
+    def _resolve_budget(self) -> Optional[int]:
+        """Only an EXPLICIT configured budget is captured; None lets
+        admission resolve the runtime device budget live (the runtime
+        commonly initializes after the service is constructed)."""
+        explicit = self.conf.get(cfg.SERVICE_ADMISSION_BUDGET)
+        return explicit if explicit else None
+
+    # -- front door -------------------------------------------------------
+
+    def submit(self, df_or_plan, tenant: str = "default",
+               priority: int = 0,
+               deadline: Optional[float] = None) -> QueryHandle:
+        """Plan + enqueue a query; returns immediately with a handle.
+        Raises ServiceOverloaded (state SHED) past the queue limit.
+        ``deadline`` is seconds from submission (queue + run time); the
+        conf default applies when None."""
+        from spark_rapids_tpu.plan.optimizer import (
+            estimate_footprint_bytes, cut_stages)
+        from spark_rapids_tpu.plan.overrides import apply_overrides
+
+        plan = getattr(df_or_plan, "_plan", df_or_plan)
+        if deadline is None:
+            d = self.conf.get(cfg.SERVICE_DEFAULT_DEADLINE)
+            deadline = d if d and d > 0 else None
+        # shed BEFORE planning: under overload — exactly when the
+        # backpressure signal matters — a rejection must not pay the
+        # full planner walk only to throw it away
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("QueryService is shut down")
+            self._counters["submitted"] += 1
+            if self.admission.would_shed(tenant):
+                raise self._shed_locked(plan, tenant, priority, deadline)
+        exec_ = apply_overrides(plan, self.conf)
+        stages = cut_stages(exec_)
+        footprint = estimate_footprint_bytes(
+            plan,
+            default_rows=self.conf.get(cfg.SERVICE_DEFAULT_ROW_ESTIMATE))
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("QueryService is shut down")
+            if self.admission.would_shed(tenant):
+                # concurrent submitters planned past the first check
+                # and filled the queue meanwhile — the bound is hard
+                raise self._shed_locked(plan, tenant, priority, deadline)
+            q = Query(next(_GLOBAL_QUERY_IDS), tenant, plan, exec_,
+                      priority, deadline, footprint, stages,
+                      self._done_cv)
+            self._queries[q.query_id] = q
+            self.admission.offer(q)
+            self._pump_locked()
+        return QueryHandle(self, q)
+
+    def _shed_locked(self, plan, tenant: str, priority: int,
+                     deadline) -> ServiceOverloaded:
+        """Record the rejection as a terminal SHED query so the
+        lifecycle is observable (stats().per_query history) and build
+        the exception — the caller gets no handle back, but it carries
+        the id for gateway-side correlation."""
+        q = Query(next(_GLOBAL_QUERY_IDS), tenant, None, None,
+                  priority, deadline, 0, [], self._done_cv)
+        q.state = QueryState.SHED
+        q.finished_at = time.perf_counter()
+        self._queries[q.query_id] = q
+        self._retain_locked(q)
+        self._counters["shed"] += 1
+        err = ServiceOverloaded(
+            tenant, self.admission.queue_depth(),
+            self.admission.queue_limit)
+        err.query_id = q.query_id
+        return err
+
+    def stats(self) -> ServiceStats:
+        from spark_rapids_tpu.utils import dispatch as _disp
+        from spark_rapids_tpu.utils import progcache
+
+        with self._lock:
+            qcounts = _disp.query_counts()
+            per_query = []
+            running = 0
+            for q in self._queries.values():
+                if q.state is QueryState.RUNNING:
+                    running += 1
+                per_query.append({
+                    "query_id": q.query_id,
+                    "tenant": q.tenant,
+                    "state": q.state.value,
+                    "footprint_bytes": q.footprint,
+                    "slices": q.slices_done,
+                    "dispatches": qcounts.get(q.query_id,
+                                              q.dispatches),
+                    "queue_time_s": q.queue_time_s(),
+                    "run_time_s": q.run_time_s(),
+                })
+            semaphore = self.admission.current_semaphore()
+            return ServiceStats(
+                queue_depth=self.admission.queue_depth(),
+                running=running,
+                admitted_inflight=len(self.admission.inflight),
+                inflight_bytes=self.admission.inflight_bytes,
+                budget_bytes=self.admission.current_budget(),
+                counters=dict(self._counters),
+                queue_time_hist=self._queue_time.snapshot(),
+                run_time_hist=self._run_time.snapshot(),
+                per_query=per_query,
+                progcache=progcache.stats(),
+                semaphore={
+                    "available": semaphore.available(),
+                    "max": semaphore.max_permits,
+                })
+
+    def shutdown(self, cancel_running: bool = True) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            for q in list(self._queries.values()):
+                if not q.terminal:
+                    if q.state is QueryState.QUEUED:
+                        self.admission.remove_queued(q)
+                        self._finalize_locked(q, QueryState.CANCELLED)
+                    elif cancel_running:
+                        q.cancel_requested = True
+            self.scheduler.stop()
+        self.scheduler.join()
+        # workers are gone: no future slice will observe the cancel
+        # flags, so finalize whatever they left mid-flight here — a
+        # waiter blocked in result() must terminate, and the queries'
+        # admission charges + catalog buffers must release
+        with self._lock:
+            for q in list(self._queries.values()):
+                if not q.terminal:
+                    self._finalize_locked(q, QueryState.CANCELLED)
+
+    # -- handle backends --------------------------------------------------
+
+    def _poll(self, q: Query) -> QueryState:
+        with self._lock:
+            self._maybe_expire_locked(q)
+            return q.state
+
+    def _result(self, q: Query, timeout: Optional[float]):
+        deadline = None if timeout is None else \
+            time.perf_counter() + timeout
+        with self._lock:
+            while True:
+                self._maybe_expire_locked(q)
+                if q.terminal:
+                    break
+                wait = None
+                if q.deadline_at is not None:
+                    # floor keeps the re-check from busy-looping while
+                    # an overdue RUNNING query finishes its slice (the
+                    # scheduler, not this waiter, expires it)
+                    wait = max(q.deadline_at - time.perf_counter(),
+                               0.25)
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"query {q.query_id} still "
+                            f"{q.state.value} after {timeout}s")
+                    wait = remaining if wait is None else \
+                        min(wait, remaining)
+                self._done_cv.wait(wait)
+            if q.state is QueryState.DONE:
+                return q.result
+            if q.state is QueryState.CANCELLED:
+                raise QueryCancelled(
+                    f"query {q.query_id} was cancelled")
+            raise q.error or RuntimeError(
+                f"query {q.query_id} {q.state.value}")
+
+    def _cancel(self, q: Query) -> bool:
+        with self._lock:
+            if q.terminal:
+                return q.state is QueryState.CANCELLED
+            if q.state is QueryState.QUEUED:
+                self.admission.remove_queued(q)
+                self._finalize_locked(q, QueryState.CANCELLED)
+                return True
+            # admitted/running: flag it; a stalled query in the ready
+            # deque finalizes via its next slice's interrupt check
+            q.cancel_requested = True
+            return True
+
+    # -- internals --------------------------------------------------------
+
+    def _maybe_expire_locked(self, q: Query) -> None:
+        """Lazily expire an overdue query that no worker is driving:
+        QUEUED (still in admission), or ADMITTED and parked in the
+        ready deque (a stalled query may never reach a worker while a
+        long slice hogs maxConcurrent — its deadline must still fire).
+        A RUNNING query is expired by its own slice-boundary check."""
+        if q.terminal or not q.deadline_expired():
+            return
+        if q.state is QueryState.QUEUED:
+            self.admission.remove_queued(q)
+            where = "while queued"
+        elif q.state is QueryState.ADMITTED and self.scheduler.drop(q):
+            where = "while awaiting a scheduler slot"
+        else:
+            return
+        self._finalize_locked(
+            q, QueryState.FAILED,
+            DeadlineExceeded(
+                f"query {q.query_id} exceeded its "
+                f"{q.deadline_s:.3f}s deadline {where}"))
+
+    def _pump_locked(self) -> None:
+        """Admit queries while capacity allows (called on submit and on
+        every release). Reentrancy guard: expiring a queued query below
+        calls _finalize_locked, whose own tail pump must not recurse —
+        one stack frame per expired query would blow the stack on a
+        deep queue of dead deadlines; the guard makes the inner call a
+        no-op and this loop re-scans instead."""
+        if self._pumping:
+            return
+        self._pumping = True
+        try:
+            while True:
+                nxt = self.admission.next_admissible()
+                if nxt is None:
+                    return
+                if nxt.deadline_expired():
+                    self._finalize_locked(
+                        nxt, QueryState.FAILED,
+                        DeadlineExceeded(
+                            f"query {nxt.query_id} exceeded its "
+                            f"deadline while queued"))
+                    continue
+                self.admission.admit(nxt)
+                self._counters["admitted"] += 1
+                self.scheduler.enqueue(nxt)
+        finally:
+            self._pumping = False
+
+    def _finalize(self, q: Query, state: QueryState,
+                  error: Optional[BaseException] = None) -> None:
+        if state is QueryState.DONE and q.result is None:
+            # assemble OUTSIDE the lock: the finishing worker still owns
+            # the query exclusively, and a multi-GB pd.concat must not
+            # stall every submit/poll/worker on the service lock
+            q.result = self._assemble(q)
+        with self._lock:
+            self._finalize_locked(q, state, error)
+
+    def _finalize_locked(self, q: Query, state: QueryState,
+                         error: Optional[BaseException] = None) -> None:
+        from spark_rapids_tpu.utils import dispatch as _disp
+
+        if q.terminal:
+            return
+        if state is QueryState.DONE and q.cancel_requested:
+            # cancel() already told its caller the query will not
+            # complete — honor that even when the final slice raced it
+            # to the finish (flag and transition share this lock, so
+            # the race closes here); the assembled result is discarded
+            state = QueryState.CANCELLED
+            q.result = None
+        if state is QueryState.DONE and q.result is None:
+            q.result = self._assemble(q)  # _finalize pre-assembles
+        q.state = state
+        q.error = error
+        q.finished_at = time.perf_counter()
+        q.dispatches = _disp.pop_query_count(q.query_id)
+        # release every resource the query may still hold: admission
+        # charge, catalog buffers (an abandoned exec tree must not leak
+        # staged batches), and its execution cursor
+        self.admission.release(q)
+        get_catalog().remove_owner(q.owner_tag)
+        # drop the heavy execution state: the retention registry keeps
+        # up to FINISHED_RETENTION terminal queries for stats history,
+        # and pinning each one's exec/plan tree and staged frames would
+        # grow host RAM with query size, not query count. q.result
+        # stays — handle.result() after completion is the contract.
+        q._iters = {}
+        q.frames = {}
+        q.exec = None
+        q.plan = None
+        if state is QueryState.DONE:
+            self._counters["done"] += 1
+        elif state is QueryState.CANCELLED:
+            self._counters["cancelled"] += 1
+        elif state is QueryState.FAILED:
+            self._counters["failed"] += 1
+            if isinstance(error, DeadlineExceeded):
+                self._counters["deadline_expired"] += 1
+        qt, rt = q.queue_time_s(), q.run_time_s()
+        if qt is not None:
+            self._queue_time.add(qt)
+        if rt is not None and q.admitted_at is not None:
+            self._run_time.add(rt)
+        self.scheduler.drop(q)
+        self._retain_locked(q)
+        self._pump_locked()
+        self._done_cv.notify_all()
+
+    def _retain_locked(self, q: Query) -> None:
+        """Bounded history: a service alive for days must not pin every
+        finished query's result frame + exec tree in the registry."""
+        self._finished_order.append(q.query_id)
+        while len(self._finished_order) > FINISHED_RETENTION:
+            self._queries.pop(self._finished_order.pop(0), None)
+
+    def _assemble(self, q: Query):
+        """Partition-then-batch order concat — identical row order to
+        the serial collect() path (execs/base.collect)."""
+        import pandas as pd
+
+        frames = [f for p in sorted(q.frames) for f in q.frames[p]]
+        if not frames:
+            exec_ = q.exec
+            if exec_ is None:
+                # an outside finalize (cancel/shutdown) already dropped
+                # the tree; _finalize_locked discards this result anyway
+                return None
+            cols = {n: pd.Series([], dtype=object)
+                    for n in exec_.schema.names}
+            return pd.DataFrame(cols)
+        return pd.concat(frames, ignore_index=True)

@@ -1,0 +1,241 @@
+"""Stage scheduler: interleave admitted queries on the dispatch path.
+
+One query's long stage must not starve the rest: the engine executes a
+query as per-stage compiled programs (plan/optimizer.cut_stages labels
+them; each batch pull dispatches one stage program post-fusion), so the
+natural schedulable unit is ONE batch pull — a stage slice. Workers
+pull slices from a round-robin ready deque: after each slice the query
+goes to the back, cancellation and deadline are checked between slices
+(= between stage programs), and the slice brackets set the thread's
+buffer-owner tag (memory/catalog) and dispatch query tag
+(utils/dispatch) so spill demotion and per-query telemetry attribute
+correctly. Device entry within a slice passes through the TpuSemaphore
+exactly as in single-query mode — the execs acquire at device touch and
+release per batch. The scheduler deliberately does NOT hold a permit
+across a slice: a slice may materialize an exchange whose internal task
+threads take permits of their own, and a slice-long hold would deadlock
+against them (the engine-wide invariant is that nobody holds a permit
+while waiting on other threads). Admission consults permit availability
+instead (admission.py).
+
+While a query sits in the ready deque (stalled: admitted, not on a
+worker) its catalog buffers carry a large negative spill bias — under
+memory pressure the catalog evicts the stalled tenant's batches first
+and the running tenant keeps its working set (SpillPriorities aging,
+applied cross-query).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional
+
+from spark_rapids_tpu.memory import semaphore as sem
+from spark_rapids_tpu.memory.catalog import get_catalog, set_buffer_owner
+from spark_rapids_tpu.service.types import (DeadlineExceeded, Query,
+                                            QueryState)
+from spark_rapids_tpu.utils import dispatch as _disp
+
+#: spill-priority bias applied to a stalled query's buffers: larger in
+#: magnitude than every SpillPriorities band (tops out near 1 << 62),
+#: so a stalled tenant's batches — even its ACTIVE on-deck ones — are
+#: always preferred victims over any running query's buffers
+STALLED_SPILL_BIAS = -(1 << 63)
+
+
+class _Interrupted(BaseException):
+    """Internal slice unwind (cancel/deadline); never escapes the
+    scheduler. BaseException so a careless ``except Exception`` inside
+    an exec iterator cannot swallow a cancellation."""
+
+    def __init__(self, state: QueryState,
+                 error: Optional[BaseException] = None):
+        self.state = state
+        self.error = error
+
+
+class StageScheduler:
+    """Worker pool driving stage slices. All shared state is guarded by
+    the service lock (``service._lock``); slice execution itself runs
+    unlocked."""
+
+    def __init__(self, service, n_workers: int):
+        self._service = service
+        self._n_workers = max(n_workers, 1)
+        self._ready: deque = deque()
+        self._workers: List[threading.Thread] = []
+        self._shutdown = False
+
+    # -- service-side hooks (called under the service lock) ---------------
+
+    def enqueue(self, q: Query) -> None:
+        self._ready.append(q)
+        self._service._work_cv.notify_all()
+        self._ensure_workers()
+
+    def ready_count(self) -> int:
+        return len(self._ready)
+
+    def drop(self, q: Query) -> bool:
+        """Remove a query from the ready deque (cancel while stalled)."""
+        try:
+            self._ready.remove(q)
+            return True
+        except ValueError:
+            return False
+
+    def stop(self) -> None:
+        self._shutdown = True
+        self._service._work_cv.notify_all()
+
+    def join(self, timeout: float = 5.0) -> None:
+        for w in self._workers:
+            w.join(timeout)
+
+    def _ensure_workers(self) -> None:
+        if self._workers or self._shutdown:
+            return
+        for i in range(self._n_workers):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"svc-worker-{i}", daemon=True)
+            self._workers.append(t)
+            t.start()
+
+    # -- worker side ------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        svc = self._service
+        while True:
+            with svc._lock:
+                while not self._ready and not self._shutdown:
+                    svc._work_cv.wait()
+                if self._shutdown:
+                    return
+                q = self._ready.popleft()
+                if q.terminal:
+                    continue
+                q.state = QueryState.RUNNING
+                if q.started_at is None:
+                    import time
+
+                    q.started_at = time.perf_counter()
+            self._run_slice(q)
+
+    def _run_slice(self, q: Query) -> None:
+        """Advance one stage slice (one batch pull) of ``q``, then hand
+        it back to the ready deque — or finalize it."""
+        catalog = get_catalog()
+        # back on the device: restore normal spill priority (skipped
+        # unless the last yield actually demoted — the common
+        # single-query case never touches the catalog heap)
+        if q.spill_demoted:
+            catalog.set_owner_bias(q.owner_tag, 0)
+            q.spill_demoted = False
+        done = False
+        outcome: Optional[_Interrupted] = None
+        prev_owner = set_buffer_owner(q.owner_tag)
+        qtok = _disp.enter_query(q.query_id)
+        try:
+            self._check_interrupt(q)
+            done = self._advance(q)
+            q.slices_done += 1
+        except _Interrupted as stop:
+            outcome = stop
+        except BaseException as e:  # exec failure -> query failure
+            outcome = _Interrupted(QueryState.FAILED, e)
+        finally:
+            # execs acquire the (thread-keyed) permit inside their
+            # iterators and hold it across yields; a suspended slice
+            # must not pin this worker's permit while the query waits
+            # in the ready deque — release whatever this thread holds.
+            # Cross-thread iterator resumption makes the per-batch
+            # semaphore accounting advisory across slice boundaries
+            # (never a leak, never a deadlock: releases only ever free
+            # permits); the strict cross-query bound is admission's.
+            sem.get().release_if_necessary()
+            _disp.exit_query(qtok)
+            set_buffer_owner(prev_owner)
+
+        svc = self._service
+        requeued = False
+        if outcome is not None:
+            svc._finalize(q, outcome.state, outcome.error)
+        elif done:
+            svc._finalize(q, QueryState.DONE)
+        else:
+            with svc._lock:
+                if not q.terminal:   # else: cancel raced the slice
+                    # cooperative yield: back of the deque, another
+                    # query's stage goes next; stalled buffers become
+                    # spill victims
+                    q.state = QueryState.ADMITTED
+                    if len(svc.admission.inflight) > 1:
+                        # another admitted query can use the memory:
+                        # make the stalled tenant the preferred spill
+                        # victim. Solo queries skip the demote/restore
+                        # churn (2 x n_buffers heap updates per slice
+                        # that could never benefit anyone).
+                        catalog.set_owner_bias(q.owner_tag,
+                                               STALLED_SPILL_BIAS)
+                        q.spill_demoted = True
+                    self._ready.append(q)
+                    # permits freed during the slice may unblock
+                    # admission (the availability gate in
+                    # admission._fits): pump here, not only at
+                    # submit/finalize, or a queued query could wait a
+                    # whole query's latency instead of a slice's
+                    svc._pump_locked()
+                    svc._work_cv.notify_all()
+                    requeued = True
+        if not requeued and q.terminal:
+            # an outside finalize (shutdown's post-join pass, a cancel
+            # racing the finish) may have swept the owner tag while
+            # this slice was still registering buffers under it; the
+            # slice is off the device now, so a re-sweep closes the
+            # leak (idempotent when nothing raced). Resolve the catalog
+            # FRESH: a runtime teardown racing this slice swaps the
+            # global catalog, and late registrations landed in the new
+            # one — the instance captured at slice start is stale.
+            get_catalog().remove_owner(q.owner_tag)
+            # same race for telemetry: dispatches this slice issued
+            # after the finalize popped the query's count re-created
+            # the _query_counts entry; drop it or it lives forever
+            _disp.pop_query_count(q.query_id)
+
+    def _check_interrupt(self, q: Query) -> None:
+        if q.cancel_requested:
+            raise _Interrupted(QueryState.CANCELLED)
+        if q.deadline_expired():
+            raise _Interrupted(
+                QueryState.FAILED,
+                DeadlineExceeded(
+                    f"query {q.query_id} exceeded its "
+                    f"{q.deadline_s:.3f}s deadline"))
+
+    def _advance(self, q: Query) -> bool:
+        """Pull the next batch of the current partition; True when the
+        whole query has drained. The first pull of a partition runs any
+        upstream stage materializations (exchange/broadcast builds) —
+        that whole stage is one slice, which is exactly the cooperative
+        granularity: yields happen at stage boundaries, never inside a
+        compiled program."""
+        if q.num_partitions is None:
+            # first slice: resolving the count may materialize adaptive
+            # exchanges — that is exactly the work a slice is for
+            q.num_partitions = q.exec.num_partitions
+        while q._cursor < q.num_partitions:
+            p = q._cursor
+            it = q._iters.get(p)
+            if it is None:
+                it = q._iters[p] = iter(q.exec.execute(p))
+            try:
+                batch = next(it)
+            except StopIteration:
+                q._iters.pop(p, None)
+                q._cursor += 1
+                continue
+            frame = batch.to_pandas(q.exec.schema)
+            if len(frame):
+                q.frames.setdefault(p, []).append(frame)
+            return False
+        return True

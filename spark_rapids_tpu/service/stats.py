@@ -1,0 +1,74 @@
+"""Service observability: the ServiceStats snapshot.
+
+The multi-tenant wins this surfaces: queue depth + shed counts show
+backpressure working, queue/run-time histograms show fairness, and the
+compile-cache hit rate shows tenants sharing compiled programs — a
+repeated plan shape admitted for tenant B reuses tenant A's XLA
+executables (utils/progcache), which is the dominant cost behind the
+remote-compile tunnel.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+#: histogram bucket upper bounds in seconds (last bucket is +inf)
+HIST_BUCKETS = (0.001, 0.01, 0.1, 1.0, 10.0, 100.0)
+HIST_LABELS = tuple(f"le_{b:g}s" for b in HIST_BUCKETS) + ("inf",)
+
+
+class Histogram:
+    """Fixed log-bucket latency histogram (enough for a snapshot; the
+    service is not a metrics pipeline)."""
+
+    def __init__(self):
+        self.counts = [0] * (len(HIST_BUCKETS) + 1)
+        self.total = 0
+        self.sum_s = 0.0
+
+    def add(self, seconds: float) -> None:
+        for i, b in enumerate(HIST_BUCKETS):
+            if seconds <= b:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.total += 1
+        self.sum_s += seconds
+
+    def snapshot(self) -> dict:
+        return {
+            "buckets": dict(zip(HIST_LABELS, self.counts)),
+            "count": self.total,
+            "mean_s": round(self.sum_s / self.total, 6)
+            if self.total else 0.0,
+        }
+
+
+@dataclasses.dataclass
+class ServiceStats:
+    """Point-in-time service snapshot; ``to_dict()`` is what the
+    benchmark runner embeds in its JSON."""
+
+    queue_depth: int
+    running: int
+    admitted_inflight: int
+    inflight_bytes: int
+    budget_bytes: Optional[int]
+    counters: Dict[str, int]           # admitted/shed/done/failed/...
+    queue_time_hist: dict
+    run_time_hist: dict
+    per_query: List[dict]
+    progcache: dict
+    semaphore: dict
+
+    @property
+    def progcache_hit_rate(self) -> float:
+        hits = self.progcache.get("hits", 0)
+        misses = self.progcache.get("misses", 0)
+        return hits / (hits + misses) if hits + misses else 0.0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["progcache"]["hit_rate"] = round(self.progcache_hit_rate, 4)
+        return d

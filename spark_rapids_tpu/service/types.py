@@ -1,0 +1,180 @@
+"""Query lifecycle types shared by the service modules."""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import List, Optional
+
+
+class QueryState(enum.Enum):
+    QUEUED = "QUEUED"          # accepted, waiting for admission
+    ADMITTED = "ADMITTED"      # counted against budget, awaiting a slice
+    RUNNING = "RUNNING"        # a scheduler worker is driving a slice
+    DONE = "DONE"
+    FAILED = "FAILED"          # error or deadline expiry
+    CANCELLED = "CANCELLED"    # explicit cancel()
+    SHED = "SHED"              # rejected at submit (queue limit)
+
+
+TERMINAL_STATES = frozenset(
+    {QueryState.DONE, QueryState.FAILED, QueryState.CANCELLED,
+     QueryState.SHED})
+
+
+class ServiceOverloaded(RuntimeError):
+    """Structured load-shed rejection: the admission queue is at
+    ``rapids.tpu.service.queueLimit``. Callers should back off and
+    retry; the fields let a gateway turn this into a 429."""
+
+    def __init__(self, tenant: str, queue_depth: int, queue_limit: int):
+        self.tenant = tenant
+        self.queue_depth = queue_depth
+        self.queue_limit = queue_limit
+        super().__init__(
+            f"service overloaded: admission queue depth {queue_depth} "
+            f"at limit {queue_limit} (tenant {tenant!r}) — retry with "
+            f"backoff or raise rapids.tpu.service.queueLimit")
+
+
+class DeadlineExceeded(RuntimeError):
+    """The query's deadline (queue time + run time) expired before it
+    completed; its admission, permit and buffers were released."""
+
+
+class QueryCancelled(RuntimeError):
+    """result() on a query whose cancel() won."""
+
+
+class Query:
+    """Internal per-query record. All mutable fields are guarded by the
+    service-wide lock; the condition variable wakes ``result()``
+    waiters on any state transition."""
+
+    def __init__(self, query_id: int, tenant: str, plan, exec_,
+                 priority: int, deadline_s: Optional[float],
+                 footprint: int, stages: List[dict],
+                 cv: threading.Condition):
+        self.query_id = query_id
+        self.tenant = tenant
+        self.plan = plan
+        self.exec = exec_
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.footprint = footprint
+        self.stages = stages
+        self.cv = cv
+        self.state = QueryState.QUEUED
+        self.cancel_requested = False
+        self.error: Optional[BaseException] = None
+        self.result = None  # assembled pandas frame once DONE
+        self.submitted_at = time.perf_counter()
+        self.admitted_at: Optional[float] = None
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.slices_done = 0
+        self.dispatches = 0  # filled from telemetry when installed
+        self.spill_demoted = False  # stalled-yield bias currently set
+        # cooperative execution cursor: per-partition batch iterators,
+        # advanced one stage-slice at a time by the scheduler. The REAL
+        # partition count resolves lazily on the first slice — querying
+        # it eagerly would materialize adaptive exchanges on the
+        # submitter's thread (exactly the blocking submit() must avoid).
+        if exec_ is None:
+            # shed-at-submit record: never planned, never runs
+            self.planned_partitions = 0
+        else:
+            from spark_rapids_tpu.execs import adaptive as adaptive_exec
+
+            with adaptive_exec.planning_mode():
+                self.planned_partitions = exec_.num_partitions
+        self.num_partitions: Optional[int] = None
+        self.frames: dict = {}            # partition -> [pandas frames]
+        self._iters: dict = {}            # partition -> live iterator
+        self._cursor = 0
+
+    # buffer-ownership tag for catalog attribution (demotion + cleanup)
+    @property
+    def owner_tag(self):
+        return ("svc-query", self.query_id)
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        if not self.deadline_s or self.deadline_s <= 0:
+            return None
+        return self.submitted_at + self.deadline_s
+
+    def deadline_expired(self) -> bool:
+        d = self.deadline_at
+        return d is not None and time.perf_counter() > d
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def queue_time_s(self) -> Optional[float]:
+        end = self.admitted_at if self.admitted_at is not None \
+            else self.finished_at
+        return None if end is None else end - self.submitted_at
+
+    def run_time_s(self) -> Optional[float]:
+        if self.admitted_at is None:
+            return None
+        end = self.finished_at if self.finished_at is not None \
+            else time.perf_counter()
+        return end - self.admitted_at
+
+
+class QueryHandle:
+    """The caller's view of a submitted query (the service front door
+    hands one back from ``submit()``)."""
+
+    def __init__(self, service, query: Query):
+        self._service = service
+        self._query = query
+
+    @property
+    def query_id(self) -> int:
+        return self._query.query_id
+
+    @property
+    def tenant(self) -> str:
+        return self._query.tenant
+
+    def poll(self) -> QueryState:
+        """Non-blocking state probe (also lazily expires the deadline
+        of a still-queued query)."""
+        return self._service._poll(self._query)
+
+    @property
+    def state(self) -> QueryState:
+        return self.poll()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until terminal, then return the pandas DataFrame
+        (DONE) or raise: the original error / DeadlineExceeded
+        (FAILED), QueryCancelled (CANCELLED). ``timeout`` raises
+        TimeoutError without affecting the query."""
+        return self._service._result(self._query, timeout)
+
+    def cancel(self) -> bool:
+        """Request cancellation; True if the query will not (or did
+        not) complete. Queued queries cancel immediately; running ones
+        stop at the next stage boundary."""
+        return self._service._cancel(self._query)
+
+    def info(self) -> dict:
+        q = self._query
+        return {
+            "query_id": q.query_id,
+            "tenant": q.tenant,
+            "state": self.poll().value,
+            "priority": q.priority,
+            "footprint_bytes": q.footprint,
+            "num_partitions": q.num_partitions
+            if q.num_partitions is not None else q.planned_partitions,
+            "stages": len(q.stages),
+            "slices_done": q.slices_done,
+            "queue_time_s": q.queue_time_s(),
+            "run_time_s": q.run_time_s(),
+        }

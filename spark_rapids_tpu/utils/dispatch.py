@@ -56,13 +56,59 @@ def exit_stage(token) -> None:
         _tls.stage = token[0]
 
 
+# -- per-query attribution --------------------------------------------------
+# The query service brackets each stage slice with enter_query/exit_query
+# so concurrent queries' dispatches split per query id in ServiceStats —
+# same thread-local scheme as stages, orthogonal bucket.
+_query_counts: dict = {}
+
+
+def enter_query(query_id):
+    """Tag this thread's dispatches with ``query_id``; returns a token
+    for exit_query. No-op (None token) when telemetry isn't installed."""
+    if not _installed or query_id is None:
+        return None
+    prev = getattr(_tls, "query", None)
+    _tls.query = query_id
+    return (prev,)
+
+
+def exit_query(token) -> None:
+    if token is not None:
+        _tls.query = token[0]
+
+
+def current_query():
+    """The query id tagging this thread's dispatches, or None —
+    run_partitions propagates it onto its pool threads the same way it
+    propagates the catalog buffer-owner tag."""
+    return getattr(_tls, "query", None)
+
+
+def query_counts() -> dict:
+    """{query_id: dispatch_count} accumulated so far (live queries)."""
+    with _stage_lock:
+        return dict(_query_counts)
+
+
+def pop_query_count(query_id) -> int:
+    """Final dispatch count of a finished query, removed from the live
+    map — a long-lived service must not accumulate one entry per query
+    ever submitted."""
+    with _stage_lock:
+        return _query_counts.pop(query_id, 0)
+
+
 def _bump_stage(kind: str) -> None:
     label = getattr(_tls, "stage", None) or "<unstaged>"
+    qid = getattr(_tls, "query", None)
     with _stage_lock:
         d = _stage_counts.get(label)
         if d is None:
             d = _stage_counts[label] = {"jit": 0, "eager": 0, "get": 0}
         d[kind] += 1
+        if qid is not None:
+            _query_counts[qid] = _query_counts.get(qid, 0) + 1
 
 # -- measured device timing (serialized mode) -------------------------------
 # When enabled, every counted jit call BLOCKS until its result is ready

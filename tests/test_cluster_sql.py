@@ -187,16 +187,18 @@ def test_mesh_subtree_ships_to_worker_process(cluster_teardown):
 
     runtime = session_cluster(s.conf)
     assert runtime is not None and runtime.mesh_devices >= 2
-    # align round-robin placement so the mesh map task lands on the
-    # WORKER process, not the in-process executor
-    ids = runtime.executor_ids()
-    widx = ids.index(runtime.workers[0].executor_id)
-    # consume counter values until the NEXT draw maps to the worker
-    while (next(runtime._rr) + 1) % len(ids) != widx:
-        pass
+    # steer placement so the mesh map task lands on the WORKER process,
+    # not the in-process executor (injectable placement seam — no
+    # coupling to the round-robin counter internals)
+    wid = runtime.workers[0].executor_id
+    runtime.placement_hook = \
+        lambda sid, mid, targets: wid if wid in targets else None
 
     from spark_rapids_tpu.execs.base import collect
-    got = collect(exec_, conf=s.conf)
+    try:
+        got = collect(exec_, conf=s.conf)
+    finally:
+        runtime.placement_hook = None  # module-cached runtime
 
     # rebuild views on a plain session for the oracle
     plain = Session()

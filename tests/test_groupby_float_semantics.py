@@ -1,0 +1,210 @@
+"""Dense-vs-sort groupby float semantics (r5 ask #8).
+
+The groupby has two kernels: the sort-free dense sweep for host-known
+key spaces of <= 128 slots (ops/groupby._DENSE_MAX_GROUPS) and the
+variadic-sort path for everything else. Their float-reduction trees
+differ, so ops/groupby.py:123-144 gates grouping-set (ROLLUP/CUBE)
+aggregates off the dense path ONLY when an order-sensitive float
+reduction is present — order-insensitive aggregates (min/max/count,
+integer sums) must be bit-exact on BOTH paths, with ties, NaN, -0.0
+and nulls in play, straddling the 128-slot boundary. This is the
+property suite that pins that contract.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.ops import groupby as gb
+from spark_rapids_tpu.ops.groupby import AggSpec
+
+# order-insensitive aggs: result independent of the reduction tree
+ORDER_INSENSITIVE = [AggSpec("min", 1), AggSpec("max", 1),
+                     AggSpec("count", 1), AggSpec("count_star")]
+
+
+def _make_batch(rng, n, span, vdtype, with_stats):
+    """Keys 0..span-1 with ties; float values seeded with NaN, -0.0,
+    +0.0, exact ties and nulls."""
+    keys = rng.integers(0, span, n).astype(np.int64)
+    keys[: span] = np.arange(span)          # every slot occupied
+    vals = rng.standard_normal(n).astype(vdtype.np_dtype)
+    vals[rng.random(n) < 0.1] = np.nan
+    vals[rng.random(n) < 0.1] = vdtype.np_dtype.type(-0.0)
+    vals[rng.random(n) < 0.1] = vdtype.np_dtype.type(0.0)
+    vals[rng.random(n) < 0.15] = vdtype.np_dtype.type(1.5)  # ties
+    validity = rng.random(n) > 0.1
+    kcol = Column.from_numpy(keys)
+    if with_stats:
+        kcol.stats = (0, span - 1)
+    vcol = Column.from_numpy(vals, validity=validity)
+    return ColumnarBatch([kcol, vcol], n)
+
+
+def _rows(out, num_aggs):
+    """Realized (key -> agg tuple) dict with float bits for exactness."""
+    import jax
+
+    n = out.realized_num_rows()
+    cols = []
+    for c in out.columns:
+        data = np.asarray(jax.device_get(c.data))[:n]
+        if data.dtype.kind == "f":
+            data = data.view(f"u{data.dtype.itemsize}")
+        valid = np.ones(n, bool) if c.validity is None else \
+            np.asarray(jax.device_get(c.validity))[:n]
+        cols.append((data, valid))
+    rows = {}
+    for i in range(n):
+        key = (cols[0][0][i].item(), bool(cols[0][1][i]))
+        rows[key] = tuple(
+            (cols[j][0][i].item(), bool(cols[j][1][i]))
+            for j in range(1, 1 + num_aggs))
+    return rows
+
+
+@pytest.mark.parametrize("vdtype", [dt.FLOAT32, dt.FLOAT64])
+@pytest.mark.parametrize("span", [96, 127, 128])
+def test_dense_and_sort_paths_bit_exact(vdtype, span):
+    """Within the dense-eligible regime (quantized span <= 128 slots),
+    order-insensitive aggregates must agree BIT-exactly between the
+    dense sweep (stats present) and the sort kernel (stats absent) —
+    including NaN payload bits and the sign of zero."""
+    rng = np.random.default_rng(span * 7 + vdtype.byte_width)
+    n = 4000
+    dtypes = [dt.INT64, vdtype]
+    dense_b = _make_batch(rng, n, span, vdtype, with_stats=True)
+    sort_b = ColumnarBatch(list(dense_b.columns), n)
+    sort_b.columns[0] = Column(dt.INT64, dense_b.columns[0].data,
+                               dense_b.columns[0].validity)  # no stats
+    out_d, _ = gb.groupby_aggregate(dense_b, [0], ORDER_INSENSITIVE,
+                                    dtypes)
+    out_s, _ = gb.groupby_aggregate(sort_b, [0], ORDER_INSENSITIVE,
+                                    dtypes)
+    rows_d = _rows(out_d, len(ORDER_INSENSITIVE))
+    rows_s = _rows(out_s, len(ORDER_INSENSITIVE))
+    assert rows_d == rows_s
+
+
+def test_boundary_span_129_uses_sort_even_with_stats():
+    """One slot past the boundary (span 129 quantizes to 256 > 128):
+    stats or not, the sort kernel runs, and results still match the
+    stats-free run exactly."""
+    rng = np.random.default_rng(11)
+    n = 2000
+    dtypes = [dt.INT64, dt.FLOAT64]
+    b_stats = _make_batch(rng, n, 129, dt.FLOAT64, with_stats=True)
+    b_plain = ColumnarBatch(list(b_stats.columns), n)
+    b_plain.columns[0] = Column(dt.INT64, b_stats.columns[0].data,
+                                b_stats.columns[0].validity)
+    seen = _spy_paths(lambda: gb.groupby_aggregate(
+        b_stats, [0], ORDER_INSENSITIVE, dtypes))
+    assert seen == ["sort"]
+    out_a, _ = gb.groupby_aggregate(b_stats, [0], ORDER_INSENSITIVE,
+                                    dtypes)
+    out_b, _ = gb.groupby_aggregate(b_plain, [0], ORDER_INSENSITIVE,
+                                    dtypes)
+    assert _rows(out_a, 4) == _rows(out_b, 4)
+
+
+def _spy_paths(fn):
+    """Run ``fn`` recording which kernel each _groupby call selects
+    (from its FINAL static args — the jit cache never hides this
+    because the capture happens before dispatch)."""
+    seen = []
+    real = gb._groupby
+
+    def spy(cols, dtypes, key_ordinals, aggs, num_rows, live_mask=None,
+            key_ranges=None, dense_ok=True):
+        key_has_v = tuple(cols[o][1] is not None for o in key_ordinals)
+        dense = dense_ok and gb._dense_layout(
+            list(dtypes), list(key_ordinals), key_ranges,
+            key_has_v) is not None
+        seen.append("dense" if dense else "sort")
+        return real(cols, dtypes, key_ordinals, aggs, num_rows,
+                    live_mask=live_mask, key_ranges=key_ranges,
+                    dense_ok=dense_ok)
+
+    gb._groupby = spy
+    try:
+        fn()
+    finally:
+        gb._groupby = real
+    return seen
+
+
+@pytest.mark.parametrize("vdtype,expect", [
+    (dt.FLOAT32, "sort"), (dt.FLOAT64, "sort")])
+def test_grouping_set_gating_float_sum_forces_sort(vdtype, expect):
+    """dense_ok=False (the grouping-set caller) + a FLOAT sum must take
+    the sort path even when the key span is dense-eligible: the dense
+    sweep's reduction tree is position-dependent and would split
+    rank()-over-sum ties across ROLLUP levels (ops/groupby.py:123-144)."""
+    rng = np.random.default_rng(3)
+    b = _make_batch(rng, 1000, 64, vdtype, with_stats=True)
+    seen = _spy_paths(lambda: gb.groupby_aggregate(
+        b, [0], [AggSpec("sum", 1)], [dt.INT64, vdtype],
+        dense_ok=False))
+    assert seen == [expect]
+
+
+def test_grouping_set_gating_order_insensitive_keeps_dense():
+    """dense_ok=False with ONLY order-insensitive aggregates flips back
+    to the dense path (the gate suppresses order-SENSITIVE float
+    reductions, not the kernel): integer sums, counts and min/max are
+    exact on any reduction tree."""
+    rng = np.random.default_rng(4)
+    n = 1000
+    keys = rng.integers(0, 64, n).astype(np.int64)
+    ivals = rng.integers(-100, 100, n).astype(np.int64)
+    kcol = Column.from_numpy(keys)
+    kcol.stats = (0, 63)
+    b = ColumnarBatch([kcol, Column.from_numpy(ivals)], n)
+    seen = _spy_paths(lambda: gb.groupby_aggregate(
+        b, [0], [AggSpec("sum", 1), AggSpec("min", 1),
+                 AggSpec("count_star")], [dt.INT64, dt.INT64],
+        dense_ok=False))
+    assert seen == ["dense"]
+    # ...but a float min/max stays order-insensitive too: float min
+    # with dense_ok=False also keeps the dense kernel
+    b2 = _make_batch(rng, 1000, 64, dt.FLOAT64, with_stats=True)
+    seen2 = _spy_paths(lambda: gb.groupby_aggregate(
+        b2, [0], [AggSpec("min", 1), AggSpec("max", 1)],
+        [dt.INT64, dt.FLOAT64], dense_ok=False))
+    assert seen2 == ["dense"]
+
+
+def test_order_sensitive_float_sum_paths_both_run():
+    """Sanity on the split the gate exists for: a float sum across the
+    two kernels agrees to tolerance (NOT necessarily bitwise — that is
+    exactly why grouping sets pin one path) and count/min/max remain
+    bit-exact alongside."""
+    rng = np.random.default_rng(5)
+    n = 4000
+    dtypes = [dt.INT64, dt.FLOAT64]
+    aggs = [AggSpec("sum", 1), AggSpec("min", 1), AggSpec("count", 1)]
+    b_dense = _make_batch(rng, n, 32, dt.FLOAT64, with_stats=True)
+    # scrub NaN for the tolerance compare (NaN != NaN)
+    import jax
+
+    vals = np.asarray(jax.device_get(b_dense.columns[1].data)).copy()
+    vals[np.isnan(vals)] = 1.25
+    b_dense.columns[1] = Column(dt.FLOAT64, vals,
+                                b_dense.columns[1].validity)
+    b_sort = ColumnarBatch(list(b_dense.columns), n)
+    b_sort.columns[0] = Column(dt.INT64, b_dense.columns[0].data,
+                               b_dense.columns[0].validity)
+    out_d, _ = gb.groupby_aggregate(b_dense, [0], aggs, dtypes)
+    out_s, _ = gb.groupby_aggregate(b_sort, [0], aggs, dtypes)
+    rows_d = _rows(out_d, len(aggs))
+    rows_s = _rows(out_s, len(aggs))
+    assert rows_d.keys() == rows_s.keys()
+    for k in rows_d:
+        sd, ss = rows_d[k][0], rows_s[k][0]
+        assert sd[1] == ss[1]  # validity agrees
+        if sd[1]:
+            np.testing.assert_allclose(
+                np.uint64(sd[0]).view(np.float64),
+                np.uint64(ss[0]).view(np.float64), rtol=1e-9)
+        assert rows_d[k][1:] == rows_s[k][1:]  # min/count bit-exact

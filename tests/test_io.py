@@ -180,6 +180,42 @@ def test_csv_scan_with_schema(tmp_path):
     assert_cpu_and_tpu_equal(plan)
 
 
+def test_csv_timestamp_gate(tmp_path):
+    """CSV TIMESTAMP compat gate (the reference's csvTimestamps.enabled,
+    RapidsConf.scala:482): off -> the scan is refused with a tagging
+    reason; on -> only the configured formats parse (as UTC storage)."""
+    path = tmp_path / "ts.csv"
+    path.write_text("t,v\n2020-01-01T10:00:00,1\n"
+                    "2020-01-02 11:30:00,2\n")
+    schema = Schema(["t", "v"], [dt.TIMESTAMP, dt.INT64])
+
+    # default (gate off): planner refuses the scan with a reason, and
+    # the query still RUNS via the CPU fallback (permissive arrow
+    # parsers — the Spark-CPU-semantics stand-in)
+    from spark_rapids_tpu.plan.overrides import explain
+
+    plan = pn.ScanNode(CsvSource(str(path), schema=schema))
+    assert "csv.read.timestamps.enabled" in explain(plan, RapidsConf())
+    fell_back = collect(apply_overrides(plan, RapidsConf()))
+    assert len(fell_back) == 2
+
+    # gate on: both default formats parse, values are UTC micros
+    conf = RapidsConf({cfg.CSV_TIMESTAMPS_ENABLED.key: True})
+    src = CsvSource(str(path), schema=schema, conf=conf)
+    out = collect(apply_overrides(pn.ScanNode(src), conf))
+    want = [int(pd.Timestamp(x).value) // 1000
+            for x in ("2020-01-01 10:00:00", "2020-01-02 11:30:00")]
+    assert out["t"].tolist() == want
+
+    # a format outside the configured list fails loudly (FAILFAST),
+    # never silently shifts
+    bad = tmp_path / "bad.csv"
+    bad.write_text("t,v\n01/02/2020 10:00,1\n")
+    with pytest.raises(Exception, match="(?i)convert|invalid"):
+        CsvSource(str(bad), schema=schema,
+                  conf=conf).read_host_split(0)
+
+
 def test_csv_inferred_schema(tmp_path):
     path = tmp_path / "inf.csv"
     pd.DataFrame({"x": [10, 20], "y": ["a", "b"]}).to_csv(path,

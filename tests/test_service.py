@@ -1,0 +1,465 @@
+"""Concurrent query service (service/): admission control, fair stage
+scheduling, backpressure — the acceptance suite of the multi-tenant
+subsystem. Smoke tier; everything runs on the virtual CPU mesh."""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.api import Session, col, functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory import semaphore as sem
+from spark_rapids_tpu.memory.catalog import (BufferCatalog, get_catalog,
+                                             set_buffer_owner)
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.service import (DeadlineExceeded, QueryService,
+                                      QueryState, ServiceOverloaded)
+from spark_rapids_tpu.service.admission import parse_fairness_weights
+
+
+def _frame(rng, n=4000, nk=12):
+    return pd.DataFrame({
+        "k": rng.integers(0, nk, n).astype(np.int64),
+        "v": rng.random(n)})
+
+
+def _agg_query(s, df):
+    return df.filter(col("v") > 0.2).group_by("k").agg(
+        F.sum(col("v")).alias("sv"), F.count("*").alias("n"))
+
+
+def _sorted(frame):
+    return frame.sort_values("k").reset_index(drop=True)
+
+
+class GateSource(pn.DataSource):
+    """Multi-split source whose reads block on per-split events —
+    deterministic control over how long a query's stages run."""
+
+    def __init__(self, n_splits=2, rows=200, open_all=False):
+        self.n = n_splits
+        self.rows = rows
+        self.gates = [threading.Event() for _ in range(n_splits)]
+        if open_all:
+            for g in self.gates:
+                g.set()
+
+    def schema(self):
+        return Schema(["k", "v"], [dt.INT64, dt.FLOAT64])
+
+    def num_splits(self):
+        return self.n
+
+    def split_origin(self, p):
+        return None
+
+    def split_stats(self, p):
+        return None
+
+    def estimated_row_count(self):
+        return self.n * self.rows
+
+    def read_host_split(self, p):
+        assert self.gates[p].wait(timeout=30), f"gate {p} never opened"
+        rng = np.random.default_rng(p)
+        return ({"k": rng.integers(0, 8, self.rows).astype(np.int64),
+                 "v": rng.random(self.rows)},
+                {"k": None, "v": None})
+
+
+# -- (a) concurrent correctness ---------------------------------------------
+
+
+def test_concurrent_submissions_match_serial():
+    """8+ concurrently submitted queries all complete, each
+    oracle-matched against its own serial collect()."""
+    s = Session({"rapids.tpu.sql.shuffle.partitions": 2})
+    rng = np.random.default_rng(7)
+    df_a = s.create_dataframe(_frame(rng))
+    df_b = s.create_dataframe(_frame(rng, n=3000, nk=5))
+    qa, qb = _agg_query(s, df_a), _agg_query(s, df_b)
+    serial = {"a": _sorted(qa.collect()), "b": _sorted(qb.collect())}
+    handles = [(which, (qa if which == "a" else qb).collect_async(
+        tenant=f"t{i % 3}"))
+        for i, which in enumerate("abababab")]
+    assert len(handles) >= 8
+    for which, h in handles:
+        got = _sorted(h.result(timeout=120))
+        pd.testing.assert_frame_equal(got, serial[which])
+        assert h.poll() is QueryState.DONE
+    stats = s.service.stats()
+    assert stats.counters["done"] >= 8
+    assert stats.counters["failed"] == 0
+    s.stop()
+
+
+def test_sql_async_path():
+    s = Session()
+    rng = np.random.default_rng(1)
+    s.create_temp_view("t", s.create_dataframe(_frame(rng)))
+    want = _sorted(
+        s.sql("SELECT k, sum(v) AS sv FROM t GROUP BY k").collect())
+    h = s.sql_async("SELECT k, sum(v) AS sv FROM t GROUP BY k",
+                    tenant="sqltenant")
+    got = _sorted(h.result(timeout=60))
+    pd.testing.assert_frame_equal(got, want)
+    s.stop()
+
+
+# -- (b) admission bounds HBM ------------------------------------------------
+
+
+def test_admission_bounds_hbm_budget():
+    """Two queries whose combined footprint exceeds the budget never
+    run together: the second WAITS (QUEUED) while the first is
+    inflight, then runs — nothing is rejected and nothing OOMs."""
+    src1, src2 = GateSource(1), GateSource(1, open_all=True)
+    plan1 = pn.ScanNode(src1)
+    plan2 = pn.ScanNode(src2)
+    svc = QueryService(RapidsConf({
+        cfg.SERVICE_MAX_CONCURRENT.key: 4,
+        # footprint = 200 rows * (8+1 + 8+1) bytes = 3600; one fits,
+        # two do not
+        cfg.SERVICE_ADMISSION_BUDGET.key: 5000}))
+    h1 = svc.submit(plan1, tenant="a")
+    h2 = svc.submit(plan2, tenant="b")
+    deadline = time.time() + 5
+    while h1.poll() not in (QueryState.RUNNING,) and \
+            time.time() < deadline:
+        time.sleep(0.01)
+    # q1 blocked inside its gated scan, holding its admission charge:
+    # q2 must be waiting at admission, not running
+    time.sleep(0.2)
+    assert h1.poll() in (QueryState.RUNNING, QueryState.ADMITTED)
+    assert h2.poll() is QueryState.QUEUED
+    assert svc.admission.inflight_bytes <= svc.admission.budget_bytes
+    src1.gates[0].set()
+    assert len(h1.result(timeout=30)) == 200
+    assert len(h2.result(timeout=30)) == 200  # admitted after release
+    assert svc.admission.inflight_bytes == 0
+    svc.shutdown()
+
+
+def test_footprint_estimate_monotone():
+    from spark_rapids_tpu.plan.optimizer import estimate_footprint_bytes
+
+    small = pn.ScanNode(GateSource(1, rows=100))
+    big = pn.ScanNode(GateSource(1, rows=100000))
+    assert estimate_footprint_bytes(big) > \
+        estimate_footprint_bytes(small) > 0
+    # unknown-cardinality plans fall back to the configured default
+    class _NoEst(GateSource):
+        def estimated_row_count(self):
+            return None
+    assert estimate_footprint_bytes(pn.ScanNode(_NoEst(1)),
+                                    default_rows=1000) == \
+        estimate_footprint_bytes(pn.ScanNode(GateSource(1, rows=1000)))
+
+
+# -- (c) tenant fairness -----------------------------------------------------
+
+
+def test_tenant_fairness_no_starvation():
+    """Tenant A floods 10 queries; tenant B submits 1. WRR admission
+    puts B near the front — B finishes before all but the first couple
+    of A's queries instead of queueing behind all 10."""
+    s = Session()
+    rng = np.random.default_rng(3)
+    q = _agg_query(s, s.create_dataframe(_frame(rng, n=20000)))
+    svc = QueryService(RapidsConf({cfg.SERVICE_MAX_CONCURRENT.key: 1}),
+                       session=s)
+    a_handles = [svc.submit(q, tenant="A") for _ in range(10)]
+    b_handle = svc.submit(q, tenant="B")
+    b_handle.result(timeout=120)
+    for h in a_handles:
+        h.result(timeout=120)
+    b_done = b_handle._query.finished_at
+    a_before_b = sum(h._query.finished_at < b_done for h in a_handles)
+    assert a_before_b <= 3, \
+        f"tenant B starved: {a_before_b} of A's queries finished first"
+    # and B's queue time is bounded by a few of A's runs, not all 10
+    a_total_run = sum(h._query.run_time_s() for h in a_handles)
+    assert b_handle._query.queue_time_s() < a_total_run
+    svc.shutdown()
+    s.stop()
+
+
+def test_fairness_weight_parsing():
+    assert parse_fairness_weights("a:2, b:1") == {"a": 2, "b": 1}
+    assert parse_fairness_weights("") == {}
+    assert parse_fairness_weights("junk,x:notint,y:3") == {"y": 3}
+
+
+# -- (d) cancel / deadline release resources --------------------------------
+
+
+def _leak_probe_plan():
+    """Scan -> repartition -> groupby: the exchange stages catalog
+    buffers mid-query, so an abandoned run WOULD leak without the
+    owner cleanup."""
+    src = GateSource(2, rows=500)
+    scan = pn.ScanNode(src)
+    shuffled = pn.ShuffleExchangeNode(("hash", [0]), 2, scan)
+    from spark_rapids_tpu.expressions.base import BoundReference
+    from spark_rapids_tpu.expressions import aggregates as A
+
+    agg = pn.AggregateNode(
+        [BoundReference(0, dt.INT64)],
+        [pn.AggCall(A.Sum(BoundReference(1, dt.FLOAT64)), "sv")],
+        shuffled, grouping_names=["k"])
+    return src, agg
+
+
+def test_cancel_releases_permits_and_buffers():
+    src, plan = _leak_probe_plan()
+    src.gates[0].set()
+    svc = QueryService(RapidsConf({cfg.SERVICE_MAX_CONCURRENT.key: 2}))
+    h = svc.submit(plan, tenant="c")
+    q = h._query
+    deadline = time.time() + 10
+    while q.slices_done < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert h.cancel()
+    src.gates[1].set()  # let the blocked stage finish so cancel lands
+    with pytest.raises(Exception) as ei:
+        h.result(timeout=30)
+    assert type(ei.value).__name__ == "QueryCancelled"
+    assert h.poll() is QueryState.CANCELLED
+    # no catalog leak: every buffer the query registered is gone
+    assert get_catalog().owner_refcounts(q.owner_tag) == {}
+    # no permit leak
+    semaphore = sem.get()
+    assert semaphore.available() == semaphore.max_permits
+    svc.shutdown()
+
+
+def test_deadline_expiry_releases_resources():
+    src, plan = _leak_probe_plan()
+    src.gates[0].set()
+    svc = QueryService(RapidsConf({cfg.SERVICE_MAX_CONCURRENT.key: 2}))
+    h = svc.submit(plan, tenant="d", deadline=0.3)
+    q = h._query
+    # gate 1 opens only AFTER the deadline: the slice in flight finishes
+    # late and the next boundary check expires the query
+    threading.Timer(0.6, src.gates[1].set).start()
+    with pytest.raises(DeadlineExceeded):
+        h.result(timeout=30)
+    assert h.poll() is QueryState.FAILED
+    assert get_catalog().owner_refcounts(q.owner_tag) == {}
+    semaphore = sem.get()
+    assert semaphore.available() == semaphore.max_permits
+    stats = svc.stats()
+    assert stats.counters["deadline_expired"] == 1
+    svc.shutdown()
+
+
+def test_deadline_expires_while_queued():
+    """A queued query past its deadline fails lazily without ever
+    being admitted (no resources to release)."""
+    src, _ = _leak_probe_plan()
+    blocker_src = GateSource(1)
+    svc = QueryService(RapidsConf({cfg.SERVICE_MAX_CONCURRENT.key: 1}))
+    h1 = svc.submit(pn.ScanNode(blocker_src), tenant="x")
+    h2 = svc.submit(pn.ScanNode(GateSource(1, open_all=True)),
+                    tenant="y", deadline=0.15)
+    time.sleep(0.3)
+    assert h2.poll() is QueryState.FAILED
+    with pytest.raises(DeadlineExceeded):
+        h2.result(timeout=5)
+    blocker_src.gates[0].set()
+    h1.result(timeout=30)
+    svc.shutdown()
+
+
+# -- (e) shedding + stats ----------------------------------------------------
+
+
+def test_overload_sheds_instead_of_deadlocking():
+    blocker = GateSource(1)
+    svc = QueryService(RapidsConf({
+        cfg.SERVICE_MAX_CONCURRENT.key: 1,
+        cfg.SERVICE_QUEUE_LIMIT.key: 2}))
+    h1 = svc.submit(pn.ScanNode(blocker), tenant="t")
+    waiting = [svc.submit(pn.ScanNode(GateSource(1, open_all=True)),
+                          tenant="t") for _ in range(2)]
+    with pytest.raises(ServiceOverloaded) as ei:
+        svc.submit(pn.ScanNode(GateSource(1, open_all=True)),
+                   tenant="t")
+    assert ei.value.queue_depth == 2
+    assert ei.value.queue_limit == 2
+    assert ei.value.tenant == "t"
+    stats = svc.stats()
+    assert stats.queue_depth == 2
+    assert stats.counters["shed"] == 1
+    assert "hit_rate" in stats.to_dict()["progcache"]
+    # shedding didn't wedge the service: everything queued completes
+    blocker.gates[0].set()
+    h1.result(timeout=30)
+    for h in waiting:
+        h.result(timeout=30)
+    assert svc.stats().queue_depth == 0
+    svc.shutdown()
+
+
+def test_shed_band_spares_light_tenant():
+    """Between queueLimit and 2x, only tenants at/above their fair
+    share shed: a flooding tenant cannot fill every queue slot and
+    starve a light tenant at the front door. At the 2x hard ceiling
+    everybody sheds."""
+    blocker = GateSource(1)
+    svc = QueryService(RapidsConf({
+        cfg.SERVICE_MAX_CONCURRENT.key: 1,
+        cfg.SERVICE_QUEUE_LIMIT.key: 2}))
+    h1 = svc.submit(pn.ScanNode(blocker), tenant="flood")
+    flood = [svc.submit(pn.ScanNode(GateSource(1, open_all=True)),
+                        tenant="flood") for _ in range(2)]
+    # queue full (2/2) entirely with tenant "flood": flood sheds...
+    with pytest.raises(ServiceOverloaded):
+        svc.submit(pn.ScanNode(GateSource(1, open_all=True)),
+                   tenant="flood")
+    # ...but a light tenant's first submission still gets in
+    light = svc.submit(pn.ScanNode(GateSource(1, open_all=True)),
+                       tenant="light")
+    # beyond the 2x hard ceiling even new tenants shed
+    spill = []
+    with pytest.raises(ServiceOverloaded):
+        for i in range(10):
+            spill.append(svc.submit(
+                pn.ScanNode(GateSource(1, open_all=True)),
+                tenant=f"fresh{i}"))
+    assert svc.stats().queue_depth <= 2 * 2
+    blocker.gates[0].set()
+    h1.result(timeout=30)
+    for h in flood + [light] + spill:
+        h.result(timeout=30)
+    svc.shutdown()
+
+
+def test_service_stats_in_bench_json(tmp_path):
+    """benchmarks/service_bench.py emits runner-shaped JSON with the
+    ServiceStats block: queue depth, shed count, progcache hit rate,
+    per-query queue-time vs run-time."""
+    from spark_rapids_tpu.benchmarks.service_bench import \
+        run_service_bench
+
+    out = run_service_bench(str(tmp_path / "tpch"), sf=0.001,
+                            queries=4, mix=["tpch_q6", "tpch_q1"],
+                            tenants=2)
+    assert out["concurrent_queries"] == 4
+    assert len(out["per_query"]) == 4
+    for rec in out["per_query"]:
+        assert rec["queue_time_s"] >= 0
+        assert rec["run_time_s"] >= 0
+    ss = out["service_stats"]
+    assert ss["queue_depth"] == 0
+    assert ss["counters"]["done"] == 4
+    assert ss["counters"]["shed"] == 0
+    assert "hit_rate" in ss["progcache"]
+    assert ss["queue_time_hist"]["count"] == 4
+    # the multi-tenant win: repeated shapes share compiled programs
+    assert ss["progcache"]["hits"] >= 0
+
+
+# -- scheduler internals -----------------------------------------------------
+
+
+def test_stalled_query_spill_demotion():
+    """Buffers owned by a stalled query out-rank everything as spill
+    victims; resuming restores their priority."""
+    from spark_rapids_tpu.memory import priorities
+    from spark_rapids_tpu.memory.spillable import SpillableBatch
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.service.scheduler import STALLED_SPILL_BIAS
+
+    cat = BufferCatalog()
+
+    def batch():
+        return ColumnarBatch(
+            [Column.from_numpy(np.arange(100, dtype=np.int64))], 100)
+
+    prev = set_buffer_owner(("svc-query", 42))
+    sb_stalled = SpillableBatch(batch(),
+                                priorities.ACTIVE_ON_DECK_PRIORITY,
+                                catalog=cat)
+    set_buffer_owner(None)
+    sb_other = SpillableBatch(batch(),
+                              priorities.OUTPUT_FOR_SHUFFLE_PRIORITY,
+                              catalog=cat)
+    set_buffer_owner(prev)
+    # normally the shuffle-output buffer (priority 0) spills first
+    assert cat._pick_spill_victim(
+        cat.tier_of(sb_other.buffer_id)).buffer_id == sb_other.buffer_id
+    cat._requeue(cat._entries[sb_other.buffer_id])
+    # demoted: the stalled query's ACTIVE buffer becomes the victim
+    assert cat.set_owner_bias(("svc-query", 42),
+                              STALLED_SPILL_BIAS) == 1
+    assert cat._pick_spill_victim(
+        cat.tier_of(sb_stalled.buffer_id)).buffer_id == \
+        sb_stalled.buffer_id
+    cat._requeue(cat._entries[sb_stalled.buffer_id])
+    # restored on resume
+    cat.set_owner_bias(("svc-query", 42), 0)
+    assert cat._pick_spill_victim(
+        cat.tier_of(sb_other.buffer_id)).buffer_id == sb_other.buffer_id
+    # owner bookkeeping
+    assert set(cat.owner_refcounts(("svc-query", 42))) == \
+        {sb_stalled.buffer_id}
+    assert cat.remove_owner(("svc-query", 42)) == 1
+    assert cat.owner_refcounts(("svc-query", 42)) == {}
+
+
+def test_shutdown_finalizes_inflight_queries():
+    """shutdown() must terminate RUNNING/ADMITTED queries itself —
+    workers are gone, so no future slice will observe the cancel flag;
+    a result() waiter must not hang and resources must release."""
+    src = GateSource(2)
+    src.gates[0].set()
+    svc = QueryService(RapidsConf({cfg.SERVICE_MAX_CONCURRENT.key: 2}))
+    h = svc.submit(pn.ScanNode(src), tenant="z")
+    q = h._query
+    deadline = time.time() + 10
+    while q.slices_done < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    src.gates[1].set()  # let the in-flight slice drain during join()
+    svc.shutdown()
+    assert h.poll() is QueryState.CANCELLED
+    with pytest.raises(Exception):
+        h.result(timeout=5)
+    assert get_catalog().owner_refcounts(q.owner_tag) == {}
+
+
+def test_owner_tag_propagates_to_task_pool_threads():
+    """Batches registered from exec-internal task-pool threads (e.g.
+    exchange materialization under run_partitions) must carry the
+    submitting query's owner tag, or cancel cleanup would miss them."""
+    from spark_rapids_tpu.execs.base import run_partitions
+    from spark_rapids_tpu.memory.catalog import current_buffer_owner
+
+    tag = ("svc-query", 777)
+    prev = set_buffer_owner(tag)
+    try:
+        seen = run_partitions(4, lambda p: current_buffer_owner(),
+                              task_threads=4)
+    finally:
+        set_buffer_owner(prev)
+    assert seen == [tag] * 4
+
+
+def test_query_failure_propagates():
+    class BoomSource(GateSource):
+        def read_host_split(self, p):
+            raise RuntimeError("boom in stage")
+
+    svc = QueryService(RapidsConf({}))
+    h = svc.submit(pn.ScanNode(BoomSource(1)), tenant="e")
+    with pytest.raises(RuntimeError, match="boom in stage"):
+        h.result(timeout=30)
+    assert h.poll() is QueryState.FAILED
+    assert svc.stats().counters["failed"] == 1
+    svc.shutdown()
