@@ -1,0 +1,230 @@
+"""Chaos regression fence for the OOM-resilience subsystem (CLI twin of
+tests/test_chaos.py, which runs the same scenarios under the `chaos`
+pytest marker in tier-1).
+
+Runs a q5lite/q26-class query suite three ways and asserts oracle
+parity plus the counters that prove the machinery actually fired:
+
+  1. tiny-budget : device budget = working set / 4, host tier halved —
+                   must complete through the disk spill chain
+                   (spilled_device/host bytes > 0),
+  2. injected    : deterministic RESOURCE_EXHAUSTED at the aggregate +
+                   join sites, bursts long enough to force splits —
+                   must complete with retries >= 2 and splits >= 1,
+  3. seeded-sweep: probabilistic injection over every guarded site,
+                   bounded by --sweep-injections.
+
+    python scripts/chaos_check.py [--rows 150000] [--seed 11]
+                                  [--sweep-probability 1.0]
+                                  [--sweep-injections 2]
+
+Prints one JSON report; exit code 0 = fence holds.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+
+def _data(rows: int, seed: int):
+    rng = np.random.default_rng(seed)
+    n_dim = 64
+    fact = pd.DataFrame({
+        "k": rng.integers(0, n_dim, rows).astype(np.int64),
+        "v": rng.random(rows),
+        "w": rng.integers(0, 1000, rows).astype(np.int64)})
+    dim = pd.DataFrame({
+        "k": np.arange(n_dim, dtype=np.int64),
+        "cat": (np.arange(n_dim, dtype=np.int64) % 7)})
+    return fact, dim
+
+
+def _q26_class(s, fact, dim):
+    from spark_rapids_tpu.api import col, functions as F
+
+    return (s.create_dataframe(fact)
+            .join(s.create_dataframe(dim), on="k")
+            .filter(col("v") > 0.2)
+            .group_by("cat")
+            .agg(F.sum(col("v")).alias("sv"),
+                 F.count("*").alias("n"))
+            .order_by("cat"))
+
+
+def _sort_q(s, fact, dim):
+    from spark_rapids_tpu.api import col
+
+    return (s.create_dataframe(fact)
+            .join(s.create_dataframe(dim), on="k")
+            .filter(col("v") > 0.2)
+            .order_by("w", "k", "cat", "v"))
+
+
+def _agg_oracle(fact, dim):
+    j = fact.merge(dim, on="k")
+    j = j[j["v"] > 0.2]
+    return (j.groupby("cat").agg(sv=("v", "sum"), n=("v", "size"))
+            .reset_index().sort_values("cat").reset_index(drop=True))
+
+
+def _sort_oracle(fact, dim):
+    j = fact.merge(dim, on="k")
+    return (j[j["v"] > 0.2]
+            .sort_values(["w", "k", "cat", "v"], kind="stable")
+            .reset_index(drop=True))
+
+
+def _frames_equal(got, want, float_cols=("sv",)) -> str:
+    got = got.reset_index(drop=True)[list(want.columns)]
+    if len(got) != len(want):
+        return f"row count {len(got)} != {len(want)}"
+    for c in want.columns:
+        a, b = got[c].to_numpy(), want[c].to_numpy()
+        try:
+            if c in float_cols:
+                np.testing.assert_allclose(a.astype(float),
+                                           b.astype(float), rtol=1e-9)
+            else:
+                np.testing.assert_array_equal(a, b)
+        except AssertionError as e:
+            return f"column {c}: {str(e)[:200]}"
+    return ""
+
+
+def check_tiny_budget(rows: int, seed: int) -> dict:
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.plan.optimizer import estimate_footprint_bytes
+
+    fact, dim = _data(rows, seed)
+    probe = Session()
+    footprint = estimate_footprint_bytes(
+        _sort_q(probe, fact, dim)._plan)
+    staged = int(rows * 0.8) * (8 + 8 + 8 + 8 + 4)
+    budget = min(footprint // 4, staged // 2)
+    spill_dir = tempfile.mkdtemp(prefix="chaos-spill-")
+    s = Session({
+        cfg.DEVICE_BUDGET.key: budget,
+        cfg.HOST_SPILL_STORAGE_SIZE.key: max(budget // 2, 1 << 16),
+        cfg.SPILL_DIR.key: spill_dir,
+    }, initialize_runtime=True)
+    try:
+        got = _sort_q(s, fact, dim).collect()
+        cat = s.runtime.catalog
+        cat.flush_spills()
+        mismatch = _frames_equal(got, _sort_oracle(fact, dim),
+                                 float_cols=("v",))
+        rec = {
+            "footprint_bytes": footprint,
+            "device_budget": budget,
+            "over_budget_factor": round(footprint / budget, 2),
+            "spilled_device_bytes": cat.spilled_device_bytes,
+            "spilled_host_bytes": cat.spilled_host_bytes,
+            "matches_cpu": not mismatch,
+            "detail": mismatch,
+        }
+        rec["ok"] = (not mismatch and footprint >= 4 * budget and
+                     cat.spilled_device_bytes > 0 and
+                     cat.spilled_host_bytes > 0)
+        return rec
+    finally:
+        s.stop()
+
+
+def check_injected(rows: int, seed: int) -> dict:
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.memory import fault_injection as FI
+    from spark_rapids_tpu.memory import retry as R
+
+    fact, dim = _data(min(rows, 40_000), seed + 1)
+    s = Session()
+    FI.arm_from_conf(RapidsConf({
+        cfg.FAULT_INJECTION_ENABLED.key: True,
+        cfg.FAULT_INJECTION_AT_CALL.key: 1,
+        cfg.FAULT_INJECTION_SITES.key: "aggregate.update,join.probe",
+        cfg.FAULT_INJECTION_CONSECUTIVE.key: 3,
+        cfg.FAULT_INJECTION_MAX.key: 6,
+    }))
+    try:
+        pre = R.snapshot()
+        got = _q26_class(s, fact, dim).collect()
+        d = R.delta(pre)
+        mismatch = _frames_equal(got, _agg_oracle(fact, dim))
+        rec = {"retry": d,
+               "injector": FI.get_injector().stats(),
+               "matches_cpu": not mismatch, "detail": mismatch}
+        rec["ok"] = (not mismatch and d["oom_retries"] >= 2 and
+                     d["oom_splits"] >= 1 and d["gave_ups"] == 0 and
+                     rec["injector"]["injections"] > 0)
+        return rec
+    finally:
+        FI.get_injector().disarm()
+        s.stop()
+
+
+def check_sweep(rows: int, seed: int, probability: float,
+                max_injections: int) -> dict:
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.memory import fault_injection as FI
+
+    fact, dim = _data(min(rows, 40_000), seed + 2)
+    s = Session()
+    FI.get_injector().arm(probability=probability, seed=seed,
+                          consecutive=1,
+                          max_injections=max_injections)
+    try:
+        got = _q26_class(s, fact, dim).collect()
+        mismatch = _frames_equal(got, _agg_oracle(fact, dim))
+        rec = {"injector": FI.get_injector().stats(),
+               "matches_cpu": not mismatch, "detail": mismatch}
+        rec["ok"] = not mismatch
+        return rec
+    finally:
+        FI.get_injector().disarm()
+        s.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", type=int, default=150_000,
+                   help="fact-table rows for the tiny-budget sort "
+                        "fence (must exceed the 65536-row sort budget "
+                        "floor to exercise the out-of-core path)")
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--sweep-probability", type=float, default=1.0)
+    p.add_argument("--sweep-injections", type=int, default=2,
+                   help="total injections in the probabilistic sweep; "
+                        "keep below the spill-rung count to stay away "
+                        "from give-up on no-split sites")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+
+    report = {
+        "tiny_budget": check_tiny_budget(args.rows, args.seed),
+        "injected": check_injected(args.rows, args.seed),
+        "seeded_sweep": check_sweep(args.rows, args.seed,
+                                    args.sweep_probability,
+                                    args.sweep_injections),
+    }
+    report["ok"] = all(r["ok"] for r in report.values()
+                       if isinstance(r, dict))
+    text = json.dumps(report, indent=2, default=str)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

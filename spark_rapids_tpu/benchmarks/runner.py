@@ -93,24 +93,59 @@ class BenchmarkRunner:
             "env": self._env(),
             "iterations": [],
         }
+        from spark_rapids_tpu.memory import fault_injection as _fi
+        from spark_rapids_tpu.memory import retry as _retry
+        from spark_rapids_tpu.memory.catalog import get_catalog
         from spark_rapids_tpu.utils import dispatch as disp
 
         telemetry = disp.installed()
         df = None
         pre_stage = None
+        # run-relative snapshots: totals, per-site map, catalog spill
+        # counters and injector counts all report DELTAS over this run
+        # — a second benchmark in the same process must not inherit the
+        # first one's OOM activity in its report
+        run_pre_retry = _retry.snapshot()
+        run_pre_sites = _retry.stats()["per_site"]
+        cat = get_catalog()
+        pre_spill_dev = cat.spilled_device_bytes
+        pre_spill_host = cat.spilled_host_bytes
+        pre_inj = _fi.get_injector().stats()
         for i in range(warmup + iterations):
             plan = plan_fn(self.data_dir)  # fresh plan: no cached blocks
             exec_ = apply_overrides(plan, self.conf)
             pre = disp.snapshot() if telemetry else None
             pre_stage = disp.stage_snapshot() if telemetry else None
+            pre_retry = _retry.snapshot()
             t0 = time.perf_counter()
             df = collect(exec_)
             elapsed = time.perf_counter() - t0
             if i >= warmup:
-                it_rec = {"time_sec": elapsed}
+                it_rec = {"time_sec": elapsed,
+                          "oom_retry": _retry.delta(pre_retry)}
                 if telemetry:
                     it_rec["dispatch"] = disp.delta(pre)
                 result["iterations"].append(it_rec)
+        # OOM-resilience accounting across the whole run: the retry
+        # ladder's per-site counters plus the spill catalog's tier
+        # traffic — nonzero numbers here are the proof an over-budget
+        # or fault-injected run actually exercised the machinery
+        run_retry = _retry.delta(run_pre_retry)
+        run_retry["per_site"] = _retry.site_delta(run_pre_sites)
+        inj = _fi.get_injector().stats()
+        result["memory"] = {
+            "oom_retry": run_retry,
+            "spilled_device_bytes": cat.spilled_device_bytes -
+            pre_spill_dev,
+            "spilled_host_bytes": cat.spilled_host_bytes -
+            pre_spill_host,
+            "device_budget": cat.device_budget,
+            "fault_injection": {
+                "armed": inj["armed"],
+                "calls": inj["calls"] - pre_inj["calls"],
+                "injections": inj["injections"] - pre_inj["injections"],
+            },
+        }
         if telemetry and result["iterations"]:
             # the BASELINE.md-promised split: dispatch_count x RTT vs
             # time actually spent computing on the device

@@ -91,6 +91,10 @@ def unwrap(data: bytes) -> bytes:
     mv = memoryview(data)
     if bytes(mv[:4]) != ENVELOPE_MAGIC:
         return data  # legacy/uncompressed stream
+    if len(mv) < 17:
+        raise ValueError(
+            f"truncated compression envelope: {len(mv)} bytes, header "
+            f"needs 17 (corrupted spill/shuffle payload)")
     codec_id, raw_len, crc = struct.unpack("<BQI", mv[4:17])
     body = bytes(mv[17:])
     if native.crc32c(body) != crc:

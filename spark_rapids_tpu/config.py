@@ -212,6 +212,83 @@ SPILL_DIR = conf("rapids.tpu.memory.spillDir").doc(
     "Directory for disk-tier spill files."
 ).string_conf.create_with_default("/tmp/rapids_tpu_spill")
 
+DEVICE_BUDGET = conf("rapids.tpu.memory.device.budget").doc(
+    "Explicit device-memory budget for the spill catalog in bytes; 0 "
+    "(the default) derives the budget from reported HBM "
+    "(allocFraction * HBM - reserve). Setting a deliberately tiny "
+    "value forces the out-of-core execution paths end to end — the "
+    "chaos regression fence runs real queries with a budget a quarter "
+    "of their working set."
+).bytes_conf.create_with_default(0)
+
+SPILL_ASYNC_WRITE = conf("rapids.tpu.memory.spill.asyncWrite.enabled").doc(
+    "Write host->disk spills on a double-buffered background writer "
+    "(compressed serialization overlaps compute; a spill storm "
+    "backpressures the evicting thread at the buffer depth) instead "
+    "of inline on the evicting thread."
+).boolean_conf.create_with_default(True)
+
+RETRY_MAX_SPILL_RETRIES = conf("rapids.tpu.memory.retry.maxSpillRetries").doc(
+    "Spill rungs of the OOM retry ladder before splitting/giving up: "
+    "rung 1 spills tracked device buffers to half, rung 2 spills "
+    "everything (DeviceMemoryEventHandler escalation analogue)."
+).int_conf.create_with_default(2)
+
+RETRY_MAX_SPLIT_DEPTH = conf("rapids.tpu.memory.retry.maxSplitDepth").doc(
+    "Maximum recursive input halvings after the spill rungs are "
+    "exhausted at a splittable call site (2^depth sub-batches at the "
+    "bound); past it the computation fails with SplitAndRetryOOM."
+).int_conf.create_with_default(8)
+
+FAULT_INJECTION_ENABLED = conf(
+    "rapids.tpu.memory.faultInjection.enabled").doc(
+    "Arm the deterministic device-OOM injector: guarded device "
+    "computations raise synthetic RESOURCE_EXHAUSTED per the "
+    "faultInjection.* trigger config, exercising the full retry "
+    "ladder (spill, spill-all, split, give-up) on any backend — "
+    "including CPU-only CI. Never enable in production."
+).boolean_conf.create_with_default(False)
+
+FAULT_INJECTION_AT_CALL = conf(
+    "rapids.tpu.memory.faultInjection.atCall").doc(
+    "Fail the Nth eligible guarded device call (counted from 1 across "
+    "the process, after the sites filter); 0 disables the "
+    "deterministic trigger."
+).int_conf.create_with_default(0)
+
+FAULT_INJECTION_SITES = conf(
+    "rapids.tpu.memory.faultInjection.sites").doc(
+    "Comma-separated call-site tags eligible for injection (prefix "
+    "match: 'join' hits join.probe and join.build.concat). Empty = "
+    "every guarded site."
+).string_conf.create_with_default("")
+
+FAULT_INJECTION_PROBABILITY = conf(
+    "rapids.tpu.memory.faultInjection.probability").doc(
+    "Per-guarded-call injection probability for seeded chaos sweeps "
+    "(0.0 disables). Reproducible via faultInjection.seed."
+).double_conf.create_with_default(0.0)
+
+FAULT_INJECTION_SEED = conf(
+    "rapids.tpu.memory.faultInjection.seed").doc(
+    "RNG seed for probabilistic injection — the same seed replays the "
+    "same failure sequence."
+).int_conf.create_with_default(0)
+
+FAULT_INJECTION_CONSECUTIVE = conf(
+    "rapids.tpu.memory.faultInjection.consecutive").doc(
+    "Guarded calls failed in a row per firing point. Values above "
+    "maxSpillRetries push the ladder past spill-and-retry into "
+    "split-and-retry, which is why the default is 3 (= the default 2 "
+    "spill rungs + 1)."
+).int_conf.create_with_default(3)
+
+FAULT_INJECTION_MAX = conf(
+    "rapids.tpu.memory.faultInjection.maxInjections").doc(
+    "Total injections cap (0 = unlimited) so probabilistic chaos runs "
+    "terminate."
+).int_conf.create_with_default(0)
+
 MEMORY_DEBUG = conf("rapids.tpu.memory.debug").doc(
     "Log every allocation/free (RMM debug-mode analogue, RapidsConf.scala:277)."
 ).boolean_conf.create_with_default(False)
@@ -547,6 +624,22 @@ SERVICE_DEFAULT_ROW_ESTIMATE = conf(
     "optimizer cannot estimate (no footer stats); feeds the admission "
     "footprint estimate."
 ).int_conf.create_with_default(1 << 20)
+
+SERVICE_OUT_OF_CORE = conf("rapids.tpu.service.outOfCore.enabled").doc(
+    "Admit a query whose estimated peak footprint exceeds the WHOLE "
+    "device budget in flagged out-of-core mode — planned with a "
+    "forced-splitting batch budget and eager spill priority, charged "
+    "a capped share of HBM — instead of parking it in the admission "
+    "queue until the device drains (or its deadline fires)."
+).boolean_conf.create_with_default(True)
+
+SERVICE_OUT_OF_CORE_POLICY = conf("rapids.tpu.service.outOfCore.policy").doc(
+    "What to do with an over-budget query when outOfCore.enabled: "
+    "'run' executes it out-of-core (splitting + spilling to disk); "
+    "'shed' rejects it at submit with a structured OutOfCoreRejected "
+    "— for deployments that prefer failing whales fast over letting "
+    "them occupy the device for a long spill-bound run."
+).string_conf.create_with_default("run")
 
 FILTER_PUSHDOWN_ENABLED = conf(
     "rapids.tpu.sql.format.pushDownFilters.enabled").doc(

@@ -180,21 +180,56 @@ class HashAggregateExec(TpuExec):
         return ok
 
     def _agg_batch(self, batch: ColumnarBatch, specs: List[AggSpec],
-                   types: List[dt.DType], live_mask=None
-                   ) -> ColumnarBatch:
-        from spark_rapids_tpu.memory.oom import with_oom_retry
+                   types: List[dt.DType], live_mask=None,
+                   site: str = "aggregate.update") -> ColumnarBatch:
+        """Aggregate one batch under the split-and-retry ladder: device
+        OOM first spills the catalog and retries (the RMM event
+        handler's spill-and-retry, DeviceMemoryEventHandler.scala:42),
+        then HALVES the input and aggregates the halves — valid because
+        partial aggregates re-merge with the merge ops, exactly what
+        the streaming loop does between batches anyway."""
+        from spark_rapids_tpu.memory import retry as _retry
 
         nkeys = len(self.grouping)
-        if nkeys == 0:
-            return with_oom_retry(
-                lambda: reduce_aggregate(batch, specs, types,
-                                         live_mask))[0]
-        # device OOM spills the catalog and retries (the RMM event
-        # handler's spill-and-retry, DeviceMemoryEventHandler.scala:42)
-        return with_oom_retry(
-            lambda: groupby_aggregate(batch, list(range(nkeys)), specs,
-                                      types, live_mask,
-                                      dense_ok=self._dense_ok()))[0]
+
+        def run(item):
+            b, m = item
+            if nkeys == 0:
+                return reduce_aggregate(b, specs, types, m)[0]
+            return groupby_aggregate(b, list(range(nkeys)), specs,
+                                     types, m,
+                                     dense_ok=self._dense_ok())[0]
+
+        def split(item):
+            b, m = item
+            if m is not None:
+                # the live-mask is capacity-aligned to THIS batch; a
+                # row-range half would need a matching mask slice at a
+                # rebucketed capacity — compact the survivors instead
+                # so the halves carry no mask at all
+                from spark_rapids_tpu.ops import filter as filt
+
+                b = rebucket(filt.compact_batch(b, m))
+            halves = _retry.halve_batch(b)
+            if halves is None:
+                return None
+            return [(h, None) for h in halves]
+
+        parts = _retry.with_retry((batch, live_mask), run, split=split,
+                                  tag=site)
+        out = parts[0]
+        for part in parts[1:]:
+            # the re-merge runs at the memory level that just OOM'd, so
+            # it goes through the ladder too: the concat under the
+            # spill rungs, the merge aggregate recursively guarded
+            # (splittable — merge ops are associative over partials)
+            merged_in = _retry.with_retry_no_split(
+                lambda o=out, p=part: concat_batches([o, p]),
+                tag="aggregate.merge.concat")
+            out = self._agg_batch(merged_in, self.merge_specs,
+                                  self._merge_types(),
+                                  site="aggregate.merge")
+        return out
 
     def _merge_types(self) -> List[dt.DType]:
         return [e.dtype for e in self.grouping] + self.partial_types
@@ -260,7 +295,8 @@ class HashAggregateExec(TpuExec):
                         merged_in = concat_batches([running, part])
                         running = self._agg_batch(merged_in,
                                                   self.merge_specs,
-                                                  self._merge_types())
+                                                  self._merge_types(),
+                                                  site="aggregate.merge")
             if running is None:
                 if self.grouping or (self.mode == "final" and not saw_input):
                     # grouped agg over empty input -> no rows (in the

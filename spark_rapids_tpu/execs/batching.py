@@ -70,9 +70,12 @@ def drain_to_single_batch(it: Iterator[ColumnarBatch], schema
         return ColumnarBatch.empty(schema)
     if len(batches) == 1:
         return batches[0]
-    from spark_rapids_tpu.memory.oom import with_oom_retry
+    from spark_rapids_tpu.memory.retry import with_retry_no_split
 
-    return with_oom_retry(lambda: concat_batches(batches))
+    # single-batch contract: only the spill rungs apply (halving the
+    # inputs cannot shrink the concatenated result)
+    return with_retry_no_split(lambda: concat_batches(batches),
+                               tag="coalesce.concat")
 
 
 def coalesce_iterator(it: Iterator[ColumnarBatch], goal: CoalesceGoal

@@ -108,7 +108,7 @@ class HashJoinExec(TpuExec):
     def _concat_staged(staged, schema) -> ColumnarBatch:
         from contextlib import ExitStack
 
-        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
         from spark_rapids_tpu.ops.concat import concat_batches
 
         if not staged:
@@ -116,18 +116,38 @@ class HashJoinExec(TpuExec):
         with ExitStack() as stack:
             parts = [stack.enter_context(sb.acquired()) for sb in staged]
             merged = parts[0] if len(parts) == 1 else \
-                with_oom_retry(lambda: concat_batches(parts))
+                with_retry_no_split(lambda: concat_batches(parts),
+                                    tag="join.build.concat")
         for sb in staged:
             sb.close()
         return merged
+
+    def _probe_retry(self, b: ColumnarBatch, build: ColumnarBatch,
+                     left_types, right_types, tag: str):
+        """Probe one stream batch under split-and-retry: the stream
+        side halves freely for every kind except full (a full join
+        emits unmatched BUILD rows once per probe call, so its single
+        stream batch must stay whole). Returns one output per final
+        sub-batch."""
+        from spark_rapids_tpu.memory import retry as _retry
+
+        split = _retry.halve_batch if self.kind != "full" else None
+        outs = _retry.with_retry(
+            b,
+            lambda bb: equi_join(bb, build, self.left_keys,
+                                 self.right_keys, left_types,
+                                 right_types,
+                                 join_type=_KIND_MAP[self.kind])[0],
+            split=split, tag=tag)
+        if self.condition is not None:
+            outs = [self.condition(out) for out in outs]
+        return outs
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         left_types = list(self.children[0].schema.types)
         right_types = list(self.children[1].schema.types)
 
         def it():
-            from spark_rapids_tpu.memory.oom import with_oom_retry
-
             build_staged, build_total = self._stage(1, partition)
             budget = self._budget_rows()
             if build_total > budget:
@@ -151,15 +171,10 @@ class HashJoinExec(TpuExec):
                     continue
                 saw = True
                 with TraceRange(f"HashJoinExec.{self.kind}"):
-                    out, _ = with_oom_retry(
-                        lambda b=b: equi_join(
-                            b, build, self.left_keys,
-                            self.right_keys, left_types,
-                            right_types,
-                            join_type=_KIND_MAP[self.kind]))
-                if self.condition is not None:
-                    out = self.condition(out)
-                yield out
+                    outs = self._probe_retry(b, build, left_types,
+                                             right_types,
+                                             tag="join.probe")
+                yield from outs
         return timed(self, it())
 
     def _bucket(self, staged, keys: List[int], types, n_buckets: int,
@@ -194,8 +209,6 @@ class HashJoinExec(TpuExec):
         bucket; left/full unmatched rows surface from their own bucket,
         each build row is in exactly one bucket so full-outer emits its
         unmatched rows exactly once."""
-        from spark_rapids_tpu.memory.oom import with_oom_retry
-
         # 2x headroom over the mean bucket absorbs hash skew
         n_buckets = max(-(-build_total // budget) * 2, 2)
         build_buckets = self._bucket(build_staged, self.right_keys,
@@ -217,15 +230,11 @@ class HashJoinExec(TpuExec):
             build_b = self._concat_staged(build_buckets[p],
                                           self.children[1].schema)
             with TraceRange(f"HashJoinExec.oob.{self.kind}"):
-                out, _ = with_oom_retry(
-                    lambda s=stream_b, b=build_b: equi_join(
-                        s, b, self.left_keys, self.right_keys,
-                        left_types, right_types,
-                        join_type=_KIND_MAP[self.kind]))
-            if self.condition is not None:
-                out = self.condition(out)
+                outs = self._probe_retry(stream_b, build_b, left_types,
+                                         right_types,
+                                         tag="join.oob.probe")
             emitted = True
-            yield out
+            yield from outs
         if not emitted:
             yield ColumnarBatch.empty(self.schema)
 
@@ -263,7 +272,7 @@ class _NestedLoopJoinBase(TpuExec):
     def _join_batches(self, stream_it, build: ColumnarBatch):
         left_types = list(self.children[0].schema.types)
         right_types = list(self.children[1].schema.types)
-        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.memory import retry as _retry
 
         saw = False
         for b in stream_it:
@@ -271,19 +280,28 @@ class _NestedLoopJoinBase(TpuExec):
                 continue
             saw = True
             with TraceRange(self.name):
+                # the pair expansion is per-stream-row, so the stream
+                # batch halves freely under the retry ladder (the build
+                # side stays whole — it is the broadcast)
                 if self.condition is not None and self.condition.fused:
-                    out, _ = with_oom_retry(
-                        lambda b=b: nested_loop_join(
-                            b, build, left_types, right_types,
+                    outs = _retry.with_retry(
+                        b,
+                        lambda bb: nested_loop_join(
+                            bb, build, left_types, right_types,
                             self.condition.mask,
-                            self.condition.condition.references()))
+                            self.condition.condition.references())[0],
+                        split=_retry.halve_batch,
+                        tag="join.nestedloop")
                 else:
-                    out, _ = with_oom_retry(
-                        lambda b=b: cross_join(b, build, left_types,
-                                               right_types))
+                    outs = _retry.with_retry(
+                        b,
+                        lambda bb: cross_join(bb, build, left_types,
+                                              right_types)[0],
+                        split=_retry.halve_batch,
+                        tag="join.nestedloop")
                     if self.condition is not None:
-                        out = self.condition(out)
-            yield out
+                        outs = [self.condition(o) for o in outs]
+            yield from outs
 
 
 class BroadcastNestedLoopJoinExec(_NestedLoopJoinBase):

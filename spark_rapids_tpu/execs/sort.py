@@ -64,7 +64,7 @@ class SortExec(TpuExec):
                         yield sort_batch(b, self.specs, types)
                 return
             from spark_rapids_tpu.memory import priorities
-            from spark_rapids_tpu.memory.oom import with_oom_retry
+            from spark_rapids_tpu.memory.retry import with_retry_no_split
             from spark_rapids_tpu.memory.spillable import SpillableBatch
 
             budget = self._budget_rows()
@@ -96,11 +96,17 @@ class SortExec(TpuExec):
                     parts = [stack.enter_context(sb.acquired())
                              for sb in handles]
                     with TraceRange("SortExec.global"):
+                        # output contract is ONE globally sorted batch:
+                        # spill rungs only (sorted halves would need a
+                        # merge kernel the TPU path deliberately lacks)
                         merged = parts[0] if len(parts) == 1 else \
-                            with_oom_retry(lambda: concat_batches(parts))
-                        out = with_oom_retry(
+                            with_retry_no_split(
+                                lambda: concat_batches(parts),
+                                tag="sort.concat")
+                        out = with_retry_no_split(
                             lambda: sort_batch(merged, self.specs,
-                                               types))
+                                               types),
+                            tag="sort.sort")
                 for sb in handles:
                     sb.close()
                 return out
@@ -135,7 +141,7 @@ class SortExec(TpuExec):
     def _out_of_core(self, staged, total: int, budget: int,
                      types) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.memory import priorities
-        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
         from spark_rapids_tpu.memory.spillable import SpillableBatch
         from spark_rapids_tpu.ops import partition as part_ops
         from spark_rapids_tpu.ops.concat import concat_batches
@@ -181,9 +187,12 @@ class SortExec(TpuExec):
                          for h in handles]
                 with TraceRange("SortExec.oob.bucket"):
                     merged = parts[0] if len(parts) == 1 else \
-                        with_oom_retry(lambda: concat_batches(parts))
-                    out = with_oom_retry(
-                        lambda: sort_batch(merged, self.specs, types))
+                        with_retry_no_split(
+                            lambda: concat_batches(parts),
+                            tag="sort.oob.concat")
+                    out = with_retry_no_split(
+                        lambda: sort_batch(merged, self.specs, types),
+                        tag="sort.oob.sort")
             for h in handles:
                 h.close()
             yield out

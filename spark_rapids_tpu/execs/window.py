@@ -388,13 +388,14 @@ class WindowExec(TpuExec):
     def _concat_staged(staged) -> ColumnarBatch:
         from contextlib import ExitStack
 
-        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
         from spark_rapids_tpu.ops.concat import concat_batches
 
         with ExitStack() as stack:
             parts = [stack.enter_context(sb.acquired()) for sb in staged]
             merged = parts[0] if len(parts) == 1 else \
-                with_oom_retry(lambda: concat_batches(parts))
+                with_retry_no_split(lambda: concat_batches(parts),
+                                    tag="window.concat")
         for sb in staged:
             sb.close()
         return merged
@@ -406,7 +407,7 @@ class WindowExec(TpuExec):
         exact; output order is per-bucket, same contract as the
         post-shuffle window)."""
         from spark_rapids_tpu.memory import priorities
-        from spark_rapids_tpu.memory.oom import with_oom_retry
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
         from spark_rapids_tpu.memory.spillable import SpillableBatch
         from spark_rapids_tpu.ops import partition as part_ops
 
@@ -434,7 +435,10 @@ class WindowExec(TpuExec):
             if b.realized_num_rows() == 0:
                 continue
             with TraceRange("WindowExec.oob.bucket"):
-                out = with_oom_retry(lambda b=b: self._run(b))
+                # a bucket holds whole PARTITION BY groups; halving by
+                # rows would split a group, so no split rung here
+                out = with_retry_no_split(lambda b=b: self._run(b),
+                                          tag="window.bucket")
             emitted = True
             yield out
         if not emitted:

@@ -21,13 +21,23 @@ from spark_rapids_tpu.memory.priorities import (  # noqa: F401
 )
 from spark_rapids_tpu.memory.catalog import (  # noqa: F401
     BufferCatalog,
+    SpillCorruptionError,
     StorageTier,
     get_catalog,
     reset_catalog,
 )
 from spark_rapids_tpu.memory.spillable import SpillableBatch  # noqa: F401
 from spark_rapids_tpu.memory.semaphore import TpuSemaphore  # noqa: F401
-from spark_rapids_tpu.memory.oom import (  # noqa: F401
-    is_oom_error,
-    with_oom_retry,
+from spark_rapids_tpu.memory.fault_injection import (  # noqa: F401
+    FaultInjector,
+    InjectedOOM,
+    get_injector,
 )
+from spark_rapids_tpu.memory.retry import (  # noqa: F401
+    SplitAndRetryOOM,
+    halve_batch,
+    is_oom_error,
+    with_retry,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.memory.oom import with_oom_retry  # noqa: F401

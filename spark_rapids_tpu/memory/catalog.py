@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import enum
 import itertools
+import logging
 import os
+import queue
 import tempfile
 import threading
 from typing import Dict, Optional
@@ -34,6 +36,14 @@ from typing import Dict, Optional
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar import serde
 from spark_rapids_tpu.memory.hashed_pq import HashedPriorityQueue
+
+log = logging.getLogger(__name__)
+
+
+class SpillCorruptionError(RuntimeError):
+    """A disk-tier spill file failed to decode (truncation, checksum
+    mismatch, bad envelope). Raised instead of handing a kernel garbage
+    data; chains the underlying decode error."""
 
 
 class StorageTier(enum.IntEnum):
@@ -90,14 +100,87 @@ def current_buffer_owner():
     return getattr(_owner_tls, "owner", None)
 
 
+class _AsyncSpillWriter:
+    """Double-buffered host->disk eviction (mirrors PR 1's upload
+    pipeline, inverted): the caller keeps computing while a single
+    writer thread serializes+compresses+commits victims. The bounded
+    queue (depth 2) is the double buffer — one victim in flight, one
+    staged — and doubles as backpressure: a spill storm blocks the
+    submitter instead of queueing unbounded host batches."""
+
+    _STOP = object()
+
+    def __init__(self, catalog: "BufferCatalog", depth: int = 2):
+        self._catalog = catalog
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._pending = 0
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="srt-spill-writer", daemon=True)
+            self._thread.start()
+
+    def submit(self, entry: "_Entry") -> None:
+        with self._cv:
+            self._pending += 1
+            self._ensure_thread()
+        self._q.put(entry)  # blocks at depth: the backpressure point
+
+    def _loop(self) -> None:
+        while True:
+            e = self._q.get()
+            if e is self._STOP:
+                return
+            try:
+                self._catalog._finish_async_spill(e)
+            except Exception:  # noqa: BLE001 - must not kill the writer
+                log.exception("async host->disk spill of buffer %d "
+                              "failed; entry stays on the host tier",
+                              e.buffer_id)
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()
+
+    def drain(self) -> None:
+        """Block until every submitted spill committed (or aborted)."""
+        with self._cv:
+            while self._pending:
+                self._cv.wait()
+
+    def stop(self) -> None:
+        """Drain, then end the writer thread — without this the parked
+        queue.get() would pin the thread (and its catalog reference)
+        for the life of the process."""
+        self.drain()
+        with self._cv:
+            t = self._thread
+        if t is None or not t.is_alive():
+            return
+        self._q.put(self._STOP)
+        t.join(timeout=5.0)
+
+
 class BufferCatalog:
     """id→buffer map + spill orchestration across the three tiers."""
 
     def __init__(self, device_budget: Optional[int] = None,
                  host_budget: Optional[int] = None,
                  spill_dir: Optional[str] = None,
-                 disk_codec: str = "lz4"):
+                 disk_codec: str = "lz4",
+                 async_spill: bool = False):
         self.disk_codec = disk_codec
+        # host->disk eviction path: async (double-buffered writer
+        # thread, compute overlaps the compressed write) or inline.
+        # Default inline: unit tests and short-lived catalogs want
+        # deterministic tier transitions; runtime.initialize flips it
+        # on from rapids.tpu.memory.spill.asyncWrite.enabled.
+        self.async_spill = async_spill
+        self._writer: Optional[_AsyncSpillWriter] = None
+        self._spilling_bytes = 0  # submitted to the writer, uncommitted
         self._lock = threading.RLock()
         self._entries: Dict[int, _Entry] = {}
         self._ids = itertools.count(1)
@@ -115,6 +198,11 @@ class BufferCatalog:
         # query's buffers once per stage slice, which must not scan the
         # whole catalog
         self._owners: Dict[object, set] = {}
+        # sticky per-owner bias: set_owner_bias applies to entries the
+        # owner registers LATER too (an out-of-core query keeps its
+        # eager-spill bias for its whole life, not just for buffers
+        # that existed when the scheduler set it)
+        self._owner_bias: Dict[object, int] = {}
         self.spilled_device_bytes = 0  # task-metric accounting
         self.spilled_host_bytes = 0
 
@@ -131,6 +219,7 @@ class BufferCatalog:
             self._entries[bid] = e
             if e.owner is not None:
                 self._owners.setdefault(e.owner, set()).add(e)
+                e.bias = self._owner_bias.get(e.owner, 0)
             self._device_bytes += size
             self._queues[StorageTier.DEVICE].push(e, e.spill_key())
         self._maybe_spill_async()
@@ -221,6 +310,10 @@ class BufferCatalog:
         of entries touched."""
         n = 0
         with self._lock:
+            if bias:
+                self._owner_bias[owner] = bias
+            else:
+                self._owner_bias.pop(owner, None)
             for e in self._owners.get(owner, ()):
                 if e.bias == bias:
                     continue
@@ -248,6 +341,7 @@ class BufferCatalog:
         must not leak its staged shuffle/broadcast batches."""
         with self._lock:
             ids = [e.buffer_id for e in self._owners.get(owner, ())]
+            self._owner_bias.pop(owner, None)
         for bid in ids:
             self.remove(bid)
         return len(ids)
@@ -295,6 +389,8 @@ class BufferCatalog:
             spilled += self._spill_device_entry(victim)
 
     def spill_host_to_disk(self, target_host_bytes: int) -> int:
+        if self.async_spill:
+            return self._spill_host_to_disk_async(target_host_bytes)
         spilled = 0
         while True:
             with self._lock:
@@ -304,6 +400,60 @@ class BufferCatalog:
                 if victim is None:
                     return spilled
             spilled += self._spill_host_entry(victim)
+
+    def _spill_host_to_disk_async(self, target_host_bytes: int) -> int:
+        """Hand victims to the writer thread until host bytes MINUS the
+        in-flight submissions reach the target, then return — the
+        compressed writes land while the caller computes. Returns bytes
+        submitted (an upper bound on bytes that will commit; a raced
+        acquire can still rescue a victim)."""
+        submitted = 0
+        while True:
+            with self._lock:
+                if self._host_bytes - self._spilling_bytes \
+                        <= target_host_bytes:
+                    return submitted
+                victim = self._pick_spill_victim(StorageTier.HOST)
+                if victim is None:
+                    return submitted
+                self._spilling_bytes += victim.size
+                if self._writer is None:
+                    self._writer = _AsyncSpillWriter(self)
+                writer = self._writer
+            writer.submit(victim)
+            submitted += victim.size
+
+    def _finish_async_spill(self, e: "_Entry") -> None:
+        """Writer-thread body: the same serialize+compress+commit as
+        the inline path, then retire the in-flight accounting. A lost
+        race (acquire/remove rescued the entry) leaves it at its
+        current tier; if it is still an unpinned host victim it gets
+        requeued by the release path as usual."""
+        try:
+            self._spill_host_entry(e)
+        finally:
+            with self._lock:
+                self._spilling_bytes -= e.size
+
+    def flush_spills(self) -> None:
+        """Barrier for the async eviction pipeline: returns when every
+        submitted host->disk write committed. Tests and shutdown paths
+        use it; the hot path never waits here."""
+        with self._lock:
+            writer = self._writer
+        if writer is not None:
+            writer.drain()
+
+    def close(self) -> None:
+        """Quiesce the catalog's background machinery: drain pending
+        disk writes and END the writer thread. A catalog being retired
+        (runtime shutdown, test teardown) must not leave a parked
+        daemon thread pinning it in memory; the catalog stays usable —
+        a later spill lazily restarts the writer."""
+        with self._lock:
+            writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.stop()
 
     def spill_all_device(self) -> int:
         return self.synchronous_spill(0)
@@ -383,9 +533,16 @@ class BufferCatalog:
         if tier is StorageTier.DISK:
             from spark_rapids_tpu.columnar import compression
 
-            with open(path, "rb") as f:
-                hb = serde.deserialize_host_batch(
-                    compression.unwrap(f.read()))
+            try:
+                with open(path, "rb") as f:
+                    hb = serde.deserialize_host_batch(
+                        compression.unwrap(f.read()))
+            except Exception as exc:
+                # a truncated/bit-flipped spill file must fail loudly
+                # here, not surface as garbage rows in a kernel
+                raise SpillCorruptionError(
+                    f"disk spill for buffer {e.buffer_id} at {path} "
+                    f"is unreadable: {exc}") from exc
         batch = serde.to_device_batch(hb)
         with self._lock:
             if e.buffer_id not in self._entries:

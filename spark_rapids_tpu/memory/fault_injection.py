@@ -1,0 +1,158 @@
+"""Deterministic device-OOM fault injection.
+
+The reference validates its spill-and-retry machinery with
+RmmSpark.forceRetryOOM / forceSplitAndRetryOOM — test hooks that make
+the Nth allocation on a task thread fail so the retry ladder is
+exercised without real memory pressure (spark-rapids-jni RmmSpark API).
+XLA gives us no allocation hook, but the retry framework
+(memory/retry.py) brackets every guarded device computation with
+``maybe_inject(site)`` — so the injector fires synthetic
+RESOURCE_EXHAUSTED errors at exact, reproducible points:
+
+- ``at_call=N``: the Nth eligible guarded call fails,
+- ``sites``: restrict eligibility to call-site tags (e.g.
+  ``aggregate.update``; prefix match, so ``join`` hits every join site),
+- ``probability`` + ``seed``: seeded random firing for chaos sweeps,
+- ``consecutive=K``: each firing point fails K guarded calls in a row,
+  which is what pushes the ladder past spill-and-retry into
+  split-and-retry (K > maxSpillRetries forces a split),
+- ``max_injections``: total cap, so a chaos run terminates.
+
+Armed from config (``rapids.tpu.memory.faultInjection.*``) by
+``runtime.initialize`` or directly by tests/scripts. Everything runs on
+CPU CI: the injected error takes the identical except-path a real XLA
+RESOURCE_EXHAUSTED takes.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional, Sequence, Tuple
+
+
+class InjectedOOM(RuntimeError):
+    """Synthetic device OOM. The message carries the canonical
+    RESOURCE_EXHAUSTED marker so ``is_oom_error`` classifies it exactly
+    like a real XLA allocation failure."""
+
+    def __init__(self, site: str, call_no: int):
+        self.site = site
+        self.call_no = call_no
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM at guarded call "
+            f"{call_no} (site {site!r})")
+
+
+class FaultInjector:
+    """Thread-safe injection point shared by every guarded call."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.disarm()
+
+    def disarm(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self._armed = False
+            self._at_call = 0
+            self._sites: Tuple[str, ...] = ()
+            self._probability = 0.0
+            self._rng: Optional[random.Random] = None
+            self._consecutive = 1
+            self._max_injections = 0
+            self._burst_left = 0
+            self._calls = 0
+            self._eligible_calls = 0
+            self._injections = 0
+
+    def arm(self, at_call: int = 0, sites: Sequence[str] = (),
+            probability: float = 0.0, seed: int = 0,
+            consecutive: int = 1, max_injections: int = 0) -> None:
+        """Arm (resetting all counters). ``at_call`` counts ELIGIBLE
+        (site-matching) guarded calls from 1; 0 disables the
+        deterministic trigger (probability may still fire)."""
+        with self._lock:
+            self._armed = True
+            self._at_call = max(int(at_call), 0)
+            self._sites = tuple(s for s in sites if s)
+            self._probability = float(probability)
+            self._rng = random.Random(seed) if probability > 0 else None
+            self._consecutive = max(int(consecutive), 1)
+            self._max_injections = max(int(max_injections), 0)
+            self._burst_left = 0
+            self._calls = 0
+            self._eligible_calls = 0
+            self._injections = 0
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def _site_matches(self, site: str) -> bool:
+        if not self._sites:
+            return True
+        return any(site.startswith(s) for s in self._sites)
+
+    def maybe_inject(self, site: str) -> None:
+        """Called by the retry framework before every guarded device
+        computation; raises InjectedOOM when the armed config says this
+        call fails. Near-zero cost when disarmed."""
+        if not self._armed:
+            return
+        with self._lock:
+            self._calls += 1
+            if not self._site_matches(site):
+                return
+            self._eligible_calls += 1
+            if self._max_injections and \
+                    self._injections >= self._max_injections:
+                return
+            fire = False
+            if self._burst_left > 0:
+                self._burst_left -= 1
+                fire = True
+            elif self._at_call and self._eligible_calls == self._at_call:
+                fire = True
+                self._burst_left = self._consecutive - 1
+            elif self._rng is not None and \
+                    self._rng.random() < self._probability:
+                fire = True
+                self._burst_left = self._consecutive - 1
+            if not fire:
+                return
+            self._injections += 1
+            call_no = self._eligible_calls
+        raise InjectedOOM(site, call_no)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"armed": self._armed, "calls": self._calls,
+                    "eligible_calls": self._eligible_calls,
+                    "injections": self._injections}
+
+
+_injector = FaultInjector()
+
+
+def get_injector() -> FaultInjector:
+    return _injector
+
+
+def arm_from_conf(conf) -> bool:
+    """Arm/disarm the global injector from ``rapids.tpu.memory.
+    faultInjection.*``; returns True when armed."""
+    from spark_rapids_tpu import config as cfg
+
+    if not conf.get(cfg.FAULT_INJECTION_ENABLED):
+        _injector.disarm()
+        return False
+    sites = [s.strip() for s in
+             str(conf.get(cfg.FAULT_INJECTION_SITES)).split(",")
+             if s.strip()]
+    _injector.arm(
+        at_call=conf.get(cfg.FAULT_INJECTION_AT_CALL),
+        sites=sites,
+        probability=conf.get(cfg.FAULT_INJECTION_PROBABILITY),
+        seed=conf.get(cfg.FAULT_INJECTION_SEED),
+        consecutive=conf.get(cfg.FAULT_INJECTION_CONSECUTIVE),
+        max_injections=conf.get(cfg.FAULT_INJECTION_MAX))
+    return True

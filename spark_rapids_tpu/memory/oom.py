@@ -1,56 +1,37 @@
-"""OOM detection + spill-and-retry.
+"""OOM detection + spill-and-retry (compatibility surface).
 
-The reference installs an RMM event handler whose alloc-failure callback
-spills the device store and asks RMM to retry
-(DeviceMemoryEventHandler.onAllocFailure, DeviceMemoryEventHandler.scala:
-42-69). XLA exposes no alloc callback, so the TPU design inverts control:
-wrap device computations in ``with_oom_retry`` — on RESOURCE_EXHAUSTED we
-synchronously spill catalog-managed buffers and re-run, escalating from
-"spill to budget" to "spill everything" before giving up.
+The real machinery moved to :mod:`spark_rapids_tpu.memory.retry`, which
+generalizes the original spill-and-rerun ladder into the reference's
+split-and-retry shape (RmmRapidsRetryIterator) with per-site accounting
+and deterministic fault injection. This module keeps the historical
+names importable:
+
+- ``is_oom_error`` — now type-gated with anchored markers (a ValueError
+  whose user data mentions "OOM" is no longer treated as a device OOM),
+- ``with_oom_retry`` — the spill-only ladder; on give-up the terminal
+  ``SplitAndRetryOOM`` chains ``from`` the original device error
+  instead of discarding the retry context.
 """
 from __future__ import annotations
 
-import logging
 from typing import Callable, Optional, TypeVar
 
-from spark_rapids_tpu.memory.catalog import BufferCatalog, get_catalog
-
-log = logging.getLogger(__name__)
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.memory.retry import (  # noqa: F401
+    SplitAndRetryOOM,
+    is_oom_error,
+    with_retry_no_split,
+)
 
 T = TypeVar("T")
-
-_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
-                "Resource exhausted")
-
-
-def is_oom_error(exc: BaseException) -> bool:
-    msg = str(exc)
-    return any(m in msg for m in _OOM_MARKERS)
 
 
 def with_oom_retry(fn: Callable[[], T],
                    catalog: Optional[BufferCatalog] = None,
-                   max_retries: int = 2) -> T:
-    """Run ``fn``; on device OOM spill and retry (escalating), then re-raise.
-
-    Retry ladder mirrors DeviceMemoryEventHandler's store-exhausted logic:
-    first spill down to half the tracked bytes, then spill everything.
-    """
-    cat = catalog if catalog is not None else get_catalog()
-    attempt = 0
-    while True:
-        try:
-            return fn()
-        except Exception as exc:  # jaxlib raises XlaRuntimeError(RuntimeError)
-            if not is_oom_error(exc) or attempt >= max_retries:
-                raise
-            if attempt == 0:
-                target = cat.device_bytes // 2
-                log.warning("device OOM: spilling to %d tracked bytes and "
-                            "retrying", target)
-                cat.synchronous_spill(target)
-            else:
-                log.warning("device OOM persists: spilling all tracked "
-                            "device buffers")
-                cat.spill_all_device()
-            attempt += 1
+                   max_retries: int = 2,
+                   tag: str = "oom.retry") -> T:
+    """Run ``fn``; on device OOM spill and retry (escalating: half the
+    tracked bytes, then everything), then raise SplitAndRetryOOM from
+    the original error."""
+    return with_retry_no_split(fn, catalog=catalog, tag=tag,
+                               max_spill_retries=max_retries)

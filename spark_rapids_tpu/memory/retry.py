@@ -1,0 +1,298 @@
+"""Split-and-retry: the OOM-resilience framework.
+
+The reference survives device memory pressure in two layers: the RMM
+alloc-failure handler spills the buffer store and retries the
+allocation (DeviceMemoryEventHandler.scala:42-69), and the retry
+iterator generalizes that so ANY operator can halve its input and keep
+going instead of dying (RmmRapidsRetryIterator: withRetry /
+withRetryNoSplit / splitAndRetry semantics). XLA exposes no alloc
+callback, so control inverts: device computations run inside
+``with_retry`` and on RESOURCE_EXHAUSTED the framework climbs a ladder
+
+    spill to half the tracked bytes  ->  spill everything  ->
+    split the offending input and process the halves (bounded depth)
+    ->  give up (SplitAndRetryOOM, chained to the original error)
+
+Call sites that can consume multiple outputs (aggregate update batches,
+join probe batches) pass a ``split`` function and genuinely halve;
+sites whose contract is one output (concat-to-single-batch, a sort
+bucket) use ``with_retry_no_split`` and stop at the spill rungs.
+
+Every rung is accounted per call-site tag and per catalog buffer-owner
+(the query service's owner tag), so retries/splits/bytes-spilled/time
+blocked surface in ServiceStats and the benchmark-runner JSON. The
+fault injector (memory/fault_injection.py) hooks the guarded-call
+bracket, so the whole ladder is exercised deterministically on CPU CI.
+"""
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from typing import Callable, List, Optional, TypeVar
+
+from spark_rapids_tpu.memory.catalog import (BufferCatalog,
+                                             current_buffer_owner,
+                                             get_catalog)
+from spark_rapids_tpu.memory.fault_injection import InjectedOOM, get_injector
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+class SplitAndRetryOOM(RuntimeError):
+    """The whole ladder — spill-to-budget, spill-all, splits to the
+    depth bound — failed to make the computation fit. Raised ``from``
+    the original device error so the trace keeps both contexts."""
+
+
+# -- OOM classification ------------------------------------------------------
+# Type-gated + anchored-marker matching. The old bare substring scan
+# (`"OOM" in str(exc)`) classified a ValueError mentioning "OOM" in
+# user data as a device OOM and silently spill-retried it; now only
+# runtime-level errors whose message carries an allocation-failure
+# marker in marker position qualify.
+
+_OOM_PATTERNS = (
+    re.compile(r"(?:^|[:\s(])RESOURCE[_ ]EXHAUSTED(?:$|[:\s)])"),
+    re.compile(r"(?:^|: )Out of memory(?:$|[ :])"),
+    re.compile(r"(?:^|: )Resource exhausted(?:$|[ :])"),
+    re.compile(r"\bOut of memory allocating\b"),
+)
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    if isinstance(exc, (InjectedOOM, MemoryError)):
+        return True
+    # jaxlib raises XlaRuntimeError (a RuntimeError subclass); user
+    # errors like ValueError/KeyError never count however their
+    # message reads
+    if not isinstance(exc, RuntimeError):
+        return False
+    msg = str(exc)
+    return any(p.search(msg) for p in _OOM_PATTERNS)
+
+
+# -- retry policy (config-wired) --------------------------------------------
+
+DEFAULT_MAX_SPILL_RETRIES = 2
+DEFAULT_MAX_SPLIT_DEPTH = 8
+
+_policy_lock = threading.Lock()
+_max_spill_retries = DEFAULT_MAX_SPILL_RETRIES
+_max_split_depth = DEFAULT_MAX_SPLIT_DEPTH
+
+
+def configure(max_spill_retries: Optional[int] = None,
+              max_split_depth: Optional[int] = None) -> None:
+    global _max_spill_retries, _max_split_depth
+    with _policy_lock:
+        if max_spill_retries is not None:
+            _max_spill_retries = max(int(max_spill_retries), 0)
+        if max_split_depth is not None:
+            _max_split_depth = max(int(max_split_depth), 0)
+
+
+def configure_from_conf(conf) -> None:
+    from spark_rapids_tpu import config as cfg
+
+    configure(max_spill_retries=conf.get(cfg.RETRY_MAX_SPILL_RETRIES),
+              max_split_depth=conf.get(cfg.RETRY_MAX_SPLIT_DEPTH))
+
+
+def reset_config() -> None:
+    configure(DEFAULT_MAX_SPILL_RETRIES, DEFAULT_MAX_SPLIT_DEPTH)
+
+
+# -- accounting --------------------------------------------------------------
+
+_STAT_KEYS = ("oom_retries", "oom_splits", "spilled_bytes", "blocked_s",
+              "gave_ups")
+
+_stats_lock = threading.Lock()
+_totals = {k: 0 for k in _STAT_KEYS}
+_per_site: dict = {}
+_per_owner: dict = {}
+
+
+def _record(site: str, owner, retries: int = 0, splits: int = 0,
+            spilled: int = 0, blocked_s: float = 0.0,
+            gave_up: int = 0) -> None:
+    delta = {"oom_retries": retries, "oom_splits": splits,
+             "spilled_bytes": spilled, "blocked_s": blocked_s,
+             "gave_ups": gave_up}
+    with _stats_lock:
+        for k, v in delta.items():
+            _totals[k] += v
+        site_d = _per_site.setdefault(site, {k: 0 for k in _STAT_KEYS})
+        for k, v in delta.items():
+            site_d[k] += v
+        if owner is not None:
+            own = _per_owner.setdefault(owner,
+                                        {k: 0 for k in _STAT_KEYS})
+            for k, v in delta.items():
+                own[k] += v
+
+
+def snapshot() -> dict:
+    """Totals so far (for before/after deltas in the runner)."""
+    with _stats_lock:
+        return dict(_totals)
+
+
+def delta(before: dict) -> dict:
+    now = snapshot()
+    return {k: round(now[k] - before.get(k, 0), 6)
+            for k in _STAT_KEYS}
+
+
+def stats() -> dict:
+    """{"totals": ..., "per_site": ...} — the observability snapshot
+    the runner JSON and chaos fence embed."""
+    with _stats_lock:
+        return {"totals": dict(_totals),
+                "per_site": {s: dict(d) for s, d in _per_site.items()}}
+
+
+def site_delta(before_per_site: dict) -> dict:
+    """Per-site deltas against a prior ``stats()["per_site"]`` snapshot
+    (sites with no activity since dropped) — so a report covering one
+    run never mixes in another run's counters."""
+    with _stats_lock:
+        now = {s: dict(d) for s, d in _per_site.items()}
+    out = {}
+    for site, d in now.items():
+        prev = before_per_site.get(site, {})
+        dd = {k: round(d[k] - prev.get(k, 0), 6) for k in _STAT_KEYS}
+        if any(dd.values()):
+            out[site] = dd
+    return out
+
+
+def owner_stats(owner) -> dict:
+    """Accumulated retry accounting of one buffer-owner tag (the query
+    service's per-query view)."""
+    with _stats_lock:
+        d = _per_owner.get(owner)
+        return dict(d) if d else {k: 0 for k in _STAT_KEYS}
+
+
+def pop_owner_stats(owner) -> dict:
+    """Final per-owner accounting, removed from the live map — a
+    long-lived service must not keep an entry per query ever run."""
+    with _stats_lock:
+        d = _per_owner.pop(owner, None)
+        return dict(d) if d else {k: 0 for k in _STAT_KEYS}
+
+
+def reset_stats() -> None:
+    with _stats_lock:
+        for k in _STAT_KEYS:
+            _totals[k] = 0
+        _per_site.clear()
+        _per_owner.clear()
+
+
+# -- splitters ---------------------------------------------------------------
+
+
+def halve_batch(batch) -> Optional[list]:
+    """Split a ColumnarBatch into two row-range halves; None when it
+    cannot shrink further (the ladder then gives up)."""
+    n = batch.realized_num_rows()
+    if n <= 1:
+        return None
+    h = n // 2
+    return [batch.slice(0, h), batch.slice(h, n - h)]
+
+
+# -- the ladder --------------------------------------------------------------
+
+
+def _spill_rung(cat: BufferCatalog, attempt: int) -> int:
+    """Rung ``attempt`` of the spill escalation: first to half the
+    tracked device bytes, then everything (DeviceMemoryEventHandler's
+    store-exhausted escalation)."""
+    if attempt == 0:
+        target = cat.device_bytes // 2
+        log.warning("device OOM: spilling to %d tracked bytes and "
+                    "retrying", target)
+        return cat.synchronous_spill(target)
+    log.warning("device OOM persists: spilling all tracked device "
+                "buffers")
+    return cat.spill_all_device()
+
+
+def with_retry(item: U, fn: Callable[[U], T], *,
+               split: Optional[Callable[[U], Optional[list]]] = None,
+               catalog: Optional[BufferCatalog] = None,
+               tag: str = "<untagged>",
+               max_spill_retries: Optional[int] = None,
+               max_split_depth: Optional[int] = None) -> List[T]:
+    """Run ``fn(item)`` under the OOM ladder; returns the result list —
+    one element normally, several when the input had to split.
+
+    ``split(item)`` must return >= 2 sub-items that together cover the
+    input (or None when it cannot shrink), and ``fn`` over the parts
+    must compose: callers merge the returned parts themselves (partial
+    aggregates re-merge, join probe outputs just concatenate).
+    """
+    cat = catalog if catalog is not None else get_catalog()
+    spill_rungs = _max_spill_retries if max_spill_retries is None \
+        else max_spill_retries
+    depth_bound = _max_split_depth if max_split_depth is None \
+        else max_split_depth
+    injector = get_injector()
+    out: List[T] = []
+    work = [(item, 0)]  # LIFO would reorder halves; treat as FIFO
+    while work:
+        cur, depth = work.pop(0)
+        attempt = 0
+        while True:
+            try:
+                injector.maybe_inject(tag)
+                out.append(fn(cur))
+                break
+            except Exception as exc:
+                if not is_oom_error(exc):
+                    raise
+                owner = current_buffer_owner()
+                if attempt < spill_rungs:
+                    t0 = time.perf_counter()
+                    spilled = _spill_rung(cat, attempt)
+                    _record(tag, owner, retries=1, spilled=spilled,
+                            blocked_s=time.perf_counter() - t0)
+                    attempt += 1
+                    continue
+                halves = None
+                if split is not None and depth < depth_bound:
+                    halves = split(cur)
+                if halves:
+                    log.warning(
+                        "device OOM survived %d spill retries at %s: "
+                        "splitting input (depth %d)", attempt, tag,
+                        depth + 1)
+                    _record(tag, owner, splits=1)
+                    work[:0] = [(h, depth + 1) for h in halves]
+                    break
+                _record(tag, owner, gave_up=1)
+                raise SplitAndRetryOOM(
+                    f"device OOM at {tag!r} persisted through "
+                    f"{attempt} spill retries and split depth {depth} "
+                    f"(splittable={split is not None})") from exc
+    return out
+
+
+def with_retry_no_split(fn: Callable[[], T], *,
+                        catalog: Optional[BufferCatalog] = None,
+                        tag: str = "<untagged>",
+                        max_spill_retries: Optional[int] = None) -> T:
+    """Single-output form: spill rungs only, no splitting — for call
+    sites whose contract is exactly one result (concat-to-one, a sort
+    bucket). The reference's withRetryNoSplit."""
+    return with_retry(None, lambda _none: fn(), catalog=catalog,
+                      tag=tag, max_spill_retries=max_spill_retries,
+                      max_split_depth=0)[0]

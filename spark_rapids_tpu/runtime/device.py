@@ -88,16 +88,23 @@ def initialize(conf: Optional[RapidsConf] = None,
     with _lock:
         dm = TpuDeviceManager(device_ordinal)
         _ = dm.device  # fail fast if the device is unavailable
-        budget = dm.device_budget(conf)
+        # an explicit configured budget wins over the HBM-derived one —
+        # the artificially-small-budget mode the out-of-core fence uses
+        budget = conf.get(cfg.DEVICE_BUDGET) or dm.device_budget(conf)
         catalog = BufferCatalog(
             device_budget=budget,
             host_budget=conf.get(cfg.HOST_SPILL_STORAGE_SIZE),
             spill_dir=conf.get(cfg.SPILL_DIR),
             disk_codec=conf.get(cfg.SHUFFLE_COMPRESSION_CODEC)
             if conf.get(cfg.SHUFFLE_COMPRESSION_CODEC) != "none"
-            else "lz4")
+            else "lz4",
+            async_spill=conf.get(cfg.SPILL_ASYNC_WRITE))
         reset_catalog(catalog)
         semaphore = sem.initialize(conf.get(cfg.CONCURRENT_TPU_TASKS))
+        from spark_rapids_tpu.memory import fault_injection, retry
+
+        retry.configure_from_conf(conf)
+        fault_injection.arm_from_conf(conf)
         _env = RuntimeEnv(conf, dm, catalog, semaphore,
                           conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         return _env
@@ -112,6 +119,13 @@ def shutdown() -> None:
     """Test teardown: drop the environment and restore defaults."""
     global _env
     with _lock:
+        old = _env
         _env = None
+        if old is not None:
+            old.catalog.close()  # drain + end the spill writer thread
         reset_catalog(BufferCatalog())
         sem.initialize(2)
+        from spark_rapids_tpu.memory import fault_injection, retry
+
+        retry.reset_config()
+        fault_injection.get_injector().disarm()

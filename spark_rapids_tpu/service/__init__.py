@@ -26,6 +26,7 @@ package IS that scheduler:
   multi-tenant win: tenant B's q1 reuses tenant A's executables).
 """
 from spark_rapids_tpu.service.types import (DeadlineExceeded,  # noqa: F401
+                                            OutOfCoreRejected,
                                             QueryCancelled, QueryHandle,
                                             QueryState, ServiceOverloaded)
 from spark_rapids_tpu.service.query_service import \
@@ -33,5 +34,5 @@ from spark_rapids_tpu.service.query_service import \
 from spark_rapids_tpu.service.stats import ServiceStats  # noqa: F401
 
 __all__ = ["QueryService", "QueryHandle", "QueryState",
-           "ServiceOverloaded", "DeadlineExceeded", "QueryCancelled",
-           "ServiceStats"]
+           "ServiceOverloaded", "OutOfCoreRejected", "DeadlineExceeded",
+           "QueryCancelled", "ServiceStats"]

@@ -193,8 +193,13 @@ class AdmissionController:
             # queries only builds a convoy at the semaphore
             return False
         budget = self.current_budget()
+        # charge, not footprint: an out-of-core query is charged a
+        # capped share of HBM (the service set q.charge at submit) —
+        # its real working set lives in the spill chain, so billing
+        # the full over-budget footprint would park it behind every
+        # in-flight query until the device drained
         if budget is not None and \
-                self.inflight_bytes + q.footprint > budget:
+                self.inflight_bytes + q.charge > budget:
             return False
         return True
 
@@ -227,11 +232,11 @@ class AdmissionController:
         q.state = QueryState.ADMITTED
         q.admitted_at = time.perf_counter()
         self.inflight.add(q)
-        self.inflight_bytes += q.footprint
+        self.inflight_bytes += q.charge
 
     def release(self, q: Query) -> None:
         """Completion/cancel/expiry of an admitted query frees its
         budget charge (the service then pumps admission again)."""
         if q in self.inflight:
             self.inflight.discard(q)
-            self.inflight_bytes -= q.footprint
+            self.inflight_bytes -= q.charge

@@ -23,7 +23,8 @@ from spark_rapids_tpu.service.admission import (AdmissionController,
                                                 parse_fairness_weights)
 from spark_rapids_tpu.service.scheduler import StageScheduler
 from spark_rapids_tpu.service.stats import Histogram, ServiceStats
-from spark_rapids_tpu.service.types import (DeadlineExceeded, Query,
+from spark_rapids_tpu.service.types import (DeadlineExceeded,
+                                            OutOfCoreRejected, Query,
                                             QueryCancelled, QueryHandle,
                                             QueryState, ServiceOverloaded)
 
@@ -51,7 +52,9 @@ class QueryService:
         self._finished_order: list = []  # terminal qids, oldest first
         self._counters = {"submitted": 0, "admitted": 0, "shed": 0,
                           "done": 0, "failed": 0, "cancelled": 0,
-                          "deadline_expired": 0}
+                          "deadline_expired": 0,
+                          "admitted_out_of_core": 0,
+                          "oom_retries": 0, "oom_splits": 0}
         self._queue_time = Histogram()
         self._run_time = Histogram()
         self._shutdown = False
@@ -99,11 +102,35 @@ class QueryService:
             self._counters["submitted"] += 1
             if self.admission.would_shed(tenant):
                 raise self._shed_locked(plan, tenant, priority, deadline)
-        exec_ = apply_overrides(plan, self.conf)
-        stages = cut_stages(exec_)
         footprint = estimate_footprint_bytes(
             plan,
             default_rows=self.conf.get(cfg.SERVICE_DEFAULT_ROW_ESTIMATE))
+        # out-of-core decision BEFORE physical planning: a query whose
+        # estimated peak exceeds the WHOLE device budget can never fit,
+        # so either shed it now (policy=shed) or plan it with a
+        # forced-splitting batch budget so every staging exec takes its
+        # bucketed out-of-core path and the spill chain absorbs the
+        # overflow (ROADMAP item 3)
+        plan_conf = self.conf
+        out_of_core = False
+        budget = self.admission.current_budget()
+        if budget is not None and footprint > budget and \
+                self.conf.get(cfg.SERVICE_OUT_OF_CORE):
+            policy = str(self.conf.get(
+                cfg.SERVICE_OUT_OF_CORE_POLICY)).strip().lower()
+            if policy == "shed":
+                with self._lock:
+                    rec = self._record_shed_locked(tenant, priority,
+                                                   deadline)
+                err = OutOfCoreRejected(tenant, footprint, budget)
+                err.query_id = rec.query_id
+                raise err
+            out_of_core = True
+            forced = max(budget // 4, 1 << 20)
+            plan_conf = self.conf.with_overrides(
+                {cfg.BATCH_SIZE_BYTES.key: forced})
+        exec_ = apply_overrides(plan, plan_conf)
+        stages = cut_stages(exec_)
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("QueryService is shut down")
@@ -114,17 +141,22 @@ class QueryService:
             q = Query(next(_GLOBAL_QUERY_IDS), tenant, plan, exec_,
                       priority, deadline, footprint, stages,
                       self._done_cv)
+            if out_of_core:
+                q.out_of_core = True
+                # charge half the device: the forced-splitting plan
+                # bounds the resident working set far below the
+                # footprint, and a whale must not occupy the whole
+                # budget ledger while it spills
+                q.charge = min(footprint, max(budget // 2, 1))
             self._queries[q.query_id] = q
             self.admission.offer(q)
             self._pump_locked()
         return QueryHandle(self, q)
 
-    def _shed_locked(self, plan, tenant: str, priority: int,
-                     deadline) -> ServiceOverloaded:
-        """Record the rejection as a terminal SHED query so the
-        lifecycle is observable (stats().per_query history) and build
-        the exception — the caller gets no handle back, but it carries
-        the id for gateway-side correlation."""
+    def _record_shed_locked(self, tenant: str, priority: int,
+                            deadline) -> Query:
+        """Record a rejection as a terminal SHED query so the lifecycle
+        is observable (stats().per_query history)."""
         q = Query(next(_GLOBAL_QUERY_IDS), tenant, None, None,
                   priority, deadline, 0, [], self._done_cv)
         q.state = QueryState.SHED
@@ -132,6 +164,14 @@ class QueryService:
         self._queries[q.query_id] = q
         self._retain_locked(q)
         self._counters["shed"] += 1
+        return q
+
+    def _shed_locked(self, plan, tenant: str, priority: int,
+                     deadline) -> ServiceOverloaded:
+        """Record + build the overload rejection — the caller gets no
+        handle back, but the exception carries the id for gateway-side
+        correlation."""
+        q = self._record_shed_locked(tenant, priority, deadline)
         err = ServiceOverloaded(
             tenant, self.admission.queue_depth(),
             self.admission.queue_limit)
@@ -139,6 +179,7 @@ class QueryService:
         return err
 
     def stats(self) -> ServiceStats:
+        from spark_rapids_tpu.memory import retry as _retry
         from spark_rapids_tpu.utils import dispatch as _disp
         from spark_rapids_tpu.utils import progcache
 
@@ -154,14 +195,20 @@ class QueryService:
                     "tenant": q.tenant,
                     "state": q.state.value,
                     "footprint_bytes": q.footprint,
+                    "out_of_core": q.out_of_core,
                     "slices": q.slices_done,
                     "dispatches": qcounts.get(q.query_id,
                                               q.dispatches),
+                    # live queries read the retry map; terminal ones
+                    # keep the snapshot finalize popped
+                    "retry": q.retry or _retry.owner_stats(
+                        q.owner_tag),
                     "queue_time_s": q.queue_time_s(),
                     "run_time_s": q.run_time_s(),
                 })
             semaphore = self.admission.current_semaphore()
             return ServiceStats(
+                retry=_retry.stats(),
                 queue_depth=self.admission.queue_depth(),
                 running=running,
                 admitted_inflight=len(self.admission.inflight),
@@ -299,6 +346,8 @@ class QueryService:
                     continue
                 self.admission.admit(nxt)
                 self._counters["admitted"] += 1
+                if nxt.out_of_core:
+                    self._counters["admitted_out_of_core"] += 1
                 self.scheduler.enqueue(nxt)
         finally:
             self._pumping = False
@@ -315,6 +364,7 @@ class QueryService:
 
     def _finalize_locked(self, q: Query, state: QueryState,
                          error: Optional[BaseException] = None) -> None:
+        from spark_rapids_tpu.memory import retry as _retry
         from spark_rapids_tpu.utils import dispatch as _disp
 
         if q.terminal:
@@ -332,6 +382,9 @@ class QueryService:
         q.error = error
         q.finished_at = time.perf_counter()
         q.dispatches = _disp.pop_query_count(q.query_id)
+        q.retry = _retry.pop_owner_stats(q.owner_tag)
+        self._counters["oom_retries"] += q.retry["oom_retries"]
+        self._counters["oom_splits"] += q.retry["oom_splits"]
         # release every resource the query may still hold: admission
         # charge, catalog buffers (an abandoned exec tree must not leak
         # staged batches), and its execution cursor

@@ -42,6 +42,13 @@ from spark_rapids_tpu.utils import dispatch as _disp
 #: always preferred victims over any running query's buffers
 STALLED_SPILL_BIAS = -(1 << 63)
 
+#: eager-spill bias an out-of-core query carries WHILE RUNNING: its
+#: active working batches (ACTIVE_* bands, ~1 << 62) drop below every
+#: other query's actives but stay above bystanders' passive bands —
+#: memory pressure evicts the whale's staged data into its spill chain
+#: first, never a well-behaved tenant's working set
+OUT_OF_CORE_SPILL_BIAS = -(1 << 61)
+
 
 class _Interrupted(BaseException):
     """Internal slice unwind (cancel/deadline); never escapes the
@@ -125,11 +132,14 @@ class StageScheduler:
         """Advance one stage slice (one batch pull) of ``q``, then hand
         it back to the ready deque — or finalize it."""
         catalog = get_catalog()
-        # back on the device: restore normal spill priority (skipped
-        # unless the last yield actually demoted — the common
-        # single-query case never touches the catalog heap)
-        if q.spill_demoted:
-            catalog.set_owner_bias(q.owner_tag, 0)
+        # back on the device: restore the query's RUNNING spill bias —
+        # 0 normally, the eager-spill band for out-of-core queries
+        # (skipped unless the last yield demoted or this is an OOC
+        # query's first slice — the common single-query case never
+        # touches the catalog heap)
+        base_bias = OUT_OF_CORE_SPILL_BIAS if q.out_of_core else 0
+        if q.spill_demoted or (q.out_of_core and q.slices_done == 0):
+            catalog.set_owner_bias(q.owner_tag, base_bias)
             q.spill_demoted = False
         done = False
         outcome: Optional[_Interrupted] = None

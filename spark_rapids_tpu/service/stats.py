@@ -61,6 +61,9 @@ class ServiceStats:
     per_query: List[dict]
     progcache: dict
     semaphore: dict
+    #: OOM-retry ladder accounting (memory/retry.stats()): totals +
+    #: per-call-site retries/splits/bytes-spilled/time-blocked
+    retry: dict = dataclasses.field(default_factory=dict)
 
     @property
     def progcache_hit_rate(self) -> float:

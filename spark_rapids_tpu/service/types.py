@@ -42,6 +42,24 @@ class DeadlineExceeded(RuntimeError):
     completed; its admission, permit and buffers were released."""
 
 
+class OutOfCoreRejected(RuntimeError):
+    """The query's estimated footprint exceeds the whole device budget
+    and ``rapids.tpu.service.outOfCore.policy`` is ``shed``: the
+    service refuses to run it out-of-core. Recorded as a terminal SHED
+    query; callers can resubmit with a smaller working set or to a
+    service configured with policy ``run``."""
+
+    def __init__(self, tenant: str, footprint: int, budget: int):
+        self.tenant = tenant
+        self.footprint = footprint
+        self.budget = budget
+        super().__init__(
+            f"query footprint {footprint} bytes exceeds the device "
+            f"budget {budget} and outOfCore.policy=shed (tenant "
+            f"{tenant!r}) — shrink the query or set "
+            f"rapids.tpu.service.outOfCore.policy=run")
+
+
 class QueryCancelled(RuntimeError):
     """result() on a query whose cancel() won."""
 
@@ -75,6 +93,16 @@ class Query:
         self.slices_done = 0
         self.dispatches = 0  # filled from telemetry when installed
         self.spill_demoted = False  # stalled-yield bias currently set
+        # out-of-core mode: footprint exceeds the whole device budget;
+        # planned with a forced-splitting batch budget, runs with eager
+        # spill bias, and charges admission only ``charge`` bytes (a
+        # capped share — the spill chain, not HBM, absorbs the rest)
+        self.out_of_core = False
+        self.charge = footprint
+        # final per-query OOM-retry accounting (memory/retry), filled
+        # at finalize so stats history keeps it after the live map is
+        # popped
+        self.retry: dict = {}
         # cooperative execution cursor: per-partition batch iterators,
         # advanced one stage-slice at a time by the scheduler. The REAL
         # partition count resolves lazily on the first slice — querying

@@ -30,6 +30,7 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.shuffle.meta import BlockId, ShuffleTableMeta
@@ -177,14 +178,31 @@ class TcpShuffleServer:
 
 class TcpConnection(Connection):
     """Client endpoint for one peer server; request/response pairs are
-    serialized under a lock (one socket, in-order protocol)."""
+    serialized under a lock (one socket, in-order protocol).
+
+    Transient transport faults (a slow peer's timeout, a dropped
+    connection) retry with bounded exponential backoff — the failing
+    round trip already dropped the socket, so each retry is also the
+    one reconnect. Only after the retry budget (or the caller's
+    timeout window) is exhausted does the error surface as a fetch
+    failure and cost a whole stage re-run
+    (RapidsShuffleIterator.scala:242-300 keeps that escalation)."""
+
+    #: bounded transient-fault retries per request (first backoff
+    #: _RETRY_BASE_S, doubling; total added wait stays well under any
+    #: sane request timeout)
+    MAX_TRANSIENT_RETRIES = 3
+    _RETRY_BASE_S = 0.05
 
     def __init__(self, host: str, port: int,
-                 connect_timeout: float = 10.0):
+                 connect_timeout: float = 10.0,
+                 max_transient_retries: Optional[int] = None):
         self._addr = (host, port)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._connect_timeout = connect_timeout
+        self._max_retries = self.MAX_TRANSIENT_RETRIES \
+            if max_transient_retries is None else max_transient_retries
 
     def _ensure(self, timeout: float) -> socket.socket:
         if self._sock is None:
@@ -208,8 +226,39 @@ class TcpConnection(Connection):
                 raise TransportError(
                     f"transport to {self._addr} failed: {e}")
         if not resp.get("ok"):
-            raise TransportError(resp.get("error", "unknown peer error"))
+            # peer answered with a semantic error: retrying would just
+            # re-ask the same question
+            raise TransportError(resp.get("error", "unknown peer error"),
+                                 retryable=False)
         return resp, payload
+
+    def _roundtrip_retrying(self, header: dict, timeout: float):
+        """``_roundtrip`` with bounded exponential backoff on transient
+        TransportError. The total wall time (tries + sleeps) is capped
+        at the caller's ``timeout`` — a hiccuping peer costs backoff,
+        never more than the budget the caller already signed up for."""
+        deadline = time.monotonic() + timeout
+        backoff = self._RETRY_BASE_S
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TransportError(
+                    f"transport to {self._addr} timed out after "
+                    f"{attempt} attempts within {timeout}s")
+            try:
+                return self._roundtrip(header, remaining)
+            except TransportError as e:
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                if not getattr(e, "retryable", True) or \
+                        attempt > self._max_retries or \
+                        remaining <= backoff:
+                    raise
+                # the failed roundtrip dropped the socket; the sleep
+                # then _ensure() is the backoff + reconnect
+                time.sleep(min(backoff, remaining))
+                backoff *= 2
 
     def _drop(self):
         if self._sock is not None:
@@ -223,14 +272,14 @@ class TcpConnection(Connection):
 
     def request_metadata(self, blocks: List[BlockId], timeout: float = 30.0
                          ) -> List[ShuffleTableMeta]:
-        resp, _ = self._roundtrip(
+        resp, _ = self._roundtrip_retrying(
             {"op": "metadata",
              "blocks": [_block_to_wire(b) for b in blocks]}, timeout)
         return [ShuffleTableMeta.from_json(m) for m in resp["metas"]]
 
     def request_chunk(self, block: BlockId, offset: int, length: int,
                       timeout: float = 30.0) -> bytes:
-        _, payload = self._roundtrip(
+        _, payload = self._roundtrip_retrying(
             {"op": "chunk", "block": _block_to_wire(block),
              "offset": offset, "length": length}, timeout)
         return payload

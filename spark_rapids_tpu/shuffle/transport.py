@@ -34,7 +34,15 @@ DEFAULT_MAX_INFLIGHT = 1 << 30      # inflight receive bytes throttle
 
 
 class TransportError(RuntimeError):
-    pass
+    """A shuffle transport request failed. ``retryable`` separates
+    transient transport faults (socket drop, timeout — safe to retry:
+    metadata/chunk reads are idempotent) from peer-reported semantic
+    errors (unknown block, server exception) where a retry would just
+    repeat the same answer."""
+
+    def __init__(self, msg: str = "", retryable: bool = True):
+        super().__init__(msg)
+        self.retryable = retryable
 
 
 # ---------------------------------------------------------------------------

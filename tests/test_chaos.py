@@ -1,0 +1,353 @@
+"""Chaos fence: OOM resilience end to end (ROADMAP item 3).
+
+Three guarantees, all on CPU CI:
+
+1. A query whose estimated working set is >= 4x an artificially small
+   device budget completes ORACLE-MATCHED through the retry ladder +
+   three-tier spill chain (device -> host -> compressed disk, async
+   writer), with nonzero spill counters.
+2. The same query under deterministic OOM injection (no budget cap)
+   also completes oracle-matched, with nonzero retry/split counters.
+3. An over-budget query submitted to the query service is ADMITTED in
+   flagged out-of-core mode — not parked in the queue — and the
+   shed-vs-run policy knob sheds it instead when asked.
+
+``scripts/chaos_check.py`` runs the same suite as a standalone CLI.
+"""
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.api import Session, col, functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory import fault_injection as FI
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory.catalog import get_catalog
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.optimizer import estimate_footprint_bytes
+from spark_rapids_tpu.service import (OutOfCoreRejected, QueryService,
+                                      QueryState)
+
+pytestmark = pytest.mark.chaos
+
+N_FACT = 40_000
+N_DIM = 64
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    FI.get_injector().disarm()
+    R.reset_config()
+    yield
+    FI.get_injector().disarm()
+    R.reset_config()
+
+
+def _frames(seed=11):
+    rng = np.random.default_rng(seed)
+    fact = pd.DataFrame({
+        "k": rng.integers(0, N_DIM, N_FACT).astype(np.int64),
+        "v": rng.random(N_FACT),
+        "w": rng.integers(0, 1000, N_FACT).astype(np.int64)})
+    dim = pd.DataFrame({
+        "k": np.arange(N_DIM, dtype=np.int64),
+        "cat": (np.arange(N_DIM, dtype=np.int64) % 7)})
+    return fact, dim
+
+
+def _q26_class(s, fact_df, dim_df):
+    """join + filter + groupby-agg + order by — the q26-class shape
+    that exercises join staging, aggregate update/merge and sort."""
+    return (fact_df.join(dim_df, on="k")
+            .filter(col("v") > 0.2)
+            .group_by("cat")
+            .agg(F.sum(col("v")).alias("sv"),
+                 F.count("*").alias("n"),
+                 F.max(col("w")).alias("mw"))
+            .order_by("cat"))
+
+
+def _oracle(fact, dim):
+    j = fact.merge(dim, on="k")
+    j = j[j["v"] > 0.2]
+    out = (j.groupby("cat")
+            .agg(sv=("v", "sum"), n=("v", "size"), mw=("w", "max"))
+            .reset_index()
+            .sort_values("cat")
+            .reset_index(drop=True))
+    return out
+
+
+def _assert_matches(got, want):
+    got = got.reset_index(drop=True)
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got["cat"].to_numpy(),
+                                  want["cat"].to_numpy())
+    np.testing.assert_allclose(got["sv"].to_numpy(dtype=float),
+                               want["sv"].to_numpy(dtype=float),
+                               rtol=1e-9)
+    np.testing.assert_array_equal(got["n"].to_numpy(dtype=np.int64),
+                                  want["n"].to_numpy(dtype=np.int64))
+    np.testing.assert_array_equal(got["mw"].to_numpy(dtype=np.int64),
+                                  want["mw"].to_numpy(dtype=np.int64))
+
+
+def test_four_x_over_budget_completes_oracle_matched(tmp_path):
+    """THE fence: working set >= 4x the device budget, tiny host tier
+    (so the chain reaches compressed disk), async spill writer on —
+    and the result still matches the CPU oracle.
+
+    The query is a row-level global sort above a join: SortExec stages
+    its WHOLE input as spillable chunks and, past its row budget, takes
+    the range-bucketed out-of-core path — the heavy rows genuinely
+    live in the catalog and must survive device -> host -> disk."""
+    rng = np.random.default_rng(11)
+    n = 150_000  # above the sort exec's 65536-row budget floor
+    fact = pd.DataFrame({
+        "k": rng.integers(0, N_DIM, n).astype(np.int64),
+        "v": rng.random(n),
+        "w": rng.integers(0, 1000, n).astype(np.int64)})
+    dim = pd.DataFrame({
+        "k": np.arange(N_DIM, dtype=np.int64),
+        "cat": (np.arange(N_DIM, dtype=np.int64) % 7)})
+
+    def sort_q(s):
+        return (s.create_dataframe(fact)
+                .join(s.create_dataframe(dim), on="k")
+                .filter(col("v") > 0.2)
+                .order_by("w", "k", "cat", "v"))
+
+    probe = Session()
+    plan = sort_q(probe)._plan
+    footprint = estimate_footprint_bytes(plan)
+    # staged bytes are the joined+filtered rows; half of that bounds
+    # the budget so the catalog MUST evict, and the 4x fence holds by
+    # construction (footprint >= staged input)
+    staged = int(n * 0.8) * (8 + 8 + 8 + 8 + 4)
+    budget = min(footprint // 4, staged // 2)
+    assert footprint >= 4 * budget > 0
+
+    s = Session({
+        cfg.DEVICE_BUDGET.key: budget,
+        cfg.HOST_SPILL_STORAGE_SIZE.key: max(budget // 2, 1 << 16),
+        cfg.SPILL_DIR.key: str(tmp_path),
+        cfg.SPILL_ASYNC_WRITE.key: True,
+    }, initialize_runtime=True)
+    try:
+        cat = s.runtime.catalog
+        assert cat.device_budget == budget and cat.async_spill
+        got = sort_q(s).collect()
+        cat.flush_spills()
+        j = fact.merge(dim, on="k")
+        want = (j[j["v"] > 0.2]
+                .sort_values(["w", "k", "cat", "v"], kind="stable")
+                .reset_index(drop=True))
+        got = got.reset_index(drop=True)[list(want.columns)]
+        for c in want.columns:
+            np.testing.assert_array_equal(
+                got[c].to_numpy(), want[c].to_numpy(),
+                err_msg=f"column {c}")
+        # the run must actually have gone through the spill chain
+        assert cat.spilled_device_bytes > 0
+        assert cat.spilled_host_bytes > 0  # disk tier reached
+    finally:
+        s.stop()
+
+
+def test_injected_oom_completes_oracle_matched():
+    """Deterministic RESOURCE_EXHAUSTED at the aggregate + join sites,
+    long enough bursts to force real splits — results still match."""
+    fact, dim = _frames(seed=5)
+    s = Session()
+    FI.arm_from_conf(RapidsConf({
+        cfg.FAULT_INJECTION_ENABLED.key: True,
+        cfg.FAULT_INJECTION_AT_CALL.key: 1,
+        cfg.FAULT_INJECTION_SITES.key: "aggregate.update,join.probe",
+        cfg.FAULT_INJECTION_CONSECUTIVE.key: 3,
+        cfg.FAULT_INJECTION_MAX.key: 6,
+    }))
+    pre = R.snapshot()
+    got = _q26_class(s, s.create_dataframe(fact),
+                     s.create_dataframe(dim)).collect()
+    d = R.delta(pre)
+    _assert_matches(got, _oracle(fact, dim))
+    inj = FI.get_injector().stats()
+    assert inj["injections"] > 0
+    assert d["oom_retries"] >= 2   # both spill rungs climbed
+    assert d["oom_splits"] >= 1    # and a genuine split happened
+    assert d["gave_ups"] == 0
+
+
+def test_injected_oom_probability_sweep_bounded():
+    """Probabilistic injection across every guarded site, bounded by
+    maxInjections: p=1.0 fails the first guarded call AND its first
+    spill retry, then the cap clears the ladder — still
+    oracle-matched. (The cap keeps the sweep below the give-up rung;
+    seeded sub-1.0 sweeps are the chaos_check CLI's domain.)"""
+    fact, dim = _frames(seed=8)
+    s = Session()
+    FI.get_injector().arm(probability=1.0, seed=42, consecutive=1,
+                          max_injections=2)
+    pre = R.snapshot()
+    got = _q26_class(s, s.create_dataframe(fact),
+                     s.create_dataframe(dim)).collect()
+    _assert_matches(got, _oracle(fact, dim))
+    assert FI.get_injector().stats()["injections"] == 2
+    assert R.delta(pre)["oom_retries"] == 2
+
+
+# -- out-of-core admission ---------------------------------------------------
+
+
+class _GateSource(pn.DataSource):
+    """Single-split source that blocks on an event — pins a query in
+    RUNNING deterministically."""
+
+    def __init__(self, rows=200):
+        self.rows = rows
+        self.gate = threading.Event()
+
+    def schema(self):
+        return Schema(["k", "v"], [dt.INT64, dt.FLOAT64])
+
+    def num_splits(self):
+        return 1
+
+    def split_origin(self, p):
+        return None
+
+    def split_stats(self, p):
+        return None
+
+    def estimated_row_count(self):
+        return self.rows
+
+    def read_host_split(self, p):
+        assert self.gate.wait(timeout=30), "gate never opened"
+        rng = np.random.default_rng(p)
+        return ({"k": rng.integers(0, 8, self.rows).astype(np.int64),
+                 "v": rng.random(self.rows)},
+                {"k": None, "v": None})
+
+
+def test_over_budget_query_admitted_out_of_core():
+    """Budget-exceeding query is admitted (flagged out-of-core) while
+    ANOTHER query is still inflight — not parked until the device
+    drains."""
+    small_src = _GateSource(rows=200)
+    small_plan = pn.ScanNode(small_src)
+    small_fp = estimate_footprint_bytes(small_plan)
+    budget = 4 * small_fp
+    s = Session()
+    rng = np.random.default_rng(2)
+    whale_df = s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 16, 50_000).astype(np.int64),
+        "v": rng.random(50_000)}))
+    whale_q = whale_df.group_by("k").agg(F.sum(col("v")).alias("sv"))
+    whale_fp = estimate_footprint_bytes(whale_q._plan)
+    assert whale_fp > budget > 2 * small_fp
+
+    svc = QueryService(RapidsConf({
+        cfg.SERVICE_ADMISSION_BUDGET.key: budget,
+        cfg.SERVICE_MAX_CONCURRENT.key: 4}))
+    try:
+        h_small = svc.submit(small_plan, tenant="a")
+        # whale: footprint > whole budget -> flagged out-of-core,
+        # charged half the budget, admitted NEXT TO the gated query
+        h_whale = svc.submit(whale_q, tenant="b")
+        got = h_whale.result(timeout=120)
+        assert h_small.poll() in (QueryState.RUNNING,
+                                  QueryState.ADMITTED)  # still gated
+        stats = svc.stats()
+        assert stats.counters["admitted_out_of_core"] >= 1
+        rec = [q for q in stats.per_query
+               if q["query_id"] == h_whale.query_id][0]
+        assert rec["out_of_core"] is True
+        # oracle parity for the whale
+        want = (whale_df.collect().groupby("k")
+                .agg(sv=("v", "sum")).reset_index()
+                .sort_values("k").reset_index(drop=True))
+        got = got.sort_values("k").reset_index(drop=True)
+        np.testing.assert_allclose(got["sv"], want["sv"], rtol=1e-9)
+        small_src.gate.set()
+        assert len(h_small.result(timeout=30)) == 200
+    finally:
+        small_src.gate.set()
+        svc.shutdown()
+        s.stop()
+
+
+def test_out_of_core_policy_shed_rejects():
+    s = Session()
+    rng = np.random.default_rng(3)
+    df = s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 16, 50_000).astype(np.int64),
+        "v": rng.random(50_000)}))
+    q = df.group_by("k").agg(F.sum(col("v")).alias("sv"))
+    fp = estimate_footprint_bytes(q._plan)
+    svc = QueryService(RapidsConf({
+        cfg.SERVICE_ADMISSION_BUDGET.key: fp // 8,
+        cfg.SERVICE_OUT_OF_CORE_POLICY.key: "shed"}))
+    try:
+        with pytest.raises(OutOfCoreRejected) as ei:
+            svc.submit(q, tenant="t")
+        assert ei.value.footprint == fp
+        assert svc.stats().counters["shed"] == 1
+    finally:
+        svc.shutdown()
+        s.stop()
+
+
+def test_out_of_core_disabled_keeps_legacy_wait():
+    """outOfCore.enabled=false restores the old behavior: the whale is
+    NOT flagged and simply waits for an empty device (it still runs
+    solo eventually)."""
+    s = Session()
+    rng = np.random.default_rng(4)
+    df = s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 16, 50_000).astype(np.int64),
+        "v": rng.random(50_000)}))
+    q = df.group_by("k").agg(F.sum(col("v")).alias("sv"))
+    fp = estimate_footprint_bytes(q._plan)
+    svc = QueryService(RapidsConf({
+        cfg.SERVICE_ADMISSION_BUDGET.key: fp // 8,
+        cfg.SERVICE_OUT_OF_CORE.key: False}))
+    try:
+        h = svc.submit(q, tenant="t")
+        h.result(timeout=120)  # empty device admits it solo
+        rec = [x for x in svc.stats().per_query
+               if x["query_id"] == h.query_id][0]
+        assert rec["out_of_core"] is False
+        assert svc.stats().counters["admitted_out_of_core"] == 0
+    finally:
+        svc.shutdown()
+        s.stop()
+
+
+def test_service_stats_carry_retry_counters():
+    """Injected OOM during a service-run query lands in ServiceStats:
+    per-query retry block + service-level counters."""
+    fact, dim = _frames(seed=9)
+    s = Session()
+    try:
+        FI.get_injector().arm(at_call=1, consecutive=1,
+                              sites=["aggregate"], max_injections=2)
+        h = _q26_class(s, s.create_dataframe(fact),
+                       s.create_dataframe(dim)).collect_async(
+            tenant="chaos")
+        got = h.result(timeout=120)
+        _assert_matches(got, _oracle(fact, dim))
+        stats = s.service.stats()
+        assert stats.counters["oom_retries"] >= 1
+        rec = [q for q in stats.per_query
+               if q["query_id"] == h.query_id][0]
+        assert rec["retry"]["oom_retries"] >= 1
+        assert stats.retry["totals"]["oom_retries"] >= 1
+    finally:
+        s.stop()

@@ -249,6 +249,191 @@ class TestCatalogRaces:
         assert not sem.holds()
 
 
+class TestCatalogConcurrentPressure:
+    """Satellite coverage (PR 6): the catalog under concurrent
+    unspill/release/remove_owner traffic, spill attempts against
+    acquired buffers, and disk-tier corruption detection."""
+
+    def test_unspill_races_release_and_remove_owner(self, tmp_path):
+        """Readers acquire (unspilling from disk) while another thread
+        sweeps remove_owner and a third keeps spilling: every acquire
+        that wins sees intact data; removal of acquired entries defers;
+        nothing deadlocks or leaks."""
+        from spark_rapids_tpu.memory.catalog import set_buffer_owner
+
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path))
+        owner = ("q", 1)
+        prev = set_buffer_owner(owner)
+        try:
+            ids = [cat.register(make_batch(seed=i, with_strings=True),
+                                OUTPUT_FOR_SHUFFLE_PRIORITY)
+                   for i in range(6)]
+        finally:
+            set_buffer_owner(prev)
+        cat.synchronous_spill(0)  # all to disk (host budget 0)
+        errors = []
+        stop = threading.Event()
+
+        def reader(bid):
+            try:
+                while not stop.is_set():
+                    try:
+                        b = cat.acquire(bid)
+                    except KeyError:
+                        return  # removed by the sweeper: fine
+                    try:
+                        assert b.realized_num_rows() == 100
+                    finally:
+                        cat.release(bid)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def spiller():
+            try:
+                for _ in range(20):
+                    cat.synchronous_spill(0)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader, args=(bid,))
+                   for bid in ids] + [threading.Thread(target=spiller)]
+        for t in threads:
+            t.start()
+        import time as _t
+
+        _t.sleep(0.1)
+        removed = cat.remove_owner(owner)  # races the readers
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors
+        assert removed == 6
+        # deferred removals complete once readers released
+        assert len(cat) == 0
+
+    def test_spill_skips_acquired_buffer_under_concurrency(self):
+        """A refcount>0 buffer never spills even while another thread
+        hammers synchronous_spill."""
+        cat = BufferCatalog()
+        pinned = cat.register(make_batch(seed=1),
+                              OUTPUT_FOR_SHUFFLE_PRIORITY)
+        victim = cat.register(make_batch(seed=2),
+                              OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.acquire(pinned)
+        spillers = [threading.Thread(
+            target=lambda: cat.synchronous_spill(0)) for _ in range(4)]
+        for t in spillers:
+            t.start()
+        for t in spillers:
+            t.join(10)
+        assert cat.tier_of(pinned) is StorageTier.DEVICE
+        assert cat.tier_of(victim) is StorageTier.HOST
+        cat.release(pinned)
+        cat.synchronous_spill(0)
+        assert cat.tier_of(pinned) is StorageTier.HOST
+
+    def test_truncated_spill_file_raises_clear_error(self, tmp_path):
+        """Disk-tier corruption (truncated file) surfaces as
+        SpillCorruptionError naming the buffer — never garbage rows."""
+        import os
+
+        from spark_rapids_tpu.memory import SpillCorruptionError
+
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path))
+        bid = cat.register(make_batch(seed=3, with_strings=True),
+                           OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.synchronous_spill(0)
+        assert cat.tier_of(bid) is StorageTier.DISK
+        path = os.path.join(str(tmp_path), f"spill-{bid}.srt")
+        size = os.path.getsize(path)
+        with open(path, "rb+") as f:
+            f.truncate(size // 2)
+        with pytest.raises(SpillCorruptionError,
+                           match=f"buffer {bid}"):
+            cat.acquire(bid)
+        # the entry survives the failed unspill and stays removable
+        cat.remove(bid)
+        assert bid not in cat
+
+    def test_bitflip_spill_file_fails_checksum(self, tmp_path):
+        from spark_rapids_tpu.memory import SpillCorruptionError
+
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path))
+        bid = cat.register(make_batch(seed=4),
+                           OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.synchronous_spill(0)
+        import os
+
+        path = os.path.join(str(tmp_path), f"spill-{bid}.srt")
+        with open(path, "rb+") as f:
+            f.seek(-1, os.SEEK_END)
+            last = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([last[0] ^ 0xFF]))
+        with pytest.raises(SpillCorruptionError):
+            cat.acquire(bid)
+
+
+class TestAsyncSpill:
+    def test_async_host_to_disk_commits_after_flush(self, tmp_path):
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path),
+                            async_spill=True)
+        b = make_batch(seed=21, with_strings=True)
+        bid = cat.register(b, OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.synchronous_spill(0)  # D2H inline, H2D handed to writer
+        cat.flush_spills()
+        assert cat.tier_of(bid) is StorageTier.DISK
+        assert cat.host_bytes == 0
+        got = cat.acquire(bid)
+        batch_equal(b, got)
+        cat.release(bid)
+        cat.remove(bid)
+        # close() ends the writer thread (no parked daemon pinning the
+        # catalog) and the catalog stays usable afterwards
+        writer_thread = cat._writer._thread
+        cat.close()
+        assert not writer_thread.is_alive()
+        bid2 = cat.register(make_batch(seed=22),
+                            OUTPUT_FOR_SHUFFLE_PRIORITY)
+        cat.synchronous_spill(0)
+        cat.flush_spills()
+        assert cat.tier_of(bid2) is StorageTier.DISK
+        cat.close()
+
+    def test_acquire_races_inflight_write(self, tmp_path):
+        """Acquiring while the writer still owns the host batch either
+        unspills from host (write aborts, file unlinked) or from the
+        committed disk file — both hand back intact data."""
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path),
+                            async_spill=True)
+        batches = {cat.register(make_batch(seed=30 + i),
+                                OUTPUT_FOR_SHUFFLE_PRIORITY): i
+                   for i in range(4)}
+        cat.synchronous_spill(0)
+        for bid in batches:
+            got = cat.acquire(bid)  # may race the in-flight write
+            assert got.realized_num_rows() == 100
+            cat.release(bid)
+        cat.flush_spills()
+        for bid in list(batches):
+            cat.remove(bid)
+        assert len(cat) == 0
+        cat.close()
+
+    def test_writer_backpressure_bounded_queue(self, tmp_path):
+        """A burst of evictions completes (the depth-2 queue blocks
+        the submitter, never drops or deadlocks)."""
+        cat = BufferCatalog(host_budget=0, spill_dir=str(tmp_path),
+                            async_spill=True)
+        ids = [cat.register(make_batch(seed=50 + i),
+                            OUTPUT_FOR_SHUFFLE_PRIORITY)
+               for i in range(10)]
+        cat.synchronous_spill(0)
+        cat.flush_spills()
+        assert all(cat.tier_of(bid) is StorageTier.DISK for bid in ids)
+        cat.close()
+
+
 def test_hashed_priority_queue():
     from spark_rapids_tpu.memory.hashed_pq import HashedPriorityQueue
 

@@ -1,0 +1,262 @@
+"""Split-and-retry framework + fault injection (memory/retry.py,
+memory/fault_injection.py) — the unit half of the OOM-resilience
+subsystem; tests/test_chaos.py is the end-to-end fence."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory import fault_injection as FI
+from spark_rapids_tpu.memory.catalog import (BufferCatalog,
+                                             set_buffer_owner)
+from spark_rapids_tpu.memory.oom import with_oom_retry
+
+
+OOM_MSG = "RESOURCE_EXHAUSTED: Out of memory allocating 1234 bytes"
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    FI.get_injector().disarm()
+    R.reset_config()
+    yield
+    FI.get_injector().disarm()
+    R.reset_config()
+
+
+def make_batch(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch(
+        [Column.from_numpy(rng.integers(0, 1000, n).astype(np.int64))],
+        n)
+
+
+class TestIsOomError:
+    def test_xla_resource_exhausted_matches(self):
+        assert R.is_oom_error(RuntimeError(OOM_MSG))
+        assert R.is_oom_error(RuntimeError(
+            "Resource exhausted: while allocating"))
+        assert R.is_oom_error(MemoryError())
+        assert R.is_oom_error(FI.InjectedOOM("site", 1))
+
+    def test_user_data_mentioning_oom_does_not_match(self):
+        # the old bare-substring scan classified these as device OOM
+        assert not R.is_oom_error(ValueError("column 'OOM' not found"))
+        assert not R.is_oom_error(KeyError("OOM"))
+        assert not R.is_oom_error(RuntimeError(
+            "parse error near token 'OOM'"))
+        assert not R.is_oom_error(RuntimeError(
+            "user wrote RESOURCE_EXHAUSTEDISH"))
+
+    def test_non_runtime_error_never_matches(self):
+        assert not R.is_oom_error(ValueError(OOM_MSG))
+
+
+class TestSpillLadder:
+    def test_spills_then_succeeds(self):
+        cat = BufferCatalog()
+        cat.register(make_batch(), priority=0)
+        before = cat.device_bytes
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError(OOM_MSG)
+            return "ok"
+
+        pre = R.snapshot()
+        assert R.with_retry_no_split(fn, catalog=cat, tag="t1") == "ok"
+        d = R.delta(pre)
+        assert d["oom_retries"] == 1
+        assert d["spilled_bytes"] == before  # spill-to-half spilled all
+        assert cat.device_bytes == 0
+
+    def test_give_up_chains_original_error(self):
+        cat = BufferCatalog()
+
+        def always_oom():
+            raise RuntimeError(OOM_MSG)
+
+        with pytest.raises(R.SplitAndRetryOOM) as ei:
+            R.with_retry_no_split(always_oom, catalog=cat, tag="t2")
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        assert "RESOURCE_EXHAUSTED" in str(ei.value.__cause__)
+
+    def test_non_oom_error_passes_through_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("not an OOM")
+
+        with pytest.raises(ValueError):
+            R.with_retry_no_split(fn, catalog=BufferCatalog())
+        assert len(calls) == 1  # no retry on a user error
+
+    def test_legacy_with_oom_retry_shim(self):
+        cat = BufferCatalog()
+        assert with_oom_retry(lambda: 42, catalog=cat) == 42
+        with pytest.raises(ValueError):
+            with_oom_retry(lambda: (_ for _ in ()).throw(ValueError()),
+                           catalog=cat)
+
+
+class TestSplitAndRetry:
+    def test_splits_until_fits(self):
+        """fn rejects items above a size bound; the ladder halves the
+        input until every part fits, and the parts cover the input."""
+        cat = BufferCatalog()
+
+        def fn(item):
+            if item[1] - item[0] > 25:
+                raise RuntimeError(OOM_MSG)
+            return item
+
+        def split(item):
+            lo, hi = item
+            if hi - lo <= 1:
+                return None
+            mid = (lo + hi) // 2
+            return [(lo, mid), (mid, hi)]
+
+        pre = R.snapshot()
+        out = R.with_retry((0, 100), fn, split=split, catalog=cat,
+                           tag="t3", max_spill_retries=0)
+        assert out[0][0] == 0 and out[-1][1] == 100
+        for (a, b), (c, d) in zip(out, out[1:]):
+            assert b == c  # contiguous cover, in order
+        assert all(b - a <= 25 for a, b in out)
+        assert R.delta(pre)["oom_splits"] >= 3
+
+    def test_split_depth_bound_gives_up(self):
+        def always_oom(item):
+            raise RuntimeError(OOM_MSG)
+
+        with pytest.raises(R.SplitAndRetryOOM):
+            R.with_retry((0, 1024), always_oom,
+                         split=lambda it: [(it[0], sum(it) // 2),
+                                           (sum(it) // 2, it[1])],
+                         catalog=BufferCatalog(), tag="t4",
+                         max_spill_retries=0, max_split_depth=3)
+
+    def test_halve_batch_covers_rows(self):
+        b = make_batch(101)
+        halves = R.halve_batch(b)
+        assert len(halves) == 2
+        assert halves[0].realized_num_rows() + \
+            halves[1].realized_num_rows() == 101
+        one = ColumnarBatch(b.columns, 1).slice(0, 1)
+        assert R.halve_batch(one) is None
+
+    def test_config_wiring(self):
+        conf = RapidsConf({"rapids.tpu.memory.retry.maxSpillRetries": 0,
+                           "rapids.tpu.memory.retry.maxSplitDepth": 0})
+        R.configure_from_conf(conf)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise RuntimeError(OOM_MSG)
+
+        with pytest.raises(R.SplitAndRetryOOM):
+            R.with_retry_no_split(fn, catalog=BufferCatalog())
+        assert len(calls) == 1  # zero spill rungs configured
+
+
+class TestFaultInjection:
+    def test_at_call_fires_deterministically(self):
+        inj = FI.get_injector()
+        inj.arm(at_call=2, consecutive=1)
+        inj.maybe_inject("a")  # call 1: clean
+        with pytest.raises(FI.InjectedOOM):
+            inj.maybe_inject("a")  # call 2: fires
+        inj.maybe_inject("a")  # burst over
+        assert inj.stats()["injections"] == 1
+
+    def test_sites_prefix_filter(self):
+        inj = FI.get_injector()
+        inj.arm(at_call=1, sites=["join"])
+        inj.maybe_inject("aggregate.update")  # ineligible
+        with pytest.raises(FI.InjectedOOM):
+            inj.maybe_inject("join.probe")
+
+    def test_consecutive_pushes_ladder_to_split(self):
+        """consecutive=3 fails the first try AND both spill retries,
+        forcing a genuine split; the halves then run clean."""
+        FI.get_injector().arm(at_call=1, consecutive=3)
+        cat = BufferCatalog()
+        pre = R.snapshot()
+        out = R.with_retry((0, 8), lambda it: it,
+                           split=lambda it: [(it[0], sum(it) // 2),
+                                             (sum(it) // 2, it[1])],
+                           catalog=cat, tag="x")
+        assert out == [(0, 4), (4, 8)]
+        d = R.delta(pre)
+        assert d["oom_retries"] == 2 and d["oom_splits"] == 1
+
+    def test_probability_mode_is_seeded(self):
+        def run(seed):
+            inj = FI.FaultInjector()
+            inj.arm(probability=0.5, seed=seed, max_injections=100)
+            fired = []
+            for i in range(50):
+                try:
+                    inj.maybe_inject("s")
+                    fired.append(False)
+                except FI.InjectedOOM:
+                    fired.append(True)
+            return fired
+
+        assert run(7) == run(7)
+        assert any(run(7)) and not all(run(7))
+
+    def test_max_injections_caps(self):
+        inj = FI.get_injector()
+        inj.arm(probability=1.0, seed=1, max_injections=2)
+        hits = 0
+        for _ in range(10):
+            try:
+                inj.maybe_inject("s")
+            except FI.InjectedOOM:
+                hits += 1
+        assert hits == 2
+
+    def test_arm_from_conf(self):
+        conf = RapidsConf({
+            "rapids.tpu.memory.faultInjection.enabled": True,
+            "rapids.tpu.memory.faultInjection.atCall": 1,
+            "rapids.tpu.memory.faultInjection.sites": "sort",
+        })
+        assert FI.arm_from_conf(conf)
+        inj = FI.get_injector()
+        with pytest.raises(FI.InjectedOOM):
+            inj.maybe_inject("sort.concat")
+        assert not FI.arm_from_conf(RapidsConf())
+        assert not FI.get_injector().armed
+
+
+class TestPerOwnerAccounting:
+    def test_owner_attribution_and_pop(self):
+        cat = BufferCatalog()
+        owner = ("svc-query", 991)
+        prev = set_buffer_owner(owner)
+        try:
+            calls = []
+
+            def fn():
+                calls.append(1)
+                if len(calls) == 1:
+                    raise RuntimeError(OOM_MSG)
+                return 1
+
+            R.with_retry_no_split(fn, catalog=cat, tag="owned")
+        finally:
+            set_buffer_owner(prev)
+        assert R.owner_stats(owner)["oom_retries"] == 1
+        popped = R.pop_owner_stats(owner)
+        assert popped["oom_retries"] == 1
+        assert R.owner_stats(owner)["oom_retries"] == 0  # popped

@@ -213,3 +213,68 @@ def test_serving_executor_spills_then_unspills_on_serve(tmp_path):
         assert it.remote_blocks_read == 3  # all served cross-executor
     finally:
         c.shutdown()
+
+
+def test_transient_fault_retries_with_backoff(tmp_path):
+    """Satellite (PR 6): a peer that hiccups — drops the connection on
+    the first two requests — costs bounded backoff + reconnect, NOT a
+    fetch failure and a whole stage re-run. A PERSISTENT fault still
+    exhausts the retry budget and surfaces as TransportError, and a
+    peer-reported semantic error is never retried."""
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+    from spark_rapids_tpu.shuffle.meta import BlockId
+    from spark_rapids_tpu.shuffle.tcp import (Hangup, TcpConnection,
+                                              TcpShuffleServer)
+    from spark_rapids_tpu.shuffle.transport import (ShuffleServer,
+                                                    TransportError)
+
+    cat = ShuffleBufferCatalog(BufferCatalog(spill_dir=str(tmp_path)))
+    block = BlockId(1, 0, 0)
+    cat.register(block, make_block_batch(0, 64))
+    server = ShuffleServer("exec-flaky", cat)
+    fails = {"n": 2, "seen": 0}
+
+    def flaky_metadata(blocks):
+        fails["seen"] += 1
+        if fails["seen"] <= fails["n"]:
+            raise Hangup()
+
+    server.on_metadata = flaky_metadata
+    ts = TcpShuffleServer(server)
+    try:
+        conn = TcpConnection(ts.host, ts.port)
+        import time as _t
+
+        t0 = _t.monotonic()
+        metas = conn.request_metadata([block], timeout=10.0)
+        took = _t.monotonic() - t0
+        assert len(metas) == 1 and metas[0].num_rows == 64
+        assert fails["seen"] == 3  # 2 hangups + the success
+        assert took < 5.0  # backoff stayed far under the timeout
+        # chunk fetch works over the recovered connection
+        data = conn.request_chunk(block, 0, metas[0].payload_len)
+        assert len(data) == metas[0].payload_len
+
+        # persistent fault: retry budget exhausts, error surfaces
+        fails["n"], fails["seen"] = 10_000, 0
+        with pytest.raises(TransportError):
+            conn.request_metadata([block], timeout=3.0)
+        assert fails["seen"] == 1 + TcpConnection.MAX_TRANSIENT_RETRIES
+
+        # semantic (peer-reported) error: exactly ONE attempt
+        server.on_metadata = None
+        missing = BlockId(9, 9, 9)
+        calls = {"n": 0}
+
+        def counting(blocks):
+            calls["n"] += 1
+
+        server.on_metadata = counting
+        with pytest.raises(TransportError) as ei:
+            conn.request_metadata([missing], timeout=3.0)
+        assert "not found" in str(ei.value)
+        assert calls["n"] == 1  # no retry of a semantic error
+        conn.close()
+    finally:
+        ts.close()
