@@ -1,15 +1,28 @@
-"""Concurrent-service benchmark: replay N TPC-H instances through the
-QueryService and report queue-time vs run-time (runner-JSON shaped).
+"""Concurrent-service benchmark: closed-loop replay AND open-loop
+sustained-load SLO sweeps over the QueryService (runner-JSON shaped).
 
 The single-query runner measures how fast ONE query goes; this measures
 how the SERVICE multiplexes many — the numbers that matter for the
-ROADMAP's serve-heavy-traffic goal: per-query queue time vs run time,
-shed counts under a bounded queue, and the cross-query compile-cache
-hit rate (instance 2..N of the same shape should be ~all hits).
+ROADMAP's serve-heavy-traffic goal. Two modes:
+
+- **closed loop** (default): submit N instances, wait for all. Reports
+  per-query queue-time vs run-time splits, shed counts under a bounded
+  queue, and the cross-query compile-cache hit rate (instance 2..N of
+  the same shape should be ~all hits).
+- **open loop** (``--open-loop``): Poisson arrivals at each offered
+  QPS in ``--qps`` — arrivals do NOT slow down because the service is
+  busy, which is what makes the p50/p99 queue+run latency and shed
+  rate at each rate a real SLO measurement (service/batching/slo).
+  Emits an ``SLO_r*``-style block with the ROADMAP item-4 criterion
+  (p99 total latency within ``--ratio`` x serial single-query time)
+  evaluated at the highest sustained rate.
 
     python -m spark_rapids_tpu.benchmarks.service_bench \
         --queries 8 --mix tpch_q1,tpch_q6 --tenants 2 --sf 0.01 \
         --data-dir /tmp/rapids_tpu_tpch --output service.json
+
+    python -m spark_rapids_tpu.benchmarks.service_bench --open-loop \
+        --qps 1,2,4 --queries 16 --warmup --sf 0.01
 """
 from __future__ import annotations
 
@@ -21,15 +34,39 @@ from typing import List, Optional
 from spark_rapids_tpu.config import RapidsConf
 
 
+def _serial_single_query_s(runner, mix: List[str],
+                           data_dir: str) -> dict:
+    """Warm serial reference per template (second run of two — the
+    first pays tracing/compiles), plus the max across the mix: the
+    denominator of the ratio-based SLO criterion."""
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.benchmarks.runner import ALL_BENCHMARKS
+
+    per = {}
+    for name in dict.fromkeys(mix):
+        dt = 0.0
+        for _ in range(2):
+            plan = ALL_BENCHMARKS[name](data_dir)
+            t0 = time.perf_counter()
+            collect(apply_overrides(plan, runner.conf))
+            dt = time.perf_counter() - t0
+        per[name] = round(dt, 4)
+    return {"per_template": per, "max_s": max(per.values())}
+
+
 def run_service_bench(data_dir: str, sf: float, queries: int = 8,
                       mix: Optional[List[str]] = None, tenants: int = 2,
-                      conf: Optional[RapidsConf] = None) -> dict:
-    """Submit ``queries`` instances round-robin over ``mix`` plans and
-    ``tenants`` submitter keys; returns the runner-style JSON record
-    with per-query queue/run splits and the ServiceStats snapshot."""
+                      conf: Optional[RapidsConf] = None,
+                      warmup: bool = False) -> dict:
+    """Closed loop: submit ``queries`` instances round-robin over
+    ``mix`` plans and ``tenants`` submitter keys; returns the
+    runner-style JSON record with per-query queue/run splits, latency
+    percentiles, and the ServiceStats snapshot."""
     from spark_rapids_tpu.benchmarks.runner import (ALL_BENCHMARKS,
                                                     BenchmarkRunner)
     from spark_rapids_tpu.service import QueryService, ServiceOverloaded
+    from spark_rapids_tpu.service.batching import slo
 
     mix = mix or ["tpch_q1", "tpch_q6"]
     conf = conf or RapidsConf()
@@ -38,6 +75,12 @@ def run_service_bench(data_dir: str, sf: float, queries: int = 8,
         runner.ensure_data(name)
 
     service = QueryService(conf)
+    warmup_report = None
+    if warmup:
+        for name in dict.fromkeys(mix):
+            service.register_template(ALL_BENCHMARKS[name](data_dir),
+                                      name)
+        warmup_report = service.warmup()
     t0 = time.perf_counter()
     handles = []
     shed = 0
@@ -66,7 +109,8 @@ def run_service_bench(data_dir: str, sf: float, queries: int = 8,
     service.shutdown()
     qt = [q["queue_time_s"] for q in per_query]
     rt = [q["run_time_s"] for q in per_query]
-    return {
+    tot = [a + b for a, b in zip(qt, rt)]
+    out = {
         "benchmark": "service_bench",
         "scale_factor": sf,
         "env": BenchmarkRunner._env(),
@@ -76,30 +120,116 @@ def run_service_bench(data_dir: str, sf: float, queries: int = 8,
         "wall_time_sec": round(wall, 3),
         "queue_time_sec": {"max": max(qt, default=0.0),
                            "mean": round(sum(qt) / len(qt), 4)
-                           if qt else 0.0},
+                           if qt else 0.0,
+                           "p50": round(slo.percentile(qt, 50), 4),
+                           "p99": round(slo.percentile(qt, 99), 4)},
         "run_time_sec": {"max": max(rt, default=0.0),
                          "mean": round(sum(rt) / len(rt), 4)
-                         if rt else 0.0},
+                         if rt else 0.0,
+                         "p50": round(slo.percentile(rt, 50), 4),
+                         "p99": round(slo.percentile(rt, 99), 4)},
+        "total_time_sec": {"p50": round(slo.percentile(tot, 50), 4),
+                           "p99": round(slo.percentile(tot, 99), 4)},
         "per_query": per_query,
         "shed_at_submit": shed,
         "service_stats": stats.to_dict(),
     }
+    if warmup_report is not None:
+        out["warmup"] = warmup_report
+    return out
+
+
+def run_slo_sweep(data_dir: str, sf: float,
+                  qps_list: List[float], queries_per_rate: int = 16,
+                  mix: Optional[List[str]] = None, tenants: int = 4,
+                  conf: Optional[RapidsConf] = None,
+                  warmup: bool = True, ratio: float = 3.0,
+                  seed: int = 7) -> dict:
+    """Open-loop offered-QPS sweep: Poisson arrivals at each rate in
+    ``qps_list`` (``queries_per_rate`` fresh instances each), through
+    ONE warmed service. Returns the ``SLO_r*``-style record."""
+    from spark_rapids_tpu.benchmarks.runner import (ALL_BENCHMARKS,
+                                                    BenchmarkRunner)
+    from spark_rapids_tpu.service import QueryService
+    from spark_rapids_tpu.service.batching import slo
+
+    mix = mix or ["tpch_q1", "tpch_q6"]
+    conf = conf or RapidsConf()
+    runner = BenchmarkRunner(data_dir, sf, conf=conf)
+    for name in dict.fromkeys(mix):
+        runner.ensure_data(name)
+    serial = _serial_single_query_s(runner, mix, data_dir)
+
+    service = QueryService(conf)
+    warmup_report = None
+    if warmup:
+        for name in dict.fromkeys(mix):
+            service.register_template(ALL_BENCHMARKS[name](data_dir),
+                                      name)
+        warmup_report = service.warmup()
+
+    def make_query(i: int):
+        return ALL_BENCHMARKS[mix[i % len(mix)]](data_dir)
+
+    sweep = []
+    for qps in qps_list:
+        sweep.append(slo.run_open_loop(
+            service, make_query, qps, queries_per_rate,
+            tenants=tenants, seed=seed))
+    stats = service.stats()
+    service.shutdown()
+    out = {
+        "benchmark": "service_slo",
+        "scale_factor": sf,
+        "env": BenchmarkRunner._env(),
+        "mix": mix,
+        "tenants": tenants,
+        "queries_per_rate": queries_per_rate,
+        "serial": serial,
+        "slo": slo.slo_block(sweep, serial["max_s"], ratio=ratio),
+        "service_stats": stats.to_dict(),
+    }
+    if warmup_report is not None:
+        out["warmup"] = warmup_report
+    return out
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--queries", type=int, default=8)
+    p.add_argument("--queries", type=int, default=8,
+                   help="closed loop: total; open loop: per rate")
     p.add_argument("--mix", default="tpch_q1,tpch_q6",
                    help="comma-separated benchmark names to cycle")
     p.add_argument("--tenants", type=int, default=2)
     p.add_argument("--sf", type=float, default=0.01)
     p.add_argument("--data-dir", default="/tmp/rapids_tpu_tpch")
     p.add_argument("--output", default=None)
+    p.add_argument("--warmup", action="store_true",
+                   help="register the mix as templates and AOT-warm "
+                        "before measuring")
+    p.add_argument("--open-loop", action="store_true",
+                   help="Poisson-arrival offered-QPS sweep instead of "
+                        "closed-loop replay")
+    p.add_argument("--qps", default="1,2,4",
+                   help="open loop: comma-separated offered rates")
+    p.add_argument("--ratio", type=float, default=3.0,
+                   help="open loop: SLO criterion = p99 total within "
+                        "ratio x serial single-query time")
+    p.add_argument("--seed", type=int, default=7)
     args = p.parse_args(argv)
-    result = run_service_bench(args.data_dir, args.sf,
-                               queries=args.queries,
-                               mix=args.mix.split(","),
-                               tenants=args.tenants)
+    if args.open_loop:
+        result = run_slo_sweep(
+            args.data_dir, args.sf,
+            qps_list=[float(q) for q in args.qps.split(",")],
+            queries_per_rate=args.queries, mix=args.mix.split(","),
+            tenants=args.tenants, warmup=args.warmup,
+            ratio=args.ratio, seed=args.seed)
+    else:
+        result = run_service_bench(args.data_dir, args.sf,
+                                   queries=args.queries,
+                                   mix=args.mix.split(","),
+                                   tenants=args.tenants,
+                                   warmup=args.warmup)
     text = json.dumps(result, indent=2)
     if args.output:
         with open(args.output, "w") as f:

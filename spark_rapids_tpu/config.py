@@ -641,6 +641,60 @@ SERVICE_OUT_OF_CORE_POLICY = conf("rapids.tpu.service.outOfCore.policy").doc(
     "them occupy the device for a long spill-bound run."
 ).string_conf.create_with_default("run")
 
+SERVICE_BATCHING_ENABLED = conf("rapids.tpu.service.batching.enabled").doc(
+    "Cross-tenant micro-batching: a stage-program dispatch inside a "
+    "service slice holds for batching.windowMs and coalesces with "
+    "compatible same-program same-bucket dispatches from OTHER queries "
+    "into one physical launch (per-query row-count scalars mask each "
+    "participant's padding; results split inside the same compiled "
+    "program). One launch then serves K tenants — the inference-"
+    "serving batching trick applied to SQL stages. The hold only "
+    "engages while more than one query is in flight."
+).boolean_conf.create_with_default(True)
+
+SERVICE_BATCHING_WINDOW_MS = conf(
+    "rapids.tpu.service.batching.windowMs").doc(
+    "Micro-batch hold window in milliseconds: how long a stage "
+    "dispatch waits for compatible peers before launching. Behind a "
+    "~100 ms-per-dispatch remote attachment a few ms buys up to a "
+    "K-fold dispatch reduction; keep it well under the backend RTT."
+).double_conf.create_with_default(2.0)
+
+SERVICE_BATCHING_MAX = conf("rapids.tpu.service.batching.maxBatch").doc(
+    "Maximum queries coalesced into one physical stage launch (a full "
+    "group launches immediately, before the window expires). Each "
+    "group size K compiles its own K-way program variant once, so "
+    "keep this small."
+).int_conf.create_with_default(8)
+
+SERVICE_BATCHING_BUCKET_GROWTH = conf(
+    "rapids.tpu.service.batching.bucketGrowth").doc(
+    "Growth factor of the geometric capacity-bucket ladder "
+    "(ops/buckets), installed process-wide at service construction. "
+    "2.0 = classic power-of-two buckets. Coarser (e.g. 4.0) funnels "
+    "more tenants onto the same compiled executables and coalescible "
+    "shapes at the cost of more padding lanes; finer (e.g. 1.5) "
+    "wastes less HBM but fragments the executable space. Padding is "
+    "masked by the per-batch row-count scalar either way."
+).double_conf.create_with_default(2.0)
+
+SERVICE_WARMUP_ENABLED = conf("rapids.tpu.service.warmup.enabled").doc(
+    "AOT-warm the compile caches when a query template is registered "
+    "(QueryService.register_template): the template runs once under a "
+    "reserved '__warmup__' tenant so its stage programs trace, "
+    "compile, and land in the persistent progcache BEFORE the first "
+    "tenant request — which otherwise eats the cold compile (behind "
+    "the remote-compile tunnel, minutes)."
+).boolean_conf.create_with_default(False)
+
+SERVICE_WARMUP_LADDER = conf("rapids.tpu.service.warmup.ladder").doc(
+    "After template warmup, replay each recorded stage program over "
+    "the capacity-ladder rungs at/below its observed bucket with "
+    "zero-filled operands (service/batching shape-bucket registry), "
+    "pre-compiling the executables smaller batches will hit. Only "
+    "applies when warmup.enabled is set."
+).boolean_conf.create_with_default(True)
+
 FILTER_PUSHDOWN_ENABLED = conf(
     "rapids.tpu.sql.format.pushDownFilters.enabled").doc(
     "Push comparison conjuncts from a Filter above a file scan into the "

@@ -169,25 +169,35 @@ def run_partitions(n_partitions: int, fn, task_threads: int = 4):
 
     from spark_rapids_tpu.memory.catalog import (current_buffer_owner,
                                                  set_buffer_owner)
+    from spark_rapids_tpu.service.batching import microbatch as _mb
     from spark_rapids_tpu.utils import dispatch as _disp
 
-    # propagate the caller's buffer-owner tag and dispatch query tag
-    # (both thread-local) onto the pool threads: a query-service slice
-    # that fans out here must have every batch the tasks register and
-    # every dispatch they issue attributed to its query, or
-    # cancel/deadline cleanup, stalled-query spill demotion, and
-    # ServiceStats per-query dispatch counts would miss pool work
+    # propagate the caller's buffer-owner tag, dispatch query tag and
+    # micro-batching slice context (all thread-local) onto the pool
+    # threads: a query-service slice that fans out here must have every
+    # batch the tasks register and every dispatch they issue attributed
+    # to its query — and its stage programs must stay coalescible — or
+    # cancel/deadline cleanup, stalled-query spill demotion,
+    # ServiceStats per-query dispatch counts and cross-query
+    # micro-batching would all miss pool work
     owner = current_buffer_owner()
     qid = _disp.current_query()
+    bctx = _mb.current()
     run = fn
-    if owner is not None or qid is not None:
-        def run(p, _fn=fn, _owner=owner, _qid=qid):
+    if owner is not None or qid is not None or bctx is not None:
+        def run(p, _fn=fn, _owner=owner, _qid=qid, _bctx=bctx):
             prev = set_buffer_owner(_owner) if _owner is not None \
                 else None
             qtok = _disp.enter_query(_qid)
+            btok = None
+            if _bctx is not None:
+                btok = _mb.enter_slice(_bctx.batcher, _bctx.query_id,
+                                       _bctx.multi)
             try:
                 return _fn(p)
             finally:
+                if _bctx is not None:
+                    _mb.exit_slice(btok)
                 _disp.exit_query(qtok)
                 if _owner is not None:
                     set_buffer_owner(prev)

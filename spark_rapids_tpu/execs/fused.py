@@ -57,10 +57,8 @@ from spark_rapids_tpu.execs.exchange import BroadcastExchangeExec
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference, ColV,
                                                EvalContext, Expression,
                                                Literal, broadcast)
-from spark_rapids_tpu.expressions.compiler import (_fused_cache_get,
-                                                   _fused_cache_put,
-                                                   _unwrap_alias,
-                                                   derive_stats)
+from spark_rapids_tpu.expressions.compiler import (
+    _unwrap_alias, derive_stats, fused_cache_get_or_build)
 from spark_rapids_tpu.ops import hashing, sortkeys
 from spark_rapids_tpu.ops import join as join_ops
 from spark_rapids_tpu.ops.join import _BUILD_NULL, _PROBE_NULL
@@ -596,6 +594,17 @@ def prepare_build(exch: BroadcastExchangeExec, build_keys: Sequence[int],
 # ---------------------------------------------------------------------------
 
 
+def _batching_ctx():
+    """The thread's micro-batching slice context, or None outside a
+    query-service slice (the common library path: one sys.modules hit
+    plus a thread-local read)."""
+    try:
+        from spark_rapids_tpu.service.batching import microbatch as _mb
+    except Exception:  # pragma: no cover - service package unavailable
+        return None
+    return _mb.current()
+
+
 @dataclasses.dataclass
 class _Ghost:
     """Host mirror of one working column during the ghost walk: what the
@@ -652,10 +661,12 @@ class FusedChain:
         if prog is not None:
             return prog
         key = self.chain_key(compact_out, modes, decode)
-        prog = _fused_cache_get(key)
-        if prog is None:
-            prog = self._build_program(compact_out, modes, decode)
-            _fused_cache_put(key, prog)
+        # single-flight: concurrent same-template queries (different
+        # tenants) racing a cold key trace it ONCE and share the
+        # program — the cross-tenant compile fence
+        prog = fused_cache_get_or_build(
+            key, lambda: self._build_program(compact_out, modes,
+                                             decode))
         self._programs[(compact_out, modes, decode)] = prog
         return prog
 
@@ -770,7 +781,13 @@ class FusedChain:
         ghost walk runs ONCE per batch, serving both the aux operand
         collection and the caller's output wrapping. ``batch`` may be a
         still-packed upload (interop.PackedBatch): the program then
-        inlines the transfer decode as its first traced steps."""
+        inlines the transfer decode as its first traced steps.
+
+        Under a query-service slice (service/batching context on this
+        thread) the launch routes through the micro-batcher: same-key
+        same-bucket dispatches from concurrent queries coalesce into
+        one physical program launch, and the shape-bucket registry logs
+        the (program, bucket) observation for warmup/stats."""
         from spark_rapids_tpu.execs import interop as _interop
 
         states, final_ghosts = self._ghost_states(batch, preps)
@@ -784,16 +801,35 @@ class FusedChain:
         aux = self._aux_from_states(states)
         if isinstance(batch, _interop.PackedBatch):
             decode = batch.decode_key()
-            outs, live = self._program(compact_out, modes, decode)(
-                tuple(batch.bufs), tuple(batch.dec_bases),
-                batch.num_rows_device(), build_ops, aux,
-                types=tuple(self.source_types))
+            prog = self._program(compact_out, modes, decode)
+            args = (tuple(batch.bufs), tuple(batch.dec_bases),
+                    batch.num_rows_device(), build_ops, aux)
         else:
-            outs, live = self._program(compact_out, modes)(
-                [c.data for c in batch.columns],
-                [c.validity for c in batch.columns],
-                batch.num_rows_device(), build_ops, aux,
-                types=tuple(self.source_types))
+            decode = ()
+            prog = self._program(compact_out, modes)
+            args = ([c.data for c in batch.columns],
+                    [c.validity for c in batch.columns],
+                    batch.num_rows_device(), build_ops, aux)
+        statics = {"types": tuple(self.source_types)}
+        ctx = _batching_ctx()
+        key = None if ctx is None else \
+            self.chain_key(compact_out, modes, decode)
+        if ctx is None or key is None:
+            # unkeyed chains (some step has no structural key) must NOT
+            # coalesce: the only stable identity would be id(prog), and
+            # a recycled object id after GC could hand another chain's
+            # cached K-way program back — silently wrong results
+            outs, live = prog(*args, **statics)
+        else:
+            reg = getattr(ctx.batcher, "registry", None)
+            if reg is not None and not decode:
+                # packed chains bake the decode capacity in as a
+                # static, so their shapes are not ladder-replayable.
+                # stream_args=2: leaves of (datas, vals) ride the
+                # ladder; build_ops/aux keep their recorded shapes
+                reg.record(key, prog, args, statics, stream_args=2)
+            outs, live = ctx.batcher.call(key, prog, args, statics,
+                                          ctx.query_id, ctx.multi)
         return outs, live, final_ghosts
 
     # -- host mirror --------------------------------------------------------

@@ -10,6 +10,7 @@ per-node evaluation (still device compute, host dictionary transforms).
 """
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Callable, List, Optional, Sequence
 
@@ -39,26 +40,78 @@ _FUSED_CACHE_MAX = 1024
 #: hit/miss telemetry surfaced by utils/progcache.stats(): a miss is a
 #: fresh trace (and, cold, an XLA compile); a None key can never cache
 _FUSED_CACHE_STATS = {"hits": 0, "misses": 0, "unkeyed": 0}
+#: single-flight coordination: key -> Event while a builder traces it.
+#: Guarded (with _FUSED_CACHE and its stats) by _FUSED_CACHE_LOCK —
+#: the cross-tenant compile fence requires that N concurrent queries
+#: racing one program key trace/compile it at most ONCE; the old
+#: unlocked get/build/put raced N tracers to the same slot.
+_FUSED_CACHE_LOCK = threading.Lock()
+_FUSED_BUILDING: dict = {}
 
 
 def _fused_cache_get(key):
     if key is None:
         _FUSED_CACHE_STATS["unkeyed"] += 1
         return None
-    fn = _FUSED_CACHE.get(key)
-    if fn is not None:
-        _FUSED_CACHE_STATS["hits"] += 1
-    else:
-        _FUSED_CACHE_STATS["misses"] += 1
-    return fn
+    with _FUSED_CACHE_LOCK:
+        fn = _FUSED_CACHE.get(key)
+        if fn is not None:
+            _FUSED_CACHE_STATS["hits"] += 1
+        else:
+            _FUSED_CACHE_STATS["misses"] += 1
+        return fn
 
 
 def _fused_cache_put(key, fn):
     if key is None:
         return
-    if len(_FUSED_CACHE) >= _FUSED_CACHE_MAX:
-        _FUSED_CACHE.clear()  # crude bound; keys are tiny, fns are jits
-    _FUSED_CACHE[key] = fn
+    with _FUSED_CACHE_LOCK:
+        if len(_FUSED_CACHE) >= _FUSED_CACHE_MAX:
+            _FUSED_CACHE.clear()  # crude bound; keys tiny, fns are jits
+        _FUSED_CACHE[key] = fn
+
+
+def fused_cache_get_or_build(key, builder):
+    """Single-flight lookup: at most one thread runs ``builder()`` per
+    key; concurrent losers WAIT for the winner's program and count as
+    hits (they got the shared executable — the multi-tenant outcome
+    the progcache hit-rate fence measures). A failed build releases the
+    key so a later caller may retry."""
+    if key is None:
+        _FUSED_CACHE_STATS["unkeyed"] += 1
+        return builder()
+    while True:
+        with _FUSED_CACHE_LOCK:
+            fn = _FUSED_CACHE.get(key)
+            if fn is not None:
+                _FUSED_CACHE_STATS["hits"] += 1
+                return fn
+            ev = _FUSED_BUILDING.get(key)
+            if ev is None:
+                ev = _FUSED_BUILDING[key] = threading.Event()
+                _FUSED_CACHE_STATS["misses"] += 1
+                building = True
+            else:
+                building = False
+        if not building:
+            # the winner is tracing: wait, then loop to pick its
+            # program up (or claim the build if it failed)
+            ev.wait(timeout=120)
+            continue
+        try:
+            fn = builder()
+        except BaseException:
+            with _FUSED_CACHE_LOCK:
+                _FUSED_BUILDING.pop(key, None)
+            ev.set()
+            raise
+        with _FUSED_CACHE_LOCK:
+            if len(_FUSED_CACHE) >= _FUSED_CACHE_MAX:
+                _FUSED_CACHE.clear()
+            _FUSED_CACHE[key] = fn
+            _FUSED_BUILDING.pop(key, None)
+        ev.set()
+        return fn
 
 
 def _unwrap_alias(e: Expression) -> Expression:
@@ -192,10 +245,8 @@ class CompiledProjection:
                            for e in self.exprs)
             if all(k is not None for k in kparts):
                 key = ("projection", kparts)
-            self._jit = _fused_cache_get(key)
-            if self._jit is None:
-                self._jit = self._build_fused()
-                _fused_cache_put(key, self._jit)
+            self._jit = fused_cache_get_or_build(key,
+                                                 self._build_fused)
 
     def _build_fused(self):
         exprs = self.exprs
@@ -288,25 +339,23 @@ class CompiledFilter:
             cond = condition
             key = condition.tree_key()
             key = ("filter", key) if key is not None else None
-            self._mask = _fused_cache_get(key)
-            if self._mask is not None:
-                return
 
-            @partial(jax.jit, static_argnames=("types",))
-            def run_mask(datas, validities, num_rows, task, types):
-                capacity = datas[0].shape[0] if datas else 128
-                cols = [ColV(t, d, v) for (t, d, v) in
-                        zip(types, datas, validities)]
-                ctx = EvalContext(cols, capacity, num_rows, in_jit=True,
-                                  task_info=task)
-                v = broadcast(cond.eval(ctx), ctx)
-                keep = v.data
-                if v.validity is not None:
-                    keep = keep & v.validity
-                return keep
+            def build_mask():
+                @partial(jax.jit, static_argnames=("types",))
+                def run_mask(datas, validities, num_rows, task, types):
+                    capacity = datas[0].shape[0] if datas else 128
+                    cols = [ColV(t, d, v) for (t, d, v) in
+                            zip(types, datas, validities)]
+                    ctx = EvalContext(cols, capacity, num_rows,
+                                      in_jit=True, task_info=task)
+                    v = broadcast(cond.eval(ctx), ctx)
+                    keep = v.data
+                    if v.validity is not None:
+                        keep = keep & v.validity
+                    return keep
+                return run_mask
 
-            self._mask = run_mask
-            _fused_cache_put(key, run_mask)
+            self._mask = fused_cache_get_or_build(key, build_mask)
 
     def __getstate__(self):
         return {"condition": self.condition, "conf": self.conf}

@@ -9,20 +9,86 @@ device scalar, masking padding lanes. This bounds compilation to
 O(log(max_rows)) variants per kernel and keeps the last-dim/lane layout
 friendly (multiples of 128).
 
+The menu is a GEOMETRIC LADDER: rungs grow by a configurable factor
+(default 2.0 = the classic power-of-two buckets), each rounded up to a
+multiple of the 128-lane width. The serving layer
+(service/batching) tunes the factor as the sharing-vs-padding knob:
+a coarser ladder (e.g. 4.0) funnels more concurrent tenants onto the
+same compiled executables at the cost of more padding lanes; a finer
+one (e.g. 1.5) wastes less HBM but fragments the executable space.
+
 Reference contrast: SURVEY.md §7 "Dynamic shapes vs XLA".
 """
 from __future__ import annotations
 
+from typing import List
+
 # TPU lane width; also keeps tiny arrays out of degenerate layouts.
 MIN_CAPACITY = 128
 
+#: ladder growth factor; 2.0 = power-of-two buckets (the historical
+#: behavior and the fast path below). Configured process-wide via
+#: set_ladder_growth (rapids.tpu.service.batching.bucketGrowth).
+_LADDER_GROWTH = 2.0
+
+#: floor on the growth factor: below ~1.13 the next 128-aligned rung
+#: above MIN_CAPACITY would equal the current one and the ladder
+#: could stall (rung *must* strictly increase)
+_MIN_GROWTH = 1.125
+
+
+def set_ladder_growth(growth: float) -> float:
+    """Install the process-wide ladder growth factor; returns the value
+    actually installed (clamped to the stall floor). One ladder per
+    process: capacities are compared across every subsystem (concat,
+    slice, shuffle), so two coexisting ladders would break the
+    all-columns-share-one-capacity batch invariant."""
+    global _LADDER_GROWTH
+    _LADDER_GROWTH = max(float(growth), _MIN_GROWTH)
+    return _LADDER_GROWTH
+
+
+def ladder_growth() -> float:
+    return _LADDER_GROWTH
+
+
+def _next_rung(cap: int) -> int:
+    """Smallest 128-aligned rung strictly above ``cap``."""
+    grown = int(cap * _LADDER_GROWTH)
+    aligned = -(-grown // MIN_CAPACITY) * MIN_CAPACITY
+    return max(aligned, cap + MIN_CAPACITY)
+
 
 def bucket_capacity(n: int) -> int:
-    """Smallest power-of-two capacity >= n (>= MIN_CAPACITY)."""
+    """Smallest ladder capacity >= n (>= MIN_CAPACITY)."""
     if n <= MIN_CAPACITY:
         return MIN_CAPACITY
-    return 1 << (int(n - 1).bit_length())
+    if _LADDER_GROWTH == 2.0:
+        # fast path: power-of-two ladder (every rung is 128 * 2^i)
+        return 1 << (int(n - 1).bit_length())
+    cap = MIN_CAPACITY
+    while cap < n:
+        cap = _next_rung(cap)
+    return cap
+
+
+def ladder_rungs(max_capacity: int) -> List[int]:
+    """Every ladder rung from MIN_CAPACITY up to and including the
+    bucket of ``max_capacity`` — the shapes a warmed service
+    pre-compiles its stage programs over (service/batching)."""
+    top = bucket_capacity(max(max_capacity, 1))
+    rungs = [MIN_CAPACITY]
+    while rungs[-1] < top:
+        if _LADDER_GROWTH == 2.0:
+            rungs.append(rungs[-1] * 2)
+        else:
+            rungs.append(_next_rung(rungs[-1]))
+    return rungs
 
 
 def is_bucketed(capacity: int) -> bool:
-    return capacity >= MIN_CAPACITY and (capacity & (capacity - 1)) == 0
+    if capacity < MIN_CAPACITY:
+        return False
+    if _LADDER_GROWTH == 2.0:
+        return (capacity & (capacity - 1)) == 0
+    return capacity == bucket_capacity(capacity)

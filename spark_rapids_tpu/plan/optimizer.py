@@ -632,12 +632,18 @@ def estimate_footprint_bytes(plan: pn.PlanNode,
     estimate assume ``default_rows``. Deliberately coarse and
     conservative — admission needs an upper-bound-shaped number, not a
     point estimate; the spill catalog is the real enforcement."""
+    from spark_rapids_tpu.ops.buckets import bucket_capacity
+
     resident = 0  # exchange/aggregate materializations live across stages
 
     def bytes_of(node: pn.PlanNode) -> int:
         rows = estimate_rows(node)
-        return max(rows if rows is not None else default_rows, 1) * \
-            _row_width(node)
+        rows = max(rows if rows is not None else default_rows, 1)
+        # BUCKETED, not raw: device columns are padded to the capacity
+        # ladder (ops/buckets), so the bytes a node actually pins are
+        # the bucket's, not the row count's — an estimate off by up to
+        # a full growth factor would under-admit against real HBM
+        return bucket_capacity(rows) * _row_width(node)
 
     def walk(node: pn.PlanNode, seen) -> int:
         """Peak transient bytes of the subtree rooted at node."""

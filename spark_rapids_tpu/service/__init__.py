@@ -20,10 +20,18 @@ package IS that scheduler:
   per-stage programs on the single dispatch path — cooperative yields
   at stage boundaries, cancellation/deadline checks between stages,
   and spill-priority demotion for batches owned by stalled queries.
-- ``ServiceStats`` (stats.py): queue depth, queue/run-time histograms,
-  admitted/shed/cancelled counts, per-query dispatch counts, and the
-  cross-tenant compile-cache hit rate (shared programs are the
-  multi-tenant win: tenant B's q1 reuses tenant A's executables).
+- ``ServiceStats`` (stats.py): queue depth, queue/run-time histograms
+  with p50/p95/p99, admitted/shed/cancelled counts, per-query dispatch
+  counts, and the cross-tenant compile-cache hit rate (shared programs
+  are the multi-tenant win: tenant B's q1 reuses tenant A's
+  executables).
+- ``batching/`` (the serving layer — docs/service.md "Micro-batching
+  & SLOs"): shape-bucket registry + AOT warmup
+  (``QueryService.register_template``), the micro-batcher coalescing
+  compatible stage dispatches from different queries into one physical
+  launch, and the open-loop Poisson SLO harness behind
+  ``benchmarks/service_bench.py --open-loop`` and
+  ``scripts/slo_check.py``.
 """
 from spark_rapids_tpu.service.types import (DeadlineExceeded,  # noqa: F401
                                             OutOfCoreRejected,
@@ -32,7 +40,10 @@ from spark_rapids_tpu.service.types import (DeadlineExceeded,  # noqa: F401
 from spark_rapids_tpu.service.query_service import \
     QueryService  # noqa: F401
 from spark_rapids_tpu.service.stats import ServiceStats  # noqa: F401
+from spark_rapids_tpu.service.batching import (MicroBatcher,  # noqa: F401
+                                               ShapeBucketRegistry)
 
 __all__ = ["QueryService", "QueryHandle", "QueryState",
            "ServiceOverloaded", "OutOfCoreRejected", "DeadlineExceeded",
-           "QueryCancelled", "ServiceStats"]
+           "QueryCancelled", "ServiceStats", "MicroBatcher",
+           "ShapeBucketRegistry"]

@@ -1,0 +1,31 @@
+"""Cross-tenant micro-batching serving layer.
+
+The inference-serving batching playbook applied to SQL stage programs
+(ROADMAP item 4 — "make concurrency fast"):
+
+- ``buckets.py``: the shape-bucket registry. Batch capacities already
+  ride a geometric ladder (ops/buckets); the registry pins the
+  consequence — concurrent queries from different tenants hit the SAME
+  compiled executable by construction — by recording every
+  (stage program, bucket shape) the service dispatches, exposing the
+  per-bucket sharing stats through ``utils/progcache.stats()``, and
+  replaying recorded programs across the ladder rungs for AOT warmup
+  (``rapids.tpu.service.warmup.*``).
+- ``microbatch.py``: the micro-batcher. A stage dispatch holds for a
+  bounded window (``rapids.tpu.service.batching.windowMs``) and
+  compatible same-bucket stage slices from different queries coalesce
+  into ONE physical program launch (per-query row-count scalars mask
+  each participant's padding); results split back out inside the same
+  compiled program and dispatch telemetry attributes the launch once
+  globally and fractionally per participant.
+- ``slo.py``: the sustained-load harness. Open-loop (Poisson-arrival)
+  offered-QPS sweeps with p50/p95/p99 queue+run latency and shed rate,
+  feeding ``benchmarks/service_bench.py`` and the
+  ``scripts/slo_check.py`` fence.
+"""
+from spark_rapids_tpu.service.batching.buckets import (  # noqa: F401
+    ShapeBucketRegistry, get_registry)
+from spark_rapids_tpu.service.batching.microbatch import (  # noqa: F401
+    MicroBatcher)
+
+__all__ = ["ShapeBucketRegistry", "get_registry", "MicroBatcher"]

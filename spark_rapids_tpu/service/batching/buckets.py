@@ -1,0 +1,221 @@
+"""Shape-bucket registry: executable sharing as a measured, warmable
+property.
+
+Stage programs are jitted over BUCKETED operand shapes (ops/buckets
+pads every device column to a geometric-ladder capacity and carries the
+true row count as a masked device scalar), so two tenants running the
+same query template compile NOTHING after the first — their operand
+shapes collide on the same ladder rung and XLA's executable cache plus
+the chain-key program cache (expressions/compiler) hand back the same
+compiled program. This module makes that sharing:
+
+- **observable**: every service-path stage dispatch records its
+  (program key, bucket shape); ``stats()`` reports distinct programs,
+  distinct (program, bucket) executables, and the observation/compile
+  split — surfaced through ``utils/progcache.stats()`` next to the
+  chain-key hit rate the fence asserts on;
+- **warmable**: ``warm()`` replays each recorded program over the
+  ladder rungs at/below its observed bucket with zero-filled operands,
+  so a service that registered its query templates at startup
+  (``rapids.tpu.service.warmup.enabled``) compiles the whole ladder
+  before the first tenant request arrives (ROADMAP item 2's AOT-warm).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.ops import buckets as _ladder
+
+
+class _ProgramSpec:
+    """One recorded (program, operand-shape) point: enough to replay
+    the call at other ladder rungs. ``stream_cap`` is the leading dim
+    of the stage's streaming operands — the axis the ladder buckets;
+    only the first ``n_stream_leaves`` leaves (the caller's streaming
+    args) resize on replay, so a build-side operand that merely
+    COINCIDES with the stream capacity keeps its recorded shape."""
+
+    __slots__ = ("prog", "treedef", "leaf_spec", "statics",
+                 "stream_cap", "n_stream_leaves")
+
+    def __init__(self, prog, treedef, leaf_spec, statics, stream_cap,
+                 n_stream_leaves):
+        self.prog = prog
+        self.treedef = treedef
+        self.leaf_spec = leaf_spec    # [("arr", shape, dtype) | ("val", v)]
+        self.statics = statics
+        self.stream_cap = stream_cap
+        self.n_stream_leaves = n_stream_leaves
+
+
+class ShapeBucketRegistry:
+    """Thread-safe observation log + warm replayer. Bounded: a
+    long-lived service must not pin one spec per program x bucket
+    forever (specs hold jitted callables, which hold device constants);
+    the observation COUNTS stay exact past the bound."""
+
+    MAX_SPECS = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: (program_key, bucket) -> observation count
+        self._seen: Dict[Tuple, int] = {}
+        #: program_key -> replayable spec at the largest observed bucket
+        self._specs: Dict[Tuple, _ProgramSpec] = {}
+        self._warmed: set = set()     # (program_key, bucket) replayed
+        self._warm_compiles = 0
+
+    # -- observation (hot path: one dict bump per stage dispatch) ---------
+
+    def record(self, program_key, prog, args, statics,
+               stream_args: int = 1) -> None:
+        """Log a service-path stage dispatch. ``args`` is the program's
+        positional operand pytree; the bucket is the leading dimension
+        of its first array leaf (the stage's streaming capacity).
+        ``stream_args``: how many leading positional args carry the
+        STREAMING operands — only their leaves resize on warm replay."""
+        import jax.tree_util as tu
+
+        leaves, treedef = tu.tree_flatten(args)
+        n_stream = len(tu.tree_flatten(tuple(args[:stream_args]))[0])
+        stream_cap = None
+        leaf_spec = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            if shape is not None and getattr(leaf, "dtype", None) \
+                    is not None:
+                if stream_cap is None and len(shape) >= 1:
+                    stream_cap = int(shape[0])
+                leaf_spec.append(("arr", tuple(shape), leaf.dtype))
+            else:
+                leaf_spec.append(("val", leaf))
+        if stream_cap is None:
+            return
+        key = (program_key, stream_cap)
+        with self._lock:
+            self._seen[key] = self._seen.get(key, 0) + 1
+            keep = program_key not in self._specs or \
+                self._specs[program_key].stream_cap < stream_cap
+            if keep and len(self._specs) < self.MAX_SPECS:
+                self._specs[program_key] = _ProgramSpec(
+                    prog, treedef, leaf_spec, statics, stream_cap,
+                    n_stream)
+
+    # -- warm replay -------------------------------------------------------
+
+    @staticmethod
+    def _zero_args(spec: _ProgramSpec, rung: Optional[int] = None):
+        """Rebuild the recorded operand pytree with zero-filled arrays;
+        STREAMING array leaves (the first ``n_stream_leaves``, at the
+        stream capacity) resize to ``rung`` (None keeps the observed
+        bucket); build-side leaves and scalar leaves keep their
+        recorded shapes/values."""
+        import jax.numpy as jnp
+
+        leaves = []
+        for i, (kind, *info) in enumerate(spec.leaf_spec):
+            if kind == "val":
+                leaves.append(info[0])
+                continue
+            shape, dtype = info
+            if rung is not None and i < spec.n_stream_leaves and \
+                    shape and shape[0] == spec.stream_cap:
+                shape = (rung,) + tuple(shape[1:])
+            leaves.append(jnp.zeros(shape, dtype=dtype))
+        return spec.treedef.unflatten(leaves)
+
+    def replay_specs(self):
+        """[(program_key, prog, zero_args_at_observed_bucket, statics)]
+        for every recorded program — the micro-batcher pre-compiles its
+        K-way coalesced variants from these at warmup."""
+        with self._lock:
+            specs = list(self._specs.items())
+        return [(key, s.prog, self._zero_args(s), s.statics)
+                for key, s in specs]
+
+    def warm(self, max_rung: Optional[int] = None) -> dict:
+        """Replay every recorded program over the ladder rungs at/below
+        its observed bucket (bounded by ``max_rung``) with zero-filled
+        operands: each replay forces the XLA compile for that
+        (program, bucket) executable, so the compiles land at startup
+        instead of under the first tenant whose batch hits the rung.
+        Returns {"programs", "replays", "errors"}."""
+        with self._lock:
+            specs = list(self._specs.items())
+        replays = errors = 0
+        for program_key, spec in specs:
+            rungs = _ladder.ladder_rungs(spec.stream_cap)
+            for rung in rungs:
+                if max_rung is not None and rung > max_rung:
+                    continue
+                mark = (program_key, rung)
+                with self._lock:
+                    if mark in self._warmed:
+                        continue
+                    if rung == spec.stream_cap and \
+                            (program_key, rung) in self._seen:
+                        # organically observed = already compiled
+                        self._warmed.add(mark)
+                        continue
+                args = self._zero_args(spec, rung)
+                try:
+                    out = spec.prog(*args, **spec.statics)
+                    # block so the compile definitely happened before
+                    # warmup reports done (async dispatch would defer
+                    # it to the first real request)
+                    import jax
+
+                    jax.block_until_ready(out)
+                    replays += 1
+                    # mark only on SUCCESS: a transiently-failed replay
+                    # must stay retryable by the next warmup() call,
+                    # not be silently skipped forever (worst case of a
+                    # concurrent double-warm is one duplicate compile)
+                    with self._lock:
+                        self._warmed.add(mark)
+                except Exception:
+                    # a program whose trace depends on operand VALUES
+                    # (not shapes) may reject zeros; warmup is advisory
+                    errors += 1
+        return {"programs": len(specs), "replays": replays,
+                "errors": errors}
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Sharing effectiveness per the bucket discipline: every
+        observation past the first of a (program, bucket) pair reused a
+        compiled executable instead of creating one."""
+        with self._lock:
+            observations = sum(self._seen.values())
+            executables = len(self._seen)
+            programs = len({k for k, _b in self._seen})
+            warmed = len(self._warmed)
+        reuses = observations - executables
+        return {
+            "programs": programs,
+            "bucket_executables": executables,
+            "observations": observations,
+            "bucket_reuses": max(reuses, 0),
+            "bucket_reuse_rate": round(reuses / observations, 4)
+            if observations else 0.0,
+            "warmed": warmed,
+            "ladder_growth": _ladder.ladder_growth(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._seen.clear()
+            self._specs.clear()
+            self._warmed.clear()
+
+
+#: process-global registry, mirroring the process-global program caches
+#: it measures (two services in one process share executables, so they
+#: share the ledger too)
+_REGISTRY = ShapeBucketRegistry()
+
+
+def get_registry() -> ShapeBucketRegistry:
+    return _REGISTRY

@@ -1,0 +1,395 @@
+"""Micro-batcher: coalesce compatible stage dispatches across queries.
+
+Behind a remote device attachment every program launch pays ~100 ms of
+fixed round-trip overhead (BASELINE.md), so N concurrent tenants each
+dispatching the SAME stage program over the SAME bucket shape pay N
+round trips for work one launch could carry. This is the
+inference-serving continuous-batching trick applied to SQL: a stage
+dispatch entering the service holds for a bounded window
+(``rapids.tpu.service.batching.windowMs``); compatible dispatches from
+other queries that arrive inside the window join the group; the group
+leader then executes ONE jitted program that runs every participant's
+stage — each with its own operands and row-count scalar masking its
+own padding — and splits the results back out inside the same compiled
+program (no per-participant slicing dispatches).
+
+Compatibility = same program key (the structural chain key from
+execs/fused — shared across plan instances and tenants by
+construction), same operand tree structure, and same bucketed operand
+shapes. The coalesced K-way program is built from the chain program's
+RAW traceable function (``prog.__wrapped__``) so the inner program
+inlines instead of nesting a jit, and is cached per
+(program key, signature, K) — the ladder bounds the shape space, K is
+bounded by ``maxBatch``, so the variant count stays small.
+
+Deadlock-freedom: a leader never waits on other participants — it
+seals its group at the window deadline regardless — and participants
+wait only on their leader, who is by construction not waiting on them.
+Workers hold no service lock inside the batcher, and every thread
+RELEASES its device-entry permit (TpuSemaphore) while parked in the
+batcher, re-acquiring before device work resumes: the engine-wide
+invariant is that nobody holds a permit while waiting on other
+threads, and a leader holding one through its window would block the
+very peers it is waiting for at the device door (measured: with
+concurrentTpuTasks=2, two window-holders starved the third query's
+compatible dispatch until both windows expired — zero coalescing).
+
+Attribution: the physical launch counts ONCE in the global dispatch
+telemetry; each participating query's ledger records a fractional
+share (1/K — per-query counts sum to the physical launch count) plus
+one entry in its coalesced-participation counter
+(utils/dispatch.enter_coalesced).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.utils import dispatch as _disp
+
+#: ceiling on how long a participant waits for its leader to execute —
+#: generous (the leader's wait is window-bounded; past this something
+#: is genuinely wedged and failing the slice beats hanging the worker)
+_PARTICIPANT_TIMEOUT_S = 120.0
+
+
+class _SliceContext:
+    """Thread-local marker a scheduler slice (and the task threads it
+    fans out to) carries: which batcher to route stage dispatches
+    through, which query to attribute them to, and whether holding for
+    coalescing can possibly pay (another query is in flight)."""
+
+    __slots__ = ("batcher", "query_id", "multi")
+
+    def __init__(self, batcher, query_id, multi):
+        self.batcher = batcher
+        self.query_id = query_id
+        self.multi = multi
+
+
+_tls = threading.local()
+
+
+def enter_slice(batcher, query_id, multi: bool):
+    """Install the batching context on this thread; returns a token for
+    ``exit_slice``. ``multi`` False keeps the hold window off (a solo
+    query must not pay windowMs per dispatch waiting for peers that
+    cannot exist)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = _SliceContext(batcher, query_id, multi) \
+        if batcher is not None else None
+    return prev
+
+
+def exit_slice(token) -> None:
+    _tls.ctx = token
+
+
+def current() -> Optional[_SliceContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def _semaphore():
+    try:
+        from spark_rapids_tpu.memory import semaphore as sem
+
+        return sem.get()
+    except Exception:  # pragma: no cover - memory package unavailable
+        return None
+
+
+def _quantize_group(k: int, max_batch: int) -> int:
+    """Next power of two >= k, capped at max_batch."""
+    q = 1
+    while q < k:
+        q *= 2
+    return min(q, max_batch)
+
+
+class _Group:
+    """One forming micro-batch: the leader's + joiners' call slots."""
+
+    __slots__ = ("slots", "sealed", "done", "results", "error")
+
+    def __init__(self):
+        self.slots: List[Tuple[Optional[int], tuple]] = []
+        self.sealed = False
+        self.done = threading.Event()
+        self.results: Optional[list] = None
+        self.error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    """One per QueryService. ``call()`` is the only hot entry point."""
+
+    def __init__(self, window_s: float = 0.002, max_batch: int = 8,
+                 enabled: bool = True, registry=None,
+                 inflight_fn=None):
+        self.window_s = max(float(window_s), 0.0)
+        # max_batch normalizes DOWN to a power of two: group sizes
+        # quantize to powers of two, so a non-power cap (say 6) would
+        # admit a 6-way group that warm_coalesced (which enumerates
+        # 2, 4, 8, ...) never pre-compiled — reintroducing exactly the
+        # mid-run cold-compile stall warmup exists to prevent
+        mb = max(int(max_batch), 1)
+        self.max_batch = 1 << (mb.bit_length() - 1)
+        self.enabled = bool(enabled) and self.window_s > 0 and \
+            self.max_batch > 1
+        self.registry = registry
+        #: live inflight-query-count probe (the service passes its
+        #: admission ledger). Serves two holds-related decisions: the
+        #: slice-start ``multi`` snapshot goes stale when a peer is
+        #: admitted MID-slice (re-probe before skipping the hold), and
+        #: a leader whose group already contains every inflight query
+        #: can seal EARLY — nobody else can possibly join, so waiting
+        #: out the window would be pure added latency
+        self.inflight_fn = inflight_fn
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._groups: Dict[tuple, _Group] = {}
+        #: (program_key, signature, k) -> jitted K-way program
+        self._coalesced: Dict[tuple, object] = {}
+        # stats (under self._lock)
+        self._solo_launches = 0
+        self._coalesced_launches = 0
+        self._coalesced_participants = 0
+        self._held_s = 0.0
+
+    # -- public ------------------------------------------------------------
+
+    def call(self, program_key, prog, args: tuple, statics: dict,
+             query_id=None, multi: bool = True):
+        """Execute ``prog(*args, **statics)``, possibly coalesced with
+        compatible concurrent calls. Returns exactly what the direct
+        call would."""
+        if not self.enabled:
+            return self._direct(prog, args, statics)
+        if not multi:
+            # stale slice-start snapshot? re-probe live before giving
+            # up the hold — a peer admitted mid-slice is coalescible
+            if self.inflight_fn is None or self.inflight_fn() <= 1:
+                return self._direct(prog, args, statics)
+        raw = getattr(prog, "__wrapped__", None)
+        if raw is None:
+            # no traceable inner function: coalescing would nest jits
+            return self._direct(prog, args, statics)
+        key = self._group_key(program_key, args, statics)
+        if key is None:
+            return self._direct(prog, args, statics)
+
+        with self._cv:
+            g = self._groups.get(key)
+            if g is not None and not g.sealed and \
+                    len(g.slots) < self.max_batch:
+                idx = len(g.slots)
+                g.slots.append((query_id, args))
+                if len(g.slots) >= self.max_batch:
+                    g.sealed = True
+                    self._groups.pop(key, None)
+                # wake the leader either way: it re-evaluates the
+                # early-seal condition on every join
+                self._cv.notify_all()
+                leader = False
+            else:
+                g = _Group()
+                g.slots.append((query_id, args))
+                idx = 0
+                self._groups[key] = g
+                leader = True
+
+        # park WITHOUT the device permit: peers must pass the
+        # TpuSemaphore to reach this same coalescing point, so a
+        # window-holder keeping its permit would starve its own group
+        sem = _semaphore()
+        had_permit = sem is not None and sem.holds()
+        if had_permit:
+            sem.release_if_necessary()
+        try:
+            if leader:
+                t0 = time.perf_counter()
+                deadline = t0 + self.window_s
+                with self._cv:
+                    while not g.sealed:
+                        if self.inflight_fn is not None and \
+                                len(g.slots) >= min(self.max_batch,
+                                                    self.inflight_fn()):
+                            # every inflight query is already in the
+                            # group (or it is full): nobody else can
+                            # join — seal now instead of burning the
+                            # rest of the window
+                            break
+                        remaining = deadline - time.perf_counter()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                    g.sealed = True
+                    if self._groups.get(key) is g:
+                        self._groups.pop(key, None)
+                    self._held_s += time.perf_counter() - t0
+                # back on the device for the group's launch
+                if had_permit:
+                    sem.acquire_if_necessary()
+                    had_permit = False  # re-held; skip finally's path
+                self._execute(key, g, prog, raw, statics)
+            else:
+                if not g.done.wait(_PARTICIPANT_TIMEOUT_S):
+                    raise RuntimeError(
+                        "micro-batch leader never executed "
+                        "(participant timed out after "
+                        f"{_PARTICIPANT_TIMEOUT_S:.0f}s)")
+        finally:
+            if had_permit:
+                # participants (and a leader that errored before
+                # re-acquiring) restore the permit the surrounding
+                # exec believes it holds
+                sem.acquire_if_necessary()
+        if g.error is not None:
+            raise g.error
+        return g.results[idx]
+
+    def warm_coalesced(self) -> dict:
+        """Pre-compile the quantized K-way coalesced variants (2, 4,
+        ..., maxBatch) of every program the registry recorded, with
+        zero-filled operands at the observed bucket — a cold group
+        forming mid-run must not stall its K participants on a trace +
+        compile (measured: one lazy 2-way compile put a ~0.4 s outlier
+        at p99 of an otherwise ~30 ms distribution). Called from
+        QueryService.warmup()."""
+        if not self.enabled or self.registry is None:
+            return {"programs": 0, "variants": 0, "errors": 0}
+        import jax
+
+        sizes = []
+        k = 2
+        while k <= self.max_batch:
+            sizes.append(k)
+            k *= 2
+        programs = variants = errors = 0
+        for pkey, prog, zargs, statics in self.registry.replay_specs():
+            raw = getattr(prog, "__wrapped__", None)
+            if raw is None:
+                continue
+            key = self._group_key(pkey, zargs, statics)
+            if key is None:
+                continue
+            programs += 1
+            for k in sizes:
+                fn = self._coalesced_program(key, k, raw, statics)
+                try:
+                    jax.block_until_ready(fn(tuple([zargs] * k)))
+                    variants += 1
+                except Exception:
+                    errors += 1
+        return {"programs": programs, "variants": variants,
+                "errors": errors}
+
+    def stats(self) -> dict:
+        with self._lock:
+            launches = self._solo_launches + self._coalesced_launches
+            return {
+                "enabled": self.enabled,
+                "window_ms": round(self.window_s * 1e3, 3),
+                "max_batch": self.max_batch,
+                "launches": launches,
+                "coalesced_launches": self._coalesced_launches,
+                "coalesced_participants": self._coalesced_participants,
+                "mean_group_size": round(
+                    self._coalesced_participants /
+                    self._coalesced_launches, 3)
+                if self._coalesced_launches else 0.0,
+                "held_s": round(self._held_s, 4),
+            }
+
+    # -- internals ---------------------------------------------------------
+
+    def _direct(self, prog, args, statics):
+        with self._lock:
+            self._solo_launches += 1
+        return prog(*args, **statics)
+
+    @staticmethod
+    def _group_key(program_key, args, statics):
+        """Compatibility key: program identity + operand tree structure
+        + bucketed array shapes/dtypes. Non-array leaves become traced
+        scalar operands in the coalesced program, so their VALUES may
+        differ per participant — only their positions must line up
+        (the treedef covers that)."""
+        import jax.tree_util as tu
+
+        try:
+            leaves, treedef = tu.tree_flatten(args)
+            sig = tuple(
+                (tuple(leaf.shape), str(leaf.dtype))
+                if getattr(leaf, "shape", None) is not None and
+                getattr(leaf, "dtype", None) is not None
+                else ("scalar", type(leaf).__name__)
+                for leaf in leaves)
+            skey = tuple(sorted((k, repr(v))
+                                for k, v in statics.items()))
+            return (program_key, treedef, sig, skey)
+        except Exception:
+            return None
+
+    def _coalesced_program(self, key, k: int, raw, statics):
+        ckey = (key, k)
+        with self._lock:
+            fn = self._coalesced.get(ckey)
+        if fn is not None:
+            return fn
+        import jax
+
+        def coalesced(parts):
+            # K inner programs inline into ONE executable; each
+            # participant's outputs come back as its own pytree — the
+            # split happens inside the compiled program, not as
+            # per-participant slicing dispatches afterwards
+            return tuple(raw(*p, **statics) for p in parts)
+
+        inner = getattr(raw, "__name__", "program")
+        coalesced.__name__ = coalesced.__qualname__ = \
+            f"coalesced[{k}x]{inner}"
+        fn = jax.jit(coalesced)
+        with self._lock:
+            if len(self._coalesced) >= 512:
+                self._coalesced.clear()
+            self._coalesced[ckey] = fn
+        return fn
+
+    def _execute(self, key, g: _Group, prog, raw, statics) -> None:
+        """Leader-side: run the sealed group (one launch) and publish
+        per-participant results."""
+        try:
+            k = len(g.slots)
+            if k == 1:
+                # nobody joined inside the window: plain direct call
+                # through the original jitted program (compile reuse +
+                # per-program telemetry naming), only the hold paid
+                g.results = [self._direct(prog, g.slots[0][1],
+                                          statics)]
+            else:
+                # group sizes QUANTIZE to powers of two (pad with the
+                # leader's operands, discard the padding results): the
+                # compiled K-way variant space shrinks from maxBatch-1
+                # programs to log2(maxBatch), which is what lets
+                # warm_coalesced() pre-compile ALL of them at startup
+                # instead of a cold group eating a mid-run trace
+                kq = _quantize_group(k, self.max_batch)
+                fn = self._coalesced_program(key, kq, raw, statics)
+                parts = [args for _qid, args in g.slots]
+                parts += [parts[0]] * (kq - k)
+                qids = [qid for qid, _args in g.slots
+                        if qid is not None]
+                tok = _disp.enter_coalesced(qids)
+                try:
+                    outs = fn(tuple(parts))
+                finally:
+                    _disp.exit_coalesced(tok)
+                g.results = list(outs[:k])
+                with self._lock:
+                    self._coalesced_launches += 1
+                    self._coalesced_participants += k
+        except BaseException as e:
+            g.error = e
+        finally:
+            g.done.set()

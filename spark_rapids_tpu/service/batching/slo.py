@@ -1,0 +1,143 @@
+"""Sustained-load SLO harness: open-loop offered-QPS sweeps.
+
+Closed-loop benchmarks (submit N, wait for N) measure throughput but
+hide latency pathologies — a closed loop self-throttles exactly when
+the service saturates. A SERVING SLO is defined the other way around:
+arrivals are OPEN-LOOP (a Poisson process at an offered rate that does
+not slow down because the service is busy), and the question is what
+p50/p99 queue+run latency and shed rate the service sustains at that
+rate. This module is the harness behind
+``benchmarks/service_bench.py --open-loop`` and the
+``scripts/slo_check.py`` fence (ROADMAP item 4: p99 at N=64 concurrent
+q1/q6 within 3x serial single-query time — a RATIO, so the criterion
+is meaningful on any backend, CPU CI included).
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, List, Optional, Sequence
+
+
+def poisson_gaps(rate_qps: float, n: int, seed: int = 7) -> List[float]:
+    """Inter-arrival gaps (seconds) of a Poisson process at
+    ``rate_qps``, deterministic per seed (exponential inversion —
+    the harness must replay identically across runs)."""
+    import numpy as np
+
+    if rate_qps <= 0:
+        return [0.0] * n
+    rng = np.random.default_rng(seed)
+    u = rng.random(n)
+    return list(-np.log1p(-u) / rate_qps)
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile on a copy (q in [0, 100])."""
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    rank = max(int(math.ceil(q / 100.0 * len(vals))) - 1, 0)
+    return float(vals[min(rank, len(vals) - 1)])
+
+
+def run_open_loop(service, make_query: Callable[[int], object],
+                  offered_qps: float, n_queries: int,
+                  tenants: int = 4, seed: int = 7,
+                  deadline_s: Optional[float] = None,
+                  result_timeout_s: float = 600.0) -> dict:
+    """Submit ``n_queries`` fresh query instances at Poisson arrivals
+    of ``offered_qps`` (round-robin over ``tenants`` submitter keys),
+    then drain. Returns the per-rate record: latency percentiles over
+    queue/run/total, shed + failure counts, achieved vs offered rate.
+
+    ``make_query(i)`` must return a FRESH plan/DataFrame per call (plan
+    instances are single-use through the override planner)."""
+    from spark_rapids_tpu.service.types import (OutOfCoreRejected,
+                                                ServiceOverloaded)
+
+    gaps = poisson_gaps(offered_qps, n_queries, seed)
+    handles = []
+    shed = 0
+    t0 = time.perf_counter()
+    next_at = t0
+    for i, gap in enumerate(gaps):
+        next_at += gap
+        delay = next_at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            handles.append(service.submit(
+                make_query(i), tenant=f"tenant{i % max(tenants, 1)}",
+                deadline=deadline_s))
+        except (ServiceOverloaded, OutOfCoreRejected):
+            # open loop: a shed arrival — queue-limit OR whale-policy
+            # rejection — is a data point, not a retry; that IS the
+            # backpressure signal the sweep reports
+            shed += 1
+    submit_wall = time.perf_counter() - t0
+
+    queue_s: List[float] = []
+    run_s: List[float] = []
+    total_s: List[float] = []
+    failed = 0
+    for h in handles:
+        try:
+            h.result(timeout=result_timeout_s)
+        except Exception:
+            failed += 1
+            continue
+        info = h.info()
+        qt = info["queue_time_s"] or 0.0
+        rt = info["run_time_s"] or 0.0
+        queue_s.append(qt)
+        run_s.append(rt)
+        total_s.append(qt + rt)
+    wall = time.perf_counter() - t0
+    done = len(total_s)
+    return {
+        "offered_qps": round(offered_qps, 4),
+        "achieved_qps": round(done / wall, 4) if wall > 0 else 0.0,
+        "queries": n_queries,
+        "done": done,
+        "shed": shed,
+        "failed": failed,
+        "shed_rate": round(shed / n_queries, 4) if n_queries else 0.0,
+        "submit_wall_s": round(submit_wall, 4),
+        "wall_s": round(wall, 4),
+        "latency_s": _latency_block(queue_s, run_s, total_s),
+    }
+
+
+def _latency_block(queue_s, run_s, total_s) -> dict:
+    def pcts(vals):
+        return {
+            "p50": round(percentile(vals, 50), 4),
+            "p95": round(percentile(vals, 95), 4),
+            "p99": round(percentile(vals, 99), 4),
+            "max": round(max(vals), 4) if vals else 0.0,
+            "mean": round(sum(vals) / len(vals), 4) if vals else 0.0,
+        }
+    return {"queue": pcts(queue_s), "run": pcts(run_s),
+            "total": pcts(total_s)}
+
+
+def slo_block(sweep: List[dict], serial_s: Optional[float],
+              ratio: float = 3.0) -> dict:
+    """The ``SLO_r*``-style summary the runner embeds: the sweep plus
+    the ROADMAP fence criterion evaluated at the highest offered rate
+    the service sustained (shed rate < 50%) — p99 total (queue+run)
+    latency within ``ratio`` x the serial single-query time."""
+    block = {"sweep": sweep, "serial_single_query_s": serial_s,
+             "ratio_threshold": ratio}
+    sustained = [e for e in sweep if e["shed_rate"] < 0.5 and e["done"]]
+    if sustained and serial_s:
+        at = max(sustained, key=lambda e: e["offered_qps"])
+        p99 = at["latency_s"]["total"]["p99"]
+        block["criterion"] = {
+            "at_offered_qps": at["offered_qps"],
+            "p99_total_s": p99,
+            "p99_over_serial": round(p99 / serial_s, 3),
+            "pass": bool(p99 <= ratio * serial_s),
+        }
+    return block

@@ -68,6 +68,24 @@ class QueryService:
                 self.conf.get(cfg.SERVICE_FAIRNESS_WEIGHTS)))
         self.scheduler = StageScheduler(
             self, n_workers=self.conf.get(cfg.SERVICE_MAX_CONCURRENT))
+        # cross-tenant micro-batching (service/batching): the ladder
+        # growth installs process-wide (capacities are compared across
+        # subsystems — one ladder per process; last service wins, the
+        # intended deployment is one service per process anyway)
+        from spark_rapids_tpu.ops import buckets as _ladder
+        from spark_rapids_tpu.service.batching import (MicroBatcher,
+                                                       get_registry)
+
+        _ladder.set_ladder_growth(
+            self.conf.get(cfg.SERVICE_BATCHING_BUCKET_GROWTH))
+        self.batcher = MicroBatcher(
+            window_s=self.conf.get(cfg.SERVICE_BATCHING_WINDOW_MS)
+            / 1e3,
+            max_batch=self.conf.get(cfg.SERVICE_BATCHING_MAX),
+            enabled=self.conf.get(cfg.SERVICE_BATCHING_ENABLED),
+            registry=get_registry(),
+            inflight_fn=lambda: len(self.admission.inflight))
+        self._templates: list = []   # (name, plan) for warmup replay
 
     def _resolve_budget(self) -> Optional[int]:
         """Only an EXPLICIT configured budget is captured; None lets
@@ -153,6 +171,50 @@ class QueryService:
             self._pump_locked()
         return QueryHandle(self, q)
 
+    # -- warmup (ROADMAP item 2: AOT-warm the progcache at startup) -------
+
+    def register_template(self, df_or_plan, name: Optional[str] = None):
+        """Register a query template the service expects tenants to
+        run. With ``rapids.tpu.service.warmup.enabled`` the template is
+        warmed immediately (returns the warmup report); otherwise it is
+        only recorded for a later explicit ``warmup()`` call."""
+        plan = getattr(df_or_plan, "_plan", df_or_plan)
+        entry = (name or f"template{len(self._templates)}", plan)
+        self._templates.append(entry)
+        if self.conf.get(cfg.SERVICE_WARMUP_ENABLED):
+            return self.warmup([entry])
+        return None
+
+    def warmup(self, templates=None, timeout: float = 600.0) -> dict:
+        """Run each template once under the reserved ``__warmup__``
+        tenant — tracing + compiling its stage programs into the
+        in-process chain-key cache and the persistent compile cache —
+        then (warmup.ladder) replay the recorded stage programs across
+        the capacity-ladder rungs so smaller buckets are compiled too.
+        The first REAL tenant request then starts hot instead of
+        eating the cold compile."""
+        t0 = time.perf_counter()
+        todo = list(self._templates) if templates is None \
+            else list(templates)
+        ran = errors = 0
+        for _name, plan in todo:
+            try:
+                self.submit(plan, tenant="__warmup__").result(
+                    timeout=timeout)
+                ran += 1
+            except Exception:
+                errors += 1   # warmup is advisory: a template that
+                #               cannot run fails ITS tenant later, not
+                #               service startup
+        ladder: dict = {}
+        if self.batcher.registry is not None and \
+                self.conf.get(cfg.SERVICE_WARMUP_LADDER):
+            ladder = self.batcher.registry.warm()
+        coalesced = self.batcher.warm_coalesced()
+        return {"templates": ran, "errors": errors, "ladder": ladder,
+                "coalesced": coalesced,
+                "seconds": round(time.perf_counter() - t0, 3)}
+
     def _record_shed_locked(self, tenant: str, priority: int,
                             deadline) -> Query:
         """Record a rejection as a terminal SHED query so the lifecycle
@@ -185,6 +247,7 @@ class QueryService:
 
         with self._lock:
             qcounts = _disp.query_counts()
+            qcoal = _disp.query_coalesced_counts()
             per_query = []
             running = 0
             for q in self._queries.values():
@@ -197,8 +260,12 @@ class QueryService:
                     "footprint_bytes": q.footprint,
                     "out_of_core": q.out_of_core,
                     "slices": q.slices_done,
-                    "dispatches": qcounts.get(q.query_id,
-                                              q.dispatches),
+                    # float: coalesced launches contribute a 1/K share
+                    # so per-query counts SUM to physical launches
+                    "dispatches": round(qcounts.get(q.query_id,
+                                                    q.dispatches), 4),
+                    "coalesced_dispatches": qcoal.get(q.query_id,
+                                                      q.coalesced),
                     # live queries read the retry map; terminal ones
                     # keep the snapshot finalize popped
                     "retry": q.retry or _retry.owner_stats(
@@ -209,6 +276,7 @@ class QueryService:
             semaphore = self.admission.current_semaphore()
             return ServiceStats(
                 retry=_retry.stats(),
+                batching=self.batcher.stats(),
                 queue_depth=self.admission.queue_depth(),
                 running=running,
                 admitted_inflight=len(self.admission.inflight),
@@ -382,6 +450,7 @@ class QueryService:
         q.error = error
         q.finished_at = time.perf_counter()
         q.dispatches = _disp.pop_query_count(q.query_id)
+        q.coalesced = _disp.pop_query_coalesced(q.query_id)
         q.retry = _retry.pop_owner_stats(q.owner_tag)
         self._counters["oom_retries"] += q.retry["oom_retries"]
         self._counters["oom_splits"] += q.retry["oom_splits"]

@@ -32,6 +32,7 @@ from typing import List, Optional
 
 from spark_rapids_tpu.memory import semaphore as sem
 from spark_rapids_tpu.memory.catalog import get_catalog, set_buffer_owner
+from spark_rapids_tpu.service.batching import microbatch as _mb
 from spark_rapids_tpu.service.types import (DeadlineExceeded, Query,
                                             QueryState)
 from spark_rapids_tpu.utils import dispatch as _disp
@@ -145,6 +146,16 @@ class StageScheduler:
         outcome: Optional[_Interrupted] = None
         prev_owner = set_buffer_owner(q.owner_tag)
         qtok = _disp.enter_query(q.query_id)
+        # micro-batching context: stage programs dispatched inside this
+        # slice may coalesce with other queries' (service/batching).
+        # ``multi`` snapshots whether a peer even exists — a solo query
+        # must not pay the hold window waiting for peers that cannot
+        # arrive (len() read is advisory; worst case one slice holds
+        # a window for a peer that just finished)
+        svc = self._service
+        multi = len(svc.admission.inflight) > 1
+        btok = _mb.enter_slice(getattr(svc, "batcher", None),
+                               q.query_id, multi)
         try:
             self._check_interrupt(q)
             done = self._advance(q)
@@ -163,10 +174,10 @@ class StageScheduler:
             # (never a leak, never a deadlock: releases only ever free
             # permits); the strict cross-query bound is admission's.
             sem.get().release_if_necessary()
+            _mb.exit_slice(btok)
             _disp.exit_query(qtok)
             set_buffer_owner(prev_owner)
 
-        svc = self._service
         requeued = False
         if outcome is not None:
             svc._finalize(q, outcome.state, outcome.error)
@@ -211,6 +222,7 @@ class StageScheduler:
             # after the finalize popped the query's count re-created
             # the _query_counts entry; drop it or it lives forever
             _disp.pop_query_count(q.query_id)
+            _disp.pop_query_coalesced(q.query_id)
 
     def _check_interrupt(self, q: Query) -> None:
         if q.cancel_requested:

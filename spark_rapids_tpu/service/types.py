@@ -92,6 +92,10 @@ class Query:
         self.finished_at: Optional[float] = None
         self.slices_done = 0
         self.dispatches = 0  # filled from telemetry when installed
+        #: coalesced launches this query participated in (a physical
+        #: launch shared with K-1 other queries counts here once, and
+        #: 1/K in ``dispatches``) — service/batching attribution
+        self.coalesced = 0
         self.spill_demoted = False  # stalled-yield bias currently set
         # out-of-core mode: footprint exceeds the whole device budget;
         # planned with a forced-splitting batch budget, runs with eager

@@ -60,7 +60,16 @@ def exit_stage(token) -> None:
 # The query service brackets each stage slice with enter_query/exit_query
 # so concurrent queries' dispatches split per query id in ServiceStats —
 # same thread-local scheme as stages, orthogonal bucket.
+#
+# Coalesced dispatches (service/batching/microbatch): ONE physical
+# launch serves K queries. The launch counts once globally and once in
+# _tagged_total; each participant's _query_counts entry takes a 1/K
+# share (per-query counts SUM to the physical launch count — counting
+# 1 per participant would inflate the global picture K-fold) and its
+# _query_coalesced entry records the participation itself.
 _query_counts: dict = {}
+_query_coalesced: dict = {}
+_tagged_total = 0.0  # physical dispatches attributed to ANY query
 
 
 def enter_query(query_id):
@@ -85,13 +94,48 @@ def current_query():
     return getattr(_tls, "query", None)
 
 
+def enter_coalesced(query_ids):
+    """Mark this thread's NEXT dispatches as one physical launch
+    serving every query in ``query_ids`` (the micro-batch leader wraps
+    exactly the coalesced program call). Each launch then counts once
+    globally and 1/K per participant, with the participation itself
+    recorded in the coalesced counter. Returns a token for
+    exit_coalesced; no-op (None) when telemetry isn't installed."""
+    if not _installed or not query_ids:
+        return None
+    prev = getattr(_tls, "coalesced", None)
+    _tls.coalesced = tuple(query_ids)
+    return (prev,)
+
+
+def exit_coalesced(token) -> None:
+    if token is not None:
+        _tls.coalesced = token[0]
+
+
 def query_counts() -> dict:
-    """{query_id: dispatch_count} accumulated so far (live queries)."""
+    """{query_id: dispatch_count} accumulated so far (live queries).
+    Counts are floats: a coalesced launch contributes a 1/K share to
+    each of its K participants."""
     with _stage_lock:
         return dict(_query_counts)
 
 
-def pop_query_count(query_id) -> int:
+def query_coalesced_counts() -> dict:
+    """{query_id: coalesced launches participated in} (live queries)."""
+    with _stage_lock:
+        return dict(_query_coalesced)
+
+
+def tagged_total() -> float:
+    """Physical dispatches attributed to any query so far — by
+    construction equal to the sum of per-query counts (the attribution
+    invariant tests/test_batching.py fences)."""
+    with _stage_lock:
+        return _tagged_total
+
+
+def pop_query_count(query_id) -> float:
     """Final dispatch count of a finished query, removed from the live
     map — a long-lived service must not accumulate one entry per query
     ever submitted."""
@@ -99,16 +143,31 @@ def pop_query_count(query_id) -> int:
         return _query_counts.pop(query_id, 0)
 
 
+def pop_query_coalesced(query_id) -> int:
+    """Final coalesced-participation count of a finished query."""
+    with _stage_lock:
+        return _query_coalesced.pop(query_id, 0)
+
+
 def _bump_stage(kind: str) -> None:
+    global _tagged_total
     label = getattr(_tls, "stage", None) or "<unstaged>"
     qid = getattr(_tls, "query", None)
+    group = getattr(_tls, "coalesced", None)
     with _stage_lock:
         d = _stage_counts.get(label)
         if d is None:
             d = _stage_counts[label] = {"jit": 0, "eager": 0, "get": 0}
         d[kind] += 1
-        if qid is not None:
+        if group:
+            share = 1.0 / len(group)
+            for g in group:
+                _query_counts[g] = _query_counts.get(g, 0) + share
+                _query_coalesced[g] = _query_coalesced.get(g, 0) + 1
+            _tagged_total += 1
+        elif qid is not None:
             _query_counts[qid] = _query_counts.get(qid, 0) + 1
+            _tagged_total += 1
 
 # -- measured device timing (serialized mode) -------------------------------
 # When enabled, every counted jit call BLOCKS until its result is ready

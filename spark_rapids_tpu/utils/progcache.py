@@ -96,11 +96,22 @@ def installed_dir():
 
 def stats() -> dict:
     """Program-registry effectiveness: in-process chain-key cache size
-    and hit/miss counts (a miss = one trace + compile somewhere), plus
-    the persistent directory when active."""
+    and hit/miss counts (a miss = one trace + compile somewhere), the
+    persistent directory when active, and the shape-bucket ledger —
+    how many distinct (program, bucket-shape) executables the service
+    path observed vs reused (service/batching: programs are keyed on
+    BUCKETED operand shapes, so concurrent tenants land on the same
+    executables by construction)."""
     from spark_rapids_tpu.expressions import compiler as _c
 
     out = dict(_c._FUSED_CACHE_STATS)
     out["programs"] = len(_c._FUSED_CACHE)
     out["persistent_dir"] = _installed_dir
+    try:
+        from spark_rapids_tpu.service.batching.buckets import \
+            get_registry
+
+        out["buckets"] = get_registry().stats()
+    except Exception:  # pragma: no cover - service package unavailable
+        pass
     return out

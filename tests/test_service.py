@@ -451,6 +451,39 @@ def test_owner_tag_propagates_to_task_pool_threads():
     assert seen == [tag] * 4
 
 
+def test_batching_and_slo_smoke():
+    """Fast tier-1 smoke over the serving layer: a service with
+    micro-batching + warmup enabled completes a small multi-tenant
+    burst, and the stats snapshot carries the batching block and the
+    latency percentiles the SLO harness consumes. (Deterministic
+    coalescing/SLO fences live in tests/test_batching.py and
+    scripts/slo_check.py.)"""
+    s = Session()
+    rng = np.random.default_rng(41)
+    q = _agg_query(s, s.create_dataframe(_frame(rng)))
+    svc = QueryService(RapidsConf({
+        cfg.SERVICE_BATCHING_WINDOW_MS.key: 5.0,
+        cfg.SERVICE_WARMUP_ENABLED.key: True}), session=s)
+    svc.register_template(q, "agg")
+    want = _sorted(q.collect())
+    handles = [svc.submit(q, tenant=f"t{i % 3}") for i in range(6)]
+    for h in handles:
+        pd.testing.assert_frame_equal(_sorted(h.result(timeout=120)),
+                                      want)
+    snap = svc.stats().to_dict()
+    svc.shutdown()
+    s.stop()
+    b = snap["batching"]
+    assert b["enabled"] and b["launches"] >= 1
+    assert b["coalesced_participants"] >= 0
+    for hist in (snap["queue_time_hist"], snap["run_time_hist"]):
+        for key in ("p50_s", "p95_s", "p99_s"):
+            assert hist[key] >= 0
+    assert snap["latency"]["run_p99_s"] >= \
+        snap["latency"]["run_p50_s"] >= 0
+    assert "buckets" in snap["progcache"]
+
+
 def test_query_failure_propagates():
     class BoomSource(GateSource):
         def read_host_split(self, p):
