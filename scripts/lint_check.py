@@ -1,0 +1,154 @@
+"""tpulint CI gate: zero NEW findings relative to the checked-in state.
+
+The analyzer (``spark_rapids_tpu/analysis/``) runs four passes —
+host-sync (TPU1xx), recompile hazards (TPU2xx), lock order (TPU3xx),
+robustness/config (TPU4xx) — over the package, filters through the
+per-site allowlist (``analysis/allowlist.txt``, every entry carries a
+mandatory written justification), and compares the survivors against
+``scripts/lint_baseline.json``. The baseline is EMPTY and is meant to
+stay empty: a new finding means fix the site or add a justified
+allowlist entry in the same PR, never "append to the baseline".
+
+Exit status:
+
+- 0 — no findings beyond allowlist+baseline, no stale allowlist
+  entries, no parse errors.
+- 1 — new findings (each rendered with code, site, and message), or
+  stale allowlist entries (a justification whose site was fixed must
+  be deleted so the exemption can't silently migrate).
+- 2 — allowlist parse error (missing justification, unknown code).
+
+Modes:
+
+    python scripts/lint_check.py                  # the gate
+    python scripts/lint_check.py --json out.json  # + machine-readable dump
+    python scripts/lint_check.py --write-baseline # refresh baseline file
+    python scripts/lint_check.py --root DIR       # scan a seeded tree
+    python scripts/lint_check.py --sync-map       # q26 plan-level sync map
+
+``--sync-map`` builds the q26 physical plan and prints every
+device->host synchronization point the stage-cut plan implies, one per
+line as ``<stage>  <exec>  <kind>`` — the plan-level complement to the
+per-site AST passes (acceptance: exactly a duplicate-flag fetch and the
+result fetch). Runs the planner only; no data is executed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(REPO, "scripts", "lint_baseline.json")
+
+
+def _load_baseline(path):
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {(e["code"], e["path"], e["qualname"]) for e in data["findings"]}
+
+
+def _sync_map(data_dir: str) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from spark_rapids_tpu.analysis import plan_sync
+    from spark_rapids_tpu.benchmarks.runner import (ALL_BENCHMARKS,
+                                                    BenchmarkRunner)
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    r = BenchmarkRunner(data_dir, 0.1)
+    r.ensure_data("tpcxbb_q26")
+    root = apply_overrides(ALL_BENCHMARKS["tpcxbb_q26"](data_dir),
+                           r.conf)
+    print(plan_sync.render(plan_sync.sync_map(root)))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="tree to scan (default: this repo)")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="write all raw findings + verdicts as JSON")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite scripts/lint_baseline.json from the "
+                         "current post-allowlist findings")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist path (default: analysis/allowlist.txt)")
+    ap.add_argument("--sync-map", action="store_true",
+                    help="print the q26 plan-level sync map and exit")
+    ap.add_argument("--data-dir", default="/tmp/srt_dispatch_fence",
+                    help="--sync-map dataset dir (reuses the dispatch-"
+                         "fence tables; generated if missing)")
+    args = ap.parse_args(argv)
+
+    if args.sync_map:
+        return _sync_map(args.data_dir)
+
+    from spark_rapids_tpu import analysis
+    from spark_rapids_tpu.analysis.allowlist import (Allowlist,
+                                                     AllowlistError)
+
+    try:
+        allowlist = (Allowlist.load(args.allowlist) if args.allowlist
+                     else Allowlist.load())
+    except AllowlistError as e:
+        print(f"lint_check: allowlist error: {e}", file=sys.stderr)
+        return 2
+
+    raw = analysis.run_all(args.root)
+    survivors = allowlist.filter(raw)
+    stale = allowlist.unused_entries(raw) if args.root is None else []
+
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as f:
+            json.dump({
+                "total": len(raw),
+                "allowlisted": len(raw) - len(survivors),
+                "new": [fi.to_json() for fi in survivors],
+                "stale_allowlist_entries": [
+                    {"code": c, "scope": s, "justification": j}
+                    for c, s, j in stale],
+                "findings": [fi.to_json() for fi in raw],
+            }, f, indent=2)
+            f.write("\n")
+
+    if args.write_baseline:
+        with open(BASELINE_PATH, "w", encoding="utf-8") as f:
+            json.dump({"findings": [fi.to_json() for fi in survivors]},
+                      f, indent=2)
+            f.write("\n")
+        print(f"lint_check: baseline written "
+              f"({len(survivors)} entries) to {BASELINE_PATH}")
+        return 0
+
+    baseline = _load_baseline(BASELINE_PATH)
+    new = [fi for fi in survivors
+           if (fi.code, fi.path, fi.qualname) not in baseline]
+
+    ok = True
+    if new:
+        ok = False
+        print(f"lint_check: {len(new)} new finding(s) "
+              f"(fix the site or add a justified allowlist entry):")
+        for fi in new:
+            print(f"  {fi.render()}")
+    if stale:
+        ok = False
+        print(f"lint_check: {len(stale)} stale allowlist entr"
+              f"{'y' if len(stale) == 1 else 'ies'} "
+              f"(site fixed — delete the exemption):")
+        for code, scope, _ in stale:
+            print(f"  {code} {scope}")
+    if ok:
+        print(f"lint_check: OK — {len(raw)} finding(s), all "
+              f"allowlisted with justifications, 0 new vs baseline")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""tpulint: static analysis passes for the invariants runtime fences
+can't reliably reach.
+
+The framework's performance and safety contracts — no hidden host
+round-trips inside a fused stage (ROADMAP item 2), one compile per
+(program, bucket) (PR 7), device OOM always reaches the retry ladder
+(PR 6), no lock-order inversions across the ~40 framework locks — are
+structural properties of the source. This package checks them at
+analysis time, on CPU, with stable diagnostic codes:
+
+- ``TPU1xx`` host-sync discipline (:mod:`.host_sync`)
+- ``TPU2xx`` recompile hazards (:mod:`.recompile`)
+- ``TPU3xx`` lock-order / blocking-under-lock (:mod:`.locks`)
+- ``TPU4xx`` robustness + config-knob consistency (:mod:`.robustness`)
+
+plus a plan-level sync map (:mod:`.plan_sync`) that walks
+``plan/optimizer.cut_stages`` output and names, per pipeline stage,
+every operator that forces a device->host round trip.
+
+Findings outside ``allowlist.txt`` (per-site entries, justification
+mandatory) fail the CI gate ``scripts/lint_check.py``. Workflow and
+code reference: docs/static-analysis.md.
+"""
+from spark_rapids_tpu.analysis.diagnostics import (  # noqa: F401
+    CODES, Finding)
+from spark_rapids_tpu.analysis.allowlist import Allowlist  # noqa: F401
+
+
+def run_all(pkg_root=None):
+    """Run every pass over the package tree rooted at ``pkg_root``
+    (default: the installed spark_rapids_tpu sources); returns the raw
+    (un-allowlisted) findings sorted by location."""
+    from spark_rapids_tpu.analysis import (
+        host_sync, locks, recompile, robustness)
+    from spark_rapids_tpu.analysis.astutil import package_root
+
+    root = pkg_root or package_root()
+    findings = []
+    findings += host_sync.run(root)
+    findings += recompile.run(root)
+    findings += locks.run(root)
+    findings += robustness.run(root)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings

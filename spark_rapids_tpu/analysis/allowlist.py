@@ -1,0 +1,107 @@
+"""The per-site allowlist: every accepted finding carries a written
+justification, reviewed like code.
+
+Format (``allowlist.txt``, one entry per line)::
+
+    CODE <scope> -- <justification>
+
+where ``<scope>`` is one of
+
+- ``path/to/file.py::Qual.Name`` — one function/method (preferred),
+- ``path/to/file.py``            — a whole module,
+- ``path/prefix/*``              — every module under a directory
+  (reserved for tooling that exists to perform the flagged operation,
+  e.g. the benchmark harness syncing on purpose).
+
+The ``--`` justification is MANDATORY: a bare scope is a parse error,
+so "allowlist it" is never cheaper than writing down why it's safe.
+Blank lines and ``#`` comments are ignored.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+from spark_rapids_tpu.analysis.diagnostics import CODES, Finding
+
+DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "allowlist.txt")
+
+
+class AllowlistError(ValueError):
+    pass
+
+
+class Allowlist:
+    def __init__(self, entries: List[Tuple[str, str, str]]):
+        #: (code, scope, justification)
+        self.entries = entries
+        self._exact: Dict[Tuple[str, str], str] = {}
+        self._globs: List[Tuple[str, str, str]] = []
+        for code, scope, just in entries:
+            if scope.endswith("/*"):
+                self._globs.append((code, scope[:-1], just))
+            else:
+                self._exact[(code, scope)] = just
+
+    @classmethod
+    def parse(cls, text: str, origin: str = "<allowlist>") -> "Allowlist":
+        entries = []
+        for i, raw in enumerate(text.splitlines(), 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "--" not in line:
+                raise AllowlistError(
+                    f"{origin}:{i}: missing '-- justification' "
+                    f"(justifications are mandatory): {line!r}")
+            head, just = line.split("--", 1)
+            just = just.strip()
+            if not just:
+                raise AllowlistError(
+                    f"{origin}:{i}: empty justification: {line!r}")
+            parts = head.split()
+            if len(parts) != 2:
+                raise AllowlistError(
+                    f"{origin}:{i}: expected 'CODE scope -- why': {line!r}")
+            code, scope = parts
+            if code not in CODES:
+                raise AllowlistError(
+                    f"{origin}:{i}: unknown diagnostic code {code!r}")
+            entries.append((code, scope, just))
+        return cls(entries)
+
+    @classmethod
+    def load(cls, path: str = DEFAULT_PATH) -> "Allowlist":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path) as f:
+            return cls.parse(f.read(), origin=path)
+
+    def allows(self, finding: Finding) -> bool:
+        if (finding.code, finding.scope) in self._exact:
+            return True
+        if (finding.code, finding.path) in self._exact:
+            return True
+        return any(code == finding.code and finding.path.startswith(prefix)
+                   for code, prefix, _ in self._globs)
+
+    def filter(self, findings: List[Finding]) -> List[Finding]:
+        """The findings NOT covered by this allowlist."""
+        return [f for f in findings if not self.allows(f)]
+
+    def unused_entries(self, findings: List[Finding]) -> List[Tuple[str, str, str]]:
+        """Entries matching no finding — stale justifications that
+        should be deleted when the underlying site is fixed."""
+        out = []
+        for code, scope, just in self.entries:
+            if scope.endswith("/*"):
+                prefix = scope[:-1]
+                hit = any(f.code == code and f.path.startswith(prefix)
+                          for f in findings)
+            else:
+                hit = any(f.code == code and scope in (f.scope, f.path)
+                          for f in findings)
+            if not hit:
+                out.append((code, scope, just))
+        return out

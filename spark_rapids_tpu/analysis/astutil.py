@@ -1,0 +1,123 @@
+"""Shared AST plumbing for the tpulint passes.
+
+Each pass walks every module under the package root once; this module
+owns source discovery, parsing, qualname attribution, and the small
+call-graph used by the lock pass. Everything is stdlib ``ast`` — the
+linter must run in a bare CPU CI container with no extra deps.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: Modules the code passes never scan: the analysis package itself
+#: (its fixtures and docstrings mention every anti-pattern by name).
+SKIP_PREFIXES = ("spark_rapids_tpu/analysis/",)
+
+
+def package_root() -> str:
+    """Repo-root directory containing ``spark_rapids_tpu/``."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def iter_modules(root: str) -> Iterator[Tuple[str, ast.Module, str]]:
+    """Yield (relpath, parsed AST, source) for every package module
+    under ``root``. ``root`` is a directory that contains a
+    ``spark_rapids_tpu`` tree OR any directory of .py files (the
+    seeded-violation fences point this at a temp tree)."""
+    pkg = os.path.join(root, "spark_rapids_tpu")
+    scan = pkg if os.path.isdir(pkg) else root
+    for dirpath, dirnames, filenames in os.walk(scan):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root)
+            if any(rel.startswith(p) for p in SKIP_PREFIXES):
+                continue
+            with open(full, encoding="utf-8") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError:
+                continue  # not our job; CI's compile step reports it
+            yield rel, tree, src
+
+
+class QualnameVisitor(ast.NodeVisitor):
+    """Base visitor that tracks the enclosing def/class qualname, so
+    findings attribute to ``Class.method`` allowlist scopes."""
+
+    def __init__(self):
+        self._stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self._stack)
+
+    def _push(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._push(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._push(node)
+
+    def visit_ClassDef(self, node):
+        self._push(node)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` attribute chains as a string, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted(node.func)
+
+
+def collect_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    """qualname -> def node for every function/method in a module."""
+    out: Dict[str, ast.AST] = {}
+
+    class V(QualnameVisitor):
+        def _push(self, node):
+            super()._push(node)
+
+        def visit_FunctionDef(self, node):
+            self._stack.append(node.name)
+            out[".".join(self._stack)] = node
+            self.generic_visit(node)
+            self._stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    V().visit(tree)
+    return out
+
+
+def local_calls(fn_node: ast.AST) -> List[str]:
+    """Names this function calls, as dotted strings (``self.foo`` and
+    bare ``foo`` both reported) — the intraprocedural call-graph edge
+    list used by the lock pass."""
+    out = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name:
+                out.append(name)
+    return out

@@ -1,0 +1,245 @@
+"""TPU3xx: lock discipline, statically.
+
+The declared hierarchy lives in ``utils/lockorder.py`` (single source
+of truth — the runtime assertion proxy reads the same tables). This
+pass extracts every ``with <lock>:`` nesting across a per-module call
+graph and checks:
+
+- TPU301 the inner lock's rank must exceed the outer's (same-group
+  plan barriers and same-name nestable locks exempt, mirroring the
+  runtime rules);
+- TPU302 no blocking call — device transfer, socket I/O, sleep, a
+  ``wait`` on anything that isn't the held lock's own condition —
+  while a framework lock is held;
+- TPU303 every lock is created through the ``lockorder`` factories
+  with a name the hierarchy declares (a raw ``threading.Lock()`` is
+  invisible to both enforcement layers).
+
+The call graph is per-module and name-resolved (``self.meth`` within
+the same class, bare names at module level): deep enough to catch the
+real pattern (a ``with`` body calling a helper that transfers), cheap
+enough to run on every CI push.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.analysis import astutil
+from spark_rapids_tpu.analysis.diagnostics import Finding
+from spark_rapids_tpu.utils.lockorder import (
+    GROUPS, LOCK_HIERARCHY, NESTABLE)
+
+_FACTORIES = {"lockorder.make_lock": "lock",
+              "lockorder.make_rlock": "rlock",
+              "lockorder.make_condition": "condition"}
+_RAW = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+#: modules allowed to create raw threading primitives: the factory
+#: module itself, and the telemetry installed before everything else.
+_RAW_OK = ("spark_rapids_tpu/utils/lockorder.py",)
+
+_BLOCKING_ATTRS = {"recv", "recv_into", "sendall", "accept", "connect",
+                   "device_get", "device_put", "block_until_ready"}
+_BLOCKING_DOTTED = {"time.sleep", "jax.device_get", "jax.device_put",
+                    "jax.block_until_ready"}
+
+
+def _order_ok(outer: str, inner: str) -> bool:
+    """Mirror of _TrackedLock._check: may ``inner`` be acquired while
+    ``outer`` is held?"""
+    g_out, g_in = GROUPS.get(outer), GROUPS.get(inner)
+    if g_in is not None and g_in == g_out:
+        return True
+    ro, ri = LOCK_HIERARCHY[outer], LOCK_HIERARCHY[inner]
+    if ri > ro:
+        return True
+    return ri == ro and inner == outer and inner in NESTABLE
+
+
+class _ModuleLocks:
+    """Lock-name resolution tables for one module."""
+
+    def __init__(self, tree: ast.Module, rel: str,
+                 findings: List[Finding]):
+        self.globals: Dict[str, str] = {}
+        self.attrs: Dict[Tuple[str, str], str] = {}
+        self.functions = astutil.collect_functions(tree)
+
+        class V(astutil.QualnameVisitor):
+            def visit_Assign(v, node):
+                if isinstance(node.value, ast.Call):
+                    fname = astutil.call_name(node.value)
+                    if fname in _FACTORIES:
+                        self._record(node, v.qualname, rel, findings)
+                    elif fname in _RAW and rel not in _RAW_OK:
+                        findings.append(Finding(
+                            code="TPU303", path=rel, line=node.lineno,
+                            qualname=v.qualname,
+                            message=f"{fname}() bypasses the lockorder "
+                                    f"factories — invisible to both the "
+                                    f"static and runtime hierarchy "
+                                    f"checks"))
+                v.generic_visit(node)
+
+            def visit_Call(v, node):
+                # raw creations not in assignments (e.g. default args)
+                fname = astutil.call_name(node)
+                if fname in _RAW and rel not in _RAW_OK and \
+                        not isinstance(getattr(node, "_parent", None),
+                                       ast.Assign):
+                    pass  # assignments handled above; flag the rest
+                v.generic_visit(node)
+
+        # mark assignment value nodes so visit_Call skips them
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for sub in ast.walk(node.value):
+                    sub._parent = node
+        V().visit(tree)
+        # raw creations OUTSIDE assignments (inline `with
+        # threading.Lock():`, getattr fallbacks)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    astutil.call_name(node) in _RAW and \
+                    rel not in _RAW_OK and \
+                    not isinstance(getattr(node, "_parent", None),
+                                   ast.Assign):
+                findings.append(Finding(
+                    code="TPU303", path=rel, line=node.lineno,
+                    qualname="",
+                    message=f"{astutil.call_name(node)}() bypasses the "
+                            f"lockorder factories"))
+
+    def _record(self, assign: ast.Assign, qualname: str, rel: str,
+                findings: List[Finding]):
+        call = assign.value
+        name_arg = call.args[0] if call.args else None
+        if not (isinstance(name_arg, ast.Constant) and
+                isinstance(name_arg.value, str)):
+            findings.append(Finding(
+                code="TPU303", path=rel, line=assign.lineno,
+                qualname=qualname,
+                message="lockorder factory called with a non-literal "
+                        "name — the hierarchy cannot be checked"))
+            return
+        lock_name = name_arg.value
+        if lock_name not in LOCK_HIERARCHY:
+            findings.append(Finding(
+                code="TPU303", path=rel, line=assign.lineno,
+                qualname=qualname,
+                message=f"lock name {lock_name!r} is not declared in "
+                        f"utils/lockorder.py LOCK_HIERARCHY"))
+            return
+        for t in assign.targets:
+            if isinstance(t, ast.Name):
+                self.globals[t.id] = lock_name
+            elif isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self" and qualname:
+                cls = qualname.split(".")[0]
+                self.attrs[(cls, t.attr)] = lock_name
+
+    def resolve(self, expr: ast.AST, qualname: str) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.globals.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and qualname:
+            return self.attrs.get((qualname.split(".")[0], expr.attr))
+        return None
+
+    def resolve_callee(self, call_name: str,
+                       qualname: str) -> Optional[str]:
+        if call_name.startswith("self."):
+            cand = qualname.split(".")[0] + "." + call_name[5:]
+            if cand in self.functions:
+                return cand
+        if call_name in self.functions:
+            return call_name
+        return None
+
+
+def _walk_with_bodies(mod: _ModuleLocks, qualname: str, body,
+                      emit, held: List[str],
+                      visited_fns: Set[str]) -> None:
+    """Walk statements with ``held`` (outermost-first lock names) in
+    effect; recurse into nested withs and same-module callees."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.With):
+                inner_names = []
+                for item in node.items:
+                    nm = mod.resolve(item.context_expr, qualname)
+                    if nm:
+                        inner_names.append((nm, node))
+                for nm, wnode in inner_names:
+                    for h in held:
+                        if not _order_ok(h, nm):
+                            emit("TPU301", wnode, qualname,
+                                 f"acquires {nm!r} (rank "
+                                 f"{LOCK_HIERARCHY[nm]}) while "
+                                 f"{h!r} (rank {LOCK_HIERARCHY[h]}) "
+                                 f"is held — inverts the declared "
+                                 f"hierarchy")
+                # note: ast.walk re-visits nested bodies; the recursion
+                # below carries the extended held-set, and the dedup in
+                # the gate collapses the duplicate shallow visit
+            elif isinstance(node, ast.Call) and held:
+                cn = astutil.call_name(node) or ""
+                blocking = (cn in _BLOCKING_DOTTED or
+                            cn.split(".")[-1] in _BLOCKING_ATTRS or
+                            cn.endswith(".wait"))
+                if cn.endswith(".wait"):
+                    # waiting on the held lock's OWN condition releases
+                    # it — that's what conditions are for
+                    target = mod.resolve(node.func.value, qualname) \
+                        if isinstance(node.func, ast.Attribute) else None
+                    if target is not None and target == held[-1]:
+                        blocking = False
+                if blocking:
+                    emit("TPU302", node, qualname,
+                         f"blocking call {cn}(...) while "
+                         f"{held[-1]!r} is held")
+                callee = mod.resolve_callee(cn, qualname)
+                if callee and callee not in visited_fns:
+                    visited_fns.add(callee)
+                    fn = mod.functions[callee]
+                    _walk_with_bodies(mod, callee, fn.body, emit,
+                                      held, visited_fns)
+
+    # second pass: recurse into each with body with the lock pushed
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.With):
+                names = [mod.resolve(i.context_expr, qualname)
+                         for i in node.items]
+                names = [n for n in names if n]
+                if names:
+                    _walk_with_bodies(mod, qualname, node.body, emit,
+                                      held + names, set(visited_fns))
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple] = set()
+
+    for rel, tree, _src in astutil.iter_modules(root):
+        if rel.endswith("utils/lockorder.py"):
+            continue
+        mod = _ModuleLocks(tree, rel, findings)
+        if not (mod.globals or mod.attrs):
+            continue
+
+        def emit(code, node, qualname, msg, rel=rel):
+            key = (code, rel, qualname, msg)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                code=code, path=rel, line=node.lineno,
+                qualname=qualname, message=msg))
+
+        for qn, fn in mod.functions.items():
+            _walk_with_bodies(mod, qn, fn.body, emit, [], {qn})
+    return findings

@@ -1,0 +1,79 @@
+"""Plan-level sync map: which operators in a COMPILED plan force a
+device->host round trip, per pipeline stage.
+
+The AST passes see source; this walk sees the actual exec tree a query
+will run, labeled by ``plan/optimizer.cut_stages``. Output is the
+static round-trip map ROADMAP item 2's ``vs_cpu_oracle`` work needs:
+every sync a stage will pay, named, BEFORE the query runs. For tpcxbb
+q26 at sf 0.1 the map is exactly two entries — the fused join chain's
+batched duplicate-flag fetch and the root result fetch — and
+``tests/test_analysis.py`` fences that it stays exactly those two.
+
+Classification (kind -> why it syncs):
+
+- ``duplicate-flag fetch`` — an exec with broadcast ``builds``:
+  ``execs/fused.prepare_builds`` must host-check the build-side
+  duplicate-key flag once per query (batched over all builds).
+- ``result fetch`` — the root exec: ``collect`` materializes the
+  result to host by definition.
+- ``UDF host round-trip`` — python/pandas execs ship batches to a
+  worker process and back.
+- ``CPU fallback transition`` — device->host->device around the
+  pandas engine.
+- ``mesh shard/gather staging`` — multi-device mesh execs stage
+  shards through the host.
+"""
+from __future__ import annotations
+
+from typing import List
+
+
+def _classify(exec_node, is_root: bool) -> List[str]:
+    kinds = []
+    cls = type(exec_node).__name__
+    if getattr(exec_node, "builds", None):
+        kinds.append("duplicate-flag fetch")
+    if is_root:
+        kinds.append("result fetch")
+    if "InPandas" in cls or "EvalPython" in cls:
+        kinds.append("UDF host round-trip")
+    if cls == "CpuFallbackExec":
+        kinds.append("CPU fallback transition")
+    if cls.startswith("Mesh"):
+        kinds.append("mesh shard/gather staging")
+    return kinds
+
+
+def sync_map(root) -> List[dict]:
+    """[{stage, op, kind}] for every sync-forcing operator reachable
+    from ``root`` (children and broadcast builds), in stage order.
+    Labels every exec via cut_stages as a side effect."""
+    from spark_rapids_tpu.plan.optimizer import cut_stages
+
+    cut_stages(root)  # assigns _stage_label to every exec
+    out: List[dict] = []
+    seen = set()
+
+    def walk(node, is_root):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for kind in _classify(node, is_root):
+            out.append({
+                "stage": getattr(node, "_stage_label", "<unlabeled>"),
+                "op": type(node).__name__,
+                "kind": kind,
+            })
+        for c in node.children:
+            walk(c, False)
+        for bx in getattr(node, "builds", ()) or ():
+            walk(bx, False)
+
+    walk(root, True)
+    return out
+
+
+def render(entries: List[dict]) -> str:
+    lines = [f"{e['stage']:>8}  {e['kind']:<28} {e['op']}"
+             for e in entries]
+    return "\n".join(lines) if lines else "(no sync-forcing operators)"

@@ -1,0 +1,131 @@
+"""TPU4xx: robustness and config-knob consistency.
+
+- TPU401 an ``except Exception`` (or bare ``except``) on the device
+  path that neither re-raises nor consults
+  ``memory.retry.is_oom_error`` can swallow RESOURCE_EXHAUSTED — the
+  retry ladder (PR 6) then never sees the OOM and the query dies (or
+  silently degrades) instead of splitting. Import guards (try bodies
+  that only import) are exempt: no device call can raise there.
+- TPU402 every ``rapids.tpu.*`` string literal must resolve against
+  the live config registry (``plan/overrides`` imported first so the
+  per-op flag families are registered): a typo'd knob silently no-ops.
+- TPU403 every registered knob must appear in ``docs/configs.md``
+  (regenerate with ``scripts/gen_config_docs.py``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from spark_rapids_tpu.analysis import astutil
+from spark_rapids_tpu.analysis.diagnostics import Finding
+
+#: the device path: an OOM can only surface under these trees
+_DEVICE_PATH = ("spark_rapids_tpu/execs/", "spark_rapids_tpu/service/",
+                "spark_rapids_tpu/memory/", "spark_rapids_tpu/runtime/",
+                "spark_rapids_tpu/shuffle/", "spark_rapids_tpu/parallel/",
+                "spark_rapids_tpu/ops/")
+
+#: a full knob key: no trailing dot, so key-family PREFIX strings
+#: ("rapids.tpu.sql.") used to build dynamic names don't match
+_KNOB_RE = re.compile(
+    r"^rapids\.tpu\.[A-Za-z0-9_]+(\.[A-Za-z0-9_]+)+$")
+
+
+def _registered_keys():
+    """(all registered keys, keys requiring documentation) with the
+    import-time per-op flag families registered first. Docs-required =
+    the import-time snapshot minus ``internal()`` entries — exactly
+    what gen_config_docs.py emits: it skips internals, and apply-time
+    per-node flags (an open set) never exist in its fresh process."""
+    import spark_rapids_tpu.plan.overrides  # noqa: F401  registers op flags
+    from spark_rapids_tpu import config
+
+    snapshot = config.snapshot_docs_registry()
+    documented = {e.key for e in config.registered_entries()
+                  if not e.internal and e.key in snapshot}
+    return set(config._REGISTRY), documented
+
+
+def _is_import_guard(try_node: ast.Try) -> bool:
+    return all(isinstance(s, (ast.Import, ast.ImportFrom))
+               for s in try_node.body)
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and
+                   e.id in ("Exception", "BaseException")
+                   for e in t.elts)
+    return False
+
+
+def _handler_reraises_or_gates(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node) or ""
+            if name.split(".")[-1] == "is_oom_error":
+                return True
+    return False
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    registry, documented = _registered_keys()
+
+    for rel, tree, _src in astutil.iter_modules(root):
+        on_device_path = any(rel.startswith(p) for p in _DEVICE_PATH)
+
+        class V(astutil.QualnameVisitor):
+            def _emit(self, code, node, msg):
+                findings.append(Finding(
+                    code=code, path=rel, line=node.lineno,
+                    qualname=self.qualname, message=msg))
+
+            def visit_Try(self, node):
+                if on_device_path and not _is_import_guard(node):
+                    for h in node.handlers:
+                        if _handler_is_broad(h) and \
+                                not _handler_reraises_or_gates(h):
+                            self._emit(
+                                "TPU401", h,
+                                "broad except without re-raise or "
+                                "is_oom_error gate can swallow "
+                                "RESOURCE_EXHAUSTED before the retry "
+                                "ladder sees it")
+                self.generic_visit(node)
+
+            def visit_Constant(self, node):
+                if isinstance(node.value, str) and \
+                        _KNOB_RE.match(node.value) and \
+                        node.value not in registry:
+                    self._emit(
+                        "TPU402", node,
+                        f"knob string {node.value!r} is not registered "
+                        f"in config.py — a typo here silently no-ops")
+
+        V().visit(tree)
+
+    # TPU403: registry vs docs/configs.md (only when scanning the real
+    # repo — a seeded fixture tree has no docs to cross-check)
+    docs_path = os.path.join(root, "docs", "configs.md")
+    if os.path.exists(docs_path):
+        with open(docs_path, encoding="utf-8") as f:
+            doc_text = f.read()
+        for key in sorted(documented):
+            if key not in doc_text:
+                findings.append(Finding(
+                    code="TPU403", path="docs/configs.md", line=1,
+                    qualname="",
+                    message=f"registered knob {key!r} is undocumented "
+                            f"— run scripts/gen_config_docs.py"))
+    return findings

@@ -9,6 +9,7 @@ from spark_rapids_tpu.api.dataframe import DataFrame
 from spark_rapids_tpu.columnar.batch import Schema
 from spark_rapids_tpu.config import RapidsConf
 from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.utils import lockorder
 
 
 class Session:
@@ -43,7 +44,7 @@ class Session:
         self._service = None
         import threading
 
-        self._service_init_lock = threading.Lock()
+        self._service_init_lock = lockorder.make_lock("api.session.serviceInit")
 
     @property
     def service(self):

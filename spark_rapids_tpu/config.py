@@ -20,12 +20,13 @@ from __future__ import annotations
 import os
 import re
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
 
 T = TypeVar("T")
 
 _REGISTRY: "Dict[str, ConfEntry]" = {}
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = lockorder.make_lock("config.registry")
 
 _BYTE_SUFFIXES = {
     "b": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40,
@@ -123,6 +124,22 @@ def conf(key: str) -> _Builder:
 def registered_entries() -> List[ConfEntry]:
     with _REGISTRY_LOCK:
         return list(_REGISTRY.values())
+
+
+#: keys present when plan/overrides finished importing — the exact set
+#: a fresh docs-generation process sees. Per-op flags registered later
+#: (overrides.NodeMeta, one per plan-node class at apply time) are an
+#: open set no static docs file can contain.
+_DOCS_SNAPSHOT: Optional[frozenset] = None
+
+
+def snapshot_docs_registry() -> frozenset:
+    """Freeze (once) and return the import-time registry key set."""
+    global _DOCS_SNAPSHOT
+    if _DOCS_SNAPSHOT is None:
+        with _REGISTRY_LOCK:
+            _DOCS_SNAPSHOT = frozenset(_REGISTRY)
+    return _DOCS_SNAPSHOT
 
 
 def register_op_flag(kind: str, name: str, desc: str,
@@ -291,6 +308,16 @@ FAULT_INJECTION_MAX = conf(
 
 MEMORY_DEBUG = conf("rapids.tpu.memory.debug").doc(
     "Log every allocation/free (RMM debug-mode analogue, RapidsConf.scala:277)."
+).boolean_conf.create_with_default(False)
+
+DEBUG_LOCK_ORDER = conf("rapids.tpu.debug.lockOrder.enabled").doc(
+    "Wrap every framework lock in a tracking proxy that asserts the "
+    "declared hierarchy (utils/lockorder.py) on each acquire. Read at "
+    "lock-CREATION time via its env spelling "
+    "(RAPIDS_TPU_DEBUG_LOCKORDER_ENABLED), so it must be set before "
+    "the framework imports; tests/conftest.py enables it for every "
+    "tier-1 run. Static half of the same check: tpulint TPU301 "
+    "(docs/static-analysis.md)."
 ).boolean_conf.create_with_default(False)
 
 SHUFFLE_PARTITIONS = conf("rapids.tpu.sql.shuffle.partitions").doc(

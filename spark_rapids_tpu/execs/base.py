@@ -10,6 +10,7 @@ devices).
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -33,7 +34,7 @@ class Metrics:
         self.num_output_batches = 0
         self.op_time_ns = 0
         self.pipeline_time_ns = 0
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("execs.base.metrics")
 
     def record(self, batch: ColumnarBatch, elapsed_ns: int = 0,
                child_ns: int = 0):
@@ -72,7 +73,7 @@ class Metrics:
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("execs.base.metrics")
 
 
 class TpuExec:

@@ -9,6 +9,7 @@ identical machinery to shuffle blocks and broadcast tables."""
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, Iterator, List, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
@@ -39,7 +40,7 @@ class CacheHolder:
     """Partition -> spillable batches, filled once."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("execs.cache.materialize")
         self._parts: Optional[Dict[int, List[SpillableBatch]]] = None
 
     @property

@@ -13,6 +13,7 @@ path rides the mesh all_to_all in parallel/shuffle.py.
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -78,7 +79,7 @@ class ShuffleExchangeExec(TpuExec):
         # reduce tasks run on concurrent threads; the map side must
         # materialize exactly once (Spark serializes this via stage
         # boundaries — here a lock is the stage barrier)
-        self._mat_lock = threading.Lock()
+        self._mat_lock = lockorder.make_lock("exchange.shuffle.materialize")
 
     # an exchange shipping inside a remote task closure restarts clean:
     # blocks are per-process state (the receiving executor re-runs or
@@ -91,7 +92,7 @@ class ShuffleExchangeExec(TpuExec):
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._mat_lock = threading.Lock()
+        self._mat_lock = lockorder.make_lock("exchange.shuffle.materialize")
 
     @property
     def num_partitions(self) -> int:
@@ -273,7 +274,7 @@ class BroadcastExchangeExec(TpuExec):
     def __init__(self, child: TpuExec):
         super().__init__([child], child.schema)
         self._cached: Optional[SpillableBatch] = None
-        self._mat_lock = threading.Lock()
+        self._mat_lock = lockorder.make_lock("exchange.broadcast.materialize")
 
     def __getstate__(self):
         state = dict(self.__dict__)
@@ -283,7 +284,7 @@ class BroadcastExchangeExec(TpuExec):
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._mat_lock = threading.Lock()
+        self._mat_lock = lockorder.make_lock("exchange.broadcast.materialize")
 
     @property
     def num_partitions(self) -> int:

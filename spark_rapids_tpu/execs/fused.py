@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from spark_rapids_tpu.utils import lockorder
 from functools import partial
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -415,7 +416,7 @@ def _ghost_of(col: Column) -> "_Ghost":
 #: per-(exchange, key) event so concurrent consumers wait on their own
 #: build, never on an unrelated one.
 _PREP_CACHE: "weakref.WeakKeyDictionary" = None
-_PREP_LOCK = threading.Lock()
+_PREP_LOCK = lockorder.make_lock("execs.fused.prepCache")
 
 
 def _finalize_entries_locked(entries) -> None:
@@ -996,7 +997,7 @@ class FusedChainExec(TpuExec):
         self.build_key_specs = _build_key_specs(chain.steps)
         self._preps: Optional[List[PreparedBuild]] = None
         self._preps_ok: Optional[bool] = None
-        self._prep_lock = threading.Lock()
+        self._prep_lock = lockorder.make_lock("execs.fused.chainPrep")
 
     def __getstate__(self):
         state = dict(self.__dict__)
@@ -1007,7 +1008,7 @@ class FusedChainExec(TpuExec):
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._prep_lock = threading.Lock()
+        self._prep_lock = lockorder.make_lock("execs.fused.chainPrep")
 
     @property
     def num_partitions(self) -> int:
@@ -1134,7 +1135,7 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
         self.build_key_specs = _build_key_specs(self.chain.steps)
         self._preps: Optional[List[PreparedBuild]] = None
         self._preps_ok: Optional[bool] = None
-        self._prep_lock = threading.Lock()
+        self._prep_lock = lockorder.make_lock("execs.fused.chainPrep")
 
     __getstate__ = FusedChainExec.__getstate__
     __setstate__ = FusedChainExec.__setstate__

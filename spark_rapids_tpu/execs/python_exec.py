@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import functools
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Callable, Iterator, Optional
 
 import numpy as np
@@ -54,7 +55,7 @@ class PythonWorkerSemaphore:
 
     _sem: Optional[threading.Semaphore] = None
     _slots = 4
-    _lock = threading.Lock()
+    _lock = lockorder.make_lock("execs.python.pool")
 
     @classmethod
     def acquire(cls):

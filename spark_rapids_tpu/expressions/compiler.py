@@ -11,6 +11,7 @@ per-node evaluation (still device compute, host dictionary transforms).
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from functools import partial
 from typing import Callable, List, Optional, Sequence
 
@@ -45,7 +46,7 @@ _FUSED_CACHE_STATS = {"hits": 0, "misses": 0, "unkeyed": 0}
 #: the cross-tenant compile fence requires that N concurrent queries
 #: racing one program key trace/compile it at most ONCE; the old
 #: unlocked get/build/put raced N tracers to the same slot.
-_FUSED_CACHE_LOCK = threading.Lock()
+_FUSED_CACHE_LOCK = lockorder.make_lock("expressions.fusedCache")
 _FUSED_BUILDING: dict = {}
 
 

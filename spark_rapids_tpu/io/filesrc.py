@@ -13,6 +13,7 @@ from __future__ import annotations
 import glob
 import os
 import threading
+from spark_rapids_tpu.utils import lockorder
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
@@ -115,7 +116,7 @@ class FileSourceBase(DataSource):
         self._schema: Optional[Schema] = None
         self._splits: Optional[list] = None
         # reentrant: splits() -> _build_splits() -> schema() nests
-        self._lock = threading.RLock()
+        self._lock = lockorder.make_rlock("io.filesrc.splits")
         # observability for tests / explain (pruning effectiveness)
         self.chunks_total = 0
         self.chunks_pruned = 0
@@ -130,7 +131,7 @@ class FileSourceBase(DataSource):
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._lock = threading.RLock()
+        self._lock = lockorder.make_rlock("io.filesrc.splits")
 
     # conf key naming the debug-dump directory for this format (None =
     # no dump support); subclasses point at their format's key
@@ -302,7 +303,7 @@ class FileSourceBase(DataSource):
         c = copy.copy(self)
         c.filters = self.filters + list(filters)
         c._splits = None
-        c._lock = threading.RLock()
+        c._lock = lockorder.make_rlock("io.filesrc.splits")
         c.chunks_total = 0
         c.chunks_pruned = 0
         return c

@@ -5,6 +5,7 @@ RDMA-registered allocation. First-fit with free-block coalescing."""
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, List, Optional, Tuple
 
 
@@ -12,7 +13,7 @@ class AddressSpaceAllocator:
     def __init__(self, size: int):
         assert size > 0
         self.size = size
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("memory.addressSpace")
         self._free: List[Tuple[int, int]] = [(0, size)]  # (offset, len)
         self._allocated: Dict[int, int] = {}             # offset -> len
 

@@ -31,6 +31,7 @@ import os
 import queue
 import tempfile
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -114,7 +115,7 @@ class _AsyncSpillWriter:
         self._catalog = catalog
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._pending = 0
-        self._cv = threading.Condition()
+        self._cv = lockorder.make_condition("memory.catalog.spillWriter")
         self._thread: Optional[threading.Thread] = None
 
     def _ensure_thread(self) -> None:
@@ -181,7 +182,7 @@ class BufferCatalog:
         self.async_spill = async_spill
         self._writer: Optional[_AsyncSpillWriter] = None
         self._spilling_bytes = 0  # submitted to the writer, uncommitted
-        self._lock = threading.RLock()
+        self._lock = lockorder.make_rlock("memory.catalog.state")
         self._entries: Dict[int, _Entry] = {}
         self._ids = itertools.count(1)
         self._seq = itertools.count()
@@ -579,7 +580,7 @@ class BufferCatalog:
 
 
 _global_catalog: Optional[BufferCatalog] = None
-_global_lock = threading.Lock()
+_global_lock = lockorder.make_lock("memory.catalog.global")
 
 
 def get_catalog() -> BufferCatalog:

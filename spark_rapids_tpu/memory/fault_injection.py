@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import random
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Optional, Sequence, Tuple
 
 
@@ -47,7 +48,7 @@ class FaultInjector:
     """Thread-safe injection point shared by every guarded call."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("memory.faultInjection")
         self.disarm()
 
     def disarm(self) -> None:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import re
 import threading
+from spark_rapids_tpu.utils import lockorder
 import time
 from typing import Callable, List, Optional, TypeVar
 
@@ -81,7 +82,7 @@ def is_oom_error(exc: BaseException) -> bool:
 DEFAULT_MAX_SPILL_RETRIES = 2
 DEFAULT_MAX_SPLIT_DEPTH = 8
 
-_policy_lock = threading.Lock()
+_policy_lock = lockorder.make_lock("memory.retry.policy")
 _max_spill_retries = DEFAULT_MAX_SPILL_RETRIES
 _max_split_depth = DEFAULT_MAX_SPLIT_DEPTH
 
@@ -112,7 +113,7 @@ def reset_config() -> None:
 _STAT_KEYS = ("oom_retries", "oom_splits", "spilled_bytes", "blocked_s",
               "gave_ups")
 
-_stats_lock = threading.Lock()
+_stats_lock = lockorder.make_lock("memory.retry.stats")
 _totals = {k: 0 for k in _STAT_KEYS}
 _per_site: dict = {}
 _per_owner: dict = {}

@@ -10,6 +10,7 @@ task: a task that already holds a permit doesn't double-acquire
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Optional, Set
 
 
@@ -24,7 +25,7 @@ class TpuSemaphore:
         # permit total (the reference keeps per-task TaskInfo for the same
         # reason, GpuSemaphore.scala:106-130)
         self._holders: Set[int] = set()
-        self._cv = threading.Condition()
+        self._cv = lockorder.make_condition("memory.semaphore")
         self._tls = threading.local()
 
     def acquire_if_necessary(self, task_id: Optional[int] = None) -> bool:
@@ -83,7 +84,7 @@ class TpuSemaphore:
 
 
 _instance: Optional[TpuSemaphore] = None
-_instance_lock = threading.Lock()
+_instance_lock = lockorder.make_lock("memory.semaphore.instance")
 
 
 def initialize(max_concurrent: int) -> TpuSemaphore:

@@ -13,12 +13,13 @@ import ctypes
 import os
 import subprocess
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Optional
 
 import numpy as np
 
 _LIB_NAME = "libsrt_native.so"
-_lock = threading.Lock()
+_lock = lockorder.make_lock("native.init")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

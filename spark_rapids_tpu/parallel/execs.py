@@ -94,11 +94,12 @@ def _shard_batch(mesh, batch: ColumnarBatch, dtypes: List[dt.DType]):
     String columns shard their int32 codes; dictionaries stay host-side
     with the template column."""
     n = batch.realized_num_rows()
-    arrays, valids = [], []
-    for c in batch.columns:
-        arrays.append(np.asarray(jax.device_get(c.data))[:n])
-        valids.append(None if c.validity is None else
-                      np.asarray(jax.device_get(c.validity))[:n])
+    # ONE device_get over the whole batch (device_get takes a pytree;
+    # None validities pass through as empty nodes): the per-column loop
+    # this replaces paid one ~105 ms RTT per data/validity array
+    host = jax.device_get([(c.data, c.validity) for c in batch.columns])
+    arrays = [np.asarray(d)[:n] for d, _v in host]
+    valids = [None if v is None else np.asarray(v)[:n] for _d, v in host]
     return distributed_batch_from_host(mesh, arrays, dtypes,
                                        validities=valids)
 
@@ -115,9 +116,13 @@ def _gather_sharded(out_datas, out_valids, counts, dtypes: List[dt.DType],
                     ) -> ColumnarBatch:
     """Collect per-shard live prefixes into one batch, rebuilding string
     columns onto their template dictionaries."""
-    host_d = [np.asarray(jax.device_get(d)) for d in out_datas]
-    host_v = [np.asarray(jax.device_get(v)) for v in out_valids]
-    ns = np.atleast_1d(np.asarray(jax.device_get(counts)))
+    # ONE device_get for every shard's data, validity, and counts
+    # (was 2 x n_cols + 1 transfers — each a full RTT behind the tunnel)
+    hd, hv, hn = jax.device_get((list(out_datas), list(out_valids),
+                                 counts))
+    host_d = [np.asarray(d) for d in hd]
+    host_v = [np.asarray(v) for v in hv]
+    ns = np.atleast_1d(np.asarray(hn))
     rcap = len(host_d[0]) // n_dev
     total = int(ns.sum())
     cap = bucket_capacity(max(total, 1))

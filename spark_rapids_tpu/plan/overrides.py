@@ -1223,3 +1223,9 @@ def explain(plan: pn.PlanNode, conf: Optional[RapidsConf] = None) -> str:
     meta = NodeMeta(plan, conf)
     meta.tag_for_tpu()
     return meta.explain()
+
+
+# all module-level knobs (including every import-time op flag above)
+# are registered by this point; anything added later is a per-node
+# apply-time flag that docs generation can never see
+cfg.snapshot_docs_registry()

@@ -32,6 +32,7 @@ import base64
 import itertools
 import pickle
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
@@ -134,7 +135,7 @@ class ExecutorContext:
         self.executor = executor
         self.transport = transport
         self._clients: Dict[str, ShuffleClient] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("runtime.cluster.clients")
 
     def client_for(self, peer: str) -> ShuffleClient:
         with self._lock:
@@ -445,7 +446,7 @@ class RemoteWorkerHandle:
         self.proc = proc
         self.host = host
         self.port = port
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("runtime.cluster.worker")
 
     @classmethod
     def spawn(cls, executor_id: str,
@@ -541,12 +542,12 @@ class ClusterRuntime:
             self.cluster.register_remote_executor(w.executor_id, w.host,
                                                   w.port)
         self._sid = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("runtime.cluster.state")
         # serializes fetch-failure recovery against stub rebuilds: the
         # window between invalidating a dead executor's MapStatus and the
         # re-run registering its replacement must not be observable (a
         # snapshot taken inside it would silently drop that map's blocks)
-        self._recover_lock = threading.RLock()
+        self._recover_lock = lockorder.make_rlock("runtime.cluster.recover")
         # shuffle_id -> exchange exec (for upstream stage re-runs)
         self.exchanges: Dict[int, ClusterShuffleExchangeExec] = {}
         # shuffle_id -> map_id -> executor_id assignment

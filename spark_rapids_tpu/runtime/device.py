@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Optional
 
 from spark_rapids_tpu import config as cfg
@@ -75,7 +76,7 @@ class RuntimeEnv:
 
 
 _env: Optional[RuntimeEnv] = None
-_lock = threading.Lock()
+_lock = lockorder.make_lock("runtime.device")
 
 
 def initialize(conf: Optional[RapidsConf] = None,

@@ -23,6 +23,7 @@ compiled program. This module makes that sharing:
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.ops import buckets as _ladder
@@ -58,7 +59,7 @@ class ShapeBucketRegistry:
     MAX_SPECS = 256
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("service.batching.buckets")
         #: (program_key, bucket) -> observation count
         self._seen: Dict[Tuple, int] = {}
         #: program_key -> replayable spec at the largest observed bucket
@@ -174,7 +175,16 @@ class ShapeBucketRegistry:
                     # concurrent double-warm is one duplicate compile)
                     with self._lock:
                         self._warmed.add(mark)
-                except Exception:
+                except Exception as e:
+                    from spark_rapids_tpu.memory.retry import \
+                        is_oom_error
+
+                    if is_oom_error(e):
+                        # device OOM on a ladder rung is not a bad
+                        # program — it must reach the retry ladder /
+                        # admission, not be counted away (tpulint
+                        # TPU401)
+                        raise
                     # a program whose trace depends on operand VALUES
                     # (not shapes) may reject zeros; warmup is advisory
                     errors += 1

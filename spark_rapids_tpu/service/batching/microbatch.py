@@ -43,6 +43,7 @@ one entry in its coalesced-participation counter
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -145,8 +146,8 @@ class MicroBatcher:
         #: can seal EARLY — nobody else can possibly join, so waiting
         #: out the window would be pure added latency
         self.inflight_fn = inflight_fn
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = lockorder.make_lock("service.batching.microbatch")
+        self._cv = lockorder.make_condition("service.batching.microbatch", lock=self._lock)
         self._groups: Dict[tuple, _Group] = {}
         #: (program_key, signature, k) -> jitted K-way program
         self._coalesced: Dict[tuple, object] = {}
@@ -279,7 +280,13 @@ class MicroBatcher:
                 try:
                     jax.block_until_ready(fn(tuple([zargs] * k)))
                     variants += 1
-                except Exception:
+                except Exception as e:
+                    from spark_rapids_tpu.memory.retry import \
+                        is_oom_error
+
+                    if is_oom_error(e):
+                        raise  # OOM belongs to the retry ladder, not
+                        #        the advisory error count (TPU401)
                     errors += 1
         return {"programs": programs, "variants": variants,
                 "errors": errors}
@@ -328,7 +335,11 @@ class MicroBatcher:
             skey = tuple(sorted((k, repr(v))
                                 for k, v in statics.items()))
             return (program_key, treedef, sig, skey)
-        except Exception:
+        except Exception as e:
+            from spark_rapids_tpu.memory.retry import is_oom_error
+
+            if is_oom_error(e):
+                raise  # never classify an OOM as "unbatchable" (TPU401)
             return None
 
     def _coalesced_program(self, key, k: int, raw, statics):

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+from spark_rapids_tpu.utils import lockorder
 import time
 from typing import Dict, Optional
 
@@ -45,9 +46,9 @@ class QueryService:
         self.conf = conf if isinstance(conf, RapidsConf) else \
             RapidsConf(conf)
         self.session = session
-        self._lock = threading.RLock()
-        self._done_cv = threading.Condition(self._lock)   # result() waits
-        self._work_cv = threading.Condition(self._lock)   # workers wait
+        self._lock = lockorder.make_rlock("service.query")
+        self._done_cv = lockorder.make_condition("service.query", lock=self._lock)   # result() waits
+        self._work_cv = lockorder.make_condition("service.query", lock=self._lock)   # workers wait
         self._queries: Dict[int, Query] = {}
         self._finished_order: list = []  # terminal qids, oldest first
         self._counters = {"submitted": 0, "admitted": 0, "shed": 0,
@@ -202,7 +203,15 @@ class QueryService:
                 self.submit(plan, tenant="__warmup__").result(
                     timeout=timeout)
                 ran += 1
-            except Exception:
+            except Exception as e:
+                from spark_rapids_tpu.memory.retry import is_oom_error
+
+                if is_oom_error(e):
+                    # an OOM that survived the in-query retry ladder is
+                    # a capacity fault, not a bad template: surface it
+                    # instead of shipping a service that admits load it
+                    # cannot hold (tpulint TPU401)
+                    raise
                 errors += 1   # warmup is advisory: a template that
                 #               cannot run fails ITS tenant later, not
                 #               service startup

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import List, Optional, Tuple
 
 
@@ -127,7 +128,7 @@ PROVIDERS: List[type] = [ModernJaxShimProvider, LegacyJaxShimProvider]
 
 OVERRIDE_ENV = "RAPIDS_TPU_SHIMS_PROVIDER_OVERRIDE"
 
-_lock = threading.Lock()
+_lock = lockorder.make_lock("shims.init")
 _shims: Optional[JaxShims] = None
 
 

@@ -8,6 +8,7 @@ serving path acquires through the spill catalog, transparently unspilling
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.columnar import compression, serde
@@ -23,7 +24,7 @@ class ShuffleBufferCatalog:
                  codec: str = "lz4"):
         self.buffer_catalog = buffer_catalog
         self.codec = codec
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("shuffle.catalog.state")
         self._blocks: Dict[BlockId, SpillableBatch] = {}
         self._metas: Dict[BlockId, ShuffleTableMeta] = {}
 

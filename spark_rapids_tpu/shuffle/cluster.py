@@ -11,6 +11,7 @@ a DCN-backed transport."""
 from __future__ import annotations
 
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Dict, Iterator, List, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -78,7 +79,7 @@ class LocalCluster:
             self.transport.register(ex.server)
         # shuffle_id -> map_id -> executor_id (MapOutputTracker)
         self._map_outputs: Dict[int, Dict[int, str]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("shuffle.cluster.state")
         self._clients: Dict[tuple, ShuffleClient] = {}
 
     def executor(self, i: int) -> Executor:

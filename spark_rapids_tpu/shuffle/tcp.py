@@ -30,6 +30,7 @@ import json
 import socket
 import struct
 import threading
+from spark_rapids_tpu.utils import lockorder
 import time
 from typing import Dict, List, Optional
 
@@ -97,7 +98,7 @@ class TcpShuffleServer:
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self._conns: List[socket.socket] = []
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("shuffle.tcp.server")
         self._closed = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop,
@@ -199,7 +200,7 @@ class TcpConnection(Connection):
                  max_transient_retries: Optional[int] = None):
         self._addr = (host, port)
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("shuffle.tcp.client")
         self._connect_timeout = connect_timeout
         self._max_retries = self.MAX_TRANSIENT_RETRIES \
             if max_transient_retries is None else max_transient_retries
@@ -303,7 +304,7 @@ class TcpTransport:
     def __init__(self):
         self._servers: Dict[str, TcpShuffleServer] = {}
         self._addrs: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("shuffle.tcp.registry")
 
     def register(self, server: ShuffleServer, host: str = "127.0.0.1",
                  port: int = 0) -> TcpShuffleServer:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from spark_rapids_tpu.utils import lockorder
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -61,7 +62,7 @@ class ShuffleServer:
         self.executor_id = executor_id
         self.catalog = catalog
         self._payloads: Dict[BlockId, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("shuffle.transport.store")
         # fault-injection hooks (tests): raise/mutate per request
         self.on_metadata: Optional[Callable] = None
         self.on_chunk: Optional[Callable] = None
@@ -200,7 +201,7 @@ class LocalTransport:
 
     def __init__(self):
         self._endpoints: Dict[str, _Endpoint] = {}
-        self._lock = threading.Lock()
+        self._lock = lockorder.make_lock("shuffle.transport.endpoints")
 
     def register(self, server: ShuffleServer) -> None:
         with self._lock:
@@ -232,7 +233,7 @@ class _InflightThrottle:
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
         self._inflight = 0
-        self._cv = threading.Condition()
+        self._cv = lockorder.make_condition("shuffle.transport.throttle")
         self.peak = 0  # observability
 
     def acquire(self, n: int) -> None:

@@ -30,6 +30,7 @@ import struct
 import subprocess
 import sys
 import threading
+from spark_rapids_tpu.utils import lockorder
 from typing import Optional
 
 _HDR = struct.Struct("<I")
@@ -142,7 +143,7 @@ class PythonWorkerPool:
 
 
 _POOL: Optional[PythonWorkerPool] = None
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = lockorder.make_lock("udf.pyworker.pool")
 
 
 def run_udf(conf, fn, *args):

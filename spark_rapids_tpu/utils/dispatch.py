@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import threading
+from spark_rapids_tpu.utils import lockorder
 import time
 
 _installed = False
@@ -38,7 +39,7 @@ _compiled_fns: list = []
 # carry their own stage.
 _tls = threading.local()
 _stage_counts: dict = {}
-_stage_lock = threading.Lock()
+_stage_lock = lockorder.make_lock("utils.dispatch.stage")
 
 
 def enter_stage(label):

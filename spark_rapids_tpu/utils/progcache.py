@@ -25,9 +25,10 @@ from __future__ import annotations
 
 import os
 import threading
+from spark_rapids_tpu.utils import lockorder
 
 _installed_dir = None
-_lock = threading.Lock()
+_lock = lockorder.make_lock("utils.progcache")
 
 
 def _platform_suffix() -> str:

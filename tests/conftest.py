@@ -12,9 +12,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Runtime lock-order assertions for the whole tier-1 run
+# (rapids.tpu.debug.lockOrder.enabled). Must be set BEFORE the package
+# imports: every lock is wrapped (or not) at creation time. Record mode
+# (the default): violations accumulate instead of raising mid-test, and
+# pytest_sessionfinish below fails the run if any were observed.
+os.environ.setdefault("RAPIDS_TPU_DEBUG_LOCKORDER_ENABLED", "1")
+
 import pytest  # noqa: E402
 
 import spark_rapids_tpu  # noqa: E402,F401  (enables x64 before jax use)
+from spark_rapids_tpu.utils import lockorder  # noqa: E402
 
 # The axon TPU bootstrap (sitecustomize) overrides jax_platforms via
 # jax.config.update at interpreter start, so the env var alone is not
@@ -40,3 +48,22 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "full" not in item.keywords:
             item.add_marker(pytest.mark.smoke)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail the run if any lock-order inversion was recorded anywhere in
+    the suite — the dynamic half of tpulint's TPU301 (the static pass
+    only sees nestings it can prove; this catches the interleavings)."""
+    viols = lockorder.violations()
+    if not viols:
+        return
+    rep = session.config.pluginmanager.get_plugin("terminalreporter")
+    for v in viols:
+        msg = ("LOCK-ORDER VIOLATION: acquired %(acquiring)r (rank "
+               "%(acquiring_rank)d) while holding %(held)r (rank "
+               "%(held_rank)d) on thread %(thread)s\n%(stack)s" % v)
+        if rep:
+            rep.write_line(msg, red=True)
+        else:  # pragma: no cover - no terminal plugin
+            print(msg)
+    session.exitstatus = 3
