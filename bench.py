@@ -152,7 +152,32 @@ def bench_cpu(keys, key_valid, vals):
     return dt, out
 
 
-def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1):
+def _service_warmup(runner, benchmark: str):
+    """Warm compile caches through the service warmup ladder before the
+    timed run: register_template traces + compiles the query's stage
+    programs (persisted via progcache, which IS process-global), then
+    replays the bucket-registry rungs so smaller capacity buckets start
+    hot too. The throwaway service is discarded — its per-service
+    result cache is never consulted by the timed BenchmarkRunner path,
+    so the measurement below is a genuine cold-data/hot-code run."""
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.benchmarks.runner import ALL_BENCHMARKS
+    from spark_rapids_tpu.service.query_service import QueryService
+
+    runner.ensure_data(benchmark)
+    plan = ALL_BENCHMARKS[benchmark](runner.data_dir)
+    svc = QueryService({cfg.SERVICE_WARMUP_ENABLED.key: True})
+    try:
+        report = svc.register_template(plan, name=benchmark) or {}
+    finally:
+        svc.shutdown()
+    return {"templates": report.get("templates"),
+            "ladder_rungs": len(report.get("ladder") or {}),
+            "seconds": report.get("seconds")}
+
+
+def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
+                     warmup_service: bool = True):
     """One REAL TPC query end-to-end through the engine (round-5
     verdict: the driver-visible bench must capture a full query whose
     number moves with engine work, not only the q5lite microbench).
@@ -162,6 +187,12 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1):
     from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner
 
     r = BenchmarkRunner(os.path.join("/tmp", "srt_bench_tpcxbb"), sf)
+    warmed = None
+    if warmup_service:
+        try:
+            warmed = _service_warmup(r, benchmark)
+        except Exception as e:  # advisory: a warmup fault must not
+            warmed = {"error": str(e)[:120]}  # sink the measurement
     res = r.run(benchmark, iterations=2, warmup=1, compare=True)
     wall = res["min_time_sec"]
     dt = res.get("dispatch_telemetry", {})
@@ -183,6 +214,7 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1):
         "cpu_oracle_s": round(cpu_s, 3),
         "vs_cpu_oracle": round(cpu_s / wall, 3) if wall else None,
         "matches_cpu": cmp_.get("matches_cpu"),
+        "warmup": warmed,
     }
 
 
@@ -204,8 +236,11 @@ def main():
     refresh_cache_seed()
     cpu_dt, cpu_out = bench_cpu(keys, key_valid, vals)
     full = None
+    # --warmup is default-on (PR 7 ladder: first real query starts
+    # hot); --no-warmup opts out for cold-compile measurements
+    warmup_service = "--no-warmup" not in sys.argv
     try:
-        full = bench_full_query()
+        full = bench_full_query(warmup_service=warmup_service)
     except Exception as e:  # the headline line must still print
         full = {"error": f"{type(e).__name__}: {e}"[:300]}
 

@@ -41,6 +41,9 @@ class Session:
         else:
             self.runtime = None
         self._catalog: Dict = {}
+        #: table name -> registration version; replacing a temp view
+        #: bumps it (a SNAPSHOT EVENT for the semantic cache)
+        self._catalog_versions: Dict[str, int] = {}
         self._service = None
         import threading
 
@@ -131,15 +134,46 @@ class Session:
 
     # -- SQL entry point ---------------------------------------------------
 
-    def create_temp_view(self, name: str, df_or_source) -> None:
+    def create_temp_view(self, name: str, df_or_source) -> int:
         """Register a DataFrame / DataSource / plan under ``name`` for
-        Session.sql (createOrReplaceTempView analogue)."""
+        Session.sql (createOrReplaceTempView analogue). REPLACING a
+        registered view is a SNAPSHOT EVENT: the displaced target's
+        sources get their cache snapshot version bumped, so results the
+        semantic cache computed from the old view are unreachable (the
+        version participates in every cache key) — a silent replace
+        must never serve yesterday's dashboard. Returns the table's new
+        registration version."""
         target = df_or_source
         if isinstance(target, DataFrame):
             target = target._plan
+        prev = self._catalog.get(name)
+        if prev is not None and prev is not target:
+            from spark_rapids_tpu.service.cache import snapshots
+
+            snapshots.bump_plan(prev)
         self._catalog[name] = target
+        version = self._catalog_versions.get(name, 0) + 1
+        self._catalog_versions[name] = version
+        return version
 
     createOrReplaceTempView = create_temp_view
+
+    def table_version(self, name: str) -> int:
+        """Registration version of ``name`` (0 = never registered)."""
+        return self._catalog_versions.get(name, 0)
+
+    def bump_table_version(self, name: str) -> int:
+        """Explicitly invalidate cached results over ``name`` (the
+        in-place-mutation escape hatch: data changed UNDER the same
+        registered source object, which no key can see on its own)."""
+        from spark_rapids_tpu.service.cache import snapshots
+
+        target = self._catalog.get(name)
+        if target is not None:
+            snapshots.bump_plan(target)
+        version = self._catalog_versions.get(name, 0) + 1
+        self._catalog_versions[name] = version
+        return version
 
     def register_parquet(self, name: str, path, columns=None) -> None:
         """Catalog a parquet directory as a SQL table."""

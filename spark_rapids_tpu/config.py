@@ -722,6 +722,53 @@ SERVICE_WARMUP_LADDER = conf("rapids.tpu.service.warmup.ladder").doc(
     "applies when warmup.enabled is set."
 ).boolean_conf.create_with_default(True)
 
+SERVICE_CACHE_ENABLED = conf("rapids.tpu.service.cache.enabled").doc(
+    "Master switch for the semantic cache (service/cache): repeat "
+    "queries over unchanged table snapshots are served from the exact "
+    "result cache, and matching stage subplans from the fragment "
+    "cache, instead of recomputing on the device. Keys are canonical "
+    "plan fingerprints (plan/fingerprint) plus table snapshot "
+    "versions, so invalidation is a version comparison — a replaced "
+    "view, a rewritten file, or Session.bump_table_version all miss "
+    "exactly. Sources without a stable identity (in-memory data) "
+    "always bypass."
+).boolean_conf.create_with_default(True)
+
+SERVICE_CACHE_RESULT = conf(
+    "rapids.tpu.service.cache.resultCache.enabled").doc(
+    "Serve a query whose (canonical plan fingerprint, table snapshot "
+    "versions) key matches a stored result directly from the host-side "
+    "result cache — zero planning, zero device dispatches. Concurrent "
+    "identical misses single-flight: one leader computes, followers "
+    "are served a copy when it completes."
+).boolean_conf.create_with_default(True)
+
+SERVICE_CACHE_FRAGMENT = conf(
+    "rapids.tpu.service.cache.fragmentCache.enabled").doc(
+    "Materialize cacheable stage subplans (aggregate/join/sort/window "
+    "roots — the stage-breaker analogues of plan/optimizer.cut_stages) "
+    "as spillable batches on first execution and graft them into later "
+    "plans as cached-scan leaves, so subplans shared across queries "
+    "and tenants compute once. Entries ride the device->host->disk "
+    "spill tiers under the normal priority machinery and their "
+    "device-resident bytes count against admission's HBM budget."
+).boolean_conf.create_with_default(True)
+
+SERVICE_CACHE_MAX_BYTES = conf("rapids.tpu.service.cache.maxBytes").doc(
+    "Combined byte budget for cached results (host frames) and cached "
+    "fragments (spillable batches, measured at device width). Above "
+    "it, least-recently-used unpinned entries are evicted; an entry "
+    "larger than the whole budget is never stored. See "
+    "docs/tuning-guide.md for sizing against the device budget."
+).bytes_conf.create_with_default(256 << 20)
+
+SERVICE_CACHE_TTL = conf("rapids.tpu.service.cache.ttlSec").doc(
+    "Time-to-live in seconds for cache entries: an entry older than "
+    "this is treated as a miss and evicted on next touch. 0 (default) "
+    "disables TTL — snapshot-version invalidation alone decides "
+    "freshness, which is exact for file-backed and protocol sources."
+).double_conf.create_with_default(0.0)
+
 FILTER_PUSHDOWN_ENABLED = conf(
     "rapids.tpu.sql.format.pushDownFilters.enabled").doc(
     "Push comparison conjuncts from a Filter above a file scan into the "

@@ -13,6 +13,12 @@ OUTPUT_FOR_SHUFFLE_PRIORITY = 0
 # Buffers received from a remote shuffle, not yet handed to a task.
 INPUT_FROM_SHUFFLE_PRIORITY = 1 << 20
 
+# Materialized semantic-cache fragments (service/cache): re-creatable
+# from their source plan, so they spill before any query's working
+# batches, but they serve many future queries, so they outlast shuffle
+# residue awaiting a single consumer.
+CACHED_FRAGMENT_PRIORITY = 1 << 30
+
 # Batches buffered by the coalesce iterator while accumulating to its goal.
 COALESCE_PRIORITY = 1 << 40
 

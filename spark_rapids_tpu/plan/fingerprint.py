@@ -1,0 +1,129 @@
+"""Canonical plan fingerprinting for the semantic cache (service/cache).
+
+Mirrors ``Expression.tree_key()`` one level up: a hashable structural
+key over a logical plan tree, with every leaf DataSource keyed by its
+``(identity, snapshot version)`` pair from service/cache/snapshots. Two
+plans with equal fingerprints read provably identical data and compute
+provably identical results — the key the result cache and fragment
+cache both hang entries on.
+
+Conservative by construction: any payload this module cannot key —
+an unkeyable expression, an opaque source (InMemorySource), a node
+carrying runtime state (execs.cache.CacheNode's holder) — makes the
+whole fingerprint None and the plan bypasses caching. A false "miss"
+costs a recompute; a false "hit" would be a wrong answer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.expressions.base import Expression
+from spark_rapids_tpu.plan import nodes as pn
+
+#: sentinel distinct from a legitimate ``None`` attribute value
+_UNKEYABLE = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanFingerprint:
+    """``key`` is the hashable structural fingerprint; ``reads`` lists
+    every ``(source identity, snapshot version)`` pair the plan reads —
+    already folded into ``key``, kept separately for cache-entry
+    observability (stats can say WHAT an entry depends on)."""
+
+    key: tuple
+    reads: tuple
+
+
+def plan_fingerprint(plan: pn.PlanNode) -> Optional[PlanFingerprint]:
+    """Fingerprint a plan subtree, or None when it cannot be keyed.
+    Snapshot versions are resolved AS OF NOW: calling this twice around
+    a table mutation yields different keys — which is exactly how
+    publish-time revalidation detects a mid-run version bump."""
+    reads: List[tuple] = []
+    memo: dict = {}
+    key = _node_key(plan, reads, memo)
+    if key is None:
+        return None
+    return PlanFingerprint(key=key, reads=tuple(reads))
+
+
+def _node_key(node: pn.PlanNode, reads, memo):
+    cached = memo.get(id(node))
+    if cached is not None:  # shared CTE subtree: key (and stat) once
+        return cached
+    params = []
+    for k in sorted(vars(node)):
+        if k == "children":
+            continue
+        vk = _val_key(vars(node)[k], reads)
+        if vk is _UNKEYABLE:
+            if k.startswith("_"):
+                continue  # private unkeyable attrs are caches, not params
+            return None
+        params.append((k, vk))
+    kids = []
+    for c in node.children:
+        ck = _node_key(c, reads, memo)
+        if ck is None:
+            return None
+        kids.append(ck)
+    out = (type(node).__module__, type(node).__qualname__,
+           tuple(params), tuple(kids))
+    memo[id(node)] = out
+    return out
+
+
+def _source_key(source, reads):
+    from spark_rapids_tpu.service.cache import snapshots
+
+    ident = snapshots.source_identity(source)
+    if ident is None:
+        return _UNKEYABLE
+    version = snapshots.source_version(source)
+    if version is None:
+        return _UNKEYABLE
+    reads.append((ident, version))
+    return ("#src", ident, version)
+
+
+def _val_key(v, reads):
+    # float by repr: NaN would never dict-hit and -0.0 == 0.0 would
+    # alias two semantically different constants (same rationale as
+    # Expression.tree_key)
+    if isinstance(v, (float, np.floating)):
+        return ("#f", repr(float(v)))
+    if isinstance(v, (bool, int, str, bytes, type(None))):
+        return v
+    if isinstance(v, np.integer):
+        return ("#np", int(v))
+    if isinstance(v, np.bool_):
+        return ("#np", bool(v))
+    if isinstance(v, Expression):
+        tk = v.tree_key()
+        return _UNKEYABLE if tk is None else ("#expr", tk)
+    if isinstance(v, pn.DataSource):
+        return _source_key(v, reads)
+    if hasattr(v, "name") and hasattr(v, "kernel_dtype"):
+        return ("#dtype", v.name)
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        # AggCall / SortKeySpec / WindowFrame / WindowCall payloads
+        fields = []
+        for f in dataclasses.fields(v):
+            fk = _val_key(getattr(v, f.name), reads)
+            if fk is _UNKEYABLE:
+                return _UNKEYABLE
+            fields.append((f.name, fk))
+        return ("#dc", type(v).__qualname__, tuple(fields))
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            xk = _val_key(x, reads)
+            if xk is _UNKEYABLE:
+                return _UNKEYABLE
+            out.append(xk)
+        return ("#seq",) + tuple(out)
+    return _UNKEYABLE

@@ -424,6 +424,13 @@ def estimate_key_ndv(node: pn.PlanNode, ordinal: int) -> Optional[int]:
 
 def estimate_rows(node: pn.PlanNode) -> Optional[int]:
     """Plan-time cardinality estimate; None = unknown (no reordering)."""
+    est_fn = getattr(node, "plan_row_estimate", None)
+    if est_fn is not None:
+        # nodes that carry their own estimate (a cached-fragment leaf
+        # knows the cardinality of the subtree it replaced) — without
+        # this, a grafted serve leaf would charge default_rows against
+        # admission for data that is already materialized
+        return est_fn()
     if isinstance(node, pn.ScanNode):
         est = node.source.estimated_row_count()
         if est is not None and isinstance(node.source, pn.DataSource) \

@@ -889,6 +889,16 @@ class _CacheRule(NodeRule):
         return CachedExec(meta.node, children[0])
 
 
+class _FragmentRule(NodeRule):
+    def convert(self, meta, children):
+        from spark_rapids_tpu.service.cache.fragments import (
+            FragmentCaptureExec, FragmentServeExec)
+
+        if children:
+            return FragmentCaptureExec(meta.node, children[0])
+        return FragmentServeExec(meta.node)
+
+
 class _MapInPandasRule(NodeRule):
     def convert(self, meta, children):
         from spark_rapids_tpu.execs.python_exec import MapInPandasExec
@@ -974,6 +984,11 @@ def _register_io_rules():
     from spark_rapids_tpu.execs.cache import CacheNode
     from spark_rapids_tpu.execs.python_exec import MapInPandasNode
     from spark_rapids_tpu.io.write import WriteFilesNode
+    # cycle-safe: service/cache/fragments imports execs/memory/plan.nodes
+    # only, never this module (the service layer reaches overrides
+    # exclusively through function-level imports)
+    from spark_rapids_tpu.service.cache.fragments import \
+        CachedFragmentNode
 
     from spark_rapids_tpu.execs.python_exec import (
         AggregateInPandasNode, ArrowEvalPythonNode,
@@ -988,6 +1003,7 @@ def _register_io_rules():
     _NODE_RULES[ArrowEvalPythonNode] = _ArrowEvalPythonRule()
     _NODE_RULES[AggregateInPandasNode] = _AggInPandasRule()
     _NODE_RULES[CacheNode] = _CacheRule()
+    _NODE_RULES[CachedFragmentNode] = _FragmentRule()
     # mirror the reference: pandas execs are off by default because data
     # leaves the accelerator for the Python worker
     # (GpuOverrides.scala:1888-1907)

@@ -90,6 +90,12 @@ class AdmissionController:
         # keep gating on the orphaned one forever. An explicit instance
         # (tests) is honored as-is.
         self.semaphore = semaphore
+        # optional callable charging extra device-resident bytes
+        # against the HBM budget — the service points it at the
+        # semantic cache's READY fragments so cached data and inflight
+        # queries share one accounting (a full cache narrows admission
+        # instead of overcommitting the device)
+        self.extra_bytes_fn = None
         self._weights = dict(weights or {})
         self._tenants: Dict[str, _TenantQueue] = {}
         self._rr: List[str] = []   # WRR cycle order (arrival order)
@@ -198,9 +204,11 @@ class AdmissionController:
         # its real working set lives in the spill chain, so billing
         # the full over-budget footprint would park it behind every
         # in-flight query until the device drained
-        if budget is not None and \
-                self.inflight_bytes + q.charge > budget:
-            return False
+        if budget is not None:
+            extra = int(self.extra_bytes_fn()) \
+                if self.extra_bytes_fn is not None else 0
+            if self.inflight_bytes + extra + q.charge > budget:
+                return False
         return True
 
     def next_admissible(self) -> Optional[Query]:

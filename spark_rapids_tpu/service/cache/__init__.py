@@ -1,0 +1,28 @@
+"""Semantic result & fragment cache (ROADMAP item 4).
+
+The engine already applies identity discipline to *programs* — compiled
+plans persist in progcache, shape-bucketed executables are shared across
+tenants (service/batching) — but recomputed every *result* from
+scratch. Production SQL traffic is dominated by repeated dashboard
+queries over slowly-changing data; this package extends the same
+identity discipline to data, with three tiers of reuse:
+
+- ``result_cache``: exact repeat queries served from a host-side result
+  cache keyed on (canonical plan fingerprint, table snapshot versions)
+  — zero device dispatches.
+- ``fragments``: materialized stage outputs keyed on (stage subplan
+  fingerprint, input snapshot versions), grafted into later plans as
+  cached-scan leaves so shared subplans across queries and tenants
+  compute once. Entries are first-class spillable citizens — stored as
+  ``SpillableBatch``es with owner tagging, evicted through the
+  device→host→disk tiers by the existing priority machinery, charged
+  against admission's device budget.
+- ``snapshots``: table snapshot versioning — every cache entry records
+  the (source identity, version) pairs it read, so invalidation is a
+  version comparison, never a staleness guess.
+
+``manager.CacheManager`` (one per ``QueryService``) ties the tiers
+together: lookup/publish hooks, single-flight coordination so N
+concurrent identical misses compute once, a shared LRU byte budget,
+and stats. Plan fingerprinting lives in ``plan/fingerprint.py``.
+"""

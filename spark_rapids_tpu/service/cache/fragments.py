@@ -1,0 +1,236 @@
+"""Fragment cache: materialized stage outputs as spillable citizens.
+
+A *fragment* is the output of a cacheable stage subplan (aggregate /
+join / sort / window roots — the logical analogues of the stage
+breakers ``plan/optimizer.cut_stages`` cuts on). The manager's graft
+pass (manager.CacheManager.graft_fragments) rewrites submitted plans:
+
+- a READY entry replaces its subplan with a **serve-mode**
+  ``CachedFragmentNode`` leaf — no children, no device work; the
+  planner converts it to ``FragmentServeExec`` which streams the
+  stored ``SpillableBatch``es (auto-unspilling through host/disk
+  tiers exactly like shuffle blocks),
+- a first miss wraps the subplan in a **capture-mode** node —
+  ``FragmentCaptureExec`` drains the child once under a
+  materialize-once barrier (the same ``execs.cache.materialize``
+  plan-barrier rank CacheHolder uses) and publishes the entry.
+
+Safety properties the tests fence:
+
+- batches register under the entry's OWN owner tag ``("svc-cache",
+  id)`` — the scheduler's post-terminal owner sweep for the capturing
+  query must not reap cache entries that outlive it;
+- an OOM while materializing degrades to cache-off: the half-built
+  entry is dropped and the child subtree re-executes streaming —
+  never a wrong answer (PR 6 retry-ladder contract);
+- publish revalidates the subplan fingerprint against CURRENT snapshot
+  versions, so a table bumped mid-materialization aborts the entry
+  instead of publishing stale data under a fresh-looking key;
+- a key already PENDING in another query is NOT waited on (a worker
+  slice blocking on another query's barrier could deadlock at
+  maxConcurrent=1) and NOT double-captured — the second query simply
+  compiles the plain subtree.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+from spark_rapids_tpu.execs.base import TpuExec, timed
+from spark_rapids_tpu.memory import priorities
+from spark_rapids_tpu.memory.catalog import set_buffer_owner
+from spark_rapids_tpu.memory.fault_injection import get_injector
+from spark_rapids_tpu.memory.retry import is_oom_error
+from spark_rapids_tpu.memory.spillable import SpillableBatch
+from spark_rapids_tpu.plan.nodes import PlanNode
+from spark_rapids_tpu.utils import lockorder
+
+#: entry lifecycle: PENDING (registered, not yet materialized) ->
+#: READY (published, servable) | ABORTED (failed/evicted/invalidated)
+PENDING, READY, ABORTED = "pending", "ready", "aborted"
+
+#: fault-injection site armed by tests to OOM a materialization
+MATERIALIZE_SITE = "cache.fragment.materialize"
+
+_ENTRY_IDS = itertools.count(1)
+
+
+class FragmentEntry:
+    """One cached fragment. ``state``/``bytes``/``pins``/``last_used``
+    are guarded by the manager's ``service.cache.state`` lock; the
+    per-entry materialize barrier only serializes capture itself."""
+
+    def __init__(self, key, subtree: PlanNode, schema: Schema,
+                 reads: tuple, est_rows: Optional[int], manager):
+        self.key = key
+        #: the ORIGINAL subplan (pre-graft) — publish re-fingerprints
+        #: it to detect a snapshot bump that happened mid-run
+        self.subtree = subtree
+        self.schema = schema
+        self.reads = reads
+        self.est_rows = est_rows
+        self.manager = manager
+        self.entry_id = next(_ENTRY_IDS)
+        self.state = PENDING
+        self.bytes = 0
+        self.pins = 0
+        self.hits = 0
+        self.created_at = time.perf_counter()
+        self.last_used = self.created_at
+        self._barrier = lockorder.make_lock("execs.cache.materialize")
+        self._parts: Optional[Dict[int, List[SpillableBatch]]] = None
+
+    @property
+    def owner_tag(self):
+        """Catalog buffer-owner tag. NOT the capturing query's tag: the
+        scheduler sweeps a terminal query's owned buffers, and a cache
+        entry must outlive the query that happened to fill it."""
+        return ("svc-cache", self.entry_id)
+
+    def num_partitions(self) -> int:
+        parts = self._parts
+        return max(len(parts), 1) if parts else 1
+
+    def close_parts(self) -> None:
+        parts, self._parts = self._parts, None
+        _close_handles(parts or {})
+
+
+def _close_handles(parts: Dict[int, List[SpillableBatch]]) -> None:
+    for handles in parts.values():
+        for h in handles:
+            h.close()
+
+
+def _serve(entry: FragmentEntry, schema: Schema,
+           partition: int) -> Iterator[ColumnarBatch]:
+    """Yield an entry's stored batches for one partition, pinned for
+    the duration so eviction cannot close handles mid-iteration."""
+    entry.manager.fragment_pin(entry)
+    try:
+        handles = (entry._parts or {}).get(partition, ())
+        if not handles:
+            yield ColumnarBatch.empty(schema)
+            return
+        for h in handles:
+            with h.acquired() as batch:
+                yield batch
+    finally:
+        entry.manager.fragment_unpin(entry)
+
+
+class CachedFragmentNode(PlanNode):
+    """Graft marker. Serve mode has no children (a cached-scan leaf);
+    capture mode wraps the original subtree as its only child."""
+
+    def __init__(self, entry: FragmentEntry,
+                 child: Optional[PlanNode] = None):
+        super().__init__([child] if child is not None else [])
+        self.entry = entry
+
+    def output_schema(self) -> Schema:
+        return self.entry.schema
+
+    def plan_row_estimate(self) -> Optional[int]:
+        # the optimizer's estimate_rows hook: a serve leaf knows the
+        # cardinality of the subtree it replaced (estimated at graft)
+        return self.entry.est_rows
+
+    def describe(self) -> str:
+        mode = "capture" if self.children else "serve"
+        return f"CachedFragment[{mode}, {self.entry.state}]"
+
+
+class FragmentServeExec(TpuExec):
+    """Serve a READY fragment: stream its spillable batches. Acquiring
+    a handle unspills it back to device transparently (the disk-tier
+    round trip the tests fence bit-exact)."""
+
+    def __init__(self, node: CachedFragmentNode):
+        super().__init__([], node.entry.schema)
+        self.node = node
+
+    @property
+    def num_partitions(self) -> int:
+        return self.node.entry.num_partitions()
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        return timed(self, _serve(self.node.entry, self.schema,
+                                  partition))
+
+
+class FragmentCaptureExec(TpuExec):
+    """First execution of a missed fragment: drain the child subtree
+    once into spillable batches, publish, then serve. On any failure
+    the entry aborts and execution degrades to streaming the child."""
+
+    def __init__(self, node: CachedFragmentNode, child: TpuExec):
+        super().__init__([child], child.schema)
+        self.node = node
+
+    @property
+    def num_partitions(self) -> int:
+        entry = self.node.entry
+        if entry.state == READY and entry._parts is not None:
+            return entry.num_partitions()
+        return self.children[0].num_partitions
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        def it():
+            entry = self.node.entry
+            if self._capture(entry):
+                yield from _serve(entry, self.schema, partition)
+            else:
+                # cache-off degrade: deterministic re-execution of the
+                # plain subtree — correctness never depends on capture
+                for b in self.children[0].execute(partition):
+                    yield b
+        return timed(self, it())
+
+    def _capture(self, entry: FragmentEntry) -> bool:
+        """Materialize-once; True iff the entry is servable. Runs under
+        the per-entry plan barrier: concurrent partitions of the SAME
+        query serialize here, then all serve from the stored parts."""
+        with entry._barrier:
+            if entry.state == READY:
+                return True
+            if entry.state != PENDING:
+                return False
+            child = self.children[0]
+            parts: Dict[int, List[SpillableBatch]] = {}
+            prev = set_buffer_owner(entry.owner_tag)
+            try:
+                injector = get_injector()
+                for p in range(child.num_partitions):
+                    handles: List[SpillableBatch] = []
+                    for b in child.execute(p):
+                        injector.maybe_inject(MATERIALIZE_SITE)
+                        # defer_count: counting rows here would force a
+                        # host sync per batch (tpulint TPU1xx) for a
+                        # number serving never needs eagerly
+                        handles.append(SpillableBatch(
+                            b, priorities.CACHED_FRAGMENT_PRIORITY,
+                            defer_count=True))
+                    parts[p] = handles
+            except Exception as e:
+                _close_handles(parts)
+                if not is_oom_error(e):
+                    entry.manager.fragment_aborted(entry, oom=False)
+                    raise
+                # OOM while filling the cache degrades to cache-off,
+                # never to a wrong answer: drop the partial entry and
+                # let the caller stream the child fresh
+                entry.manager.fragment_aborted(entry, oom=True)
+                return False
+            except BaseException:
+                # scheduler interrupts (cancel/deadline) pass through;
+                # the half-built entry must not linger half-registered
+                _close_handles(parts)
+                entry.manager.fragment_aborted(entry, oom=False)
+                raise
+            finally:
+                set_buffer_owner(prev)
+            entry._parts = parts
+            return entry.manager.publish_fragment(entry)

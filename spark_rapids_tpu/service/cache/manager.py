@@ -1,0 +1,372 @@
+"""CacheManager: one registry uniting result + fragment tiers.
+
+Every QueryService owns one manager. ``submit()`` consults it twice:
+
+1. **result tier** — ``result_key(plan)`` fingerprints the whole plan
+   (canonical tree key + source snapshot versions); a hit serves the
+   stored frame with ZERO device work, and a non-terminal leader for
+   the same key absorbs concurrent identical misses as *followers*
+   (single-flight: N dashboards refreshing together compute once);
+2. **fragment tier** — ``graft_fragments(plan)`` rewrites the plan,
+   replacing READY cacheable stage roots with serve leaves and
+   wrapping first-seen ones in capture nodes (see
+   :mod:`spark_rapids_tpu.service.cache.fragments`).
+
+Both tiers share one byte budget (``rapids.tpu.service.cache.maxBytes``)
+and one LRU clock, and both revalidate their fingerprint at PUBLISH
+time — a table version bumped mid-run aborts the publish instead of
+installing stale data under a fresh-looking key.
+
+Locking: one ``service.cache.state`` lock (rank 76) guards the
+registries and counters. Lookups arrive under the service lock (20),
+fragment publishes arrive inside a materialize barrier (planBarrier,
+rank <=38), and eviction closes spillable handles through the catalog
+(rank 100) — the rank sits between those bands so every path nests
+cleanly; see utils/lockorder.py.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.memory.retry import is_oom_error
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.fingerprint import plan_fingerprint
+from spark_rapids_tpu.service.cache import fragments
+from spark_rapids_tpu.service.cache.result_cache import (ResultCache,
+                                                         ResultEntry)
+from spark_rapids_tpu.utils import lockorder
+
+#: stage roots worth materializing: the logical analogues of the
+#: pipeline breakers cut_stages cuts on — their output is small relative
+#: to the work that produced it, which is exactly when caching pays
+FRAGMENT_CANDIDATES = (pn.AggregateNode, pn.JoinNode, pn.SortNode,
+                       pn.WindowNode)
+
+
+class CacheManager:
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        conf = conf if isinstance(conf, RapidsConf) else RapidsConf(conf)
+        master = conf.get(cfg.SERVICE_CACHE_ENABLED)
+        self.enabled = master
+        self.result_enabled = master and conf.get(cfg.SERVICE_CACHE_RESULT)
+        self.fragment_enabled = master and \
+            conf.get(cfg.SERVICE_CACHE_FRAGMENT)
+        self.max_bytes = conf.get(cfg.SERVICE_CACHE_MAX_BYTES)
+        self.ttl_s = conf.get(cfg.SERVICE_CACHE_TTL)
+        self._lock = lockorder.make_lock("service.cache.state")
+        self._results = ResultCache()
+        self._fragments: Dict[Tuple, fragments.FragmentEntry] = {}
+        self._frag_bytes = 0
+        self._frag_hits = 0
+        self._frag_misses = 0
+        self._frag_published = 0
+        self._frag_aborted = 0
+        self._frag_evicted = 0
+        self._oom_degraded = 0
+        self._followers = 0
+
+    # -- result tier --------------------------------------------------
+
+    def result_key(self, plan: pn.PlanNode) -> Optional[Tuple]:
+        """Cache key for a whole plan, or None when any leaf is
+        unkeyable (ad-hoc in-memory frames, gated test sources)."""
+        if not self.result_enabled:
+            return None
+        fp = plan_fingerprint(plan)
+        if fp is None:
+            return None
+        return ("result", fp.key)
+
+    def lookup_result(self, key, count: bool = True):
+        """The cached frame (a private copy) or None."""
+        with self._lock:
+            e = self._results.get(key, time.perf_counter(), self.ttl_s,
+                                  count=count)
+            if e is None:
+                return None
+            return e.frame.copy()
+
+    def publish_result(self, key, plan: pn.PlanNode, frame) -> bool:
+        """Install a completed query's frame. Recomputes the plan's
+        fingerprint first: a snapshot bumped while the query ran means
+        ``frame`` describes data that no longer exists — skip."""
+        if not self.result_enabled or frame is None:
+            return False
+        fp = plan_fingerprint(plan)
+        if fp is None or ("result", fp.key) != key:
+            return False
+        try:
+            nbytes = int(frame.memory_usage(index=True, deep=True).sum())
+        except Exception as e:
+            if is_oom_error(e):
+                raise
+            nbytes = 0  # exotic frame: admit unmetered rather than drop
+        if nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            self._evict_locked(nbytes)
+            self._results.put(ResultEntry(key, frame.copy(), nbytes,
+                                          fp.reads))
+        return True
+
+    def note_follower(self) -> None:
+        with self._lock:
+            self._followers += 1
+
+    # -- fragment tier ------------------------------------------------
+
+    def graft_fragments(self, plan: pn.PlanNode
+                        ) -> Tuple[pn.PlanNode,
+                                   List[fragments.FragmentEntry]]:
+        """Rewrite ``plan`` against the fragment registry. Returns the
+        (possibly identical) plan plus the PENDING entries this query
+        became responsible for — the service aborts them at finalize if
+        the run never published them."""
+        if not self.fragment_enabled:
+            return plan, []
+        pending: List[fragments.FragmentEntry] = []
+        memo: dict = {}
+        out = self._graft(plan, True, pending, memo)
+        return out, pending
+
+    def _graft(self, node, allow_capture, pending, memo):
+        mk = (id(node), allow_capture)
+        hit = memo.get(mk)
+        if hit is None:
+            hit = self._graft_inner(node, allow_capture, pending, memo)
+            memo[mk] = hit
+        return hit
+
+    def _graft_inner(self, node, allow_capture, pending, memo):
+        if isinstance(node, FRAGMENT_CANDIDATES):
+            fp = plan_fingerprint(node)
+            if fp is not None:
+                key = ("fragment", fp.key)
+                entry = self._fragment_lookup_or_register(
+                    key, node, fp, allow_capture)
+                if entry is not None and entry.state == fragments.READY:
+                    return fragments.CachedFragmentNode(entry)
+                if entry is not None:
+                    # fresh PENDING entry owned by this query: capture.
+                    # Children still graft (a READY inner fragment
+                    # feeds the capture), but no nested captures — one
+                    # materialization per path keeps the plan's memory
+                    # footprint shaped like a single extra stage.
+                    pending.append(entry)
+                    inner = self._rebuild(node, False, pending, memo)
+                    return fragments.CachedFragmentNode(entry,
+                                                        child=inner)
+                # PENDING in another query (don't block on someone
+                # else's barrier, don't double-capture) or aborted and
+                # not recapturable here: compile the plain subtree
+        return self._rebuild(node, allow_capture, pending, memo)
+
+    def _rebuild(self, node, allow_capture, pending, memo):
+        kids = [self._graft(c, allow_capture, pending, memo)
+                for c in node.children]
+        if all(k is c for k, c in zip(kids, node.children)):
+            return node
+        return node.with_children(kids)
+
+    def _fragment_lookup_or_register(self, key, node, fp,
+                                     allow_capture):
+        """READY entry (hit), a NEW pending entry this caller must
+        capture, or None (pending/aborted elsewhere, or capture not
+        allowed here)."""
+        now = time.perf_counter()
+        with self._lock:
+            entry = self._fragments.get(key)
+            if entry is not None and entry.state == fragments.READY \
+                    and self.ttl_s > 0 \
+                    and now - entry.created_at > self.ttl_s:
+                self._evict_fragment_locked(entry)
+                entry = None
+            if entry is not None:
+                if entry.state == fragments.READY:
+                    entry.hits += 1
+                    entry.last_used = now
+                    self._frag_hits += 1
+                    return entry
+                return None
+            if not allow_capture:
+                return None
+            self._frag_misses += 1
+            est = self._estimate_rows(node)
+            entry = fragments.FragmentEntry(
+                key, node, node.output_schema(), fp.reads, est, self)
+            self._fragments[key] = entry
+            return entry
+
+    @staticmethod
+    def _estimate_rows(node) -> Optional[int]:
+        from spark_rapids_tpu.plan.optimizer import estimate_rows
+        try:
+            return estimate_rows(node)
+        except Exception as e:
+            if is_oom_error(e):
+                raise
+            return None  # estimate is advisory; capture proceeds
+
+    def publish_fragment(self, entry: fragments.FragmentEntry) -> bool:
+        """Promote a fully materialized entry to READY. Revalidates the
+        subplan fingerprint against CURRENT snapshot versions and the
+        registry mapping; any mismatch drops the entry (the capture
+        degrades to streaming — a correctness no-op)."""
+        parts = entry._parts or {}
+        size = sum(h.device_memory_size()
+                   for handles in parts.values() for h in handles)
+        fp = plan_fingerprint(entry.subtree)
+        ok = (fp is not None and ("fragment", fp.key) == entry.key
+              and size <= self.max_bytes)
+        with self._lock:
+            if ok and entry.state == fragments.PENDING \
+                    and self._fragments.get(entry.key) is entry:
+                self._evict_locked(size)
+                entry.bytes = size
+                entry.state = fragments.READY
+                entry.last_used = time.perf_counter()
+                self._frag_bytes += size
+                self._frag_published += 1
+                return True
+            if self._fragments.get(entry.key) is entry:
+                self._fragments.pop(entry.key, None)
+            entry.state = fragments.ABORTED
+            self._frag_aborted += 1
+            entry.close_parts()
+            return False
+
+    def fragment_aborted(self, entry: fragments.FragmentEntry,
+                         oom: bool) -> None:
+        """Capture failed (handles already closed by the caller)."""
+        with self._lock:
+            if self._fragments.get(entry.key) is entry:
+                self._fragments.pop(entry.key, None)
+            if entry.state == fragments.PENDING:
+                entry.state = fragments.ABORTED
+                self._frag_aborted += 1
+                if oom:
+                    self._oom_degraded += 1
+            entry.close_parts()
+
+    def abort_pending(self,
+                      entries: List[fragments.FragmentEntry]) -> None:
+        """Finalize sweep for a query's registered-but-unpublished
+        entries (shed/failed/cancelled before capture ran). Removing
+        the aborted mapping lets a future query retry the capture."""
+        for entry in entries:
+            with self._lock:
+                if entry.state == fragments.PENDING:
+                    entry.state = fragments.ABORTED
+                    self._frag_aborted += 1
+                    entry.close_parts()
+                if entry.state == fragments.ABORTED and \
+                        self._fragments.get(entry.key) is entry:
+                    self._fragments.pop(entry.key, None)
+
+    def fragment_pin(self, entry: fragments.FragmentEntry) -> None:
+        with self._lock:
+            entry.pins += 1
+            entry.last_used = time.perf_counter()
+
+    def fragment_unpin(self, entry: fragments.FragmentEntry) -> None:
+        with self._lock:
+            entry.pins = max(entry.pins - 1, 0)
+
+    # -- shared budget -------------------------------------------------
+
+    def _evict_locked(self, need: int) -> None:
+        """LRU across BOTH tiers until ``need`` more bytes fit. Pinned
+        or pending fragments are not candidates; if nothing is
+        evictable the new entry is admitted anyway (the spill tiers
+        absorb transient overshoot — maxBytes bounds the steady state,
+        not a hard ceiling mid-publish)."""
+        while self._results.bytes + self._frag_bytes + need \
+                > self.max_bytes:
+            r = self._results.coldest()
+            f = None
+            for e in self._fragments.values():
+                if e.state == fragments.READY and e.pins == 0:
+                    if f is None or e.last_used < f.last_used:
+                        f = e
+            if r is not None and (f is None
+                                  or r.last_used <= f.last_used):
+                self._results.pop(r.key)
+                self._results.evicted += 1
+            elif f is not None:
+                self._evict_fragment_locked(f)
+            else:
+                break
+
+    def _evict_fragment_locked(self,
+                               entry: fragments.FragmentEntry) -> None:
+        if self._fragments.get(entry.key) is entry:
+            self._fragments.pop(entry.key, None)
+        self._frag_bytes -= entry.bytes
+        entry.state = fragments.ABORTED
+        entry.close_parts()
+        self._frag_evicted += 1
+
+    def device_resident_bytes(self) -> int:
+        """Bytes of READY fragment batches currently on the DEVICE
+        tier — admission charges these against the device budget (see
+        AdmissionController.extra_bytes_fn) so cached data and inflight
+        queries share one accounting. Spilled handles cost nothing."""
+        from spark_rapids_tpu.memory.catalog import (StorageTier,
+                                                     get_catalog)
+        cat = get_catalog()
+        total = 0
+        with self._lock:
+            for entry in self._fragments.values():
+                if entry.state != fragments.READY:
+                    continue
+                for handles in (entry._parts or {}).values():
+                    for h in handles:
+                        try:
+                            if cat.tier_of(h.buffer_id) == \
+                                    StorageTier.DEVICE:
+                                total += cat.size_of(h.buffer_id)
+                        except KeyError:
+                            continue
+        return total
+
+    # -- observability / lifecycle ------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            pending = sum(1 for e in self._fragments.values()
+                          if e.state == fragments.PENDING)
+            return {
+                "enabled": self.enabled,
+                "result": {
+                    "hits": self._results.hits,
+                    "misses": self._results.misses,
+                    "entries": len(self._results),
+                    "bytes": self._results.bytes,
+                    "evicted": self._results.evicted,
+                    "single_flight_followers": self._followers,
+                },
+                "fragment": {
+                    "hits": self._frag_hits,
+                    "misses": self._frag_misses,
+                    "published": self._frag_published,
+                    "aborted": self._frag_aborted,
+                    "oom_degraded": self._oom_degraded,
+                    "evicted": self._frag_evicted,
+                    "entries": len(self._fragments),
+                    "bytes": self._frag_bytes,
+                    "pending": pending,
+                },
+            }
+
+    def close(self) -> None:
+        """Release every entry (service shutdown, workers joined)."""
+        with self._lock:
+            for entry in list(self._fragments.values()):
+                entry.state = fragments.ABORTED
+                entry.close_parts()
+            self._fragments.clear()
+            self._frag_bytes = 0
+            self._results.clear()

@@ -1,0 +1,92 @@
+"""Exact result cache: final assembled frames keyed on plan identity.
+
+An entry maps ``("result", plan_fingerprint.key)`` — the canonical plan
+tree key WITH embedded source snapshot versions — to the pandas frame a
+prior query assembled. Because the snapshot version participates in the
+key, invalidation is free: bumping a table version makes every
+dependent key unreachable, and the orphaned entries age out of the LRU
+(or fall to TTL) without any scan-and-invalidate pass.
+
+This container is deliberately NOT self-locking: ``CacheManager``
+serializes every access under its single ``service.cache.state`` lock,
+and splitting that into a second lock here would only add a rank to the
+hierarchy for zero concurrency (all operations are dict moves).
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+
+class ResultEntry:
+    """One cached final result."""
+
+    __slots__ = ("key", "frame", "bytes", "reads", "created_at",
+                 "last_used", "hits")
+
+    def __init__(self, key, frame, nbytes: int, reads: tuple):
+        self.key = key
+        self.frame = frame
+        self.bytes = nbytes
+        self.reads = reads
+        self.created_at = time.perf_counter()
+        self.last_used = self.created_at
+        self.hits = 0
+
+
+class ResultCache:
+    """LRU over ``OrderedDict`` (front = coldest). Frames are stored and
+    served as copies so callers can mutate what they get back."""
+
+    def __init__(self):
+        self._entries: "OrderedDict[Tuple, ResultEntry]" = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key, now: float, ttl_s: float,
+            count: bool = True) -> Optional[ResultEntry]:
+        e = self._entries.get(key)
+        if e is not None and ttl_s > 0 and now - e.created_at > ttl_s:
+            # expired: treat as a miss and reclaim immediately
+            self.pop(key)
+            self.evicted += 1
+            e = None
+        if e is None:
+            if count:
+                self.misses += 1
+            return None
+        if count:
+            self.hits += 1
+            e.hits += 1
+        e.last_used = now
+        self._entries.move_to_end(key)
+        return e
+
+    def put(self, entry: ResultEntry) -> None:
+        old = self._entries.pop(entry.key, None)
+        if old is not None:
+            self.bytes -= old.bytes
+        self._entries[entry.key] = entry
+        self.bytes += entry.bytes
+
+    def pop(self, key) -> Optional[ResultEntry]:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self.bytes -= e.bytes
+        return e
+
+    def coldest(self) -> Optional[ResultEntry]:
+        """Peek the LRU-front entry (eviction candidate)."""
+        if not self._entries:
+            return None
+        return next(iter(self._entries.values()))
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.bytes = 0

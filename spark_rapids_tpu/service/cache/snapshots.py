@@ -1,0 +1,108 @@
+"""Table snapshot versioning: cache invalidation as version comparison.
+
+Every cache entry (service/cache) records the ``(source identity,
+snapshot version)`` pairs its plan read; a lookup recomputes them and
+misses on any difference. Nothing is ever "expired by guess" — a cached
+result is served iff the data it read is provably the data a fresh run
+would read.
+
+Identity and version resolve per source kind:
+
+- **file sources** (io/filesrc.FileSourceBase): identity is the sorted
+  path list + projected columns + pushed-down filters; version is the
+  per-file ``(mtime_ns, size)`` stat vector — rewriting or appending to
+  a file changes it with no bookkeeping required — plus the manual
+  bump counter below.
+- **custom sources** implementing the optional ``cache_identity()`` /
+  ``cache_version()`` protocol: whatever they return (must be hashable).
+- **everything else** (``InMemorySource``, test gate sources, ...):
+  UNKEYABLE — ``source_identity`` returns None and every plan over the
+  source bypasses the cache entirely. Ad-hoc host arrays have no stable
+  name, and two submissions of the same object must stay two
+  computations unless the source opts in.
+
+Manual bumps (``bump``/``bump_plan``) increment a monotonic counter on
+the source object itself — ``Session.create_temp_view`` replacing a
+view and ``Session.bump_table_version`` route through here, so a
+replaced view's old cached results are never served even when the
+underlying files did not move.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.utils import lockorder
+
+#: guards the per-source manual version counter (leaf lock: bump holds
+#: nothing else)
+_lock = lockorder.make_lock("service.cache.snapshots")
+
+
+def bump(source) -> int:
+    """Increment ``source``'s manual snapshot version; returns the new
+    version. Any cache entry keyed on the old version misses forever."""
+    with _lock:
+        v = int(getattr(source, "_snap_version", 0)) + 1
+        source._snap_version = v
+        return v
+
+
+def bump_plan(target) -> int:
+    """Bump every DataSource reachable from ``target`` (a DataSource, a
+    PlanNode tree, or a DataFrame-like with ``._plan``). Returns the
+    number of sources bumped."""
+    from spark_rapids_tpu.plan import nodes as pn
+
+    plan = getattr(target, "_plan", target)
+    if isinstance(plan, pn.DataSource):
+        bump(plan)
+        return 1
+    n = 0
+    if isinstance(plan, pn.PlanNode):
+        for node in pn.walk(plan):
+            src = getattr(node, "source", None)
+            if isinstance(src, pn.DataSource):
+                bump(src)
+                n += 1
+    return n
+
+
+def source_identity(source) -> Optional[tuple]:
+    """Stable content-addressing identity of a DataSource, or None when
+    the source is unkeyable (see module docstring)."""
+    fn = getattr(source, "cache_identity", None)
+    if callable(fn):
+        return ("#custom", type(source).__module__,
+                type(source).__qualname__, fn())
+    from spark_rapids_tpu.io.filesrc import FileSourceBase
+
+    if isinstance(source, FileSourceBase):
+        filters = tuple(tuple(f) for f in (source.filters or ()))
+        columns = tuple(source.columns) if source.columns else None
+        return ("#file", type(source).__qualname__,
+                tuple(source.paths), columns, filters)
+    return None
+
+
+def source_version(source) -> Optional[tuple]:
+    """Snapshot version of a keyable DataSource as of NOW, or None when
+    the version cannot be established (then nothing over this source is
+    cached — staleness must never be a guess)."""
+    import os
+
+    manual = int(getattr(source, "_snap_version", 0))
+    fn = getattr(source, "cache_version", None)
+    if callable(fn):
+        return ("#v", manual, fn())
+    from spark_rapids_tpu.io.filesrc import FileSourceBase
+
+    if isinstance(source, FileSourceBase):
+        stats = []
+        for p in source.paths:
+            try:
+                st = os.stat(p)
+            except OSError:
+                return None  # a vanished file: never serve cached data
+            stats.append((st.st_mtime_ns, st.st_size))
+        return ("#v", manual, tuple(stats))
+    return None

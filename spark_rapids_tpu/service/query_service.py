@@ -22,6 +22,7 @@ from spark_rapids_tpu.config import RapidsConf
 from spark_rapids_tpu.memory.catalog import get_catalog
 from spark_rapids_tpu.service.admission import (AdmissionController,
                                                 parse_fairness_weights)
+from spark_rapids_tpu.service.cache.manager import CacheManager
 from spark_rapids_tpu.service.scheduler import StageScheduler
 from spark_rapids_tpu.service.stats import Histogram, ServiceStats
 from spark_rapids_tpu.service.types import (DeadlineExceeded,
@@ -69,6 +70,14 @@ class QueryService:
                 self.conf.get(cfg.SERVICE_FAIRNESS_WEIGHTS)))
         self.scheduler = StageScheduler(
             self, n_workers=self.conf.get(cfg.SERVICE_MAX_CONCURRENT))
+        # semantic result & fragment cache (service/cache): per-service
+        # like the admission ledger. Its device-resident fragment bytes
+        # charge the admission budget so cached data and inflight
+        # queries never overcommit HBM between them.
+        self.cache = CacheManager(self.conf)
+        self.admission.extra_bytes_fn = self.cache.device_resident_bytes
+        #: result-cache key -> live leader Query (single-flight)
+        self._result_leaders: Dict = {}
         # cross-tenant micro-batching (service/batching): the ladder
         # growth installs process-wide (capacities are compared across
         # subsystems — one ladder per process; last service wins, the
@@ -115,14 +124,28 @@ class QueryService:
         # shed BEFORE planning: under overload — exactly when the
         # backpressure signal matters — a rejection must not pay the
         # full planner walk only to throw it away
+        ckey = self.cache.result_key(plan)
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("QueryService is shut down")
             self._counters["submitted"] += 1
             if self.admission.would_shed(tenant):
                 raise self._shed_locked(plan, tenant, priority, deadline)
+            # result tier: an exact hit needs no planning and no device
+            # work; a live leader for the same key absorbs this submit
+            # as a single-flight follower
+            if ckey is not None:
+                served = self._serve_cached_locked(ckey, tenant,
+                                                   priority, deadline)
+                if served is not None:
+                    return served
+        # fragment tier: replace READY cached stage roots with serve
+        # leaves, wrap first-seen ones in capture nodes; footprint and
+        # physical planning run on the grafted plan (a serve leaf costs
+        # what it stores, not what its subtree would recompute)
+        plan_to_run, pending_frags = self.cache.graft_fragments(plan)
         footprint = estimate_footprint_bytes(
-            plan,
+            plan_to_run,
             default_rows=self.conf.get(cfg.SERVICE_DEFAULT_ROW_ESTIMATE))
         # out-of-core decision BEFORE physical planning: a query whose
         # estimated peak exceeds the WHOLE device budget can never fit,
@@ -138,6 +161,7 @@ class QueryService:
             policy = str(self.conf.get(
                 cfg.SERVICE_OUT_OF_CORE_POLICY)).strip().lower()
             if policy == "shed":
+                self.cache.abort_pending(pending_frags)
                 with self._lock:
                     rec = self._record_shed_locked(tenant, priority,
                                                    deadline)
@@ -148,18 +172,33 @@ class QueryService:
             forced = max(budget // 4, 1 << 20)
             plan_conf = self.conf.with_overrides(
                 {cfg.BATCH_SIZE_BYTES.key: forced})
-        exec_ = apply_overrides(plan, plan_conf)
+        exec_ = apply_overrides(plan_to_run, plan_conf)
         stages = cut_stages(exec_)
         with self._lock:
             if self._shutdown:
+                self.cache.abort_pending(pending_frags)
                 raise RuntimeError("QueryService is shut down")
             if self.admission.would_shed(tenant):
                 # concurrent submitters planned past the first check
                 # and filled the queue meanwhile — the bound is hard
+                self.cache.abort_pending(pending_frags)
                 raise self._shed_locked(plan, tenant, priority, deadline)
+            if ckey is not None:
+                # a concurrent identical submit may have become leader
+                # (or finished) while this thread planned
+                served = self._serve_cached_locked(ckey, tenant,
+                                                   priority, deadline,
+                                                   count=False)
+                if served is not None:
+                    self.cache.abort_pending(pending_frags)
+                    return served
             q = Query(next(_GLOBAL_QUERY_IDS), tenant, plan, exec_,
                       priority, deadline, footprint, stages,
                       self._done_cv)
+            q.pending_fragments = pending_frags
+            if ckey is not None:
+                q.result_cache_key = ckey
+                self._result_leaders[ckey] = q
             if out_of_core:
                 q.out_of_core = True
                 # charge half the device: the forced-splitting plan
@@ -237,6 +276,34 @@ class QueryService:
         self._counters["shed"] += 1
         return q
 
+    def _serve_cached_locked(self, ckey, tenant: str, priority: int,
+                             deadline, count: bool = True):
+        """Serve a result-cache hit, or register behind a live leader.
+        Returns a handle, or None when this submit must run (and lead).
+        Hits finalize DONE immediately with zero device work; followers
+        park until the leader finalizes. Both stamp admitted/started so
+        stats never sees a DONE query without timing."""
+        frame = self.cache.lookup_result(ckey, count=count)
+        if frame is not None:
+            q = Query(next(_GLOBAL_QUERY_IDS), tenant, None, None,
+                      priority, deadline, 0, [], self._done_cv)
+            q.cache_hit = True
+            q.admitted_at = q.started_at = time.perf_counter()
+            q.result = frame
+            self._queries[q.query_id] = q
+            self._finalize_locked(q, QueryState.DONE)
+            return QueryHandle(self, q)
+        leader = self._result_leaders.get(ckey)
+        if leader is not None and not leader.terminal:
+            q = Query(next(_GLOBAL_QUERY_IDS), tenant, None, None,
+                      priority, deadline, 0, [], self._done_cv)
+            q.cache_hit = True
+            self.cache.note_follower()
+            leader.cache_followers.append(q)
+            self._queries[q.query_id] = q
+            return QueryHandle(self, q)
+        return None
+
     def _shed_locked(self, plan, tenant: str, priority: int,
                      deadline) -> ServiceOverloaded:
         """Record + build the overload rejection — the caller gets no
@@ -286,6 +353,7 @@ class QueryService:
             return ServiceStats(
                 retry=_retry.stats(),
                 batching=self.batcher.stats(),
+                cache=self.cache.stats(),
                 queue_depth=self.admission.queue_depth(),
                 running=running,
                 admitted_inflight=len(self.admission.inflight),
@@ -323,6 +391,9 @@ class QueryService:
             for q in list(self._queries.values()):
                 if not q.terminal:
                     self._finalize_locked(q, QueryState.CANCELLED)
+        # workers joined and every query finalized: no capture or serve
+        # can still be touching an entry's spillable handles
+        self.cache.close()
 
     # -- handle backends --------------------------------------------------
 
@@ -463,6 +534,33 @@ class QueryService:
         q.retry = _retry.pop_owner_stats(q.owner_tag)
         self._counters["oom_retries"] += q.retry["oom_retries"]
         self._counters["oom_splits"] += q.retry["oom_splits"]
+        # semantic cache bookkeeping — BEFORE q.plan is dropped below,
+        # because publish revalidates the plan's fingerprint against
+        # current snapshot versions (a table bumped while this query
+        # ran must not install a stale result under a fresh key)
+        if q.result_cache_key is not None:
+            if self._result_leaders.get(q.result_cache_key) is q:
+                self._result_leaders.pop(q.result_cache_key, None)
+            if state is QueryState.DONE and q.result is not None \
+                    and q.plan is not None:
+                self.cache.publish_result(q.result_cache_key, q.plan,
+                                          q.result)
+        if q.pending_fragments:
+            # capture entries this query registered but never published
+            # (failed/cancelled, or the capture path was never driven):
+            # drop them so a future query can retry the capture
+            self.cache.abort_pending(q.pending_fragments)
+            q.pending_fragments = []
+        followers, q.cache_followers = q.cache_followers, []
+        for f in followers:
+            if f.terminal:
+                continue  # cancelled/expired on its own while parked
+            if state is QueryState.DONE and q.result is not None:
+                f.result = q.result.copy()
+                f.admitted_at = f.started_at = time.perf_counter()
+                self._finalize_locked(f, QueryState.DONE)
+            else:
+                self._finalize_locked(f, state, error)
         # release every resource the query may still hold: admission
         # charge, catalog buffers (an abandoned exec tree must not leak
         # staged batches), and its execution cursor

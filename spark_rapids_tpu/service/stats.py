@@ -103,6 +103,10 @@ class ServiceStats:
     #: micro-batcher effectiveness (service/batching): physical
     #: launches, coalesced launches/participants, mean group size
     batching: dict = dataclasses.field(default_factory=dict)
+    #: semantic result & fragment cache effectiveness (service/cache):
+    #: per-tier hits/misses/bytes, single-flight followers, publishes,
+    #: OOM-degraded captures, evictions
+    cache: dict = dataclasses.field(default_factory=dict)
 
     @property
     def progcache_hit_rate(self) -> float:

@@ -107,6 +107,15 @@ class Query:
         # at finalize so stats history keeps it after the live map is
         # popped
         self.retry: dict = {}
+        # semantic cache (service/cache): a query holding a result-cache
+        # key is the single-flight LEADER for it — identical concurrent
+        # misses register as followers and are served (or failed) when
+        # the leader finalizes; pending_fragments are capture entries
+        # this query is responsible for publishing or aborting
+        self.result_cache_key = None
+        self.cache_followers: list = []
+        self.pending_fragments: list = []
+        self.cache_hit = False
         # cooperative execution cursor: per-partition batch iterators,
         # advanced one stage-slice at a time by the scheduler. The REAL
         # partition count resolves lazily on the first slice — querying

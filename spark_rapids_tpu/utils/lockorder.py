@@ -73,6 +73,12 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # -- fused-chain build prep cache (global registry bookkeeping;
     # acquired UNDER chainPrep, never holds a barrier itself) ----------
     "execs.fused.prepCache": 70,
+    # -- semantic cache registry (service/cache/manager): lookups run
+    # under the service lock (20), publishes run inside fragment
+    # materialize barriers (planBarrier, <=38), and eviction closes
+    # spillable entries through the catalog (100) — so it sits between
+    # the barriers and the memory subsystem --------------------------
+    "service.cache.state": 76,
     # -- serving-layer batching ----------------------------------------
     "service.batching.microbatch": 80,
     "service.batching.buckets": 84,
@@ -97,6 +103,7 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # -- leaf utility locks (never hold anything under these) ----------
     "execs.base.metrics": 150,
     "utils.progcache": 154,
+    "service.cache.snapshots": 158,  # per-source version bump counter
     "memory.retry.policy": 160,
     "memory.retry.stats": 164,
     "memory.faultInjection": 168,

@@ -1,0 +1,343 @@
+"""Semantic result & fragment cache (service/cache): the acceptance
+suite. Every fence is an ORACLE fence — hit, miss, follower, degraded
+and spilled paths must all return the exact frame a cache-off run
+returns — plus invalidation (a version bump is never served stale) and
+the resource contracts (single-flight, OOM-degrade, disk round trip)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.api import Session
+from spark_rapids_tpu.benchmarks.runner import (ALL_BENCHMARKS,
+                                                BenchmarkRunner)
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.cpu.engine import execute_cpu
+from spark_rapids_tpu.memory import fault_injection as FI
+from spark_rapids_tpu.memory.catalog import (BufferCatalog, StorageTier,
+                                             get_catalog, reset_catalog)
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.fingerprint import plan_fingerprint
+from spark_rapids_tpu.service import QueryService
+from spark_rapids_tpu.service.cache import snapshots
+
+from tests.compare import assert_frames_equal
+
+SF = 0.001
+
+
+@pytest.fixture(scope="module")
+def tpch_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("cache_tpch"))
+    BenchmarkRunner(d, SF).ensure_data("tpch_q1")
+    return d
+
+
+@pytest.fixture(scope="module")
+def tpcxbb_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("cache_tpcxbb"))
+    BenchmarkRunner(d, SF).ensure_data("tpcxbb_q26")
+    return d
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    FI.get_injector().disarm()
+    yield
+    FI.get_injector().disarm()
+
+
+def _write(path: str, df: pd.DataFrame) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.Table.from_pandas(df), path)
+    # parquet rewrites within one mtime tick must still version-bump
+    os.utime(path, ns=(time.time_ns(), time.time_ns()))
+
+
+def _tbl(seed=7, n=4000, nk=12):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({"k": rng.integers(0, nk, n).astype(np.int64),
+                         "v": rng.random(n)})
+
+
+AGG_SQL = "SELECT k, SUM(v) AS sv, COUNT(*) AS n FROM t GROUP BY k"
+
+
+# -- (1) hit / miss / off oracle fence --------------------------------------
+
+
+@pytest.mark.parametrize("qname", ["tpch_q1", "tpch_q6", "tpcxbb_q26"])
+def test_hit_miss_off_oracle_fence(qname, tpch_dir, tpcxbb_dir):
+    """Three runs of a real TPC query — cold miss, warm hit, and a
+    cache-disabled control — must all match the CPU oracle, and the hit
+    must do zero device work (no scheduler slices at all)."""
+    data_dir = tpcxbb_dir if qname.startswith("tpcxbb") else tpch_dir
+    plan_fn = ALL_BENCHMARKS[qname]
+    oracle = execute_cpu(plan_fn(data_dir)).to_pandas()
+
+    svc = QueryService()
+    try:
+        # fresh plan objects per submit: the key is STRUCTURAL, not
+        # object identity — two dashboards building the same query
+        # independently must collide on one entry
+        h_miss = svc.submit(plan_fn(data_dir))
+        miss = h_miss.result(timeout=600)
+        h_hit = svc.submit(plan_fn(data_dir))
+        hit = h_hit.result(timeout=600)
+        st = svc.stats()
+        assert st.cache["result"]["hits"] == 1
+        assert st.cache["result"]["misses"] >= 1
+        rec = [q for q in st.per_query
+               if q["query_id"] == h_hit.query_id][0]
+        assert rec["slices"] == 0, "a result-cache hit must not run"
+        assert rec["run_time_s"] is not None and rec["run_time_s"] >= 0
+    finally:
+        svc.shutdown()
+
+    off = QueryService({cfg.SERVICE_CACHE_ENABLED.key: False})
+    try:
+        control = off.submit(plan_fn(data_dir)).result(timeout=600)
+        assert off.stats().cache["enabled"] is False
+        assert off.stats().cache["result"]["hits"] == 0
+    finally:
+        off.shutdown()
+
+    assert_frames_equal(oracle, miss)
+    assert_frames_equal(oracle, hit)
+    assert_frames_equal(oracle, control)
+
+
+# -- (2) invalidation: data changed under the same plan ---------------------
+
+
+def test_version_bump_invalidates(tmp_path):
+    """Rewriting the backing parquet between two identical submits must
+    produce the NEW answer — the file's (mtime, size) participates in
+    the key, so the old entry is simply unreachable."""
+    p = str(tmp_path / "t.parquet")
+    old = _tbl(seed=1)
+    _write(p, old)
+    s = Session()
+    s.register_parquet("t", p)
+    q = s.sql(AGG_SQL)
+    svc = QueryService(s.conf, session=s)
+    try:
+        r1 = svc.submit(q).result(timeout=300)
+        assert_frames_equal(
+            old.groupby("k").agg(sv=("v", "sum"),
+                                 n=("v", "size")).reset_index(), r1)
+        new = _tbl(seed=2)
+        _write(p, new)
+        r2 = svc.submit(q).result(timeout=300)
+        assert_frames_equal(
+            new.groupby("k").agg(sv=("v", "sum"),
+                                 n=("v", "size")).reset_index(), r2)
+        st = svc.stats().cache
+        assert st["result"]["hits"] == 0, \
+            "a rewritten table must never serve the old frame"
+        assert st["result"]["misses"] == 2
+    finally:
+        svc.shutdown()
+
+
+def test_manual_bump_changes_fingerprint(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    _write(p, _tbl())
+    s = Session()
+    s.register_parquet("t", p)
+    plan = s.sql(AGG_SQL)._plan
+    before = plan_fingerprint(plan)
+    assert before is not None
+    assert snapshots.bump_plan(plan) == 1
+    after = plan_fingerprint(plan)
+    assert after is not None and after.key != before.key
+
+
+# -- (3) replaced temp view is a snapshot event (satellite 2) ---------------
+
+
+def test_replaced_temp_view_not_served_stale(tmp_path):
+    """createOrReplaceTempView over an existing name bumps the displaced
+    target's snapshot version: a plan captured against the OLD view must
+    re-compute after the replace, never serve its pre-replace cached
+    result (the silent-replace staleness regression)."""
+    from spark_rapids_tpu.io import ParquetSource
+
+    pa_, pb = str(tmp_path / "a.parquet"), str(tmp_path / "b.parquet")
+    _write(pa_, _tbl(seed=3))
+    _write(pb, _tbl(seed=4))
+    s = Session()
+    assert s.table_version("t") == 0
+    assert s.create_temp_view("t", ParquetSource(pa_)) == 1
+    q_old = s.sql(AGG_SQL)  # plans against (and pins) the OLD source
+    svc = QueryService(s.conf, session=s)
+    try:
+        r1 = svc.submit(q_old).result(timeout=300)
+        assert svc.stats().cache["result"]["misses"] == 1
+        assert s.create_temp_view("t", ParquetSource(pb)) == 2
+        assert s.table_version("t") == 2
+        r2 = svc.submit(q_old).result(timeout=300)
+        st = svc.stats().cache
+        assert st["result"]["hits"] == 0, \
+            "replaced view's old cached result was served"
+        assert st["result"]["misses"] == 2
+        # the old plan still reads the old files — same ANSWER, but it
+        # must have been recomputed, not replayed
+        assert_frames_equal(r1, r2)
+        # a plan over the replacement source computes the new data
+        r3 = svc.submit(s.sql(AGG_SQL)).result(timeout=300)
+        assert_frames_equal(
+            _tbl(seed=4).groupby("k").agg(
+                sv=("v", "sum"), n=("v", "size")).reset_index(), r3)
+    finally:
+        svc.shutdown()
+
+
+# -- (4) single-flight ------------------------------------------------------
+
+
+class SlowKeyedSource(pn.DataSource):
+    """Keyable via the cache_identity/cache_version protocol (GateSource
+    and InMemorySource are unkeyable BY DESIGN), with a gate so the
+    leader is provably still running when followers arrive."""
+
+    def __init__(self, tag: str, n=2000):
+        self.tag = tag
+        self.n = n
+        self.gate = threading.Event()
+        self.reads = 0
+
+    def cache_identity(self):
+        return ("slow-keyed", self.tag)
+
+    def cache_version(self):
+        return 1
+
+    def schema(self):
+        return Schema(["k", "v"], [dt.INT64, dt.FLOAT64])
+
+    def estimated_row_count(self):
+        return self.n
+
+    def read_host(self):
+        assert self.gate.wait(timeout=60), "gate never opened"
+        self.reads += 1
+        rng = np.random.default_rng(11)
+        return ({"k": rng.integers(0, 6, self.n).astype(np.int64),
+                 "v": rng.random(self.n)}, {"k": None, "v": None})
+
+
+def test_single_flight_concurrent_identical_misses():
+    """N concurrent identical submissions compute ONCE: the first
+    becomes leader, the rest park as followers and are served the
+    leader's frame at finalize."""
+    from spark_rapids_tpu.api import col, functions as F
+
+    from spark_rapids_tpu.api.dataframe import DataFrame
+
+    s = Session()
+    src = SlowKeyedSource("sf")
+    base = DataFrame(pn.ScanNode(src), s)
+    q = base.filter(col("v") > 0.2).group_by("k").agg(
+        F.sum(col("v")).alias("sv"), F.count("*").alias("n"))
+    svc = QueryService(s.conf, session=s)
+    try:
+        handles = [svc.submit(q, tenant=f"t{i}") for i in range(4)]
+        # all four accepted while the leader is gated; open the gate
+        time.sleep(0.1)
+        src.gate.set()
+        frames = [h.result(timeout=300) for h in handles]
+        st = svc.stats().cache["result"]
+        assert st["single_flight_followers"] == 3
+        assert st["misses"] >= 1
+        assert src.reads == 1, \
+            f"single-flight must compute once, read {src.reads}x"
+        ref = frames[0].sort_values("k").reset_index(drop=True)
+        for f in frames[1:]:
+            pd.testing.assert_frame_equal(
+                f.sort_values("k").reset_index(drop=True), ref)
+    finally:
+        src.gate.set()
+        svc.shutdown()
+
+
+# -- (5) OOM while materializing degrades to cache-off ----------------------
+
+
+def test_oom_during_capture_degrades_not_corrupts(tmp_path):
+    """An injected OOM inside fragment materialization drops the entry
+    and streams the subtree fresh — the query completes oracle-matched.
+    After disarm the next run captures, and the third run serves."""
+    p = str(tmp_path / "t.parquet")
+    src_df = _tbl(seed=5)
+    _write(p, src_df)
+    s = Session()
+    s.register_parquet("t", p)
+    q = s.sql(AGG_SQL)
+    oracle = src_df.groupby("k").agg(sv=("v", "sum"),
+                                     n=("v", "size")).reset_index()
+    # result tier off so every submit drives the fragment path
+    svc = QueryService({cfg.SERVICE_CACHE_RESULT.key: False},
+                       session=s)
+    try:
+        FI.get_injector().arm(at_call=1, consecutive=1,
+                              sites=["cache.fragment.materialize"],
+                              max_injections=1)
+        r1 = svc.submit(q).result(timeout=300)
+        assert_frames_equal(oracle, r1)
+        st = svc.stats().cache["fragment"]
+        assert st["oom_degraded"] >= 1
+        assert st["entries"] == 0, "the half-built entry must be gone"
+        FI.get_injector().disarm()
+        r2 = svc.submit(q).result(timeout=300)  # recapture succeeds
+        assert_frames_equal(oracle, r2)
+        assert svc.stats().cache["fragment"]["published"] >= 1
+        r3 = svc.submit(q).result(timeout=300)  # and now it serves
+        assert_frames_equal(oracle, r3)
+        assert svc.stats().cache["fragment"]["hits"] >= 1
+    finally:
+        svc.shutdown()
+
+
+# -- (6) cached fragment round-trips the disk tier bit-exact ----------------
+
+
+def test_fragment_spill_disk_roundtrip_bit_exact(tmp_path):
+    cat = reset_catalog(BufferCatalog(
+        spill_dir=str(tmp_path / "spill")))
+    try:
+        p = str(tmp_path / "t.parquet")
+        _write(p, _tbl(seed=6))
+        s = Session()
+        s.register_parquet("t", p)
+        q = s.sql(AGG_SQL)
+        svc = QueryService({cfg.SERVICE_CACHE_RESULT.key: False},
+                           session=s)
+        try:
+            r1 = svc.submit(q).result(timeout=300)
+            assert svc.stats().cache["fragment"]["published"] >= 1
+            # force every cached handle through host down to disk
+            cat.synchronous_spill(0)
+            cat.spill_host_to_disk(0)
+            tiers = [cat.tier_of(h.buffer_id)
+                     for e in svc.cache._fragments.values()
+                     if e._parts
+                     for hs in e._parts.values() for h in hs]
+            assert tiers and all(t is StorageTier.DISK for t in tiers)
+            r2 = svc.submit(q).result(timeout=300)
+            assert svc.stats().cache["fragment"]["hits"] >= 1
+            pd.testing.assert_frame_equal(
+                r1.sort_values("k").reset_index(drop=True),
+                r2.sort_values("k").reset_index(drop=True),
+                check_exact=True)
+        finally:
+            svc.shutdown()
+    finally:
+        reset_catalog(BufferCatalog())
