@@ -764,7 +764,9 @@ SERVICE_CACHE_MAX_BYTES = conf("rapids.tpu.service.cache.maxBytes").doc(
 
 SERVICE_CACHE_TTL = conf("rapids.tpu.service.cache.ttlSec").doc(
     "Time-to-live in seconds for cache entries: an entry older than "
-    "this is treated as a miss and evicted on next touch. 0 (default) "
+    "this is treated as a miss on next touch and evicted — or, while "
+    "queries still pin it (serving or holding it grafted in a queued "
+    "plan), marked stale and evicted on the last unpin. 0 (default) "
     "disables TTL — snapshot-version invalidation alone decides "
     "freshness, which is exact for file-backed and protocol sources."
 ).double_conf.create_with_default(0.0)

@@ -17,6 +17,12 @@ pass (manager.CacheManager.graft_fragments) rewrites submitted plans:
 
 Safety properties the tests fence:
 
+- a READY entry grafted as a serve leaf is **pinned at graft time**
+  and unpinned only when the query finalizes, so LRU/TTL eviction can
+  never close its handles while the query waits in the admission
+  queue; if parts are somehow gone at execute time, ``_serve`` raises
+  :class:`FragmentUnavailable` rather than yielding an empty (wrong)
+  batch;
 - batches register under the entry's OWN owner tag ``("svc-cache",
   id)`` — the scheduler's post-terminal owner sweep for the capturing
   query must not reap cache entries that outlive it;
@@ -57,6 +63,14 @@ MATERIALIZE_SITE = "cache.fragment.materialize"
 _ENTRY_IDS = itertools.count(1)
 
 
+class FragmentUnavailable(RuntimeError):
+    """A serve leaf reached execution but its entry's parts are gone
+    (evicted/aborted). Grafting pins READY entries for the query's
+    whole lifetime precisely so this cannot happen — raising (instead
+    of yielding an empty batch) turns any future pinning bug into a
+    loud failure, never a silently wrong answer."""
+
+
 class FragmentEntry:
     """One cached fragment. ``state``/``bytes``/``pins``/``last_used``
     are guarded by the manager's ``service.cache.state`` lock; the
@@ -76,6 +90,10 @@ class FragmentEntry:
         self.state = PENDING
         self.bytes = 0
         self.pins = 0
+        #: TTL expired while pinned: unservable to NEW grafts, but the
+        #: handles stay open until the last unpin evicts it (closing a
+        #: pinned entry under a mid-iteration server is use-after-close)
+        self.stale = False
         self.hits = 0
         self.created_at = time.perf_counter()
         self.last_used = self.created_at
@@ -107,11 +125,23 @@ def _close_handles(parts: Dict[int, List[SpillableBatch]]) -> None:
 def _serve(entry: FragmentEntry, schema: Schema,
            partition: int) -> Iterator[ColumnarBatch]:
     """Yield an entry's stored batches for one partition, pinned for
-    the duration so eviction cannot close handles mid-iteration."""
+    the duration so eviction cannot close handles mid-iteration. A
+    serve leaf additionally holds a graft-time pin for the query's
+    whole queued+running life, so the READY check below cannot fail
+    for a grafted plan — it guards against pinning bugs by raising
+    rather than fabricating an empty (wrong) result."""
     entry.manager.fragment_pin(entry)
     try:
-        handles = (entry._parts or {}).get(partition, ())
+        parts = entry._parts
+        if entry.state != READY or parts is None:
+            raise FragmentUnavailable(
+                f"cached fragment {entry.entry_id} is {entry.state} "
+                f"with no stored parts — entry evicted while a plan "
+                f"referencing it was live (missing pin?)")
+        handles = parts.get(partition, ())
         if not handles:
+            # a legitimately empty stored partition (captured zero
+            # batches there), NOT a closed entry
             yield ColumnarBatch.empty(schema)
             return
         for h in handles:
@@ -180,8 +210,16 @@ class FragmentCaptureExec(TpuExec):
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():
             entry = self.node.entry
-            if self._capture(entry):
-                yield from _serve(entry, self.schema, partition)
+            # pin-if-ready closes the publish->serve window: unlike a
+            # serve LEAF (pinned since graft), a capture node's entry
+            # is evictable the instant publish makes it READY, so
+            # re-check under the pin and degrade on loss
+            if self._capture(entry) and \
+                    entry.manager.fragment_pin_if_ready(entry):
+                try:
+                    yield from _serve(entry, self.schema, partition)
+                finally:
+                    entry.manager.fragment_unpin(entry)
             else:
                 # cache-off degrade: deterministic re-execution of the
                 # plain subtree — correctness never depends on capture

@@ -120,33 +120,41 @@ class CacheManager:
 
     def graft_fragments(self, plan: pn.PlanNode
                         ) -> Tuple[pn.PlanNode,
+                                   List[fragments.FragmentEntry],
                                    List[fragments.FragmentEntry]]:
         """Rewrite ``plan`` against the fragment registry. Returns the
-        (possibly identical) plan plus the PENDING entries this query
-        became responsible for — the service aborts them at finalize if
-        the run never published them."""
+        (possibly identical) plan, the PENDING entries this query
+        became responsible for (the service aborts them at finalize if
+        the run never published them), and the READY entries its serve
+        leaves reference — each **pinned here, at graft time**, so
+        LRU/TTL eviction cannot close a grafted entry's handles while
+        the query waits in the admission queue (a serve leaf has no
+        child to recompute from). The service releases the pins at
+        finalize via :meth:`release_served`."""
         if not self.fragment_enabled:
-            return plan, []
+            return plan, [], []
         pending: List[fragments.FragmentEntry] = []
+        served: List[fragments.FragmentEntry] = []
         memo: dict = {}
-        out = self._graft(plan, True, pending, memo)
-        return out, pending
+        out = self._graft(plan, True, pending, served, memo)
+        return out, pending, served
 
-    def _graft(self, node, allow_capture, pending, memo):
+    def _graft(self, node, allow_capture, pending, served, memo):
         mk = (id(node), allow_capture)
         hit = memo.get(mk)
         if hit is None:
-            hit = self._graft_inner(node, allow_capture, pending, memo)
+            hit = self._graft_inner(node, allow_capture, pending,
+                                    served, memo)
             memo[mk] = hit
         return hit
 
-    def _graft_inner(self, node, allow_capture, pending, memo):
+    def _graft_inner(self, node, allow_capture, pending, served, memo):
         if isinstance(node, FRAGMENT_CANDIDATES):
             fp = plan_fingerprint(node)
             if fp is not None:
                 key = ("fragment", fp.key)
                 entry = self._fragment_lookup_or_register(
-                    key, node, fp, allow_capture)
+                    key, node, fp, allow_capture, served)
                 if entry is not None and entry.state == fragments.READY:
                     return fragments.CachedFragmentNode(entry)
                 if entry is not None:
@@ -156,39 +164,52 @@ class CacheManager:
                     # materialization per path keeps the plan's memory
                     # footprint shaped like a single extra stage.
                     pending.append(entry)
-                    inner = self._rebuild(node, False, pending, memo)
+                    inner = self._rebuild(node, False, pending, served,
+                                          memo)
                     return fragments.CachedFragmentNode(entry,
                                                         child=inner)
                 # PENDING in another query (don't block on someone
                 # else's barrier, don't double-capture) or aborted and
                 # not recapturable here: compile the plain subtree
-        return self._rebuild(node, allow_capture, pending, memo)
+        return self._rebuild(node, allow_capture, pending, served, memo)
 
-    def _rebuild(self, node, allow_capture, pending, memo):
-        kids = [self._graft(c, allow_capture, pending, memo)
+    def _rebuild(self, node, allow_capture, pending, served, memo):
+        kids = [self._graft(c, allow_capture, pending, served, memo)
                 for c in node.children]
         if all(k is c for k, c in zip(kids, node.children)):
             return node
         return node.with_children(kids)
 
     def _fragment_lookup_or_register(self, key, node, fp,
-                                     allow_capture):
-        """READY entry (hit), a NEW pending entry this caller must
-        capture, or None (pending/aborted elsewhere, or capture not
-        allowed here)."""
+                                     allow_capture, served):
+        """READY entry (hit, pinned + recorded in ``served``), a NEW
+        pending entry this caller must capture, or None
+        (pending/aborted elsewhere, or capture not allowed here)."""
         now = time.perf_counter()
         with self._lock:
             entry = self._fragments.get(key)
             if entry is not None and entry.state == fragments.READY \
                     and self.ttl_s > 0 \
                     and now - entry.created_at > self.ttl_s:
-                self._evict_fragment_locked(entry)
+                # expired: a miss either way, but NEVER close a pinned
+                # entry's handles — a server may be mid-iteration and a
+                # queued query's graft may reference it. Mark it stale;
+                # the last unpin performs the eviction.
+                if entry.pins == 0:
+                    self._evict_fragment_locked(entry)
+                else:
+                    entry.stale = True
                 entry = None
             if entry is not None:
                 if entry.state == fragments.READY:
                     entry.hits += 1
+                    # graft-time pin: held until the query finalizes
+                    # (release_served), so eviction cannot invalidate
+                    # the serve leaf this hit becomes
+                    entry.pins += 1
                     entry.last_used = now
                     self._frag_hits += 1
+                    served.append(entry)
                     return entry
                 return None
             if not allow_capture:
@@ -271,9 +292,35 @@ class CacheManager:
             entry.pins += 1
             entry.last_used = time.perf_counter()
 
+    def fragment_pin_if_ready(self, entry: fragments.FragmentEntry
+                              ) -> bool:
+        """Pin only if the entry is still servable — the capture path
+        uses this to close the publish->serve race (a just-published
+        entry is evictable until someone pins it)."""
+        with self._lock:
+            if entry.state != fragments.READY or entry._parts is None:
+                return False
+            entry.pins += 1
+            entry.last_used = time.perf_counter()
+            return True
+
     def fragment_unpin(self, entry: fragments.FragmentEntry) -> None:
         with self._lock:
             entry.pins = max(entry.pins - 1, 0)
+            if entry.pins == 0 and entry.stale \
+                    and entry.state == fragments.READY:
+                # deferred TTL eviction: expiry observed while pinned
+                # could not close the handles then — do it now that the
+                # last server/graft reference is gone
+                self._evict_fragment_locked(entry)
+
+    def release_served(self,
+                       entries: List[fragments.FragmentEntry]) -> None:
+        """Drop the graft-time pins a query's serve leaves hold (taken
+        in _fragment_lookup_or_register). Called exactly once per
+        graft_fragments, at query finalize or on a failed submit."""
+        for entry in entries:
+            self.fragment_unpin(entry)
 
     # -- shared budget -------------------------------------------------
 

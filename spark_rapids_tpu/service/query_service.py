@@ -113,103 +113,147 @@ class QueryService:
         Raises ServiceOverloaded (state SHED) past the queue limit.
         ``deadline`` is seconds from submission (queue + run time); the
         conf default applies when None."""
-        from spark_rapids_tpu.plan.optimizer import (
-            estimate_footprint_bytes, cut_stages)
-        from spark_rapids_tpu.plan.overrides import apply_overrides
-
         plan = getattr(df_or_plan, "_plan", df_or_plan)
         if deadline is None:
             d = self.conf.get(cfg.SERVICE_DEFAULT_DEADLINE)
             deadline = d if d and d > 0 else None
-        # shed BEFORE planning: under overload — exactly when the
+        # shed BEFORE any planning: under overload — exactly when the
         # backpressure signal matters — a rejection must not pay the
-        # full planner walk only to throw it away
-        ckey = self.cache.result_key(plan)
+        # planner walk, and result_key is already a plan walk with an
+        # os.stat per source file, so even IT comes after this check
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("QueryService is shut down")
             self._counters["submitted"] += 1
             if self.admission.would_shed(tenant):
                 raise self._shed_locked(plan, tenant, priority, deadline)
-            # result tier: an exact hit needs no planning and no device
-            # work; a live leader for the same key absorbs this submit
-            # as a single-flight follower
-            if ckey is not None:
+        # result tier: an exact hit needs no planning and no device
+        # work; a live leader for the same key absorbs this submit as
+        # a single-flight follower
+        ckey = self.cache.result_key(plan)
+        if ckey is not None:
+            with self._lock:
+                if self._shutdown:
+                    raise RuntimeError("QueryService is shut down")
                 served = self._serve_cached_locked(ckey, tenant,
                                                    priority, deadline)
                 if served is not None:
                     return served
+        try:
+            planned = self._plan_query(plan, tenant)
+        except OutOfCoreRejected as err:
+            with self._lock:
+                rec = self._record_shed_locked(tenant, priority,
+                                               deadline)
+            err.query_id = rec.query_id
+            raise
+        # from here the grafted fragment registrations/pins are this
+        # frame's responsibility until a Query takes them over — any
+        # exit without a handoff must release them, or the PENDING
+        # entries block every future capture of the same keys forever
+        pending_frags = planned["pending"]
+        served_frags = planned["served"]
+        try:
+            with self._lock:
+                if self._shutdown:
+                    raise RuntimeError("QueryService is shut down")
+                if self.admission.would_shed(tenant):
+                    # concurrent submitters planned past the first
+                    # check and filled the queue meanwhile — the bound
+                    # is hard
+                    raise self._shed_locked(plan, tenant, priority,
+                                            deadline)
+                if ckey is not None:
+                    # a concurrent identical submit may have become
+                    # leader (or finished) while this thread planned
+                    served = self._serve_cached_locked(ckey, tenant,
+                                                       priority,
+                                                       deadline,
+                                                       count=False)
+                    if served is not None:
+                        self.cache.abort_pending(pending_frags)
+                        self.cache.release_served(served_frags)
+                        pending_frags, served_frags = [], []
+                        return served
+                q = Query(next(_GLOBAL_QUERY_IDS), tenant, plan,
+                          planned["exec"], priority, deadline,
+                          planned["footprint"], planned["stages"],
+                          self._done_cv)
+                # ownership of the fragment registrations/pins moves
+                # to the query (finalize aborts/releases them)
+                q.pending_fragments, pending_frags = pending_frags, []
+                q.served_fragments, served_frags = served_frags, []
+                if ckey is not None:
+                    q.result_cache_key = ckey
+                    self._result_leaders[ckey] = q
+                if planned["out_of_core"]:
+                    q.out_of_core = True
+                    q.charge = planned["charge"]
+                self._queries[q.query_id] = q
+                self.admission.offer(q)
+                self._pump_locked()
+            return QueryHandle(self, q)
+        except BaseException:
+            self.cache.abort_pending(pending_frags)
+            self.cache.release_served(served_frags)
+            raise
+
+    def _plan_query(self, plan, tenant: str) -> dict:
+        """The planning core shared by submit() and single-flight
+        follower promotion: fragment graft, footprint estimate, the
+        out-of-core decision, physical planning and stage cutting. On
+        ANY failure — including OutOfCoreRejected(policy=shed), which
+        the caller records — the grafted fragment registrations and
+        graft-time pins are released before the exception propagates,
+        so a planner error can never leak PENDING registry entries."""
+        from spark_rapids_tpu.plan.optimizer import (
+            estimate_footprint_bytes, cut_stages)
+        from spark_rapids_tpu.plan.overrides import apply_overrides
+
         # fragment tier: replace READY cached stage roots with serve
-        # leaves, wrap first-seen ones in capture nodes; footprint and
-        # physical planning run on the grafted plan (a serve leaf costs
-        # what it stores, not what its subtree would recompute)
-        plan_to_run, pending_frags = self.cache.graft_fragments(plan)
-        footprint = estimate_footprint_bytes(
-            plan_to_run,
-            default_rows=self.conf.get(cfg.SERVICE_DEFAULT_ROW_ESTIMATE))
-        # out-of-core decision BEFORE physical planning: a query whose
-        # estimated peak exceeds the WHOLE device budget can never fit,
-        # so either shed it now (policy=shed) or plan it with a
-        # forced-splitting batch budget so every staging exec takes its
-        # bucketed out-of-core path and the spill chain absorbs the
-        # overflow (ROADMAP item 3)
-        plan_conf = self.conf
-        out_of_core = False
-        budget = self.admission.current_budget()
-        if budget is not None and footprint > budget and \
-                self.conf.get(cfg.SERVICE_OUT_OF_CORE):
-            policy = str(self.conf.get(
-                cfg.SERVICE_OUT_OF_CORE_POLICY)).strip().lower()
-            if policy == "shed":
-                self.cache.abort_pending(pending_frags)
-                with self._lock:
-                    rec = self._record_shed_locked(tenant, priority,
-                                                   deadline)
-                err = OutOfCoreRejected(tenant, footprint, budget)
-                err.query_id = rec.query_id
-                raise err
-            out_of_core = True
-            forced = max(budget // 4, 1 << 20)
-            plan_conf = self.conf.with_overrides(
-                {cfg.BATCH_SIZE_BYTES.key: forced})
-        exec_ = apply_overrides(plan_to_run, plan_conf)
-        stages = cut_stages(exec_)
-        with self._lock:
-            if self._shutdown:
-                self.cache.abort_pending(pending_frags)
-                raise RuntimeError("QueryService is shut down")
-            if self.admission.would_shed(tenant):
-                # concurrent submitters planned past the first check
-                # and filled the queue meanwhile — the bound is hard
-                self.cache.abort_pending(pending_frags)
-                raise self._shed_locked(plan, tenant, priority, deadline)
-            if ckey is not None:
-                # a concurrent identical submit may have become leader
-                # (or finished) while this thread planned
-                served = self._serve_cached_locked(ckey, tenant,
-                                                   priority, deadline,
-                                                   count=False)
-                if served is not None:
-                    self.cache.abort_pending(pending_frags)
-                    return served
-            q = Query(next(_GLOBAL_QUERY_IDS), tenant, plan, exec_,
-                      priority, deadline, footprint, stages,
-                      self._done_cv)
-            q.pending_fragments = pending_frags
-            if ckey is not None:
-                q.result_cache_key = ckey
-                self._result_leaders[ckey] = q
-            if out_of_core:
-                q.out_of_core = True
+        # leaves (pinned at graft — see CacheManager.graft_fragments),
+        # wrap first-seen ones in capture nodes; footprint and physical
+        # planning run on the grafted plan (a serve leaf costs what it
+        # stores, not what its subtree would recompute)
+        plan_to_run, pending, served = self.cache.graft_fragments(plan)
+        try:
+            footprint = estimate_footprint_bytes(
+                plan_to_run, default_rows=self.conf.get(
+                    cfg.SERVICE_DEFAULT_ROW_ESTIMATE))
+            # out-of-core decision BEFORE physical planning: a query
+            # whose estimated peak exceeds the WHOLE device budget can
+            # never fit, so either shed it now (policy=shed) or plan it
+            # with a forced-splitting batch budget so every staging
+            # exec takes its bucketed out-of-core path and the spill
+            # chain absorbs the overflow (ROADMAP item 3)
+            plan_conf = self.conf
+            out_of_core = False
+            charge = None
+            budget = self.admission.current_budget()
+            if budget is not None and footprint > budget and \
+                    self.conf.get(cfg.SERVICE_OUT_OF_CORE):
+                policy = str(self.conf.get(
+                    cfg.SERVICE_OUT_OF_CORE_POLICY)).strip().lower()
+                if policy == "shed":
+                    raise OutOfCoreRejected(tenant, footprint, budget)
+                out_of_core = True
+                forced = max(budget // 4, 1 << 20)
+                plan_conf = self.conf.with_overrides(
+                    {cfg.BATCH_SIZE_BYTES.key: forced})
                 # charge half the device: the forced-splitting plan
                 # bounds the resident working set far below the
                 # footprint, and a whale must not occupy the whole
                 # budget ledger while it spills
-                q.charge = min(footprint, max(budget // 2, 1))
-            self._queries[q.query_id] = q
-            self.admission.offer(q)
-            self._pump_locked()
-        return QueryHandle(self, q)
+                charge = min(footprint, max(budget // 2, 1))
+            exec_ = apply_overrides(plan_to_run, plan_conf)
+            stages = cut_stages(exec_)
+        except BaseException:
+            self.cache.abort_pending(pending)
+            self.cache.release_served(served)
+            raise
+        return {"exec": exec_, "stages": stages,
+                "footprint": footprint, "out_of_core": out_of_core,
+                "charge": charge, "pending": pending, "served": served}
 
     # -- warmup (ROADMAP item 2: AOT-warm the progcache at startup) -------
 
@@ -551,16 +595,23 @@ class QueryService:
             # drop them so a future query can retry the capture
             self.cache.abort_pending(q.pending_fragments)
             q.pending_fragments = []
-        followers, q.cache_followers = q.cache_followers, []
-        for f in followers:
-            if f.terminal:
-                continue  # cancelled/expired on its own while parked
+        if q.served_fragments:
+            # graft-time pins on the READY entries this query's serve
+            # leaves referenced — held since submit so eviction could
+            # not close the stored parts while the query sat queued
+            self.cache.release_served(q.served_fragments)
+            q.served_fragments = []
+        followers = [f for f in q.cache_followers if not f.terminal]
+        q.cache_followers = []
+        if followers:
             if state is QueryState.DONE and q.result is not None:
-                f.result = q.result.copy()
-                f.admitted_at = f.started_at = time.perf_counter()
-                self._finalize_locked(f, QueryState.DONE)
+                for f in followers:
+                    f.result = q.result.copy()
+                    f.admitted_at = f.started_at = time.perf_counter()
+                    self._finalize_locked(f, QueryState.DONE)
             else:
-                self._finalize_locked(f, state, error)
+                self._promote_follower_locked(q, state, error,
+                                              followers)
         # release every resource the query may still hold: admission
         # charge, catalog buffers (an abandoned exec tree must not leak
         # staged batches), and its execution cursor
@@ -592,6 +643,54 @@ class QueryService:
         self._retain_locked(q)
         self._pump_locked()
         self._done_cv.notify_all()
+
+    def _promote_follower_locked(self, leader: Query, state: QueryState,
+                                 error, followers) -> None:
+        """The single-flight leader finalized WITHOUT a result
+        (cancelled / failed / deadline-expired). Followers are
+        independent client submissions that only parked on the
+        leader's computation as an optimization — they must not
+        inherit its fate: promote the first live one to a fresh leader
+        that computes the shared plan itself; the rest stay parked
+        behind the new leader (and are promoted in turn if it dies
+        too). Falls back to propagating the leader's terminal state
+        only when promotion is impossible (service shutting down, plan
+        already dropped); a failed replan fails the followers with the
+        REPLAN's error, their own."""
+        plan, ckey = leader.plan, leader.result_cache_key
+        if self._shutdown or plan is None:
+            for f in followers:
+                self._finalize_locked(f, state, error)
+            return
+        new_leader, rest = followers[0], followers[1:]
+        try:
+            planned = self._plan_query(plan, new_leader.tenant)
+        except Exception as e:
+            for f in followers:
+                self._finalize_locked(f, QueryState.FAILED, e)
+            return
+        from spark_rapids_tpu.execs import adaptive as adaptive_exec
+
+        new_leader.plan = plan
+        new_leader.exec = planned["exec"]
+        new_leader.stages = planned["stages"]
+        new_leader.footprint = planned["footprint"]
+        new_leader.out_of_core = planned["out_of_core"]
+        new_leader.charge = planned["charge"] \
+            if planned["out_of_core"] else planned["footprint"]
+        new_leader.pending_fragments = planned["pending"]
+        new_leader.served_fragments = planned["served"]
+        new_leader.cache_hit = False
+        new_leader.cache_followers = rest
+        new_leader.result_cache_key = ckey
+        if ckey is not None:
+            self._result_leaders[ckey] = new_leader
+        with adaptive_exec.planning_mode():
+            new_leader.planned_partitions = \
+                planned["exec"].num_partitions
+        self.admission.offer(new_leader)
+        # the finalize that triggered this promotion ends in
+        # _pump_locked, which admits the new leader if capacity allows
 
     def _retain_locked(self, q: Query) -> None:
         """Bounded history: a service alive for days must not pin every

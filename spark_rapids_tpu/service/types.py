@@ -109,12 +109,16 @@ class Query:
         self.retry: dict = {}
         # semantic cache (service/cache): a query holding a result-cache
         # key is the single-flight LEADER for it — identical concurrent
-        # misses register as followers and are served (or failed) when
-        # the leader finalizes; pending_fragments are capture entries
-        # this query is responsible for publishing or aborting
+        # misses register as followers and are served when the leader
+        # finalizes DONE (one is PROMOTED to a fresh leader otherwise);
+        # pending_fragments are capture entries this query must publish
+        # or abort; served_fragments are READY entries its serve leaves
+        # reference, pinned at graft time and unpinned at finalize so
+        # eviction cannot close them while the query is queued
         self.result_cache_key = None
         self.cache_followers: list = []
         self.pending_fragments: list = []
+        self.served_fragments: list = []
         self.cache_hit = False
         # cooperative execution cursor: per-partition batch iterators,
         # advanced one stage-slice at a time by the scheduler. The REAL

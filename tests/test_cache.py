@@ -341,3 +341,139 @@ def test_fragment_spill_disk_roundtrip_bit_exact(tmp_path):
             svc.shutdown()
     finally:
         reset_catalog(BufferCatalog())
+
+
+# -- (7) eviction-vs-liveness: graft pins, TTL-vs-pins, leak, promotion -----
+
+
+def test_graft_pins_entry_and_ttl_defers_eviction(tmp_path):
+    """A READY entry grafted as a serve leaf is pinned from graft time:
+    neither LRU pressure nor TTL expiry may close its parts while the
+    referencing query could still be queued. Expiry marks a pinned
+    entry stale and the LAST unpin evicts it; a closed entry raises
+    FragmentUnavailable instead of serving an empty (wrong) batch."""
+    from spark_rapids_tpu.service.cache import fragments as frag_mod
+
+    p = str(tmp_path / "t.parquet")
+    _write(p, _tbl(seed=9))
+    s = Session()
+    s.register_parquet("t", p)
+    q = s.sql(AGG_SQL)
+    svc = QueryService({cfg.SERVICE_CACHE_RESULT.key: False},
+                       session=s)
+    try:
+        svc.submit(q).result(timeout=300)
+        mgr = svc.cache
+        assert svc.stats().cache["fragment"]["published"] >= 1
+        _, pending, served = mgr.graft_fragments(q._plan)
+        assert not pending and len(served) == 1
+        entry = served[0]
+        assert entry.pins == 1, "graft must pin the serve leaf's entry"
+        # LRU pressure far past the budget: a pinned entry is not a
+        # candidate, so the parts must survive untouched
+        with mgr._lock:
+            mgr._evict_locked(mgr.max_bytes + entry.bytes + 1)
+        assert entry.state == frag_mod.READY \
+            and entry._parts is not None, \
+            "LRU evicted a pinned entry out from under a live graft"
+        # TTL expiry observed while pinned: the lookup misses (a fresh
+        # capture is registered) but the parts must NOT close — a
+        # server could be mid-iteration on them
+        mgr.ttl_s = 0.001
+        entry.created_at -= 10.0
+        _, pending2, served2 = mgr.graft_fragments(q._plan)
+        assert entry not in served2
+        assert entry.stale and entry._parts is not None, \
+            "TTL eviction must defer while pinned (use-after-close)"
+        mgr.abort_pending(pending2)
+        mgr.release_served(served2)
+        # the last unpin performs the deferred eviction
+        mgr.release_served([entry])
+        assert entry.state == frag_mod.ABORTED and entry._parts is None
+        # and serving a closed entry fails loudly, never empty-frame
+        with pytest.raises(frag_mod.FragmentUnavailable):
+            next(frag_mod._serve(entry, entry.schema, 0))
+    finally:
+        svc.shutdown()
+
+
+def test_planning_failure_releases_fragment_registrations(
+        tmp_path, monkeypatch):
+    """An exception between graft_fragments and Query registration must
+    abort the query's PENDING entries and drop its graft pins — a
+    leaked PENDING key would block every future capture of that subplan
+    forever (PENDING-elsewhere keys are never waited on)."""
+    from spark_rapids_tpu.plan import optimizer as opt_mod
+
+    p = str(tmp_path / "t.parquet")
+    _write(p, _tbl(seed=10))
+    s = Session()
+    s.register_parquet("t", p)
+    q = s.sql(AGG_SQL)
+    svc = QueryService({cfg.SERVICE_CACHE_RESULT.key: False},
+                       session=s)
+    try:
+        real = opt_mod.estimate_footprint_bytes
+
+        def boom(*a, **k):
+            raise RuntimeError("injected planner fault")
+
+        monkeypatch.setattr(opt_mod, "estimate_footprint_bytes", boom)
+        with pytest.raises(RuntimeError, match="injected planner"):
+            svc.submit(q)
+        st = svc.stats().cache["fragment"]
+        assert st["pending"] == 0 and st["entries"] == 0, \
+            "planner fault leaked PENDING fragment entries"
+        monkeypatch.setattr(opt_mod, "estimate_footprint_bytes", real)
+        svc.submit(q).result(timeout=300)
+        assert svc.stats().cache["fragment"]["published"] >= 1, \
+            "the key must remain capturable after the failed submit"
+    finally:
+        svc.shutdown()
+
+
+def test_cancelled_leader_promotes_follower():
+    """Single-flight followers are independent client submissions:
+    cancelling the leader must NOT cancel them — one follower is
+    promoted to a fresh leader that computes the shared plan itself,
+    and every follower still gets the oracle frame."""
+    from spark_rapids_tpu.api import col, functions as F
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    from spark_rapids_tpu.service.types import QueryCancelled
+
+    s = Session()
+    src = SlowKeyedSource("promote")
+    base = DataFrame(pn.ScanNode(src), s)
+    q = base.group_by("k").agg(F.sum(col("v")).alias("sv"))
+    # fragment tier off: the cancelled leader may have published its
+    # captured fragment before the cancel landed, and a promoted
+    # leader serving from it would (correctly) skip the re-read this
+    # test uses as its promotion witness
+    svc = QueryService({cfg.SERVICE_CACHE_FRAGMENT.key: False},
+                       session=s)
+    try:
+        leader = svc.submit(q, tenant="t0")
+        deadline = time.time() + 30
+        while leader.poll().value != "RUNNING" \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        followers = [svc.submit(q, tenant=f"t{i}") for i in (1, 2)]
+        assert svc.stats().cache["result"][
+            "single_flight_followers"] == 2
+        assert leader.cancel()
+        src.gate.set()
+        frames = [h.result(timeout=300) for h in followers]
+        with pytest.raises(QueryCancelled):
+            leader.result(timeout=60)
+        assert src.reads == 2, \
+            f"want leader+promoted reads (2), got {src.reads}"
+        rng = np.random.default_rng(11)
+        raw = pd.DataFrame(
+            {"k": rng.integers(0, 6, src.n).astype(np.int64),
+             "v": rng.random(src.n)})
+        oracle = raw.groupby("k").agg(sv=("v", "sum")).reset_index()
+        for f in frames:
+            assert_frames_equal(oracle, f)
+    finally:
+        src.gate.set()
+        svc.shutdown()
