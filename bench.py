@@ -207,6 +207,13 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
         # stage-cut attribution: measured round trips per pipeline
         # stage (the whole-plan coalescing target is ~1 per stage)
         "per_stage_dispatch": dt.get("per_stage"),
+        # the named complement: WHICH programs each stage launched, so
+        # a regression in fusion shows up as a program-name diff rather
+        # than a bare count bump (round-7)
+        "per_stage_programs": dt.get("per_stage_programs"),
+        # mesh-requested shuffles that stayed on the host/TCP path,
+        # with the spmd gate's reason (empty = all folded in-program)
+        "shuffle_fallbacks": dt.get("shuffle_fallbacks"),
         "rtt_share": round(
             min(dt.get("est_dispatch_overhead_s", 0.0) / wall, 1.0), 3)
         if wall else None,

@@ -20,15 +20,30 @@ Classification (kind -> why it syncs):
   worker process and back.
 - ``CPU fallback transition`` — device->host->device around the
   pandas engine.
-- ``mesh shard/gather staging`` — multi-device mesh execs stage
-  shards through the host.
+- ``mesh shard staging (leaf input)`` — a mesh exec with a non-mesh
+  child stages that child's host batches into device shards.
+- ``mesh result gather`` — the topmost mesh exec of a chain gathers
+  shards back to host for its non-mesh consumer.
+- ``mesh exchange map-side staging`` — an in-program
+  ``ShuffleExchangeExec`` stages its child's batches through the host
+  around ONE compiled all_to_all program (three batched dispatches).
+
+A mesh exec BETWEEN two mesh execs contributes nothing: the sharded
+hand-off stays on device and the exchange between them is the
+in-program ``all_to_all`` — the SPMD whole-stage path's zero-hidden-
+sync guarantee, and ``tests/test_spmd_shuffle.py`` fences it.
 """
 from __future__ import annotations
 
 from typing import List
 
 
-def _classify(exec_node, is_root: bool) -> List[str]:
+def _is_mesh(node) -> bool:
+    return type(node).__name__.startswith("Mesh")
+
+
+def _classify(exec_node, is_root: bool,
+              mesh_parent: bool = False) -> List[str]:
     kinds = []
     cls = type(exec_node).__name__
     if getattr(exec_node, "builds", None):
@@ -40,7 +55,15 @@ def _classify(exec_node, is_root: bool) -> List[str]:
     if cls == "CpuFallbackExec":
         kinds.append("CPU fallback transition")
     if cls.startswith("Mesh"):
-        kinds.append("mesh shard/gather staging")
+        # only the mesh<->host BOUNDARIES sync; mesh-internal execs
+        # hand DistributedBatch shards device-to-device (execute_any)
+        # and their exchanges run as in-program all_to_all collectives
+        if any(not _is_mesh(c) for c in exec_node.children):
+            kinds.append("mesh shard staging (leaf input)")
+        if not mesh_parent:
+            kinds.append("mesh result gather")
+    if getattr(exec_node, "in_program", False):
+        kinds.append("mesh exchange map-side staging")
     return kinds
 
 
@@ -54,22 +77,22 @@ def sync_map(root) -> List[dict]:
     out: List[dict] = []
     seen = set()
 
-    def walk(node, is_root):
+    def walk(node, is_root, mesh_parent):
         if id(node) in seen:
             return
         seen.add(id(node))
-        for kind in _classify(node, is_root):
+        for kind in _classify(node, is_root, mesh_parent):
             out.append({
                 "stage": getattr(node, "_stage_label", "<unlabeled>"),
                 "op": type(node).__name__,
                 "kind": kind,
             })
         for c in node.children:
-            walk(c, False)
+            walk(c, False, _is_mesh(node))
         for bx in getattr(node, "builds", ()) or ():
-            walk(bx, False)
+            walk(bx, False, _is_mesh(node))
 
-    walk(root, True)
+    walk(root, True, False)
     return out
 
 

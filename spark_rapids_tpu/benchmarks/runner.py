@@ -98,9 +98,15 @@ class BenchmarkRunner:
         from spark_rapids_tpu.memory.catalog import get_catalog
         from spark_rapids_tpu.utils import dispatch as disp
 
+        from spark_rapids_tpu.parallel import spmd
+
         telemetry = disp.installed()
         df = None
         pre_stage = None
+        pre_prog = None
+        # fallback telemetry covers the WHOLE run (planning records the
+        # reasons, and planning happens inside the iteration loop)
+        run_pre_fb = spmd.fallback_snapshot()
         # run-relative snapshots: totals, per-site map, catalog spill
         # counters and injector counts all report DELTAS over this run
         # — a second benchmark in the same process must not inherit the
@@ -116,6 +122,8 @@ class BenchmarkRunner:
             exec_ = apply_overrides(plan, self.conf)
             pre = disp.snapshot() if telemetry else None
             pre_stage = disp.stage_snapshot() if telemetry else None
+            pre_prog = disp.stage_programs_snapshot() if telemetry \
+                else None
             pre_retry = _retry.snapshot()
             t0 = time.perf_counter()
             df = collect(exec_)
@@ -166,11 +174,18 @@ class BenchmarkRunner:
                 # next to the plan's static per-stage estimate — the
                 # split that shows WHERE the dispatch budget sits
                 "per_stage": disp.stage_delta(pre_stage),
+                # which PROGRAMS each stage launched (round-7: names
+                # the six dispatches a bare "stage0: 6" hides)
+                "per_stage_programs": disp.stage_program_delta(pre_prog),
                 "stages": [
                     {"stage": s["stage"],
                      "ops": "+".join(s["ops"]),
-                     "est_dispatches": s["est_dispatches"]}
+                     "est_dispatches": s["est_dispatches"],
+                     "mesh_internal": s["mesh_internal"]}
                     for s in cut_stages(exec_)],
+                # every mesh-requested shuffle that stayed on the
+                # host/TCP path this run, with the gate's reason
+                "shuffle_fallbacks": spmd.fallback_delta(run_pre_fb),
                 "compile_cache": progcache.stats(),
             }
             # MEASURED on-device time (round-5): one extra serialized

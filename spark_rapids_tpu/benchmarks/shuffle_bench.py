@@ -82,6 +82,137 @@ def run(rows: int, n_keys: int, n_devices: int = 0,
     return result
 
 
+def run_head_to_head(rows: int, n_keys: int, n_devices: int = 0,
+                     iterations: int = 3, warmup: int = 1,
+                     seed: int = 7) -> dict:
+    """TCP transport vs in-program ``all_to_all`` at matched partition
+    counts and (statistically) matched partition sizes: the same rows
+    shuffle once per iteration through each transport, and the record
+    reports bytes-moved and wall-clock PER EXCHANGE for both.
+
+    The in-program side times ONE compiled hash-route + all_to_all
+    launch (parallel/shuffle.DistributedShuffleStep — the exchange
+    ShuffleExchangeExec's in-program mode runs). The TCP side times
+    write_map_output + read_partition over shuffle/tcp.py's real
+    sockets with pre-partitioned blocks, so the clock covers transport
+    (metadata, windowed chunks, reassembly) and not the partition
+    kernel — the fair analogue of the collective, which also excludes
+    upstream compute.
+    """
+    import tempfile
+
+    import jax
+
+    import spark_rapids_tpu  # noqa: F401  (x64 on)
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.parallel import (data_mesh,
+                                           distributed_batch_from_host)
+    from spark_rapids_tpu.parallel.shuffle import DistributedShuffleStep
+    from spark_rapids_tpu.shuffle import LocalCluster
+
+    if n_devices:
+        from spark_rapids_tpu.parallel.mesh import force_cpu_mesh
+
+        force_cpu_mesh(n_devices)
+    n_dev = n_devices or len(jax.devices())
+    n_parts = n_dev  # matched partition count across both transports
+    mesh = data_mesh(n_dev)
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_keys, rows).astype(np.int64)
+    vals = rng.random(rows)
+    dtypes = [dt.INT64, dt.FLOAT64]
+    # live payload crossing the exchange: key + value + validity per row
+    payload_bytes = rows * (8 + 8 + 2)
+
+    # ---- in-program all_to_all ------------------------------------
+    datas, valids, counts, cap = distributed_batch_from_host(
+        mesh, [keys, vals], dtypes)
+    step = DistributedShuffleStep(mesh, dtypes, [0], n_parts)
+    prog_times = []
+    for i in range(warmup + iterations):
+        t0 = time.perf_counter()
+        out = step(datas, valids, counts)
+        jax.block_until_ready(out)
+        if i >= warmup:
+            prog_times.append(time.perf_counter() - t0)
+    # the collective physically moves full padded blocks: each device
+    # sends its (n_dev, cap) block per column (+ pid + valids)
+    prog_wire = n_dev * n_dev * cap * (8 + 8 + 8 + 1 + 1 + 1)
+
+    # ---- TCP transport --------------------------------------------
+    # pre-partition OUTSIDE the clock: one map input per executor,
+    # blocks cut by a cheap balanced pid (sizes match the hash route
+    # statistically — both are uniform over n_parts)
+    pid = (keys % n_parts).astype(np.int64)
+    maps = np.array_split(np.arange(rows), n_parts)
+    map_blocks = []
+    for m in range(n_parts):
+        rows_m = maps[m]
+        out = {}
+        for p in range(n_parts):
+            idx = rows_m[pid[rows_m] == p]
+            if not len(idx):
+                continue
+            out[p] = ColumnarBatch(
+                [Column.from_numpy(keys[idx], dt.INT64),
+                 Column.from_numpy(vals[idx], dt.FLOAT64)], len(idx))
+        map_blocks.append(out)
+    tmp = tempfile.mkdtemp(prefix="srt_shuffle_h2h_")
+    cluster = LocalCluster(n_parts, spill_dir=tmp, transport="tcp")
+    tcp_times = []
+    tcp_wire = 0
+    try:
+        for i in range(warmup + iterations):
+            sid = i + 1
+            t0 = time.perf_counter()
+            for m in range(n_parts):
+                cluster.write_map_output(sid, m, m, map_blocks[m])
+            got = 0
+            for p in range(n_parts):
+                for b in cluster.read_partition(
+                        sid, p, reader_executor_index=p):
+                    got += b.realized_num_rows()
+            elapsed = time.perf_counter() - t0
+            assert got == rows, (got, rows)
+            if i >= warmup:
+                tcp_times.append(elapsed)
+        # serialized block bytes actually registered for the exchange
+        tcp_wire = sum(
+            sum(b.capacity * (8 + 8 + 2) for b in out.values())
+            for out in map_blocks)
+    finally:
+        cluster.shutdown()
+
+    prog_best, tcp_best = min(prog_times), min(tcp_times)
+    return {
+        "benchmark": "shuffle_head_to_head",
+        "rows": rows,
+        "distinct_keys": n_keys,
+        "devices": n_dev,
+        "partitions": n_parts,
+        "backend": jax.devices()[0].platform,
+        "payload_bytes_per_exchange": payload_bytes,
+        "in_program": {
+            "transport": "all_to_all (in-program collective)",
+            "times_sec": prog_times,
+            "wall_per_exchange_s": prog_best,
+            "bytes_moved_per_exchange": prog_wire,
+            "payload_gb_per_sec": payload_bytes / prog_best / 1e9,
+        },
+        "tcp": {
+            "transport": "tcp (shuffle/tcp.py sockets)",
+            "times_sec": tcp_times,
+            "wall_per_exchange_s": tcp_best,
+            "bytes_moved_per_exchange": tcp_wire,
+            "payload_gb_per_sec": payload_bytes / tcp_best / 1e9,
+        },
+        "in_program_speedup": tcp_best / prog_best,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--rows", type=int, default=4_000_000)
@@ -91,9 +222,19 @@ def main(argv=None):
                         "N-device CPU mesh when fewer are attached")
     p.add_argument("--iterations", type=int, default=3)
     p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--head-to-head", action="store_true",
+                   help="also time the SAME exchange through the TCP "
+                        "transport at matched partition counts/sizes "
+                        "and report bytes-moved + wall per exchange")
     args = p.parse_args(argv)
-    print(json.dumps(run(args.rows, args.keys, args.devices,
-                         args.iterations, args.warmup)))
+    out = run(args.rows, args.keys, args.devices,
+              args.iterations, args.warmup)
+    if args.head_to_head:
+        out = {"wide_shuffle": out,
+               "head_to_head": run_head_to_head(
+                   args.rows, args.keys, args.devices,
+                   args.iterations, args.warmup)}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

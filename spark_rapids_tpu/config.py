@@ -434,6 +434,26 @@ CLUSTER_WORKERS = conf("rapids.tpu.cluster.workers").doc(
     "their output over TCP — the separate-executor-JVM model."
 ).int_conf.create_with_default(1)
 
+SHUFFLE_IN_PROGRAM = conf("rapids.tpu.shuffle.inProgram.enabled").doc(
+    "Fold mesh-internal shuffles into the compiled program: when the "
+    "session mesh is active, hash-routed exchanges lower to in-program "
+    "lax.all_to_all collectives inside the enclosing stage's shard_map "
+    "program (scan-decode -> hash-partition -> all_to_all -> local "
+    "join/aggregate/sort as ONE pjit launch), the SPMD analogue of the "
+    "reference's UCX on-device shuffle (PAPER L7). Disable to force "
+    "every exchange through the host/TCP block-store path even with a "
+    "mesh attached; the planner records the fallback reason either way "
+    "(parallel/spmd.fallback_snapshot, surfaced in run telemetry)."
+).boolean_conf.create_with_default(True)
+
+SHUFFLE_IN_PROGRAM_MIN_ROWS = conf(
+    "rapids.tpu.shuffle.inProgram.minRows").doc(
+    "Estimated-row floor for the in-program shuffle: below it the "
+    "exchange stays on the host block-store path (an all_to_all "
+    "program over a handful of rows pays mesh staging + a fresh "
+    "compile for nothing). 0 = no floor."
+).int_conf.create_with_default(0)
+
 SHUFFLE_COMPRESSION_CODEC = conf("rapids.tpu.shuffle.compression.codec").doc(
     "Compression for host-path shuffle payloads: none, lz4 (native C++ "
     "codec; the nvcomp-LZ4 analogue, RapidsConf.scala:685) or zlib."

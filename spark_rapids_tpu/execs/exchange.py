@@ -76,10 +76,23 @@ class ShuffleExchangeExec(TpuExec):
         self.task_threads = task_threads
         # block store: output partition -> spillable sub-batches
         self._blocks: Optional[Dict[int, List[SpillableBatch]]] = None
+        # in-program mode (SPMD whole-stage exchange): the map side runs
+        # as ONE compiled hash-route + all_to_all program over the mesh
+        # instead of per-batch partition kernels + per-partition slices.
+        # apply_overrides flips this on for eligible hash exchanges via
+        # enable_in_program(); parallel/spmd.py owns the eligibility
+        # decision and records every "no" with a reason.
+        self.in_program = False
+        self._in_program_mesh = None
         # reduce tasks run on concurrent threads; the map side must
         # materialize exactly once (Spark serializes this via stage
-        # boundaries — here a lock is the stage barrier)
-        self._mat_lock = lockorder.make_lock("exchange.shuffle.materialize")
+        # boundaries — here a lock is the stage barrier). A condition
+        # rather than a bare lock: the in-program path runs its device
+        # program OUTSIDE the lock (no device transfer while a
+        # framework lock is held) and late arrivals wait on it.
+        self._mat_lock = lockorder.make_condition(
+            "exchange.shuffle.materialize")
+        self._mat_running = False
 
     # an exchange shipping inside a remote task closure restarts clean:
     # blocks are per-process state (the receiving executor re-runs or
@@ -87,12 +100,30 @@ class ShuffleExchangeExec(TpuExec):
     def __getstate__(self):
         state = dict(self.__dict__)
         state.pop("_mat_lock", None)
+        state["_mat_running"] = False
         state["_blocks"] = None
+        # meshes are process-local device handles; a shipped exchange
+        # re-decides on the receiving side (cluster mode shuffles over
+        # TCP anyway — the spmd gate never enables both)
+        state["in_program"] = False
+        state["_in_program_mesh"] = None
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._mat_lock = lockorder.make_lock("exchange.shuffle.materialize")
+        self._mat_lock = lockorder.make_condition(
+            "exchange.shuffle.materialize")
+
+    def enable_in_program(self, mesh) -> None:
+        """Switch the map side to the compiled all_to_all program over
+        ``mesh``. Partition count and per-row partition assignment are
+        unchanged (the step reproduces the host partition kernel's pid
+        exactly), so consumers — including a co-partitioned sibling
+        exchange that stays on the host path — see identical blocks."""
+        assert self.partitioning[0] == "hash", self.partitioning
+        assert self._blocks is None, "already materialized"
+        self.in_program = True
+        self._in_program_mesh = mesh
 
     @property
     def num_partitions(self) -> int:
@@ -124,6 +155,9 @@ class ShuffleExchangeExec(TpuExec):
         unresolved bounds stages the input (spillable) and samples bounds
         host-side first — the reference runs a separate sampling pass the
         same way (GpuRangePartitioner.scala:42-95)."""
+        if self.in_program and self._in_program_mesh is not None:
+            self._materialize_in_program_once()
+            return
         with self._mat_lock:
             if self._blocks is not None:
                 return
@@ -216,6 +250,100 @@ class ShuffleExchangeExec(TpuExec):
     # estimated resident bytes a map task may stage before realizing
     # counts and moving the chunk into spillable blocks
     CHUNK_BYTE_BUDGET = 256 << 20
+
+    def _materialize_in_program_once(self) -> None:
+        """Single-flight wrapper for the in-program map side: the
+        compiled program and its host<->device transfers run OUTSIDE
+        the materialize lock (holding a framework lock across a device
+        transfer stalls every sibling reduce task for the transfer's
+        full RTT); late arrivals wait on the condition instead of
+        re-running the program."""
+        with self._mat_lock:
+            while self._mat_running:
+                self._mat_lock.wait()
+            if self._blocks is not None:
+                return
+            self._mat_running = True
+        blocks = None
+        try:
+            blocks = self._materialize_in_program()
+        finally:
+            with self._mat_lock:
+                self._mat_running = False
+                if blocks is not None and self._blocks is None:
+                    self._blocks = blocks
+                self._mat_lock.notify_all()
+
+    def _materialize_in_program(self) -> Dict[int, List[SpillableBatch]]:
+        """Map-side write over the mesh: stage child rows once, run ONE
+        compiled hash-route + ``all_to_all`` program, slice each
+        device's received block into that partition's store. Three
+        dispatches total (staging gather, the program, result gather)
+        regardless of batch or partition count — the host path pays a
+        partition kernel per batch plus a slice per partition."""
+        import jax
+        from spark_rapids_tpu.parallel import shuffle as pshuffle
+        from spark_rapids_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = self._in_program_mesh
+        n_dev = mesh.shape[DATA_AXIS]
+        num_out = self.num_out_partitions
+        types = list(self.schema.types)
+        blocks: Dict[int, List[SpillableBatch]] = {
+            p: [] for p in range(num_out)}
+        batches = list(self._input_batches())
+        ColumnarBatch.realize_counts(batches)
+        batches = [b for b in batches if b.realized_num_rows() > 0]
+        if not batches:
+            return blocks
+        # ONE host gather for every staged batch's columns (pytree get);
+        # device_get returns host ndarrays, so everything below is pure
+        # numpy with no further transfers
+        host = jax.device_get(
+            [[(c.data, c.validity) for c in b.columns] for b in batches])
+        ns = [b.realized_num_rows() for b in batches]
+        arrays, valids = [], []
+        for ci in range(len(types)):
+            arrays.append(np.concatenate(
+                [host[bi][ci][0][:n] for bi, n in enumerate(ns)]))
+            valids.append(np.concatenate(
+                [np.ones(n, dtype=bool) if host[bi][ci][1] is None
+                 else host[bi][ci][1][:n]
+                 for bi, n in enumerate(ns)]))
+        datas, vs, counts = pshuffle.distributed_batch_from_host(
+            mesh, arrays, types, validities=valids)[:3]
+        step = pshuffle.shuffle_step(mesh, types,
+                                     list(self.partitioning[1]), num_out)
+        with TraceRange("ShuffleExchangeExec.all_to_all"):
+            out_d, out_v, pids, recv = step(datas, vs, counts)
+        hd, hv, hp, hn = jax.device_get(
+            (list(out_d), list(out_v), pids, recv))
+        rcap = len(hd[0]) // n_dev
+        from spark_rapids_tpu.ops.buckets import bucket_capacity
+        from spark_rapids_tpu.columnar.column import Column
+
+        for d in range(n_dev):
+            k = int(hn[d])
+            if k == 0:
+                continue
+            seg = slice(d * rcap, d * rcap + k)
+            seg_pids = hp[seg]
+            # device d received every row with pid % n_dev == d; split
+            # its compacted block into per-partition sub-blocks (pure
+            # numpy — no extra dispatch)
+            for p in range(d, num_out, n_dev):
+                idx = np.nonzero(seg_pids == p)[0]
+                if not len(idx):
+                    continue
+                cap = bucket_capacity(len(idx))
+                cols = [Column.from_numpy(
+                    hd[ci][seg][idx], t,
+                    validity=hv[ci][seg][idx],
+                    capacity=cap) for ci, t in enumerate(types)]
+                blocks[p].append(SpillableBatch(
+                    ColumnarBatch(cols, len(idx)),
+                    priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
+        return blocks
 
     def _write_blocks(self, source, into=None
                       ) -> Dict[int, List[SpillableBatch]]:

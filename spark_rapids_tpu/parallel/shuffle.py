@@ -183,6 +183,110 @@ class DistributedGroupByStep:
         return out
 
 
+class DistributedShuffleStep:
+    """Compiled in-program exchange: hash-route rows by key columns →
+    ``lax.all_to_all`` → per-device compacted rows. The transport half
+    of :class:`DistributedGroupByStep` without the aggregate tail —
+    ``ShuffleExchangeExec``'s in-program mode and the shuffle bench's
+    TCP-vs-ICI head-to-head ride this.
+
+    Partition ids are computed EXACTLY like the host partition kernel
+    (ops/hashing.hash_columns images incl. the null seed, then pmod by
+    ``num_out``), and each row's pid travels through the collective as
+    an extra routed column: device ``d`` receives every row whose
+    ``pid % n_dev == d`` and the caller splits by pid host-side. That
+    identity makes an in-program exchange partition-for-partition
+    interchangeable with a host-path one — a co-partitioned sibling
+    under a shuffled join may stay on the host path and still line up.
+    """
+
+    def __init__(self, mesh: Mesh, dtypes: Sequence[dt.DType],
+                 key_ordinals: Sequence[int], num_out: int,
+                 axis: str = DATA_AXIS):
+        self.mesh = mesh
+        self.dtypes = tuple(dtypes)
+        self.key_ordinals = tuple(key_ordinals)
+        self.num_out = num_out
+        self.axis = axis
+        self.n_dev = mesh.shape[axis]
+        self._fn = self._build()
+
+    def _build(self):
+        n_dev = self.n_dev
+        num_out = self.num_out
+        dtypes = self.dtypes
+        key_ordinals = self.key_ordinals
+        axis = self.axis
+
+        def device_step(datas, valids, n_rows):
+            cap = datas[0].shape[0]
+            live = jnp.arange(cap, dtype=jnp.int32) < n_rows[0]
+            # host-hash-matching images: _numeric_to_int64 + the null
+            # seed hash_columns uses (NOT _key_image's sentinel) so pid
+            # here == pid from ops/partition.hash_partition
+            imgs = tuple(
+                jnp.where(valids[o],
+                          hashing._numeric_to_int64(datas[o], dtypes[o]),
+                          jnp.int64(hashing._NULL_HASH))
+                for o in key_ordinals)
+            h = hashing._combine(imgs)
+            m = h % jnp.int64(num_out)
+            pid = jnp.where(m < 0, m + num_out, m).astype(jnp.int32)
+            dest = pid % n_dev
+            ex = _exchange(list(datas) + [pid.astype(jnp.int64)],
+                           list(valids) + [live],
+                           dest, live, n_dev, axis)
+            ex_d, ex_v, total = ex
+            return (ex_d[:-1], ex_v[:-1], ex_d[-1].astype(jnp.int32),
+                    total.reshape(1))
+
+        n_cols = len(self.dtypes)
+        in_specs = ([P(self.axis)] * n_cols, [P(self.axis)] * n_cols,
+                    P(self.axis))
+        out_specs = ([P(self.axis)] * n_cols, [P(self.axis)] * n_cols,
+                     P(self.axis), P(self.axis))
+        return get_shims().shard_map()(device_step, mesh=self.mesh,
+                                       in_specs=in_specs,
+                                       out_specs=out_specs)
+
+    def __call__(self, datas: List[jax.Array], valids: List[jax.Array],
+                 counts: jax.Array):
+        """datas[i]: (n_dev*cap,) row-sharded; counts: (n_dev,). Returns
+        (out_datas, out_valids, pids, recv_counts): per-device capacity
+        n_dev*cap, recv_counts[d] live rows on device d, pids[j] the
+        output partition of row j (only pids with pid % n_dev == d land
+        on device d)."""
+        return _run_shuffle_step(self, list(datas), list(valids), counts)
+
+
+@partial(jax.jit, static_argnames=("step",))
+def _run_shuffle_step(step, datas, valids, counts):
+    """ONE module-level jit entry for every shuffle step (the
+    execs/interop.py memoized idiom): the trace cache lives here, keyed
+    by the identity-stable ``step`` (static) + operand shapes, so a
+    fresh wrapper is never minted per call."""
+    return step._fn(datas, valids, counts)
+
+
+# one step per (mesh, schema, keys, parts): identity-stable steps keep
+# the shard_map/jit caches warm across repeated exchanges of the same
+# plan shape (the progcache in-process layer for sharded programs)
+_SHUFFLE_STEPS: dict = {}
+
+
+def shuffle_step(mesh: Mesh, dtypes: Sequence[dt.DType],
+                 key_ordinals: Sequence[int],
+                 num_out: int) -> DistributedShuffleStep:
+    key = (id(mesh), tuple(dtypes), tuple(key_ordinals), num_out)
+    got = _SHUFFLE_STEPS.get(key)
+    if got is None:
+        if len(_SHUFFLE_STEPS) >= 64:  # bound: distinct schemas are few
+            _SHUFFLE_STEPS.clear()
+        got = _SHUFFLE_STEPS[key] = DistributedShuffleStep(
+            mesh, dtypes, key_ordinals, num_out)
+    return got
+
+
 def distributed_batch_from_host(mesh: Mesh, arrays: List[np.ndarray],
                                 dtypes: List[dt.DType],
                                 validities: Optional[List[Optional[np.ndarray]]] = None,

@@ -1,0 +1,104 @@
+"""SPMD whole-stage decision: does a shuffle boundary fold into the
+compiled program, and if not, why.
+
+The reference keeps shuffle data on-device through a UCX/RDMA transport
+(PAPER L7); our same-slice analogue is an in-program
+``jax.lax.all_to_all`` over the session mesh — the exchange becomes a
+collective inside the enclosing stage's shard_map program, so a
+distributed stage costs one launch instead of a host round trip per
+block. TCP (shuffle/tcp.py) stays as the cross-host DCN fallback and as
+the path for plans whose stages cannot be uniformly sharded.
+
+This module is the ONE place that decision lives. Planner rules call
+:func:`in_program_mesh` instead of reading the mesh directly; every
+"no" answer on a mesh-enabled session is recorded with a reason, and
+the run telemetry (benchmarks/runner.py ``shuffle_fallbacks``) surfaces
+the counts — a plan silently staying on the host path is a bug class
+this PR retires.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.utils import lockorder
+
+# {(op, reason): count} — process-wide, snapshot/delta like dispatch
+# telemetry so a runner reports only its own run's fallbacks.
+_fallbacks: dict = {}
+_lock = lockorder.make_lock("parallel.spmd.fallbacks")
+
+
+def record_fallback(op: str, reason: str) -> None:
+    """Count one mesh-requested shuffle that stayed on the host/TCP
+    path. ``op`` names the planner shape (join/groupby/sort/window/
+    exchange), ``reason`` the gate that said no."""
+    with _lock:
+        key = (op, reason)
+        _fallbacks[key] = _fallbacks.get(key, 0) + 1
+
+
+def fallback_snapshot() -> dict:
+    """{"op: reason": count} so far (flattened for JSON telemetry)."""
+    with _lock:
+        return {f"{op}: {reason}": n
+                for (op, reason), n in sorted(_fallbacks.items())}
+
+
+def fallback_delta(before: dict) -> dict:
+    """Fallbacks recorded since ``before`` (a fallback_snapshot)."""
+    now = fallback_snapshot()
+    return {k: n - before.get(k, 0) for k, n in now.items()
+            if n - before.get(k, 0)}
+
+
+def in_program_mesh(conf, op: str, *, keyed: bool = True,
+                    reason_if_unkeyed: str = "",
+                    est_rows: Optional[int] = None,
+                    cluster_local: bool = False):
+    """The mesh to lower ``op``'s shuffle onto when the in-program path
+    applies, else None with the fallback reason recorded.
+
+    Gates, in order (first "no" wins and is the recorded reason):
+
+    - mesh not requested (``rapids.tpu.mesh.enabled`` off / no conf):
+      None, NOT recorded — there is no shuffle decision to explain.
+    - ``rapids.tpu.shuffle.inProgram.enabled`` off: explicit opt-out.
+    - ``rapids.tpu.cluster.enabled``: cross-host executors shuffle over
+      DCN; ICI collectives cannot reach a peer process's devices.
+      SKIPPED when ``cluster_local`` — a Mesh*Exec subtree ships to one
+      executor whole, so its internal collective only ever spans that
+      process's local mesh (fenced by tests/test_cluster_sql.py's
+      mesh-subtree-on-worker case).
+    - fewer than 2 visible devices: no axis to collect over.
+    - ``keyed`` False: the plan shape cannot be uniformly sharded
+      (callers pass the concrete reason, e.g. an ungrouped aggregate).
+    - ``est_rows`` below ``rapids.tpu.shuffle.inProgram.minRows``.
+    """
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.parallel.mesh import session_mesh
+
+    if conf is None or not conf.get(cfg.MESH_ENABLED):
+        return None
+    if not conf.get(cfg.SHUFFLE_IN_PROGRAM):
+        record_fallback(op, "disabled by "
+                        + cfg.SHUFFLE_IN_PROGRAM.key)
+        return None
+    if conf.get(cfg.CLUSTER_ENABLED) and not cluster_local:
+        record_fallback(op, "cross-host DCN: cluster mode shuffles "
+                        "over TCP (shuffle/tcp.py)")
+        return None
+    mesh = session_mesh(conf)
+    if mesh is None:
+        record_fallback(op, "mesh unavailable: fewer than 2 devices")
+        return None
+    if not keyed:
+        record_fallback(op, "non-uniform: "
+                        + (reason_if_unkeyed or "no shard keys"))
+        return None
+    floor = conf.get(cfg.SHUFFLE_IN_PROGRAM_MIN_ROWS)
+    if floor and est_rows is not None and est_rows < floor:
+        record_fallback(
+            op, f"below {cfg.SHUFFLE_IN_PROGRAM_MIN_ROWS.key} "
+                f"({est_rows} < {floor})")
+        return None
+    return mesh

@@ -704,6 +704,11 @@ def _own_cost(e) -> int:
     from spark_rapids_tpu.execs.sort import SortExec
 
     parts = max(getattr(e, "num_partitions", 1), 1)
+    if type(e).__name__.startswith("Mesh"):
+        # whole-stage SPMD exec: one compiled shard_map launch plus a
+        # staging/gather hop, independent of partition count — the
+        # point of folding the shuffle into the program
+        return 2
     if isinstance(e, FusedAggregateExec):
         own = 3 * parts + 1  # chain + (chunked) groupby per partition
     elif isinstance(e, FusedChainExec):
@@ -720,7 +725,12 @@ def _own_cost(e) -> int:
     elif isinstance(e, AdaptiveShuffleReaderExec):
         own = 0  # a view over its exchange; the exchange carries cost
     elif isinstance(e, ShuffleExchangeExec):
-        own = 2 * max(e.children[0].num_partitions, 1) + parts
+        if getattr(e, "in_program", False):
+            # staging gather + ONE all_to_all program + result gather,
+            # regardless of batch or partition count
+            own = 3
+        else:
+            own = 2 * max(e.children[0].num_partitions, 1) + parts
     elif isinstance(e, BroadcastExchangeExec):
         own = 2
     elif isinstance(e, basic.FilterExec):
@@ -757,13 +767,21 @@ def _is_stage_breaker(e) -> bool:
                                                  ShuffleExchangeExec)
     from spark_rapids_tpu.execs.sort import SortExec
 
+    if isinstance(e, ShuffleExchangeExec) and \
+            getattr(e, "in_program", False):
+        # the shuffle is a collective inside the enclosing stage's
+        # program, not a materialization boundary: child and consumer
+        # share one stage (whole-stage SPMD execution)
+        return False
     return isinstance(e, (HashAggregateExec, ShuffleExchangeExec,
                           BroadcastExchangeExec, SortExec))
 
 
 def cut_stages(root) -> List[dict]:
     """Assign ``_stage_label`` to every exec and return the stage list:
-    [{stage, ops, est_dispatches}] in discovery (top-down) order. A
+    [{stage, ops, est_dispatches, mesh_internal}] in discovery
+    (top-down) order. ``mesh_internal`` marks stages whose shuffle is
+    an in-program mesh collective rather than a host exchange. A
     stage starts at the root, below every breaker, and at every
     broadcast build subtree (reached via ``.builds`` on fused execs —
     those exchanges are not ``children``). ``est_dispatches`` is the
@@ -777,7 +795,7 @@ def cut_stages(root) -> List[dict]:
 
     def new_stage() -> dict:
         s = {"stage": f"stage{len(stages)}", "ops": [],
-             "est_dispatches": 0}
+             "est_dispatches": 0, "mesh_internal": False}
         stages.append(s)
         return s
 
@@ -790,6 +808,11 @@ def cut_stages(root) -> List[dict]:
         node._stage_label = stage["stage"]
         stage["ops"].append(node.name)
         stage["est_dispatches"] += _own_cost(node)
+        if node.name.startswith("Mesh") or \
+                getattr(node, "in_program", False):
+            # this stage's shuffle rides an in-program collective over
+            # the mesh (no host exchange at its boundary)
+            stage["mesh_internal"] = True
         breaker = _is_stage_breaker(node)
         for c in node.children:
             walk(c, None if breaker else stage)

@@ -41,6 +41,28 @@ def _session_mesh(conf):
     return session_mesh(conf)
 
 
+def _in_program_mesh(conf, node, op, **kw):
+    """The SPMD whole-stage gate (parallel/spmd.py): the mesh when this
+    shuffle boundary folds into the compiled program as an in-program
+    all_to_all, else None with the fallback reason recorded for run
+    telemetry. Row estimates feed the inProgram.minRows floor.
+
+    ``cluster_local=True`` because every caller here lowers a Mesh*Exec
+    SUBTREE: in cluster mode the subtree ships to one executor whole and
+    its collective spans only that process's local mesh — the DCN gate
+    applies to the cross-process exchanges, not to these."""
+    from spark_rapids_tpu.parallel import spmd
+    from spark_rapids_tpu.plan.optimizer import estimate_rows
+
+    est = None
+    try:
+        est = estimate_rows(node.children[0]) if node.children else None
+    except Exception:  # estimation must never block planning
+        est = None
+    return spmd.in_program_mesh(conf, op, est_rows=est,
+                                cluster_local=True, **kw)
+
+
 def _cluster_mode(conf) -> bool:
     return conf is not None and conf.get(cfg.CLUSTER_ENABLED)
 
@@ -459,8 +481,11 @@ class _AggregateRule(NodeRule):
             return agg_exec.HashAggregateExec(
                 node.grouping, node.aggs, child, out_schema,
                 mode=node.mode, conf=meta.conf, fused_filter=ff)
-        mesh = _session_mesh(meta.conf)
-        if mesh is not None and node.grouping:
+        mesh = _in_program_mesh(
+            meta.conf, node, "groupby", keyed=bool(node.grouping),
+            reason_if_unkeyed="ungrouped aggregate funnels to one "
+                              "device")
+        if mesh is not None:
             # mesh lowering: the partial/exchange/final pipeline becomes
             # one all_to_all + local-groupby program per chip
             from spark_rapids_tpu.parallel.execs import MeshGroupByExec
@@ -512,8 +537,11 @@ class _SortRule(NodeRule):
     def convert(self, meta, children):
         node: pn.SortNode = meta.node
         child = children[0]
-        mesh = _session_mesh(meta.conf)
-        if node.global_sort and mesh is not None:
+        # a non-global sort has no exchange to fold — only ORDER BY
+        # consults the SPMD gate (so no fallback noise for local sorts)
+        mesh = _in_program_mesh(meta.conf, node, "sort") \
+            if node.global_sort else None
+        if mesh is not None:
             from spark_rapids_tpu.parallel.execs import MeshSortExec
 
             return MeshSortExec(node.specs, child,
@@ -652,10 +680,13 @@ class _JoinRule(NodeRule):
     @staticmethod
     def _plan(meta, kind, left, right, lk, rk, cond, out_schema,
               build_node=None):
-        mesh = _session_mesh(meta.conf)
-        if mesh is not None and lk and kind in ("inner", "left",
-                                                "left_semi", "left_anti",
-                                                "full"):
+        supported = bool(lk) and kind in ("inner", "left", "left_semi",
+                                          "left_anti", "full")
+        mesh = _in_program_mesh(
+            meta.conf, meta.node, "join", keyed=supported,
+            reason_if_unkeyed=("no equi-join keys to hash-route" if not lk
+                               else f"unsupported join kind '{kind}'"))
+        if mesh is not None:
             # right joins arrive here already flipped to "left" (convert()
             # above); "full" composes left + null-extended anti halves with
             # a sharded union (GpuHashJoin.scala:302-318 emits FullOuter
@@ -837,8 +868,12 @@ class _WindowRule(NodeRule):
     def convert(self, meta, children):
         node: pn.WindowNode = meta.node
         child = children[0]
-        mesh = _session_mesh(meta.conf)
-        if mesh is not None and node.partition_ordinals:
+        mesh = _in_program_mesh(
+            meta.conf, node, "window",
+            keyed=bool(node.partition_ordinals),
+            reason_if_unkeyed="window without PARTITION BY funnels to "
+                              "one device")
+        if mesh is not None:
             # partition-by windows lower onto the mesh: the hash
             # exchange + per-partition window (GpuWindowExec.scala:92)
             # fuse into one all_to_all + per-chip kernel program
@@ -1211,6 +1246,7 @@ def apply_overrides(plan: pn.PlanNode,
         runtime = session_cluster(conf)
         if runtime is not None:
             exec_ = install_cluster_exchanges(exec_, runtime)
+    _enable_in_program_exchanges(exec_, conf)
     if conf.get(cfg.TEST_ENABLED):
         allowed = {s.strip() for s in
                    conf.get(cfg.TEST_ALLOWED_NON_TPU).split(",")
@@ -1222,6 +1258,55 @@ def apply_overrides(plan: pn.PlanNode,
 
     cut_stages(exec_)
     return exec_
+
+
+def _enable_in_program_exchanges(exec_: TpuExec, conf) -> None:
+    """SPMD whole-stage exchange: flip every eligible hash
+    ShuffleExchangeExec surviving in the physical plan to the compiled
+    all_to_all map side (execs/exchange._materialize_in_program). The
+    mesh exec lowering already absorbs most shuffles into chained
+    Mesh*Execs; this walk catches the rest — explicit repartitions,
+    shuffled-join inputs, partial/final aggregate boundaries. Safe to
+    flip one side of a co-partitioned pair: the in-program step
+    reproduces the host partition kernel's pid exactly. Every "no" on a
+    mesh-enabled session lands in parallel/spmd.py's fallback telemetry
+    with a reason."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.parallel import spmd
+
+    if conf is None or not conf.get(cfg.MESH_ENABLED):
+        return
+    seen: set = set()
+
+    def walk(e) -> None:
+        if id(e) in seen:
+            return
+        seen.add(id(e))
+        if isinstance(e, ShuffleExchangeExec) and not e.in_program \
+                and e._blocks is None \
+                and e.partitioning[0] != "single":
+            kind = e.partitioning[0]
+            if kind != "hash":
+                mesh = spmd.in_program_mesh(
+                    conf, "exchange", keyed=False,
+                    reason_if_unkeyed=f"{kind} partitioning routes "
+                    "host-side (sampled bounds / row order)")
+            elif any(t is dt.STRING for t in e.schema.types):
+                mesh = spmd.in_program_mesh(
+                    conf, "exchange", keyed=False,
+                    reason_if_unkeyed="string columns need host-side "
+                    "dictionary unification")
+            else:
+                mesh = spmd.in_program_mesh(conf, "exchange")
+            if mesh is not None:
+                e.enable_in_program(mesh)
+        for c in e.children:
+            walk(c)
+        for bx in getattr(e, "builds", ()) or ():
+            walk(bx)
+
+    walk(exec_)
 
 
 def _assert_on_tpu(exec_: TpuExec, allowed: set):

@@ -113,7 +113,14 @@ class _LegacyJaxShims(_ModernJaxShims):
     def clear_backends(self):
         import jax
 
-        jax.clear_backends()  # type: ignore[attr-defined]
+        # jax.clear_backends was removed mid-0.4.x (0.4.36); late 0.4.x
+        # already carries the jax.extend.backend API
+        if hasattr(jax, "clear_backends"):
+            jax.clear_backends()  # type: ignore[attr-defined]
+        else:
+            from jax.extend import backend
+
+            backend.clear_backends()
 
 
 class LegacyJaxShimProvider(JaxShimServiceProvider):

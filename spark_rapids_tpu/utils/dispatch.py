@@ -39,6 +39,13 @@ _compiled_fns: list = []
 # carry their own stage.
 _tls = threading.local()
 _stage_counts: dict = {}
+# {stage_label: {program_label: count}} — which PROGRAMS a stage's
+# dispatches ran, not just how many (round-7: BENCH r05->r06 could say
+# "stage0: 6" but not name the six, so a fusion regression and a
+# legitimate chunked loop were indistinguishable from the JSON alone).
+# jit launches label as the traced fn's qualname, eager primitives as
+# "eager:<prim>", transfers as "device_get".
+_stage_programs: dict = {}
 _stage_lock = lockorder.make_lock("utils.dispatch.stage")
 
 
@@ -150,7 +157,7 @@ def pop_query_coalesced(query_id) -> int:
         return _query_coalesced.pop(query_id, 0)
 
 
-def _bump_stage(kind: str) -> None:
+def _bump_stage(kind: str, program: str = None) -> None:
     global _tagged_total
     label = getattr(_tls, "stage", None) or "<unstaged>"
     qid = getattr(_tls, "query", None)
@@ -160,6 +167,9 @@ def _bump_stage(kind: str) -> None:
         if d is None:
             d = _stage_counts[label] = {"jit": 0, "eager": 0, "get": 0}
         d[kind] += 1
+        if program is not None:
+            progs = _stage_programs.setdefault(label, {})
+            progs[program] = progs.get(program, 0) + 1
         if group:
             share = 1.0 / len(group)
             for g in group:
@@ -209,7 +219,7 @@ def install() -> None:
             def __call__(self, *a, **k):
                 global _jit_calls
                 _jit_calls += 1
-                _bump_stage("jit")
+                _bump_stage("jit", name)
                 if not _device_timing:
                     return compiled(*a, **k)
                 t0 = time.perf_counter()
@@ -240,7 +250,7 @@ def install() -> None:
         def counting_apply(prim, *a, **k):
             global _eager_calls
             _eager_calls += 1
-            _bump_stage("eager")
+            _bump_stage("eager", "eager:" + getattr(prim, "name", "?"))
             return real_apply(prim, *a, **k)
 
         jdispatch.apply_primitive = counting_apply
@@ -252,7 +262,7 @@ def install() -> None:
     def counting_get(x):
         global _transfers
         _transfers += 1
-        _bump_stage("get")
+        _bump_stage("get", "device_get")
         return real_get(x)
 
     jax.device_get = counting_get
@@ -291,6 +301,28 @@ def stage_delta(before: dict) -> dict:
         n = sum(counts[k] - prev.get(k, 0) for k in counts)
         if n:
             out[label] = n
+    return out
+
+
+def stage_programs_snapshot() -> dict:
+    """Per-stage {label: {program_label: count}} so far."""
+    with _stage_lock:
+        return {k: dict(v) for k, v in _stage_programs.items()}
+
+
+def stage_program_delta(before: dict) -> dict:
+    """Per-stage PROGRAM attribution accumulated since ``before`` (a
+    stage_programs_snapshot): {stage: {program_label: launches}} with
+    zero-delta programs dropped. The named complement of stage_delta —
+    "stage0: 6" becomes "stage0: chain@a1b2 x4 + groupby x1 + get x1"."""
+    now = stage_programs_snapshot()
+    out = {}
+    for label, progs in now.items():
+        prev = before.get(label, {})
+        d = {p: n - prev.get(p, 0) for p, n in progs.items()
+             if n - prev.get(p, 0)}
+        if d:
+            out[label] = d
     return out
 
 

@@ -108,6 +108,7 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "memory.retry.stats": 164,
     "memory.faultInjection": 168,
     "utils.dispatch.stage": 172,
+    "parallel.spmd.fallbacks": 176,  # fallback-reason counters
     "native.init": 184,
     "shims.init": 188,
     "config.registry": 192,
