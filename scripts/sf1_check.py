@@ -1,0 +1,118 @@
+"""sf >= 1 scale fence: dispatch budgets + CPU-oracle match on full
+TPC queries at real scale (CLI twin of the slow-marked smoke in
+tests/test_dispatch_budget.py).
+
+PR 13 moved the engine past the CPU oracle at sf 1 (q1/q6-class
+queries) by collapsing stage0 into one program per batch chain, a
+single-pass group-by and an attributed result sync. This fence keeps
+that state: a future PR that re-adds a dispatch (a host sync, an
+un-fused launch, a chunked aggregate) or breaks oracle equality at
+scale fails here, not in production telemetry.
+
+Per-query WARM dispatch ceilings (measured on the single-device CPU
+backend, sf 1; multi-batch queries launch one fused chain per scan
+batch, so the ceilings scale with the sf-1 batch count and carry a
+little headroom for batching jitter — the fence catches per-batch or
+per-query regressions, which add whole multiples):
+
+    python scripts/sf1_check.py [--queries tpch_q1,tpch_q6]
+                                [--sf 1.0] [--data-dir DIR]
+                                [--output SF1.json]
+
+Prints one JSON report; exit code 0 = fence holds.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# telemetry must wrap jax.jit before any compute module import
+from spark_rapids_tpu.utils import dispatch as disp  # noqa: E402
+
+disp.install()
+
+# warm dispatch ceilings at sf 1 (measured + 2 headroom each; see
+# module docstring). A query absent here gets BUDGET_DEFAULT.
+BUDGETS = {
+    "tpch_q1": 16,    # measured 14: 3 chains + 5 groupby + 2 sync +
+                      # 2 concat + sort-tail + result_sync
+    "tpch_q6": 14,    # measured 12: 3 chains + 5 reduce + 2 concat +
+                      # final project + result_sync
+    "tpch_q12": 20,   # measured 18 (join + grouped agg over 3 scan
+                      # batches; orders side adds its own chains)
+    "tpch_q14": 25,   # measured 23 (two scan legs + join + global agg)
+    "tpcxbb_q26": 12,  # measured 10 (build-inlined chain + 3 groupby +
+                       # stage3 filter + sort-tail + result_sync)
+}
+BUDGET_DEFAULT = 24
+
+
+def run_query(benchmark: str, sf: float, data_dir: str) -> dict:
+    from spark_rapids_tpu.benchmarks.runner import (ALL_BENCHMARKS,
+                                                    BenchmarkRunner)
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    r = BenchmarkRunner(data_dir, sf)
+    r.ensure_data(benchmark)
+    # warm run traces + compiles; the fence pins the steady state
+    plan = ALL_BENCHMARKS[benchmark](data_dir)
+    collect(apply_overrides(plan, r.conf))
+    pre = disp.snapshot()
+    pre_stage = disp.stage_snapshot()
+    plan = ALL_BENCHMARKS[benchmark](data_dir)
+    t0 = time.perf_counter()
+    df = collect(apply_overrides(plan, r.conf))
+    wall = time.perf_counter() - t0
+    d = disp.delta(pre)
+    per_stage = disp.stage_delta(pre_stage)
+    cmp_ = r.compare_results(benchmark, df)
+    budget = BUDGETS.get(benchmark, BUDGET_DEFAULT)
+    rec = {
+        "benchmark": benchmark,
+        "sf": sf,
+        "wall_s": round(wall, 3),
+        "dispatch_count": d["dispatch_count"],
+        "dispatch_budget": budget,
+        "per_stage": per_stage,
+        "matches_cpu": cmp_["matches_cpu"],
+        "cpu_oracle_s": round(cmp_["cpu_time_sec"], 3),
+        "vs_cpu_oracle": round(cmp_["cpu_time_sec"] / wall, 3)
+        if wall else None,
+        "detail": cmp_.get("detail", ""),
+    }
+    rec["ok"] = bool(
+        cmp_["matches_cpu"] and
+        d["dispatch_count"] <= budget and
+        "<unstaged>" not in per_stage)
+    return rec
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--queries", default="tpch_q1,tpch_q6")
+    p.add_argument("--sf", type=float, default=1.0)
+    p.add_argument("--data-dir", default="/tmp/srt_bench_tpch")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+
+    records = [run_query(q, args.sf, args.data_dir)
+               for q in args.queries.split(",")]
+    ok = all(r["ok"] for r in records)
+    report = {"fence": "sf1_check", "sf": args.sf, "ok": ok,
+              "queries": records}
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ verify TPU results against the CPU oracle — docs/benchmarks.md:26-190).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -54,6 +55,12 @@ class BenchmarkRunner:
         if os.path.exists(marker):
             return
         os.makedirs(self.data_dir, exist_ok=True)
+        # a dir holds exactly one scale factor per family: drop stale
+        # markers so a later run at the old sf regenerates instead of
+        # silently reading this sf's tables under the old label
+        for stale in glob.glob(
+                os.path.join(self.data_dir, f".{family}-sf-*")):
+            os.remove(stale)
         if family == "mortgage":
             mortgage.gen_tables(self.data_dir, self.sf)
         elif family == "tpcds":
@@ -70,7 +77,16 @@ class BenchmarkRunner:
         import jax
 
         import spark_rapids_tpu
+        from spark_rapids_tpu.utils import dispatch as _disp
 
+        # measured, not assumed: the per-dispatch floor distinguishes a
+        # local in-process backend (~0) from a remote tunnel attachment
+        # (~105 ms), so a recorded number can be interpreted without
+        # knowing which box produced it
+        try:
+            rtt = round(_disp.measure_rtt(), 6)
+        except Exception:
+            rtt = None
         return {
             "framework_version": getattr(spark_rapids_tpu, "__version__",
                                          "dev"),
@@ -78,6 +94,7 @@ class BenchmarkRunner:
             "backend": jax.devices()[0].platform,
             "device_count": len(jax.devices()),
             "device_kind": jax.devices()[0].device_kind,
+            "rtt_probe_s": rtt,
         }
 
     def run(self, benchmark: str, iterations: int = 3,

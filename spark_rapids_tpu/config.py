@@ -414,6 +414,34 @@ FUSION_DENSE_PROBE_MAX_SPAN = conf(
     "gather loop). Single integral keys only; 0 disables."
 ).int_conf.create_with_default(1 << 22)
 
+FUSION_IN_PROGRAM_BUILD = conf(
+    "rapids.tpu.sql.fusion.inProgramBuild.enabled").doc(
+    "Fold the broadcast-join build-side preparation (hash sort, "
+    "duplicate probe, dense inverse table) INTO the consuming fused "
+    "chain program's first launch instead of running it as a separate "
+    "_prep_build dispatch plus a flag-fetch device_get. The chain's "
+    "first batch runs a build-inlined program variant that also emits "
+    "the prepared build arrays; later batches reuse them through the "
+    "probe-only variant, so stage0 sheds two dispatches. The duplicate "
+    "flag rides back with the (asynchronously fetched) speculative "
+    "output — a duplicate-keyed build discards that output and falls "
+    "back to the unfused join, exactly like the host path. Disable to "
+    "restore the standalone host-side prepare_builds launch."
+).boolean_conf.create_with_default(True)
+
+GROUPBY_SINGLE_PASS = conf(
+    "rapids.tpu.sql.groupby.singlePass.enabled").doc(
+    "Emit wide group-bys (more than 6 aggregate columns) as ONE "
+    "segmented-aggregation launch instead of the chunked two-dispatch "
+    "loop. The chunk loop exists as a workaround for a libtpu v5e "
+    "remote-compile segfault on >= 7-agg fused sort modules at "
+    "capacity >= 32768; on backends without that defect a single pass "
+    "halves the group-by's dispatch cost. Disable on v5e remote "
+    "attachments if wide-aggregate compiles crash. The "
+    "compact-wide pre-pass (_COMPACT_WIDE_MIN_CAP) applies to both "
+    "paths unchanged."
+).boolean_conf.create_with_default(True)
+
 CLUSTER_ENABLED = conf("rapids.tpu.cluster.enabled").doc(
     "Execute shuffle exchanges through the multi-process cluster runtime: "
     "map tasks write partitioned output into per-executor shuffle catalogs "

@@ -64,6 +64,7 @@ class HashAggregateExec(TpuExec):
         # swap it for a shuffle-read stub (runtime/cluster.py), and the
         # pickled exec must carry the already-resolved flag
         self._dense_ok()
+        self._single_pass()
         self._build()
 
     def _build(self):
@@ -179,6 +180,21 @@ class HashAggregateExec(TpuExec):
             self._dense_ok_cached = ok
         return ok
 
+    def _single_pass(self) -> bool:
+        """Wide aggregates launch as ONE segmented pass (default) vs the
+        chunked two-launch AOT workaround loop — see ops/groupby.py's
+        _AOT_MAX_AGGS note. Resolved once and cached on the exec so a
+        cluster-shipped pickle keeps the submitting session's choice."""
+        sp = getattr(self, "_single_pass_cached", None)
+        if sp is None:
+            from spark_rapids_tpu import config as cfg
+
+            sp = bool(self.conf.get(cfg.GROUPBY_SINGLE_PASS)
+                      if self.conf is not None
+                      else cfg.GROUPBY_SINGLE_PASS.default)
+            self._single_pass_cached = sp
+        return sp
+
     def _agg_batch(self, batch: ColumnarBatch, specs: List[AggSpec],
                    types: List[dt.DType], live_mask=None,
                    site: str = "aggregate.update") -> ColumnarBatch:
@@ -198,7 +214,8 @@ class HashAggregateExec(TpuExec):
                 return reduce_aggregate(b, specs, types, m)[0]
             return groupby_aggregate(b, list(range(nkeys)), specs,
                                      types, m,
-                                     dense_ok=self._dense_ok())[0]
+                                     dense_ok=self._dense_ok(),
+                                     single_pass=self._single_pass())[0]
 
         def split(item):
             b, m = item

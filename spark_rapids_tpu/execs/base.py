@@ -217,6 +217,7 @@ def collect(exec_: TpuExec, conf=None):
     import pandas as pd
 
     from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.utils import dispatch as _disp
 
     threads = (conf.get(cfg.TASK_THREADS) if conf is not None
                else cfg.TASK_THREADS.default)
@@ -224,9 +225,17 @@ def collect(exec_: TpuExec, conf=None):
     def one(p: int):
         # to_pandas fetches data + (possibly lazy) row count in ONE
         # device_get; a realized_num_rows() pre-filter here would pay a
-        # separate round trip per batch just to skip empties
-        frames = [batch.to_pandas(exec_.schema)
-                  for batch in exec_.execute(p)]
+        # separate round trip per batch just to skip empties. The fetch
+        # is bracketed as the "result_sync" stage: it is the documented
+        # end-of-query device->host transfer, not an unattributed
+        # mid-plan sync, and the telemetry should say so.
+        frames = []
+        for batch in exec_.execute(p):
+            tok = _disp.enter_stage("result_sync")
+            try:
+                frames.append(batch.to_pandas(exec_.schema))
+            finally:
+                _disp.exit_stage(tok)
         return [f for f in frames if len(f)]
 
     frames = [f for fs in

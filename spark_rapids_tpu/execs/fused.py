@@ -331,11 +331,14 @@ def _hash_keys(key_cols: Sequence[ColV], types: Sequence[dt.DType],
     return h
 
 
-@partial(jax.jit, static_argnames=("key_ords", "types", "hash_types",
-                                   "key_range", "dense_span"))
-def _prep_build(datas, vals, num_rows, key_ords, types, hash_types,
-                key_range=False, dense_span=0, dense_lo=0):
-    """Sort the build by key hash; null-key and padding rows park at the
+def _prep_build_arrays(datas, vals, num_rows, key_ords, types, hash_types,
+                       key_range=False, dense_span=0, dense_lo=0):
+    """Traceable build-side preparation — the body of ``_prep_build``,
+    shared verbatim by the chain engine's build-inlined program variant
+    (the in-program build traces this INSIDE the consuming chain, so
+    the standalone prep dispatch and its flag sync disappear).
+
+    Sort the build by key hash; null-key and padding rows park at the
     +inf sentinel (they can never match). Returns the duplicate flag the
     host checks once per query, plus (when ``key_range``) the single
     key's valid-row (min, max) in its comparison type — fetched in the
@@ -376,6 +379,18 @@ def _prep_build(datas, vals, num_rows, key_ords, types, hash_types,
     else:
         table = jnp.zeros(0, dtype=jnp.int32)
     return sh, sdatas, svals, dup, n_valid, kmin, kmax, table
+
+
+@partial(jax.jit, static_argnames=("key_ords", "types", "hash_types",
+                                   "key_range", "dense_span"))
+def _prep_build(datas, vals, num_rows, key_ords, types, hash_types,
+                key_range=False, dense_span=0, dense_lo=0):
+    """Standalone (host-path) build prep: one dispatch per build. The
+    in-program-build default inlines _prep_build_arrays into the chain
+    instead; this program remains for the knob-off / fallback path."""
+    return _prep_build_arrays(datas, vals, num_rows, key_ords, types,
+                              hash_types, key_range=key_range,
+                              dense_span=dense_span, dense_lo=dense_lo)
 
 
 def _dense_table_arrays(keys_sorted, n_valid, lo, span):
@@ -649,30 +664,30 @@ class FusedChain:
         self._programs = {}
 
     def chain_key(self, compact_out: bool, modes: tuple = (),
-                  decode: tuple = ()):
+                  decode: tuple = (), inline: tuple = ()):
         ks = tuple(s.key() for s in self.steps)
         if any(k is None for k in ks):
             return None
         return ("fused_chain", ks, tuple(self.source_types), compact_out,
-                modes, decode)
+                modes, decode, inline)
 
     def _program(self, compact_out: bool, modes: tuple = (),
-                 decode: tuple = ()):
-        prog = self._programs.get((compact_out, modes, decode))
+                 decode: tuple = (), inline: tuple = ()):
+        prog = self._programs.get((compact_out, modes, decode, inline))
         if prog is not None:
             return prog
-        key = self.chain_key(compact_out, modes, decode)
+        key = self.chain_key(compact_out, modes, decode, inline)
         # single-flight: concurrent same-template queries (different
         # tenants) racing a cold key trace it ONCE and share the
         # program — the cross-tenant compile fence
         prog = fused_cache_get_or_build(
             key, lambda: self._build_program(compact_out, modes,
-                                             decode))
-        self._programs[(compact_out, modes, decode)] = prog
+                                             decode, inline))
+        self._programs[(compact_out, modes, decode, inline)] = prog
         return prog
 
     def _build_program(self, compact_out: bool, modes: tuple = (),
-                       decode: tuple = ()):
+                       decode: tuple = (), inline: tuple = ()):
         steps = self.steps
         sort_step = steps[-1] if steps and \
             isinstance(steps[-1], SortStep) else None
@@ -731,6 +746,28 @@ class FusedChain:
                     for d, v in outs]
             return outs, n
 
+        def inline_build_ops(raw_builds):
+            # in-program build: trace the build-side prep (hash sort,
+            # dup probe, stats-known dense table) INSIDE this program.
+            # Per build, hand run_steps the probe-ready ops tuple and
+            # hand the caller the prepared arrays + dup flag so later
+            # batches reuse them via the probe-only variant — the
+            # standalone _prep_build dispatch and its flag-sync
+            # device_get both disappear from the stage.
+            ops, prepared = [], []
+            for spec, (bdatas, bvals, bnum) in zip(inline, raw_builds):
+                bkeys, btypes, htypes, dspan, dlo = spec
+                sh, sdatas, svals, dup, n_valid, _kn, _kx, table = \
+                    _prep_build_arrays(list(bdatas), list(bvals), bnum,
+                                       bkeys, btypes, htypes,
+                                       dense_span=dspan, dense_lo=dlo)
+                ops.append((sh, tuple(sdatas), tuple(svals), n_valid,
+                            table if dspan > 0 else None,
+                            dlo if dspan > 0 else None))
+                prepared.append((sh, tuple(sdatas), tuple(svals), dup,
+                                 n_valid, table))
+            return ops, tuple(prepared)
+
         if decode:
             # scan-decode prelude: the chain starts from the PACKED
             # upload buffers and inlines the transfer decode, so the
@@ -740,15 +777,38 @@ class FusedChain:
 
             dec_specs, col_map, cap = decode
 
-            def run(bufs, bases, num_rows, builds, aux, types):
-                decoded = _interop.unpack_arrays(list(bufs), bases,
-                                                 dec_specs, cap)
-                cols = [ColV(t, decoded[bi],
-                             None if vi < 0 else decoded[vi])
-                        for t, (_k, bi, vi) in zip(types, col_map)]
-                live = jnp.arange(cap, dtype=jnp.int32) < num_rows
-                return run_steps(cols, live, num_rows, builds, aux,
-                                 cap)
+            if inline:
+                def run(bufs, bases, num_rows, raw_builds, aux, types):
+                    decoded = _interop.unpack_arrays(list(bufs), bases,
+                                                     dec_specs, cap)
+                    cols = [ColV(t, decoded[bi],
+                                 None if vi < 0 else decoded[vi])
+                            for t, (_k, bi, vi) in zip(types, col_map)]
+                    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                    builds, prepared = inline_build_ops(raw_builds)
+                    outs, live = run_steps(cols, live, num_rows,
+                                           builds, aux, cap)
+                    return outs, live, prepared
+            else:
+                def run(bufs, bases, num_rows, builds, aux, types):
+                    decoded = _interop.unpack_arrays(list(bufs), bases,
+                                                     dec_specs, cap)
+                    cols = [ColV(t, decoded[bi],
+                                 None if vi < 0 else decoded[vi])
+                            for t, (_k, bi, vi) in zip(types, col_map)]
+                    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                    return run_steps(cols, live, num_rows, builds, aux,
+                                     cap)
+        elif inline:
+            def run(datas, vals, num_rows, raw_builds, aux, types):
+                capacity = datas[0].shape[0] if datas else 128
+                cols = [ColV(t, d, v)
+                        for t, d, v in zip(types, datas, vals)]
+                live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+                builds, prepared = inline_build_ops(raw_builds)
+                outs, live = run_steps(cols, live, num_rows, builds,
+                                       aux, capacity)
+                return outs, live, prepared
         else:
             def run(datas, vals, num_rows, builds, aux, types):
                 capacity = datas[0].shape[0] if datas else 128
@@ -767,10 +827,11 @@ class FusedChain:
         # hash-probe variants of one chain attribute separately
         import zlib
 
-        key = self.chain_key(compact_out, modes, decode)
+        key = self.chain_key(compact_out, modes, decode, inline)
         tag = zlib.crc32(repr(key if key is not None
                               else id(self)).encode()) & 0xFFFF
-        label = "fused_chain[" + ("decode+" if decode else "") + \
+        label = "fused_chain[" + ("build+" if inline else "") + \
+            ("decode+" if decode else "") + \
             "+".join(type(s).__name__.replace("Step", "").lower()
                      for s in steps) + f"]@{tag:04x}"
         run.__name__ = run.__qualname__ = label
@@ -832,6 +893,41 @@ class FusedChain:
             outs, live = ctx.batcher.call(key, prog, args, statics,
                                           ctx.query_id, ctx.multi)
         return outs, live, final_ghosts
+
+    def run_inline(self, batch, descs: tuple, raw_builds: Sequence,
+                   build_ghosts: Sequence, compact_out: bool):
+        """First-batch launch of the build-inlined program variant:
+        -> (outs, live | count, prepared build array tuples, output
+        ghosts). ``descs`` is the static per-build descriptor
+        ((build_keys, build_types, hash_types, dense_span, dense_lo),
+        ...); ``raw_builds`` the matching raw (datas, vals, num_rows)
+        triples. Deliberately bypasses the micro-batcher and the
+        warmup-ladder registry: the variant runs ONCE per (chain,
+        query) — its argument layout puts raw build arrays where
+        probe-only launches put prepared ops, so a ladder replay would
+        re-prepare builds for nothing, and a one-shot launch has no
+        cross-tenant sharing to win."""
+        from spark_rapids_tpu.execs import interop as _interop
+
+        ghost_preps = [PreparedBuild(ok=True, ghosts=list(g))
+                       for g in build_ghosts]
+        states, final_ghosts = self._ghost_states(batch, ghost_preps)
+        aux = self._aux_from_states(states)
+        raw_ops = tuple((tuple(d), tuple(v), n)
+                        for d, v, n in raw_builds)
+        if isinstance(batch, _interop.PackedBatch):
+            decode = batch.decode_key()
+            prog = self._program(compact_out, (), decode, inline=descs)
+            args = (tuple(batch.bufs), tuple(batch.dec_bases),
+                    batch.num_rows_device(), raw_ops, aux)
+        else:
+            prog = self._program(compact_out, (), inline=descs)
+            args = ([c.data for c in batch.columns],
+                    [c.validity for c in batch.columns],
+                    batch.num_rows_device(), raw_ops, aux)
+        outs, live, prepared = prog(*args,
+                                    types=tuple(self.source_types))
+        return outs, live, prepared, final_ghosts
 
     # -- host mirror --------------------------------------------------------
 
@@ -997,17 +1093,20 @@ class FusedChainExec(TpuExec):
         self.build_key_specs = _build_key_specs(chain.steps)
         self._preps: Optional[List[PreparedBuild]] = None
         self._preps_ok: Optional[bool] = None
+        self._inline_evt = None
         self._prep_lock = lockorder.make_lock("execs.fused.chainPrep")
 
     def __getstate__(self):
         state = dict(self.__dict__)
         state.pop("_prep_lock", None)
+        state.pop("_inline_evt", None)
         state["_preps"] = None
         state["_preps_ok"] = None
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self._inline_evt = None
         self._prep_lock = lockorder.make_lock("execs.fused.chainPrep")
 
     @property
@@ -1035,35 +1134,195 @@ class FusedChainExec(TpuExec):
                 self._preps_ok = ok
             return self._preps_ok
 
+    def _inline_enabled(self) -> bool:
+        """In-program build applies when the chain HAS builds and the
+        knob is on; chains without joins take the (free) host path."""
+        if not self.builds:
+            return False
+        from spark_rapids_tpu import config as cfg
+
+        conf = getattr(self, "conf", None)
+        return bool(conf.get(cfg.FUSION_IN_PROGRAM_BUILD)
+                    if conf is not None
+                    else cfg.FUSION_IN_PROGRAM_BUILD.default)
+
+    def _inline_first(self, batch, compact_out: bool):
+        """Single-flight first-batch inline build. Returns the chain
+        output triple when THIS thread ran the build-inlined launch, or
+        None when the builds were resolved (or failed to duplicates) by
+        another thread / the dup fallback engaged — the caller then
+        consults ``_preps_ok``. A leader that errors leaves ``_preps_ok``
+        None; the next waiter retries as the new leader (same contract
+        as the _PREP_CACHE poisoned-entry drop)."""
+        while True:
+            leader = False
+            with self._prep_lock:
+                if self._preps_ok is not None:
+                    return None
+                evt = self._inline_evt
+                if evt is None:
+                    evt = self._inline_evt = threading.Event()
+                    leader = True
+            if leader:
+                try:
+                    return self._inline_launch(batch, compact_out)
+                finally:
+                    with self._prep_lock:
+                        self._inline_evt = None
+                    evt.set()
+            evt.wait()
+            if self._preps_ok is not None:
+                return None
+
+    def _inline_launch(self, batch, compact_out: bool):
+        """Materialize the build sides RAW and run the chain's
+        build-inlined program variant on the first stream batch: hash
+        sort, duplicate probe and (stats-known) dense table trace
+        INSIDE the chain program, so stage0 sheds the standalone
+        _prep_build dispatch AND its flag-sync device_get. The launch
+        is SPECULATIVE — probe results are garbage if a build has
+        duplicate key hashes — so the dup flags ride back as program
+        outputs and are read via np.asarray, a transfer that overlaps
+        the (already in-flight) program instead of costing its own
+        dispatch. Duplicates discard the output, restore eager scan
+        decode, and fall back to the preserved unfused subtree, exactly
+        like the host path. Returns (outs, live|count, ghosts) or None
+        on fallback. Unlike the host path the runtime-key-range dense
+        table is NOT built here (it needed the flag sync this variant
+        exists to remove): builds without host-known stats probe in
+        hash mode."""
+        import contextlib
+
+        from spark_rapids_tpu import config as cfg
+
+        conf = getattr(self, "conf", None)
+        span_max = conf.get(cfg.FUSION_DENSE_PROBE_MAX_SPAN) \
+            if conf is not None else _DENSE_SPAN_MAX
+        descs, raw, ghosts_l = [], [], []
+        with contextlib.ExitStack() as stack:
+            for exch, (bkeys, btypes, commons) in zip(
+                    self.builds, self.build_key_specs):
+                bb = stack.enter_context(exch._materialize().acquired())
+                dense_span = 0
+                dense_lo = 0
+                want_range = span_max > 0 and len(bkeys) == 1 and (
+                    commons[0].is_integral or
+                    commons[0] in (dt.DATE, dt.TIMESTAMP, dt.BOOLEAN))
+                if want_range and bb.columns:
+                    st = getattr(bb.columns[bkeys[0]], "stats", None)
+                    if st is not None:
+                        from spark_rapids_tpu.ops.groupby import \
+                            quantize_range
+
+                        qlo, qhi = quantize_range(int(st[0]),
+                                                  int(st[1]))
+                        if qhi - qlo + 1 <= span_max:
+                            dense_span = qhi - qlo + 1
+                            dense_lo = qlo
+                descs.append((tuple(bkeys), tuple(btypes),
+                              tuple(commons), dense_span, dense_lo))
+                raw.append(([c.data for c in bb.columns],
+                            [c.validity for c in bb.columns],
+                            bb.num_rows_device()))
+                ghosts_l.append([_ghost_of(c) for c in bb.columns])
+            with TraceRange("FusedChainExec.inlineBuild"):
+                outs, live, prepared, ghosts = self.chain.run_inline(
+                    batch, tuple(descs), raw, ghosts_l, compact_out)
+        # np.asarray, not device_get: the flag rides home with the
+        # in-flight program's results rather than as its own counted
+        # round trip (the telemetry's device_get wrapper is the
+        # dispatch boundary; __array__ coercion isn't)
+        if any(bool(np.asarray(p[3])) for p in prepared):
+            with self._prep_lock:
+                self._preps = None
+                self._preps_ok = False
+            if self._defer_scan is not None:
+                # the fallback subtree re-executes the scan and is
+                # not fusion-aware: restore eager decode first
+                self._defer_scan.defer_decode = False
+            return None
+        preps = []
+        for (bkeys, _bt, _cm, dspan, dlo), p, g in zip(descs, prepared,
+                                                       ghosts_l):
+            sh, sdatas, svals, _dup, n_valid, table = p
+            prep = PreparedBuild(ok=True, h_sorted=sh, datas=sdatas,
+                                 vals=svals, n_valid=n_valid, ghosts=g)
+            if dspan > 0:
+                prep.table = table
+                prep.dense_lo = dlo
+            preps.append(prep)
+        with self._prep_lock:
+            self._preps = preps
+            self._preps_ok = True
+        return outs, live, ghosts
+
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        if self._preps_ok is None and self._inline_enabled():
+            return timed(self, self._iter_inline(partition))
         if not self._ensure_preps():
             return self.fallback.execute(partition)
+        return timed(self, self._iter_probe(partition))
 
-        def it():
-            saw = False
-            has_sort = any(isinstance(s, SortStep)
-                           for s in self.chain.steps)
-            for b in self.children[0].execute(partition):
-                # skip empties only when the count is ALREADY host-side:
-                # forcing a lazy count here would cost the same round
-                # trip the skip is trying to save
-                n = b.num_rows
-                if isinstance(n, int) and n == 0 and saw:
+    def _iter_probe(self, partition: int):
+        saw = False
+        has_sort = any(isinstance(s, SortStep)
+                       for s in self.chain.steps)
+        for b in self.children[0].execute(partition):
+            # skip empties only when the count is ALREADY host-side:
+            # forcing a lazy count here would cost the same round
+            # trip the skip is trying to save
+            n = b.num_rows
+            if isinstance(n, int) and n == 0 and saw:
+                continue
+            if saw and has_sort:
+                # not an assert: must survive python -O — a second
+                # batch through a SortStep chain would silently
+                # produce per-batch (non-global) order
+                raise RuntimeError(
+                    "SortStep chain fed more than one batch "
+                    "(planner bug: source must be a single-batch "
+                    "aggregate)")
+            saw = True
+            with TraceRange("FusedChainExec"):
+                outs, n, ghosts = self.chain.run(b, self._preps,
+                                                 compact_out=True)
+            yield self.chain.wrap(outs, ghosts, n)
+
+    def _iter_inline(self, partition: int):
+        """First batch runs the build-inlined variant (or waits for a
+        peer partition's); every later batch takes the probe-only path
+        over the prepared arrays it produced."""
+        saw = False
+        has_sort = any(isinstance(s, SortStep)
+                       for s in self.chain.steps)
+        for b in self.children[0].execute(partition):
+            n = b.num_rows
+            if isinstance(n, int) and n == 0 and saw:
+                continue
+            if saw and has_sort:
+                raise RuntimeError(
+                    "SortStep chain fed more than one batch "
+                    "(planner bug: source must be a single-batch "
+                    "aggregate)")
+            if self._preps_ok is None:
+                res = self._inline_first(b, compact_out=True)
+                if res is not None:
+                    saw = True
+                    outs, n2, ghosts = res
+                    yield self.chain.wrap(outs, ghosts, n2)
                     continue
-                if saw and has_sort:
-                    # not an assert: must survive python -O — a second
-                    # batch through a SortStep chain would silently
-                    # produce per-batch (non-global) order
-                    raise RuntimeError(
-                        "SortStep chain fed more than one batch "
-                        "(planner bug: source must be a single-batch "
-                        "aggregate)")
-                saw = True
-                with TraceRange("FusedChainExec"):
-                    outs, n, ghosts = self.chain.run(b, self._preps,
-                                                     compact_out=True)
-                yield self.chain.wrap(outs, ghosts, n)
-        return timed(self, it())
+                if not self._preps_ok:
+                    # duplicate build-key hashes: the speculative
+                    # output is discarded, the preserved subtree runs
+                    yield from self.fallback.execute(partition)
+                    return
+                # a peer thread prepared the builds; fall through to
+                # the probe path for this batch
+            saw = True
+            with TraceRange("FusedChainExec"):
+                outs, n2, ghosts = self.chain.run(b, self._preps,
+                                                  compact_out=True)
+            yield self.chain.wrap(outs, ghosts, n2)
 
     def tree_string(self, indent: int = 0) -> str:
         return _fused_tree_string(self, indent,
@@ -1097,6 +1356,15 @@ def _fused_all_metrics(exec_):
         for c in exec_.children:
             out.update(c.all_metrics())
     return out
+
+
+class _InlineDupFallback(Exception):
+    """Internal: the speculative build-inlined first launch found
+    duplicate build-key hashes. Raised out of
+    FusedAggregateExec._update_inputs — safe because the aggregate
+    yields nothing before its first _update_inputs — and caught in
+    execute(), which reruns the partition through the preserved
+    unfused subtree."""
 
 
 class FusedAggregateExec(agg_exec.HashAggregateExec):
@@ -1135,13 +1403,29 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
         self.build_key_specs = _build_key_specs(self.chain.steps)
         self._preps: Optional[List[PreparedBuild]] = None
         self._preps_ok: Optional[bool] = None
+        self._inline_evt = None
         self._prep_lock = lockorder.make_lock("execs.fused.chainPrep")
 
     __getstate__ = FusedChainExec.__getstate__
     __setstate__ = FusedChainExec.__setstate__
     _ensure_preps = FusedChainExec._ensure_preps
+    _inline_enabled = FusedChainExec._inline_enabled
+    _inline_first = FusedChainExec._inline_first
+    _inline_launch = FusedChainExec._inline_launch
 
     def _update_inputs(self, b: ColumnarBatch):
+        if self._preps_ok is None and self._inline_enabled():
+            res = self._inline_first(b, compact_out=False)
+            if res is None:
+                if not self._preps_ok:
+                    raise _InlineDupFallback()
+                # a peer thread prepared the builds: probe path below
+            else:
+                outs, live, ghosts = res
+                out = self.chain.wrap(outs, ghosts, b.num_rows)
+                if not self._proj_in_chain:
+                    out = self.input_proj(out)
+                return out, live
         with TraceRange("FusedAggregateExec.chain"):
             outs, live, ghosts = self.chain.run(b, self._preps,
                                                 compact_out=False)
@@ -1153,6 +1437,18 @@ class FusedAggregateExec(agg_exec.HashAggregateExec):
         return out, live
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        if self._preps_ok is None and self._inline_enabled():
+            # builds resolve lazily inside the first _update_inputs; a
+            # duplicate-keyed build surfaces as _InlineDupFallback
+            # BEFORE the aggregate yields anything, so the fallback
+            # subtree can still own the whole partition
+            def it():
+                try:
+                    yield from super(FusedAggregateExec,
+                                     self).execute(partition)
+                except _InlineDupFallback:
+                    yield from self.fallback.execute(partition)
+            return it()
         if not self._ensure_preps():
             return self.fallback.execute(partition)
         return super().execute(partition)

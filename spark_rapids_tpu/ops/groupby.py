@@ -110,13 +110,20 @@ def key_range_of(col: Column, dtype: dt.DType) -> Optional[Tuple[int, int]]:
 # module trips the compiler). Wide aggregate lists split into chunks of
 # <= 6 below this shape boundary; chunks re-sort but are deterministic,
 # so every chunk produces identical group order and the outputs zip.
+# ``single_pass=True`` (the default, knob
+# rapids.tpu.sql.groupby.singlePass.enabled) bypasses the chunk loop:
+# on backends without the compiler defect one wide launch costs half
+# the dispatches of two chunked ones, and the chunks' extra sorts were
+# pure waste. The chunked path stays reachable (single_pass=False) as
+# the v5e escape hatch.
 _AOT_MAX_AGGS = 6
 _AOT_CHUNK_MIN_CAP = 1 << 15
 
 
 def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
                       aggs: List[AggSpec], dtypes: List[dt.DType],
-                      live_mask=None, dense_ok: bool = True
+                      live_mask=None, dense_ok: bool = True,
+                      single_pass: bool = True
                       ) -> Tuple[ColumnarBatch, List[dt.DType]]:
     """Returns (result batch [keys..., agg results...], result dtypes).
     ``live_mask`` fuses an upstream filter into the sort pass.
@@ -126,7 +133,8 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
     positions and the dense sweep's reduction tree is position-
     dependent — levels summing the SAME value set would differ in the
     last ulp, splitting rank()-over-sum ties the sort path (segment-
-    relative scan order) keeps exact."""
+    relative scan order) keeps exact. ``single_pass`` False restores
+    the chunked AOT-workaround loop for wide aggregate lists."""
     cols = [(c.data, c.validity) for c in batch.columns]
     key_ranges = tuple(key_range_of(batch.columns[o], dtypes[o])
                        for o in key_ordinals)
@@ -146,8 +154,8 @@ def groupby_aggregate(batch: ColumnarBatch, key_ordinals: List[int],
     # segfault workaround guards against — wide agg lists stay whole
     will_dense = dense_ok and _dense_layout(
         list(dtypes), key_ordinals, key_ranges, key_has_v) is not None
-    if len(aggs) > _AOT_MAX_AGGS and not will_dense and \
-            batch.capacity >= _AOT_CHUNK_MIN_CAP:
+    if not single_pass and len(aggs) > _AOT_MAX_AGGS and \
+            not will_dense and batch.capacity >= _AOT_CHUNK_MIN_CAP:
         agg_d, agg_v = [], []
         key_d = key_v = num_groups = None
         for lo in range(0, len(aggs), _AOT_MAX_AGGS):

@@ -710,9 +710,12 @@ def _own_cost(e) -> int:
         # point of folding the shuffle into the program
         return 2
     if isinstance(e, FusedAggregateExec):
-        own = 3 * parts + 1  # chain + (chunked) groupby per partition
+        # chain + single-pass groupby per partition; the build prep is
+        # inlined into the chain's first launch (in-program build), so
+        # builds no longer add their own dispatches
+        own = 2 * parts
     elif isinstance(e, FusedChainExec):
-        own = 1 * parts + len(e.builds)
+        own = 1 * parts
     elif isinstance(e, HashAggregateExec):
         own = 3 * parts
     elif isinstance(e, joins.HashJoinExec):

@@ -369,3 +369,59 @@ def test_wide_agg_compacts_before_sort_path(monkeypatch):
     got = on.sql(sql).collect()
     want = off.sql(sql).collect()
     assert_frames_equal(got, want)
+
+
+def test_in_program_build_knob_off_matches_on():
+    """inProgramBuild on (default: builds fold into the chain's first
+    launch) vs off (host _prep_build + batched flag sync) must be
+    frame-identical, and the on-path must actually resolve the builds
+    from the inline launch rather than falling back."""
+    rng = np.random.default_rng(29)
+    fact, dim = _tables(rng)
+    sql = ("SELECT d.name AS name, count(*) AS n, sum(f.v) AS sv "
+           "FROM f JOIN d ON f.k = d.id WHERE f.g < 4 "
+           "GROUP BY d.name ORDER BY name")
+    key = "rapids.tpu.sql.fusion.inProgramBuild.enabled"
+    s_on = Session(conf={key: True})
+    s_off = Session(conf={key: False})
+    _register(s_on, fact, dim)
+    _register(s_off, fact, dim)
+    got = s_on.sql(sql).collect()
+    want = s_off.sql(sql).collect()
+    assert_frames_equal(got, want)
+    # the inline launch resolved the builds (no fallback, no host prep)
+    ex = s_on.sql(sql)._exec()
+    fused = [f for f in find(ex, (FusedAggregateExec, FusedChainExec))
+             if f.builds]
+    assert fused
+    list(fused[0].execute(0))
+    assert fused[0]._preps_ok is True
+    assert fused[0]._preps and fused[0]._preps[0].ok
+    # knob-off exec goes through the host path and agrees too
+    ex_off = s_off.sql(sql)._exec()
+    host = [f for f in find(ex_off,
+                            (FusedAggregateExec, FusedChainExec))
+            if f.builds]
+    if host:  # fusion still on; only the build inlining is disabled
+        assert not host[0]._inline_enabled()
+
+
+def test_in_program_build_dense_table_from_stats():
+    """A dim table with host-known key stats gets its dense inverse
+    table built INSIDE the inline launch — the prepared build carries
+    table + dense_lo without any separate _prep_build dispatch."""
+    rng = np.random.default_rng(31)
+    fact, dim = _tables(rng)
+    sql = ("SELECT f.k AS k, f.v AS v, d.w AS w FROM f JOIN d "
+           "ON f.k = d.id WHERE f.g = 1 ORDER BY k, v")
+    on, _ = _sessions()
+    _register(on, fact, dim)
+    ex = on.sql(sql)._exec()
+    fused = [f for f in find(ex, (FusedAggregateExec, FusedChainExec))
+             if f.builds]
+    assert fused
+    list(fused[0].execute(0))
+    assert fused[0]._preps_ok is True
+    # dim ids are 0..29 with upload stats: dense-eligible
+    assert fused[0]._preps[0].table is not None
+    assert fused[0]._preps[0].dense_lo == 0

@@ -1,0 +1,231 @@
+"""Chunked vs single-pass groupby equivalence (PR 13 satellite).
+
+ops/groupby.py historically split wide aggregate lists (> _AOT_MAX_AGGS
+columns at capacity >= _AOT_CHUNK_MIN_CAP on the sort path) into two
+launches — the libtpu v5e AOT-segfault workaround. ``single_pass=True``
+(the default) emits ONE wide launch instead; the chunked loop survives
+as an escape hatch (knob rapids.tpu.sql.groupby.singlePass.enabled).
+This suite pins the contract that the two emissions are the SAME
+aggregate: bit-exact results across the _AOT_MAX_AGGS width boundary
+and the _AOT_CHUNK_MIN_CAP capacity boundary, with and without a fused
+filter mask, and that dense/sort/chunked/single-pass all agree on
+order-insensitive aggregates. It also covers the exec-level
+_COMPACT_WIDE_MIN_CAP pre-pass composing with the knob.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.ops import groupby as gb
+from spark_rapids_tpu.ops.groupby import AggSpec
+
+WIDE_CAP = gb._AOT_CHUNK_MIN_CAP          # chunking engages at this cap
+
+# 9 aggregates (> _AOT_MAX_AGGS = 6) over a float and an int column.
+# Chunked and single-pass share the SAME sort kernel and per-column
+# segmented reductions, so even the float sum must be bit-exact between
+# them (unlike dense-vs-sort, where only order-insensitive aggs are).
+WIDE_AGGS = [AggSpec("sum", 1), AggSpec("min", 1), AggSpec("max", 1),
+             AggSpec("count", 1), AggSpec("sum", 2), AggSpec("min", 2),
+             AggSpec("max", 2), AggSpec("count", 2),
+             AggSpec("count_star")]
+
+ORDER_INSENSITIVE_WIDE = [AggSpec("min", 1), AggSpec("max", 1),
+                          AggSpec("count", 1), AggSpec("sum", 2),
+                          AggSpec("min", 2), AggSpec("max", 2),
+                          AggSpec("count_star")]       # 7 > 6, exact on
+                                                       # any kernel
+
+
+def _wide_batch(rng, n, span, with_stats=False):
+    keys = rng.integers(0, span, n).astype(np.int64)
+    keys[:min(span, n)] = np.arange(min(span, n))
+    f = rng.standard_normal(n)
+    f[rng.random(n) < 0.05] = np.nan
+    f[rng.random(n) < 0.05] = -0.0
+    i = rng.integers(-1000, 1000, n).astype(np.int64)
+    kcol = Column.from_numpy(keys)
+    if with_stats:
+        kcol.stats = (0, span - 1)
+    cols = [kcol,
+            Column.from_numpy(f, validity=rng.random(n) > 0.1),
+            Column.from_numpy(i, validity=rng.random(n) > 0.1)]
+    return ColumnarBatch(cols, n), [dt.INT64, dt.FLOAT64, dt.INT64]
+
+
+def _rows(out, num_aggs):
+    """Realized (key -> agg tuple) dict with float BITS for exactness."""
+    import jax
+
+    n = out.realized_num_rows()
+    cols = []
+    for c in out.columns:
+        data = np.asarray(jax.device_get(c.data))[:n]
+        if data.dtype.kind == "f":
+            data = data.view(f"u{data.dtype.itemsize}")
+        valid = np.ones(n, bool) if c.validity is None else \
+            np.asarray(jax.device_get(c.validity))[:n]
+        cols.append((data, valid))
+    rows = {}
+    for i in range(n):
+        key = (cols[0][0][i].item(), bool(cols[0][1][i]))
+        rows[key] = tuple(
+            (cols[j][0][i].item(), bool(cols[j][1][i]))
+            for j in range(1, 1 + num_aggs))
+    return rows
+
+
+def _count_launches(fn):
+    """Run ``fn`` counting _groupby invocations (the chunk loop calls
+    it once per chunk; single-pass exactly once)."""
+    calls = []
+    real = gb._groupby
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    gb._groupby = spy
+    try:
+        out = fn()
+    finally:
+        gb._groupby = real
+    return out, len(calls)
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_single_pass_matches_chunked_bit_exact(masked):
+    """At chunk-eligible shape (9 aggs, cap >= _AOT_CHUNK_MIN_CAP, sort
+    path) the chunked loop issues 2 launches and single-pass 1, and the
+    results — including float sums and NaN/-0.0 bits — are identical.
+    Both with and without a fused filter live_mask."""
+    rng = np.random.default_rng(42 + masked)
+    n = WIDE_CAP
+    b, types = _wide_batch(rng, n, 1000)
+    mask = (rng.random(b.capacity) > 0.3) if masked else None
+    out_c, nc = _count_launches(lambda: gb.groupby_aggregate(
+        b, [0], WIDE_AGGS, types, live_mask=mask, single_pass=False))
+    out_s, ns = _count_launches(lambda: gb.groupby_aggregate(
+        b, [0], WIDE_AGGS, types, live_mask=mask, single_pass=True))
+    assert nc == 2 and ns == 1
+    assert _rows(out_c[0], len(WIDE_AGGS)) == \
+        _rows(out_s[0], len(WIDE_AGGS))
+
+
+def test_agg_width_boundary():
+    """Exactly _AOT_MAX_AGGS aggs never chunk (either mode); one more
+    chunks under single_pass=False and stays whole under True, with
+    bit-identical results either way."""
+    rng = np.random.default_rng(7)
+    b, types = _wide_batch(rng, WIDE_CAP, 500)
+    six = WIDE_AGGS[:gb._AOT_MAX_AGGS]
+    out6, n6 = _count_launches(lambda: gb.groupby_aggregate(
+        b, [0], six, types, single_pass=False))
+    assert n6 == 1
+    seven = WIDE_AGGS[:gb._AOT_MAX_AGGS + 1]
+    out_c, n7c = _count_launches(lambda: gb.groupby_aggregate(
+        b, [0], seven, types, single_pass=False))
+    out_s, n7s = _count_launches(lambda: gb.groupby_aggregate(
+        b, [0], seven, types, single_pass=True))
+    assert n7c == 2 and n7s == 1
+    assert _rows(out_c[0], 7) == _rows(out_s[0], 7)
+    # the 6-agg prefix of the 7-agg run matches the 6-agg run: adding
+    # an aggregate must not perturb its neighbours
+    assert _rows(out6[0], 6) == {
+        k: v[:6] for k, v in _rows(out_c[0], 7).items()}
+
+
+def test_capacity_boundary_skips_chunking():
+    """One bucket below _AOT_CHUNK_MIN_CAP the chunk loop never engages
+    (the AOT defect is shape-gated), so both modes are one launch and
+    trivially identical."""
+    rng = np.random.default_rng(11)
+    b, types = _wide_batch(rng, WIDE_CAP // 2, 500)
+    assert b.capacity < gb._AOT_CHUNK_MIN_CAP
+    out_c, nc = _count_launches(lambda: gb.groupby_aggregate(
+        b, [0], WIDE_AGGS, types, single_pass=False))
+    out_s, ns = _count_launches(lambda: gb.groupby_aggregate(
+        b, [0], WIDE_AGGS, types, single_pass=True))
+    assert nc == 1 and ns == 1
+    assert _rows(out_c[0], len(WIDE_AGGS)) == \
+        _rows(out_s[0], len(WIDE_AGGS))
+
+
+def test_dense_sort_chunked_single_pass_all_agree():
+    """Order-insensitive wide aggregate, dense-eligible key span: the
+    dense sweep (stats), the sort kernel, the chunked sort loop and the
+    single-pass sort launch all produce the same bits. Dense also never
+    chunks (no sort module to protect), even under single_pass=False."""
+    rng = np.random.default_rng(13)
+    n = WIDE_CAP
+    b_stats, types = _wide_batch(rng, n, 100, with_stats=True)
+    b_plain = ColumnarBatch(list(b_stats.columns), n)
+    b_plain.columns[0] = Column(dt.INT64, b_stats.columns[0].data,
+                                b_stats.columns[0].validity)  # no stats
+    na = len(ORDER_INSENSITIVE_WIDE)
+    out_d, nd = _count_launches(lambda: gb.groupby_aggregate(
+        b_stats, [0], ORDER_INSENSITIVE_WIDE, types,
+        single_pass=False))
+    assert nd == 1          # will_dense short-circuits the chunk gate
+    out_s, _ = _count_launches(lambda: gb.groupby_aggregate(
+        b_plain, [0], ORDER_INSENSITIVE_WIDE, types, single_pass=True))
+    out_c, ncc = _count_launches(lambda: gb.groupby_aggregate(
+        b_plain, [0], ORDER_INSENSITIVE_WIDE, types,
+        single_pass=False))
+    assert ncc == 2
+    rows = _rows(out_d[0], na)
+    assert rows == _rows(out_s[0], na) == _rows(out_c[0], na)
+
+
+def test_exec_compact_wide_composes_with_single_pass(monkeypatch):
+    """Exec level: the _COMPACT_WIDE_MIN_CAP pre-pass (compact filtered
+    survivors before a wide sort-path aggregate) and the single-pass
+    knob compose — with the boundary lowered into range the compaction
+    engages and both knob settings still match the CPU oracle; at the
+    default boundary (capacity far below 1<<22) it must NOT engage."""
+    from compare import assert_cpu_and_tpu_equal
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.execs.aggregate import HashAggregateExec
+    from spark_rapids_tpu.plan import nodes as pn
+    from spark_rapids_tpu.sql import parse, plan_statement
+
+    rng = np.random.default_rng(17)
+    n = 4096
+    src = pn.InMemorySource(
+        {"k": rng.integers(0, 1000, n).astype(np.int64),
+         "v": rng.standard_normal(n),
+         "w": rng.integers(-50, 50, n).astype(np.int64)},
+        validity={"v": rng.random(n) > 0.1})
+    sql = ("SELECT k, sum(v) AS a1, min(v) AS a2, max(v) AS a3, "
+           "count(v) AS a4, sum(w) AS a5, min(w) AS a6, max(w) AS a7 "
+           "FROM t WHERE v > 0.2 GROUP BY k ORDER BY k")
+    plan = plan_statement(parse(sql), {"t": src})
+
+    compacted = []
+    real = HashAggregateExec._maybe_compact_wide
+
+    def spy(self, b, mask):
+        nb, nm = real(self, b, mask)
+        if mask is not None and nm is None:
+            compacted.append(nb.capacity)
+        return nb, nm
+
+    monkeypatch.setattr(HashAggregateExec, "_maybe_compact_wide", spy)
+    for min_cap in (256, HashAggregateExec._COMPACT_WIDE_MIN_CAP):
+        monkeypatch.setattr(HashAggregateExec, "_COMPACT_WIDE_MIN_CAP",
+                            min_cap)
+        for sp in (True, False):
+            compacted.clear()
+            conf = RapidsConf().with_overrides(
+                {cfg.GROUPBY_SINGLE_PASS.key: sp})
+            assert_cpu_and_tpu_equal(plan, conf=conf, sort=False,
+                                     approx_float=1e-9)
+            if min_cap == 256:
+                assert compacted, \
+                    "compact-wide pre-pass should engage below the " \
+                    "lowered boundary"
+            else:
+                assert not compacted
