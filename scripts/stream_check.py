@@ -1,0 +1,194 @@
+"""Streaming fence: folds must cost O(batch), never O(total), and the
+incremental answer must be bit-exact against the batch engine (CLI twin
+of tests/test_streaming.py).
+
+The claim the incremental engine makes is measured directly: a standing
+aggregation folds >= 10 identical-size micro-batches while the table's
+cumulative row count grows >= 10x, and the fence requires:
+
+  1. **bit_exact**  : after every appended batch — including an
+                      out-of-order LATE batch re-merged under the
+                      watermark — the standing query's emitted frame
+                      equals the batch engine run over the concatenated
+                      input, bit for bit (the aggregates are integer
+                      SUM/COUNT, which merge associatively: no float
+                      reorder tolerance needed, none granted)
+  2. **flat_folds** : per-fold wall clock stays flat as the table grows
+                      (max measured fold <= 3x the median — a fold that
+                      rescanned history would grow ~linearly and blow
+                      far past that)
+  3. **flat_dispatch**: per-fold device dispatch count is EXACTLY flat
+                      after warmup — fixed key domain + fixed batch
+                      size means identical compiled programs per fold,
+                      so any extra launch means the fold did work
+                      proportional to something other than the batch
+  4. **late_data**  : the late batch actually exercised the late path
+                      (late_rows_remerged > 0) and the final frame
+                      still matches the oracle
+
+    python scripts/stream_check.py [--batches 12] [--rows 20000]
+                                   [--keys 64] [--output STREAM_r01.json]
+
+Prints one JSON report; exit code 0 = fence holds.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: folds excluded from the flatness stats: fold 0 has no running-state
+#: merge (3 launches, not 6) and folds 1-2 eat the update/merge
+#: compiles for the steady-state shapes; fold 3 onward is steady state
+WARMUP_FOLDS = 3
+
+
+def _batch(rng, n, keys, t0):
+    import numpy as np
+
+    return {"k": rng.integers(0, keys, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+            "ev": (t0 + rng.integers(0, 1000, n)).astype(np.int64)}
+
+
+def _canon(frame):
+    return frame.sort_values("k").reset_index(drop=True)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--batches", type=int, default=12,
+                        help="micro-batches to fold (>= 10)")
+    parser.add_argument("--rows", type=int, default=20_000,
+                        help="rows per micro-batch")
+    parser.add_argument("--keys", type=int, default=64,
+                        help="group-by key domain")
+    parser.add_argument("--max-wall-ratio", type=float, default=3.0,
+                        help="max fold wall / median fold wall bound")
+    parser.add_argument("--output", default="STREAM_r01.json")
+    args = parser.parse_args(argv)
+
+    from spark_rapids_tpu.utils import dispatch as disp
+
+    disp.install()   # per-fold dispatch deltas need the interceptor
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.batch import Schema
+
+    rng = np.random.default_rng(42)
+    s = Session()
+    s.create_streaming_table(
+        "events", Schema(["k", "v", "ev"],
+                         [dt.INT64, dt.INT64, dt.INT64]))
+    df = s.sql("SELECT k, SUM(v) AS sv, COUNT(v) AS c "
+               "FROM events GROUP BY k")
+    sq = s.service.register_standing(
+        df, name="stream_check", event_time_col="ev",
+        watermark_ms=500, late_policy="merge")
+
+    folds = []
+    frames = []
+    mismatches = []
+    total_batches = max(args.batches, 10)
+    late_at = total_batches - 2   # one late batch, inside the run
+    for i in range(total_batches):
+        # the late batch reuses an old time range (below the
+        # watermark); every other batch advances event time
+        t0 = 0 if i == late_at else (i + 1) * 100_000
+        b = _batch(rng, args.rows, args.keys, t0)
+        frames.append(pd.DataFrame(b))
+        s.append_batch("events", b)
+        if sq.state != "EMITTING":
+            print(f"fold {i} left state {sq.state}: {sq.error}",
+                  file=sys.stderr)
+            return 1
+        # oracle at EVERY emit point: batch engine over the full table
+        got = _canon(sq.results())
+        want = _canon(
+            pd.concat(frames, ignore_index=True).groupby("k").agg(
+                sv=("v", "sum"), c=("v", "count")).reset_index())
+        if not got.equals(want):
+            mismatches.append(f"fold {i}: streamed frame != batch "
+                              f"oracle")
+        engine = _canon(df.to_pandas())
+        if not got.equals(engine):
+            mismatches.append(f"fold {i}: streamed frame != batch "
+                              f"ENGINE frame")
+        folds.append({
+            "fold": i,
+            "cumulative_rows": int(sq.rows_folded),
+            "wall_s": round(sq.last_fold_wall_s, 6),
+            "dispatches": sq.last_fold_dispatches,
+            "late": i == late_at,
+        })
+
+    measured = folds[WARMUP_FOLDS:]
+    walls = sorted(f["wall_s"] for f in measured)
+    median_wall = walls[len(walls) // 2]
+    max_wall = walls[-1]
+    dispatch_counts = {f["dispatches"] for f in measured}
+    # table growth across the run: fold 0 cost O(1 batch); the last
+    # fold runs against a table >= 10x larger — same cost required
+    growth = folds[-1]["cumulative_rows"] / folds[0]["cumulative_rows"]
+
+    checks = {
+        "bit_exact": {
+            "emit_points_checked": total_batches,
+            "mismatches": mismatches,
+            "ok": bool(not mismatches),
+        },
+        "flat_folds": {
+            "median_wall_s": round(median_wall, 6),
+            "max_wall_s": round(max_wall, 6),
+            "ratio": round(max_wall / max(median_wall, 1e-9), 3),
+            "threshold": args.max_wall_ratio,
+            "rows_growth": round(growth, 2),
+            "ok": bool(max_wall <= args.max_wall_ratio *
+                       max(median_wall, 1e-9) and growth >= 10.0),
+        },
+        "flat_dispatch": {
+            "per_fold_dispatch_counts": sorted(dispatch_counts),
+            "ok": bool(len(dispatch_counts) == 1),
+        },
+        "late_data": {
+            "late_rows_remerged": int(sq.late_rows_remerged),
+            "watermark": sq.watermark,
+            "watermark_lag_ms": sq.watermark_lag_ms,
+            "ok": bool(sq.late_rows_remerged > 0 and not mismatches),
+        },
+    }
+    streaming_stats = s.service.stats().streaming
+    streaming_stats.pop("standing", None)
+    report = {
+        "benchmark": "stream_check",
+        "batches": total_batches,
+        "rows_per_batch": args.rows,
+        "keys": args.keys,
+        "total_rows": folds[-1]["cumulative_rows"],
+        "folds": folds,
+        "streaming_stats": streaming_stats,
+        "checks": checks,
+        "ok": all(c["ok"] for c in checks.values()),
+    }
+    s.stop()
+    text = json.dumps(report, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+    if not report["ok"]:
+        print("STREAM FENCE FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

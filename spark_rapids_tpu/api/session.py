@@ -175,6 +175,41 @@ class Session:
         self._catalog_versions[name] = version
         return version
 
+    # -- streaming tables (service/streaming) ------------------------------
+
+    def create_streaming_table(self, name: str, schema: Schema):
+        """Create an appendable streaming table, register it as a temp
+        view (batch queries over it see all rows appended so far), and
+        return the StreamTableSource. Feed it with ``append_batch``;
+        register continuous aggregations over it with
+        ``service.register_standing``."""
+        from spark_rapids_tpu.service.streaming.source import \
+            StreamTableSource
+
+        src = StreamTableSource(name, schema)
+        self.create_temp_view(name, src)
+        return src
+
+    def streaming_table(self, name: str):
+        """The registered StreamTableSource behind ``name``."""
+        from spark_rapids_tpu.plan.incremental import \
+            is_streaming_source
+
+        target = self._catalog.get(name)
+        if isinstance(target, pn.ScanNode):
+            target = target.source
+        if target is None or not is_streaming_source(target):
+            raise KeyError(f"{name!r} is not a registered streaming "
+                           "table")
+        return target
+
+    def append_batch(self, table, data, validity=None) -> int:
+        """Append one micro-batch (dict of columns or pandas frame) to
+        a streaming table — by name or source — routing through the
+        query service so standing queries fold it synchronously;
+        returns the rows landed."""
+        return self.service.ingest(table, data, validity)
+
     def register_parquet(self, name: str, path, columns=None) -> None:
         """Catalog a parquet directory as a SQL table."""
         from spark_rapids_tpu.io import ParquetSource

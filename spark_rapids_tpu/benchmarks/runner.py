@@ -130,6 +130,9 @@ class BenchmarkRunner:
         # first one's OOM activity in its report
         run_pre_retry = _retry.snapshot()
         run_pre_sites = _retry.stats()["per_site"]
+        from spark_rapids_tpu.service.streaming import stats as _sstats
+
+        run_pre_stream = _sstats.snapshot()
         cat = get_catalog()
         pre_spill_dev = cat.spilled_device_bytes
         pre_spill_host = cat.spilled_host_bytes
@@ -171,6 +174,10 @@ class BenchmarkRunner:
                 "injections": inj["injections"] - pre_inj["injections"],
             },
         }
+        # streaming ingestion activity during the run (zeros for pure
+        # batch benchmarks; a dashboard-replay harness that appends
+        # micro-batches between iterations shows its folds here)
+        result["streaming"] = _sstats.delta(run_pre_stream)
         if telemetry and result["iterations"]:
             # the BASELINE.md-promised split: dispatch_count x RTT vs
             # time actually spent computing on the device

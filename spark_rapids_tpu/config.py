@@ -810,6 +810,50 @@ SERVICE_CACHE_MAX_BYTES = conf("rapids.tpu.service.cache.maxBytes").doc(
     "docs/tuning-guide.md for sizing against the device budget."
 ).bytes_conf.create_with_default(256 << 20)
 
+STREAMING_ENABLED = conf("rapids.tpu.streaming.enabled").doc(
+    "Master switch for streaming ingestion & incremental queries "
+    "(service/streaming): Session.create_streaming_table registers an "
+    "appendable table, QueryService.ingest lands micro-batches as "
+    "versioned deltas, and standing queries registered with "
+    "QueryService.register_standing fold each delta into long-lived "
+    "device-resident partial-aggregate state — one update launch plus "
+    "one merge launch per micro-batch, O(batch) not O(total). "
+    "Disabled, register_standing raises and appends still land (batch "
+    "queries over the table keep working)."
+).boolean_conf.create_with_default(True)
+
+STREAMING_WATERMARK_MS = conf("rapids.tpu.streaming.watermarkMs").doc(
+    "Default allowed event-time lateness in milliseconds for standing "
+    "queries registered with an event-time column. The per-query "
+    "watermark advances to max(event_time_seen) - watermarkMs and "
+    "never retreats; rows arriving at-or-below the watermark are LATE "
+    "(see rapids.tpu.streaming.lateData.policy), and windows whose "
+    "end is at-or-below it are FINAL (StandingQuery.results("
+    "final_only=True)). Per-registration override: the watermark_ms "
+    "argument of register_standing."
+).int_conf.create_with_default(0)
+
+STREAMING_MAX_STATE_BYTES = conf("rapids.tpu.streaming.maxStateBytes").doc(
+    "Upper bound on one standing query's partial-aggregate state, "
+    "measured at device width (the SpillableBatch registered size — "
+    "the state itself rides the device->host->disk spill tiers and "
+    "its device-resident bytes charge the admission footprint). A "
+    "fold that grows the state past this bound FAILS the standing "
+    "query and tears its state down (owner-tag removal), exactly like "
+    "cancel — unbounded key cardinality must not silently eat the "
+    "spill store. 0 disables the bound."
+).bytes_conf.create_with_default(0)
+
+STREAMING_LATE_POLICY = conf("rapids.tpu.streaming.lateData.policy").doc(
+    "What a standing query does with rows that arrive at-or-below its "
+    "watermark: 'merge' (default) folds them through the same "
+    "merge-spec path as on-time rows — already-emitted aggregates "
+    "self-correct on the next emit, counted as late-row re-merges in "
+    "the streaming stats block; 'drop' discards them host-side before "
+    "the update launch. Per-registration override: the late_policy "
+    "argument of register_standing."
+).string_conf.create_with_default("merge")
+
 SERVICE_CACHE_TTL = conf("rapids.tpu.service.cache.ttlSec").doc(
     "Time-to-live in seconds for cache entries: an entry older than "
     "this is treated as a miss on next touch and evicted — or, while "

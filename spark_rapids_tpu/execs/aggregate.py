@@ -251,6 +251,42 @@ class HashAggregateExec(TpuExec):
     def _merge_types(self) -> List[dt.DType]:
         return [e.dtype for e in self.grouping] + self.partial_types
 
+    # -- the incremental-combine seam ----------------------------------
+    # The update/merge split built for the retry ladder doubles as an
+    # incremental operator: partials from disjoint row sets re-merge to
+    # the partials of their union, so a consumer may hold ``running``
+    # partials across calls and fold new input in O(new input). The
+    # batch execute() loop below and the streaming subsystem
+    # (service/streaming/state.py) both drive these three methods.
+
+    def update_partials(self, batch: ColumnarBatch,
+                        site: str = "aggregate.update") -> ColumnarBatch:
+        """One update-program launch: a raw child batch ->
+        (keys..., partials...) in the merge schema."""
+        b, mask = self._update_inputs(batch)
+        b, mask = self._maybe_compact_wide(b, mask)
+        return self._agg_batch(b, self.first_specs, self.input_types,
+                               mask, site=site)
+
+    def merge_partials(self, running: ColumnarBatch,
+                       part: ColumnarBatch,
+                       site: str = "aggregate.merge") -> ColumnarBatch:
+        """One merge launch: concat two partial batches and re-aggregate
+        with the merge specs (associative — any fold order yields the
+        same partials for integral aggregates)."""
+        merged_in = concat_batches([running, part])
+        return self._agg_batch(merged_in, self.merge_specs,
+                               self._merge_types(), site=site)
+
+    def finalize_partials(self, running: ColumnarBatch) -> ColumnarBatch:
+        """Final projection + compaction over accumulated partials.
+        Does NOT consume ``running`` — a streaming consumer can emit
+        now and keep folding into the same partials."""
+        if self.final_proj is not None:
+            with TraceRange("HashAggregateExec.finalProject"):
+                running = self.final_proj(running)
+        return rebucket(running)
+
     def _update_inputs(self, b: ColumnarBatch):
         """Per-batch update-side inputs: (projected batch, live-mask).
         FusedAggregateExec overrides this with its one-program chain."""
@@ -300,20 +336,13 @@ class HashAggregateExec(TpuExec):
                 if b.realized_num_rows() == 0:
                     continue
                 saw_input = True
-                b, mask = self._update_inputs(b)
-                b, mask = self._maybe_compact_wide(b, mask)
                 with TraceRange("HashAggregateExec.updateAgg"):
-                    part = self._agg_batch(b, self.first_specs,
-                                           self.input_types, mask)
+                    part = self.update_partials(b)
                 if running is None:
                     running = part
                 else:
                     with TraceRange("HashAggregateExec.mergeAgg"):
-                        merged_in = concat_batches([running, part])
-                        running = self._agg_batch(merged_in,
-                                                  self.merge_specs,
-                                                  self._merge_types(),
-                                                  site="aggregate.merge")
+                        running = self.merge_partials(running, part)
             if running is None:
                 if self.grouping or (self.mode == "final" and not saw_input):
                     # grouped agg over empty input -> no rows (in the
@@ -337,10 +366,7 @@ class HashAggregateExec(TpuExec):
                     running = rebucket(running)
                 yield running
                 return
-            if self.final_proj is not None:
-                with TraceRange("HashAggregateExec.finalProject"):
-                    running = self.final_proj(running)
-            yield rebucket(running)
+            yield self.finalize_partials(running)
         return timed(self, it())
 
     def _merge_schema(self) -> Schema:

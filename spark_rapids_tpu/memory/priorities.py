@@ -19,6 +19,13 @@ INPUT_FROM_SHUFFLE_PRIORITY = 1 << 20
 # residue awaiting a single consumer.
 CACHED_FRAGMENT_PRIORITY = 1 << 30
 
+# Standing-query partial-aggregate state (service/streaming): outlives
+# any single fold by design and is NOT re-creatable without replaying
+# every ingested micro-batch, so it outranks cached fragments (which
+# recompute from their source plan) — but it is idle between folds, so
+# it spills before anything a task is actively computing on.
+STREAMING_STATE_PRIORITY = 1 << 35
+
 # Batches buffered by the coalesce iterator while accumulating to its goal.
 COALESCE_PRIORITY = 1 << 40
 

@@ -1,0 +1,160 @@
+"""Incremental-query plan analysis for standing queries.
+
+A standing query (service/streaming) folds arriving micro-batches into
+long-lived partial-aggregate state instead of recomputing from scratch.
+That is only sound for plans of the shape
+
+    Aggregate[complete](delta-reachable subtree over ONE streaming scan)
+
+because the aggregate update/merge split (execs/aggregate.py) is the
+incremental-combine operator: partials over disjoint row sets re-merge
+to the partials of their union, so per-delta update partials fold into
+the running state with one merge launch. Everything BELOW the aggregate
+(filters, projections, joins against non-streaming dimension tables) is
+row-local in the streaming input — running it over just the delta rows
+produces exactly the delta's contribution.
+
+This module validates that shape and builds the delta subplan: the
+aggregate's child with the streaming scan swapped for a mutable
+per-fold delta source. It deliberately knows nothing about the service
+layer — sources are recognized by the ``is_streaming`` marker attribute
+(service/streaming/source.py sets it), keeping plan/ free of service
+imports.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.plan import nodes as pn
+
+
+class IncrementalUnsupported(ValueError):
+    """The plan cannot be maintained incrementally — submit it as a
+    normal batch query instead."""
+
+
+@dataclasses.dataclass
+class IncrementalInfo:
+    """The validated decomposition register_standing folds over."""
+
+    #: the root complete-mode aggregate (grouping/aggs bound to child)
+    aggregate: pn.AggregateNode
+    #: the aggregate's child subtree (delta subplan template)
+    child: pn.PlanNode
+    #: the single streaming DataSource the child reads
+    stream_source: pn.DataSource
+    #: rename-only projection above the aggregate (SQL aliases GROUP BY
+    #: outputs this way): (output name, ordinal into aggregate output);
+    #: None when the aggregate is the literal root
+    projection: Optional[List[Tuple[str, int]]] = None
+
+    def output_names(self) -> List[str]:
+        if self.projection is not None:
+            return [n for n, _ in self.projection]
+        return list(self.aggregate.output_schema().names)
+
+
+def is_streaming_source(source) -> bool:
+    return bool(getattr(source, "is_streaming", False))
+
+
+def streaming_sources(plan: pn.PlanNode) -> List[pn.DataSource]:
+    """Every distinct streaming source read anywhere under ``plan``."""
+    out: List[pn.DataSource] = []
+    for node in pn.walk(plan):
+        src = getattr(node, "source", None)
+        if src is not None and is_streaming_source(src) and \
+                not any(s is src for s in out):
+            out.append(src)
+    return out
+
+
+def _rename_only(node: pn.ProjectNode
+                 ) -> Optional[List[Tuple[str, int]]]:
+    """(name, child ordinal) per output if ``node`` only renames /
+    reorders its input columns; None if any expression computes."""
+    from spark_rapids_tpu.expressions.base import Alias, BoundReference
+
+    out: List[Tuple[str, int]] = []
+    for name, e in zip(node.names, node.exprs):
+        while isinstance(e, Alias):
+            e = e.children[0]
+        if not isinstance(e, BoundReference):
+            return None
+        out.append((name, e.ordinal))
+    return out
+
+
+def analyze(plan) -> IncrementalInfo:
+    """Validate ``plan`` (a PlanNode or DataFrame-like with ``_plan``)
+    for incremental maintenance; raises IncrementalUnsupported with the
+    reason otherwise."""
+    node = getattr(plan, "_plan", plan)
+    # the SQL planner tops GROUP BY statements with a rename-only
+    # projection (SELECT aliases); peel those — the renaming applies to
+    # the EMITTED frame, it never touches what the fold maintains
+    projection: Optional[List[Tuple[str, int]]] = None
+    while isinstance(node, pn.ProjectNode):
+        mapping = _rename_only(node)
+        if mapping is None:
+            raise IncrementalUnsupported(
+                "the projection above the aggregate computes new "
+                "columns — a standing query supports only rename/"
+                "reorder above its aggregate; compute inside the "
+                "aggregation or in the consumer")
+        projection = mapping if projection is None else \
+            [(name, mapping[ordinal][1]) for name, ordinal in projection]
+        node = node.children[0]
+    if not isinstance(node, pn.AggregateNode):
+        raise IncrementalUnsupported(
+            "a standing query must be a top-level aggregation "
+            f"(got {type(node).__name__}) — the update/merge split is "
+            "the incremental operator, so the aggregate must be the "
+            "outermost node")
+    if node.mode != "complete":
+        raise IncrementalUnsupported(
+            f"standing queries fold complete-mode aggregates, not "
+            f"{node.mode!r} (partial/final splits belong to the batch "
+            f"planner)")
+    for call in node.aggs:
+        if getattr(call.fn, "distinct", False):
+            raise IncrementalUnsupported(
+                f"aggregate {call.name!r} is DISTINCT: its update "
+                "partials are not mergeable across micro-batches")
+    child = node.children[0]
+    streams = streaming_sources(child)
+    if not streams:
+        raise IncrementalUnsupported(
+            "the plan reads no streaming table (create one with "
+            "Session.create_streaming_table) — nothing would ever "
+            "arrive to fold")
+    if len(streams) > 1:
+        raise IncrementalUnsupported(
+            f"the plan reads {len(streams)} streaming tables; "
+            "incremental folding supports exactly one streaming fact "
+            "side (dimension sides must be non-streaming)")
+    for n in pn.walk(child):
+        # a runtime-state holder (df.cache()) under the delta subtree
+        # would replay its FIRST materialization on every fold
+        if type(n).__name__ == "CacheNode":
+            raise IncrementalUnsupported(
+                "the delta subtree contains a CacheNode: its one-shot "
+                "materialization cannot observe per-fold deltas")
+    return IncrementalInfo(aggregate=node, child=child,
+                           stream_source=streams[0],
+                           projection=projection)
+
+
+def substitute_source(node: pn.PlanNode, old: pn.DataSource,
+                      new: pn.DataSource) -> pn.PlanNode:
+    """The delta subplan: ``node`` with every scan of ``old`` replaced
+    by a scan of ``new``. Untouched subtrees (the dimension sides) are
+    SHARED, not copied — their exec-side materializations (broadcast
+    builds) survive across folds by identity."""
+    if isinstance(node, pn.ScanNode) and node.source is old:
+        return pn.ScanNode(new)
+    kids = [substitute_source(c, old, new) for c in node.children]
+    if all(k is c for k, c in zip(kids, node.children)):
+        return node
+    return node.with_children(kids)

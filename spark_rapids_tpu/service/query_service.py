@@ -75,7 +75,16 @@ class QueryService:
         # charge the admission budget so cached data and inflight
         # queries never overcommit HBM between them.
         self.cache = CacheManager(self.conf)
-        self.admission.extra_bytes_fn = self.cache.device_resident_bytes
+        # streaming ingestion & standing queries (service/streaming):
+        # long-lived aggregate state is device-resident between folds,
+        # so it charges the admission budget alongside cached fragments
+        from spark_rapids_tpu.service.streaming.manager import \
+            StreamingManager
+
+        self.streaming = StreamingManager(self.conf)
+        self.admission.extra_bytes_fn = lambda: (
+            self.cache.device_resident_bytes()
+            + self.streaming.device_resident_bytes())
         #: result-cache key -> live leader Query (single-flight)
         self._result_leaders: Dict = {}
         # cross-tenant micro-batching (service/batching): the ladder
@@ -255,6 +264,42 @@ class QueryService:
                 "footprint": footprint, "out_of_core": out_of_core,
                 "charge": charge, "pending": pending, "served": served}
 
+    # -- streaming front door (service/streaming) -------------------------
+
+    def ingest(self, table, data, validity: Optional[dict] = None
+               ) -> int:
+        """Append one micro-batch to a streaming table (a
+        StreamTableSource or the name of one registered as a temp view
+        on this service's Session) and fold it into every standing
+        query over it; returns the rows landed."""
+        return self.streaming.ingest(self._resolve_stream(table), data,
+                                     validity)
+
+    def register_standing(self, df_or_plan, tenant: str = "default",
+                          **kwargs):
+        """Register a continuous aggregation over a streaming table;
+        returns a StandingQuery handle (results()/cancel()). See
+        StreamingManager.register_standing for the knob set."""
+        return self.streaming.register_standing(df_or_plan, tenant,
+                                                **kwargs)
+
+    def _resolve_stream(self, table):
+        from spark_rapids_tpu.plan.incremental import \
+            is_streaming_source
+
+        if isinstance(table, str):
+            if self.session is None:
+                raise ValueError(
+                    f"cannot resolve streaming table {table!r}: the "
+                    "service has no Session — pass the "
+                    "StreamTableSource itself")
+            table = self.session.streaming_table(table)
+        if not is_streaming_source(table):
+            raise ValueError(
+                f"{type(table).__name__} is not a streaming table — "
+                "create one with Session.create_streaming_table")
+        return table
+
     # -- warmup (ROADMAP item 2: AOT-warm the progcache at startup) -------
 
     def register_template(self, df_or_plan, name: Optional[str] = None):
@@ -398,6 +443,7 @@ class QueryService:
                 retry=_retry.stats(),
                 batching=self.batcher.stats(),
                 cache=self.cache.stats(),
+                streaming=self.streaming.stats(),
                 queue_depth=self.admission.queue_depth(),
                 running=running,
                 admitted_inflight=len(self.admission.inflight),
@@ -435,6 +481,10 @@ class QueryService:
             for q in list(self._queries.values()):
                 if not q.terminal:
                     self._finalize_locked(q, QueryState.CANCELLED)
+        # standing queries first: their cancel teardown releases the
+        # owner-tagged streaming state through the catalog, and no fold
+        # can be in flight once ingest starts refusing work
+        self.streaming.shutdown()
         # workers joined and every query finalized: no capture or serve
         # can still be touching an entry's spillable handles
         self.cache.close()

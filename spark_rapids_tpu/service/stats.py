@@ -107,6 +107,10 @@ class ServiceStats:
     #: per-tier hits/misses/bytes, single-flight followers, publishes,
     #: OOM-degraded captures, evictions
     cache: dict = dataclasses.field(default_factory=dict)
+    #: streaming ingestion & standing queries (service/streaming):
+    #: appends/folds/late-row counters, live standing-query registry,
+    #: state bytes (device-resident share), watermark lag
+    streaming: dict = dataclasses.field(default_factory=dict)
 
     @property
     def progcache_hit_rate(self) -> float:

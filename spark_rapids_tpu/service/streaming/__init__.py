@@ -1,0 +1,23 @@
+"""Streaming ingestion & incremental queries (PR 14).
+
+Micro-batch appends land as versioned deltas on a StreamTableSource
+(bumping the table's snapshot version so every cached result over the
+old contents invalidates for free); standing queries fold each delta
+into long-lived partial-aggregate state via the update/merge seam in
+execs/aggregate — one update launch + one merge launch per fold,
+O(batch) regardless of how much history the table holds. See
+docs/streaming.md.
+"""
+from spark_rapids_tpu.service.streaming.manager import StreamingManager
+from spark_rapids_tpu.service.streaming.source import (DeltaBatchSource,
+                                                       StreamTableSource)
+from spark_rapids_tpu.service.streaming.standing import (
+    StandingQuery, StreamingStateOverflow)
+from spark_rapids_tpu.service.streaming.state import \
+    StreamingAggregateState
+
+__all__ = [
+    "StreamingManager", "StreamTableSource", "DeltaBatchSource",
+    "StandingQuery", "StreamingAggregateState",
+    "StreamingStateOverflow",
+]

@@ -1,0 +1,196 @@
+"""StreamingManager: the service-side registry of standing queries.
+
+One per QueryService, like the CacheManager. It owns the source ->
+standing-queries index, routes every ``ingest()`` append to the
+standing queries folding that table, catches a new registration up on
+deltas that landed before it existed, and aggregates the streaming
+block for ServiceStats. Folding itself lives in StandingQuery /
+StreamingAggregateState — the manager only decides WHO folds.
+
+Delivery contract: ``ingest`` returns after every live standing query
+over the table has folded the delta (synchronous, in-order — the
+per-query sequence cursor in ``StandingQuery.drain`` makes concurrent
+ingests safe without a manager-wide fold lock). A standing query that
+fails folds alone; the append itself and other standing queries over
+the same table are unaffected.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.plan import incremental
+from spark_rapids_tpu.service.streaming import stats as _stats
+from spark_rapids_tpu.service.streaming.standing import StandingQuery
+from spark_rapids_tpu.utils import lockorder
+
+#: terminal standing queries kept in the registry for stats history
+FINISHED_RETENTION = 64
+
+
+class StreamingManager:
+    def __init__(self, conf):
+        self.conf = conf
+        self._lock = lockorder.make_lock("service.streaming.state")
+        self._standing: Dict[int, StandingQuery] = {}
+        #: id(source) -> standing queries folding that table
+        self._by_source: Dict[int, List[StandingQuery]] = {}
+        self._finished_order: List[int] = []
+        self._shutdown = False
+
+    # -- registration ------------------------------------------------------
+
+    def register_standing(self, df_or_plan, tenant: str = "default",
+                          name: Optional[str] = None,
+                          event_time_col: Optional[str] = None,
+                          window_col: Optional[str] = None,
+                          watermark_ms: Optional[int] = None,
+                          late_policy: Optional[str] = None,
+                          max_state_bytes: Optional[int] = None,
+                          deadline: Optional[float] = None
+                          ) -> StandingQuery:
+        """Validate + register a continuous query; returns its handle
+        after catching up on every delta already appended to the table
+        (one fold per pre-existing micro-batch, so registration cost is
+        O(existing data) exactly once and O(batch) forever after)."""
+        if not self.conf.get(cfg.STREAMING_ENABLED):
+            raise RuntimeError(
+                "streaming is disabled "
+                f"({cfg.STREAMING_ENABLED.key}=false)")
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("QueryService is shut down")
+        plan = getattr(df_or_plan, "_plan", df_or_plan)
+        info = incremental.analyze(plan)
+        if watermark_ms is None:
+            watermark_ms = self.conf.get(cfg.STREAMING_WATERMARK_MS)
+        if late_policy is None:
+            late_policy = self.conf.get(cfg.STREAMING_LATE_POLICY)
+        if max_state_bytes is None:
+            max_state_bytes = self.conf.get(
+                cfg.STREAMING_MAX_STATE_BYTES)
+        sq = StandingQuery(tenant, plan, info, self.conf, name=name,
+                           event_time_col=event_time_col,
+                           window_col=window_col,
+                           watermark_ms=watermark_ms,
+                           late_policy=late_policy,
+                           max_state_bytes=max_state_bytes,
+                           deadline=deadline)
+        with self._lock:
+            if self._shutdown:
+                sq.cancel()
+                raise RuntimeError("QueryService is shut down")
+            self._standing[sq.query_id] = sq
+            self._by_source.setdefault(id(sq.source), []).append(sq)
+        _stats.bump("standing_registered")
+        # catch-up: deltas appended before registration fold now; any
+        # append racing this call is folded exactly once — either by
+        # its own ingest (the index is already published) or here (the
+        # sequence cursor dedups)
+        sq.drain()
+        return sq
+
+    # -- ingestion ---------------------------------------------------------
+
+    def ingest(self, source, data, validity: Optional[dict] = None
+               ) -> int:
+        """Append one micro-batch to ``source`` and fold it into every
+        live standing query over that table; returns the rows landed.
+        The append itself (and its snapshot bump) happens even with no
+        standing queries registered — batch queries still see it."""
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("QueryService is shut down")
+        delta = source.append(data, validity)
+        with self._lock:
+            targets = [sq for sq in self._by_source.get(id(source), ())
+                       if not sq.terminal]
+        for sq in targets:
+            sq.drain()
+            if sq.terminal:
+                self._retire(sq)
+        return delta.num_rows
+
+    def _retire(self, sq: StandingQuery) -> None:
+        """Move a terminal standing query out of the source index (so
+        future ingests stop considering it) while keeping it in the
+        bounded registry for stats history."""
+        with self._lock:
+            lst = self._by_source.get(id(sq.source))
+            if lst is not None:
+                self._by_source[id(sq.source)] = \
+                    [s for s in lst if s is not sq]
+                if not self._by_source[id(sq.source)]:
+                    del self._by_source[id(sq.source)]
+            if sq.query_id not in self._finished_order:
+                self._finished_order.append(sq.query_id)
+            while len(self._finished_order) > FINISHED_RETENTION:
+                self._standing.pop(self._finished_order.pop(0), None)
+
+    # -- lookup / cancel ---------------------------------------------------
+
+    def standing(self, standing_id: int) -> Optional[StandingQuery]:
+        with self._lock:
+            return self._standing.get(standing_id)
+
+    def list_standing(self) -> List[StandingQuery]:
+        with self._lock:
+            return list(self._standing.values())
+
+    def cancel_standing(self, standing_id: int) -> bool:
+        sq = self.standing(standing_id)
+        if sq is None:
+            return False
+        ok = sq.cancel()
+        self._retire(sq)
+        return ok
+
+    # -- accounting --------------------------------------------------------
+
+    def standing_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._standing.values()
+                       if not s.terminal)
+
+    def device_resident_bytes(self) -> int:
+        """Streaming state currently sitting in HBM — charged against
+        the admission budget next to the cache's device-resident
+        fragments, so standing-query state and inflight batch queries
+        never overcommit the device between them."""
+        with self._lock:
+            live = [s for s in self._standing.values()
+                    if not s.terminal]
+        return sum(s.agg_state.device_resident_bytes() for s in live)
+
+    def stats(self) -> dict:
+        """The ServiceStats ``streaming`` block: process counters plus
+        this service's standing-query registry."""
+        with self._lock:
+            sqs = list(self._standing.values())
+        live = [s for s in sqs if not s.terminal]
+        out = dict(_stats.snapshot())
+        out.update({
+            "standing_live": len(live),
+            "state_bytes": sum(s.agg_state.state_bytes()
+                               for s in live),
+            "device_resident_bytes": sum(
+                s.agg_state.device_resident_bytes() for s in live),
+            "watermark_lag_ms": max(
+                (s.watermark_lag_ms for s in live), default=0),
+            "standing": [s.info() for s in sqs],
+        })
+        return out
+
+    # -- teardown ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Cancel every live standing query (releasing its catalog
+        state through the normal teardown) and refuse future work."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            sqs = list(self._standing.values())
+        for sq in sqs:
+            if not sq.terminal:
+                sq.cancel()

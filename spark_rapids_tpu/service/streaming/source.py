@@ -1,0 +1,222 @@
+"""Streaming table sources: versioned micro-batch deltas.
+
+``StreamTableSource`` is the append surface. Each ``append()`` lands a
+validated micro-batch delta and bumps the table's snapshot version
+(service/cache/snapshots) — so it is the third snapshot writer after
+view replacement and file mtime changes, and every cached result or
+fragment computed over the old contents misses for free. ``read_host``
+returns the concatenation of ALL deltas: a batch query over the table
+sees exactly what a standing query has folded, which is what makes the
+batch engine the oracle for incremental-vs-batch equivalence.
+
+``DeltaBatchSource`` is the mutable leaf the per-fold exec tree reads:
+the streaming state points it at one micro-batch, drives the tree, and
+moves on — the fold's cost tracks the delta, never the table.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.plan.nodes import DataSource
+from spark_rapids_tpu.utils import lockorder
+
+#: process-global uid stream — cache identities must differ across two
+#: same-named tables in different Sessions (same reasoning as the
+#: service's global query ids)
+_STREAM_UIDS = itertools.count(1)
+
+
+def normalize_batch(data, schema: Schema,
+                    validity: Optional[dict] = None
+                    ) -> Tuple[Dict[str, np.ndarray],
+                               Dict[str, np.ndarray], int]:
+    """Validate one micro-batch against ``schema``: every column
+    present, equal lengths, numpy-backed. Accepts a dict of columns or
+    a pandas DataFrame (NaN/None -> validity mask, like
+    Session.create_dataframe). Returns (data, validity, n_rows)."""
+    import pandas as pd
+
+    validity = dict(validity or {})
+    if isinstance(data, pd.DataFrame):
+        cols: Dict[str, np.ndarray] = {}
+        for name in data.columns:
+            s = data[name]
+            if s.dtype == object or str(s.dtype) == "string":
+                cols[name] = np.array(
+                    [None if v is None or (isinstance(v, float) and
+                                           np.isnan(v)) else v
+                     for v in s], dtype=object)
+            else:
+                isna = s.isna().to_numpy(dtype=bool)
+                cols[name] = s.fillna(0).to_numpy()
+                if isna.any():
+                    validity[name] = ~isna
+        data = cols
+    missing = [n for n in schema.names if n not in data]
+    if missing:
+        raise ValueError(f"append is missing columns {missing}; the "
+                         f"table schema is {list(schema.names)}")
+    out: Dict[str, np.ndarray] = {}
+    n = None
+    for name, t in zip(schema.names, schema.types):
+        arr = data[name]
+        if t is dt.STRING:
+            arr = np.asarray(arr, dtype=object)
+        else:
+            arr = np.asarray(arr)
+        if n is None:
+            n = len(arr)
+        elif len(arr) != n:
+            raise ValueError(
+                f"ragged append: column {name!r} has {len(arr)} rows, "
+                f"expected {n}")
+        out[name] = arr
+    vout = {k: np.asarray(v, dtype=bool) for k, v in validity.items()
+            if k in out}
+    return out, vout, int(n or 0)
+
+
+def _empty_columns(schema: Schema) -> Dict[str, np.ndarray]:
+    return {name: np.empty(0, dtype=object) if t is dt.STRING
+            else np.zeros(0, dtype=t.np_dtype)
+            for name, t in zip(schema.names, schema.types)}
+
+
+def _concat_deltas(schema: Schema, deltas) -> tuple:
+    """(data, validity) over a delta list — the all-true filler makes
+    per-delta validity compose with deltas that had none."""
+    if not deltas:
+        return _empty_columns(schema), {}
+    if len(deltas) == 1:
+        d = deltas[0]
+        return dict(d.data), dict(d.validity)
+    data: Dict[str, np.ndarray] = {}
+    validity: Dict[str, np.ndarray] = {}
+    for name in schema.names:
+        data[name] = np.concatenate([d.data[name] for d in deltas])
+        if any(name in d.validity for d in deltas):
+            validity[name] = np.concatenate(
+                [d.validity.get(name,
+                                np.ones(d.num_rows, dtype=bool))
+                 for d in deltas])
+    return data, validity
+
+
+class _Delta:
+    __slots__ = ("seq", "data", "validity", "num_rows")
+
+    def __init__(self, seq: int, data, validity, num_rows: int):
+        self.seq = seq
+        self.data = data
+        self.validity = validity
+        self.num_rows = num_rows
+
+
+class StreamTableSource(DataSource):
+    """Appendable host table. Thread-safe: appends and reads copy the
+    delta list under the source lock and do the heavy concatenation
+    outside it."""
+
+    #: the marker plan/incremental.py recognizes streaming scans by
+    is_streaming = True
+
+    def __init__(self, name: str, schema: Schema):
+        self.name = name
+        self._schema = schema
+        self._uid = next(_STREAM_UIDS)
+        self._deltas: list = []
+        self._total_rows = 0
+        self._lock = lockorder.make_lock("service.streaming.source")
+
+    # -- DataSource ----------------------------------------------------
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def read_host(self):
+        with self._lock:
+            deltas = list(self._deltas)
+        return _concat_deltas(self._schema, deltas)
+
+    def estimated_row_count(self):
+        with self._lock:
+            return self._total_rows
+
+    # -- append surface ------------------------------------------------
+
+    def append(self, data, validity: Optional[dict] = None) -> _Delta:
+        """Land one micro-batch; returns its delta record. Bumping the
+        snapshot version HERE (not in the service) means even a bare
+        source append — no service, no standing queries — invalidates
+        every cached result computed over the old contents."""
+        from spark_rapids_tpu.service.cache import snapshots
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        ndata, nvalidity, n = normalize_batch(data, self._schema,
+                                              validity)
+        with self._lock:
+            delta = _Delta(len(self._deltas), ndata, nvalidity, n)
+            self._deltas.append(delta)
+            self._total_rows += n
+        snapshots.bump(self)
+        _stats.bump("appends")
+        _stats.bump("rows_appended", n)
+        return delta
+
+    @property
+    def num_appends(self) -> int:
+        with self._lock:
+            return len(self._deltas)
+
+    @property
+    def total_rows(self) -> int:
+        with self._lock:
+            return self._total_rows
+
+    def deltas_from(self, seq: int) -> list:
+        """Deltas with sequence >= ``seq`` (registration catch-up)."""
+        with self._lock:
+            return [d for d in self._deltas if d.seq >= seq]
+
+    # -- semantic-cache protocol (service/cache/snapshots) -------------
+
+    def cache_identity(self):
+        return ("stream-table", self.name, self._uid)
+
+    def cache_version(self):
+        with self._lock:
+            return len(self._deltas)
+
+
+class DeltaBatchSource(DataSource):
+    """The per-fold leaf: holds exactly one micro-batch at a time.
+    Deliberately NOT cache-keyable (no cache_identity): a fold's exec
+    tree must never be confused with a cacheable batch plan."""
+
+    def __init__(self, schema: Schema):
+        self._schema = schema
+        self._data = _empty_columns(schema)
+        self._validity: dict = {}
+        self._rows = 0
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def set_delta(self, data, validity, num_rows: int) -> None:
+        self._data = data
+        self._validity = validity
+        self._rows = num_rows
+
+    def clear(self) -> None:
+        self.set_delta(_empty_columns(self._schema), {}, 0)
+
+    def read_host(self):
+        return self._data, self._validity
+
+    def estimated_row_count(self):
+        return self._rows

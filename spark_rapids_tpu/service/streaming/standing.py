@@ -1,0 +1,338 @@
+"""StandingQuery: register once, fold forever, emit on demand.
+
+Lifecycle::
+
+    REGISTERED --fold--> FOLDING --ok--> EMITTING --fold--> FOLDING ...
+         |                  |                |
+         +---- cancel ------+--- cancel ----+---> CANCELLED
+         +---- error/deadline/state-overflow ---> FAILED
+
+Terminal transitions run the SAME teardown as a cancelled batch query
+(PR 2): close the running state and ``remove_owner`` the catalog tag,
+so nothing a fold ever registered — running partials, delta-side
+shuffle blocks, delta-side broadcast builds — can outlive the query.
+The leak fence asserts ``owner_refcounts(tag)`` is empty afterwards.
+
+Watermarks: with an ``event_time_col`` (int milliseconds in the stream
+schema) the query keeps ``wm = max(event_time seen) - watermark_ms``,
+monotonically non-decreasing. A row arriving at-or-below the current
+watermark is LATE: policy ``merge`` folds it through the same merge
+specs as on-time rows (aggregates self-correct on the next emit),
+``drop`` discards it host-side before the update launch. With a
+``window_col`` (a grouping column holding each window's END in
+milliseconds), ``results(final_only=True)`` returns only windows whose
+end is at-or-below the watermark — finalized, no in-flight data can
+still move them on-time; only late-merge can, which is the documented
+late-data contract.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_tpu.plan import incremental
+from spark_rapids_tpu.service.types import (DeadlineExceeded,
+                                            QueryCancelled)
+from spark_rapids_tpu.utils import lockorder
+
+_STANDING_IDS = itertools.count(1)
+
+LATE_POLICIES = ("merge", "drop")
+
+#: lifecycle states (string-valued like QueryState, but a standing
+#: query has no QUEUED/ADMITTED — folds are service-internal pushes,
+#: not admitted submissions)
+REGISTERED = "REGISTERED"
+FOLDING = "FOLDING"
+EMITTING = "EMITTING"
+CANCELLED = "CANCELLED"
+FAILED = "FAILED"
+TERMINAL = frozenset({CANCELLED, FAILED})
+
+
+class StandingCancelled(RuntimeError):
+    """Internal fold-abort signal raised by the cancel check."""
+
+
+class StreamingStateOverflow(RuntimeError):
+    """The running state outgrew rapids.tpu.streaming.maxStateBytes;
+    the standing query FAILED and its state was torn down."""
+
+
+class StandingQuery:
+    """One registered continuous query over one streaming table. The
+    handle the service returns from ``register_standing`` — callers
+    poll ``state``, read ``results()``, and ``cancel()``."""
+
+    def __init__(self, tenant: str, plan,
+                 info: incremental.IncrementalInfo, conf, *,
+                 name: Optional[str] = None,
+                 event_time_col: Optional[str] = None,
+                 window_col: Optional[str] = None,
+                 watermark_ms: int = 0, late_policy: str = "merge",
+                 max_state_bytes: int = 0,
+                 deadline: Optional[float] = None):
+        from spark_rapids_tpu.service.streaming.state import \
+            StreamingAggregateState
+
+        self.query_id = next(_STANDING_IDS)
+        self.name = name or f"standing{self.query_id}"
+        self.tenant = tenant
+        self.plan = plan
+        self.source = info.stream_source
+        stream_schema = info.stream_source.schema()
+        if event_time_col is not None and \
+                event_time_col not in stream_schema.names:
+            raise ValueError(
+                f"event_time_col {event_time_col!r} is not a column of "
+                f"the streaming table ({list(stream_schema.names)})")
+        out_names = info.output_names()
+        if window_col is not None and window_col not in out_names:
+            raise ValueError(
+                f"window_col {window_col!r} is not an output column "
+                f"({list(out_names)}) — it must be a grouping column "
+                f"holding each window's end in milliseconds")
+        if late_policy not in LATE_POLICIES:
+            raise ValueError(f"late_policy must be one of "
+                             f"{LATE_POLICIES}, got {late_policy!r}")
+        self.event_time_col = event_time_col
+        self.window_col = window_col
+        self.watermark_ms = int(watermark_ms)
+        self.late_policy = late_policy
+        self.max_state_bytes = int(max_state_bytes)
+        self.deadline_s = deadline
+        self.registered_at = time.perf_counter()
+        self.agg_state = StreamingAggregateState(info, conf,
+                                                self.owner_tag)
+        self.state = REGISTERED
+        self.error: Optional[BaseException] = None
+        self._cancel_requested = False
+        self._next_seq = 0
+        self._lock = lockorder.make_rlock("service.streaming.standing")
+        #: event-time watermark in ms (None until the first timed row)
+        self.watermark: Optional[int] = None
+        self._max_event: Optional[int] = None
+        self.late_rows_remerged = 0
+        self.late_rows_dropped = 0
+        self.last_fold_wall_s = 0.0
+        self.last_fold_dispatches = 0.0
+        self.fold_dispatches = 0.0
+        self.retry: dict = {}
+        #: test seam: called at every fold step boundary (the
+        #: deterministic way to exercise cancel-mid-fold)
+        self._fold_hook = None
+
+    @property
+    def owner_tag(self):
+        return ("svc-stream", self.query_id)
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL
+
+    @property
+    def folds(self) -> int:
+        return self.agg_state.folds
+
+    @property
+    def rows_folded(self) -> int:
+        return self.agg_state.rows_folded
+
+    # -- folding -------------------------------------------------------
+
+    def drain(self) -> int:
+        """Fold every not-yet-folded delta of the source, in append
+        order; returns the number of deltas folded. Idempotent and
+        safe under concurrent callers (ingest + registration catch-up):
+        the per-query lock serializes, the sequence cursor dedups."""
+        n = 0
+        with self._lock:
+            while not self.terminal:
+                if self.deadline_s is not None and \
+                        time.perf_counter() - self.registered_at > \
+                        self.deadline_s:
+                    self._teardown(FAILED, DeadlineExceeded(
+                        f"standing query {self.query_id} exceeded its "
+                        f"{self.deadline_s:.3f}s deadline"))
+                    break
+                pending = self.source.deltas_from(self._next_seq)
+                if not pending:
+                    break
+                for delta in pending:
+                    if self.terminal:
+                        break
+                    self._fold_one(delta)
+                    n += 1
+        return n
+
+    def _cancel_check(self) -> None:
+        if self._fold_hook is not None:
+            self._fold_hook()
+        if self._cancel_requested:
+            raise StandingCancelled()
+
+    def _fold_one(self, delta) -> None:
+        """One micro-batch: late-data handling host-side, then the
+        update+merge launches. Caller holds the lock."""
+        from spark_rapids_tpu.service.streaming import stats as _stats
+        from spark_rapids_tpu.utils import dispatch as _disp
+
+        self._next_seq = delta.seq + 1
+        data, validity, n = delta.data, delta.validity, delta.num_rows
+        self.state = FOLDING
+        t0 = time.perf_counter()
+        pre = _disp.snapshot() if _disp.installed() else None
+        try:
+            self._cancel_check()
+            if self.event_time_col is not None and n:
+                data, validity, n = self._handle_late(data, validity, n)
+            self.agg_state.fold(data, validity, n,
+                                cancel_check=self._cancel_check)
+            if self.max_state_bytes and \
+                    self.agg_state.state_bytes() > self.max_state_bytes:
+                raise StreamingStateOverflow(
+                    f"standing query {self.query_id} state "
+                    f"({self.agg_state.state_bytes()} bytes) exceeds "
+                    f"rapids.tpu.streaming.maxStateBytes="
+                    f"{self.max_state_bytes} — raise the bound or "
+                    f"window the aggregation")
+        except StandingCancelled:
+            self._teardown(CANCELLED)
+            return
+        except BaseException as e:
+            # the standing query dies; the ingest that fed it must not
+            # (other standing queries and the append itself are fine)
+            self._teardown(FAILED, e)
+            return
+        finally:
+            self.last_fold_wall_s = time.perf_counter() - t0
+            if pre is not None:
+                d = float(_disp.delta(pre)["dispatch_count"])
+                self.last_fold_dispatches = d
+                self.fold_dispatches += d
+                _stats.bump("fold_dispatches", int(d))
+        _stats.bump("folds")
+        _stats.bump("rows_folded", n)
+        self.state = EMITTING
+
+    def _handle_late(self, data, validity, n):
+        """Split one arriving batch against the CURRENT watermark, then
+        advance it. Late rows re-merge (policy merge) or are filtered
+        host-side (policy drop); either way the watermark advances from
+        the batch max so out-of-order arrival cannot retreat it."""
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        ev = np.asarray(data[self.event_time_col]).astype(np.int64)
+        wm = self.watermark
+        if wm is not None:
+            late = ev <= wm
+            n_late = int(late.sum())
+            if n_late:
+                if self.late_policy == "drop":
+                    keep = ~late
+                    data = {k: v[keep] for k, v in data.items()}
+                    validity = {k: v[keep]
+                                for k, v in validity.items()}
+                    n = int(keep.sum())
+                    self.late_rows_dropped += n_late
+                    _stats.bump("late_rows_dropped", n_late)
+                else:
+                    self.late_rows_remerged += n_late
+                    _stats.bump("late_rows_remerged", n_late)
+        if len(ev):
+            batch_max = int(ev.max())
+            self._max_event = batch_max if self._max_event is None \
+                else max(self._max_event, batch_max)
+            cand = self._max_event - self.watermark_ms
+            self.watermark = cand if wm is None else max(wm, cand)
+        return data, validity, n
+
+    @property
+    def watermark_lag_ms(self) -> int:
+        """How far the watermark trails the newest event seen (>= the
+        configured delay; grows only if the watermark is held back)."""
+        if self._max_event is None or self.watermark is None:
+            return 0
+        return self._max_event - self.watermark
+
+    # -- emission ------------------------------------------------------
+
+    def results(self, final_only: bool = False):
+        """Current aggregate as a pandas frame. ``final_only`` keeps
+        only windows whose end is at-or-below the watermark (requires
+        ``window_col``); without a watermark yet, nothing is final."""
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        with self._lock:
+            if self.state == CANCELLED:
+                raise QueryCancelled(
+                    f"standing query {self.query_id} was cancelled")
+            if self.state == FAILED:
+                raise self.error or RuntimeError(
+                    f"standing query {self.query_id} failed")
+            frame = self.agg_state.emit()
+            _stats.bump("emits")
+            if final_only:
+                if self.window_col is None:
+                    raise ValueError(
+                        "results(final_only=True) requires the query "
+                        "to be registered with window_col")
+                if self.watermark is None:
+                    return frame.iloc[0:0]
+                return frame[frame[self.window_col] <=
+                             self.watermark].reset_index(drop=True)
+            return frame
+
+    # -- cancel / teardown ---------------------------------------------
+
+    def cancel(self) -> bool:
+        """Request cancellation; returns True when the query is (or
+        already was) torn down on return. A fold in flight aborts at
+        its next step boundary — this call then blocks briefly on the
+        query lock until that teardown completes, so the caller never
+        observes a cancelled query still holding catalog state."""
+        self._cancel_requested = True
+        with self._lock:
+            if not self.terminal:
+                self._teardown(CANCELLED)
+            return self.state == CANCELLED
+
+    def _teardown(self, state: str,
+                  error: Optional[BaseException] = None) -> None:
+        """Idempotent terminal transition: release EVERYTHING the query
+        holds (running state + all owner-tagged catalog buffers + the
+        per-owner retry ledger)."""
+        from spark_rapids_tpu.memory import retry as _retry
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        if self.terminal:
+            return
+        self.state = state
+        self.error = error
+        self.agg_state.close()
+        self.retry = _retry.pop_owner_stats(self.owner_tag)
+        _stats.bump("standing_cancelled" if state == CANCELLED
+                    else "standing_failed")
+
+    # -- observability -------------------------------------------------
+
+    def info(self) -> dict:
+        return {
+            "standing_id": self.query_id,
+            "name": self.name,
+            "tenant": self.tenant,
+            "state": self.state,
+            "folds": self.folds,
+            "rows_folded": self.rows_folded,
+            "state_bytes": self.agg_state.state_bytes(),
+            "watermark": self.watermark,
+            "watermark_lag_ms": self.watermark_lag_ms,
+            "late_rows_remerged": self.late_rows_remerged,
+            "late_rows_dropped": self.late_rows_dropped,
+            "last_fold_wall_s": round(self.last_fold_wall_s, 6),
+            "last_fold_dispatches": self.last_fold_dispatches,
+            "fold_dispatches": self.fold_dispatches,
+        }

@@ -1,0 +1,45 @@
+"""Process-global streaming counters.
+
+Per-service numbers (live standing-query count, per-query watermark
+lag) come from StreamingManager.stats(); THESE counters are process
+totals in the style of memory/retry's, so the benchmark runner can
+bracket any run with ``snapshot()``/``delta()`` and emit a
+``streaming`` block next to its ``memory`` block without holding a
+service reference.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from spark_rapids_tpu.utils import lockorder
+
+_lock = lockorder.make_lock("service.streaming.stats")
+
+_KEYS = ("standing_registered", "standing_cancelled", "standing_failed",
+         "appends", "rows_appended", "folds", "rows_folded",
+         "late_rows_remerged", "late_rows_dropped", "fold_dispatches",
+         "emits")
+
+_counters: Dict[str, int] = {k: 0 for k in _KEYS}
+
+
+def bump(key: str, n: int = 1) -> None:
+    with _lock:
+        _counters[key] += n
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def delta(before: Dict[str, int]) -> Dict[str, int]:
+    now = snapshot()
+    return {k: now[k] - before.get(k, 0) for k in _KEYS}
+
+
+def reset() -> None:
+    """Test isolation hook."""
+    with _lock:
+        for k in _KEYS:
+            _counters[k] = 0

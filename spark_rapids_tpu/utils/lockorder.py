@@ -45,6 +45,12 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # -- query/service layer (outermost: these orchestrate everything) --
     "api.session.serviceInit": 10,
     "service.query": 20,              # QueryService RLock + done/work CVs
+    # -- streaming ingestion (service/streaming): the manager registry
+    # is taken under the service lock (stats) and holds the per-query
+    # fold lock, which in turn runs whole exec subtrees (planBarrier,
+    # >=30) and registers state in the catalog (100) ------------------
+    "service.streaming.state": 24,
+    "service.streaming.standing": 26, # per-standing-query fold lock
     # -- materialize-once stage barriers: held across whole child
     # subtree execution BY DESIGN (the lock is the stage boundary).
     # These four form the "planBarrier" GROUP (see GROUPS below): an
@@ -85,6 +91,10 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "expressions.fusedCache": 86,
     # -- io ------------------------------------------------------------
     "io.filesrc.splits": 90,
+    # -- streaming table deltas: appends hold this while bumping the
+    # snapshot counter (158); scans take it briefly to copy the delta
+    # list before concatenating outside the lock ----------------------
+    "service.streaming.source": 92,
     # -- memory subsystem ----------------------------------------------
     "memory.catalog.state": 100,
     "memory.catalog.global": 102,
@@ -109,6 +119,7 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "memory.faultInjection": 168,
     "utils.dispatch.stage": 172,
     "parallel.spmd.fallbacks": 176,  # fallback-reason counters
+    "service.streaming.stats": 180,  # process-global fold counters
     "native.init": 184,
     "shims.init": 188,
     "config.registry": 192,

@@ -477,3 +477,43 @@ def test_cancelled_leader_promotes_follower():
     finally:
         src.gate.set()
         svc.shutdown()
+
+
+# -- (10) a streaming append is a snapshot event (PR 14 satellite) ----------
+
+
+def test_streaming_append_is_a_snapshot_event():
+    """Appending a micro-batch to a streaming table bumps its snapshot
+    version: a dashboard result cached BEFORE the append must never be
+    served after it — the post-append submit recomputes over old+new
+    rows. Identical resubmits between appends still hit."""
+    s = Session()
+    schema = Schema(["k", "v"], [dt.INT64, dt.FLOAT64])
+    src = s.create_streaming_table("t", schema)
+    first = _tbl(seed=3, n=1000)
+    src.append(first)
+    q = s.sql(AGG_SQL)
+    svc = QueryService(s.conf, session=s)
+    try:
+        oracle1 = first.groupby("k").agg(
+            sv=("v", "sum"), n=("v", "size")).reset_index()
+        assert_frames_equal(oracle1, svc.submit(q).result(timeout=300))
+        assert_frames_equal(oracle1, svc.submit(q).result(timeout=300))
+        st = svc.stats().cache
+        assert st["result"]["hits"] == 1, \
+            "identical resubmit with no append in between must hit"
+        extra = _tbl(seed=4, n=500)
+        svc.ingest(src, extra)   # the service-side append surface
+        both = pd.concat([first, extra], ignore_index=True)
+        assert_frames_equal(
+            both.groupby("k").agg(sv=("v", "sum"),
+                                  n=("v", "size")).reset_index(),
+            svc.submit(q).result(timeout=300))
+        st = svc.stats().cache
+        assert st["result"]["hits"] == 1, \
+            "an appended table must never serve the pre-append frame"
+        # and the new version is itself cacheable at the new key
+        svc.submit(q).result(timeout=300)
+        assert svc.stats().cache["result"]["hits"] == 2
+    finally:
+        svc.shutdown()
