@@ -1,0 +1,241 @@
+"""Distributed chaos fence: lineage fault recovery under injected
+transport and process faults (CLI twin of tests/test_fault_recovery.py;
+the OOM sibling is scripts/chaos_check.py).
+
+Two phases over the multi-process cluster runtime
+(``rapids.tpu.cluster.*``), both on CPU:
+
+  1. survive : a join+groupby+order-by across 3 worker processes runs
+               with the deterministic injector armed — a worker is
+               SIGKILLed before its Nth task (its earlier registered
+               outputs then fail reduce-side), one transport connection
+               drops (absorbed by the reconnect/backoff budget, costing
+               NO stage), and one chunk frame comes back truncated
+               (escalating to a fetch failure + stage retry). The query
+               must finish BIT-EXACT against the single-process oracle
+               with nonzero fetch_failures / maps_rerun /
+               workers_respawned / stage_retries recovery counters.
+  2. exhaust : every remote chunk truncated, placement pinned off the
+               reader's executor, ``maxStageRetries=1`` — recovery
+               cannot win, and the run must fail CLEANLY: the original
+               ``ShuffleFetchFailedError`` surfaces chained ``from`` its
+               short-chunk ``TransportError``, after exactly the
+               budgeted number of stage retries.
+
+    python scripts/dist_chaos_check.py [--rows 400] [--fast]
+                                       [--output DIST_r01.json]
+
+Prints one JSON report; exit code 0 = fence holds.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+QUERY = ("SELECT d.name AS name, sum(s.v) AS total, count(*) AS n "
+         "FROM sales s JOIN dim d ON s.k = d.id "
+         "GROUP BY d.name ORDER BY name")
+
+
+def _views(s, n: int, seed: int = 7) -> None:
+    """Multi-partition inputs so every shuffle actually shuffles (a
+    single-partition source would broadcast the join away)."""
+    rng = np.random.default_rng(seed)
+    s.create_temp_view("sales", s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "v": rng.integers(0, 100, n).astype(np.int64)}))
+        .repartition(3, "k"))
+    s.create_temp_view("dim", s.create_dataframe(pd.DataFrame({
+        "id": np.arange(20, dtype=np.int64),
+        "name": np.array([f"g{i % 5}" for i in range(20)],
+                         dtype=object)}))
+        .repartition(2, "id"))
+
+
+def _oracle(n: int):
+    from spark_rapids_tpu.api import Session
+
+    s = Session()
+    _views(s, n)
+    return s.sql(QUERY).collect()
+
+
+def _frames_equal(got, want) -> str:
+    got = got.reset_index(drop=True)[list(want.columns)]
+    if len(got) != len(want):
+        return f"row count {len(got)} != {len(want)}"
+    for c in want.columns:
+        a, b = got[c].to_numpy(), want[c].to_numpy()
+        try:
+            np.testing.assert_array_equal(a, b)  # bit-exact, order too
+        except AssertionError as e:
+            return f"column {c}: {str(e)[:200]}"
+    return ""
+
+
+def _worker_round_robin():
+    """Placement hook pinning map tasks to worker PROCESSES round-robin
+    (skipping the in-process executor), so killed-worker recovery is
+    guaranteed to have remote outputs to lose."""
+    state = {"i": 0}
+
+    def hook(sid, mid, targets):
+        ws = [t for t in targets if t.startswith("exec-worker")]
+        if not ws:
+            return None
+        state["i"] += 1
+        return ws[state["i"] % len(ws)]
+
+    return hook
+
+
+def check_survive(rows: int) -> dict:
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.runtime import recovery
+    from spark_rapids_tpu.runtime.cluster import (session_cluster,
+                                                  shutdown_session_cluster)
+    from spark_rapids_tpu.shuffle import fault_injection as FI
+
+    want = _oracle(rows)
+    s = Session({
+        cfg.CLUSTER_ENABLED.key: True,
+        cfg.CLUSTER_EXECUTORS.key: 1,
+        cfg.CLUSTER_WORKERS.key: 3,
+        cfg.SHUFFLE_PARTITIONS.key: 4,
+        cfg.AUTO_BROADCAST_THRESHOLD.key: 0,
+        cfg.CLUSTER_RETRY_BACKOFF_MS.key: 10,
+    })
+    _views(s, rows)
+    runtime = session_cluster(s.conf)
+    runtime.placement_hook = _worker_round_robin()
+    # the 4th worker submission SIGKILLs its target — by then that
+    # worker has registered real map output; the 2nd round trip drops
+    # (reconnect absorbs it); the 6th data chunk arrives truncated
+    # (escalates to a fetch failure + stage retry)
+    FI.arm_from_conf(RapidsConf({
+        cfg.SHUFFLE_FI_ENABLED.key: True,
+        cfg.SHUFFLE_FI_KILL_BEFORE_TASK.key: 4,
+        cfg.SHUFFLE_FI_DROP_AT.key: 2,
+        cfg.SHUFFLE_FI_TRUNCATE_AT.key: 6,
+    }))
+    pre = recovery.snapshot()
+    t0 = time.monotonic()
+    try:
+        got = s.sql(QUERY).collect()
+    finally:
+        inj = FI.get_injector().stats()  # before disarm resets counts
+        FI.get_injector().disarm()
+        runtime.placement_hook = None
+    took = time.monotonic() - t0
+    d = recovery.delta(pre)
+    mismatch = _frames_equal(got, want)
+    respawned = [w.executor_id for w in runtime.workers if "~" in
+                 w.executor_id]
+    shutdown_session_cluster()
+    rec = {
+        "recovery": d,
+        "injector": inj,
+        "respawned_worker_ids": respawned,
+        "matches_single_process_oracle": not mismatch,
+        "detail": mismatch,
+        "time_sec": round(took, 2),
+    }
+    rec["ok"] = (not mismatch and
+                 inj["kills"] == 1 and inj["drops"] == 1 and
+                 inj["truncations"] == 1 and
+                 d["fetch_failures"] >= 1 and d["maps_rerun"] >= 1 and
+                 d["workers_respawned"] >= 1 and
+                 d["stage_retries"] >= 1 and
+                 len(respawned) == d["workers_respawned"])
+    return rec
+
+
+def check_exhaust(rows: int) -> dict:
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.runtime import recovery
+    from spark_rapids_tpu.runtime.cluster import (session_cluster,
+                                                  shutdown_session_cluster)
+    from spark_rapids_tpu.shuffle import fault_injection as FI
+    from spark_rapids_tpu.shuffle.iterator import ShuffleFetchFailedError
+    from spark_rapids_tpu.shuffle.transport import TransportError
+
+    s = Session({
+        cfg.CLUSTER_ENABLED.key: True,
+        cfg.CLUSTER_EXECUTORS.key: 3,
+        cfg.CLUSTER_WORKERS.key: 0,
+        cfg.SHUFFLE_PARTITIONS.key: 4,
+        cfg.AUTO_BROADCAST_THRESHOLD.key: 0,
+        cfg.CLUSTER_MAX_STAGE_RETRIES.key: 1,
+        cfg.CLUSTER_RETRY_BACKOFF_MS.key: 0,
+    })
+    _views(s, rows)
+    runtime = session_cluster(s.conf)
+    # pin every map OFF the reader's executor so each read stays remote
+    # — with every chunk truncated, recovery can never win
+    runtime.placement_hook = \
+        lambda sid, mid, targets: next(
+            (t for t in targets if t != "exec-0"), None)
+    FI.get_injector().arm(truncate_at_request=1,
+                          consecutive=1 << 30)
+    pre = recovery.snapshot()
+    err = None
+    try:
+        s.sql(QUERY).collect()
+    except ShuffleFetchFailedError as e:
+        err = e
+    finally:
+        FI.get_injector().disarm()
+        runtime.placement_hook = None
+    d = recovery.delta(pre)
+    shutdown_session_cluster()
+    rec = {
+        "recovery": d,
+        "raised": type(err).__name__ if err else None,
+        "cause": type(err.__cause__).__name__
+        if err and err.__cause__ else None,
+        "message": str(err)[:200] if err else None,
+    }
+    rec["ok"] = (err is not None and
+                 isinstance(err.__cause__, TransportError) and
+                 "short chunk" in str(err.__cause__) and
+                 d["stage_retries"] == 1 and  # exactly the budget
+                 d["fetch_failures"] >= 2)    # original + failed retry
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", type=int, default=400)
+    p.add_argument("--fast", action="store_true",
+                   help="smaller inputs for the deterministic CI fence")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+    rows = 200 if args.fast else args.rows
+
+    report = {
+        "survive": check_survive(rows),
+        "exhaust": check_exhaust(rows),
+    }
+    report["ok"] = all(r["ok"] for r in report.values()
+                       if isinstance(r, dict))
+    text = json.dumps(report, indent=2, default=str)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

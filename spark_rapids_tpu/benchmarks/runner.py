@@ -133,6 +133,9 @@ class BenchmarkRunner:
         from spark_rapids_tpu.service.streaming import stats as _sstats
 
         run_pre_stream = _sstats.snapshot()
+        from spark_rapids_tpu.runtime import recovery as _recovery
+
+        run_pre_recovery = _recovery.snapshot()
         cat = get_catalog()
         pre_spill_dev = cat.spilled_device_bytes
         pre_spill_host = cat.spilled_host_bytes
@@ -178,6 +181,9 @@ class BenchmarkRunner:
         # batch benchmarks; a dashboard-replay harness that appends
         # micro-batches between iterations shows its folds here)
         result["streaming"] = _sstats.delta(run_pre_stream)
+        # lineage fault recovery during the run (zeros on a healthy
+        # cluster; a chaos run shows its re-run maps and respawns here)
+        result["recovery"] = _recovery.delta(run_pre_recovery)
         if telemetry and result["iterations"]:
             # the BASELINE.md-promised split: dispatch_count x RTT vs
             # time actually spent computing on the device

@@ -462,6 +462,109 @@ CLUSTER_WORKERS = conf("rapids.tpu.cluster.workers").doc(
     "their output over TCP — the separate-executor-JVM model."
 ).int_conf.create_with_default(1)
 
+CLUSTER_MAX_STAGE_RETRIES = conf(
+    "rapids.tpu.cluster.maxStageRetries").doc(
+    "Lineage-recovery budget per reduce read: each ShuffleFetchFailedError "
+    "invalidates the dead executor's map outputs, re-runs the lost map "
+    "tasks on survivors/respawned workers, and re-reads — at most this "
+    "many times (with exponential backoff, cluster.retryBackoffMs base) "
+    "before the ORIGINAL fetch failure re-raises chained from its "
+    "transport cause (Spark's spark.stage.maxConsecutiveAttempts role)."
+).int_conf.create_with_default(3)
+
+CLUSTER_TASK_TIMEOUT_SEC = conf(
+    "rapids.tpu.cluster.taskTimeoutSec").doc(
+    "Liveness ceiling for one map task on a remote worker: a worker that "
+    "has not replied within this window is presumed hung, is killed, and "
+    "the task re-places (locally or on a respawned worker). Without it a "
+    "wedged worker blocks the driver's reader forever."
+).double_conf.create_with_default(120.0)
+
+CLUSTER_BLACKLIST_AFTER = conf(
+    "rapids.tpu.cluster.blacklistAfterFailures").doc(
+    "Consecutive failures (submit-time death, task-timeout kill, "
+    "fetch-failure blame) after which a worker SLOT is blacklisted: it "
+    "is no longer respawned and placement stops targeting it, so "
+    "retries quit landing on a flapping host. A successful task resets "
+    "the slot's count. 0 disables blacklisting."
+).int_conf.create_with_default(3)
+
+CLUSTER_RESPAWN_WORKERS = conf(
+    "rapids.tpu.cluster.respawnWorkers").doc(
+    "Respawn dead worker processes during fetch-failure recovery (a "
+    "fresh process per generation, re-registered with every peer). "
+    "Disable to recover onto surviving executors only."
+).boolean_conf.create_with_default(True)
+
+CLUSTER_RETRY_BACKOFF_MS = conf(
+    "rapids.tpu.cluster.retryBackoffMs").doc(
+    "Base backoff before a stage retry re-runs lost map tasks; doubles "
+    "per attempt (attempt k sleeps base * 2^k). Small by default: the "
+    "local fault injector needs no settling time, real deployments "
+    "should give a flapping peer a few seconds."
+).int_conf.create_with_default(50)
+
+SHUFFLE_FI_ENABLED = conf(
+    "rapids.tpu.shuffle.faultInjection.enabled").doc(
+    "Arm the deterministic transport/worker fault injector "
+    "(shuffle/fault_injection.py): connection drops, truncated chunk "
+    "frames, and worker kills fire at exact request/task ordinals so "
+    "the whole lineage-recovery ladder (fetch failure -> invalidate -> "
+    "re-run -> re-read) runs deterministically on CPU CI "
+    "(scripts/dist_chaos_check.py). Never enable in production."
+).boolean_conf.create_with_default(False)
+
+SHUFFLE_FI_DROP_AT = conf(
+    "rapids.tpu.shuffle.faultInjection.dropConnectionAtRequest").doc(
+    "Drop the client socket (and fail the round trip with a retryable "
+    "TransportError) on the Nth transport request, counted from 1 "
+    "across the process; 0 disables. Exercises the connection-level "
+    "reconnect+backoff path (shuffle/tcp.py _roundtrip_retrying)."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_TRUNCATE_AT = conf(
+    "rapids.tpu.shuffle.faultInjection.truncateFrameAtRequest").doc(
+    "Truncate the payload of the Nth chunk request (counted from 1); "
+    "0 disables. The short chunk is detected ABOVE the connection retry "
+    "loop (transport.py _fetch_payload), so it deterministically "
+    "escalates to a fetch failure and a stage retry."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_KILL_BEFORE_TASK = conf(
+    "rapids.tpu.shuffle.faultInjection.killWorkerBeforeTask").doc(
+    "SIGKILL the target worker process immediately before the Nth "
+    "worker task submission (counted from 1); 0 disables. Earlier "
+    "tasks' registered outputs then produce reduce-side fetch failures "
+    "— the worker-death half of the recovery ladder."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_PROBABILITY = conf(
+    "rapids.tpu.shuffle.faultInjection.probability").doc(
+    "Per-transport-request connection-drop probability for seeded "
+    "chaos sweeps (0.0 disables). Reproducible via faultInjection.seed."
+).double_conf.create_with_default(0.0)
+
+SHUFFLE_FI_SEED = conf(
+    "rapids.tpu.shuffle.faultInjection.seed").doc(
+    "RNG seed for probabilistic transport faults — the same seed "
+    "replays the same drop sequence."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_CONSECUTIVE = conf(
+    "rapids.tpu.shuffle.faultInjection.consecutive").doc(
+    "Requests failed in a row per firing point (applies to drops and "
+    "truncations). Values past the transport's transient-retry budget "
+    "escalate a drop from a reconnect into a fetch failure; a huge "
+    "value with truncateFrameAtRequest=1 makes EVERY chunk short — the "
+    "budget-exhaustion fence."
+).int_conf.create_with_default(1)
+
+SHUFFLE_FI_MAX = conf(
+    "rapids.tpu.shuffle.faultInjection.maxInjections").doc(
+    "Total injections cap across all fault kinds (0 = unlimited) so "
+    "probabilistic chaos runs terminate."
+).int_conf.create_with_default(0)
+
 SHUFFLE_IN_PROGRAM = conf("rapids.tpu.shuffle.inProgram.enabled").doc(
     "Fold mesh-internal shuffles into the compiled program: when the "
     "session mesh is active, hash-routed exchanges lower to in-program "

@@ -157,7 +157,10 @@ class ShuffleExchangeExec(TpuExec):
         same way (GpuRangePartitioner.scala:42-95)."""
         if self.in_program and self._in_program_mesh is not None:
             self._materialize_in_program_once()
-            return
+            if self._blocks is not None:
+                return
+            # a device error degraded this exchange (in_program is now
+            # False): fall through to the host/TCP path, once per query
         with self._mat_lock:
             if self._blocks is not None:
                 return
@@ -261,12 +264,30 @@ class ShuffleExchangeExec(TpuExec):
         with self._mat_lock:
             while self._mat_running:
                 self._mat_lock.wait()
-            if self._blocks is not None:
+            # a waiter wakes to either a materialized exchange or one
+            # the leader DEGRADED (in_program cleared) — both mean the
+            # in-program attempt is over for this query
+            if self._blocks is not None or not self.in_program:
                 return
             self._mat_running = True
         blocks = None
         try:
             blocks = self._materialize_in_program()
+        except Exception as e:
+            from spark_rapids_tpu.parallel import spmd
+
+            if not spmd.is_degradable_device_error(e):
+                raise
+            # SPMD degrade: a device error inside the compiled exchange
+            # program falls back to the host/TCP path for this stage —
+            # once per query (in_program stays off) — instead of
+            # failing the query on a path that has a lossless fallback
+            from spark_rapids_tpu.runtime import recovery
+
+            spmd.record_degrade("exchange")
+            recovery.bump("spmd_degrades")
+            self.in_program = False
+            self._in_program_mesh = None
         finally:
             with self._mat_lock:
                 self._mat_running = False
@@ -282,9 +303,14 @@ class ShuffleExchangeExec(TpuExec):
         regardless of batch or partition count — the host path pays a
         partition kernel per batch plus a slice per partition."""
         import jax
+        from spark_rapids_tpu.memory.fault_injection import get_injector
         from spark_rapids_tpu.parallel import shuffle as pshuffle
         from spark_rapids_tpu.parallel.mesh import DATA_AXIS
 
+        # deterministic degrade fence: the OOM injector can fail this
+        # site (InjectedOOM classifies as a device error) so the
+        # SPMD-degrade path runs on CPU CI without a real XLA fault
+        get_injector().maybe_inject("exchange.inProgram")
         mesh = self._in_program_mesh
         n_dev = mesh.shape[DATA_AXIS]
         num_out = self.num_out_partitions

@@ -51,6 +51,33 @@ def fallback_delta(before: dict) -> dict:
             if n - before.get(k, 0)}
 
 
+#: the RUNTIME fallback reason (every other reason is a plan-time gate
+#: in in_program_mesh below): an in-program exchange's compiled program
+#: failed on-device mid-query and the stage re-ran on the host/TCP
+#: path — recorded once per degraded exchange, surfaced in the same
+#: telemetry (docs/fault-tolerance.md)
+DEGRADE_DEVICE_ERROR = ("device error: in-program exchange degraded "
+                        "to host/TCP path")
+
+
+def is_degradable_device_error(err: BaseException) -> bool:
+    """Whether an in-program exchange failure is a DEVICE error worth
+    degrading to the host/TCP path (OOM, XLA runtime fault), as opposed
+    to a plan/user error that would fail identically on the host."""
+    from spark_rapids_tpu.memory.retry import is_oom_error
+
+    if is_oom_error(err):
+        return True
+    return type(err).__name__ in ("XlaRuntimeError", "JaxRuntimeError",
+                                  "InternalError")
+
+
+def record_degrade(op: str) -> None:
+    """Count one in-program exchange degraded at RUNTIME by a device
+    error (execs/exchange._materialize_in_program_once)."""
+    record_fallback(op, DEGRADE_DEVICE_ERROR)
+
+
 def in_program_mesh(conf, op: str, *, keyed: bool = True,
                     reason_if_unkeyed: str = "",
                     est_rows: Optional[int] = None,

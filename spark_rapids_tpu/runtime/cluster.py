@@ -32,6 +32,8 @@ import base64
 import itertools
 import pickle
 import threading
+import time
+from spark_rapids_tpu.runtime import recovery
 from spark_rapids_tpu.utils import lockorder
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -145,6 +147,20 @@ class ExecutorContext:
                 self._clients[peer] = c
             return c
 
+    def invalidate_client(self, peer: str) -> None:
+        """Evict a cached peer client after a fetch error so the next
+        attempt reconnects from the CURRENT address book — a respawned
+        peer (new port) is unreachable through the stale socket."""
+        with self._lock:
+            c = self._clients.pop(peer, None)
+        if c is not None:
+            close = getattr(c.conn, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except OSError:
+                    pass
+
 
 _CONTEXT: Optional[ExecutorContext] = None
 
@@ -203,7 +219,7 @@ class ClusterShuffleReadExec(TpuExec):
             sit = ShuffleIterator(
                 ctx.executor.shuffle_catalog,
                 ctx.executor.executor_id, self._locations(partition),
-                ctx.client_for)
+                ctx.client_for, on_fetch_error=ctx.invalidate_client)
             empty = True
             for b in sit:
                 if b.realized_num_rows() == 0:
@@ -408,7 +424,10 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
             # Buffered batches are SPILLABLE — a large reduce partition
             # must not pin its full size in HBM while the read drains
             staged: List[SpillableBatch] = []
-            for attempt in range(3):
+            budget = max(int(self.runtime.max_stage_retries), 0)
+            backoff_s = max(int(self.runtime.retry_backoff_ms), 0) / 1e3
+            attempt = 0
+            while True:
                 stub = self._read_stub
                 try:
                     for b in stub.execute(partition):
@@ -419,10 +438,20 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
                     for sb in staged:
                         sb.close()
                     staged = []
+                    recovery.bump("fetch_failures")
+                    if attempt >= budget:
+                        # budget exhausted: the ORIGINAL fetch failure
+                        # surfaces, chained from its transport cause
+                        raise e from (
+                            e.cause
+                            if isinstance(e.cause, BaseException)
+                            else None)
+                    if backoff_s:
+                        time.sleep(backoff_s * (2 ** attempt))
+                    attempt += 1
+                    recovery.bump("stage_retries")
                     self.runtime.recover(e)
                     self._read_stub = self.make_read_stub()
-            else:
-                raise RuntimeError("shuffle read failed after retries")
             for sb in staged:
                 with sb.acquired() as b:
                     yield b
@@ -439,18 +468,46 @@ class RemoteTaskError(RuntimeError):
 
 class RemoteWorkerHandle:
     """Driver-side handle to one worker process (a separate OS process
-    hosting an executor: catalog + TCP shuffle server + task loop)."""
+    hosting an executor: catalog + TCP shuffle server + task loop).
 
-    def __init__(self, executor_id: str, proc, host: str, port: int):
+    Replies are pumped by a daemon reader thread into a queue, which
+    buys two liveness properties at once: ``run_map`` can bound its wait
+    (``task_timeout`` — a hung worker used to be an infinite
+    ``readline``), and ``close`` never deadlocks against a worker
+    blocked mid-write on a reply larger than the pipe buffer (the
+    thread keeps draining stdout while the driver waits for exit)."""
+
+    def __init__(self, executor_id: str, proc, host: str, port: int,
+                 task_timeout: Optional[float] = None):
+        import queue
+
         self.executor_id = executor_id
         self.proc = proc
         self.host = host
         self.port = port
+        #: seconds run_map waits for a reply before declaring the worker
+        #: hung, killing it, and re-placing the task (None = forever)
+        self.task_timeout = task_timeout
         self._lock = lockorder.make_lock("runtime.cluster.worker")
+        self._replies: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._reader = threading.Thread(
+            target=self._drain_stdout,
+            name=f"worker-reader-{executor_id}", daemon=True)
+        self._reader.start()
+
+    def _drain_stdout(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                self._replies.put(line)
+        except (ValueError, OSError):
+            pass
+        finally:
+            self._replies.put(None)  # EOF sentinel: the worker is gone
 
     @classmethod
-    def spawn(cls, executor_id: str,
-              mesh_devices: int = 0) -> "RemoteWorkerHandle":
+    def spawn(cls, executor_id: str, mesh_devices: int = 0,
+              task_timeout: Optional[float] = None
+              ) -> "RemoteWorkerHandle":
         import os
         import subprocess
         import sys
@@ -480,23 +537,47 @@ class RemoteWorkerHandle:
         proc.stdin.write(
             '{"executor_id": "%s", "mode": "task"}\n' % executor_id)
         proc.stdin.flush()
+        # READY is read inline, BEFORE the reader thread exists (the
+        # thread starts in __init__), so handshake and reply streams
+        # never interleave
         line = proc.stdout.readline().split()
         assert line and line[0] == "READY", line
-        return cls(executor_id, proc, line[1], int(line[2]))
+        return cls(executor_id, proc, line[1], int(line[2]),
+                   task_timeout=task_timeout)
 
-    def run_map(self, payload: dict) -> dict:
-        """Ship one map task; blocks until the worker reports. Raises on
-        worker death (the caller re-runs the task locally)."""
+    def run_map(self, payload: dict,
+                timeout: Optional[float] = None) -> dict:
+        """Ship one map task; blocks until the worker reports or the
+        liveness timeout expires. Raises ConnectionError on worker
+        death or hang (the caller re-runs the task elsewhere)."""
         import json
+        import queue
 
+        from spark_rapids_tpu.shuffle import fault_injection
+
+        if fault_injection.get_injector().should_kill_task():
+            self.kill()  # injected worker death right before submit
         blob = base64.b64encode(pickle.dumps(payload)).decode()
+        budget = self.task_timeout if timeout is None else timeout
         with self._lock:
-            self.proc.stdin.write(
-                json.dumps({"cmd": "run_map", "payload_b64": blob}) +
-                "\n")
-            self.proc.stdin.flush()
-            line = self.proc.stdout.readline()
-        if not line:
+            try:
+                self.proc.stdin.write(
+                    json.dumps({"cmd": "run_map", "payload_b64": blob}) +
+                    "\n")
+                self.proc.stdin.flush()
+            except (BrokenPipeError, OSError, ValueError) as e:
+                raise ConnectionError(
+                    f"worker {self.executor_id} died at submit: {e}")
+            try:
+                line = self._replies.get(timeout=budget)
+            except queue.Empty:
+                # hung worker: kill it BEFORE re-placing the task, so a
+                # late completion can never double-register its output
+                self.kill()
+                raise ConnectionError(
+                    f"worker {self.executor_id} unresponsive after "
+                    f"{budget}s (killed)") from None
+        if line is None:
             raise ConnectionError(
                 f"worker {self.executor_id} died")
         reply = json.loads(line)
@@ -515,12 +596,17 @@ class RemoteWorkerHandle:
         self.proc.wait()
 
     def close(self):
+        # the reader thread keeps draining stdout, so a worker blocked
+        # writing an oversized reply finishes the write and sees the
+        # stdin EOF instead of deadlocking against our wait
         try:
-            if self.alive:
-                self.proc.stdin.close()
-                self.proc.wait(timeout=5)
+            self.proc.stdin.close()
+        except (BrokenPipeError, OSError, ValueError):
+            pass
+        try:
+            self.proc.wait(timeout=5)
         except Exception:
-            self.kill()
+            self.kill()  # always escalate: close() must end the process
 
 
 class ClusterRuntime:
@@ -530,17 +616,34 @@ class ClusterRuntime:
 
     def __init__(self, n_executors: int = 2, n_workers: int = 1,
                  spill_dir: Optional[str] = None,
-                 mesh_devices: int = 0):
+                 mesh_devices: int = 0,
+                 max_stage_retries: int = 3,
+                 task_timeout_sec: Optional[float] = 120.0,
+                 blacklist_after: int = 3,
+                 respawn_workers: bool = True,
+                 retry_backoff_ms: int = 50):
         self.cluster = LocalCluster(max(n_executors, 1), transport="tcp",
                                     spill_dir=spill_dir)
         self.mesh_devices = mesh_devices
+        self.max_stage_retries = max_stage_retries
+        self.task_timeout_sec = task_timeout_sec
+        self.blacklist_after = blacklist_after
+        self.respawn_workers = respawn_workers
+        self.retry_backoff_ms = retry_backoff_ms
         self.workers: List[RemoteWorkerHandle] = []
         for i in range(n_workers):
             w = RemoteWorkerHandle.spawn(f"exec-worker-{i}",
-                                         mesh_devices=mesh_devices)
+                                         mesh_devices=mesh_devices,
+                                         task_timeout=task_timeout_sec)
             self.workers.append(w)
             self.cluster.register_remote_executor(w.executor_id, w.host,
                                                   w.port)
+        # consecutive-failure counts + blacklist, per worker SLOT (the
+        # generation-free base id: every respawn of exec-worker-1 shares
+        # exec-worker-1's record — blacklisting targets the flapping
+        # host, not one incarnation of it)
+        self._failures: Dict[str, int] = {}
+        self.blacklisted: set = set()
         self._sid = itertools.count()
         self._lock = lockorder.make_lock("runtime.cluster.state")
         # serializes fetch-failure recovery against stub rebuilds: the
@@ -577,8 +680,77 @@ class ClusterRuntime:
 
     def executor_ids(self) -> List[str]:
         ids = [ex.executor_id for ex in self.cluster.executors]
-        ids += [w.executor_id for w in self.workers if w.alive]
+        ids += [w.executor_id for w in self.workers
+                if w.alive and
+                self._slot(w.executor_id) not in self.blacklisted]
         return ids
+
+    # -- worker supervision (respawn + blacklist) --------------------------
+
+    @staticmethod
+    def _slot(executor_id: str) -> str:
+        """Generation-free worker slot id: respawns of exec-worker-1 are
+        exec-worker-1~1, exec-worker-1~2, ... and all map to the slot."""
+        return executor_id.split("~", 1)[0]
+
+    def _note_worker_failure(self, executor_id: str) -> None:
+        """Count one liveness failure (submit-time death, task-timeout
+        kill, fetch-failure blame) against the worker's slot; the Kth
+        consecutive one blacklists it. In-process executors are never
+        blacklisted — they are the driver's own catalogs."""
+        slot = self._slot(executor_id)
+        if not any(self._slot(w.executor_id) == slot
+                   for w in self.workers):
+            return
+        newly = False
+        with self._lock:
+            n = self._failures.get(slot, 0) + 1
+            self._failures[slot] = n
+            if self.blacklist_after and n >= self.blacklist_after and \
+                    slot not in self.blacklisted:
+                self.blacklisted.add(slot)
+                newly = True
+        if newly:
+            recovery.bump("executors_blacklisted")
+
+    def _note_worker_success(self, executor_id: str) -> None:
+        with self._lock:
+            self._failures[self._slot(executor_id)] = 0
+
+    def _respawn_dead_workers(self) -> None:
+        """Supervision sweep: every dead, non-blacklisted worker slot
+        with no live generation gets a fresh process (new id, same
+        slot), registered with the driver's transport; peers learn the
+        address through the address book every task payload and read
+        stub carries (``addresses()``). Dead handles stay in
+        ``self.workers`` — their ids must keep resolving for blame and
+        for tests that index the original list."""
+        if not self.respawn_workers:
+            return
+        for w in list(self.workers):
+            if w.alive:
+                continue
+            slot = self._slot(w.executor_id)
+            if slot in self.blacklisted:
+                continue
+            if any(self._slot(o.executor_id) == slot and o.alive
+                   for o in self.workers):
+                continue
+            gen = sum(1 for o in self.workers
+                      if self._slot(o.executor_id) == slot)
+            try:
+                nw = RemoteWorkerHandle.spawn(
+                    f"{slot}~{gen}", mesh_devices=self.mesh_devices,
+                    task_timeout=self.task_timeout_sec)
+            except (OSError, AssertionError, ValueError):
+                # the replacement would not even start: that is another
+                # strike against the slot
+                self._note_worker_failure(slot)
+                continue
+            self.workers.append(nw)
+            self.cluster.register_remote_executor(nw.executor_id,
+                                                  nw.host, nw.port)
+            recovery.bump("workers_respawned")
 
     # -- task scheduling --------------------------------------------------
 
@@ -617,11 +789,14 @@ class ClusterRuntime:
                 with self._lock:
                     self.assignments[shuffle_id][map_id] = \
                         worker.executor_id
+                self._note_worker_success(target)
                 return
             except (ConnectionError, BrokenPipeError, OSError) as e:
-                # dead worker at SUBMIT time: place locally instead
+                # dead or hung worker at SUBMIT time: place locally
+                # instead, and count the strike toward its blacklist
                 exchange.local_fallbacks.append(
                     f"worker {target} dead at submit: {e}")
+                self._note_worker_failure(target)
             except (pickle.PicklingError, TypeError, AttributeError) as e:
                 # unpicklable task subtree (cached relations hold locks):
                 # this task can only run in-process — local placement,
@@ -716,10 +891,14 @@ class ClusterRuntime:
             for w in self.workers:
                 if w.executor_id == dead and w.alive:
                     w.kill()  # a peer that failed a fetch is not trusted
+            self._note_worker_failure(dead)
+            self._respawn_dead_workers()
             lost = self.cluster.invalidate_map_output(sid, dead)
             exchange = self.exchanges[sid]
             for map_id in lost:
                 self.run_map_task(exchange, sid, map_id, exclude={dead})
+            if lost:
+                recovery.bump("maps_rerun", len(lost))
 
     def shutdown(self):
         for w in self.workers:
@@ -750,13 +929,21 @@ def session_cluster(conf) -> Optional[ClusterRuntime]:
         if m is not None:
             mesh_devices = int(m.shape[DATA_AXIS])
     key = (conf.get(cfg.CLUSTER_EXECUTORS),
-           conf.get(cfg.CLUSTER_WORKERS), mesh_devices)
+           conf.get(cfg.CLUSTER_WORKERS), mesh_devices,
+           conf.get(cfg.CLUSTER_MAX_STAGE_RETRIES),
+           conf.get(cfg.CLUSTER_TASK_TIMEOUT_SEC),
+           conf.get(cfg.CLUSTER_BLACKLIST_AFTER),
+           conf.get(cfg.CLUSTER_RESPAWN_WORKERS),
+           conf.get(cfg.CLUSTER_RETRY_BACKOFF_MS))
     if _SESSION_RUNTIME is None or _RUNTIME_KEY != key:
         if _SESSION_RUNTIME is not None:
             _SESSION_RUNTIME.shutdown()
-        _SESSION_RUNTIME = ClusterRuntime(n_executors=key[0],
-                                          n_workers=key[1],
-                                          mesh_devices=mesh_devices)
+        _SESSION_RUNTIME = ClusterRuntime(
+            n_executors=key[0], n_workers=key[1],
+            mesh_devices=mesh_devices,
+            max_stage_retries=key[3], task_timeout_sec=key[4],
+            blacklist_after=key[5], respawn_workers=key[6],
+            retry_backoff_ms=key[7])
         _RUNTIME_KEY = key
         set_executor_context(ExecutorContext(
             _SESSION_RUNTIME.cluster.executors[0],

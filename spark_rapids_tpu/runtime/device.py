@@ -103,9 +103,12 @@ def initialize(conf: Optional[RapidsConf] = None,
         reset_catalog(catalog)
         semaphore = sem.initialize(conf.get(cfg.CONCURRENT_TPU_TASKS))
         from spark_rapids_tpu.memory import fault_injection, retry
+        from spark_rapids_tpu.shuffle import \
+            fault_injection as shuffle_fault_injection
 
         retry.configure_from_conf(conf)
         fault_injection.arm_from_conf(conf)
+        shuffle_fault_injection.arm_from_conf(conf)
         _env = RuntimeEnv(conf, dm, catalog, semaphore,
                           conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         return _env
@@ -127,6 +130,9 @@ def shutdown() -> None:
         reset_catalog(BufferCatalog())
         sem.initialize(2)
         from spark_rapids_tpu.memory import fault_injection, retry
+        from spark_rapids_tpu.shuffle import \
+            fault_injection as shuffle_fault_injection
 
         retry.reset_config()
         fault_injection.get_injector().disarm()
+        shuffle_fault_injection.get_injector().disarm()

@@ -1,0 +1,48 @@
+"""Process-global fault-recovery counters.
+
+Every rung of the lineage-recovery ladder (docs/fault-tolerance.md)
+bumps a counter here: reduce-side fetch failures observed, map tasks
+re-run from retained assignments, worker processes respawned, executor
+slots blacklisted, stage retries spent, and in-program exchanges
+degraded to the host/TCP path. Styled after memory/retry's and
+service/streaming/stats' process totals so the benchmark runner can
+bracket any run with ``snapshot()``/``delta()`` and emit a ``recovery``
+block next to its ``memory``/``streaming`` blocks, and the service can
+embed the same numbers in ServiceStats without holding a runtime
+reference — a query that silently survived a worker death should be
+visible in telemetry, never folklore.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from spark_rapids_tpu.utils import lockorder
+
+_lock = lockorder.make_lock("runtime.recovery.stats")
+
+_KEYS = ("fetch_failures", "maps_rerun", "workers_respawned",
+         "executors_blacklisted", "stage_retries", "spmd_degrades")
+
+_counters: Dict[str, int] = {k: 0 for k in _KEYS}
+
+
+def bump(key: str, n: int = 1) -> None:
+    with _lock:
+        _counters[key] += n
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def delta(before: Dict[str, int]) -> Dict[str, int]:
+    now = snapshot()
+    return {k: now[k] - before.get(k, 0) for k in _KEYS}
+
+
+def reset() -> None:
+    """Test isolation hook."""
+    with _lock:
+        for k in _KEYS:
+            _counters[k] = 0

@@ -407,6 +407,7 @@ class QueryService:
 
     def stats(self) -> ServiceStats:
         from spark_rapids_tpu.memory import retry as _retry
+        from spark_rapids_tpu.runtime import recovery as _recovery
         from spark_rapids_tpu.utils import dispatch as _disp
         from spark_rapids_tpu.utils import progcache
 
@@ -444,6 +445,7 @@ class QueryService:
                 batching=self.batcher.stats(),
                 cache=self.cache.stats(),
                 streaming=self.streaming.stats(),
+                recovery=_recovery.snapshot(),
                 queue_depth=self.admission.queue_depth(),
                 running=running,
                 admitted_inflight=len(self.admission.inflight),

@@ -111,6 +111,11 @@ class ServiceStats:
     #: appends/folds/late-row counters, live standing-query registry,
     #: state bytes (device-resident share), watermark lag
     streaming: dict = dataclasses.field(default_factory=dict)
+    #: lineage fault recovery (runtime/recovery.snapshot()): reduce-side
+    #: fetch failures, map tasks re-run, workers respawned, executor
+    #: slots blacklisted, stage retries spent, SPMD degrades — a query
+    #: that survived a worker death shows up here, never silently
+    recovery: dict = dataclasses.field(default_factory=dict)
 
     @property
     def progcache_hit_rate(self) -> float:

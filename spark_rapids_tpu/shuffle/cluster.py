@@ -145,6 +145,21 @@ class LocalCluster:
                 self._clients[key] = c
             return c
 
+    def evict_client(self, from_executor: str, to_executor: str) -> None:
+        """Drop a cached peer client after a fetch error: the broken
+        socket must not outlive the failure, or every later fetch to a
+        RESTARTED peer (new port, re-registered address) keeps failing
+        on the stale connection for the rest of the process lifetime."""
+        with self._lock:
+            c = self._clients.pop((from_executor, to_executor), None)
+        if c is not None:
+            close = getattr(c.conn, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except OSError:
+                    pass
+
     def read_partition(self, shuffle_id: int, partition: int,
                        reader_executor_index: int
                        ) -> Iterator[ColumnarBatch]:
@@ -160,7 +175,9 @@ class LocalCluster:
                     executor_id
         it = ShuffleIterator(
             reader.shuffle_catalog, reader.executor_id, locations,
-            lambda peer: self._client(reader.executor_id, peer))
+            lambda peer: self._client(reader.executor_id, peer),
+            on_fetch_error=lambda peer: self.evict_client(
+                reader.executor_id, peer))
         self.last_iterator = it  # for metric assertions in tests
         return iter(it)
 

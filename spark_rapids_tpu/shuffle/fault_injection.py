@@ -1,0 +1,187 @@
+"""Deterministic transport/worker fault injection.
+
+The sibling of ``memory/fault_injection.py`` for the distributed
+runtime: where that module fires synthetic device OOM at exact guarded
+calls, this one fires transport and process faults at exact protocol
+ordinals, so the whole lineage-recovery ladder (docs/fault-tolerance.md;
+RapidsShuffleIterator.scala:242-300's fetch-failure escalation) runs
+deterministically on CPU CI:
+
+- ``drop_at_request=N``: the Nth transport round trip fails with a
+  retryable TransportError after dropping the socket — exercises the
+  connection-level reconnect + exponential backoff
+  (shuffle/tcp.py ``_roundtrip_retrying``) WITHOUT costing a stage.
+- ``truncate_at_request=N``: the Nth chunk request's payload comes back
+  short. The short-chunk check sits ABOVE the connection retry loop
+  (transport.py ``_fetch_payload``), so this deterministically escalates
+  to ``ShuffleFetchFailedError`` and a stage retry.
+- ``kill_before_task=N``: SIGKILL the target worker right before the
+  Nth task submission. The submit fails over locally; the worker's
+  EARLIER registered outputs then fail reduce-side fetches — the
+  worker-death half of recovery (invalidate, respawn, re-run).
+- ``probability`` + ``seed``: seeded random connection drops for chaos
+  sweeps; ``consecutive=K`` makes each firing point fail K events in a
+  row (K past the transport retry budget escalates a drop into a fetch
+  failure; a huge K with ``truncate_at_request=1`` shorts EVERY chunk —
+  the maxStageRetries-exhaustion fence), ``max_injections`` caps the
+  total so a chaos run terminates.
+
+Only the arming process injects (workers never arm), so counts are
+driver-deterministic. Armed from config
+(``rapids.tpu.shuffle.faultInjection.*``) by ``runtime.initialize`` or
+directly by tests/scripts (scripts/dist_chaos_check.py).
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from spark_rapids_tpu.utils import lockorder
+
+
+class _Trigger:
+    """Fire at the Nth eligible event, then ``consecutive - 1`` more in
+    a row (the memory injector's at_call + burst semantics)."""
+
+    __slots__ = ("at", "consecutive", "count", "burst")
+
+    def __init__(self, at: int, consecutive: int):
+        self.at = max(int(at), 0)
+        self.consecutive = max(int(consecutive), 1)
+        self.count = 0
+        self.burst = 0
+
+    def fire(self) -> bool:
+        self.count += 1
+        if self.burst > 0:
+            self.burst -= 1
+            return True
+        if self.at and self.count == self.at:
+            self.burst = self.consecutive - 1
+            return True
+        return False
+
+
+class ShuffleFaultInjector:
+    """Thread-safe injection point shared by every transport client and
+    worker handle in the process."""
+
+    def __init__(self):
+        self._lock = lockorder.make_lock("shuffle.faultInjection")
+        self.disarm()
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+            self._drop = _Trigger(0, 1)
+            self._truncate = _Trigger(0, 1)
+            self._kill = _Trigger(0, 1)
+            self._probability = 0.0
+            self._rng: Optional[random.Random] = None
+            self._max_injections = 0
+            self._drops = 0
+            self._truncations = 0
+            self._kills = 0
+
+    def arm(self, drop_at_request: int = 0, truncate_at_request: int = 0,
+            kill_before_task: int = 0, probability: float = 0.0,
+            seed: int = 0, consecutive: int = 1,
+            max_injections: int = 0) -> None:
+        """Arm (resetting all counters). Ordinals count eligible events
+        from 1; 0 disables that fault kind (probability may still drop
+        connections)."""
+        with self._lock:
+            self._armed = True
+            self._drop = _Trigger(drop_at_request, consecutive)
+            self._truncate = _Trigger(truncate_at_request, consecutive)
+            self._kill = _Trigger(kill_before_task, 1)
+            self._probability = float(probability)
+            self._rng = random.Random(seed) if probability > 0 else None
+            self._max_injections = max(int(max_injections), 0)
+            self._drops = 0
+            self._truncations = 0
+            self._kills = 0
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def _capped(self) -> bool:
+        return self._max_injections and \
+            (self._drops + self._truncations + self._kills) >= \
+            self._max_injections
+
+    def should_drop(self) -> bool:
+        """Count one transport round trip; True = the caller must drop
+        its socket and fail the request with a retryable error."""
+        if not self._armed:
+            return False
+        with self._lock:
+            fire = self._drop.fire()
+            if not fire and self._rng is not None and \
+                    self._rng.random() < self._probability:
+                fire = True
+                self._drop.burst = self._drop.consecutive - 1
+            if not fire or self._capped():
+                return False
+            self._drops += 1
+            return True
+
+    def maybe_truncate(self, payload: bytes) -> bytes:
+        """Count one chunk request carrying data; when firing, return a
+        short payload (half the frame) for the client's length check to
+        reject."""
+        if not self._armed or len(payload) < 2:
+            return payload
+        with self._lock:
+            if not self._truncate.fire() or self._capped():
+                return payload
+            self._truncations += 1
+        return payload[:len(payload) // 2]
+
+    def should_kill_task(self) -> bool:
+        """Count one worker task submission; True = SIGKILL the target
+        worker before submitting (the caller owns the process handle)."""
+        if not self._armed:
+            return False
+        with self._lock:
+            if not self._kill.fire() or self._capped():
+                return False
+            self._kills += 1
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"armed": self._armed,
+                    "requests": self._drop.count,
+                    "chunk_requests": self._truncate.count,
+                    "tasks": self._kill.count,
+                    "drops": self._drops,
+                    "truncations": self._truncations,
+                    "kills": self._kills}
+
+
+_injector = ShuffleFaultInjector()
+
+
+def get_injector() -> ShuffleFaultInjector:
+    return _injector
+
+
+def arm_from_conf(conf) -> bool:
+    """Arm/disarm the global injector from
+    ``rapids.tpu.shuffle.faultInjection.*``; returns True when armed."""
+    from spark_rapids_tpu import config as cfg
+
+    if not conf.get(cfg.SHUFFLE_FI_ENABLED):
+        _injector.disarm()
+        return False
+    _injector.arm(
+        drop_at_request=conf.get(cfg.SHUFFLE_FI_DROP_AT),
+        truncate_at_request=conf.get(cfg.SHUFFLE_FI_TRUNCATE_AT),
+        kill_before_task=conf.get(cfg.SHUFFLE_FI_KILL_BEFORE_TASK),
+        probability=conf.get(cfg.SHUFFLE_FI_PROBABILITY),
+        seed=conf.get(cfg.SHUFFLE_FI_SEED),
+        consecutive=conf.get(cfg.SHUFFLE_FI_CONSECUTIVE),
+        max_injections=conf.get(cfg.SHUFFLE_FI_MAX))
+    return True

@@ -4,11 +4,12 @@ RapidsCachingReader.scala:59-166).
 Given the blocks a reduce task needs, partitions them into local catalog
 hits (zero-copy device reads, possibly unspilled) and per-peer remote
 fetches; transport errors surface as ``ShuffleFetchFailedError`` naming
-the failed block — the reference converts these into Spark fetch-failures
-so the stage retries (RapidsShuffleIterator.scala:242-300)."""
+EVERY failed block — the reference converts these into Spark
+fetch-failures so the stage retries
+(RapidsShuffleIterator.scala:242-300)."""
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
@@ -17,13 +18,29 @@ from spark_rapids_tpu.shuffle.transport import ShuffleClient, TransportError
 
 
 class ShuffleFetchFailedError(RuntimeError):
-    def __init__(self, block: BlockId, executor_id: str, cause):
+    """A reduce read lost block(s) to a failed peer or a corrupt frame.
+
+    ``blocks`` is the FULL list that failed with this peer (recovery
+    invalidates exactly the lost maps; logging names every missing
+    block), ``block`` its first entry for single-block call sites, and
+    ``batches_yielded`` how many batches the iterator had already
+    produced — the stage-retry barrier uses it to confirm no partial
+    progress leaks past a restart."""
+
+    def __init__(self, blocks: Union[BlockId, Sequence[BlockId]],
+                 executor_id: str, cause, batches_yielded: int = 0):
+        blocks = [blocks] if isinstance(blocks, BlockId) else list(blocks)
+        assert blocks, "a fetch failure names at least one block"
+        named = ", ".join(str(b) for b in blocks)
         super().__init__(
-            f"fetch failed for {block} from executor {executor_id}: "
-            f"{cause}")
-        self.block = block
+            f"fetch failed for {len(blocks)} block(s) [{named}] from "
+            f"executor {executor_id} after {batches_yielded} yielded "
+            f"batch(es): {cause}")
+        self.block = blocks[0]
+        self.blocks = blocks
         self.executor_id = executor_id
         self.cause = cause
+        self.batches_yielded = batches_yielded
 
 
 class ShuffleIterator:
@@ -31,19 +48,34 @@ class ShuffleIterator:
 
     ``block_locations`` maps each wanted block to the executor that holds
     it (the MapStatus/MapOutputTracker answer); ``client_for`` lazily
-    opens a transport client per peer."""
+    opens a transport client per peer; ``on_fetch_error`` (optional) is
+    told the peer whose fetch failed BEFORE the fetch failure raises, so
+    the owner of a per-peer client cache can evict the broken connection
+    (a restarted peer is then reachable on the next attempt instead of
+    failing on a stale socket forever)."""
 
     def __init__(self, local_catalog: ShuffleBufferCatalog,
                  local_executor_id: str,
                  block_locations: Dict[BlockId, str],
-                 client_for: Callable[[str], ShuffleClient]):
+                 client_for: Callable[[str], ShuffleClient],
+                 on_fetch_error: Optional[Callable[[str], None]] = None):
         self.local_catalog = local_catalog
         self.local_executor_id = local_executor_id
         self.block_locations = block_locations
         self.client_for = client_for
+        self.on_fetch_error = on_fetch_error
         self.local_blocks_read = 0
         self.remote_blocks_read = 0
         self.remote_bytes_read = 0
+        self.batches_yielded = 0
+
+    def _failed(self, blocks, executor: str, cause
+                ) -> ShuffleFetchFailedError:
+        if self.on_fetch_error is not None and \
+                executor != self.local_executor_id:
+            self.on_fetch_error(executor)
+        return ShuffleFetchFailedError(blocks, executor, cause,
+                                       self.batches_yielded)
 
     def __iter__(self) -> Iterator[ColumnarBatch]:
         local: List[BlockId] = []
@@ -58,26 +90,32 @@ class ShuffleIterator:
         for block in local:
             meta = self.local_catalog.meta(block)
             if meta is None:
-                raise ShuffleFetchFailedError(
-                    block, self.local_executor_id, "missing local block")
+                # the tracked-block-lost-by-owner contract
+                # (shuffle/cluster.py write_map_output): a block the
+                # tracker promised is a fetch failure, never a skip
+                raise self._failed([block], self.local_executor_id,
+                                   "missing local block")
             self.local_blocks_read += 1
             if meta.num_rows == 0:
                 continue
             ctx = self.local_catalog.acquire_batch(block)
             with ctx as batch:
+                self.batches_yielded += 1
                 yield batch
         for executor, blocks in sorted(by_peer.items()):
             client = self.client_for(executor)
             try:
                 results = client.fetch(blocks)
             except (TransportError, TimeoutError, KeyError) as e:
-                raise ShuffleFetchFailedError(blocks[0], executor, e)
+                raise self._failed(blocks, executor, e)
             for meta, payload in results:
                 self.remote_blocks_read += 1
                 if payload is None:
                     continue
                 self.remote_bytes_read += len(payload)
                 try:
-                    yield self.local_catalog.deserialize_payload(payload)
+                    batch = self.local_catalog.deserialize_payload(payload)
                 except ValueError as e:  # checksum/corruption
-                    raise ShuffleFetchFailedError(meta.block, executor, e)
+                    raise self._failed([meta.block], executor, e)
+                self.batches_yielded += 1
+                yield batch

@@ -217,7 +217,14 @@ class TcpConnection(Connection):
         return self._sock
 
     def _roundtrip(self, header: dict, timeout: float):
+        from spark_rapids_tpu.shuffle import fault_injection
+
         with self._lock:
+            if fault_injection.get_injector().should_drop():
+                self._drop()
+                raise TransportError(
+                    f"transport to {self._addr} failed: injected "
+                    f"connection drop")
             sock = self._ensure(timeout)
             try:
                 _send_frame(sock, header)
@@ -280,10 +287,15 @@ class TcpConnection(Connection):
 
     def request_chunk(self, block: BlockId, offset: int, length: int,
                       timeout: float = 30.0) -> bytes:
+        from spark_rapids_tpu.shuffle import fault_injection
+
         _, payload = self._roundtrip_retrying(
             {"op": "chunk", "block": _block_to_wire(block),
              "offset": offset, "length": length}, timeout)
-        return payload
+        # injected truncation sits ABOVE the retry loop on purpose: the
+        # client's short-chunk check then escalates straight to a fetch
+        # failure, the same path a mid-transfer peer crash takes
+        return fault_injection.get_injector().maybe_truncate(payload)
 
     def release(self, block: BlockId) -> None:
         try:

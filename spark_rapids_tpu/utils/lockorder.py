@@ -117,8 +117,10 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "memory.retry.policy": 160,
     "memory.retry.stats": 164,
     "memory.faultInjection": 168,
+    "shuffle.faultInjection": 170,   # transport/worker fault injector
     "utils.dispatch.stage": 172,
     "parallel.spmd.fallbacks": 176,  # fallback-reason counters
+    "runtime.recovery.stats": 178,   # process-global recovery counters
     "service.streaming.stats": 180,  # process-global fold counters
     "native.init": 184,
     "shims.init": 188,

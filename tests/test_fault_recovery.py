@@ -1,0 +1,391 @@
+"""Lineage-based fault recovery: the ladder that survives worker death,
+fetch failures, and transport faults mid-query.
+
+The reference escalates shuffle transport errors into Spark fetch
+failures so the scheduler invalidates the dead executor's MapStatus and
+re-runs the lost map tasks (RapidsShuffleIterator.scala:242-300); this
+file fences our port of that ladder rung by rung — the deterministic
+fault injector (shuffle/fault_injection.py), the multi-block fetch
+failure contract, stale-client eviction against a RESTARTED peer, the
+worker-handle liveness timeout + close() drain (a hung or oversized
+reply must never deadlock the driver), the LocalCluster
+lose/invalidate/re-register round trip, and the SPMD in-program
+exchange degrading to the host path on a device error. The end-to-end
+composition (kill + drop + truncate inside one query, oracle-matched)
+lives in scripts/dist_chaos_check.py."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.runtime import recovery
+from spark_rapids_tpu.shuffle import LocalCluster, ShuffleFetchFailedError
+from spark_rapids_tpu.shuffle.fault_injection import (ShuffleFaultInjector,
+                                                      arm_from_conf,
+                                                      get_injector)
+from spark_rapids_tpu.shuffle.meta import BlockId
+from spark_rapids_tpu.shuffle.remote_worker import make_block_batch
+
+from test_tcp_shuffle import batch_values, expect_values, spawn_worker
+
+# the fault-recovery fence rides the chaos tier (runs in tier-1;
+# scripts/dist_chaos_check.py is the CLI twin with --fast for CI)
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    get_injector().disarm()
+
+
+# ------------------------------------------------------------- injector
+
+
+def test_trigger_fires_at_nth_with_burst():
+    inj = ShuffleFaultInjector()
+    inj.arm(drop_at_request=3, consecutive=2)
+    # requests 3 and 4 drop (burst of 2), nothing before or after
+    assert [inj.should_drop() for _ in range(6)] == \
+        [False, False, True, True, False, False]
+    assert inj.stats()["drops"] == 2
+    assert inj.stats()["requests"] == 6
+
+
+def test_truncate_halves_the_nth_chunk_payload():
+    inj = ShuffleFaultInjector()
+    inj.arm(truncate_at_request=2)
+    payload = bytes(range(64))
+    assert inj.maybe_truncate(payload) == payload
+    short = inj.maybe_truncate(payload)
+    assert short == payload[:32]
+    assert inj.maybe_truncate(payload) == payload
+    assert inj.stats()["truncations"] == 1
+    # sub-2-byte payloads are never eligible (nothing to halve)
+    inj.arm(truncate_at_request=1)
+    assert inj.maybe_truncate(b"x") == b"x"
+
+
+def test_seeded_probability_is_deterministic_and_capped():
+    def run():
+        inj = ShuffleFaultInjector()
+        inj.arm(probability=0.5, seed=1234, max_injections=3)
+        return [inj.should_drop() for _ in range(40)]
+
+    a, b = run(), run()
+    assert a == b  # same seed, same drops
+    assert sum(a) == 3  # max_injections caps the chaos sweep
+
+
+def test_kill_trigger_and_disarm():
+    inj = ShuffleFaultInjector()
+    inj.arm(kill_before_task=2)
+    assert [inj.should_kill_task() for _ in range(3)] == \
+        [False, True, False]
+    inj.disarm()
+    assert not inj.armed
+    assert not inj.should_drop()
+    assert inj.maybe_truncate(b"abcd") == b"abcd"
+
+
+def test_arm_from_conf_roundtrip():
+    conf = RapidsConf({
+        cfg.SHUFFLE_FI_ENABLED.key: True,
+        cfg.SHUFFLE_FI_DROP_AT.key: 5,
+        cfg.SHUFFLE_FI_CONSECUTIVE.key: 4,
+        cfg.SHUFFLE_FI_MAX.key: 9})
+    assert arm_from_conf(conf)
+    inj = get_injector()
+    assert inj.armed
+    fired = [inj.should_drop() for _ in range(10)]
+    assert fired.index(True) == 4 and sum(fired) == 4
+    assert not arm_from_conf(RapidsConf({}))
+    assert not inj.armed
+
+
+# ------------------------------------- fetch-failure contract (S2)
+
+
+def test_fetch_failed_error_carries_all_blocks_and_progress():
+    blocks = [BlockId(7, m, 0) for m in range(3)]
+    e = ShuffleFetchFailedError(blocks, "exec-9", "boom",
+                                batches_yielded=5)
+    assert e.blocks == blocks and e.block == blocks[0]
+    assert e.executor_id == "exec-9" and e.batches_yielded == 5
+    assert "3 block(s)" in str(e) and "5 yielded" in str(e)
+    # single-block call sites pass a bare BlockId
+    e1 = ShuffleFetchFailedError(BlockId(1, 2, 3), "exec-0", "x")
+    assert e1.blocks == [BlockId(1, 2, 3)]
+    with pytest.raises(AssertionError):
+        ShuffleFetchFailedError([], "exec-0", "empty")
+
+
+def test_peer_fetch_failure_names_every_lost_block(tmp_path):
+    """One dead peer holding TWO maps of the partition: the fetch
+    failure lists both blocks, so recovery invalidates exactly the lost
+    maps in one shot instead of discovering them one stage-retry at a
+    time."""
+    c = LocalCluster(2, spill_dir=str(tmp_path), transport="tcp")
+    try:
+        c.write_map_output(3, 0, 0, {0: make_block_batch(0, 10)})
+        c.write_map_output(3, 1, 1, {0: make_block_batch(100, 10)})
+        c.write_map_output(3, 2, 1, {0: make_block_batch(200, 10)})
+        # executor 1 dies: socket gone, both its maps unreachable
+        c.transport._servers["exec-1"].close()
+        with pytest.raises(ShuffleFetchFailedError) as ei:
+            list(c.read_partition(3, 0, reader_executor_index=0))
+        e = ei.value
+        assert e.executor_id == "exec-1"
+        assert sorted(b.map_id for b in e.blocks) == [1, 2]
+    finally:
+        c.shutdown()
+
+
+# --------------------------- lose/invalidate/re-register round trip (S4)
+
+
+def test_local_cluster_recovery_round_trip(tmp_path):
+    """The full LocalCluster-level lineage cycle: an executor loses its
+    cached blocks, the tracked read converts to a fetch failure (never a
+    silent skip), invalidation returns exactly the lost maps, the re-run
+    lands on a survivor, and the re-read serves complete data."""
+    c = LocalCluster(3, spill_dir=str(tmp_path), transport="tcp")
+    try:
+        spans = {0: (0, 30), 1: (100, 30), 2: (200, 30)}
+        for mid, (lo, n) in spans.items():
+            c.write_map_output(11, mid, mid, {0: make_block_batch(lo, n)})
+        # tracked-block-lost-by-owner: executor 1's catalog empties but
+        # the tracker still names it
+        c.lose_executor(1)
+        with pytest.raises(ShuffleFetchFailedError) as ei:
+            list(c.read_partition(11, 0, reader_executor_index=0))
+        assert ei.value.executor_id == "exec-1"
+
+        lost = c.invalidate_map_output(11, "exec-1")
+        assert lost == [1]
+        # re-registration is idempotent against double-invalidation
+        assert c.invalidate_map_output(11, "exec-1") == []
+        for mid in lost:
+            lo, n = spans[mid]
+            c.write_map_output(11, mid, 2, {0: make_block_batch(lo, n)})
+        got = []
+        for b in c.read_partition(11, 0, reader_executor_index=0):
+            got.extend(v for v in batch_values(b) if v is not None)
+        assert sorted(got) == expect_values(list(spans.values()))
+    finally:
+        c.shutdown()
+
+
+def test_owner_lost_local_block_is_fetch_failure(tmp_path):
+    """The OWNER itself reads a tracked block it no longer holds: still
+    a fetch failure naming the local executor — partial results must be
+    impossible, even for local hits."""
+    c = LocalCluster(2, spill_dir=str(tmp_path), transport="tcp")
+    try:
+        c.write_map_output(4, 0, 0, {0: make_block_batch(0, 10)})
+        c.lose_executor(0)
+        with pytest.raises(ShuffleFetchFailedError) as ei:
+            list(c.read_partition(4, 0, reader_executor_index=0))
+        assert ei.value.executor_id == "exec-0"
+        assert "missing local block" in str(ei.value)
+    finally:
+        c.shutdown()
+
+
+# --------------------------------------- stale-client eviction (S1)
+
+
+def test_restarted_peer_reachable_after_eviction(tmp_path):
+    """A peer dies and RESTARTS on a new port: the first failed fetch
+    must evict the cached client, so after re-registration the next
+    read connects to the new address instead of failing on the stale
+    socket forever (the bug: _clients cached broken connections for the
+    process lifetime)."""
+    c = LocalCluster(1, spill_dir=str(tmp_path), transport="tcp")
+    procs = []
+    try:
+        proc, host, port = spawn_worker({
+            "executor_id": "exec-remote",
+            "blocks": [[21, 0, 0, 0, 50]]})
+        procs.append(proc)
+        c.register_remote_executor("exec-remote", host, port)
+        c.register_remote_map_output(21, 0, "exec-remote", {0})
+        got = [v for b in c.read_partition(21, 0, 0)
+               for v in batch_values(b) if v is not None]
+        assert sorted(got) == expect_values([(0, 50)])
+        assert ("exec-0", "exec-remote") in c._clients
+
+        proc.kill()
+        proc.wait()
+        with pytest.raises(ShuffleFetchFailedError):
+            list(c.read_partition(21, 0, reader_executor_index=0))
+        # the failure evicted the broken client
+        assert ("exec-0", "exec-remote") not in c._clients
+
+        # same executor id, NEW process, NEW port
+        proc2, host2, port2 = spawn_worker({
+            "executor_id": "exec-remote",
+            "blocks": [[21, 0, 0, 0, 50]]})
+        procs.append(proc2)
+        c.register_remote_executor("exec-remote", host2, port2)
+        got = [v for b in c.read_partition(21, 0, 0)
+               for v in batch_values(b) if v is not None]
+        assert sorted(got) == expect_values([(0, 50)])
+    finally:
+        for p in procs:
+            p.kill()
+        c.shutdown()
+
+
+# --------------------------- worker handle liveness + close() (S3)
+
+
+def _spawn_handle(executor_id, **kw):
+    from spark_rapids_tpu.runtime.cluster import RemoteWorkerHandle
+
+    return RemoteWorkerHandle.spawn(executor_id, **kw)
+
+
+def test_close_survives_oversized_error_reply():
+    """Regression: a worker blocked mid-write on a reply larger than
+    the OS pipe buffer (here a traceback embedding an 8 MiB command)
+    used to deadlock close() — the driver waited for exit while the
+    worker waited for the driver to read. The reader thread keeps
+    draining, so close() must finish promptly and leave no process."""
+    h = _spawn_handle("exec-close-test")
+    # the task loop asserts cmd == run_map with the OFFENDING dict in
+    # the assertion message — the error reply embeds all 8 MiB
+    h.proc.stdin.write(json.dumps(
+        {"cmd": "boom", "junk": "z" * (8 << 20)}) + "\n")
+    h.proc.stdin.flush()
+    t0 = time.monotonic()
+    h.close()
+    took = time.monotonic() - t0
+    assert took < 10.0, f"close() stalled {took:.1f}s"
+    assert not h.alive
+
+
+def test_run_map_times_out_on_hung_worker():
+    """A worker that stops responding mid-task: run_map bounds its wait
+    (taskTimeoutSec), KILLS the hung process (a late completion must
+    never double-register output), and raises ConnectionError so the
+    scheduler re-places the task."""
+
+    class _SleepBomb:
+        def __reduce__(self):
+            return (time.sleep, (30,))
+
+    h = _spawn_handle("exec-hang-test", task_timeout=1.0)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError) as ei:
+        h.run_map({"bomb": _SleepBomb()})
+    took = time.monotonic() - t0
+    assert "unresponsive" in str(ei.value)
+    assert took < 15.0
+    assert not h.alive  # killed, not left hanging
+    h.close()
+
+
+def test_run_map_reports_death_at_submit():
+    h = _spawn_handle("exec-dead-test")
+    h.kill()
+    with pytest.raises(ConnectionError):
+        h.run_map({"shuffle_id": 0})
+    h.close()
+
+
+def test_injected_kill_fires_before_nth_task():
+    get_injector().arm(kill_before_task=1)
+    h = _spawn_handle("exec-kill-test")
+    try:
+        with pytest.raises(ConnectionError):
+            h.run_map({"shuffle_id": 0})
+        assert not h.alive
+        assert get_injector().stats()["kills"] == 1
+    finally:
+        h.close()
+
+
+# ------------------------------------------- SPMD degrade (tentpole d)
+
+
+def test_in_program_exchange_degrades_to_host_on_device_error():
+    """A device error inside the compiled in-program exchange: the
+    leader catches it, records the degrade, and the SAME exchange
+    re-materializes on the host/TCP path — identical results, one
+    degrade per query, never a crash. InjectedOOM classifies as a
+    device error, so the CPU fence drives the real except path."""
+    from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.memory import fault_injection as mem_fi
+    from spark_rapids_tpu.parallel import spmd
+    from spark_rapids_tpu.parallel.mesh import data_mesh
+
+    rng = np.random.default_rng(77)
+    n = 120
+    keys = rng.integers(0, 9, n).astype(np.int64)
+    kv = np.ones(n, dtype=bool)
+    vals = rng.random(n)
+    parts = [[(keys, kv, vals)]]
+
+    from test_spmd_shuffle import _drain_exchange, _rows_exec
+
+    host = ShuffleExchangeExec(("hash", [0]), 4, _rows_exec(parts))
+    want = _drain_exchange(host)
+
+    prog = ShuffleExchangeExec(("hash", [0]), 4, _rows_exec(parts))
+    prog.enable_in_program(data_mesh(8))
+    before = recovery.snapshot()
+    fb_before = spmd.fallback_snapshot()
+    mem_fi.get_injector().arm(at_call=1, sites=["exchange.inProgram"])
+    try:
+        got = _drain_exchange(prog)
+    finally:
+        mem_fi.get_injector().disarm()
+
+    assert got == want  # bit-identical partition placement
+    assert not prog.in_program  # degraded once, stays host for the query
+    assert recovery.delta(before)["spmd_degrades"] == 1
+    fb = spmd.fallback_delta(fb_before)
+    assert fb == {f"exchange: {spmd.DEGRADE_DEVICE_ERROR}": 1}
+
+
+def test_in_program_exchange_reraises_non_device_errors():
+    """A plan/user error inside the in-program path is NOT degradable:
+    it would fail identically on the host, so it surfaces unchanged
+    (degrading would just run the query twice to the same failure)."""
+    from spark_rapids_tpu.parallel import spmd
+
+    assert not spmd.is_degradable_device_error(ValueError("bad plan"))
+    assert not spmd.is_degradable_device_error(KeyError("col"))
+    from spark_rapids_tpu.memory.fault_injection import InjectedOOM
+
+    assert spmd.is_degradable_device_error(InjectedOOM("site", 1))
+    assert spmd.is_degradable_device_error(MemoryError())
+
+
+# ------------------------------------------------- recovery counters
+
+
+def test_recovery_counter_snapshot_delta():
+    before = recovery.snapshot()
+    recovery.bump("fetch_failures")
+    recovery.bump("maps_rerun", 3)
+    d = recovery.delta(before)
+    assert d["fetch_failures"] == 1 and d["maps_rerun"] == 3
+    assert d["workers_respawned"] == 0
+    assert set(d) == set(recovery.snapshot())
+
+
+def test_service_stats_carry_recovery_block():
+    from spark_rapids_tpu.service.stats import ServiceStats
+
+    s = ServiceStats(
+        queue_depth=0, running=0, admitted_inflight=0, inflight_bytes=0,
+        budget_bytes=None, counters={}, queue_time_hist={},
+        run_time_hist={}, per_query=[], progcache={}, semaphore={},
+        recovery=recovery.snapshot())
+    d = s.to_dict()
+    assert set(d["recovery"]) == set(recovery.snapshot())
