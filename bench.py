@@ -166,14 +166,43 @@ def _service_warmup(runner, benchmark: str):
 
     runner.ensure_data(benchmark)
     plan = ALL_BENCHMARKS[benchmark](runner.data_dir)
+    # single-query run: rungs above the input's own bucket can never be
+    # hit, so cap the ladder replay there (BENCH_r08 showed an 11.75 s
+    # full-ladder warmup for a 1.6 s q26 run)
+    max_rung = _input_rung(plan)
     svc = QueryService({cfg.SERVICE_WARMUP_ENABLED.key: True})
     try:
-        report = svc.register_template(plan, name=benchmark) or {}
+        report = svc.register_template(plan, name=benchmark,
+                                       max_rung=max_rung) or {}
     finally:
         svc.shutdown()
+    ladder = report.get("ladder") or {}
     return {"templates": report.get("templates"),
-            "ladder_rungs": len(report.get("ladder") or {}),
+            "ladder_replays": ladder.get("replays"),
+            "rungs_skipped": ladder.get("rungs_skipped"),
+            "max_rung": max_rung,
             "seconds": report.get("seconds")}
+
+
+def _input_rung(plan):
+    """Ladder bucket of the query's largest input table (from scan-leaf
+    row-count estimates), or None when any leaf count is unknown."""
+    from spark_rapids_tpu.ops import buckets as _ladder
+    from spark_rapids_tpu.plan.nodes import ScanNode
+
+    rows = []
+    stack = [getattr(plan, "_plan", plan)]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ScanNode):
+            n = node.source.estimated_row_count()
+            if n is None:
+                return None
+            rows.append(int(n))
+        stack.extend(node.children)
+    if not rows:
+        return None
+    return _ladder.bucket_capacity(max(rows))
 
 
 def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
@@ -223,6 +252,10 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
         # a regression in fusion shows up as a program-name diff rather
         # than a bare count bump (round-7)
         "per_stage_programs": dt.get("per_stage_programs"),
+        # measured on-device seconds per (stage, program) from the
+        # serialized timing pass — the stage breakdown in TIME, not
+        # just round trips (a stage can be 1 dispatch and 4 seconds)
+        "per_stage_device_s": devt.get("per_stage_programs_device_s"),
         # mesh-requested shuffles that stayed on the host/TCP path,
         # with the spmd gate's reason (empty = all folded in-program)
         "shuffle_fallbacks": dt.get("shuffle_fallbacks"),
@@ -269,14 +302,22 @@ def _scale_main():
     sf = arg("--sf", 1.0, float)
     budget = arg("--device-budget", 0, int)
     iters = arg("--iterations", 2, int)
+    kernels = "--kernels" in sys.argv
     conf = None
-    if budget:
+    if budget or kernels:
         from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.config import RapidsConf
         from spark_rapids_tpu.runtime import device as rt
 
-        conf = RapidsConf({cfg.DEVICE_BUDGET.key: budget})
-        rt.initialize(conf)  # installs the budgeted spill catalog
+        conf_d = {}
+        if budget:
+            conf_d[cfg.DEVICE_BUDGET.key] = budget
+        if kernels:
+            # native Pallas kernel gates are process-wide (same
+            # contract as memory/retry): initialize applies them
+            conf_d[cfg.NATIVE_KERNELS_ENABLED.key] = True
+        conf = RapidsConf(conf_d)
+        rt.initialize(conf)  # budgeted spill catalog + kernel gates
     full = bench_full_query(benchmark, sf=sf,
                             warmup_service="--no-warmup" not in sys.argv,
                             conf=conf, iterations=iters,

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Native-kernel fence: every Pallas kernel must agree bit-for-bit with
+the jnp/host implementation it replaces (any backend — CPU CI runs the
+kernels through the Pallas interpreter), and on a real TPU at least one
+op must clear the 2x speedup that justifies the layer.
+
+    python scripts/kernel_check.py            # exit 0 = fence holds
+    python scripts/kernel_check.py --rows N   # smaller/larger probe
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=500_000)
+    ap.add_argument("--iterations", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.benchmarks import kernel_bench
+
+    rec = kernel_bench.run(args.rows, args.iterations)
+    failures = []
+    for name, op in rec["ops"].items():
+        if not op["equal"]:
+            failures.append(f"{name}: kernel != jnp oracle")
+    if rec["backend"] == "tpu" and rec["max_ratio"] < 2.0:
+        failures.append(
+            f"tpu: no op reached 2x vs jnp (max {rec['max_ratio']}x)")
+    rec["ok"] = not failures
+    rec["failures"] = failures
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

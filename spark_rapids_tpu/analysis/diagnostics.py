@@ -25,6 +25,9 @@ CODES = {
               "function that never quantizes through ops/buckets",
     "TPU203": "jnp scalar/array literal without an explicit dtype "
               "(weak-type promotion drifts program signatures)",
+    "TPU204": "pallas_call outside the native/kernels registry wrapper "
+              "(bypasses the interpret-mode gate: dead code on CPU CI "
+              "or a crash off-TPU)",
     # -- TPU3xx: concurrency --------------------------------------------
     "TPU301": "lock acquisition order inverts the declared hierarchy "
               "(utils/lockorder.py)",

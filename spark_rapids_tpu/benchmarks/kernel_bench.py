@@ -1,0 +1,222 @@
+"""Native-kernel microbench: each Pallas kernel vs the jnp (or host)
+implementation it replaces, op by op (KERNEL_r01 record).
+
+Four ops, matching the three gated kernel kinds plus the fused-chain
+compaction the sort kernel also serves:
+
+- ``compact``     partition_order + takes  vs  stable argsort(~mask) + takes
+- ``join_probe``  device hash-table probe  vs  two searchsorted passes
+- ``lexsort``     LSD radix lexsort        vs  jnp.lexsort over key arrays
+- ``string_contains``  char-table kernel   vs  the host dictionary map
+
+Every op asserts bit-equality between the two paths before timing —
+``scripts/kernel_check.py`` turns that into the CI fence (equality on
+any backend; the >=2x ratio only on a real TPU, where the kernels are
+compiled rather than interpreted).
+
+    python -m spark_rapids_tpu.benchmarks.kernel_bench --rows 2000000
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _time(fn, iterations: int, warmup: int = 1) -> float:
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(iterations):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_compact(rows: int, iterations: int, seed: int = 3) -> dict:
+    """Fused-chain row compaction: permutation-from-liveness + payload
+    gathers. The baseline is what execs/fused.run_steps does with the
+    gate off; the kernel path is what it does with the gate on."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.native.kernels import sort as nsort
+
+    r = np.random.default_rng(seed)
+    mask = jnp.asarray(r.random(rows) > 0.5)
+    pays = [jnp.asarray(r.integers(0, 10**9, rows)) for _ in range(3)]
+
+    @jax.jit
+    def base(m, ps):
+        order = jnp.argsort(~m, stable=True)
+        return [jnp.take(p, order) for p in ps]
+
+    @jax.jit
+    def kern(m, ps):
+        order = nsort.partition_order(m)
+        return [jnp.take(p, order) for p in ps]
+
+    b = jax.device_get(base(mask, pays))
+    k = jax.device_get(kern(mask, pays))
+    equal = all(np.array_equal(x, y) for x, y in zip(b, k))
+    base_s = _time(lambda: base(mask, pays), iterations)
+    kern_s = _time(lambda: kern(mask, pays), iterations)
+    return {"n": rows, "jnp_s": round(base_s, 4),
+            "kernel_s": round(kern_s, 4),
+            "ratio": round(base_s / kern_s, 3), "equal": bool(equal)}
+
+
+def bench_join_probe(build_rows: int, probe_rows: int, iterations: int,
+                     seed: int = 5) -> dict:
+    """Probe side of the hash join, build table amortized (the
+    build-once/probe-many contract of ops/join.prepare_build)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.native.kernels import join as njoin
+
+    r = np.random.default_rng(seed)
+    h_b = jnp.sort(jnp.asarray(
+        r.integers(-2**62, 2**62, build_rows)))
+    h_p = jnp.asarray(np.concatenate([
+        r.choice(np.asarray(jax.device_get(h_b)), probe_rows // 2),
+        r.integers(-2**62, 2**62, probe_rows - probe_rows // 2)]))
+    n_valid = jnp.asarray(build_rows)
+    table = jax.block_until_ready(njoin.build_table(
+        h_b, n_valid, njoin.table_bits_for(build_rows)))
+
+    @jax.jit
+    def base(sh, hp):
+        lo = jnp.searchsorted(sh, hp, side="left")
+        hi = jnp.searchsorted(sh, hp, side="right")
+        return lo, hi - lo
+
+    @jax.jit
+    def kern(t, hp):
+        return njoin.probe(t, hp)
+
+    bl, bc = jax.device_get(base(h_b, h_p))
+    kl, kc = jax.device_get(kern(table, h_p))
+    equal = np.array_equal(bl, kl) and np.array_equal(bc, kc)
+    base_s = _time(lambda: base(h_b, h_p), iterations)
+    kern_s = _time(lambda: kern(table, h_p), iterations)
+    return {"n": probe_rows, "jnp_s": round(base_s, 4),
+            "kernel_s": round(kern_s, 4),
+            "ratio": round(base_s / kern_s, 3), "equal": bool(equal)}
+
+
+def bench_lexsort(rows: int, iterations: int, seed: int = 7) -> dict:
+    """Permutation-producing lexsort over a composite radixable key
+    (null-rank + int64 + int32), the ops/sortkeys routing pair."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.native.kernels import sort as nsort
+    from spark_rapids_tpu.ops import sortkeys
+    from spark_rapids_tpu.ops.sortkeys import SortKeySpec
+
+    r = np.random.default_rng(seed)
+    k1 = jnp.asarray(r.integers(-10**12, 10**12, rows))
+    v1 = jnp.asarray(r.random(rows) > 0.1)
+    k2 = jnp.asarray(r.integers(0, 100, rows).astype(np.int32))
+    cols = [(k1, v1), (k2, None)]
+    dtypes = [dt.INT64, dt.INT32]
+    specs = [SortKeySpec(0, ascending=False, nulls_first=False),
+             SortKeySpec(1)]
+    num_rows = jnp.asarray(rows)
+
+    @jax.jit
+    def base(c0, c0v, c1, n):
+        keys = sortkeys.order_key_arrays(
+            [(c0, c0v), (c1, None)], dtypes, specs, n)
+        return jnp.lexsort(list(reversed(keys)))
+
+    @jax.jit
+    def kern(c0, c0v, c1, n):
+        return nsort.lexsort_order(
+            [(c0, c0v), (c1, None)], dtypes, specs, n)
+
+    b = np.asarray(jax.device_get(base(k1, v1, k2, num_rows)))
+    k = np.asarray(jax.device_get(kern(k1, v1, k2, num_rows)))
+    equal = np.array_equal(b, k)
+    base_s = _time(lambda: base(k1, v1, k2, num_rows), iterations)
+    kern_s = _time(lambda: kern(k1, v1, k2, num_rows), iterations)
+    return {"n": rows, "jnp_s": round(base_s, 4),
+            "kernel_s": round(kern_s, 4),
+            "ratio": round(base_s / kern_s, 3), "equal": bool(equal)}
+
+
+def bench_string_contains(dict_entries: int, iterations: int,
+                          seed: int = 11) -> dict:
+    """contains() over the dictionary: device char-table kernel vs the
+    host per-entry python map (the expressions/strings fallback)."""
+    import jax
+
+    from spark_rapids_tpu.native.kernels import strings as nks
+
+    r = np.random.default_rng(seed)
+    alpha = np.array(list("abcdefgh"))
+    dic = np.array(
+        ["".join(r.choice(alpha, r.integers(2, 24)))
+         for _ in range(dict_entries)], dtype=object)
+    dic = np.unique(dic.astype(str)).astype(object)
+    needle = "cde"
+    chars, lens, ascii_only = nks.encode_dictionary(dic)
+
+    def host():
+        return np.array([needle in s for s in dic])
+
+    def kern():
+        return nks._match_table(chars, lens, "contains",
+                                needle.encode("utf-8"))
+
+    equal = np.array_equal(host(), np.asarray(jax.device_get(kern())))
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        host()
+    host_s = (time.perf_counter() - t0) / iterations
+    kern_s = _time(kern, iterations)
+    return {"n": int(len(dic)), "jnp_s": round(host_s, 4),
+            "kernel_s": round(kern_s, 4),
+            "ratio": round(host_s / kern_s, 3), "equal": bool(equal)}
+
+
+def run(rows: int = 2_000_000, iterations: int = 3) -> dict:
+    import jax
+
+    import spark_rapids_tpu  # noqa: F401  (x64 on)
+    from spark_rapids_tpu.native import kernels as nk
+
+    ops = {
+        "compact": bench_compact(rows, iterations),
+        "join_probe": bench_join_probe(
+            max(rows // 8, 1024), rows, iterations),
+        "lexsort": bench_lexsort(max(rows // 4, 1024), iterations),
+        "string_contains": bench_string_contains(20_000, iterations),
+    }
+    return {
+        "metric": "native_kernel_vs_jnp",
+        "backend": jax.default_backend(),
+        "interpret": nk.interpret_mode(),
+        "ops": ops,
+        "all_equal": all(o["equal"] for o in ops.values()),
+        "max_ratio": max(o["ratio"] for o in ops.values()),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2_000_000)
+    ap.add_argument("--iterations", type=int, default=3)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(args.rows, args.iterations)))
+
+
+if __name__ == "__main__":
+    main()

@@ -243,12 +243,21 @@ class BenchmarkRunner:
                 for k, (c, s) in sorted(
                     (i for i in kt.items() if i[0] != "__total__"),
                     key=lambda i: i[1][1], reverse=True)[:12]}
+            # same seconds split per (stage, program): "stage0 spends
+            # 2.1s in chain@a1b2" rather than a global program total
+            per_stage_device = {
+                label: {p: {"calls": c, "device_s": round(s, 4)}
+                        for p, (c, s) in sorted(
+                            progs.items(), key=lambda i: i[1][1],
+                            reverse=True)[:8]}
+                for label, progs in disp.stage_device_times().items()}
             result["device_timing"] = {
                 "mode": "serialized",
                 "wall_s": round(wall_m, 3),
                 "on_device_s": round(kt["__total__"][1], 4),
                 "timed_jit_calls": kt["__total__"][0],
                 "per_kernel": per_kernel,
+                "per_stage_programs_device_s": per_stage_device,
             }
         result["query_plan"] = exec_.tree_string()
         result["metrics"] = {

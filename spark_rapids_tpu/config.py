@@ -429,6 +429,47 @@ FUSION_IN_PROGRAM_BUILD = conf(
     "restore the standalone host-side prepare_builds launch."
 ).boolean_conf.create_with_default(True)
 
+NATIVE_KERNELS_ENABLED = conf("rapids.tpu.native.kernels.enabled").doc(
+    "Master switch for the native Pallas kernel layer "
+    "(spark_rapids_tpu/native/kernels): hand-written device kernels "
+    "replacing the jnp graphs where XLA's lowering is the measured "
+    "floor — the open-addressing hash-join probe, the prefix-scan "
+    "partition/segmented sort, and the dictionary-string predicate "
+    "kernels. On CPU backends every kernel runs through Pallas "
+    "interpret mode, so CI exercises the exact kernel code that "
+    "compiles for TPU. Off by default: the jnp implementations remain "
+    "the reference semantics and every kernel is differentially "
+    "fenced against them."
+).boolean_conf.create_with_default(False)
+
+NATIVE_KERNELS_JOIN = conf("rapids.tpu.native.kernels.join").doc(
+    "Route equi-join probes through the native open-addressing hash "
+    "table kernel: the build side becomes a device-resident bucketed "
+    "table (built once, probed across every stream batch) and the "
+    "probe is one gather-scan kernel — replacing both the dense "
+    "inverse-table and the hash+searchsorted probe dichotomy. "
+    "Requires rapids.tpu.native.kernels.enabled."
+).boolean_conf.create_with_default(True)
+
+NATIVE_KERNELS_SORT = conf("rapids.tpu.native.kernels.sort").doc(
+    "Route row compaction and multi-column (segmented) sorts through "
+    "the native prefix-scan kernels: live-mask compaction becomes one "
+    "O(n) scan+scatter instead of a stable argsort, and ORDER BY "
+    "permutations run as binary-radix passes over order keys instead "
+    "of the variadic sort network whose payload carry blows up past "
+    "6 lanes. Requires rapids.tpu.native.kernels.enabled."
+).boolean_conf.create_with_default(True)
+
+NATIVE_KERNELS_STRINGS = conf("rapids.tpu.native.kernels.strings").doc(
+    "Evaluate dictionary-string predicates (LIKE / contains / "
+    "startswith / endswith / substring) with the native char-table "
+    "kernels: the dictionary's code+offset char matrix is scanned on "
+    "device instead of transforming every dictionary entry through a "
+    "host Python loop. Patterns outside the kernel's LIKE subset "
+    "(custom ESCAPE) fall back to the host path automatically. "
+    "Requires rapids.tpu.native.kernels.enabled."
+).boolean_conf.create_with_default(True)
+
 GROUPBY_SINGLE_PASS = conf(
     "rapids.tpu.sql.groupby.singlePass.enabled").doc(
     "Emit wide group-bys (more than 6 aggregate columns) as ONE "

@@ -23,7 +23,8 @@ from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.execs.batching import RequireSingleBatch
 from spark_rapids_tpu.expressions.base import Expression
 from spark_rapids_tpu.expressions.compiler import CompiledFilter
-from spark_rapids_tpu.ops.join import cross_join, equi_join, nested_loop_join
+from spark_rapids_tpu.ops.join import (cross_join, equi_join,
+                                       nested_loop_join, prepare_build)
 from spark_rapids_tpu.utils.tracing import TraceRange
 
 _KIND_MAP = {"inner": "inner", "left": "left", "left_semi": "leftsemi",
@@ -123,12 +124,13 @@ class HashJoinExec(TpuExec):
         return merged
 
     def _probe_retry(self, b: ColumnarBatch, build: ColumnarBatch,
-                     left_types, right_types, tag: str):
+                     left_types, right_types, tag: str, prepared=None):
         """Probe one stream batch under split-and-retry: the stream
         side halves freely for every kind except full (a full join
         emits unmatched BUILD rows once per probe call, so its single
         stream batch must stay whole). Returns one output per final
-        sub-batch."""
+        sub-batch. ``prepared`` is the build-once/probe-many state
+        shared across stream batches (constant under stream splits)."""
         from spark_rapids_tpu.memory import retry as _retry
 
         split = _retry.halve_batch if self.kind != "full" else None
@@ -137,7 +139,8 @@ class HashJoinExec(TpuExec):
             lambda bb: equi_join(bb, build, self.left_keys,
                                  self.right_keys, left_types,
                                  right_types,
-                                 join_type=_KIND_MAP[self.kind])[0],
+                                 join_type=_KIND_MAP[self.kind],
+                                 prepared=prepared)[0],
             split=split, tag=tag)
         if self.condition is not None:
             outs = [self.condition(out) for out in outs]
@@ -165,6 +168,12 @@ class HashJoinExec(TpuExec):
                     stream_staged, self.children[0].schema)]
             else:
                 stream_batches = self.children[0].execute(partition)
+            # build-once/probe-many: hash + sort (+ bucket table with
+            # the join kernel on) a single time, reused by every stream
+            # batch below (None when a join key is a string column)
+            prepared = prepare_build(
+                build, self.right_keys, right_types,
+                [left_types[o] for o in self.left_keys])
             saw = False
             for b in stream_batches:
                 if b.realized_num_rows() == 0 and saw:
@@ -173,7 +182,8 @@ class HashJoinExec(TpuExec):
                 with TraceRange(f"HashJoinExec.{self.kind}"):
                     outs = self._probe_retry(b, build, left_types,
                                              right_types,
-                                             tag="join.probe")
+                                             tag="join.probe",
+                                             prepared=prepared)
                 yield from outs
         return timed(self, it())
 

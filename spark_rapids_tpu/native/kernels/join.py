@@ -1,0 +1,153 @@
+"""Open-addressing hash-table build + probe kernel for equi-joins.
+
+The jnp join probes pay one of two costs per stream batch (the
+dense/hash dichotomy in ops/join.py + execs/fused._apply_join):
+
+- dense mode: a prep-time inverse table over the key's value range —
+  only exists below the span ceiling, single integral keys only;
+- hash mode: ``searchsorted`` into the hash-sorted build — a ~17-step
+  binary-search gather loop per probe, re-paid every batch.
+
+This kernel replaces both with ONE device-resident bucketed table,
+built once per build side and probed across every stream batch,
+composite keys included (they are already folded into the 64-bit row
+hash):
+
+  build:  the hash-sorted build column (already produced by
+          ``_prep_build_arrays`` / ``_probe_counts``) is viewed
+          unsigned; its top ``table_bits`` bits are the bucket id, so
+          bucket membership is a *contiguous slice* of the sorted
+          array — the open-addressing displacement is exactly the
+          bucket occupancy, no re-sort and no insertion loop. The
+          table is a bucket-offset array ``part`` (one int32 per
+          bucket, capacity 2x rows => load factor <= 0.5) plus the
+          already-resident sorted hashes.
+  probe:  one kernel: bucket id by shift, two offset gathers, then a
+          short scan of the bucket (``max_seg`` iterations — the max
+          bucket occupancy, measured at build, ~Poisson(0.5) tail for
+          unique keys; equal-hash duplicates sit contiguously so the
+          scan also yields the duplicate match count directly).
+
+Exactness is inherited, not probabilistic: bucket slices are exact by
+construction, equal hashes are contiguous, and the caller keeps the
+same exact-key verification it applies to the searchsorted probe (the
+leftmost-hash-match semantics are identical, so the differential
+fence dense == hash == pallas holds bit-for-bit).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.native import kernels as nk
+
+
+class ProbeTable(NamedTuple):
+    """Device-resident probe state derived from the hash-sorted build.
+
+    ``u_sorted``: the build hashes viewed unsigned with the sign bit
+    flipped — order-isomorphic to the signed sort, so positions are
+    SHARED with the hash-sorted build arrays and no second sort or
+    rotation exists; ``part``: int32[2^table_bits + 1] bucket offsets
+    into its valid region; ``max_seg``: max bucket occupancy = the
+    probe scan bound; ``n_valid``: live build rows. Every field is a
+    traceable array (the tuple is a clean pytree — ``table_bits`` is
+    recovered from ``part``'s static shape), so the table builds inside
+    whatever program prepares the build side, crosses jit boundaries
+    freely, and costs zero extra dispatches."""
+
+    u_sorted: jax.Array
+    part: jax.Array
+    max_seg: jax.Array
+    n_valid: jax.Array
+
+    @property
+    def table_bits(self) -> int:
+        return (self.part.shape[0] - 1).bit_length() - 1
+
+
+def table_bits_for(capacity: int) -> int:
+    """Bucket-count exponent for a build of ``capacity`` slots: 2x
+    slots => load factor <= 0.5 with whole-array buckets."""
+    bits = 1
+    while (1 << bits) < 2 * max(capacity, 1):
+        bits += 1
+    return bits
+
+
+def _unsigned(h: jax.Array) -> jax.Array:
+    # order-isomorphic unsigned view of the int64 hash
+    return h.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+
+
+def unsigned_sorted(sh: jax.Array, n_valid: jax.Array) -> jax.Array:
+    """The build hashes in the sign-flipped unsigned view (ascending,
+    same positions as the signed sort); invalid slots park at u64 max
+    (top bucket id is excluded from ``part``)."""
+    iota = jnp.arange(sh.shape[0], dtype=jnp.int32)
+    return jnp.where(iota < n_valid, _unsigned(sh),
+                     jnp.uint64(0xFFFFFFFFFFFFFFFF))
+
+
+def build_table(sh: jax.Array, n_valid, table_bits: int) -> ProbeTable:
+    """Build the bucket-offset table from the hash-sorted build column
+    ``sh`` (signed ascending, padding rows at int64 max past
+    ``n_valid``). Pure jnp — it runs once, inside the same program
+    that sorted the build."""
+    cap = sh.shape[0]
+    cap_t = 1 << table_bits
+    n_valid = jnp.asarray(n_valid, jnp.int32)
+    u_s = unsigned_sorted(sh, n_valid)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    home = jnp.where(iota < n_valid,
+                     (u_s >> (64 - table_bits)).astype(jnp.int32), cap_t)
+    # part[j] = #valid rows with bucket < j, via histogram + prefix sum
+    # (invalid rows land in the sentinel bin past the table)
+    hist = jnp.zeros((cap_t + 2,), jnp.int32).at[home + 1].add(1)
+    part = jnp.cumsum(hist)[:cap_t + 1].astype(jnp.int32)
+    max_seg = jnp.max(part[1:] - part[:-1])
+    return ProbeTable(u_s, part, max_seg, n_valid)
+
+
+def probe(table: ProbeTable, h_p: jax.Array):
+    """Probe every stream hash against the device-resident table.
+
+    Returns ``(lo, counts)`` — the exact contract of the searchsorted
+    probe it replaces: ``lo`` is the first hash-match position in the
+    hash-sorted build arrays (the unsigned view shares positions with
+    the signed sort) and ``counts`` the match-run length (0 = no hash
+    match)."""
+    cap = table.u_sorted.shape[0]
+    n = h_p.shape[0]
+    shift = 64 - table.table_bits
+    up = _unsigned(h_p)
+
+    def kernel(u_ref, part_ref, up_ref, seg_ref, lo_ref, cnt_ref):
+        upv = up_ref[:]
+        hm = (upv >> shift).astype(jnp.int32)
+        start = part_ref[hm]
+        end = part_ref[hm + 1]
+
+        def body(t, carry):
+            off, cnt = carry
+            idx = jnp.clip(start + t, 0, cap - 1)
+            ut = u_ref[idx]
+            in_seg = (start + t) < end
+            off = off + ((ut < upv) & in_seg)
+            cnt = cnt + ((ut == upv) & in_seg)
+            return off, cnt
+
+        zero = jnp.zeros((n,), jnp.int32)
+        off, cnt = jax.lax.fori_loop(0, seg_ref[0], body, (zero, zero))
+        lo_ref[:] = start + off
+        cnt_ref[:] = cnt
+
+    lo_u, counts = nk.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((n,), jnp.int32),
+                   jax.ShapeDtypeStruct((n,), jnp.int32)))(
+        table.u_sorted, table.part, up,
+        jnp.reshape(table.max_seg, (1,)).astype(jnp.int32))
+    return lo_u.astype(jnp.int32), counts

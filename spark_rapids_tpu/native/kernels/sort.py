@@ -1,0 +1,152 @@
+"""Prefix-scan partition and binary-radix segmented sort kernels.
+
+Two measured facts drive this module (BENCH_r08 + the kernel
+microbench):
+
+- The fused chain's end-of-program compaction is a stable
+  ``argsort(~live)`` + per-column gathers — an O(n log n) sort network
+  to answer an O(n) question ("where does each surviving row land?").
+  :func:`partition_order` computes the identical permutation with one
+  prefix scan + scatter: 3.6x the argsort at 2M rows even on the CPU
+  interpret path, and the same shape of win anywhere a boolean key
+  drives a sort (join match compaction, semi/anti keeps).
+
+- ORDER BY permutations ride a variadic ``lax.sort`` whose payload
+  carry cliffs at 6 lanes (ops/sort._CARRY_MAX_LANES: >20 min XLA
+  compiles beyond it). :func:`lexsort_order` instead runs stable
+  binary-radix passes over unsigned order keys — pass count scales
+  with key *bit width*, never with payload count, so wide rows sort
+  without the padding/carry blowup. Keys that cannot be radixed
+  without a float bitcast (f64 is a software pair on TPU —
+  ops/sortkeys module note) return None and the caller keeps the jnp
+  path; the gate is a routing decision, not a semantics change.
+
+Both kernels are stable and bit-exact against their jnp references
+(differential fences in tests/test_kernels.py) and fully traceable, so
+they ride inside fused-chain programs, the streaming fold, and
+shard_map without changing any dispatch count.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.native import kernels as nk
+from spark_rapids_tpu.ops import sortkeys
+
+
+def partition_order(mask: jax.Array) -> jax.Array:
+    """Stable permutation placing ``mask``-true rows first — bit-equal
+    to ``jnp.argsort(~mask, stable=True)`` at O(n). The permutation is
+    materialized once and every column gathers through it, preserving
+    the chain compaction's count-oblivious contract."""
+    n = mask.shape[0]
+
+    def kernel(mask_ref, out_ref):
+        lv = mask_ref[:]
+        cs = jnp.cumsum(lv.astype(jnp.int32))
+        n_true = cs[-1]
+        iota = jax.lax.iota(jnp.int32, n)
+        # true row i lands at its true-rank; false row i lands after
+        # every true row, at its false-rank (i - trues-before-or-at-i)
+        pos = jnp.where(lv, cs - 1, n_true + iota - cs)
+        out_ref[pos] = iota
+
+    return nk.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((n,), jnp.int32))(mask)
+
+
+# -- radix lexsort ----------------------------------------------------------
+
+# bit width of the unsigned order key per physical dtype; order_key_arrays
+# only ever emits these (rank keys are int32 0/1 -> 1 bit)
+_RADIX_BITS = {jnp.dtype(jnp.bool_): 1, jnp.dtype(jnp.int8): 8,
+               jnp.dtype(jnp.int16): 16, jnp.dtype(jnp.int32): 32,
+               jnp.dtype(jnp.int64): 64}
+
+
+def _unsigned_key(k: jax.Array) -> Tuple[jax.Array, int]:
+    """Order-isomorphic unsigned view of an integral key + its bit
+    width. No float bitcasts (TPU f64 constraint): floats are the
+    caller's fallback signal, never reach here."""
+    d = jnp.dtype(k.dtype)
+    bits = _RADIX_BITS[d]
+    if d == jnp.dtype(jnp.bool_):
+        return k.astype(jnp.uint32), 1
+    if bits < 64:
+        # widen then shift into non-negative range: order preserved
+        return (k.astype(jnp.int64) + (1 << (bits - 1))).astype(
+            jnp.uint64), bits
+    # int64: flip the sign bit in the unsigned view
+    return k.astype(jnp.uint64) ^ jnp.uint64(1 << 63), 64
+
+
+def radix_order(keys: List[jax.Array],
+                widths: Optional[List[int]] = None) -> jax.Array:
+    """Stable ascending argsort of integral ``keys`` (most significant
+    first) via LSD binary-radix inside one kernel. ``widths`` caps the
+    per-key bit count when the caller knows the key's true range (rank
+    keys are 1 bit); pass counts scale with total bits, not payloads."""
+    n = keys[0].shape[0]
+    ukeys, bit_list = [], []
+    for i, k in enumerate(keys):
+        u, b = _unsigned_key(k)
+        if widths is not None and widths[i] is not None:
+            b = min(b, widths[i])
+        ukeys.append(u)
+        bit_list.append(b)
+    bits = tuple(bit_list)
+
+    def kernel(*refs):
+        out_ref = refs[-1]
+        idx = jax.lax.iota(jnp.int32, n)
+        # LSD: least-significant key first, low bit first; each pass is
+        # a stable partition by the current bit of the key as seen
+        # through the running permutation
+        for kref, b in zip(reversed(refs[:-1]), reversed(bits)):
+            kv = kref[:]
+            for bit in range(b):
+                cur = ((kv[idx] >> bit) & 1) == 0
+                cs = jnp.cumsum(cur.astype(jnp.int32))
+                nz = cs[-1]
+                iota = jax.lax.iota(jnp.int32, n)
+                pos = jnp.where(cur, cs - 1, nz + iota - cs)
+                idx = jnp.zeros((n,), jnp.int32).at[pos].set(idx)
+        out_ref[:] = idx
+
+    return nk.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((n,), jnp.int32))(*ukeys)
+
+
+def _radixable(dtypes: List[dt.DType], specs) -> bool:
+    for spec in specs:
+        if dtypes[spec.ordinal].is_floating:
+            return False
+    return True
+
+
+def lexsort_order(cols, dtypes: List[dt.DType], specs,
+                  num_rows, live_mask=None,
+                  capacity_bits: Optional[int] = None
+                  ) -> Optional[jax.Array]:
+    """Kernel-backed replacement for ``sortkeys.lexsort_indices`` /
+    the permutation inside ``sort_with_payloads``: the identical
+    order-key arrays feed binary-radix passes instead of the variadic
+    sort network. Returns None when a key needs a float bitcast (f64
+    TPU constraint) — the caller falls back to the jnp path."""
+    if not _radixable(dtypes, specs):
+        return None
+    keys = sortkeys.order_key_arrays(cols, dtypes, specs, num_rows,
+                                     live_mask)
+    widths: List[Optional[int]] = []
+    for k in keys:
+        d = jnp.dtype(k.dtype)
+        if d not in _RADIX_BITS and not jnp.issubdtype(d, jnp.integer):
+            return None  # float key array slipped through
+        widths.append(None)
+    # the leading pad/liveness rank key is 0/1 by construction
+    widths[0] = 1
+    return radix_order(keys, widths)

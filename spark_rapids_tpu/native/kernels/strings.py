@@ -1,0 +1,340 @@
+"""Dictionary-string predicate and substring kernels over char tables.
+
+The jnp string strategy (expressions/strings.py) factors every string
+function into a per-dictionary-entry HOST transform (a Python loop over
+unique values) plus a device gather by code. That keeps row-scale work
+on device, but the host loop is O(cardinality) *Python* — on a 100k+
+entry dictionary a single ``contains`` costs tens of milliseconds of
+interpreter time per batch, serialized on the driver thread.
+
+These kernels move the per-entry work onto the device: the dictionary
+is encoded ONCE into a padded code+offset char table (uint8 chars +
+per-entry byte lengths — never a per-row character matrix; the table
+is O(cardinality * max_len), not O(rows)), and one Pallas kernel scans
+it for every entry in parallel. The device-side gather by code is
+unchanged.
+
+Semantics guardrails (fall back to the host path, never approximate):
+
+- byte-level windows are substring-exact for UTF-8 (a UTF-8 sequence
+  never matches inside another code point), so contains / startswith /
+  endswith / LIKE's ``%``-segments work on raw bytes for ANY input;
+- LIKE ``_`` matches one *character*, and substring counts characters,
+  so those routes require ASCII-only dictionary entries (checked at
+  encode time);
+- oversized tables (very long entries / huge dictionaries) fall back
+  rather than build a pathological window tensor.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.native import kernels as nk
+
+# window-tensor budget: n_entries * n_windows * needle_len bytes
+_WINDOW_BUDGET = 64 << 20
+# max padded entry length the kernels will scan
+_MAX_ENTRY_LEN = 512
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def encode_dictionary(dic: np.ndarray
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray, bool]]:
+    """(chars uint8[n, L], lens int32[n], ascii_only) — the padded char
+    table for a dictionary, or None when an entry exceeds the scan
+    ceiling. Matches the host transforms' ``str(entry)`` coercion."""
+    n = len(dic)
+    encoded = [str(s).encode("utf-8") for s in dic]
+    maxlen = max((len(b) for b in encoded), default=0)
+    if maxlen > _MAX_ENTRY_LEN:
+        return None
+    L = _pow2(max(maxlen, 1))
+    chars = np.zeros((n, L), dtype=np.uint8)
+    lens = np.zeros((n,), dtype=np.int32)
+    for i, b in enumerate(encoded):
+        chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lens[i] = len(b)
+    ascii_only = maxlen == 0 or int(chars.max()) < 0x80
+    return chars, lens, ascii_only
+
+
+def _windows(ch: jax.Array, m: int) -> jax.Array:
+    """(n, P, m) sliding byte windows of the char table, P = L - m + 1."""
+    L = ch.shape[1]
+    grid = (jnp.arange(L - m + 1, dtype=jnp.int32)[:, None] +
+            jnp.arange(m, dtype=jnp.int32)[None, :])
+    return jnp.take(ch, grid, axis=1)
+
+
+def _match_table(chars: np.ndarray, lens: np.ndarray, kind: str,
+                 needle: bytes) -> Optional[jax.Array]:
+    """bool[n] per-entry predicate table, computed on device."""
+    n, L = chars.shape
+    m = len(needle)
+    if m > L:
+        # needle longer than any entry: nothing matches
+        return jnp.zeros((n,), dtype=jnp.bool_)
+    if m == 0:
+        # '' is a prefix/suffix/substring of everything
+        return jnp.ones((n,), dtype=jnp.bool_)
+    if kind == "contains" and n * (L - m + 1) * m > _WINDOW_BUDGET:
+        return None
+    nd = jnp.asarray(np.frombuffer(needle, dtype=np.uint8))
+    ch = jnp.asarray(chars)
+    ln = jnp.asarray(lens)
+
+    def kernel(ch_ref, ln_ref, nd_ref, out_ref):
+        c = ch_ref[:]
+        lv = ln_ref[:]
+        ndv = nd_ref[:]
+        if kind == "starts":
+            hit = jnp.all(c[:, :m] == ndv[None, :], axis=1) & (lv >= m)
+        elif kind == "ends":
+            # per-entry window at len - m
+            cols = (lv[:, None] - m +
+                    jnp.arange(m, dtype=jnp.int32)[None, :])
+            w = jnp.take_along_axis(c, jnp.clip(cols, 0, L - 1), axis=1)
+            hit = jnp.all(w == ndv[None, :], axis=1) & (lv >= m)
+        else:  # contains
+            w = _windows(c, m) == ndv[None, None, :]
+            p = jnp.arange(w.shape[1], dtype=jnp.int32)
+            ok = jnp.all(w, axis=2) & (p[None, :] + m <= lv[:, None])
+            hit = jnp.any(ok, axis=1)
+        out_ref[:] = hit
+
+    return nk.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((n,), jnp.bool_))(
+        ch, ln, nd)
+
+
+def _parse_like(pattern: str, escape: str
+                ) -> Optional[List[Tuple[bool, List]]]:
+    """LIKE pattern -> (anchored_start, anchored_end, segments), each
+    segment a list of (byte, is_wildcard) tokens; None for patterns the
+    kernel must not handle (non-ASCII with ``_`` is checked later)."""
+    tokens: List = []  # byte int | None (= one-char wildcard) | "%"
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            tokens.extend(pattern[i + 1].encode("utf-8"))
+            i += 2
+            continue
+        if ch == "%":
+            tokens.append("%")
+        elif ch == "_":
+            tokens.append(None)
+        else:
+            tokens.extend(ch.encode("utf-8"))
+        i += 1
+    segments: List[List] = [[]]
+    for t in tokens:
+        if t == "%":
+            segments.append([])
+        else:
+            segments[-1].append(t)
+    return segments
+
+
+def _like_table(chars: np.ndarray, lens: np.ndarray, pattern: str,
+                escape: str, ascii_only: bool) -> Optional[jax.Array]:
+    """bool[n] LIKE table via greedy segment matching (greedy is exact
+    for %-separated segments). ``_`` wildcards require an ASCII
+    dictionary (byte == character)."""
+    segments = _parse_like(pattern, escape)
+    has_underscore = any(t is None for seg in segments for t in seg)
+    if has_underscore and not ascii_only:
+        return None
+    n, L = chars.shape
+    if any(len(seg) > L for seg in segments):
+        # a segment longer than every entry can never match...
+        # unless entries shorter than the pattern exist either way:
+        # no entry can contain it
+        return jnp.zeros((n,), dtype=jnp.bool_)
+    # segment list semantics: pattern "a%b" -> ["a","b"]; leading %
+    # yields an empty first segment, trailing % an empty last one
+    win_cost = max((n * (L - len(s) + 1) * max(len(s), 1)
+                    for s in segments), default=0)
+    if win_cost > _WINDOW_BUDGET:
+        return None
+
+    seg_arrays = []
+    for seg in segments:
+        sb = np.array([0 if t is None else t for t in seg],
+                      dtype=np.uint8)
+        wild = np.array([t is None for t in seg], dtype=bool)
+        seg_arrays.append((sb, wild))
+
+    ch = jnp.asarray(chars)
+    ln = jnp.asarray(lens)
+    # anchoring comes from the token stream, not the raw text — a
+    # trailing *escaped* % is a literal, not a wildcard
+    raw = pattern
+    toks = []
+    i = 0
+    while i < len(raw):
+        if raw[i] == escape and i + 1 < len(raw):
+            toks.append("lit")
+            i += 2
+            continue
+        toks.append("%" if raw[i] == "%" else "lit")
+        i += 1
+    first_anchored = not (toks and toks[0] == "%")
+    last_anchored = not (toks and toks[-1] == "%")
+
+    nseg = len(seg_arrays)
+
+    def kernel(ch_ref, ln_ref, *rest):
+        out_ref = rest[-1]
+        seg_refs = rest[:nseg]
+        wild_refs = rest[nseg:2 * nseg]
+        c = ch_ref[:]
+        lv = ln_ref[:]
+        ok = jnp.ones((n,), dtype=jnp.bool_)
+        cur = jnp.zeros((n,), dtype=jnp.int32)
+        for si, (sb, wild) in enumerate(seg_arrays):
+            m = len(sb)
+            sref = seg_refs[si]
+            if m == 0:
+                continue
+            sv = sref[:]
+            wv = wild_refs[si][:] != 0
+            is_first = si == 0
+            is_last = si == len(seg_arrays) - 1
+            if is_first and first_anchored:
+                w = (c[:, :m] == sv[None, :]) | wv[None, :]
+                ok = ok & jnp.all(w, axis=1) & (lv >= m)
+                cur = jnp.full((n,), m, dtype=jnp.int32)
+            elif is_last and last_anchored:
+                cols = (lv[:, None] - m +
+                        jnp.arange(m, dtype=jnp.int32)[None, :])
+                w = (jnp.take_along_axis(c, jnp.clip(cols, 0, L - 1),
+                                         axis=1) == sv[None, :]) | \
+                    wv[None, :]
+                ok = ok & jnp.all(w, axis=1) & (lv - m >= cur)
+                cur = lv
+            else:
+                w = (_windows(c, m) == sv[None, None, :]) | \
+                    wv[None, None, :]
+                p = jnp.arange(w.shape[1], dtype=jnp.int32)
+                valid = (jnp.all(w, axis=2) &
+                         (p[None, :] + m <= lv[:, None]) &
+                         (p[None, :] >= cur[:, None]))
+                found = jnp.any(valid, axis=1)
+                first = jnp.argmax(valid, axis=1).astype(jnp.int32)
+                ok = ok & found
+                cur = first + m
+        if len(segments) == 1 and first_anchored and last_anchored:
+            # no % at all: exact-length match
+            ok = ok & (lv == len(seg_arrays[0][0]))
+        out_ref[:] = ok
+
+    def _pad1(a):
+        # zero-length operands are invalid; empty segments are
+        # statically skipped in the kernel so the dummy is never read
+        return jnp.asarray(a if len(a) else np.zeros((1,), a.dtype))
+
+    args = ([ch, ln] + [_pad1(sb) for sb, _ in seg_arrays] +
+            [_pad1(w.astype(np.uint8)) for _, w in seg_arrays])
+    return nk.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((n,), jnp.bool_))(*args)
+
+
+# -- eval-layer routing -----------------------------------------------------
+
+
+def predicate_colv(v, kind: str, needle: str,
+                   escape: Optional[str] = None):
+    """Kernel route for a string predicate over a dictionary column:
+    returns the gathered boolean ColV, or None to keep the host path
+    (gate off, no dictionary, or outside the kernel's contract)."""
+    if not nk.enabled("strings"):
+        return None
+    scol = getattr(v, "scol", None)
+    if scol is None or len(scol.dictionary) == 0:
+        return None
+    enc = encode_dictionary(scol.dictionary)
+    if enc is None:
+        return None
+    chars, lens, ascii_only = enc
+    if kind == "like":
+        table = _like_table(chars, lens, needle, escape or "\\",
+                            ascii_only)
+    else:
+        table = _match_table(chars, lens, kind,
+                             needle.encode("utf-8"))
+    if table is None:
+        return None
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expressions.base import ColV
+
+    data = jnp.take(table, v.data, mode="clip")
+    return ColV(dt.BOOLEAN, data, v.validity)
+
+
+def substring_colv(v, pos: int, length: Optional[int]):
+    """Kernel route for substring(str, pos, len): the slice runs on
+    device over the char table (ASCII dictionaries: byte == character),
+    the host only decodes the already-sliced entries into the new
+    dictionary. Returns ColV or None for the host path."""
+    if not nk.enabled("strings"):
+        return None
+    scol = getattr(v, "scol", None)
+    if scol is None or len(scol.dictionary) == 0:
+        return None
+    enc = encode_dictionary(scol.dictionary)
+    if enc is None or not enc[2]:
+        return None
+    chars, lens = enc[0], enc[1]
+    n, L = chars.shape
+    ch = jnp.asarray(chars)
+    ln = jnp.asarray(lens)
+
+    def kernel(ch_ref, ln_ref, out_ref, olen_ref):
+        c = ch_ref[:]
+        lv = ln_ref[:]
+        if pos > 0:
+            start = jnp.full((n,), pos - 1, dtype=jnp.int32)
+        elif pos < 0:
+            start = lv + pos
+        else:
+            start = jnp.zeros((n,), dtype=jnp.int32)
+        end = lv if length is None else start + length
+        start_c = jnp.clip(start, 0, lv)
+        end_c = jnp.clip(jnp.minimum(end, lv), 0, lv)
+        out_len = jnp.maximum(end_c - start_c, 0)
+        cols = start_c[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+        sliced = jnp.take_along_axis(c, jnp.clip(cols, 0, L - 1), axis=1)
+        keep = jnp.arange(L, dtype=jnp.int32)[None, :] < out_len[:, None]
+        out_ref[:] = jnp.where(keep, sliced, 0).astype(jnp.uint8)
+        olen_ref[:] = out_len
+
+    out_chars, out_lens = nk.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((n, L), jnp.uint8),
+                   jax.ShapeDtypeStruct((n,), jnp.int32)))(ch, ln)
+    oc = np.asarray(jax.device_get(out_chars))
+    ol = np.asarray(jax.device_get(out_lens))
+    transformed = np.array(
+        [oc[i, :ol[i]].tobytes().decode("utf-8") for i in range(n)],
+        dtype=object)
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.column import StringColumn
+    from spark_rapids_tpu.expressions.base import ColV
+
+    new_dict, inv = np.unique(transformed.astype(str),
+                              return_inverse=True)
+    remap = jnp.asarray(inv.astype(np.int32))
+    codes = jnp.take(remap, v.data, mode="clip")
+    sc = StringColumn(codes, new_dict.astype(object), v.validity)
+    return ColV(dt.STRING, codes, v.validity, sc)

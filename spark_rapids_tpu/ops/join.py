@@ -23,7 +23,7 @@ post-join filter, same as the reference (GpuHashJoin.scala:285-291).
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +32,7 @@ import numpy as np
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import Column, StringColumn, unify_dictionaries
+from spark_rapids_tpu.native import kernels as nkr
 from spark_rapids_tpu.ops import hashing, sortkeys
 from spark_rapids_tpu.ops.buckets import bucket_capacity
 
@@ -100,16 +101,58 @@ def unify_join_strings(left: ColumnarBatch, right: ColumnarBatch,
             ColumnarBatch(rcols, right.num_rows))
 
 
+class PreparedBuild(NamedTuple):
+    """Build side prepared once and probed across every stream batch:
+    the hash-sorted build plus (join kernel on) the device-resident
+    bucket table. Only valid when no JOIN KEY is a string column —
+    string keys re-unify dictionaries per stream batch, changing the
+    build hashes (non-key string columns are fine)."""
+
+    sorted_build: ColumnarBatch
+    sb_h: jax.Array
+    table: Optional[object]  # native.kernels.join.ProbeTable
+
+
+def prepare_build(build: ColumnarBatch, build_keys: List[int],
+                  build_types: List[dt.DType],
+                  stream_types_for_keys: List[dt.DType]
+                  ) -> Optional[PreparedBuild]:
+    """Hash + sort (+ table-build, kernel on) the build side once for
+    reuse across stream batches. Returns None when a join key is a
+    string column (per-batch dictionary unification makes the build
+    hash stream-dependent)."""
+    if any(isinstance(build.columns[o], StringColumn) for o in build_keys):
+        return None
+    commons = [common_key_type(st, build_types[bo])
+               for st, bo in zip(stream_types_for_keys, build_keys)]
+    if any(c is None for c in commons):
+        return None
+    h_b = _key_hashes(build, build_keys, build_types, _BUILD_NULL,
+                      target_types=commons)
+    sb_h, sb_datas, sb_vals, table = _build_sorted(
+        [c.data for c in build.columns],
+        [c.validity for c in build.columns], h_b,
+        build.num_rows_device(), use_kernel=nkr.enabled("join"))
+    cols = [c._like(d, v) for c, d, v in
+            zip(build.columns, sb_datas, sb_vals)]
+    return PreparedBuild(ColumnarBatch(cols, build.num_rows), sb_h, table)
+
+
 def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
               stream_keys: List[int], build_keys: List[int],
               stream_types: List[dt.DType], build_types: List[dt.DType],
-              join_type: str = "inner"
+              join_type: str = "inner",
+              prepared: Optional[PreparedBuild] = None
               ) -> Tuple[ColumnarBatch, List[dt.DType]]:
     """Join ``stream`` (probe/left) against ``build`` (right). Output columns:
     stream columns then build columns (semi/anti: stream only). ``right``
-    joins are planned as flipped ``left`` by the exec layer."""
+    joins are planned as flipped ``left`` by the exec layer.
+    ``prepared`` reuses a :func:`prepare_build` result across stream
+    batches (the exec layer's build-once/probe-many seam)."""
     assert join_type in ("inner", "left", "leftsemi", "leftanti", "full")
-    stream, build = unify_join_strings(stream, build, stream_keys, build_keys)
+    if prepared is None:
+        stream, build = unify_join_strings(stream, build, stream_keys,
+                                           build_keys)
 
     commons = [common_key_type(stream_types[so], build_types[bo])
                for so, bo in zip(stream_keys, build_keys)]
@@ -117,25 +160,34 @@ def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
         "no common comparison type for join keys",
         [stream_types[o] for o in stream_keys],
         [build_types[o] for o in build_keys])
-    h_b = _key_hashes(build, build_keys, build_types, _BUILD_NULL,
-                      target_types=commons)
     h_p = _key_hashes(stream, stream_keys, stream_types, _PROBE_NULL,
                       target_types=commons)
 
-    # ---- phase 1 (device): sort build, bound-search, count matches
-    b_datas = [c.data for c in build.columns]
-    b_vals = [c.validity for c in build.columns]
-    (sb_h, sb_datas, sb_vals, lo, hi, counts, total) = _probe_counts(
-        b_datas, b_vals, h_b, build.num_rows_device(),
-        [c.data for c in stream.columns], h_p, stream.num_rows_device())
+    use_kernel = nkr.enabled("join")
+    if prepared is not None:
+        # ---- phase 1 (device), amortized: probe the prepared table
+        sorted_build = prepared.sorted_build
+        lo, hi, counts, total = _probe_sorted(
+            prepared.sb_h, prepared.table, h_p,
+            stream.num_rows_device(),
+            use_kernel=use_kernel and prepared.table is not None)
+    else:
+        # ---- phase 1 (device): sort build, probe, count matches
+        b_datas = [c.data for c in build.columns]
+        b_vals = [c.validity for c in build.columns]
+        (sb_h, sb_datas, sb_vals, lo, hi, counts, total) = _probe_counts(
+            b_datas, b_vals, h_b := _key_hashes(
+                build, build_keys, build_types, _BUILD_NULL,
+                target_types=commons),
+            build.num_rows_device(), h_p, stream.num_rows_device(),
+            use_kernel=use_kernel)
+        sorted_build_cols = [c._like(d, v) for c, d, v in
+                             zip(build.columns, sb_datas, sb_vals)]
+        sorted_build = ColumnarBatch(sorted_build_cols, build.num_rows)
 
     # ---- the one host sync: candidate-pair count -> output capacity
     total_i = int(jax.device_get(total))
     out_cap = bucket_capacity(max(total_i, 1))
-
-    sorted_build_cols = [c._like(d, v) for c, d, v in
-                         zip(build.columns, sb_datas, sb_vals)]
-    sorted_build = ColumnarBatch(sorted_build_cols, build.num_rows)
 
     # ---- phase 2 (device): expand pairs, verify exact equality (on the
     # per-pair common comparison type)
@@ -156,8 +208,7 @@ def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
                  pi, bi, match, counts, total, join_type, out_cap)
 
 
-@jax.jit
-def _probe_counts(b_datas, b_vals, h_b, b_rows, s_datas, h_p, s_rows):
+def _sort_build(b_datas, b_vals, h_b, b_rows):
     b_cap = h_b.shape[0]
     live_b = jnp.arange(b_cap, dtype=jnp.int32) < b_rows
     # Push padding rows to the top of the sort with int64 max. Real hashes
@@ -171,16 +222,65 @@ def _probe_counts(b_datas, b_vals, h_b, b_rows, s_datas, h_p, s_rows):
     sb_h = jnp.take(h_b_l, order)
     sb_datas = [jnp.take(d, order) for d in b_datas]
     sb_vals = [None if v is None else jnp.take(v, order) for v in b_vals]
+    return sb_h, sb_datas, sb_vals
 
+
+def _hash_probe(sb_h, table, h_p, s_rows, use_kernel: bool):
+    """Leftmost hash-match position + run length per probe row: the
+    bucket-table kernel and the two searchsorted calls share this exact
+    contract (tests/test_kernels.py holds them bit-equal)."""
     s_cap = h_p.shape[0]
     live_p = jnp.arange(s_cap, dtype=jnp.int32) < s_rows
-    lo = jnp.searchsorted(sb_h, h_p, side="left")
-    hi = jnp.searchsorted(sb_h, h_p, side="right")
-    # clamp hi to live build rows (padding key 2**62 never matches a real
-    # hash, but belt-and-braces if a hash equals the sentinel)
+    if use_kernel:
+        from spark_rapids_tpu.native.kernels import join as njoin
+
+        lo, cnt = njoin.probe(table, h_p)
+        hi = lo + cnt
+    else:
+        lo = jnp.searchsorted(sb_h, h_p, side="left")
+        hi = jnp.searchsorted(sb_h, h_p, side="right")
+    # clamp hi to live build rows (padding key int64-max never matches a
+    # real hash, but belt-and-braces if a hash equals the sentinel)
     counts = jnp.where(live_p, hi - lo, 0).astype(jnp.int64)
     total = jnp.sum(counts)
+    return lo, hi, counts, total
+
+
+@partial(jax.jit, static_argnames=("use_kernel",))
+def _probe_counts(b_datas, b_vals, h_b, b_rows, h_p, s_rows,
+                  use_kernel: bool = False):
+    sb_h, sb_datas, sb_vals = _sort_build(b_datas, b_vals, h_b, b_rows)
+    table = None
+    if use_kernel:
+        from spark_rapids_tpu.native.kernels import join as njoin
+
+        table = njoin.build_table(sb_h, b_rows,
+                                  njoin.table_bits_for(sb_h.shape[0]))
+    lo, hi, counts, total = _hash_probe(sb_h, table, h_p, s_rows,
+                                        use_kernel)
     return sb_h, sb_datas, sb_vals, lo, hi, counts, total
+
+
+@partial(jax.jit, static_argnames=("use_kernel",))
+def _build_sorted(b_datas, b_vals, h_b, b_rows, use_kernel: bool = False):
+    """Build-once half of the prepared path: one program sorts the build
+    and (kernel on) derives the bucket table that stays HBM-resident
+    across every stream batch."""
+    sb_h, sb_datas, sb_vals = _sort_build(b_datas, b_vals, h_b, b_rows)
+    table = None
+    if use_kernel:
+        from spark_rapids_tpu.native.kernels import join as njoin
+
+        table = njoin.build_table(sb_h, b_rows,
+                                  njoin.table_bits_for(sb_h.shape[0]))
+    return sb_h, sb_datas, sb_vals, table
+
+
+@partial(jax.jit, static_argnames=("use_kernel",))
+def _probe_sorted(sb_h, table, h_p, s_rows, use_kernel: bool = False):
+    """Probe-many half of the prepared path (one program per stream
+    batch, no build work)."""
+    return _hash_probe(sb_h, table, h_p, s_rows, use_kernel)
 
 
 @partial(jax.jit, static_argnames=("key_types", "out_cap"))
@@ -221,8 +321,14 @@ def _emit(stream: ColumnarBatch, build: ColumnarBatch,
         out = compact_batch(stream, keep)
         return out, list(stream_types)
 
-    # matched pairs, compacted
-    order = jnp.argsort(~match, stable=True)
+    # matched pairs, compacted (the partition kernel computes the same
+    # stable permutation with one prefix scan instead of a sort network)
+    if nkr.enabled("sort"):
+        from spark_rapids_tpu.native.kernels import sort as nsort
+
+        order = nsort.partition_order(match)
+    else:
+        order = jnp.argsort(~match, stable=True)
     n_match = jnp.sum(match).astype(jnp.int32)
     pi_s = jnp.take(pi, order)
     bi_s = jnp.take(bi, order)
@@ -344,7 +450,8 @@ def nested_loop_join(stream: ColumnarBatch, build: ColumnarBatch,
                          else Column.all_null(t, pair_cap))
     keep = cond_mask(ColumnarBatch(pair_cols, total))
 
-    pi_s, bi_s, n_match = _compact_pairs(pi, bi, keep & live)
+    pi_s, bi_s, n_match = _compact_pairs(pi, bi, keep & live,
+                                         use_kernel=nkr.enabled("sort"))
     n_match_i = int(jax.device_get(n_match))  # the one host sync
     out_cap = bucket_capacity(max(n_match_i, 1))
     pi_s, bi_s = pi_s[:out_cap], bi_s[:out_cap]
@@ -363,8 +470,13 @@ def _pair_grid(pair_cap: int, n_b, total):
     return pi, bi, k < total
 
 
-@jax.jit
-def _compact_pairs(pi, bi, match):
-    order = jnp.argsort(~match, stable=True)
+@partial(jax.jit, static_argnames=("use_kernel",))
+def _compact_pairs(pi, bi, match, use_kernel: bool = False):
+    if use_kernel:
+        from spark_rapids_tpu.native.kernels import sort as nsort
+
+        order = nsort.partition_order(match)
+    else:
+        order = jnp.argsort(~match, stable=True)
     return (jnp.take(pi, order), jnp.take(bi, order),
             jnp.sum(match).astype(jnp.int32))

@@ -29,8 +29,12 @@ from spark_rapids_tpu.ops.sortkeys import SortKeySpec
 _CARRY_MAX_LANES = 6
 
 
-@partial(jax.jit, static_argnames=("dtypes", "specs"))
-def _sort_carry(datas, validities, dtypes, specs, num_rows):
+@partial(jax.jit, static_argnames=("dtypes", "specs", "kernel_token"))
+def _sort_carry(datas, validities, dtypes, specs, num_rows,
+                kernel_token=()):
+    # kernel_token: native-kernel gate state — the trace routes through
+    # the radix kernel or lax.sort at trace time, so a knob flip must
+    # miss this cache
     """One stable variadic sort: [pad_rank, spec keys..., payloads...].
     Wide payload sets sort an iota lane instead and gather."""
     payloads = list(datas) + [v for v in validities if v is not None]
@@ -59,21 +63,27 @@ def sort_batch(batch: ColumnarBatch, specs: List[SortKeySpec],
                dtypes) -> ColumnarBatch:
     datas = [c.data for c in batch.columns]
     validities = [c.validity for c in batch.columns]
+    from spark_rapids_tpu.native import kernels as nkr
+
     out_d, out_v = _sort_carry(datas, validities, tuple(dtypes),
-                               tuple(specs), batch.num_rows_device())
+                               tuple(specs), batch.num_rows_device(),
+                               kernel_token=nkr.cache_token())
     out_cols = [c._like(d, v)
                 for c, d, v in zip(batch.columns, out_d, out_v)]
     return ColumnarBatch(out_cols, batch.num_rows)
 
 
-@partial(jax.jit, static_argnames=("dtypes", "specs"))
-def _sort_indices(cols, dtypes, specs, num_rows):
+@partial(jax.jit, static_argnames=("dtypes", "specs", "kernel_token"))
+def _sort_indices(cols, dtypes, specs, num_rows, kernel_token=()):
     return sortkeys.lexsort_indices(list(cols), list(dtypes), list(specs),
                                     num_rows)
 
 
 def sort_indices(batch: ColumnarBatch, specs: List[SortKeySpec],
                  dtypes) -> jax.Array:
+    from spark_rapids_tpu.native import kernels as nkr
+
     cols = [(c.data, c.validity) for c in batch.columns]
     return _sort_indices(cols, tuple(dtypes), tuple(specs),
-                         batch.num_rows_device())
+                         batch.num_rows_device(),
+                         kernel_token=nkr.cache_token())

@@ -109,6 +109,9 @@ def initialize(conf: Optional[RapidsConf] = None,
         retry.configure_from_conf(conf)
         fault_injection.arm_from_conf(conf)
         shuffle_fault_injection.arm_from_conf(conf)
+        from spark_rapids_tpu.native import kernels
+
+        kernels.configure_from_conf(conf)
         _env = RuntimeEnv(conf, dm, catalog, semaphore,
                           conf.get(cfg.SHUFFLE_COMPRESSION_CODEC))
         return _env
@@ -136,3 +139,6 @@ def shutdown() -> None:
         retry.reset_config()
         fault_injection.get_injector().disarm()
         shuffle_fault_injection.get_injector().disarm()
+        from spark_rapids_tpu.native import kernels
+
+        kernels.reset_config()

@@ -141,14 +141,17 @@ class ShapeBucketRegistry:
         operands: each replay forces the XLA compile for that
         (program, bucket) executable, so the compiles land at startup
         instead of under the first tenant whose batch hits the rung.
-        Returns {"programs", "replays", "errors"}."""
+        Returns {"programs", "replays", "errors", "rungs_skipped"}
+        (rungs_skipped: rungs above ``max_rung`` NOT replayed — a
+        single-query bench caps the ladder at its input's bucket)."""
         with self._lock:
             specs = list(self._specs.items())
-        replays = errors = 0
+        replays = errors = skipped = 0
         for program_key, spec in specs:
             rungs = _ladder.ladder_rungs(spec.stream_cap)
             for rung in rungs:
                 if max_rung is not None and rung > max_rung:
+                    skipped += 1
                     continue
                 mark = (program_key, rung)
                 with self._lock:
@@ -189,7 +192,7 @@ class ShapeBucketRegistry:
                     # (not shapes) may reject zeros; warmup is advisory
                     errors += 1
         return {"programs": len(specs), "replays": replays,
-                "errors": errors}
+                "errors": errors, "rungs_skipped": skipped}
 
     # -- stats -------------------------------------------------------------
 

@@ -302,19 +302,23 @@ class QueryService:
 
     # -- warmup (ROADMAP item 2: AOT-warm the progcache at startup) -------
 
-    def register_template(self, df_or_plan, name: Optional[str] = None):
+    def register_template(self, df_or_plan, name: Optional[str] = None,
+                          max_rung: Optional[int] = None):
         """Register a query template the service expects tenants to
         run. With ``rapids.tpu.service.warmup.enabled`` the template is
         warmed immediately (returns the warmup report); otherwise it is
-        only recorded for a later explicit ``warmup()`` call."""
+        only recorded for a later explicit ``warmup()`` call.
+        ``max_rung`` caps the ladder replay: a single-query caller that
+        knows its input capacity skips compiling rungs above it."""
         plan = getattr(df_or_plan, "_plan", df_or_plan)
         entry = (name or f"template{len(self._templates)}", plan)
         self._templates.append(entry)
         if self.conf.get(cfg.SERVICE_WARMUP_ENABLED):
-            return self.warmup([entry])
+            return self.warmup([entry], max_rung=max_rung)
         return None
 
-    def warmup(self, templates=None, timeout: float = 600.0) -> dict:
+    def warmup(self, templates=None, timeout: float = 600.0,
+               max_rung: Optional[int] = None) -> dict:
         """Run each template once under the reserved ``__warmup__``
         tenant — tracing + compiling its stage programs into the
         in-process chain-key cache and the persistent compile cache —
@@ -346,7 +350,7 @@ class QueryService:
         ladder: dict = {}
         if self.batcher.registry is not None and \
                 self.conf.get(cfg.SERVICE_WARMUP_LADDER):
-            ladder = self.batcher.registry.warm()
+            ladder = self.batcher.registry.warm(max_rung=max_rung)
         coalesced = self.batcher.warm_coalesced()
         return {"templates": ran, "errors": errors, "ladder": ladder,
                 "coalesced": coalesced,

@@ -72,13 +72,35 @@ class JaxShimServiceProvider:
         raise NotImplementedError
 
 
+def _kernel_safe_shard_map(sm):
+    """Default ``check_rep=False`` while the native-kernel gate is on:
+    interpret-mode ``pallas_call`` has no shard_map replication rule,
+    so a kernel routed inside a mesh device step would fail to trace
+    otherwise. Replication checking is a trace-time assertion, not a
+    semantics change — the mesh differential fences
+    (tests/test_spmd_shuffle.py, tests/test_kernels.py) still assert
+    bit-equality against the single-device and oracle paths."""
+    import functools
+
+    @functools.wraps(sm)
+    def wrapped(f, **kw):
+        if "check_rep" not in kw:
+            from spark_rapids_tpu.native import kernels as nk
+
+            if nk.cache_token()[0]:
+                kw["check_rep"] = False
+        return sm(f, **kw)
+
+    return wrapped
+
+
 class _ModernJaxShims(JaxShims):
     """jax >= 0.6: public top-level shard_map, jax.extend backend API."""
 
     def shard_map(self):
         from jax import shard_map
 
-        return shard_map
+        return _kernel_safe_shard_map(shard_map)
 
     def clear_backends(self):
         from jax.extend import backend
@@ -108,7 +130,7 @@ class _LegacyJaxShims(_ModernJaxShims):
     def shard_map(self):
         from jax.experimental.shard_map import shard_map  # type: ignore
 
-        return shard_map
+        return _kernel_safe_shard_map(shard_map)
 
     def clear_backends(self):
         import jax

@@ -194,6 +194,10 @@ def _bump_stage(kind: str, program: str = None) -> None:
 _device_timing = False
 _rtt_floor = 0.0
 _kernel_times: dict = {}
+# per-(stage, program) split of the same measured seconds: answers
+# "which stage's launches of chain@a1b2 are the expensive ones" when
+# one compiled program serves several pipeline stages
+_stage_kernel_times: dict = {}
 
 
 def install() -> None:
@@ -228,6 +232,11 @@ def install() -> None:
                 dt = max(time.perf_counter() - t0 - _rtt_floor, 0.0)
                 calls, secs = _kernel_times.get(name, (0, 0.0))
                 _kernel_times[name] = (calls + 1, secs + dt)
+                label = getattr(_tls, "stage", None) or "<unstaged>"
+                with _stage_lock:
+                    progs = _stage_kernel_times.setdefault(label, {})
+                    c2, s2 = progs.get(name, (0, 0.0))
+                    progs[name] = (c2 + 1, s2 + dt)
                 return out
 
             def __getattr__(self, name_):
@@ -346,6 +355,8 @@ def enable_device_timing() -> None:
     assert _installed, "dispatch.install() must run first"
     _rtt_floor = measure_rtt()
     _kernel_times = {}
+    with _stage_lock:
+        _stage_kernel_times.clear()
     _device_timing = True
 
 
@@ -359,6 +370,15 @@ def disable_device_timing() -> dict:
     total_s = sum(s for _, s in out.values())
     out["__total__"] = (total_calls, total_s)
     return out
+
+
+def stage_device_times() -> dict:
+    """Measured device seconds split per (stage, program):
+    {stage: {program: (calls, device_seconds)}}. Populated only while
+    device timing is enabled; read it AFTER disable_device_timing."""
+    with _stage_lock:
+        return {label: dict(progs)
+                for label, progs in _stage_kernel_times.items()}
 
 
 def measure_rtt(samples: int = 5) -> float:

@@ -172,6 +172,37 @@ def test_tpu203_weak_literal_flagged_dtype_exempt(tmp_path):
     assert len(fs) == 1 and fs[0].qualname == "bad"
 
 
+def test_tpu204_direct_pallas_call_flagged_registry_exempt(tmp_path):
+    from spark_rapids_tpu.analysis import recompile
+    root = _tree(tmp_path, {
+        # the registry itself: the ONE sanctioned pl.pallas_call site
+        "spark_rapids_tpu/native/kernels/__init__.py": """
+            def pallas_call(kernel, *, out_shape, **kw):
+                from spark_rapids_tpu.shims import get_shims
+                pl = get_shims().pallas()
+                return pl.pallas_call(kernel, out_shape=out_shape,
+                                      interpret=True, **kw)
+        """,
+        # kernel module routing through the registry: exempt
+        "spark_rapids_tpu/native/kernels/good.py": """
+            from spark_rapids_tpu.native import kernels as nk
+
+            def fine(kern, shape):
+                return nk.pallas_call(kern, out_shape=shape)
+        """,
+        # direct pl.pallas_call outside the registry: flagged
+        "spark_rapids_tpu/execs/bad.py": """
+            from jax.experimental import pallas as pl
+
+            def bad(kern, shape):
+                return pl.pallas_call(kern, out_shape=shape,
+                                      interpret=False)
+        """})
+    fs = [f for f in recompile.run(root) if f.code == "TPU204"]
+    assert len(fs) == 1 and fs[0].qualname == "bad"
+    assert fs[0].path.endswith("bad.py")
+
+
 # ---------------------------------------------------------------------------
 # TPU3xx lock fixtures (static)
 # ---------------------------------------------------------------------------

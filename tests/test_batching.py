@@ -506,10 +506,15 @@ def test_registry_records_and_warms_ladder():
     assert st["bucket_reuses"] == 1
     report = reg.warm()
     # rungs below 1024 replayed: 128/256/512 (1024 itself observed)
-    assert report == {"programs": 1, "replays": 3, "errors": 0}
+    assert report == {"programs": 1, "replays": 3, "errors": 0,
+                      "rungs_skipped": 0}
     assert reg.stats()["warmed"] == 4
     # idempotent: nothing new to replay
     assert reg.warm()["replays"] == 0
+    # capping at the input rung skips the rungs above it and says so
+    report = reg.warm(max_rung=256)
+    assert report["replays"] == 0
+    assert report["rungs_skipped"] == 2
 
 
 def test_register_template_warms_progcache():
