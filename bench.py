@@ -207,7 +207,8 @@ def _input_rung(plan):
 
 def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
                      warmup_service: bool = True, conf=None,
-                     iterations: int = 2, data_dir: str = None):
+                     iterations: int = 2, data_dir: str = None,
+                     skew: float = 0.0):
     """One REAL TPC query end-to-end through the engine (round-5
     verdict: the driver-visible bench must capture a full query whose
     number moves with engine work, not only the q5lite microbench).
@@ -218,9 +219,12 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
     from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner
 
     family = benchmark.split("_")[0]
-    r = BenchmarkRunner(
-        data_dir or os.path.join("/tmp", f"srt_bench_{family}"), sf,
-        conf=conf)
+    # skewed data lands in its own dir: the marker protocol allows one
+    # dataset per dir, and a skewed run must not poison uniform reruns
+    default_dir = os.path.join(
+        "/tmp", f"srt_bench_{family}" + (f"_skew{skew}" if skew else ""))
+    r = BenchmarkRunner(data_dir or default_dir, sf, conf=conf,
+                        skew=skew)
     warmed = None
     if warmup_service:
         try:
@@ -259,6 +263,17 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
         # mesh-requested shuffles that stayed on the host/TCP path,
         # with the spmd gate's reason (empty = all folded in-program)
         "shuffle_fallbacks": dt.get("shuffle_fallbacks"),
+        # every AQE replan the run made (skew splits/salting, strategy
+        # switches, re-bucketing) with counts; empty = static plan ran
+        "replan_events": res.get("replan_events"),
+        # generator provenance: a skewed record names its distribution
+        # so the JSON alone says what data produced these numbers
+        "skew_params": {
+            "skew": skew,
+            "distribution": f"zipf(s=2, ranks={_skew_ranks()})",
+            "hot_key_fraction": skew,
+            "table": "lineitem", "column": "l_orderkey",
+        } if skew else None,
         "rtt_share": round(
             min(dt.get("est_dispatch_overhead_s", 0.0) / wall, 1.0), 3)
         if wall else None,
@@ -274,6 +289,12 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
         "device_budget": mem.get("device_budget"),
         "warmup": warmed,
     }
+
+
+def _skew_ranks() -> int:
+    from spark_rapids_tpu.benchmarks import datagen
+
+    return datagen.SKEW_RANKS
 
 
 def _scale_main():
@@ -302,14 +323,33 @@ def _scale_main():
     sf = arg("--sf", 1.0, float)
     budget = arg("--device-budget", 0, int)
     iters = arg("--iterations", 2, int)
+    skew = arg("--skew", 0.0, float)
     kernels = "--kernels" in sys.argv
+
+    def _conf_value(v: str):
+        if v.lower() in ("true", "false"):
+            return v.lower() == "true"
+        for cast in (int, float):
+            try:
+                return cast(v)
+            except ValueError:
+                pass
+        return v
+
+    # repeatable --conf key=value passthrough (session knobs only —
+    # e.g. forcing adaptive skew thresholds for a skewed-join record)
+    overrides = {}
+    for i, a in enumerate(sys.argv):
+        if a == "--conf" and i + 1 < len(sys.argv):
+            k, _, v = sys.argv[i + 1].partition("=")
+            overrides[k] = _conf_value(v)
     conf = None
-    if budget or kernels:
+    if budget or kernels or overrides:
         from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.config import RapidsConf
         from spark_rapids_tpu.runtime import device as rt
 
-        conf_d = {}
+        conf_d = dict(overrides)
         if budget:
             conf_d[cfg.DEVICE_BUDGET.key] = budget
         if kernels:
@@ -317,11 +357,12 @@ def _scale_main():
             # contract as memory/retry): initialize applies them
             conf_d[cfg.NATIVE_KERNELS_ENABLED.key] = True
         conf = RapidsConf(conf_d)
-        rt.initialize(conf)  # budgeted spill catalog + kernel gates
+        if budget or kernels:
+            rt.initialize(conf)  # budgeted spill catalog + kernel gates
     full = bench_full_query(benchmark, sf=sf,
                             warmup_service="--no-warmup" not in sys.argv,
                             conf=conf, iterations=iters,
-                            data_dir=arg("--data-dir"))
+                            data_dir=arg("--data-dir"), skew=skew)
     refresh_cache_seed()
     print(json.dumps({"metric": "full_query_scale", "full_query": full}))
 

@@ -53,10 +53,37 @@ def _dates(rng, n, lo_year=1992, hi_year=1998):
     return days.astype("datetime64[D]")
 
 
-def gen_lineitem(sf: float, seed: int = 11) -> pa.Table:
+#: hot ranks of the skewed generator: rank j (1-based) draws a
+#: ``skew / j**2`` fraction of lineitem rows onto one orderkey — a
+#: truncated Zipf(s=2) head over real o_orderkey values (multiples of
+#: 4), so skewed joins still match orders rows
+SKEW_RANKS = 4
+
+
+def _skewed_orderkeys(rng, orderkey: np.ndarray, skew: float
+                      ) -> np.ndarray:
+    """Overwrite a ``skew/j**2`` fraction of rows per hot rank j with
+    the key ``4*j``; rank 1 carries exactly ``skew`` of all rows (the
+    aqe_check fence: --skew 0.5 puts half of lineitem on one key)."""
+    n = len(orderkey)
+    u = rng.random(n)
+    lo = 0.0
+    out = orderkey.copy()
+    for j in range(1, SKEW_RANKS + 1):
+        hi = lo + skew / j ** 2
+        out[(u >= lo) & (u < hi)] = 4 * j
+        lo = hi
+    return out
+
+
+def gen_lineitem(sf: float, seed: int = 11, skew: float = 0.0
+                 ) -> pa.Table:
     rng = np.random.default_rng(seed)
     n = max(int(6_000_000 * sf), 100)
     orderkey = rng.integers(1, max(int(1_500_000 * sf), 25) * 4, n)
+    if skew:
+        # cap so the rank fractions sum below 1 (sum(1/j^2) < 1.645)
+        orderkey = _skewed_orderkeys(rng, orderkey, min(skew, 0.6))
     shipdate = _dates(rng, n)
     commit_delta = rng.integers(-30, 61, n)
     receipt_delta = rng.integers(1, 31, n)
@@ -188,12 +215,17 @@ GENERATORS = {
 
 
 def write_tables(data_dir: str, sf: float, tables=None,
-                 files_per_table: int = 4) -> None:
+                 files_per_table: int = 4, skew: float = 0.0) -> None:
     """Generate and write parquet (multi-file: scan splits become TPU scan
-    partitions, like the reference's multi-file parquet layout)."""
+    partitions, like the reference's multi-file parquet layout).
+    ``skew`` > 0 concentrates lineitem's l_orderkey onto a few hot keys
+    (see :func:`_skewed_orderkeys`); other tables are unaffected."""
     os.makedirs(data_dir, exist_ok=True)
     for name in tables or GENERATORS:
-        table = GENERATORS[name](sf)
+        if name == "lineitem" and skew:
+            table = gen_lineitem(sf, skew=skew)
+        else:
+            table = GENERATORS[name](sf)
         tdir = os.path.join(data_dir, name)
         os.makedirs(tdir, exist_ok=True)
         n = table.num_rows

@@ -36,10 +36,13 @@ ALL_BENCHMARKS["mortgage_etl"] = mortgage.etl
 
 class BenchmarkRunner:
     def __init__(self, data_dir: str, sf: float,
-                 conf: Optional[RapidsConf] = None):
+                 conf: Optional[RapidsConf] = None, skew: float = 0.0):
         self.data_dir = data_dir
         self.sf = sf
         self.conf = conf or RapidsConf()
+        # hot-key fraction for the skewed generator (tpch lineitem
+        # only); 0.0 keeps the uniform data AND the uniform marker name
+        self.skew = skew
 
     def ensure_data(self, benchmark: str = "tpch") -> None:
         if benchmark.startswith("mortgage"):
@@ -50,8 +53,9 @@ class BenchmarkRunner:
             family = "tpcxbb"
         else:
             family = "tpch"
+        suffix = f"-skew-{self.skew}" if self.skew else ""
         marker = os.path.join(self.data_dir,
-                              f".{family}-sf-{self.sf}")
+                              f".{family}-sf-{self.sf}{suffix}")
         if os.path.exists(marker):
             return
         os.makedirs(self.data_dir, exist_ok=True)
@@ -68,7 +72,8 @@ class BenchmarkRunner:
         elif family == "tpcxbb":
             tpcxbb.write_tables(self.data_dir, self.sf)
         else:
-            datagen.write_tables(self.data_dir, self.sf)
+            datagen.write_tables(self.data_dir, self.sf,
+                                 skew=self.skew)
         with open(marker, "w") as f:
             f.write("ok")
 
@@ -124,6 +129,10 @@ class BenchmarkRunner:
         # fallback telemetry covers the WHOLE run (planning records the
         # reasons, and planning happens inside the iteration loop)
         run_pre_fb = spmd.fallback_snapshot()
+        # AQE replan events over the whole run (counters live in
+        # execs.adaptive; the dispatch module passes through so the
+        # telemetry consumers snapshot from one place)
+        run_pre_replan = disp.replan_snapshot()
         # run-relative snapshots: totals, per-site map, catalog spill
         # counters and injector counts all report DELTAS over this run
         # — a second benchmark in the same process must not inherit the
@@ -184,6 +193,10 @@ class BenchmarkRunner:
         # lineage fault recovery during the run (zeros on a healthy
         # cluster; a chaos run shows its re-run maps and respawns here)
         result["recovery"] = _recovery.delta(run_pre_recovery)
+        # every AQE replan this run made (skew splits/salting, strategy
+        # switches, re-bucketing), with counts — zeros/empty when the
+        # static plan ran unchanged
+        result["replan_events"] = disp.replan_delta(run_pre_replan)
         if telemetry and result["iterations"]:
             # the BASELINE.md-promised split: dispatch_count x RTT vs
             # time actually spent computing on the device
@@ -216,6 +229,7 @@ class BenchmarkRunner:
                 # every mesh-requested shuffle that stayed on the
                 # host/TCP path this run, with the gate's reason
                 "shuffle_fallbacks": spmd.fallback_delta(run_pre_fb),
+                "replan_events": disp.replan_delta(run_pre_replan),
                 "compile_cache": progcache.stats(),
             }
             # MEASURED on-device time (round-5): one extra serialized
@@ -311,6 +325,10 @@ def main(argv=None):
                         "iteration and report the dispatch-RTT vs "
                         "on-device split (install happens at module "
                         "import, before the compute modules load)")
+    p.add_argument("--skew", type=float, default=0.0,
+                   help="hot-key fraction for the skewed tpch "
+                        "generator (0.5 = one orderkey carries half "
+                        "of lineitem); 0 keeps uniform data")
     p.add_argument("--data-dir", default="/tmp/rapids_tpu_tpch")
     p.add_argument("--output", default=None)
     args = p.parse_args(argv)
@@ -327,7 +345,7 @@ def main(argv=None):
                     "for programmatic use call "
                     "spark_rapids_tpu.utils.dispatch.install() before "
                     "importing the runner)")
-    runner = BenchmarkRunner(args.data_dir, args.sf)
+    runner = BenchmarkRunner(args.data_dir, args.sf, skew=args.skew)
     result = runner.run(args.benchmark, iterations=args.iterations,
                         compare=args.compare, warmup=args.warmup)
     text = json.dumps(result, indent=2)

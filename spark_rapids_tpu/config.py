@@ -741,6 +741,90 @@ ADVISORY_PARTITION_SIZE = conf(
     "Target bytes per coalesced shuffle partition."
 ).bytes_conf.create_with_default(64 << 20)
 
+ADAPTIVE_SKEW_JOIN = conf("rapids.tpu.sql.adaptive.skewJoin.enabled").doc(
+    "Replan rule 1 (OptimizeSkewedJoin analogue): shuffle partitions "
+    "exceeding the skewedPartition cut are split into sub-reads on the "
+    "host path, and salted across mesh devices before the in-program "
+    "all_to_all, while the other join side replicates — the hot key "
+    "stops setting the whole mesh's wall clock. Each split/salt is a "
+    "skew replan event in the dispatch telemetry."
+).boolean_conf.create_with_default(True)
+
+ADAPTIVE_SKEW_FACTOR = conf(
+    "rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor").doc(
+    "A shuffle partition is skewed when its bytes exceed this multiple "
+    "of the median partition size (and the threshold below) — Spark's "
+    "skewedPartitionFactor."
+).double_conf.create_with_default(5.0)
+
+ADAPTIVE_SKEW_THRESHOLD = conf(
+    "rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes"
+).doc(
+    "Minimum bytes before a partition can be considered skewed, "
+    "whatever the factor says — Spark's skewedPartitionThresholdInBytes."
+).bytes_conf.create_with_default(256 << 20)
+
+ADAPTIVE_SKEW_MAX_SPLITS = conf(
+    "rapids.tpu.sql.adaptive.skewJoin.maxSplitsPerPartition").doc(
+    "Upper bound on sub-reads one skewed partition is split into "
+    "(bounds the replicated-side re-reads and the salt fan-out)."
+).int_conf.create_with_default(8)
+
+ADAPTIVE_STRATEGY_SWITCH = conf(
+    "rapids.tpu.sql.adaptive.strategySwitch.enabled").doc(
+    "Replan rule 2: once the build-side exchange has materialized, "
+    "re-decide the join strategy from MEASURED bytes — a shuffled hash "
+    "join whose build side came in under autoBroadcastJoinThreshold "
+    "re-plans as a broadcast join (skipping the stream-side shuffle "
+    "read restructure), and a dense key range upgrades the probe to "
+    "the direct-address table. Recorded as strategy_switch replan "
+    "events."
+).boolean_conf.create_with_default(True)
+
+ADAPTIVE_DENSE_JOIN = conf(
+    "rapids.tpu.sql.adaptive.denseJoin.enabled").doc(
+    "Allow the strategy switch to flip a shuffled hash join's probe to "
+    "the dense direct-address table when the measured build key range "
+    "is dense enough (minDensity/maxKeySpan below) — one gather per "
+    "probe row instead of an int64 hash + binary search."
+).boolean_conf.create_with_default(True)
+
+ADAPTIVE_DENSE_MAX_SPAN = conf(
+    "rapids.tpu.sql.adaptive.denseJoin.maxKeySpan").doc(
+    "Largest (max-min+1) build key span eligible for the dense table; "
+    "the start-offset table costs 4 bytes per slot of span."
+).int_conf.create_with_default(1 << 23)
+
+ADAPTIVE_DENSE_MIN_DENSITY = conf(
+    "rapids.tpu.sql.adaptive.denseJoin.minDensity").doc(
+    "Minimum build_rows / key_span ratio before the dense table is "
+    "considered worth its memory."
+).double_conf.create_with_default(0.125)
+
+ADAPTIVE_DENSE_MIN_ROWS = conf(
+    "rapids.tpu.sql.adaptive.denseJoin.minBuildRows").doc(
+    "Skip the key-range measurement (one extra dispatch + sync per "
+    "build) for builds smaller than this many rows — the hash probe is "
+    "already cheap there."
+).int_conf.create_with_default(1 << 16)
+
+ADAPTIVE_REBUCKET = conf(
+    "rapids.tpu.sql.adaptive.rebucket.enabled").doc(
+    "Replan rule 3a: an adaptive join read serving a coalesced group "
+    "of 2+ map blocks concatenates them into ONE batch bucketed at the "
+    "MEASURED row count, so the progcache serves the right ladder rung "
+    "instead of padding each small block to its own bucket. Recorded "
+    "as rebucket replan events."
+).boolean_conf.create_with_default(True)
+
+ADAPTIVE_RUNTIME_STATS = conf(
+    "rapids.tpu.sql.adaptive.runtimeStats.enabled").doc(
+    "Replan rule 3b: measured exchange cardinalities feed "
+    "estimate_footprint_bytes on later plans of the same shape, so "
+    "out-of-core admission tightens as the workload runs instead of "
+    "guessing from the static default row estimate."
+).boolean_conf.create_with_default(True)
+
 PARQUET_DEBUG_DUMP_PREFIX = conf(
     "rapids.tpu.sql.parquet.debug.dumpPrefix").doc(
     "When set, copy every parquet file a scan reads under this directory "

@@ -1,27 +1,40 @@
-"""Adaptive (AQE-equivalent) shuffle reads.
+"""Adaptive (AQE-equivalent) shuffle reads and runtime replanning.
 
 Reference: with AQE on, exchanges become query stages; after a stage's map
 side runs, Spark replans reads using MapOutputStatistics and the plugin
 supplies GpuCustomShuffleReaderExec for coalesced-partition reads
 (GpuOverrides.scala:1874-1887, GpuTransitionOverrides.scala:51-94). The
 reference v0.3 supports COALESCED reads (skewed-join splitting stayed on
-CPU), and so does this exec.
+CPU); this layer goes further and replans three ways once the map side
+has materialized, because the block store makes the statistics exact:
 
-Here the exchange exec already materializes map output into a block store,
-so statistics are exact: the reader computes contiguous partition groups
-targeting the advisory size and serves each group as one output
-partition. For joins, BOTH sides must coalesce identically — build the
-groups from the summed per-partition sizes and share the spec
-(CoalesceShufflePartitions applies one spec per stage the same way).
+1. **skew splitting** (OptimizeSkewedJoin analogue): partitions over the
+   ``skewed_partitions`` cut are split into sub-reads while the other
+   join side replicates the partition — the partition-aligned join
+   contract survives because every (sub_i, replica) pair still covers
+   exactly the co-partitioned key set.
+2. **join-strategy switch**: ``AdaptiveShuffledJoinExec`` defers the
+   shuffled-vs-broadcast (and hash-vs-dense probe) decision until the
+   build-side exchange has materialized and its size is MEASURED, not
+   estimated.
+3. **stats-driven re-bucketing**: coalesced groups of 2+ map blocks are
+   re-bucketed into one batch at the measured row count (the progcache
+   serves the right ladder rung instead of padding each block), and
+   measured exchange cardinalities feed ``estimate_footprint_bytes`` so
+   out-of-core admission tightens as the workload runs.
+
+Every replan is recorded as a replan event (``record_replan``) surfaced
+through dispatch telemetry and the runner/bench JSON.
 """
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.utils import lockorder
 
 #: while active IN THIS THREAD, AdaptiveShuffleReaderExec.num_partitions
 #: answers with the exchange's STATIC partition count instead of
@@ -50,6 +63,80 @@ def planning_mode():
         _PLANNING.depth -= 1
 
 
+# ---------------------------------------------------------------------------
+# replan-event telemetry + measured-cardinality registry
+# ---------------------------------------------------------------------------
+
+#: {(rule, detail): count} — every physical-plan change made after
+#: execution started. Process-global like parallel.spmd's fallback
+#: counters; the runner/bench snapshot-delta them per run.
+_replans: Dict[Tuple[str, str], int] = {}
+#: {schema-names signature: max measured rows} — rule 3b's runtime
+#: statistics, consumed by plan.optimizer.estimate_footprint_bytes via
+#: the query service on later plans of the same shape.
+_cardinalities: Dict[Tuple[str, ...], int] = {}
+_replan_lock = lockorder.make_lock("execs.adaptive.replans")
+
+
+def record_replan(rule: str, detail: str) -> None:
+    """Count one replan event (rule in {skew_split, skew_salt,
+    strategy_switch, rebucket})."""
+    with _replan_lock:
+        key = (rule, detail)
+        _replans[key] = _replans.get(key, 0) + 1
+
+
+def replan_snapshot() -> Dict[str, int]:
+    with _replan_lock:
+        return {f"{rule}: {detail}": n
+                for (rule, detail), n in sorted(_replans.items())}
+
+
+def replan_delta(before: Dict[str, int]) -> Dict[str, int]:
+    """Positive event deltas since ``before`` (a replan_snapshot())."""
+    now = replan_snapshot()
+    return {k: v - before.get(k, 0) for k, v in now.items()
+            if v - before.get(k, 0) > 0}
+
+
+def record_cardinality(signature: Sequence[str], rows: int) -> None:
+    """Record a MEASURED row count for plans whose node output matches
+    ``signature`` (column names). Keeps the max seen — footprint
+    admission wants the conservative bound."""
+    sig = tuple(signature)
+    with _replan_lock:
+        if rows > _cardinalities.get(sig, -1):
+            _cardinalities[sig] = rows
+
+
+def cardinality_lookup(signature: Sequence[str]) -> Optional[int]:
+    with _replan_lock:
+        return _cardinalities.get(tuple(signature))
+
+
+def plan_cardinality_rows(node) -> Optional[int]:
+    """estimate_footprint_bytes ``runtime_rows`` hook: measured rows for
+    a plan node, matched by output column names."""
+    try:
+        names = tuple(node.output_schema().names)
+    except (AttributeError, TypeError, IndexError):
+        return None  # schema-less node: no stats to serve
+    return cardinality_lookup(names)
+
+
+def _record_exchange_stats(exchange: ShuffleExchangeExec,
+                          stats: "MapOutputStatistics") -> None:
+    """Feed a materialized exchange's measured size into the
+    cardinality registry (rows from capacity bytes / row width — an
+    upper bound, which is the right direction for admission)."""
+    try:
+        names = tuple(exchange.schema.names)
+        width = sum(t.byte_width + 1 for t in exchange.schema.types) or 1
+    except (AttributeError, TypeError):
+        return  # schema-less exchange: stats stay advisory-only
+    record_cardinality(names, sum(stats.bytes_by_partition) // width)
+
+
 class MapOutputStatistics:
     """Exact per-reduce-partition byte sizes of a materialized exchange
     (the MapOutputStatistics the AQE replan consumes)."""
@@ -65,8 +152,7 @@ class MapOutputStatistics:
     def skewed_partitions(self, factor: float = 5.0,
                           threshold: int = 256 << 20) -> List[int]:
         """Partitions larger than max(threshold, factor * median) — the
-        OptimizeSkewedJoin detection rule; surfaced as diagnostics (the
-        reference keeps skew handling on CPU in v0.3)."""
+        OptimizeSkewedJoin detection rule."""
         sizes = sorted(self.bytes_by_partition)
         if not sizes:
             return []
@@ -92,7 +178,10 @@ def coalesce_groups(stats: MapOutputStatistics, advisory_bytes: int,
         cur_bytes += size
     if cur:
         groups.append(cur)
-    # honor a minimum parallelism by splitting the largest groups
+    # honor a minimum parallelism by splitting the largest groups at
+    # their byte-balanced point — an index midpoint would recreate the
+    # skew forced parallelism exists to avoid (one heavy half keeps the
+    # straggler, the light half runs empty)
     while len(groups) < min_partitions:
         big = max(range(len(groups)),
                   key=lambda i: (len(groups[i]),
@@ -101,24 +190,103 @@ def coalesce_groups(stats: MapOutputStatistics, advisory_bytes: int,
         g = groups[big]
         if len(g) <= 1:
             break
-        mid = len(g) // 2
-        groups[big:big + 1] = [g[:mid], g[mid:]]
+        sizes = [stats.bytes_by_partition[p] for p in g]
+        total = sum(sizes)
+        best_cut, best_imbalance, acc = 1, None, 0
+        for j in range(1, len(g)):
+            acc += sizes[j - 1]
+            imbalance = abs(2 * acc - total)
+            if best_imbalance is None or imbalance < best_imbalance:
+                best_cut, best_imbalance = j, imbalance
+        groups[big:big + 1] = [g[:best_cut], g[best_cut:]]
     return groups
+
+
+#: A group entry is either a whole partition id or a sub-read
+#: ``(pid, sub_index, sub_count)`` of a skew-split partition: the reader
+#: serves every ``sub_count``-th map block of ``pid`` starting at
+#: ``sub_index`` (block-granular round-robin — no device slicing, and
+#: the union of the sub-reads is exactly the partition).
+GroupEntry = Union[int, Tuple[int, int, int]]
+
+
+def _split_count(size: int, advisory_bytes: int, max_splits: int) -> int:
+    """Sub-reads for one skewed partition: target the advisory size but
+    always split a DETECTED skew at least in two."""
+    target = max(advisory_bytes, 1)
+    return max(2, min(max_splits, -(-size // target)))
+
+
+def skewed_group_pair(base_groups: List[List[int]],
+                      left_stats: MapOutputStatistics,
+                      right_stats: MapOutputStatistics,
+                      kind: str, factor: float, threshold: int,
+                      max_splits: int, advisory_bytes: int
+                      ) -> Tuple[List[List[GroupEntry]],
+                                 List[List[GroupEntry]]]:
+    """Replan rule 1 on the host path: expand a shared coalesced group
+    spec into two ALIGNED per-side specs where each skewed singleton
+    group becomes sub-read x replica pairs.
+
+    Splitting the STREAM (left) side while the build replicates is exact
+    for every kind that never emits unmatched build rows (all kinds the
+    planner routes here except ``full`` — each stream row lives in
+    exactly one sub-read, so matched and unmatched emission both happen
+    once). The BUILD side may additionally split for ``inner``, where
+    neither side emits unmatched rows; both-sides-skewed takes the
+    sub-read cross product."""
+    if kind == "full":
+        return base_groups, base_groups
+    lhot = set(left_stats.skewed_partitions(factor, threshold))
+    rhot = set(right_stats.skewed_partitions(factor, threshold)) \
+        if kind == "inner" else set()
+    lgroups: List[List[GroupEntry]] = []
+    rgroups: List[List[GroupEntry]] = []
+    for g in base_groups:
+        # only singleton groups split: a partition over the skew cut is
+        # alone in its group whenever advisory <= cut, and splitting a
+        # merged group would tangle sub-reads of different partitions
+        if len(g) != 1 or (g[0] not in lhot and g[0] not in rhot):
+            lgroups.append(list(g))
+            rgroups.append(list(g))
+            continue
+        p = g[0]
+        nl = _split_count(left_stats.bytes_by_partition[p],
+                          advisory_bytes, max_splits) if p in lhot else 1
+        nr = _split_count(right_stats.bytes_by_partition[p],
+                          advisory_bytes, max_splits) if p in rhot else 1
+        for i in range(nl):
+            for j in range(nr):
+                lgroups.append([(p, i, nl)] if nl > 1 else [p])
+                rgroups.append([(p, j, nr)] if nr > 1 else [p])
+        side = "both" if (nl > 1 and nr > 1) else \
+            ("stream" if nl > 1 else "build")
+        record_replan("skew_split", f"{side} side, host path")
+    return lgroups, rgroups
 
 
 class AdaptiveShuffleReaderExec(TpuExec):
     """Serves coalesced partition groups of a materialized exchange
     (GpuCustomShuffleReaderExec analogue). ``groups_provider`` defers the
     statistics read until first access — the map stage runs when the
-    first consumer pulls, exactly AQE's materialize-then-replan order."""
+    first consumer pulls, exactly AQE's materialize-then-replan order.
+
+    ``rebucket_bytes`` (replan rule 3a, set only on join-paired readers)
+    re-buckets a group of 2+ map blocks whose measured bytes fit the
+    limit into ONE batch at the measured row count: the progcache then
+    serves the right ladder rung instead of padding every small block to
+    its own bucket. Value-exact and order-preserving — concatenation in
+    group order is the same row order the consumer would have seen."""
 
     def __init__(self, exchange: ShuffleExchangeExec,
                  advisory_bytes: int,
-                 groups_provider=None):
+                 groups_provider=None,
+                 rebucket_bytes: int = 0):
         super().__init__([exchange], exchange.schema)
         self.advisory_bytes = advisory_bytes
+        self.rebucket_bytes = rebucket_bytes
         self._groups_provider = groups_provider
-        self._groups: Optional[List[List[int]]] = None
+        self._groups: Optional[List[List[GroupEntry]]] = None
 
     @property
     def exchange(self) -> ShuffleExchangeExec:
@@ -134,7 +302,7 @@ class AdaptiveShuffleReaderExec(TpuExec):
         return state
 
     @property
-    def groups(self) -> List[List[int]]:
+    def groups(self) -> List[List[GroupEntry]]:
         if self._groups is None:
             if self._groups_provider is not None:
                 self._groups = self._groups_provider()
@@ -153,15 +321,74 @@ class AdaptiveShuffleReaderExec(TpuExec):
     def coalesce_after(self):
         return self.exchange.coalesce_after
 
+    def _entry_batches(self, entries: List[GroupEntry]
+                       ) -> Iterator[ColumnarBatch]:
+        for e in entries:
+            if isinstance(e, tuple):
+                p, sub_i, sub_n = e
+                for bi, b in enumerate(self.exchange.execute(p)):
+                    if bi % sub_n == sub_i:
+                        yield b
+            else:
+                yield from self.exchange.execute(e)
+
+    def _group_bytes(self, entries: List[GroupEntry]) -> int:
+        sizes = self.exchange.map_output_sizes()
+        total = 0
+        for e in entries:
+            if isinstance(e, tuple):
+                p, _sub_i, sub_n = e
+                total += sizes[p] // sub_n
+            else:
+                total += sizes[e]
+        return total
+
+    def _serve_rebucketed(self, entries: List[GroupEntry]
+                          ) -> Iterator[ColumnarBatch]:
+        from contextlib import ExitStack
+
+        from spark_rapids_tpu.memory import priorities
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.memory.spillable import SpillableBatch
+        from spark_rapids_tpu.ops.concat import concat_batches
+
+        staged: List[SpillableBatch] = []
+        for b in self._entry_batches(entries):
+            if b.realized_num_rows() == 0:
+                continue
+            staged.append(SpillableBatch(
+                b, priorities.INPUT_FROM_SHUFFLE_PRIORITY))
+        if not staged:
+            yield ColumnarBatch.empty(self.schema)
+            return
+        if len(staged) == 1:
+            with staged[0].acquired() as b:
+                yield b
+            staged[0].close()
+            return
+        with ExitStack() as stack:
+            parts = [stack.enter_context(sb.acquired()) for sb in staged]
+            merged = with_retry_no_split(
+                lambda: concat_batches(parts),
+                tag="adaptive.rebucket.concat")
+        for sb in staged:
+            sb.close()
+        record_replan("rebucket", "group concat at measured rows")
+        yield merged
+
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         def it():
+            entries = self.groups[partition]
+            if self.rebucket_bytes and \
+                    self._group_bytes(entries) <= self.rebucket_bytes:
+                yield from self._serve_rebucketed(entries)
+                return
             empty = True
-            for p in self.groups[partition]:
-                for b in self.exchange.execute(p):
-                    if b.realized_num_rows() == 0:
-                        continue
-                    empty = False
-                    yield b
+            for b in self._entry_batches(entries):
+                if b.realized_num_rows() == 0:
+                    continue
+                empty = False
+                yield b
             if empty:
                 yield ColumnarBatch.empty(self.schema)
         return timed(self, it())
@@ -169,16 +396,22 @@ class AdaptiveShuffleReaderExec(TpuExec):
 
 def paired_adaptive_readers(left: ShuffleExchangeExec,
                             right: ShuffleExchangeExec,
-                            advisory_bytes: int
+                            advisory_bytes: int,
+                            join_kind: Optional[str] = None,
+                            skew: Optional[tuple] = None,
+                            rebucket_bytes: int = 0
                             ) -> "tuple[TpuExec, TpuExec]":
     """One shared group spec for a join's two shuffles, computed lazily
     from the summed per-partition sizes so the partition-aligned join
-    contract survives coalescing."""
+    contract survives coalescing. With ``skew`` (a
+    parallel.spmd.SkewSpec) and a splittable ``join_kind``, skewed
+    singleton groups expand into aligned sub-read x replica pairs
+    (replan rule 1)."""
     assert left.num_out_partitions == right.num_out_partitions
-    cache: List[Optional[List[List[int]]]] = [None]
+    cache: List[Optional[tuple]] = [None]
     readers: List[AdaptiveShuffleReaderExec] = []
 
-    def provider():
+    def resolve():
         # read through the READERS' current children, not the captured
         # exchanges: a post-planning pass (cluster mode) may swap the
         # exchange object underneath, and stats must come from the one
@@ -186,14 +419,161 @@ def paired_adaptive_readers(left: ShuffleExchangeExec,
         if cache[0] is None:
             ls = MapOutputStatistics.of(readers[0].exchange)
             rs = MapOutputStatistics.of(readers[1].exchange)
+            _record_exchange_stats(readers[0].exchange, ls)
+            _record_exchange_stats(readers[1].exchange, rs)
             combined = MapOutputStatistics(
                 [a + b for a, b in zip(ls.bytes_by_partition,
                                        rs.bytes_by_partition)])
-            cache[0] = coalesce_groups(combined, advisory_bytes)
+            base = coalesce_groups(combined, advisory_bytes)
+            if skew is not None and join_kind is not None:
+                cache[0] = skewed_group_pair(
+                    base, ls, rs, join_kind, skew.factor, skew.threshold,
+                    skew.max_splits, advisory_bytes)
+            else:
+                cache[0] = (base, base)
         return cache[0]
 
-    readers.append(AdaptiveShuffleReaderExec(left, advisory_bytes,
-                                             provider))
-    readers.append(AdaptiveShuffleReaderExec(right, advisory_bytes,
-                                             provider))
+    readers.append(AdaptiveShuffleReaderExec(
+        left, advisory_bytes, lambda: resolve()[0],
+        rebucket_bytes=rebucket_bytes))
+    readers.append(AdaptiveShuffleReaderExec(
+        right, advisory_bytes, lambda: resolve()[1],
+        rebucket_bytes=rebucket_bytes))
     return readers[0], readers[1]
+
+
+class AdaptiveShuffledJoinExec(TpuExec):
+    """Replan rule 2: a shuffled equi-join whose final strategy is
+    decided at EXECUTE time from the materialized build-side exchange.
+
+    The planner routes a would-be ShuffledHashJoinExec here when AQE is
+    on; the first consumer pull materializes the build side's map stage
+    (the stage boundary AQE replans at), then:
+
+    - measured build bytes <= autoBroadcastJoinThreshold: re-plan as a
+      broadcast join, reusing the build blocks through a whole-exchange
+      reader and SKIPPING the stream-side shuffle entirely (the stream
+      exchange's child feeds the probe directly, keeping its map-side
+      partitioning) — the mis-estimated case the static planner cannot
+      catch because scan statistics don't see filter selectivity;
+    - otherwise: shuffled hash join over skew-aware aligned adaptive
+      readers, with the dense-probe hint attached so joins.HashJoinExec
+      can upgrade hash->dense per partition from the measured key range.
+
+    Decision and children swap happen under the ``execs.adaptive.decide``
+    barrier (planBarrier group — deciding materializes child exchanges,
+    and the decision itself may run under an outer exchange's
+    materialize)."""
+
+    def __init__(self, kind: str, left: ShuffleExchangeExec,
+                 right: ShuffleExchangeExec, left_keys: List[int],
+                 right_keys: List[int], schema, condition=None,
+                 conf=None):
+        super().__init__([left, right], schema)
+        self.kind = kind
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.condition = condition
+        self.conf = conf
+        self._inner: Optional[TpuExec] = None
+        self._decide_lock = lockorder.make_lock("execs.adaptive.decide")
+
+    def __getstate__(self):
+        # cluster task closures resolve the decision first (like the
+        # reader freezing its groups); the lock stays behind
+        state = dict(self.__dict__)
+        state.pop("_decide_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._decide_lock = lockorder.make_lock("execs.adaptive.decide")
+
+    @property
+    def num_partitions(self) -> int:
+        if self._inner is None and planning_active():
+            return self.children[0].num_out_partitions
+        return self._decide().num_partitions
+
+    @property
+    def children_coalesce_goal(self):
+        return [None] * len(self.children)
+
+    def _label_subtree(self, node: TpuExec) -> None:
+        """Stage-label runtime-built nodes with this exec's own label so
+        their dispatches don't surface as <unstaged> in the telemetry
+        (cut_stages only saw the pre-decision tree)."""
+        if getattr(node, "_stage_label", None) is None:
+            node._stage_label = getattr(self, "_stage_label", None)
+            for c in node.children:
+                self._label_subtree(c)
+
+    def _decide(self) -> TpuExec:
+        if self._inner is not None:
+            return self._inner
+        with self._decide_lock:
+            if self._inner is None:
+                inner = self._plan_runtime()
+                self._label_subtree(inner)
+                self._inner = inner
+                # downstream walkers (metrics, tree_string, plan
+                # introspection) see the decided subtree
+                self.children = [inner]
+        return self._inner
+
+    def _plan_runtime(self) -> TpuExec:
+        from spark_rapids_tpu import config as cfg
+        from spark_rapids_tpu.execs import joins
+        from spark_rapids_tpu.parallel import spmd
+
+        conf = self.conf
+        lex, rex = self.children
+        advisory = conf.get(cfg.ADVISORY_PARTITION_SIZE)
+        rs = MapOutputStatistics.of(rex)
+        _record_exchange_stats(rex, rs)
+        build_bytes = sum(rs.bytes_by_partition)
+        thr = conf.get(cfg.AUTO_BROADCAST_THRESHOLD)
+        if (conf.get(cfg.ADAPTIVE_STRATEGY_SWITCH) and thr > 0
+                and self.kind != "full" and build_bytes <= thr
+                and type(lex) is ShuffleExchangeExec):
+            return self._broadcast_plan(lex, rex, advisory)
+        skew = spmd.adaptive_skew_spec(conf)
+        rebucket = advisory if conf.get(cfg.ADAPTIVE_REBUCKET) else 0
+        lr, rr = paired_adaptive_readers(
+            lex, rex, advisory, join_kind=self.kind, skew=skew,
+            rebucket_bytes=rebucket)
+        join = joins.ShuffledHashJoinExec(
+            self.kind, lr, rr, self.left_keys, self.right_keys,
+            self.schema, self.condition, conf)
+        if conf.get(cfg.ADAPTIVE_DENSE_JOIN):
+            join._dense_spec = (conf.get(cfg.ADAPTIVE_DENSE_MAX_SPAN),
+                                conf.get(cfg.ADAPTIVE_DENSE_MIN_DENSITY),
+                                conf.get(cfg.ADAPTIVE_DENSE_MIN_ROWS))
+        return join
+
+    def _broadcast_plan(self, lex: ShuffleExchangeExec,
+                        rex: ShuffleExchangeExec,
+                        advisory: int) -> TpuExec:
+        from spark_rapids_tpu.execs import joins
+        from spark_rapids_tpu.execs.exchange import BroadcastExchangeExec
+        from spark_rapids_tpu.plan.overrides import _ReplayExec
+
+        # the stream side never shuffles: its exchange is abandoned
+        # unmaterialized and the probe streams the map-side child with
+        # its original partitioning (broadcast joins preserve stream
+        # partitioning, so no contract changes)
+        stream = lex.children[0]
+        # the build blocks are already device-resident — serve ALL
+        # partitions as one reader partition feeding the broadcast
+        all_parts = [list(range(rex.num_out_partitions))]
+        reader = AdaptiveShuffleReaderExec(
+            rex, advisory, groups_provider=lambda: all_parts)
+        build = _ReplayExec(BroadcastExchangeExec(reader),
+                            stream.num_partitions)
+        record_replan("strategy_switch", "shuffled->broadcast")
+        return joins.BroadcastHashJoinExec(
+            self.kind, stream, build, self.left_keys, self.right_keys,
+            self.schema, self.condition, self.conf)
+
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        return self._decide().execute(partition)

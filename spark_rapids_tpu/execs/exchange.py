@@ -84,6 +84,11 @@ class ShuffleExchangeExec(TpuExec):
         # decision and records every "no" with a reason.
         self.in_program = False
         self._in_program_mesh = None
+        # AQE skew spec (parallel.spmd.SkewSpec) — when set, the
+        # in-program map side detects hot reduce partitions host-side
+        # (the input is already gathered for the collective) and salts
+        # them across the device axis before the all_to_all
+        self._skew_spec = None
         # reduce tasks run on concurrent threads; the map side must
         # materialize exactly once (Spark serializes this via stage
         # boundaries — here a lock is the stage barrier). A condition
@@ -107,6 +112,7 @@ class ShuffleExchangeExec(TpuExec):
         # TCP anyway — the spmd gate never enables both)
         state["in_program"] = False
         state["_in_program_mesh"] = None
+        state["_skew_spec"] = None
         return state
 
     def __setstate__(self, state):
@@ -114,16 +120,24 @@ class ShuffleExchangeExec(TpuExec):
         self._mat_lock = lockorder.make_condition(
             "exchange.shuffle.materialize")
 
-    def enable_in_program(self, mesh) -> None:
+    def enable_in_program(self, mesh, skew=None) -> None:
         """Switch the map side to the compiled all_to_all program over
         ``mesh``. Partition count and per-row partition assignment are
         unchanged (the step reproduces the host partition kernel's pid
         exactly), so consumers — including a co-partitioned sibling
-        exchange that stays on the host path — see identical blocks."""
+        exchange that stays on the host path — see identical blocks.
+
+        ``skew`` (a parallel.spmd.SkewSpec) arms AQE salting: reduce
+        partitions whose measured map-output bytes exceed the skew cut
+        are spread across ALL devices by the collective instead of
+        landing on ``pid % n_dev`` — the pid column is untouched, only
+        the routing changes, so the per-partition blocks sliced after
+        the collective are still exact."""
         assert self.partitioning[0] == "hash", self.partitioning
         assert self._blocks is None, "already materialized"
         self.in_program = True
         self._in_program_mesh = mesh
+        self._skew_spec = skew
 
     @property
     def num_partitions(self) -> int:
@@ -336,10 +350,12 @@ class ShuffleExchangeExec(TpuExec):
                 [np.ones(n, dtype=bool) if host[bi][ci][1] is None
                  else host[bi][ci][1][:n]
                  for bi, n in enumerate(ns)]))
+        salt = self._salt_pids(arrays, valids, types)
         datas, vs, counts = pshuffle.distributed_batch_from_host(
             mesh, arrays, types, validities=valids)[:3]
         step = pshuffle.shuffle_step(mesh, types,
-                                     list(self.partitioning[1]), num_out)
+                                     list(self.partitioning[1]), num_out,
+                                     salt_pids=salt)
         with TraceRange("ShuffleExchangeExec.all_to_all"):
             out_d, out_v, pids, recv = step(datas, vs, counts)
         hd, hv, hp, hn = jax.device_get(
@@ -354,13 +370,14 @@ class ShuffleExchangeExec(TpuExec):
                 continue
             seg = slice(d * rcap, d * rcap + k)
             seg_pids = hp[seg]
-            # device d received every row with pid % n_dev == d; split
-            # its compacted block into per-partition sub-blocks (pure
-            # numpy — no extra dispatch)
-            for p in range(d, num_out, n_dev):
+            # split the device's compacted block into per-partition
+            # sub-blocks (pure numpy — no extra dispatch). Unsalted,
+            # device d holds exactly the pids with pid % n_dev == d;
+            # a SALTED pid arrives on every device, so enumerate the
+            # pids actually present instead of the modular ladder
+            for p in np.unique(seg_pids):
+                p = int(p)
                 idx = np.nonzero(seg_pids == p)[0]
-                if not len(idx):
-                    continue
                 cap = bucket_capacity(len(idx))
                 cols = [Column.from_numpy(
                     hd[ci][seg][idx], t,
@@ -370,6 +387,36 @@ class ShuffleExchangeExec(TpuExec):
                     ColumnarBatch(cols, len(idx)),
                     priorities.OUTPUT_FOR_SHUFFLE_PRIORITY))
         return blocks
+
+    def _salt_pids(self, arrays, valids, types) -> Tuple[int, ...]:
+        """Hot reduce-partition ids for the in-program map side, from a
+        host mirror of the device partition hash over the already-
+        gathered input. Empty when skew handling is off or nothing
+        crosses the cut. Capped at 16 pids (largest first) — the salt
+        set is a compile-time constant of the shuffle program and an
+        unbounded set would fragment the program cache."""
+        spec = self._skew_spec
+        if spec is None or not arrays or not len(arrays[0]):
+            return ()
+        from spark_rapids_tpu.execs import adaptive as adaptive_exec
+        from spark_rapids_tpu.ops import hashing
+
+        pids = hashing.host_partition_ids(
+            arrays, valids, types, list(self.partitioning[1]),
+            self.num_out_partitions)
+        row_bytes = max(sum(t.byte_width + 1 for t in types), 1)
+        sizes = np.bincount(
+            pids, minlength=self.num_out_partitions) * row_bytes
+        stats = adaptive_exec.MapOutputStatistics(
+            [int(s) for s in sizes])
+        hot = stats.skewed_partitions(spec.factor, spec.threshold)
+        if not hot:
+            return ()
+        hot = sorted(hot, key=lambda p: -sizes[p])[:16]
+        for p in sorted(hot):
+            adaptive_exec.record_replan(
+                "skew_salt", f"partition {p} salted across mesh")
+        return tuple(sorted(hot))
 
     def _write_blocks(self, source, into=None
                       ) -> Dict[int, List[SpillableBatch]]:

@@ -1359,13 +1359,17 @@ class FusedChainExec(TpuExec):
                     outs, n2, ghosts = res
                     yield self.chain.wrap(outs, ghosts, n2)
                     continue
-                if not self._preps_ok:
-                    # duplicate build-key hashes: the speculative
-                    # output is discarded, the preserved subtree runs
-                    yield from self.fallback.execute(partition)
-                    return
-                # a peer thread prepared the builds; fall through to
-                # the probe path for this batch
+                # a peer thread may have prepared the builds; fall
+                # through to the shared dup check / probe path
+            if not self._preps_ok:
+                # duplicate build-key hashes: the speculative output
+                # is discarded, the preserved subtree runs. Checked
+                # OUTSIDE the is-None branch: a peer partition's
+                # leader can set the dup flag between our execute()
+                # routing decision and this batch, in which case
+                # _preps is None and the probe path must not run.
+                yield from self.fallback.execute(partition)
+                return
             saw = True
             with TraceRange("FusedChainExec"):
                 outs, n2, ghosts = self.chain.run(b, self._preps,

@@ -59,6 +59,10 @@ class HashJoinExec(TpuExec):
         self.condition = CompiledFilter(condition, conf) \
             if condition is not None else None
         self.join_budget_rows = join_budget_rows
+        # (max_span, min_density, min_rows) — AdaptiveShuffledJoinExec
+        # attaches this to arm the hash->dense probe upgrade; None (the
+        # default everywhere else) keeps the probe strictly hash-based
+        self._dense_spec = None
         self._batch_bytes = None
         if conf is not None:
             from spark_rapids_tpu import config as cfg
@@ -170,10 +174,15 @@ class HashJoinExec(TpuExec):
                 stream_batches = self.children[0].execute(partition)
             # build-once/probe-many: hash + sort (+ bucket table with
             # the join kernel on) a single time, reused by every stream
-            # batch below (None when a join key is a string column)
-            prepared = prepare_build(
-                build, self.right_keys, right_types,
-                [left_types[o] for o in self.left_keys])
+            # batch below (None when a join key is a string column).
+            # With the AQE dense hint armed, a measured-narrow key range
+            # upgrades the probe to a direct slot lookup instead.
+            prepared = self._dense_prepared(build, left_types,
+                                            right_types)
+            if prepared is None:
+                prepared = prepare_build(
+                    build, self.right_keys, right_types,
+                    [left_types[o] for o in self.left_keys])
             saw = False
             for b in stream_batches:
                 if b.realized_num_rows() == 0 and saw:
@@ -186,6 +195,50 @@ class HashJoinExec(TpuExec):
                                              prepared=prepared)
                 yield from outs
         return timed(self, it())
+
+    def _dense_prepared(self, build: ColumnarBatch, left_types,
+                        right_types):
+        """AQE replan: measure the build key range and, when it is
+        dense, slot-sort the build for direct-lookup probing
+        (ops.join.DensePreparedBuild). None whenever the shape or the
+        measurement disqualifies — the caller falls through to the hash
+        prepare. ``full`` is excluded: its unmatched-BUILD emission
+        order depends on the build sort (hash- vs slot-sorted), and
+        replans must stay bit-identical to the static plan."""
+        spec = self._dense_spec
+        if spec is None or self.kind == "full" \
+                or len(self.right_keys) != 1:
+            return None
+        from spark_rapids_tpu.columnar.column import StringColumn
+        from spark_rapids_tpu.ops import join as join_ops
+
+        max_span, min_density, min_rows = spec
+        col = build.columns[self.right_keys[0]]
+        if isinstance(col, StringColumn):
+            return None
+        common = join_ops.common_key_type(
+            left_types[self.left_keys[0]],
+            right_types[self.right_keys[0]])
+        if common is None or not common.is_integral:
+            return None
+        if build.realized_num_rows() < min_rows:
+            return None
+        kmin, kmax, n_valid = join_ops.measure_key_range(
+            col, build.num_rows_device())
+        if n_valid <= 0:
+            return None
+        span = kmax - kmin + 1
+        if not 0 < span <= max_span or n_valid / span < min_density:
+            return None
+        prepared = join_ops.prepare_build_dense(
+            build, self.right_keys, right_types,
+            [left_types[o] for o in self.left_keys], kmin, span)
+        if prepared is not None:
+            from spark_rapids_tpu.execs import adaptive
+
+            adaptive.record_replan("strategy_switch",
+                                   "hash->dense probe")
+        return prepared
 
     def _bucket(self, staged, keys: List[int], types, n_buckets: int,
                 trace: str):

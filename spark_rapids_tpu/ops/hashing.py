@@ -107,3 +107,71 @@ def _combine(vals: Tuple[jax.Array, ...]) -> jax.Array:
     for v in vals:
         h = _splitmix64(h ^ v)
     return h
+
+
+# ---------------------------------------------------------------------------
+# host (numpy) mirror — AQE skew detection over gathered exchange input
+# ---------------------------------------------------------------------------
+#
+# The in-program exchange already holds the full input host-side (one
+# device_get gathers it before the collective); mirroring the partition
+# hash in numpy lets skew detection run without any extra device work.
+# Routing always uses the DEVICE hash, so a mirror divergence could only
+# mis-detect skew (a performance decision), never misplace a row — but
+# tests/test_aqe_replan.py pins the mirror bit-equal anyway.
+
+
+def _host_splitmix64(x: np.ndarray) -> np.ndarray:
+    z = (x.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return z.astype(np.int64)
+
+
+def _host_canonicalize_floats(x: np.ndarray) -> np.ndarray:
+    zero = np.zeros((), dtype=x.dtype)
+    nan = np.full((), np.nan, dtype=x.dtype)
+    x = np.where(x == zero, zero, x)
+    return np.where(np.isnan(x), nan, x)
+
+
+def host_numeric_to_int64(data: np.ndarray, dtype: dt.DType) -> np.ndarray:
+    """numpy twin of :func:`_numeric_to_int64` — same (hi, residual)
+    float split, same bitcasts, so a value hashes identically on host
+    and device."""
+    if dtype is dt.FLOAT64:
+        x = _host_canonicalize_floats(data.astype(np.float64))
+        hi = x.astype(np.float32)
+        lo = (x - hi.astype(np.float64)).astype(np.float32)
+        lo = _host_canonicalize_floats(lo)
+        hi_i = hi.view(np.int32).astype(np.int64)
+        lo_i = lo.view(np.int32).astype(np.int64)
+        return (hi_i << 32) | (lo_i & np.int64(0xFFFFFFFF))
+    if dtype is dt.FLOAT32:
+        x = _host_canonicalize_floats(data.astype(np.float32))
+        return x.view(np.int32).astype(np.int64)
+    return data.astype(np.int64)
+
+
+def host_partition_ids(datas: List[np.ndarray],
+                       valids: List[Optional[np.ndarray]],
+                       dtypes: List[dt.DType], key_ordinals: List[int],
+                       num_out: int) -> np.ndarray:
+    """Per-row reduce-partition id, bit-equal to the device shuffle
+    step's pid column (parallel.shuffle.DistributedShuffleStep). String
+    keys never reach here — in-program exchanges are gated to
+    non-string schemas at the planner."""
+    with np.errstate(over="ignore"):
+        vals = []
+        for o in key_ordinals:
+            img = host_numeric_to_int64(datas[o], dtypes[o])
+            if valids[o] is not None:
+                img = np.where(valids[o], img, np.int64(_NULL_HASH))
+            vals.append(img)
+        n = len(datas[key_ordinals[0]]) if key_ordinals else 0
+        h = np.full(n, np.int64(0x2545F491), dtype=np.int64)
+        for v in vals:
+            h = _host_splitmix64(h ^ v)
+    m = h % np.int64(num_out)
+    return np.where(m < 0, m + num_out, m).astype(np.int32)

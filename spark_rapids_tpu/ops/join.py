@@ -138,6 +138,119 @@ def prepare_build(build: ColumnarBatch, build_keys: List[int],
     return PreparedBuild(ColumnarBatch(cols, build.num_rows), sb_h, table)
 
 
+class DensePreparedBuild(NamedTuple):
+    """Dense-probe build (AQE hash->dense strategy switch): when the
+    measured build key range is narrow, the probe is a direct table
+    lookup instead of a binary search. ``start`` holds run offsets of
+    the slot-sorted build — slot s's rows sit at
+    ``sorted_build[start[s]:start[s+1]]`` — so DUPLICATE keys work (the
+    fused broadcast path's inverse table is one-row-per-slot and bails
+    on dups). Probe-row match runs come out in original build order
+    (stable slot sort), exactly like the hash path's stable hash sort,
+    so matched-pair output is bit-identical to the hash probe."""
+
+    sorted_build: ColumnarBatch
+    start: jax.Array  # int32[table_span + 1] slot run offsets
+    kmin: np.int64
+    span: np.int64
+    table_span: int  # static padded slot count (>= span + 1)
+
+
+def measure_key_range(col: Column, rows) -> Tuple[int, int, int]:
+    """(min, max, valid-row count) of a numeric key column — the one
+    device round trip of the dense-probe decision. Count 0 means no
+    measurable rows (all-null or empty)."""
+    kmin, kmax, n = jax.device_get(
+        _key_range(col.data, col.validity, rows))
+    return int(kmin), int(kmax), int(n)
+
+
+@jax.jit
+def _key_range(data, valid, rows):
+    cap = data.shape[0]
+    live = jnp.arange(cap, dtype=jnp.int32) < rows
+    ok = live if valid is None else (live & valid)
+    big = jnp.int64(1) << 62
+    k = data.astype(jnp.int64)
+    return (jnp.min(jnp.where(ok, k, big)),
+            jnp.max(jnp.where(ok, k, -big)),
+            jnp.sum(ok.astype(jnp.int64)))
+
+
+def prepare_build_dense(build: ColumnarBatch, build_keys: List[int],
+                        build_types: List[dt.DType],
+                        stream_types_for_keys: List[dt.DType],
+                        kmin: int, span: int
+                        ) -> Optional[DensePreparedBuild]:
+    """Slot-sort the build for dense probing. None when the shape does
+    not qualify (only single integral non-string keys slot densely);
+    the caller decides WHETHER dense pays (density/span policy) from
+    :func:`measure_key_range` before building."""
+    if len(build_keys) != 1 or span <= 0:
+        return None
+    o = build_keys[0]
+    if isinstance(build.columns[o], StringColumn):
+        return None
+    common = common_key_type(stream_types_for_keys[0], build_types[o])
+    if common is None or not common.is_integral:
+        return None
+    table_span = bucket_capacity(span + 1)
+    sb_datas, sb_vals, start = _build_dense(
+        [c.data for c in build.columns],
+        [c.validity for c in build.columns],
+        build.num_rows_device(), np.int64(kmin),
+        key_ord=o, table_span=table_span)
+    cols = [c._like(d, v) for c, d, v in
+            zip(build.columns, sb_datas, sb_vals)]
+    return DensePreparedBuild(ColumnarBatch(cols, build.num_rows),
+                              start, np.int64(kmin), np.int64(span),
+                              table_span)
+
+
+@partial(jax.jit, static_argnames=("key_ord", "table_span"))
+def _build_dense(b_datas, b_vals, b_rows, kmin, key_ord: int,
+                 table_span: int):
+    """Stable slot sort + run-offset table. kmin rides as a TRACED
+    operand so every partition's build shares one compiled program."""
+    cap = b_datas[key_ord].shape[0]
+    live = jnp.arange(cap, dtype=jnp.int32) < b_rows
+    valid = b_vals[key_ord]
+    ok = live if valid is None else (live & valid)
+    slot64 = b_datas[key_ord].astype(jnp.int64) - kmin
+    ok = ok & (slot64 >= 0) & (slot64 < jnp.int64(table_span))
+    # nulls/padding park at table_span: past every probed slot, so they
+    # can never enter a run ([start[s], start[s+1]) with s < table_span)
+    slot = jnp.where(ok, slot64, jnp.int64(table_span)).astype(jnp.int32)
+    order = jnp.argsort(slot, stable=True)
+    s_slot = jnp.take(slot, order)
+    sb_datas = [jnp.take(d, order) for d in b_datas]
+    sb_vals = [None if v is None else jnp.take(v, order) for v in b_vals]
+    start = jnp.searchsorted(
+        s_slot,
+        jnp.arange(table_span + 1, dtype=jnp.int32)).astype(jnp.int32)
+    return sb_datas, sb_vals, start
+
+
+@partial(jax.jit, static_argnames=("table_span",))
+def _probe_dense(start, kmin, span, p_key, p_valid, s_rows,
+                 table_span: int):
+    """Dense probe: two gathers replace two binary searches. Same
+    (lo, hi, counts, total) contract as :func:`_hash_probe`, feeding
+    the unchanged expand/verify/emit tail."""
+    s_cap = p_key.shape[0]
+    live_p = jnp.arange(s_cap, dtype=jnp.int32) < s_rows
+    slot64 = p_key.astype(jnp.int64) - kmin
+    ok = live_p & (slot64 >= 0) & (slot64 < span)
+    if p_valid is not None:
+        ok = ok & p_valid
+    slot = jnp.where(ok, slot64, 0).astype(jnp.int32)
+    lo = jnp.take(start, slot)
+    hi = jnp.take(start, slot + 1)
+    counts = jnp.where(ok, hi - lo, 0).astype(jnp.int64)
+    total = jnp.sum(counts)
+    return lo, hi, counts, total
+
+
 def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
               stream_keys: List[int], build_keys: List[int],
               stream_types: List[dt.DType], build_types: List[dt.DType],
@@ -160,18 +273,28 @@ def equi_join(stream: ColumnarBatch, build: ColumnarBatch,
         "no common comparison type for join keys",
         [stream_types[o] for o in stream_keys],
         [build_types[o] for o in build_keys])
-    h_p = _key_hashes(stream, stream_keys, stream_types, _PROBE_NULL,
-                      target_types=commons)
-
     use_kernel = nkr.enabled("join")
-    if prepared is not None:
+    if isinstance(prepared, DensePreparedBuild):
+        # ---- phase 1 (device), dense: direct slot lookup, no hashing
+        # of either side at all
+        sorted_build = prepared.sorted_build
+        so = stream.columns[stream_keys[0]]
+        lo, hi, counts, total = _probe_dense(
+            prepared.start, prepared.kmin, prepared.span,
+            so.data, so.validity, stream.num_rows_device(),
+            prepared.table_span)
+    elif prepared is not None:
         # ---- phase 1 (device), amortized: probe the prepared table
+        h_p = _key_hashes(stream, stream_keys, stream_types, _PROBE_NULL,
+                          target_types=commons)
         sorted_build = prepared.sorted_build
         lo, hi, counts, total = _probe_sorted(
             prepared.sb_h, prepared.table, h_p,
             stream.num_rows_device(),
             use_kernel=use_kernel and prepared.table is not None)
     else:
+        h_p = _key_hashes(stream, stream_keys, stream_types, _PROBE_NULL,
+                          target_types=commons)
         # ---- phase 1 (device): sort build, probe, count matches
         b_datas = [c.data for c in build.columns]
         b_vals = [c.validity for c in build.columns]

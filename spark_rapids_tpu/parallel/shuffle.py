@@ -198,16 +198,28 @@ class DistributedShuffleStep:
     identity makes an in-program exchange partition-for-partition
     interchangeable with a host-path one — a co-partitioned sibling
     under a shuffled join may stay on the host path and still line up.
+
+    ``salt_pids`` (AQE replan rule 1, the in-program half): partition
+    ids in this tuple are SKEWED — their rows fan out round-robin by
+    row position over ALL devices instead of landing on ``pid % n_dev``,
+    so one hot key stops making a single chip's receive the straggler
+    of the collective. Pids are untouched (only ``dest`` changes); the
+    caller's pid-keyed split reassembles full partitions host-side, so
+    downstream consumers — including the co-partitioned join contract —
+    see identical partition contents, just sourced from several
+    devices' blocks.
     """
 
     def __init__(self, mesh: Mesh, dtypes: Sequence[dt.DType],
                  key_ordinals: Sequence[int], num_out: int,
-                 axis: str = DATA_AXIS):
+                 axis: str = DATA_AXIS,
+                 salt_pids: Sequence[int] = ()):
         self.mesh = mesh
         self.dtypes = tuple(dtypes)
         self.key_ordinals = tuple(key_ordinals)
         self.num_out = num_out
         self.axis = axis
+        self.salt_pids = tuple(sorted(salt_pids))
         self.n_dev = mesh.shape[axis]
         self._fn = self._build()
 
@@ -217,6 +229,7 @@ class DistributedShuffleStep:
         dtypes = self.dtypes
         key_ordinals = self.key_ordinals
         axis = self.axis
+        salt_pids = self.salt_pids
 
         def device_step(datas, valids, n_rows):
             cap = datas[0].shape[0]
@@ -233,6 +246,12 @@ class DistributedShuffleStep:
             m = h % jnp.int64(num_out)
             pid = jnp.where(m < 0, m + num_out, m).astype(jnp.int32)
             dest = pid % n_dev
+            if salt_pids:
+                hot = pid == jnp.int32(salt_pids[0])
+                for p in salt_pids[1:]:
+                    hot = hot | (pid == jnp.int32(p))
+                iota = jnp.arange(cap, dtype=jnp.int32)
+                dest = jnp.where(hot, (pid + iota) % n_dev, dest)
             ex = _exchange(list(datas) + [pid.astype(jnp.int64)],
                            list(valids) + [live],
                            dest, live, n_dev, axis)
@@ -275,15 +294,16 @@ _SHUFFLE_STEPS: dict = {}
 
 
 def shuffle_step(mesh: Mesh, dtypes: Sequence[dt.DType],
-                 key_ordinals: Sequence[int],
-                 num_out: int) -> DistributedShuffleStep:
-    key = (id(mesh), tuple(dtypes), tuple(key_ordinals), num_out)
+                 key_ordinals: Sequence[int], num_out: int,
+                 salt_pids: Sequence[int] = ()) -> DistributedShuffleStep:
+    key = (id(mesh), tuple(dtypes), tuple(key_ordinals), num_out,
+           tuple(sorted(salt_pids)))
     got = _SHUFFLE_STEPS.get(key)
     if got is None:
         if len(_SHUFFLE_STEPS) >= 64:  # bound: distinct schemas are few
             _SHUFFLE_STEPS.clear()
         got = _SHUFFLE_STEPS[key] = DistributedShuffleStep(
-            mesh, dtypes, key_ordinals, num_out)
+            mesh, dtypes, key_ordinals, num_out, salt_pids=salt_pids)
     return got
 
 

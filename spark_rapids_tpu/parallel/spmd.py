@@ -18,7 +18,7 @@ this PR retires.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 from spark_rapids_tpu.utils import lockorder
 
@@ -76,6 +76,31 @@ def record_degrade(op: str) -> None:
     """Count one in-program exchange degraded at RUNTIME by a device
     error (execs/exchange._materialize_in_program_once)."""
     record_fallback(op, DEGRADE_DEVICE_ERROR)
+
+
+class SkewSpec(NamedTuple):
+    """AQE skew-detection parameters resolved once at plan time and
+    carried to the two places that act on them: the host-path paired
+    readers (sub-read splitting) and the in-program exchange (salting
+    before the all_to_all). One spec type keeps both paths detecting
+    the SAME partitions as skewed."""
+
+    factor: float
+    threshold: int
+    max_splits: int
+
+
+def adaptive_skew_spec(conf) -> Optional[SkewSpec]:
+    """The session's skew spec, or None when AQE skew handling is off
+    (either gate: adaptive.enabled or adaptive.skewJoin.enabled)."""
+    from spark_rapids_tpu import config as cfg
+
+    if conf is None or not conf.get(cfg.ADAPTIVE_ENABLED) \
+            or not conf.get(cfg.ADAPTIVE_SKEW_JOIN):
+        return None
+    return SkewSpec(conf.get(cfg.ADAPTIVE_SKEW_FACTOR),
+                    conf.get(cfg.ADAPTIVE_SKEW_THRESHOLD),
+                    max(conf.get(cfg.ADAPTIVE_SKEW_MAX_SPLITS), 2))
 
 
 def in_program_mesh(conf, op: str, *, keyed: bool = True,

@@ -631,20 +631,28 @@ def _row_width(node: pn.PlanNode) -> int:
 
 
 def estimate_footprint_bytes(plan: pn.PlanNode,
-                             default_rows: int = 1 << 20) -> int:
+                             default_rows: int = 1 << 20,
+                             runtime_rows=None) -> int:
     """Estimated peak device bytes of executing ``plan``: the widest
     single operator's working set (its output plus every input it holds
     live) plus the broadcast/build sides and materialized exchanges that
     stay resident across the pipeline. Nodes without a cardinality
     estimate assume ``default_rows``. Deliberately coarse and
     conservative — admission needs an upper-bound-shaped number, not a
-    point estimate; the spill catalog is the real enforcement."""
+    point estimate; the spill catalog is the real enforcement.
+
+    ``runtime_rows`` (AQE replan rule 3b: node -> rows | None) answers
+    for nodes the STATIC estimator cannot — measured cardinalities from
+    earlier runs of the same plan shape (execs.adaptive's registry) —
+    so admission tightens as the workload repeats."""
     from spark_rapids_tpu.ops.buckets import bucket_capacity
 
     resident = 0  # exchange/aggregate materializations live across stages
 
     def bytes_of(node: pn.PlanNode) -> int:
         rows = estimate_rows(node)
+        if rows is None and runtime_rows is not None:
+            rows = runtime_rows(node)
         rows = max(rows if rows is not None else default_rows, 1)
         # BUCKETED, not raw: device columns are padded to the capacity
         # ladder (ops/buckets), so the bytes a node actually pins are

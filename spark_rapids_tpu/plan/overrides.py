@@ -389,6 +389,15 @@ class _ScanRule(NodeRule):
             return basic.DeviceBatchesExec(node.source,
                                            node.output_schema())
         rows = meta.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)
+        # file sources default to DEFAULT_CONF: hand them the session
+        # conf so reader knobs (split packing targets, read threads)
+        # follow the session, not construction-time defaults. Only
+        # before splits are derived — a source already being read
+        # keeps the split layout it advertised.
+        src = node.source
+        if hasattr(src, "conf") and \
+                getattr(src, "_splits", None) is None:
+            src.conf = meta.conf
         return basic.ScanExec(node.source, node.output_schema(),
                               batch_rows=rows,
                               pack=meta.conf.get(cfg.SCAN_PACK_TRANSFERS))
@@ -742,15 +751,17 @@ class _JoinRule(NodeRule):
             rex = exchange.ShuffleExchangeExec(("hash", rk), parts, right,
                                                task_threads=tt)
             if meta.conf.get(cfg.ADAPTIVE_ENABLED) and parts > 1:
-                # one shared group spec keeps the sides partition-aligned
-                # (cluster mode included: stats come from the tracker)
-                left, right = adaptive_exec.paired_adaptive_readers(
-                    lex, rex,
-                    meta.conf.get(cfg.ADVISORY_PARTITION_SIZE))
-            else:
-                left, right = lex, rex
+                # defer the final join strategy to EXECUTE time: once
+                # the build-side map stage has materialized, the
+                # adaptive exec picks broadcast vs shuffled-hash vs
+                # dense-probe from MEASURED sizes, and its paired
+                # readers split skewed partitions (one shared group
+                # spec keeps the sides partition-aligned; cluster mode
+                # included — stats come from the tracker)
+                return adaptive_exec.AdaptiveShuffledJoinExec(
+                    kind, lex, rex, lk, rk, out_schema, cond, meta.conf)
             return joins.ShuffledHashJoinExec(
-                kind, left, right, lk, rk, out_schema, cond, meta.conf)
+                kind, lex, rex, lk, rk, out_schema, cond, meta.conf)
         build = exchange.BroadcastExchangeExec(right)
         # broadcast replays its single partition to every stream partition
         return joins.BroadcastHashJoinExec(
@@ -1277,6 +1288,7 @@ def _enable_in_program_exchanges(exec_: TpuExec, conf) -> None:
 
     if conf is None or not conf.get(cfg.MESH_ENABLED):
         return
+    skew = spmd.adaptive_skew_spec(conf)
     seen: set = set()
 
     def walk(e) -> None:
@@ -1300,7 +1312,7 @@ def _enable_in_program_exchanges(exec_: TpuExec, conf) -> None:
             else:
                 mesh = spmd.in_program_mesh(conf, "exchange")
             if mesh is not None:
-                e.enable_in_program(mesh)
+                e.enable_in_program(mesh, skew=skew)
         for c in e.children:
             walk(c)
         for bx in getattr(e, "builds", ()) or ():

@@ -226,9 +226,19 @@ class QueryService:
         # stores, not what its subtree would recompute)
         plan_to_run, pending, served = self.cache.graft_fragments(plan)
         try:
+            # AQE runtime stats (replan rule 3b): measured exchange
+            # cardinalities from earlier runs answer for nodes the
+            # static estimator cannot, tightening admission over time
+            runtime_rows = None
+            if self.conf.get(cfg.ADAPTIVE_ENABLED) and \
+                    self.conf.get(cfg.ADAPTIVE_RUNTIME_STATS):
+                from spark_rapids_tpu.execs import adaptive
+
+                runtime_rows = adaptive.plan_cardinality_rows
             footprint = estimate_footprint_bytes(
                 plan_to_run, default_rows=self.conf.get(
-                    cfg.SERVICE_DEFAULT_ROW_ESTIMATE))
+                    cfg.SERVICE_DEFAULT_ROW_ESTIMATE),
+                runtime_rows=runtime_rows)
             # out-of-core decision BEFORE physical planning: a query
             # whose estimated peak exceeds the WHOLE device budget can
             # never fit, so either shed it now (policy=shed) or plan it

@@ -59,6 +59,7 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # a legitimate recursion over the (acyclic) plan DAG, so ordering
     # among group members is exempted rather than ranked. -------------
     "execs.cache.materialize": 30,
+    "execs.adaptive.decide": 32,      # AQE replan decision barrier
     "exchange.shuffle.materialize": 34,
     "execs.fused.chainPrep": 36,
     "exchange.broadcast.materialize": 38,
@@ -119,6 +120,7 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "memory.faultInjection": 168,
     "shuffle.faultInjection": 170,   # transport/worker fault injector
     "utils.dispatch.stage": 172,
+    "execs.adaptive.replans": 174,   # replan-event + runtime-stat counters
     "parallel.spmd.fallbacks": 176,  # fallback-reason counters
     "runtime.recovery.stats": 178,   # process-global recovery counters
     "service.streaming.stats": 180,  # process-global fold counters
@@ -155,6 +157,7 @@ NESTABLE = frozenset({
 #: against every lock outside the group.
 GROUPS: Dict[str, str] = {
     "execs.cache.materialize": "planBarrier",
+    "execs.adaptive.decide": "planBarrier",
     "exchange.shuffle.materialize": "planBarrier",
     "exchange.broadcast.materialize": "planBarrier",
     "execs.fused.chainPrep": "planBarrier",

@@ -34,11 +34,50 @@ def test_coalesce_groups_min_partitions():
     assert [p for g in groups for p in g] == list(range(8))
 
 
+def test_coalesce_min_parallelism_splits_byte_balanced():
+    """Forced-parallelism splits cut at the byte-balanced point, not
+    the index midpoint: one heavy partition must not drag half the
+    light ones along with it."""
+    stats = MapOutputStatistics([100, 1, 1, 1])
+    groups = coalesce_groups(stats, advisory_bytes=1 << 30,
+                             min_partitions=2)
+    # midpoint would give [[0, 1], [2, 3]] (101 vs 2 bytes)
+    assert groups == [[0], [1, 2, 3]]
+
+
+def test_coalesce_min_parallelism_equal_sizes_midpoint():
+    """With uniform sizes the byte-balanced cut IS the midpoint."""
+    stats = MapOutputStatistics([10, 10, 10, 10])
+    groups = coalesce_groups(stats, advisory_bytes=1 << 30,
+                             min_partitions=2)
+    assert groups == [[0, 1], [2, 3]]
+    assert [p for g in groups for p in g] == list(range(4))
+
+
 def test_skew_detection():
     sizes = [10] * 9 + [10_000_000_000]
     stats = MapOutputStatistics(sizes)
     assert stats.skewed_partitions() == [9]
     assert MapOutputStatistics([10] * 10).skewed_partitions() == []
+
+
+def test_skew_detection_edges():
+    # empty exchange: no partitions, no skew
+    assert MapOutputStatistics([]).skewed_partitions() == []
+    # strict >: everything exactly AT the cut is not skewed
+    assert MapOutputStatistics([10, 10, 10]).skewed_partitions(
+        factor=1.0, threshold=0) == []
+    # every partition over the cut: all flagged (the cut is
+    # max(threshold, factor*median), so a sub-1 factor exposes the
+    # threshold floor and uniform-but-huge partitions all qualify)
+    assert MapOutputStatistics([100, 100, 100]).skewed_partitions(
+        factor=0.5, threshold=60) == [0, 1, 2]
+    # threshold floors detection even with an aggressive factor
+    assert MapOutputStatistics([1, 1, 40]).skewed_partitions(
+        factor=1.5, threshold=1000) == []
+    # all-zero sizes never divide by zero or flag anything
+    assert MapOutputStatistics([0, 0, 0]).skewed_partitions(
+        factor=1.0, threshold=0) == []
 
 
 @pytest.fixture()

@@ -1,0 +1,376 @@
+"""Runtime replanning (AQE tentpole): the three replan rules — skew
+splitting, join-strategy switching, and stats-driven re-bucketing —
+each fire end-to-end through the planner, are counted in the replan
+telemetry, and leave results identical to the static plan and the CPU
+oracle. Plus the two correctness keystones underneath: the host mirror
+of the device partition hash (skew detection before the collective)
+and the dense-probe/hash-probe differential."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.cpu.engine import execute_cpu
+from spark_rapids_tpu.execs import adaptive
+from spark_rapids_tpu.execs.adaptive import (AdaptiveShuffledJoinExec,
+                                             AdaptiveShuffleReaderExec)
+from spark_rapids_tpu.execs.base import collect
+from spark_rapids_tpu.execs.joins import BroadcastHashJoinExec
+from spark_rapids_tpu.expressions.base import BoundReference, Literal
+from spark_rapids_tpu.expressions.predicates import LessThan
+from spark_rapids_tpu.io import ParquetSource
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.overrides import apply_overrides
+
+
+def _find(exec_, klass):
+    out, stack = [], [exec_]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, klass):
+            out.append(e)
+        stack.extend(e.children)
+    return out
+
+
+def _sorted_rows(df):
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def _assert_same(got, want, exact=True):
+    pd.testing.assert_frame_equal(_sorted_rows(got), _sorted_rows(want),
+                                  check_dtype=False,
+                                  check_exact=exact)
+
+
+# ---------------------------------------------------------------------------
+# keystone 1: the host mirror of the device partition hash
+# ---------------------------------------------------------------------------
+
+
+def test_host_mirror_matches_device_partition_ids():
+    """Skew detection runs the partition hash on the HOST before the
+    in-program collective: it must be bit-equal to the device kernel
+    across null keys and float canonicalization (NaN payloads, -0.0)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, Schema
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.ops import hashing
+
+    rng = np.random.default_rng(17)
+    n, num_out = 257, 7
+    ints = rng.integers(-1000, 1000, n).astype(np.int64)
+    iv = rng.random(n) > 0.1
+    floats = rng.random(n) * 100 - 50
+    floats[::13] = np.nan
+    floats[::17] = -0.0
+    floats[::19] = 0.0
+    fv = rng.random(n) > 0.15
+    batch = ColumnarBatch(
+        [Column.from_numpy(ints, dt.INT64, validity=iv),
+         Column.from_numpy(floats, dt.FLOAT64, validity=fv)], n)
+    types = [dt.INT64, dt.FLOAT64]
+    for keys in ([0], [1], [0, 1]):
+        h = np.asarray(hashing.hash_columns(batch, keys, types))
+        dev = h % num_out
+        dev = np.where(dev < 0, dev + num_out, dev)
+        host = hashing.host_partition_ids(
+            [ints, floats], [iv, fv], types, keys, num_out)
+        np.testing.assert_array_equal(host, dev[:n],
+                                      err_msg=f"keys={keys}")
+
+
+# ---------------------------------------------------------------------------
+# keystone 2: dense direct-address probe == hash probe, all kinds
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["inner", "left", "left_semi",
+                                  "left_anti"])
+def test_dense_probe_matches_hash_probe(kind):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.ops import join as join_ops
+
+    rng = np.random.default_rng(23)
+    bn, sn = 120, 200
+    bk = rng.integers(10, 40, bn).astype(np.int64)  # duplicate keys
+    bv_valid = rng.random(bn) > 0.1
+    sk = rng.integers(0, 60, sn).astype(np.int64)  # out-of-range probes
+    sv_valid = rng.random(sn) > 0.1
+    build = ColumnarBatch(
+        [Column.from_numpy(bk, dt.INT64, validity=bv_valid),
+         Column.from_numpy(rng.random(bn), dt.FLOAT64)], bn)
+    stream = ColumnarBatch(
+        [Column.from_numpy(sk, dt.INT64, validity=sv_valid),
+         Column.from_numpy(rng.random(sn), dt.FLOAT64)], sn)
+    btypes = [dt.INT64, dt.FLOAT64]
+
+    kmin, kmax, nvalid = join_ops.measure_key_range(
+        build.columns[0], build.num_rows_device())
+    assert nvalid > 0
+    dense = join_ops.prepare_build_dense(
+        build, [0], btypes, [dt.INT64], kmin, kmax - kmin + 1)
+    assert dense is not None
+    jt = {"left_semi": "leftsemi", "left_anti": "leftanti"}.get(kind,
+                                                                kind)
+    out_d, _ = join_ops.equi_join(stream, build, [0], [0], btypes,
+                                  btypes, jt, prepared=dense)
+    hashed = join_ops.prepare_build(build, [0], btypes, [dt.INT64])
+    out_h, _ = join_ops.equi_join(stream, build, [0], [0], btypes,
+                                  btypes, jt, prepared=hashed)
+    _assert_same(out_d.to_pandas(), out_h.to_pandas())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: each replan rule through the planner
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def skew_join_data(tmp_path_factory):
+    """Left side with 60% of rows on one key (4 scan partitions), a
+    uniform right side (2 scan partitions)."""
+    root = tmp_path_factory.mktemp("aqe")
+    rng = np.random.default_rng(31)
+    n = 2400
+    k = rng.integers(0, 50, n).astype(np.int64)
+    k[rng.random(n) < 0.6] = 7
+    for i in range(4):
+        sl = slice(i * n // 4, (i + 1) * n // 4)
+        pq.write_table(pa.table({"k": k[sl],
+                                 "v": rng.random(n // 4)}),
+                       root / f"left{i}.parquet")
+    m = 400
+    k2 = rng.integers(0, 50, m).astype(np.int64)
+    for i in range(2):
+        sl = slice(i * m // 2, (i + 1) * m // 2)
+        pq.write_table(pa.table({"k2": k2[sl],
+                                 "w": rng.random(m // 2)}),
+                       root / f"right{i}.parquet")
+    return root
+
+
+def _skew_plan(root):
+    lsrc = ParquetSource([str(root / f"left{i}.parquet")
+                          for i in range(4)])
+    lsrc.pack_splits = False
+    rsrc = ParquetSource([str(root / f"right{i}.parquet")
+                          for i in range(2)])
+    rsrc.pack_splits = False
+    return pn.JoinNode("inner", pn.ScanNode(lsrc), pn.ScanNode(rsrc),
+                       [0], [0])
+
+
+@pytest.fixture(scope="module")
+def static_reference(skew_join_data):
+    """The static planner's output (adaptive off): the byte-identity
+    baseline every replanned run must reproduce."""
+    conf = RapidsConf({"rapids.tpu.sql.test.enabled": True,
+                       "rapids.tpu.sql.adaptive.enabled": False,
+                       "rapids.tpu.sql.autoBroadcastJoinThreshold": 0})
+    exec_ = apply_overrides(_skew_plan(skew_join_data), conf)
+    assert not _find(exec_, AdaptiveShuffledJoinExec)
+    assert not _find(exec_, AdaptiveShuffleReaderExec)
+    return collect(exec_)
+
+
+def test_static_plan_matches_cpu_oracle(skew_join_data,
+                                        static_reference):
+    cpu = execute_cpu(_skew_plan(skew_join_data)).to_pandas()
+    _assert_same(static_reference, cpu, exact=False)
+
+
+def test_skew_split_replans_and_matches(skew_join_data,
+                                        static_reference):
+    """Rule 1 host path: forcing the skew cut under the hot partition
+    splits it into sub-reads, records skew_split events, and changes
+    nothing about the result."""
+    conf = RapidsConf({
+        "rapids.tpu.sql.test.enabled": True,
+        "rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        "rapids.tpu.sql.adaptive.advisoryPartitionSizeBytes": 1024,
+        "rapids.tpu.sql.adaptive.skewJoin."
+        "skewedPartitionThresholdInBytes": 64,
+        "rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor": 1.5,
+    })
+    before = adaptive.replan_snapshot()
+    exec_ = apply_overrides(_skew_plan(skew_join_data), conf)
+    assert _find(exec_, AdaptiveShuffledJoinExec)
+    got = collect(exec_)
+    events = adaptive.replan_delta(before)
+    assert any(k.startswith("skew_split") for k in events), events
+    _assert_same(got, static_reference)
+
+
+def test_runtime_broadcast_switch(tmp_path, static_reference,
+                                  skew_join_data):
+    """Rule 2: build side ESTIMATED over the broadcast threshold but
+    MEASURED under it flips shuffled->broadcast at execute time."""
+    rng = np.random.default_rng(37)
+    for i in range(4):
+        pq.write_table(pa.table(
+            {"k2": rng.integers(0, 50, 12500).astype(np.int64),
+             "w": rng.random(12500)}), tmp_path / f"r{i}.parquet")
+    lsrc = ParquetSource([str(skew_join_data / f"left{i}.parquet")
+                          for i in range(4)])
+    lsrc.pack_splits = False
+    rsrc = ParquetSource([str(tmp_path / f"r{i}.parquet")
+                          for i in range(4)])
+    rsrc.pack_splits = False
+    # keeps ~2% of build rows: the scan-statistics estimate stays big
+    filt = pn.FilterNode(LessThan(BoundReference(0, dt.INT64),
+                                  Literal(1)), pn.ScanNode(rsrc))
+    plan = pn.JoinNode("inner", pn.ScanNode(lsrc), filt, [0], [0])
+
+    static = collect(apply_overrides(plan, RapidsConf(
+        {"rapids.tpu.sql.test.enabled": True,
+         "rapids.tpu.sql.adaptive.enabled": False,
+         "rapids.tpu.sql.autoBroadcastJoinThreshold": 0})))
+
+    conf = RapidsConf({
+        "rapids.tpu.sql.test.enabled": True,
+        "rapids.tpu.sql.autoBroadcastJoinThreshold": 48 * 1024})
+    before = adaptive.replan_snapshot()
+    exec_ = apply_overrides(plan, conf)
+    assert _find(exec_, AdaptiveShuffledJoinExec), \
+        "estimate must stay above the threshold at plan time"
+    got = collect(exec_)
+    events = adaptive.replan_delta(before)
+    assert any("shuffled->broadcast" in k for k in events), events
+    assert _find(exec_, BroadcastHashJoinExec)
+    _assert_same(got, static)
+
+
+def test_dense_switch_replans_and_matches(skew_join_data,
+                                          static_reference):
+    """Rule 2 dense flavor: a narrow measured key range flips the hash
+    probe to the direct-address table, result unchanged."""
+    conf = RapidsConf({
+        "rapids.tpu.sql.test.enabled": True,
+        "rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        "rapids.tpu.sql.adaptive.denseJoin.enabled": True,
+        "rapids.tpu.sql.adaptive.denseJoin.minBuildRows": 1,
+    })
+    before = adaptive.replan_snapshot()
+    got = collect(apply_overrides(_skew_plan(skew_join_data), conf))
+    events = adaptive.replan_delta(before)
+    assert any("hash->dense" in k for k in events), events
+    _assert_same(got, static_reference)
+
+
+def test_rebucket_records_events_and_matches(skew_join_data,
+                                             static_reference):
+    """Rule 3a: coalesced groups concatenate to the measured row count
+    (progcache right-rung), counted as rebucket events."""
+    conf = RapidsConf({
+        "rapids.tpu.sql.test.enabled": True,
+        "rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        "rapids.tpu.sql.adaptive.rebucket.enabled": True,
+    })
+    before = adaptive.replan_snapshot()
+    got = collect(apply_overrides(_skew_plan(skew_join_data), conf))
+    events = adaptive.replan_delta(before)
+    assert any(k.startswith("rebucket") for k in events), events
+    _assert_same(got, static_reference)
+
+
+def test_adaptive_disabled_is_static(skew_join_data, static_reference):
+    """The master gate: adaptive.enabled=false must reproduce the
+    static planner byte for byte and leave the telemetry silent."""
+    conf = RapidsConf({
+        "rapids.tpu.sql.test.enabled": True,
+        "rapids.tpu.sql.adaptive.enabled": False,
+        "rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        # skew knobs armed but master-gated off: nothing may fire
+        "rapids.tpu.sql.adaptive.advisoryPartitionSizeBytes": 1024,
+        "rapids.tpu.sql.adaptive.skewJoin."
+        "skewedPartitionThresholdInBytes": 64,
+    })
+    before = adaptive.replan_snapshot()
+    exec_ = apply_overrides(_skew_plan(skew_join_data), conf)
+    assert not _find(exec_, AdaptiveShuffledJoinExec)
+    got = collect(exec_)
+    assert adaptive.replan_delta(before) == {}
+    _assert_same(got, static_reference)
+
+
+# ---------------------------------------------------------------------------
+# rule 1 on the in-program path: salting before the collective
+# ---------------------------------------------------------------------------
+
+
+def test_in_program_salting_matches_host_path():
+    """A hot hash partition is salted across mesh devices before the
+    all_to_all; per-output-partition content is unchanged vs the host
+    path and the salt is counted as a skew_salt replan event."""
+    from spark_rapids_tpu.execs.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.parallel.mesh import data_mesh
+    from spark_rapids_tpu.parallel.spmd import SkewSpec
+    from tests.test_spmd_shuffle import _drain_exchange, _rows_exec
+
+    rng = np.random.default_rng(43)
+
+    def mk(n, hot_frac):
+        keys = rng.integers(0, 40, n).astype(np.int64)
+        keys[rng.random(n) < hot_frac] = 7  # hot key
+        kv = rng.random(n) > 0.1
+        vals = rng.random(n)
+        return keys, kv, vals
+
+    parts = [[mk(1000, 0.75)], [mk(1000, 0.75)],
+             [mk(1000, 0.75)], [mk(1000, 0.75)]]
+    num_out = 5
+
+    host = ShuffleExchangeExec(("hash", [0]), num_out, _rows_exec(parts))
+    want = _drain_exchange(host)
+
+    before = adaptive.replan_snapshot()
+    prog = ShuffleExchangeExec(("hash", [0]), num_out, _rows_exec(parts))
+    prog.enable_in_program(data_mesh(8),
+                           skew=SkewSpec(factor=2.0, threshold=1024,
+                                         max_splits=8))
+    got = _drain_exchange(prog)
+    assert prog.in_program
+    events = adaptive.replan_delta(before)
+    assert any(k.startswith("skew_salt") for k in events), events
+    for p in range(num_out):
+        assert got[p] == want[p], f"partition {p} diverged"
+
+
+# ---------------------------------------------------------------------------
+# rule 3b: measured cardinalities feed footprint admission
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_stats_feed_footprint():
+    from spark_rapids_tpu.plan.optimizer import estimate_footprint_bytes
+
+    class _Node:
+        def __init__(self, names):
+            self._names = names
+            self.children = []
+
+        def output_schema(self):
+            from spark_rapids_tpu.columnar.batch import Schema
+            return Schema(self._names,
+                          [dt.INT64] * len(self._names))
+
+    sig = ("aqe_test_col_a", "aqe_test_col_b")
+    adaptive.record_cardinality(sig, 5000)
+    adaptive.record_cardinality(sig, 3000)  # max wins
+    assert adaptive.cardinality_lookup(sig) == 5000
+    assert adaptive.plan_cardinality_rows(_Node(list(sig))) == 5000
+    assert adaptive.plan_cardinality_rows(_Node(["unseen"])) is None
+
+    node = _Node(list(sig))
+    with_stats = estimate_footprint_bytes(
+        node, default_rows=1 << 20,
+        runtime_rows=adaptive.plan_cardinality_rows)
+    without = estimate_footprint_bytes(node, default_rows=1 << 20)
+    assert with_stats < without  # 5000 measured rows << 1M default

@@ -250,6 +250,7 @@ def test_small_build_side_broadcasts_instead_of_shuffling():
     """Spark's autoBroadcastJoinThreshold from scan statistics: a
     multi-partition join whose build side is estimated under the
     threshold plans as broadcast (no exchange pair); 0 disables."""
+    from spark_rapids_tpu.execs.adaptive import AdaptiveShuffledJoinExec
     from spark_rapids_tpu.execs.joins import (BroadcastHashJoinExec,
                                               ShuffledHashJoinExec)
     from spark_rapids_tpu.plan.overrides import apply_overrides
@@ -269,7 +270,8 @@ def test_small_build_side_broadcasts_instead_of_shuffling():
         from spark_rapids_tpu.execs.fused import FusedChainExec
 
         while not isinstance(e, (BroadcastHashJoinExec,
-                                 ShuffledHashJoinExec)):
+                                 ShuffledHashJoinExec,
+                                 AdaptiveShuffledJoinExec)):
             if isinstance(e, FusedChainExec):
                 # the broadcast join was absorbed into a fused chain;
                 # its unfused form is preserved as the fallback subtree
@@ -282,6 +284,13 @@ def test_small_build_side_broadcasts_instead_of_shuffling():
     assert isinstance(top_join(exec_), BroadcastHashJoinExec)
     exec_ = apply_overrides(plan, RapidsConf(
         {"rapids.tpu.sql.autoBroadcastJoinThreshold": 0}))
+    # AQE (default on) defers the shuffled join's final strategy to
+    # execute time; with it off the static planner must still emit the
+    # plain shuffled join
+    assert isinstance(top_join(exec_), AdaptiveShuffledJoinExec)
+    exec_ = apply_overrides(plan, RapidsConf(
+        {"rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+         "rapids.tpu.sql.adaptive.enabled": False}))
     assert isinstance(top_join(exec_), ShuffledHashJoinExec)
     assert_cpu_and_tpu_equal(plan, sort=True)
 
