@@ -1,0 +1,382 @@
+"""Multi-host elastic-membership fence: host loss, DCN partition, and
+autoscale as RECOVERY EVENTS, not outages (CLI twin of
+tests/test_multihost_mesh.py; the single-host lineage sibling is
+scripts/dist_chaos_check.py).
+
+Four phases over an emulated 2-host x 4-device topology — the driver
+plus worker processes, each reconstructing a 4-device virtual-CPU mesh
+slice — all on CPU:
+
+  1. differential : join + group-by run across the 2-host mesh,
+                    BIT-EXACT against a single-process oracle with the
+                    SAME mesh shape (identical shard_map programs =>
+                    identical float reduction order), the driver's
+                    per-stage dispatch count within the single-host
+                    budget, and every ICI-vs-DCN seam decision recorded
+                    with its exact reason. -> MULTICHIP_r07.json
+  2. host_kill    : ``killHostAtStage`` SIGKILLs the output-owning
+                    worker at the final exchange's reduce entry — every
+                    map output registered, the worst moment. The lineage
+                    ladder (fetch failure -> invalidate -> respawn
+                    {slot}~{gen} -> re-run lost maps -> re-read) must
+                    resolve it bit-exact with nonzero
+                    workers_respawned / maps_rerun / stage_retries.
+  3. dcn_partition: ``partitionDcnAtRequest`` fails a burst of
+                    cross-host round trips past the transport reconnect
+                    budget — the partition escalates to a fetch failure
+                    and resolves through the SAME stage-retry ladder,
+                    bit-exact, with the partition counted once.
+  4. scale_up     : an open-loop submission burst under
+                    ``service.maxConcurrent=1`` builds queue pressure;
+                    the autoscaler answers with ``add_host`` — the same
+                    elastic-membership seam recovery drives — and every
+                    queued query still returns the oracle answer.
+
+Phases 2-4 are the DIST record -> DIST_r02.json.
+
+    python scripts/multihost_chaos_check.py [--rows 3000] [--fast]
+        [--output-multichip MULTICHIP_r07.json]
+        [--output-dist DIST_r02.json]
+
+Prints one JSON report; exit code 0 = fence holds.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# dispatch telemetry must wrap jax.jit BEFORE the compute modules
+# import (module-level @jit decorators capture the binding) — phase 1
+# fences the driver-side per-stage dispatch budget
+from spark_rapids_tpu.utils import dispatch as disp  # noqa: E402
+
+disp.install()
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+JOIN_Q = ("SELECT s.k AS k, count(*) AS n, sum(s.v) AS sv, "
+          "sum(d.w) AS sw FROM sales s JOIN dim d ON s.k = d.id "
+          "GROUP BY s.k ORDER BY s.k")
+GROUPBY_Q = ("SELECT k, count(*) AS n, sum(v) AS sv, min(v) AS mn, "
+             "max(v) AS mx FROM sales GROUP BY k ORDER BY k")
+
+#: single-process mesh sessions share the plan shape with the cluster
+#: driver; the cluster run may not exceed this many extra driver-side
+#: round trips (stub reads replace in-process child execution)
+MESH_CONF = {
+    "rapids.tpu.mesh.enabled": True,
+    "rapids.tpu.mesh.devices": 4,
+    "rapids.tpu.sql.shuffle.partitions": 4,
+    "rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+}
+
+CLUSTER_CONF = dict(MESH_CONF, **{
+    "rapids.tpu.cluster.enabled": True,
+    "rapids.tpu.cluster.workers": 2,
+    "rapids.tpu.cluster.executors": 1,
+    "rapids.tpu.cluster.retryBackoffMs": 10,
+})
+
+DCN_SEAM_REASON = ("exchange: dcn: cluster exchange: map outputs "
+                   "cross the host boundary over TCP")
+
+
+def _views(s, n: int, seed: int = 7) -> None:
+    """Multi-partition inputs so every shuffle actually shuffles (a
+    single-partition source would broadcast the join away)."""
+    rng = np.random.default_rng(seed)
+    s.create_temp_view("sales", s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.normal(size=n)}))
+        .repartition(3, "k"))
+    s.create_temp_view("dim", s.create_dataframe(pd.DataFrame({
+        "id": np.arange(50, dtype=np.int64),
+        "w": rng.normal(size=50)}))
+        .repartition(2, "id"))
+
+
+def _oracle(query: str, n: int):
+    """Single-process oracle with the SAME mesh shape as the cluster
+    sessions — the bit-exactness contract needs identical shard_map
+    programs on both sides."""
+    from spark_rapids_tpu.api import Session
+
+    s = Session(dict(MESH_CONF))
+    _views(s, n)
+    return s.sql(query).collect()
+
+
+def _frames_equal(got, want) -> str:
+    got = got.reset_index(drop=True)[list(want.columns)]
+    if len(got) != len(want):
+        return f"row count {len(got)} != {len(want)}"
+    for c in want.columns:
+        a, b = got[c].to_numpy(), want[c].to_numpy()
+        try:
+            np.testing.assert_array_equal(a, b)  # bit-exact, order too
+        except AssertionError as e:
+            return f"column {c}: {str(e)[:200]}"
+    return ""
+
+
+def check_differential(rows: int) -> dict:
+    """Phase 1: 2-host x 4-device differential + dispatch budget +
+    seam-decision telemetry (the MULTICHIP record)."""
+    import jax
+
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.parallel import mesh as pmesh
+    from spark_rapids_tpu.parallel import spmd
+    from spark_rapids_tpu.runtime.cluster import shutdown_session_cluster
+
+    rec: dict = {"n_devices": len(jax.devices()), "queries": {}}
+    topo = pmesh.HostTopology(n_hosts=3, devices_per_host=4)
+    rec["topology"] = topo.axis_layout()
+    ok = True
+    for name, query in (("join", JOIN_Q), ("groupby", GROUPBY_Q)):
+        # single-host budget: warm run compiles, second run measures
+        single = Session(dict(MESH_CONF))
+        _views(single, rows)
+        single.sql(query).collect()
+        pre = disp.snapshot()
+        pre_stage = disp.stage_snapshot()
+        want = single.sql(query).collect()
+        single_d = disp.delta(pre)
+        single_stage = disp.stage_delta(pre_stage)
+
+        cluster = Session(dict(CLUSTER_CONF))
+        _views(cluster, rows)
+        cluster.sql(query).collect()
+        pre = disp.snapshot()
+        pre_stage = disp.stage_snapshot()
+        pre_seam = spmd.seam_snapshot()
+        got = cluster.sql(query).collect()
+        cluster_d = disp.delta(pre)
+        cluster_stage = disp.stage_delta(pre_stage)
+        seams = spmd.seam_delta(pre_seam)
+        shutdown_session_cluster()
+
+        mismatch = _frames_equal(got, want)
+        ici = {k: v for k, v in seams.items() if ": ici: " in k}
+        q = {
+            "rows": len(want),
+            "matches_same_mesh_oracle": not mismatch,
+            "detail": mismatch,
+            "single_host_dispatches": single_d["dispatch_count"],
+            "cluster_driver_dispatches": cluster_d["dispatch_count"],
+            "single_host_per_stage": single_stage,
+            "cluster_driver_per_stage": cluster_stage,
+            "seam_decisions": seams,
+            "ok": (not mismatch
+                   # the driver sheds work to the workers; its own
+                   # dispatch bill must stay within the single-host
+                   # budget for the same plan shape
+                   and cluster_d["dispatch_count"]
+                   <= single_d["dispatch_count"]
+                   and seams.get(DCN_SEAM_REASON, 0) >= 1
+                   and len(ici) >= 1),
+        }
+        rec["queries"][name] = q
+        ok = ok and q["ok"]
+    rec["ok"] = ok
+    return rec
+
+
+def check_host_kill(rows: int) -> dict:
+    """Phase 2: SIGKILL the output-owning host at the final reduce
+    entry; the lineage ladder must win, bit-exact."""
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.runtime import recovery
+    from spark_rapids_tpu.runtime.cluster import (session_cluster,
+                                                  shutdown_session_cluster)
+    from spark_rapids_tpu.shuffle import fault_injection as FI
+
+    want = _oracle(JOIN_Q, rows)
+    s = Session(dict(CLUSTER_CONF))
+    _views(s, rows)
+    runtime = session_cluster(s.conf)
+    # stage boundaries of this plan: map(sid0), reduce(sid0),
+    # map(sid1), reduce(sid1) — ordinal 4 is the final reduce entry,
+    # when every map output is registered
+    FI.arm_from_conf(RapidsConf({
+        cfg.SHUFFLE_FI_ENABLED.key: True,
+        cfg.SHUFFLE_FI_KILL_HOST_AT_STAGE.key: 4,
+    }))
+    pre = recovery.snapshot()
+    t0 = time.monotonic()
+    try:
+        got = s.sql(JOIN_Q).collect()
+    finally:
+        inj = FI.get_injector().stats()  # before disarm resets counts
+        FI.get_injector().disarm()
+    took = time.monotonic() - t0
+    d = recovery.delta(pre)
+    mismatch = _frames_equal(got, want)
+    respawned = [w.executor_id for w in runtime.workers
+                 if "~" in w.executor_id]
+    shutdown_session_cluster()
+    rec = {
+        "recovery": d,
+        "host_kills": inj["host_kills"],
+        "respawned_worker_ids": respawned,
+        "matches_same_mesh_oracle": not mismatch,
+        "detail": mismatch,
+        "time_sec": round(took, 2),
+    }
+    rec["ok"] = (not mismatch and inj["host_kills"] == 1 and
+                 d["fetch_failures"] >= 1 and d["maps_rerun"] >= 1 and
+                 d["workers_respawned"] >= 1 and
+                 d["stage_retries"] >= 1 and
+                 len(respawned) == d["workers_respawned"])
+    return rec
+
+
+def check_dcn_partition(rows: int) -> dict:
+    """Phase 3: a DCN partition outlasting the transport reconnect
+    budget escalates to a fetch failure and resolves through the same
+    stage-retry ladder."""
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.runtime import recovery
+    from spark_rapids_tpu.runtime.cluster import shutdown_session_cluster
+    from spark_rapids_tpu.shuffle import fault_injection as FI
+
+    want = _oracle(JOIN_Q, rows)
+    s = Session(dict(CLUSTER_CONF))
+    _views(s, rows)
+    # consecutive=5 outlasts the default 3-reconnect transport budget:
+    # the partition is not absorbed, it escalates to the ladder
+    FI.arm_from_conf(RapidsConf({
+        cfg.SHUFFLE_FI_ENABLED.key: True,
+        cfg.SHUFFLE_FI_PARTITION_DCN_AT.key: 3,
+        cfg.SHUFFLE_FI_CONSECUTIVE.key: 5,
+    }))
+    pre = recovery.snapshot()
+    t0 = time.monotonic()
+    try:
+        got = s.sql(JOIN_Q).collect()
+    finally:
+        inj = FI.get_injector().stats()
+        FI.get_injector().disarm()
+    took = time.monotonic() - t0
+    d = recovery.delta(pre)
+    mismatch = _frames_equal(got, want)
+    shutdown_session_cluster()
+    rec = {
+        "recovery": d,
+        "dcn_partitions": inj["dcn_partitions"],
+        "dcn_drops": inj["dcn_drops"],
+        "matches_same_mesh_oracle": not mismatch,
+        "detail": mismatch,
+        "time_sec": round(took, 2),
+    }
+    rec["ok"] = (not mismatch and inj["dcn_partitions"] == 1 and
+                 inj["dcn_drops"] >= 2 and
+                 d["dcn_partitions"] == 1)
+    return rec
+
+
+def check_scale_up(rows: int) -> dict:
+    """Phase 4: queue pressure under an open-loop submission burst;
+    the autoscaler grows the cluster through the SAME add_host seam
+    recovery uses, and every queued query still matches the oracle."""
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.runtime import recovery
+    from spark_rapids_tpu.runtime.cluster import (session_cluster,
+                                                  shutdown_session_cluster)
+
+    want = _oracle(JOIN_Q, rows)
+    s = Session(dict(CLUSTER_CONF, **{
+        "rapids.tpu.cluster.workers": 1,
+        "rapids.tpu.cluster.autoscale.enabled": True,
+        "rapids.tpu.cluster.autoscale.queueDepthHigh": 1,
+        "rapids.tpu.cluster.autoscale.maxWorkers": 3,
+        "rapids.tpu.cluster.autoscale.cooldownSec": 0.0,
+        "rapids.tpu.service.maxConcurrent": 1,
+    }))
+    _views(s, rows)
+    # materialize the cluster BEFORE the burst: the autoscaler extends
+    # live membership, it never creates it
+    runtime = session_cluster(s.conf)
+    n_before = len(runtime.live_worker_slots())
+    pre = recovery.snapshot()
+    t0 = time.monotonic()
+    handles = [s.sql(JOIN_Q).collect_async(tenant=f"t{i}")
+               for i in range(4)]
+    frames = [h.result(timeout=600.0) for h in handles]
+    took = time.monotonic() - t0
+    stats = s.service.stats().to_dict()
+    d = recovery.delta(pre)
+    n_after = len(runtime.live_worker_slots())
+    mismatches = [m for m in (_frames_equal(f, want) for f in frames)
+                  if m]
+    shutdown_session_cluster()
+    s.service.shutdown()
+    rec = {
+        "recovery": d,
+        "workers_before": n_before,
+        "workers_after": n_after,
+        "scale_ups": stats["counters"].get("scale_ups", 0),
+        "autoscaler": stats["autoscaler"],
+        "queries": len(frames),
+        "all_match_same_mesh_oracle": not mismatches,
+        "detail": mismatches[:1],
+        "time_sec": round(took, 2),
+    }
+    rec["ok"] = (not mismatches and
+                 d["hosts_added"] >= 1 and
+                 rec["scale_ups"] >= 1 and
+                 n_after > n_before)
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", type=int, default=3000)
+    p.add_argument("--fast", action="store_true",
+                   help="smaller inputs for the deterministic CI fence")
+    p.add_argument("--output-multichip", default=None,
+                   help="write the differential record here "
+                        "(MULTICHIP_r07.json)")
+    p.add_argument("--output-dist", default=None,
+                   help="write the chaos/elasticity record here "
+                        "(DIST_r02.json)")
+    args = p.parse_args(argv)
+    rows = 1000 if args.fast else args.rows
+
+    multichip = check_differential(rows)
+    dist = {
+        "host_kill": check_host_kill(rows),
+        "dcn_partition": check_dcn_partition(rows),
+        "scale_up": check_scale_up(rows),
+    }
+    dist["ok"] = all(r["ok"] for r in dist.values()
+                     if isinstance(r, dict))
+    report = {"differential": multichip, **{k: v for k, v in
+                                            dist.items() if k != "ok"},
+              "ok": multichip["ok"] and dist["ok"]}
+    if args.output_multichip:
+        with open(args.output_multichip, "w") as f:
+            f.write(json.dumps(multichip, indent=2, default=str))
+    if args.output_dist:
+        with open(args.output_dist, "w") as f:
+            f.write(json.dumps(dist, indent=2, default=str))
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

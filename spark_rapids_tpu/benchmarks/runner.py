@@ -122,6 +122,8 @@ class BenchmarkRunner:
 
         from spark_rapids_tpu.parallel import spmd
 
+        from spark_rapids_tpu.parallel import mesh as pmesh
+
         telemetry = disp.installed()
         df = None
         pre_stage = None
@@ -129,6 +131,10 @@ class BenchmarkRunner:
         # fallback telemetry covers the WHOLE run (planning records the
         # reasons, and planning happens inside the iteration loop)
         run_pre_fb = spmd.fallback_snapshot()
+        # mesh-construction fallbacks (device clamp, dropped model axis)
+        # and ICI-vs-DCN seam decisions over the same window
+        run_pre_mesh_fb = pmesh.mesh_fallback_snapshot()
+        run_pre_seam = spmd.seam_snapshot()
         # AQE replan events over the whole run (counters live in
         # execs.adaptive; the dispatch module passes through so the
         # telemetry consumers snapshot from one place)
@@ -229,6 +235,14 @@ class BenchmarkRunner:
                 # every mesh-requested shuffle that stayed on the
                 # host/TCP path this run, with the gate's reason
                 "shuffle_fallbacks": spmd.fallback_delta(run_pre_fb),
+                # mesh construction that downgraded the conf's request
+                # (device clamp, dropped model axis) — the silent-clamp
+                # fix: a too-big rapids.tpu.mesh.devices shows up here
+                "mesh_fallbacks": pmesh.mesh_fallback_delta(
+                    run_pre_mesh_fb),
+                # which seam (intra-host ICI vs cross-host DCN) carried
+                # each shuffle decision this run
+                "seam_decisions": spmd.seam_delta(run_pre_seam),
                 "replan_events": disp.replan_delta(run_pre_replan),
                 "compile_cache": progcache.stats(),
             }

@@ -350,7 +350,28 @@ MESH_ENABLED = conf("rapids.tpu.mesh.enabled").doc(
 ).boolean_conf.create_with_default(False)
 
 MESH_DEVICES = conf("rapids.tpu.mesh.devices").doc(
-    "Device count for the mesh data axis; 0 = all visible devices."
+    "Device count for the mesh data axis; 0 = all visible devices. A "
+    "request larger than the attached backend clamps to what exists and "
+    "records a mesh-fallback reason (parallel/mesh.mesh_fallback_snapshot, "
+    "surfaced in runner telemetry next to shuffle_fallbacks)."
+).int_conf.create_with_default(0)
+
+MESH_MODEL_DEVICES = conf("rapids.tpu.mesh.modelDevices").doc(
+    "Width of the mesh's model axis: the session mesh becomes a 2-D "
+    "data x model layout (devices = data * model) with shuffles riding "
+    "the data axis and the model axis reserved for tensor-parallel "
+    "operators. 1 (default) keeps the 1-D data-only mesh. Values that "
+    "leave fewer than 2 data devices disable the mesh with a recorded "
+    "reason."
+).int_conf.create_with_default(1)
+
+MESH_HOSTS = conf("rapids.tpu.mesh.hosts").doc(
+    "Host (process) count in the logical multi-host topology: each host "
+    "owns one mesh slice and runs ONE SPMD program over its own devices "
+    "with in-program ICI collectives; the DCN seam between hosts is "
+    "carried by the TCP exchange path (parallel/mesh.HostTopology). "
+    "0 = infer: 1 + rapids.tpu.cluster.workers when cluster mode is "
+    "enabled, else 1."
 ).int_conf.create_with_default(0)
 
 FUSION_ENABLED = conf("rapids.tpu.sql.fusion.enabled").doc(
@@ -545,6 +566,35 @@ CLUSTER_RETRY_BACKOFF_MS = conf(
     "should give a flapping peer a few seconds."
 ).int_conf.create_with_default(50)
 
+CLUSTER_AUTOSCALE_ENABLED = conf(
+    "rapids.tpu.cluster.autoscale.enabled").doc(
+    "Let the service's autoscaler add worker hosts while queries queue: "
+    "each admission pump observes queue depth, and sustained pressure "
+    "above autoscale.queueDepthHigh invokes ClusterRuntime.add_host — "
+    "the SAME elastic-membership seam operators and the recovery ladder "
+    "use, so a scale-up is a recovery event, not a special deployment "
+    "path. Requires rapids.tpu.cluster.enabled."
+).boolean_conf.create_with_default(False)
+
+CLUSTER_AUTOSCALE_MAX_WORKERS = conf(
+    "rapids.tpu.cluster.autoscale.maxWorkers").doc(
+    "Ceiling on live worker hosts the autoscaler may grow to (counting "
+    "distinct live slots); scale-ups stop at this size."
+).int_conf.create_with_default(4)
+
+CLUSTER_AUTOSCALE_QUEUE_HIGH = conf(
+    "rapids.tpu.cluster.autoscale.queueDepthHigh").doc(
+    "Admission queue depth at or above which the autoscaler requests a "
+    "new host on the next pump."
+).int_conf.create_with_default(8)
+
+CLUSTER_AUTOSCALE_COOLDOWN_SEC = conf(
+    "rapids.tpu.cluster.autoscale.cooldownSec").doc(
+    "Minimum seconds between autoscaler scale-ups, so one burst does "
+    "not spawn a host per queued query before the first new host "
+    "drains anything."
+).double_conf.create_with_default(30.0)
+
 SHUFFLE_FI_ENABLED = conf(
     "rapids.tpu.shuffle.faultInjection.enabled").doc(
     "Arm the deterministic transport/worker fault injector "
@@ -606,6 +656,31 @@ SHUFFLE_FI_MAX = conf(
     "probabilistic chaos runs terminate."
 ).int_conf.create_with_default(0)
 
+SHUFFLE_FI_KILL_HOST_AT_STAGE = conf(
+    "rapids.tpu.shuffle.faultInjection.killHostAtStage").doc(
+    "SIGKILL one live worker HOST (preferring one that owns registered "
+    "map output) at the Nth driver-side stage boundary — each shuffle "
+    "map stage start and each exchange's first reduce read, counted "
+    "from 1 across the process; 0 disables. Unlike "
+    "killWorkerBeforeTask (which intercepts one submission), this kills "
+    "the whole host out from under a running query: its earlier "
+    "registered map outputs fail reduce-side fetches and the full "
+    "elastic-membership ladder (invalidate, respawn {slot}~{gen}, "
+    "re-run lost maps, re-read) runs deterministically on CPU CI "
+    "(scripts/multihost_chaos_check.py)."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_PARTITION_DCN_AT = conf(
+    "rapids.tpu.shuffle.faultInjection.partitionDcnAtRequest").doc(
+    "Partition the DCN seam starting at the Nth cross-host transport "
+    "round trip (counted from 1); 0 disables. Each affected request "
+    "fails like a downed inter-host link (socket dropped, retryable "
+    "TransportError); combine with faultInjection.consecutive past the "
+    "transport retry budget to escalate the partition into a fetch "
+    "failure and a stage retry. Each distinct partition event bumps the "
+    "dcn_partitions recovery counter."
+).int_conf.create_with_default(0)
+
 SHUFFLE_IN_PROGRAM = conf("rapids.tpu.shuffle.inProgram.enabled").doc(
     "Fold mesh-internal shuffles into the compiled program: when the "
     "session mesh is active, hash-routed exchanges lower to in-program "
@@ -626,6 +701,17 @@ SHUFFLE_IN_PROGRAM_MIN_ROWS = conf(
     "compile for nothing). 0 = no floor."
 ).int_conf.create_with_default(0)
 
+SHUFFLE_SEAM_ICI = conf(
+    "rapids.tpu.shuffle.seam.intraHostIci.enabled").doc(
+    "Per-seam shuffle routing in cluster mode: keep in-program ICI "
+    "collectives for exchanges whose subtree ships to one host whole "
+    "(the collective spans only that process's mesh slice) and use the "
+    "TCP path ONLY at the DCN seam between hosts. Disable to restore "
+    "the all-or-nothing cluster gate where ANY cluster session forces "
+    "every exchange onto TCP. Every seam decision is recorded either "
+    "way (parallel/spmd.seam_snapshot, surfaced in run telemetry)."
+).boolean_conf.create_with_default(True)
+
 SHUFFLE_COMPRESSION_CODEC = conf("rapids.tpu.shuffle.compression.codec").doc(
     "Compression for host-path shuffle payloads: none, lz4 (native C++ "
     "codec; the nvcomp-LZ4 analogue, RapidsConf.scala:685) or zlib."
@@ -635,6 +721,23 @@ SHUFFLE_MAX_INFLIGHT = conf(
     "rapids.tpu.shuffle.transport.maxReceiveInflightBytes").doc(
     "Inflight-bytes throttle for shuffle fetches (RapidsConf.scala:603-685)."
 ).bytes_conf.create_with_default(1 << 30)
+
+SHUFFLE_RETRY_JITTER_MS = conf(
+    "rapids.tpu.shuffle.retry.jitterMs").doc(
+    "Uniform random jitter (0..jitterMs) added to each transport "
+    "reconnect backoff sleep, so hosts that watched the same DCN blip "
+    "de-synchronize instead of stampeding one survivor with "
+    "simultaneous reconnects. 0 disables jitter (deterministic "
+    "backoff, useful under fault injection)."
+).int_conf.create_with_default(10)
+
+SHUFFLE_RETRY_MAX_RECONNECTS = conf(
+    "rapids.tpu.shuffle.retry.maxReconnects").doc(
+    "Transient-fault retry budget per transport request (each retry is "
+    "also the one reconnect — the failed round trip already dropped "
+    "the socket). Past it the error surfaces as a fetch failure and "
+    "costs a stage retry."
+).int_conf.create_with_default(3)
 
 TEST_ENABLED = conf("rapids.tpu.sql.test.enabled").doc(
     "Test mode: assert the whole plan is on the TPU "

@@ -135,6 +135,13 @@ class ShuffleExchangeExec(TpuExec):
         the collective are still exact."""
         assert self.partitioning[0] == "hash", self.partitioning
         assert self._blocks is None, "already materialized"
+        from spark_rapids_tpu.parallel import spmd
+
+        # per-exchange seam record (the plan-time gate records the
+        # decision; this records an exchange actually ARMED onto it)
+        spmd.record_seam("exchange", spmd.SEAM_ICI,
+                         "in-program all_to_all armed over the "
+                         "session mesh slice")
         self.in_program = True
         self._in_program_mesh = mesh
         self._skew_spec = skew

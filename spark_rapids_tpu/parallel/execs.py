@@ -297,22 +297,27 @@ class _MeshShippable:
     session mesh size to every spawned worker."""
 
     def __getstate__(self):
+        from spark_rapids_tpu.parallel.mesh import mesh_model_size
+
         state = dict(self.__dict__)
         mesh = state.pop("mesh", None)
         state.pop("_steps", None)
         state.pop("_dstep", None)
         state["_mesh_n"] = None if mesh is None else \
             int(mesh.shape[DATA_AXIS])
+        state["_mesh_model"] = 1 if mesh is None else \
+            int(mesh_model_size(mesh))
         return state
 
     def __setstate__(self, state):
         from spark_rapids_tpu.parallel.mesh import reconstruct_mesh
 
         n = state.pop("_mesh_n", None)
+        model = state.pop("_mesh_model", 1)
         self.__dict__.update(state)
         self._steps = {}
         self._dstep = None
-        self.mesh = None if n is None else reconstruct_mesh(n)
+        self.mesh = None if n is None else reconstruct_mesh(n, model)
 
 
 class MeshGroupByExec(_MeshShippable, HashAggregateExec):

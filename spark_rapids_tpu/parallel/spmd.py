@@ -51,6 +51,41 @@ def fallback_delta(before: dict) -> dict:
             if n - before.get(k, 0)}
 
 
+#: seam classes a shuffle decision lands on (parallel/mesh.HostTopology
+#: uses the same vocabulary): ICI = in-program collective inside one
+#: host's mesh slice, DCN = host boundary crossed over the TCP exchange
+#: path.
+SEAM_ICI = "ici"
+SEAM_DCN = "dcn"
+
+# {(op, seam, reason): count} — every ICI-vs-DCN decision, not just the
+# "no" answers: the multi-host fence (scripts/multihost_chaos_check.py)
+# asserts both sides of the seam were exercised.
+_seams: dict = {}
+
+
+def record_seam(op: str, seam: str, reason: str) -> None:
+    """Count one seam decision for ``op``'s shuffle: which link class
+    (SEAM_ICI / SEAM_DCN) carries it and why."""
+    with _lock:
+        key = (op, seam, reason)
+        _seams[key] = _seams.get(key, 0) + 1
+
+
+def seam_snapshot() -> dict:
+    """{"op: seam: reason": count} so far (flattened for JSON)."""
+    with _lock:
+        return {f"{op}: {seam}: {reason}": n
+                for (op, seam, reason), n in sorted(_seams.items())}
+
+
+def seam_delta(before: dict) -> dict:
+    """Seam decisions recorded since ``before`` (a seam_snapshot)."""
+    now = seam_snapshot()
+    return {k: n - before.get(k, 0) for k, n in now.items()
+            if n - before.get(k, 0)}
+
+
 #: the RUNTIME fallback reason (every other reason is a plan-time gate
 #: in in_program_mesh below): an in-program exchange's compiled program
 #: failed on-device mid-query and the stage re-ran on the host/TCP
@@ -115,19 +150,27 @@ def in_program_mesh(conf, op: str, *, keyed: bool = True,
     - mesh not requested (``rapids.tpu.mesh.enabled`` off / no conf):
       None, NOT recorded — there is no shuffle decision to explain.
     - ``rapids.tpu.shuffle.inProgram.enabled`` off: explicit opt-out.
-    - ``rapids.tpu.cluster.enabled``: cross-host executors shuffle over
-      DCN; ICI collectives cannot reach a peer process's devices.
-      SKIPPED when ``cluster_local`` — a Mesh*Exec subtree ships to one
+    - ``rapids.tpu.cluster.enabled``: this shuffle's blocks cross the
+      host boundary, so the DCN seam (TCP, shuffle/tcp.py) carries it.
+      This is a PER-SEAM decision, not an all-or-nothing cluster gate:
+      when ``cluster_local`` — a Mesh*Exec subtree ships to one
       executor whole, so its internal collective only ever spans that
-      process's local mesh (fenced by tests/test_cluster_sql.py's
-      mesh-subtree-on-worker case).
+      process's local mesh slice (fenced by tests/test_cluster_sql.py's
+      mesh-subtree-on-worker case) — the shuffle stays ICI in-program
+      even in cluster mode, unless
+      ``rapids.tpu.shuffle.seam.intraHostIci.enabled`` restores the
+      old blanket gate. Both outcomes are recorded as seam decisions
+      (:func:`record_seam`) on top of the fallback reason.
+    - a model-parallel axis on the session mesh: the in-program
+      exchange's collectives ride the data axis only.
     - fewer than 2 visible devices: no axis to collect over.
     - ``keyed`` False: the plan shape cannot be uniformly sharded
       (callers pass the concrete reason, e.g. an ungrouped aggregate).
     - ``est_rows`` below ``rapids.tpu.shuffle.inProgram.minRows``.
     """
     from spark_rapids_tpu import config as cfg
-    from spark_rapids_tpu.parallel.mesh import session_mesh
+    from spark_rapids_tpu.parallel.mesh import (mesh_model_size,
+                                                session_mesh)
 
     if conf is None or not conf.get(cfg.MESH_ENABLED):
         return None
@@ -135,13 +178,25 @@ def in_program_mesh(conf, op: str, *, keyed: bool = True,
         record_fallback(op, "disabled by "
                         + cfg.SHUFFLE_IN_PROGRAM.key)
         return None
-    if conf.get(cfg.CLUSTER_ENABLED) and not cluster_local:
+    cluster = bool(conf.get(cfg.CLUSTER_ENABLED))
+    if cluster and not cluster_local:
+        record_seam(op, SEAM_DCN, "inter-host exchange: blocks cross "
+                    "the process boundary, TCP carries the DCN seam")
         record_fallback(op, "cross-host DCN: cluster mode shuffles "
                         "over TCP (shuffle/tcp.py)")
+        return None
+    if cluster and not conf.get(cfg.SHUFFLE_SEAM_ICI):
+        record_seam(op, SEAM_DCN, "intra-host ICI disabled by "
+                    + cfg.SHUFFLE_SEAM_ICI.key)
+        record_fallback(op, "disabled by " + cfg.SHUFFLE_SEAM_ICI.key)
         return None
     mesh = session_mesh(conf)
     if mesh is None:
         record_fallback(op, "mesh unavailable: fewer than 2 devices")
+        return None
+    if mesh_model_size(mesh) > 1:
+        record_fallback(op, "model-parallel axis active: in-program "
+                        "shuffle rides the data axis only")
         return None
     if not keyed:
         record_fallback(op, "non-uniform: "
@@ -153,4 +208,9 @@ def in_program_mesh(conf, op: str, *, keyed: bool = True,
             op, f"below {cfg.SHUFFLE_IN_PROGRAM_MIN_ROWS.key} "
                 f"({est_rows} < {floor})")
         return None
+    if cluster:
+        record_seam(op, SEAM_ICI, "intra-host slice: collective spans "
+                    "one process's devices")
+    else:
+        record_seam(op, SEAM_ICI, "single host: no DCN seam in session")
     return mesh

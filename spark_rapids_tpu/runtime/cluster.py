@@ -254,6 +254,9 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
         # cluster-mode degradation is visible, never silent
         self.local_fallbacks: List[str] = []
         self._read_stub: Optional[ClusterShuffleReadExec] = None
+        # the first reduce read of this exchange counts as one stage
+        # boundary for the host-granularity fault injector
+        self._reduce_stage_counted = False
 
     @classmethod
     def wrap(cls, ex: ShuffleExchangeExec, runtime: "ClusterRuntime"
@@ -275,9 +278,25 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
     # -- map side ---------------------------------------------------------
 
     def _materialize(self) -> None:
+        from spark_rapids_tpu.parallel import spmd
+        from spark_rapids_tpu.shuffle import fault_injection
+
         with self._mat_lock:
             if self.shuffle_id is not None:
                 return
+            # this exchange's blocks cross the host boundary: the DCN
+            # seam decision pairs with the ICI decisions the planner
+            # records for host-local Mesh*Exec subtrees
+            spmd.record_seam("exchange", spmd.SEAM_DCN,
+                             "cluster exchange: map outputs cross the "
+                             "host boundary over TCP")
+            if fault_injection.get_injector().should_kill_host_at_stage():
+                # host-granularity fault: SIGKILL a live worker at the
+                # stage boundary. Recovery is NOT told — it discovers
+                # the death through submit failures and reduce-side
+                # fetch failures, the same signals a real host loss
+                # produces.
+                self.runtime.kill_one_host()
             sid = self.runtime.new_shuffle_id(self)
             child = self.children[0]
             if self.partitioning[0] == "range" and \
@@ -416,8 +435,21 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
         def it():
             from spark_rapids_tpu.memory import priorities
             from spark_rapids_tpu.memory.spillable import SpillableBatch
+            from spark_rapids_tpu.shuffle import fault_injection
 
             self._materialize()
+            # the reduce entry is a stage boundary too (the map stage
+            # ended, the read stage begins) — and it is the boundary
+            # where a host death costs the most: every map output is
+            # registered, so killing here deterministically drives the
+            # full fetch-failure -> recover -> re-run ladder. Counted
+            # once per exchange, not per reduce partition.
+            with self._mat_lock:
+                first_reduce = not self._reduce_stage_counted
+                self._reduce_stage_counted = True
+            if first_reduce and fault_injection.get_injector() \
+                    .should_kill_host_at_stage():
+                self.runtime.kill_one_host()
             # stage-retry barrier: buffer the partition so a mid-stream
             # fetch failure can restart the read without duplicating
             # already-yielded batches (Spark re-runs the whole task).
@@ -644,6 +676,14 @@ class ClusterRuntime:
         # host, not one incarnation of it)
         self._failures: Dict[str, int] = {}
         self.blacklisted: set = set()
+        # slots retired by remove_host: never respawned, never targeted
+        # — DISTINCT from blacklisting (a decommission is an operator /
+        # autoscaler decision, not a fault record)
+        self.decommissioned: set = set()
+        # next fresh slot index for add_host (existing slots are 0..n-1)
+        self._next_slot = n_workers
+        # membership-change journal: (action, executor_id, reason)
+        self.scale_events: List[dict] = []
         self._sid = itertools.count()
         self._lock = lockorder.make_lock("runtime.cluster.state")
         # serializes fetch-failure recovery against stub rebuilds: the
@@ -682,8 +722,21 @@ class ClusterRuntime:
         ids = [ex.executor_id for ex in self.cluster.executors]
         ids += [w.executor_id for w in self.workers
                 if w.alive and
-                self._slot(w.executor_id) not in self.blacklisted]
+                self._slot(w.executor_id) not in self.blacklisted and
+                self._slot(w.executor_id) not in self.decommissioned]
         return ids
+
+    def live_worker_slots(self) -> List[str]:
+        """Distinct worker slots with a live, targetable generation —
+        the autoscaler's notion of current cluster size."""
+        slots = []
+        for w in self.workers:
+            slot = self._slot(w.executor_id)
+            if w.alive and slot not in self.blacklisted and \
+                    slot not in self.decommissioned and \
+                    slot not in slots:
+                slots.append(slot)
+        return slots
 
     # -- worker supervision (respawn + blacklist) --------------------------
 
@@ -731,7 +784,7 @@ class ClusterRuntime:
             if w.alive:
                 continue
             slot = self._slot(w.executor_id)
-            if slot in self.blacklisted:
+            if slot in self.blacklisted or slot in self.decommissioned:
                 continue
             if any(self._slot(o.executor_id) == slot and o.alive
                    for o in self.workers):
@@ -751,6 +804,94 @@ class ClusterRuntime:
             self.cluster.register_remote_executor(nw.executor_id,
                                                   nw.host, nw.port)
             recovery.bump("workers_respawned")
+
+    # -- elastic membership (hosts join and leave as recovery events) -----
+
+    def add_host(self, reason: str = "scale-up") -> str:
+        """Join a NEW worker host to the running cluster: fresh slot,
+        fresh process, registered with the driver's transport so the
+        next task placement and every subsequent read stub's address
+        book can target it. No stage pauses — the membership change
+        rides the same seam recovery uses (serialized under the recover
+        lock so a concurrent fetch-failure recovery never observes a
+        half-registered host)."""
+        with self._recover_lock:
+            slot_idx = self._next_slot
+            self._next_slot += 1
+            eid = f"exec-worker-{slot_idx}"
+            w = RemoteWorkerHandle.spawn(
+                eid, mesh_devices=self.mesh_devices,
+                task_timeout=self.task_timeout_sec)
+            self.workers.append(w)
+            self.cluster.register_remote_executor(w.executor_id, w.host,
+                                                  w.port)
+            self.scale_events.append(
+                {"action": "add", "executor_id": eid, "reason": reason})
+        recovery.bump("hosts_added")
+        return eid
+
+    def remove_host(self, executor_id: str,
+                    reason: str = "scale-down") -> List[Tuple[int, int]]:
+        """Decommission a worker host mid-query, driving the SAME
+        lineage ladder a host death does: kill every live generation of
+        the slot, invalidate its registered map outputs, and re-run
+        exactly the lost maps on the survivors — so reduces that later
+        rebuild their stubs read repaired trackers, never the dead
+        host. The slot is retired (no respawn, no future placement) but
+        NOT blacklisted: leaving on request is not a fault. Returns the
+        (shuffle_id, map_id) pairs that re-ran."""
+        slot = self._slot(executor_id)
+        rerun: List[Tuple[int, int]] = []
+        with self._recover_lock:
+            self.decommissioned.add(slot)
+            gens = [w for w in self.workers
+                    if self._slot(w.executor_id) == slot]
+            assert gens, f"remove_host: unknown worker slot {slot}"
+            for w in gens:
+                if w.alive:
+                    w.kill()
+            gen_ids = {w.executor_id for w in gens}
+            with self._lock:
+                sids = sorted(self.assignments)
+            for sid in sids:
+                exchange = self.exchanges.get(sid)
+                if exchange is None:
+                    continue
+                for eid in gen_ids:
+                    lost = self.cluster.invalidate_map_output(sid, eid)
+                    for map_id in lost:
+                        self.run_map_task(exchange, sid, map_id,
+                                          exclude=gen_ids)
+                        rerun.append((sid, map_id))
+            if rerun:
+                recovery.bump("maps_rerun", len(rerun))
+            self.scale_events.append(
+                {"action": "remove", "executor_id": executor_id,
+                 "reason": reason, "maps_rerun": len(rerun)})
+        recovery.bump("hosts_removed")
+        return rerun
+
+    def kill_one_host(self) -> Optional[str]:
+        """SIGKILL one live, targetable worker host (the fault
+        injector's host-granularity primitive), PREFERRING a host that
+        owns registered map output — a load-bearing loss, so the
+        deterministic CI kill exercises the recovery ladder instead of
+        an idle bystander. Deliberately does NO bookkeeping: recovery
+        must discover the death through fetch failures, exactly as
+        with a real host loss."""
+        owners = {self._slot(eid) for maps in self.assignments.values()
+                  for eid in maps.values()}
+        candidates = [
+            w for w in self.workers
+            if w.alive and self._slot(w.executor_id) not in
+            self.blacklisted and self._slot(w.executor_id) not in
+            self.decommissioned]
+        preferred = [w for w in candidates
+                     if self._slot(w.executor_id) in owners]
+        for w in (preferred or candidates):
+            w.kill()
+            return w.executor_id
+        return None
 
     # -- task scheduling --------------------------------------------------
 
@@ -923,11 +1064,13 @@ def session_cluster(conf) -> Optional[ClusterRuntime]:
     global _SESSION_RUNTIME, _RUNTIME_KEY
     mesh_devices = 0
     if conf.get(cfg.MESH_ENABLED):
-        from spark_rapids_tpu.parallel.mesh import DATA_AXIS, session_mesh
+        from spark_rapids_tpu.parallel.mesh import session_mesh
 
         m = session_mesh(conf)
         if m is not None:
-            mesh_devices = int(m.shape[DATA_AXIS])
+            # total devices (data * model): workers must be able to
+            # reconstruct the full 2-D slice a shipped subtree names
+            mesh_devices = int(m.devices.size)
     key = (conf.get(cfg.CLUSTER_EXECUTORS),
            conf.get(cfg.CLUSTER_WORKERS), mesh_devices,
            conf.get(cfg.CLUSTER_MAX_STAGE_RETRIES),
@@ -951,6 +1094,13 @@ def session_cluster(conf) -> Optional[ClusterRuntime]:
         import atexit
 
         atexit.register(shutdown_session_cluster)
+    return _SESSION_RUNTIME
+
+
+def active_cluster() -> Optional[ClusterRuntime]:
+    """The live session cluster runtime, if one has been built — the
+    autoscaler's handle onto the elastic-membership seam (it must never
+    CREATE a cluster, only grow one the session already runs)."""
     return _SESSION_RUNTIME
 
 

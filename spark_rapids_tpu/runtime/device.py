@@ -109,6 +109,9 @@ def initialize(conf: Optional[RapidsConf] = None,
         retry.configure_from_conf(conf)
         fault_injection.arm_from_conf(conf)
         shuffle_fault_injection.arm_from_conf(conf)
+        from spark_rapids_tpu.shuffle import tcp as shuffle_tcp
+
+        shuffle_tcp.configure_retry_from_conf(conf)
         from spark_rapids_tpu.native import kernels
 
         kernels.configure_from_conf(conf)

@@ -21,7 +21,13 @@ from spark_rapids_tpu.utils import lockorder
 _lock = lockorder.make_lock("runtime.recovery.stats")
 
 _KEYS = ("fetch_failures", "maps_rerun", "workers_respawned",
-         "executors_blacklisted", "stage_retries", "spmd_degrades")
+         "executors_blacklisted", "stage_retries", "spmd_degrades",
+         # elastic-membership events (ClusterRuntime.add_host /
+         # remove_host and the injected DCN seam partition): a host
+         # joining or leaving mid-query is a recovery event here, not
+         # an outage — counted in the same block the runner/service
+         # already surface
+         "hosts_added", "hosts_removed", "dcn_partitions")
 
 _counters: Dict[str, int] = {k: 0 for k in _KEYS}
 

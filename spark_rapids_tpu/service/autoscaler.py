@@ -1,0 +1,85 @@
+"""Queue-pressure autoscaler: scale-up as a recovery event.
+
+Watches the admission queue from the service's pump and, under
+sustained pressure, invokes ``ClusterRuntime.add_host`` — the SAME
+elastic-membership seam the lineage-recovery ladder drives when a host
+dies (runtime/cluster.py): a new slot spawns, registers with the
+transport, and the next task placement can target it. No separate
+deployment path, no stage pause; the only difference from recovery is
+who asked.
+
+The observer runs under the service lock (rank 20) and the scale-up
+takes the cluster recover lock (rank 50) — the same outer-to-inner
+direction every service-to-runtime call already follows. Spawning a
+process under the service lock is bounded by the cooldown and the
+worker ceiling, and costs far less than the queued work it unblocks.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from spark_rapids_tpu import config as cfg
+
+
+class ClusterAutoscaler:
+    """Decides, per admission pump, whether the cluster should grow.
+
+    NOT thread-safe on its own: the service calls ``observe`` under its
+    lock, which is the only writer."""
+
+    def __init__(self, conf):
+        self.enabled = bool(conf.get(cfg.CLUSTER_AUTOSCALE_ENABLED)
+                            and conf.get(cfg.CLUSTER_ENABLED))
+        self.queue_high = max(
+            conf.get(cfg.CLUSTER_AUTOSCALE_QUEUE_HIGH), 1)
+        self.max_workers = max(
+            conf.get(cfg.CLUSTER_AUTOSCALE_MAX_WORKERS), 1)
+        self.cooldown_s = max(
+            conf.get(cfg.CLUSTER_AUTOSCALE_COOLDOWN_SEC), 0.0)
+        self.scale_ups = 0
+        self.last_reason = ""
+        self.last_executor_id = ""
+        self._last_at: Optional[float] = None
+
+    def observe(self, queue_depth: int, inflight: int) -> Optional[str]:
+        """One pressure observation; returns the new executor id when a
+        scale-up fired, else None. Grows only a cluster the session
+        already runs (runtime.cluster.active_cluster) — the autoscaler
+        never CREATES membership, it extends it."""
+        if not self.enabled or queue_depth < self.queue_high:
+            return None
+        now = time.monotonic()
+        if self._last_at is not None and \
+                now - self._last_at < self.cooldown_s:
+            return None
+        from spark_rapids_tpu.runtime.cluster import active_cluster
+
+        runtime = active_cluster()
+        if runtime is None:
+            return None
+        if len(runtime.live_worker_slots()) >= self.max_workers:
+            return None
+        reason = (f"queue depth {queue_depth} >= {self.queue_high} "
+                  f"with {inflight} inflight")
+        try:
+            eid = runtime.add_host(reason=f"autoscaler: {reason}")
+        except (OSError, AssertionError, ValueError):
+            # the host would not spawn: admission pressure stays, the
+            # next pump (past cooldown) tries again
+            self._last_at = now
+            return None
+        self.scale_ups += 1
+        self.last_reason = reason
+        self.last_executor_id = eid
+        self._last_at = now
+        return eid
+
+    def stats(self) -> dict:
+        return {"enabled": self.enabled,
+                "scale_ups": self.scale_ups,
+                "queue_depth_high": self.queue_high,
+                "max_workers": self.max_workers,
+                "cooldown_sec": self.cooldown_s,
+                "last_reason": self.last_reason,
+                "last_executor_id": self.last_executor_id}

@@ -22,6 +22,7 @@ from spark_rapids_tpu.config import RapidsConf
 from spark_rapids_tpu.memory.catalog import get_catalog
 from spark_rapids_tpu.service.admission import (AdmissionController,
                                                 parse_fairness_weights)
+from spark_rapids_tpu.service.autoscaler import ClusterAutoscaler
 from spark_rapids_tpu.service.cache.manager import CacheManager
 from spark_rapids_tpu.service.scheduler import StageScheduler
 from spark_rapids_tpu.service.stats import Histogram, ServiceStats
@@ -56,7 +57,8 @@ class QueryService:
                           "done": 0, "failed": 0, "cancelled": 0,
                           "deadline_expired": 0,
                           "admitted_out_of_core": 0,
-                          "oom_retries": 0, "oom_splits": 0}
+                          "oom_retries": 0, "oom_splits": 0,
+                          "scale_ups": 0}
         self._queue_time = Histogram()
         self._run_time = Histogram()
         self._shutdown = False
@@ -70,6 +72,10 @@ class QueryService:
                 self.conf.get(cfg.SERVICE_FAIRNESS_WEIGHTS)))
         self.scheduler = StageScheduler(
             self, n_workers=self.conf.get(cfg.SERVICE_MAX_CONCURRENT))
+        # queue-pressure autoscaler (service/autoscaler.py): observes
+        # every admission pump, grows the session cluster through the
+        # elastic-membership seam when queries keep queuing
+        self.autoscaler = ClusterAutoscaler(self.conf)
         # semantic result & fragment cache (service/cache): per-service
         # like the admission ledger. Its device-resident fragment bytes
         # charge the admission budget so cached data and inflight
@@ -460,6 +466,7 @@ class QueryService:
                 cache=self.cache.stats(),
                 streaming=self.streaming.stats(),
                 recovery=_recovery.snapshot(),
+                autoscaler=self.autoscaler.stats(),
                 queue_depth=self.admission.queue_depth(),
                 running=running,
                 admitted_inflight=len(self.admission.inflight),
@@ -594,6 +601,15 @@ class QueryService:
             while True:
                 nxt = self.admission.next_admissible()
                 if nxt is None:
+                    # nothing admissible but work still queued: that is
+                    # admission pressure — let the autoscaler decide
+                    # whether the cluster should grow a host
+                    if self.admission.queue_depth() > 0:
+                        eid = self.autoscaler.observe(
+                            self.admission.queue_depth(),
+                            len(self.admission.inflight))
+                        if eid is not None:
+                            self._counters["scale_ups"] += 1
                     return
                 if nxt.deadline_expired():
                     self._finalize_locked(

@@ -113,9 +113,13 @@ class ServiceStats:
     streaming: dict = dataclasses.field(default_factory=dict)
     #: lineage fault recovery (runtime/recovery.snapshot()): reduce-side
     #: fetch failures, map tasks re-run, workers respawned, executor
-    #: slots blacklisted, stage retries spent, SPMD degrades — a query
-    #: that survived a worker death shows up here, never silently
+    #: slots blacklisted, stage retries spent, SPMD degrades, hosts
+    #: added/removed through elastic membership — a query that survived
+    #: a worker death shows up here, never silently
     recovery: dict = dataclasses.field(default_factory=dict)
+    #: queue-pressure autoscaler (service/autoscaler): scale-ups fired,
+    #: thresholds, last reason/executor — pairs with counters.scale_ups
+    autoscaler: dict = dataclasses.field(default_factory=dict)
 
     @property
     def progcache_hit_rate(self) -> float:

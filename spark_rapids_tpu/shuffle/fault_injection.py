@@ -19,6 +19,17 @@ deterministically on CPU CI:
   Nth task submission. The submit fails over locally; the worker's
   EARLIER registered outputs then fail reduce-side fetches — the
   worker-death half of recovery (invalidate, respawn, re-run).
+- ``kill_host_at_stage=N``: SIGKILL one live worker HOST at the start
+  of the Nth shuffle map stage — the host-granularity fault for the
+  elastic-membership ladder (runtime/cluster.py): the host's earlier
+  registered outputs fail reduce-side fetches, the slot respawns as
+  ``{slot}~{gen}``, and exactly the lost maps re-run.
+- ``partition_dcn_at_request=N``: the DCN seam partitions starting at
+  the Nth cross-host round trip — each affected request fails like a
+  downed inter-host link (socket dropped, retryable). With
+  ``consecutive`` past the transport retry budget this escalates into
+  a fetch failure and a stage retry; each distinct partition event
+  bumps the ``dcn_partitions`` recovery counter.
 - ``probability`` + ``seed``: seeded random connection drops for chaos
   sweeps; ``consecutive=K`` makes each firing point fail K events in a
   row (K past the transport retry budget escalates a drop into a fetch
@@ -76,17 +87,23 @@ class ShuffleFaultInjector:
             self._drop = _Trigger(0, 1)
             self._truncate = _Trigger(0, 1)
             self._kill = _Trigger(0, 1)
+            self._kill_host = _Trigger(0, 1)
+            self._dcn = _Trigger(0, 1)
             self._probability = 0.0
             self._rng: Optional[random.Random] = None
             self._max_injections = 0
             self._drops = 0
             self._truncations = 0
             self._kills = 0
+            self._host_kills = 0
+            self._dcn_drops = 0
+            self._dcn_partitions = 0
 
     def arm(self, drop_at_request: int = 0, truncate_at_request: int = 0,
             kill_before_task: int = 0, probability: float = 0.0,
             seed: int = 0, consecutive: int = 1,
-            max_injections: int = 0) -> None:
+            max_injections: int = 0, kill_host_at_stage: int = 0,
+            partition_dcn_at_request: int = 0) -> None:
         """Arm (resetting all counters). Ordinals count eligible events
         from 1; 0 disables that fault kind (probability may still drop
         connections)."""
@@ -95,12 +112,17 @@ class ShuffleFaultInjector:
             self._drop = _Trigger(drop_at_request, consecutive)
             self._truncate = _Trigger(truncate_at_request, consecutive)
             self._kill = _Trigger(kill_before_task, 1)
+            self._kill_host = _Trigger(kill_host_at_stage, 1)
+            self._dcn = _Trigger(partition_dcn_at_request, consecutive)
             self._probability = float(probability)
             self._rng = random.Random(seed) if probability > 0 else None
             self._max_injections = max(int(max_injections), 0)
             self._drops = 0
             self._truncations = 0
             self._kills = 0
+            self._host_kills = 0
+            self._dcn_drops = 0
+            self._dcn_partitions = 0
 
     @property
     def armed(self) -> bool:
@@ -108,7 +130,8 @@ class ShuffleFaultInjector:
 
     def _capped(self) -> bool:
         return self._max_injections and \
-            (self._drops + self._truncations + self._kills) >= \
+            (self._drops + self._truncations + self._kills +
+             self._host_kills + self._dcn_drops) >= \
             self._max_injections
 
     def should_drop(self) -> bool:
@@ -150,15 +173,55 @@ class ShuffleFaultInjector:
             self._kills += 1
             return True
 
+    def should_kill_host_at_stage(self) -> bool:
+        """Count one shuffle map-stage start (driver-side); True = the
+        runtime must SIGKILL one live worker HOST before running the
+        stage (ClusterRuntime.kill_one_host owns the handles). Recovery
+        then discovers the death through reduce-side fetch failures —
+        the same signal a real host loss produces."""
+        if not self._armed:
+            return False
+        with self._lock:
+            if not self._kill_host.fire() or self._capped():
+                return False
+            self._host_kills += 1
+            return True
+
+    def should_partition_dcn(self) -> bool:
+        """Count one cross-host transport round trip; True = the DCN
+        seam is partitioned for this request (the caller drops its
+        socket and fails with a retryable TransportError). The FIRST
+        request of each partition event bumps the ``dcn_partitions``
+        recovery counter; the burst that follows models the link
+        staying down."""
+        if not self._armed:
+            return False
+        with self._lock:
+            if not self._dcn.fire() or self._capped():
+                return False
+            self._dcn_drops += 1
+            initial = self._dcn.count == self._dcn.at
+            if initial:
+                self._dcn_partitions += 1
+        if initial:
+            from spark_rapids_tpu.runtime import recovery
+
+            recovery.bump("dcn_partitions")
+        return True
+
     def stats(self) -> dict:
         with self._lock:
             return {"armed": self._armed,
                     "requests": self._drop.count,
                     "chunk_requests": self._truncate.count,
                     "tasks": self._kill.count,
+                    "stages": self._kill_host.count,
                     "drops": self._drops,
                     "truncations": self._truncations,
-                    "kills": self._kills}
+                    "kills": self._kills,
+                    "host_kills": self._host_kills,
+                    "dcn_drops": self._dcn_drops,
+                    "dcn_partitions": self._dcn_partitions}
 
 
 _injector = ShuffleFaultInjector()
@@ -183,5 +246,8 @@ def arm_from_conf(conf) -> bool:
         probability=conf.get(cfg.SHUFFLE_FI_PROBABILITY),
         seed=conf.get(cfg.SHUFFLE_FI_SEED),
         consecutive=conf.get(cfg.SHUFFLE_FI_CONSECUTIVE),
-        max_injections=conf.get(cfg.SHUFFLE_FI_MAX))
+        max_injections=conf.get(cfg.SHUFFLE_FI_MAX),
+        kill_host_at_stage=conf.get(cfg.SHUFFLE_FI_KILL_HOST_AT_STAGE),
+        partition_dcn_at_request=conf.get(
+            cfg.SHUFFLE_FI_PARTITION_DCN_AT))
     return True

@@ -69,6 +69,15 @@ class ShuffleIterator:
         self.remote_bytes_read = 0
         self.batches_yielded = 0
 
+    def seam_stats(self) -> Dict[str, int]:
+        """This read's traffic split by seam class (the multi-host
+        topology vocabulary, parallel/mesh.HostTopology): local catalog
+        hits never left the host ("ici" side of the seam), remote
+        fetches crossed the DCN over transport."""
+        return {"ici_local_blocks": self.local_blocks_read,
+                "dcn_remote_blocks": self.remote_blocks_read,
+                "dcn_remote_bytes": self.remote_bytes_read}
+
     def _failed(self, blocks, executor: str, cause
                 ) -> ShuffleFetchFailedError:
         if self.on_fetch_error is not None and \

@@ -27,6 +27,7 @@ protocol holds across processes:
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
@@ -40,6 +41,34 @@ from spark_rapids_tpu.shuffle.transport import (Connection, ShuffleServer,
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 256 << 20
+
+# Process-wide transport retry policy (rapids.tpu.shuffle.retry.*):
+# connections are created per-peer deep inside the transport registry,
+# so the session pushes the knobs here once (configure_retry_from_conf,
+# called from runtime.initialize alongside the fault injector) instead
+# of threading a conf through every connect().
+_retry_policy = {"max_reconnects": 3, "jitter_ms": 10}
+
+
+def configure_retry(max_reconnects: Optional[int] = None,
+                    jitter_ms: Optional[int] = None) -> None:
+    """Set the process-wide transport retry policy; None leaves a field
+    unchanged. Existing connections keep the policy they were built
+    with (one socket, in-flight requests)."""
+    if max_reconnects is not None:
+        _retry_policy["max_reconnects"] = max(int(max_reconnects), 0)
+    if jitter_ms is not None:
+        _retry_policy["jitter_ms"] = max(int(jitter_ms), 0)
+
+
+def configure_retry_from_conf(conf) -> None:
+    """Push ``rapids.tpu.shuffle.retry.{maxReconnects,jitterMs}`` into
+    the process-wide policy."""
+    from spark_rapids_tpu import config as cfg
+
+    configure_retry(
+        max_reconnects=conf.get(cfg.SHUFFLE_RETRY_MAX_RECONNECTS),
+        jitter_ms=conf.get(cfg.SHUFFLE_RETRY_JITTER_MS))
 
 
 class Hangup(Exception):
@@ -191,7 +220,9 @@ class TcpConnection(Connection):
 
     #: bounded transient-fault retries per request (first backoff
     #: _RETRY_BASE_S, doubling; total added wait stays well under any
-    #: sane request timeout)
+    #: sane request timeout). The process-wide default comes from the
+    #: retry policy (rapids.tpu.shuffle.retry.maxReconnects); this
+    #: class attribute is the policy's own fallback.
     MAX_TRANSIENT_RETRIES = 3
     _RETRY_BASE_S = 0.05
 
@@ -202,8 +233,9 @@ class TcpConnection(Connection):
         self._sock: Optional[socket.socket] = None
         self._lock = lockorder.make_lock("shuffle.tcp.client")
         self._connect_timeout = connect_timeout
-        self._max_retries = self.MAX_TRANSIENT_RETRIES \
+        self._max_retries = _retry_policy["max_reconnects"] \
             if max_transient_retries is None else max_transient_retries
+        self._jitter_s = _retry_policy["jitter_ms"] / 1e3
 
     def _ensure(self, timeout: float) -> socket.socket:
         if self._sock is None:
@@ -220,7 +252,13 @@ class TcpConnection(Connection):
         from spark_rapids_tpu.shuffle import fault_injection
 
         with self._lock:
-            if fault_injection.get_injector().should_drop():
+            injector = fault_injection.get_injector()
+            if injector.should_partition_dcn():
+                self._drop()
+                raise TransportError(
+                    f"transport to {self._addr} failed: injected DCN "
+                    f"partition (inter-host link down)")
+            if injector.should_drop():
                 self._drop()
                 raise TransportError(
                     f"transport to {self._addr} failed: injected "
@@ -244,7 +282,10 @@ class TcpConnection(Connection):
         """``_roundtrip`` with bounded exponential backoff on transient
         TransportError. The total wall time (tries + sleeps) is capped
         at the caller's ``timeout`` — a hiccuping peer costs backoff,
-        never more than the budget the caller already signed up for."""
+        never more than the budget the caller already signed up for.
+        Each sleep carries uniform jitter (shuffle.retry.jitterMs) so
+        the fan-in after a DCN blip — every surviving host re-knocking
+        on the same peer — de-synchronizes instead of stampeding."""
         deadline = time.monotonic() + timeout
         backoff = self._RETRY_BASE_S
         attempt = 0
@@ -265,7 +306,10 @@ class TcpConnection(Connection):
                     raise
                 # the failed roundtrip dropped the socket; the sleep
                 # then _ensure() is the backoff + reconnect
-                time.sleep(min(backoff, remaining))
+                sleep = backoff
+                if self._jitter_s:
+                    sleep += random.uniform(0.0, self._jitter_s)
+                time.sleep(min(sleep, remaining))
                 backoff *= 2
 
     def _drop(self):

@@ -121,7 +121,8 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "shuffle.faultInjection": 170,   # transport/worker fault injector
     "utils.dispatch.stage": 172,
     "execs.adaptive.replans": 174,   # replan-event + runtime-stat counters
-    "parallel.spmd.fallbacks": 176,  # fallback-reason counters
+    "parallel.spmd.fallbacks": 176,  # fallback/seam-decision counters
+    "parallel.mesh.fallbacks": 177,  # mesh clamp/topology counters
     "runtime.recovery.stats": 178,   # process-global recovery counters
     "service.streaming.stats": 180,  # process-global fold counters
     "native.kernels.config": 182,    # pallas kernel gate state

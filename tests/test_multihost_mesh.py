@@ -1,0 +1,544 @@
+"""Multi-host mesh topology + elastic membership (round-18 tentpole).
+
+Three layers under test, bottom-up:
+
+- :class:`parallel.mesh.HostTopology` — the explicit ``hosts x (data x
+  model)`` axis map, its ICI/DCN seam classification, and the recorded
+  (never silent) clamps/downgrades ``session_mesh`` applies.
+- the per-seam in-program decision in ``parallel.spmd.in_program_mesh``:
+  a Mesh*Exec subtree shipped whole to one executor keeps its collective
+  ICI in-program even in cluster mode, while exchange lowerings that
+  cross the process boundary take the DCN/TCP path — and every decision
+  lands in the seam telemetry with an exact reason.
+- elastic membership in ``runtime.cluster.ClusterRuntime``: ``add_host``
+  (operator/autoscaler scale-up) and ``remove_host`` (planned
+  decommission driving the PR-15 lineage ladder), plus the
+  host-granularity fault ordinals (``killHostAtStage``,
+  ``partitionDcnAtRequest``) that make host loss a deterministic CPU-CI
+  event.
+
+The differential suite emulates 2 hosts x 4 devices: the driver plus
+two worker processes, each reconstructing a 4-device virtual-CPU mesh
+slice, checked bit-exact against a single-process oracle running the
+SAME mesh shape (same shard_map programs => identical float reduction
+order; a no-mesh oracle only matches to tolerance).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import Session
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.parallel import mesh as pmesh
+from spark_rapids_tpu.parallel import spmd
+from spark_rapids_tpu.parallel.mesh import (HostTopology, data_model_mesh,
+                                            mesh_model_size)
+from spark_rapids_tpu.runtime import recovery
+from spark_rapids_tpu.runtime.cluster import (active_cluster,
+                                              session_cluster,
+                                              shutdown_session_cluster)
+from spark_rapids_tpu.shuffle import fault_injection
+
+MESH_CONF = {
+    "rapids.tpu.mesh.enabled": True,
+    "rapids.tpu.mesh.devices": 4,
+    "rapids.tpu.sql.shuffle.partitions": 4,
+    "rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+}
+
+CLUSTER_CONF = dict(MESH_CONF, **{
+    "rapids.tpu.cluster.enabled": True,
+    "rapids.tpu.cluster.workers": 2,
+    "rapids.tpu.cluster.executors": 1,
+})
+
+JOIN_Q = ("SELECT s.k AS k, count(*) AS n, sum(s.v) AS sv, "
+          "sum(d.w) AS sw FROM sales s JOIN dim d ON s.k = d.id "
+          "GROUP BY s.k ORDER BY s.k")
+GROUPBY_Q = ("SELECT k, count(*) AS n, sum(v) AS sv, min(v) AS mn, "
+             "max(v) AS mx FROM sales GROUP BY k ORDER BY k")
+SORT_Q = "SELECT k, v FROM sales ORDER BY v, k"
+
+
+@pytest.fixture()
+def cluster_teardown():
+    yield
+    shutdown_session_cluster()
+    fault_injection.get_injector().disarm()
+
+
+def _views(s: Session, n=3000) -> None:
+    rng = np.random.default_rng(7)
+    s.create_temp_view("sales", s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.normal(size=n)}))
+        .repartition(3, "k"))
+    s.create_temp_view("dim", s.create_dataframe(pd.DataFrame({
+        "id": np.arange(50, dtype=np.int64),
+        "w": rng.normal(size=50)}))
+        .repartition(2, "id"))
+
+
+def _mesh_oracle(query: str) -> pd.DataFrame:
+    """Single-process oracle with the SAME mesh shape as the cluster
+    session: identical shard_map programs give identical float
+    reduction order, so the differential can demand bit-exactness."""
+    s = Session(dict(MESH_CONF))
+    _views(s)
+    return s.sql(query).collect()
+
+
+# ---------------------------------------------------------------------------
+# HostTopology / mesh construction
+# ---------------------------------------------------------------------------
+
+
+def test_host_topology_axis_math():
+    t = HostTopology(n_hosts=2, devices_per_host=4)
+    assert t.data_per_host == 4
+    assert t.global_data == 8
+    assert t.total_devices == 8
+    assert [t.host_of(i) for i in range(8)] == [0] * 4 + [1] * 4
+    assert t.seam(0, 3) == "ici"
+    assert t.seam(3, 4) == "dcn"
+    assert t.seam(7, 7) == "ici"
+    with pytest.raises(AssertionError):
+        t.host_of(8)
+    assert t.axis_layout() == {"hosts": 2, "data_per_host": 4,
+                               "model": 1, "global_data": 8,
+                               "total_devices": 8}
+
+
+def test_host_topology_model_axis_carves_data():
+    t = HostTopology(n_hosts=2, devices_per_host=4, model=2)
+    assert t.data_per_host == 2
+    assert t.global_data == 4
+    assert t.total_devices == 8
+    assert t.seam(1, 2) == "dcn"  # host 0 holds data slots 0..1 only
+
+
+def test_data_model_mesh_axes():
+    m = data_model_mesh(2, 2)
+    assert m.axis_names == (pmesh.DATA_AXIS, pmesh.MODEL_AXIS)
+    assert m.shape[pmesh.DATA_AXIS] == 2
+    assert m.shape[pmesh.MODEL_AXIS] == 2
+    assert mesh_model_size(m) == 2
+    # model=1 stays the plain 1-D data mesh (shard_map cache identity)
+    m1 = data_model_mesh(4, 1)
+    assert m1.axis_names == (pmesh.DATA_AXIS,)
+    assert mesh_model_size(m1) == 1
+
+
+def test_session_mesh_clamp_is_recorded_not_silent():
+    import jax
+
+    avail = len(jax.devices())
+    want = avail + 56
+    pre = pmesh.mesh_fallback_snapshot()
+    m = pmesh.session_mesh(RapidsConf({
+        "rapids.tpu.mesh.enabled": True,
+        "rapids.tpu.mesh.devices": want}))
+    assert m is not None and m.devices.size == avail
+    delta = pmesh.mesh_fallback_delta(pre)
+    key = (f"rapids.tpu.mesh.devices={want} exceeds the attached "
+           f"backend ({avail} devices): clamped to {avail}")
+    assert delta == {key: 1}, delta
+
+
+def test_session_mesh_drops_starved_model_axis():
+    pre = pmesh.mesh_fallback_snapshot()
+    m = pmesh.session_mesh(RapidsConf({
+        "rapids.tpu.mesh.enabled": True,
+        "rapids.tpu.mesh.devices": 4,
+        "rapids.tpu.mesh.modelDevices": 4}))
+    # 4 devices / model=4 leaves 1 data device: axis dropped, recorded
+    assert m is not None and mesh_model_size(m) == 1
+    assert m.shape[pmesh.DATA_AXIS] == 4
+    (reason,) = pmesh.mesh_fallback_delta(pre)
+    assert reason == ("rapids.tpu.mesh.modelDevices=4 leaves fewer "
+                      "than 2 data devices out of 4: model axis "
+                      "dropped")
+
+
+def test_session_mesh_carves_model_axis():
+    m = pmesh.session_mesh(RapidsConf({
+        "rapids.tpu.mesh.enabled": True,
+        "rapids.tpu.mesh.devices": 8,
+        "rapids.tpu.mesh.modelDevices": 2}))
+    assert m is not None
+    assert m.shape[pmesh.DATA_AXIS] == 4
+    assert mesh_model_size(m) == 2
+
+
+def test_session_topology_counts_cluster_hosts():
+    t = pmesh.session_topology(RapidsConf(dict(CLUSTER_CONF)))
+    assert t is not None
+    assert t.n_hosts == 3  # driver + 2 workers
+    assert t.devices_per_host == 4
+    # explicit host count wins over inference
+    t2 = pmesh.session_topology(RapidsConf(dict(
+        CLUSTER_CONF, **{"rapids.tpu.mesh.hosts": 2})))
+    assert t2 is not None and t2.n_hosts == 2
+    assert pmesh.session_topology(RapidsConf(
+        {"rapids.tpu.mesh.enabled": False})) is None
+
+
+# ---------------------------------------------------------------------------
+# per-seam in-program decision + seam telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_seam_single_host_records_ici():
+    pre = spmd.seam_snapshot()
+    m = spmd.in_program_mesh(RapidsConf(dict(MESH_CONF)), "join")
+    assert m is not None
+    delta = spmd.seam_delta(pre)
+    assert delta == {
+        "join: ici: single host: no DCN seam in session": 1}, delta
+
+
+def test_seam_cluster_local_stays_ici_in_program():
+    """The per-seam decision replacing the all-or-nothing cluster gate:
+    a host-local Mesh*Exec subtree keeps its collective in-program even
+    with cluster mode on."""
+    pre = spmd.seam_snapshot()
+    conf = RapidsConf(dict(CLUSTER_CONF))
+    m = spmd.in_program_mesh(conf, "groupby", cluster_local=True)
+    assert m is not None, "cluster_local seam must stay ICI in-program"
+    delta = spmd.seam_delta(pre)
+    assert delta == {"groupby: ici: intra-host slice: collective "
+                     "spans one process's devices": 1}, delta
+
+
+def test_seam_cluster_exchange_takes_dcn():
+    pre = spmd.seam_snapshot()
+    pre_fb = spmd.fallback_snapshot()
+    conf = RapidsConf(dict(CLUSTER_CONF))
+    assert spmd.in_program_mesh(conf, "exchange") is None
+    assert spmd.seam_delta(pre) == {
+        "exchange: dcn: inter-host exchange: blocks cross the process "
+        "boundary, TCP carries the DCN seam": 1}
+    # the legacy fallback reason is preserved alongside the seam record
+    fb = spmd.fallback_delta(pre_fb)
+    assert fb == {"exchange: cross-host DCN: cluster mode shuffles "
+                  "over TCP (shuffle/tcp.py)": 1}, fb
+
+
+def test_seam_intra_host_ici_opt_out_restores_blanket_gate():
+    pre = spmd.seam_snapshot()
+    conf = RapidsConf(dict(CLUSTER_CONF, **{
+        "rapids.tpu.shuffle.seam.intraHostIci.enabled": False}))
+    assert spmd.in_program_mesh(conf, "sort", cluster_local=True) is None
+    assert spmd.seam_delta(pre) == {
+        "sort: dcn: intra-host ICI disabled by "
+        "rapids.tpu.shuffle.seam.intraHostIci.enabled": 1}
+
+
+def test_model_axis_gates_in_program_shuffle():
+    pre = spmd.fallback_snapshot()
+    conf = RapidsConf(dict(MESH_CONF, **{
+        "rapids.tpu.mesh.devices": 8,
+        "rapids.tpu.mesh.modelDevices": 2}))
+    assert spmd.in_program_mesh(conf, "join") is None
+    (reason,) = spmd.fallback_delta(pre)
+    assert reason == ("join: model-parallel axis active: in-program "
+                      "shuffle rides the data axis only")
+
+
+# ---------------------------------------------------------------------------
+# emulated 2-host x 4-device differential suite
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("query", [JOIN_Q, GROUPBY_Q, SORT_Q],
+                         ids=["hash_join", "group_by", "sort"])
+def test_multihost_differential_bit_exact(query, cluster_teardown):
+    """join / group-by / sort over driver + 2 worker processes (each a
+    4-device virtual mesh slice), bit-exact against the single-process
+    same-mesh oracle — ``DataFrame.equals``, not approximate compare:
+    identical shard_map programs must give identical bits."""
+    oracle = _mesh_oracle(query)
+    s = Session(dict(CLUSTER_CONF))
+    _views(s)
+    got = s.sql(query).collect()
+    assert got.equals(oracle), "cluster result diverged from the " \
+        "same-mesh single-process oracle"
+    runtime = session_cluster(s.conf)
+    assert runtime is not None and len(runtime.workers) == 2
+
+
+def test_multihost_seam_decisions_recorded(cluster_teardown):
+    """One cluster join: the seam telemetry must hold BOTH sides of the
+    per-seam decision — DCN records for every materialized cluster
+    exchange, ICI records for the host-local mesh subtrees — with the
+    exact reason strings the docs promise."""
+    s = Session(dict(CLUSTER_CONF))
+    _views(s)
+    pre = spmd.seam_snapshot()
+    got = s.sql(JOIN_Q).collect()
+    assert len(got) == 50
+    delta = spmd.seam_delta(pre)
+    dcn_key = ("exchange: dcn: cluster exchange: map outputs cross "
+               "the host boundary over TCP")
+    # >= 2 materialized cluster exchanges: the mesh lowering absorbs
+    # one join side into the shipped subtree, the rest cross the seam
+    assert delta.get(dcn_key, 0) >= 2, delta
+    ici = {k: n for k, n in delta.items() if ": ici: " in k}
+    assert ici, f"no ICI seam decision recorded: {delta}"
+    assert all(("intra-host slice: collective spans one process's "
+                "devices") in k or "single host" in k
+               for k in ici), ici
+
+
+# ---------------------------------------------------------------------------
+# elastic membership: add/remove/kill hosts
+# ---------------------------------------------------------------------------
+
+
+def test_add_and_remove_host_drive_recovery_ladder(cluster_teardown):
+    """Scale-up then planned decommission mid-session: the new slot
+    takes placements, the removed slot's map outputs re-run through the
+    PR-15 lineage ladder (invalidate -> re-run exactly the lost maps),
+    queries before/between/after stay bit-exact, and the recovery
+    counters tell the story."""
+    oracle = _mesh_oracle(JOIN_Q)
+    s = Session(dict(CLUSTER_CONF))
+    _views(s)
+    assert s.sql(JOIN_Q).collect().equals(oracle)
+
+    rt = active_cluster()
+    assert rt is not None
+    pre = recovery.snapshot()
+
+    eid = rt.add_host(reason="test scale-up")
+    assert eid == "exec-worker-2"
+    assert sorted(rt.live_worker_slots()) == [
+        "exec-worker-0", "exec-worker-1", "exec-worker-2"]
+    assert s.sql(JOIN_Q).collect().equals(oracle)
+
+    rerun = rt.remove_host("exec-worker-0", reason="test scale-down")
+    assert rerun, "decommission re-ran no maps: the removed slot " \
+        "held registered output"
+    assert sorted(rt.live_worker_slots()) == [
+        "exec-worker-1", "exec-worker-2"]
+    # decommission is NOT a fault: no blacklist entry, no respawn
+    assert "exec-worker-0" in rt.decommissioned
+    assert s.sql(JOIN_Q).collect().equals(oracle)
+
+    delta = recovery.delta(pre)
+    assert delta["hosts_added"] == 1
+    assert delta["hosts_removed"] == 1
+    assert delta["maps_rerun"] >= len(rerun)
+    assert delta["executors_blacklisted"] == 0
+    assert delta["workers_respawned"] == 0
+    actions = [e["action"] for e in rt.scale_events]
+    assert actions == ["add", "remove"]
+
+
+def test_kill_host_at_stage_recovers_bit_exact(cluster_teardown):
+    """Deterministic host loss: ``killHostAtStage=4`` SIGKILLs the
+    output-owning worker at the fourth stage boundary — the final
+    exchange's reduce entry, when every map output is registered, the
+    worst moment to lose a host. Recovery must discover the death
+    organically (fetch failures), respawn the slot, re-run its maps,
+    and still produce the bit-exact answer."""
+    oracle = _mesh_oracle(JOIN_Q)
+    pre = recovery.snapshot()
+    s = Session(dict(CLUSTER_CONF))
+    _views(s)
+    fault_injection.arm_from_conf(RapidsConf({
+        "rapids.tpu.shuffle.faultInjection.enabled": True,
+        "rapids.tpu.shuffle.faultInjection.killHostAtStage": 4}))
+    try:
+        got = s.sql(JOIN_Q).collect()
+        stats = fault_injection.get_injector().stats()
+    finally:
+        fault_injection.get_injector().disarm()
+    assert got.equals(oracle)
+    assert stats["host_kills"] == 1, stats
+    delta = recovery.delta(pre)
+    assert delta["workers_respawned"] >= 1, delta
+    assert delta["maps_rerun"] >= 1, delta
+
+
+def test_partition_dcn_at_request_retries_through(cluster_teardown):
+    """A transient DCN partition (a burst of injected transport
+    failures on the inter-host link) resolves through the transport
+    retry + stage-retry ladder, bit-exact, with the partition counted
+    once in the recovery stats."""
+    oracle = _mesh_oracle(JOIN_Q)
+    pre = recovery.snapshot()
+    s = Session(dict(CLUSTER_CONF))
+    _views(s)
+    fault_injection.arm_from_conf(RapidsConf({
+        "rapids.tpu.shuffle.faultInjection.enabled": True,
+        "rapids.tpu.shuffle.faultInjection.partitionDcnAtRequest": 3,
+        "rapids.tpu.shuffle.faultInjection.consecutive": 2}))
+    try:
+        got = s.sql(JOIN_Q).collect()
+        stats = fault_injection.get_injector().stats()
+    finally:
+        fault_injection.get_injector().disarm()
+    assert got.equals(oracle)
+    assert stats["dcn_partitions"] == 1, stats
+    assert stats["dcn_drops"] >= 2, stats
+    assert recovery.delta(pre).get("dcn_partitions", 0) == 1
+
+
+def test_injector_host_and_dcn_ordinals_are_deterministic():
+    inj = fault_injection.ShuffleFaultInjector()
+    inj.arm(kill_host_at_stage=2)
+    assert [inj.should_kill_host_at_stage() for _ in range(4)] == \
+        [False, True, False, False]
+    inj.arm(partition_dcn_at_request=3, consecutive=2)
+    assert [inj.should_partition_dcn() for _ in range(5)] == \
+        [False, False, True, True, False]
+    assert inj.stats()["dcn_partitions"] == 1
+    inj.disarm()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+
+def _autoscale_conf(**extra):
+    return RapidsConf(dict({
+        "rapids.tpu.cluster.enabled": True,
+        "rapids.tpu.cluster.autoscale.enabled": True,
+        "rapids.tpu.cluster.autoscale.queueDepthHigh": 2,
+        "rapids.tpu.cluster.autoscale.maxWorkers": 3,
+        "rapids.tpu.cluster.autoscale.cooldownSec": 0.0,
+    }, **extra))
+
+
+class _FakeRuntime:
+    def __init__(self, slots=1):
+        self._slots = ["exec-worker-%d" % i for i in range(slots)]
+        self.added = []
+
+    def live_worker_slots(self):
+        return list(self._slots)
+
+    def add_host(self, reason=""):
+        eid = "exec-worker-%d" % len(self._slots)
+        self._slots.append(eid)
+        self.added.append(reason)
+        return eid
+
+
+def test_autoscaler_fires_on_queue_pressure(monkeypatch):
+    from spark_rapids_tpu.runtime import cluster as rc
+    from spark_rapids_tpu.service.autoscaler import ClusterAutoscaler
+
+    fake = _FakeRuntime(slots=1)
+    monkeypatch.setattr(rc, "active_cluster", lambda: fake)
+    a = ClusterAutoscaler(_autoscale_conf())
+    assert a.observe(queue_depth=1, inflight=0) is None  # below high
+    eid = a.observe(queue_depth=4, inflight=1)
+    assert eid == "exec-worker-1"
+    assert a.scale_ups == 1
+    assert "queue depth 4 >= 2" in a.last_reason
+    assert fake.added == ["autoscaler: queue depth 4 >= 2 with 1 "
+                          "inflight"]
+    # grows to the ceiling, then refuses
+    assert a.observe(queue_depth=9, inflight=0) == "exec-worker-2"
+    assert a.observe(queue_depth=9, inflight=0) is None  # at max 3
+    assert a.scale_ups == 2
+
+
+def test_autoscaler_cooldown_and_gates(monkeypatch):
+    from spark_rapids_tpu.runtime import cluster as rc
+    from spark_rapids_tpu.service.autoscaler import ClusterAutoscaler
+
+    fake = _FakeRuntime(slots=1)
+    monkeypatch.setattr(rc, "active_cluster", lambda: fake)
+    a = ClusterAutoscaler(_autoscale_conf(**{
+        "rapids.tpu.cluster.autoscale.cooldownSec": 3600.0}))
+    assert a.observe(queue_depth=5, inflight=0) is not None
+    assert a.observe(queue_depth=50, inflight=0) is None  # in cooldown
+    assert a.scale_ups == 1
+    # disabled without cluster mode: autoscale extends membership, it
+    # never creates it
+    off = ClusterAutoscaler(RapidsConf({
+        "rapids.tpu.cluster.autoscale.enabled": True}))
+    assert not off.enabled
+    assert off.observe(queue_depth=99, inflight=0) is None
+    # no active cluster runtime -> no-op even when enabled
+    monkeypatch.setattr(rc, "active_cluster", lambda: None)
+    a2 = ClusterAutoscaler(_autoscale_conf())
+    assert a2.observe(queue_depth=99, inflight=0) is None
+
+
+# ---------------------------------------------------------------------------
+# tcp retry policy (jitter + reconnect cap)
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_retry_policy_from_conf():
+    from spark_rapids_tpu.shuffle import tcp
+
+    before = dict(tcp._retry_policy)
+    try:
+        tcp.configure_retry_from_conf(RapidsConf({
+            "rapids.tpu.shuffle.retry.maxReconnects": 5,
+            "rapids.tpu.shuffle.retry.jitterMs": 25}))
+        assert tcp._retry_policy == {"max_reconnects": 5,
+                                     "jitter_ms": 25}
+    finally:
+        tcp.configure_retry(**before)
+
+
+def test_tcp_connection_picks_up_policy():
+    from spark_rapids_tpu.shuffle import tcp
+
+    before = dict(tcp._retry_policy)
+    try:
+        tcp.configure_retry(max_reconnects=7, jitter_ms=40)
+        conn = tcp.TcpConnection("127.0.0.1", 1)
+        assert conn._max_retries == 7
+        assert conn._jitter_s == pytest.approx(0.040)
+        # explicit constructor arg still wins over the policy
+        conn2 = tcp.TcpConnection("127.0.0.1", 1,
+                                  max_transient_retries=2)
+        assert conn2._max_retries == 2
+    finally:
+        tcp.configure_retry(**before)
+
+
+# ---------------------------------------------------------------------------
+# runner surfaces mesh fallbacks + seam decisions
+# ---------------------------------------------------------------------------
+
+
+def test_runner_embeds_mesh_and_seam_telemetry(tmp_path):
+    """The runner JSON carries ``mesh_fallbacks`` and ``seam_decisions``
+    next to ``shuffle_fallbacks`` — satellite 1's 'surfaced, not
+    silent' contract for the session_mesh clamp. Subprocess because
+    dispatch telemetry must install before the compute modules import
+    (same constraint as the dispatch-budget fence)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {root!r})\n"
+        "from spark_rapids_tpu.utils import dispatch as disp\n"
+        "disp.install()\n"
+        "from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner\n"
+        f"r = BenchmarkRunner({str(tmp_path)!r}, 0.01)\n"
+        "rec = r.run('tpch_q6', iterations=1, warmup=0)\n"
+        "tel = rec['dispatch_telemetry']\n"
+        "print(json.dumps(sorted(tel)))\n")
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stderr[-3000:]
+    keys = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "mesh_fallbacks" in keys, keys
+    assert "seam_decisions" in keys, keys
+    assert "shuffle_fallbacks" in keys, keys
