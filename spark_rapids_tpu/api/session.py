@@ -183,10 +183,19 @@ class Session:
         return the StreamTableSource. Feed it with ``append_batch``;
         register continuous aggregations over it with
         ``service.register_standing``."""
+        from spark_rapids_tpu import config as cfg
         from spark_rapids_tpu.service.streaming.source import \
             StreamTableSource
 
         src = StreamTableSource(name, schema)
+        if str(self.conf.get(cfg.STREAMING_CHECKPOINT_DIR)
+               or "").strip():
+            # durability (PR 19): replay the table's WAL and route
+            # future appends through it — BEFORE the view registers,
+            # so batch queries see recovered rows from the first scan.
+            # The knob check keeps the lazy `service` property lazy for
+            # non-durable sessions.
+            self.service.streaming.attach_source(src)
         self.create_temp_view(name, src)
         return src
 

@@ -590,10 +590,37 @@ CLUSTER_AUTOSCALE_QUEUE_HIGH = conf(
 
 CLUSTER_AUTOSCALE_COOLDOWN_SEC = conf(
     "rapids.tpu.cluster.autoscale.cooldownSec").doc(
-    "Minimum seconds between autoscaler scale-ups, so one burst does "
-    "not spawn a host per queued query before the first new host "
-    "drains anything."
+    "Minimum seconds between autoscaler scale events (up or down), so "
+    "one burst does not spawn a host per queued query before the first "
+    "new host drains anything — and a scale-down cannot immediately "
+    "chase a scale-up."
 ).double_conf.create_with_default(30.0)
+
+CLUSTER_AUTOSCALE_QUEUE_LOW = conf(
+    "rapids.tpu.cluster.autoscale.queueDepthLow").doc(
+    "Scale-DOWN watermark: with the cluster idle — admission queue "
+    "depth at or below this value AND zero inflight queries — "
+    "sustained for autoscale.idleSec (and past the shared cooldown), "
+    "the autoscaler retires one worker host through "
+    "ClusterRuntime.remove_host: the SAME planned-decommission seam "
+    "operators use (slot generations killed, its map outputs "
+    "invalidated, lost maps re-run through the lineage ladder), never "
+    "below autoscale.minWorkers. -1 (default) disables scale-down."
+).int_conf.create_with_default(-1)
+
+CLUSTER_AUTOSCALE_MIN_WORKERS = conf(
+    "rapids.tpu.cluster.autoscale.minWorkers").doc(
+    "Floor on live worker hosts the autoscaler may shrink to "
+    "(counting distinct live slots); scale-downs stop at this size."
+).int_conf.create_with_default(1)
+
+CLUSTER_AUTOSCALE_IDLE_SEC = conf(
+    "rapids.tpu.cluster.autoscale.idleSec").doc(
+    "Seconds the idle condition (queue depth <= queueDepthLow, zero "
+    "inflight) must hold continuously before a scale-down fires — a "
+    "gap between dashboard refreshes must not decommission a host "
+    "the next refresh needs."
+).double_conf.create_with_default(60.0)
 
 SHUFFLE_FI_ENABLED = conf(
     "rapids.tpu.shuffle.faultInjection.enabled").doc(
@@ -679,6 +706,39 @@ SHUFFLE_FI_PARTITION_DCN_AT = conf(
     "transport retry budget to escalate the partition into a fetch "
     "failure and a stage retry. Each distinct partition event bumps the "
     "dcn_partitions recovery counter."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_CRASH_AT_FOLD = conf(
+    "rapids.tpu.shuffle.faultInjection.crashAtFold").doc(
+    "SIGKILL the CURRENT process at the start of the Nth standing-"
+    "query fold (counted from 1 across the process; 0 disables) — "
+    "after the delta's WAL record is durable, before the running "
+    "state swaps. The hard-crash half of the streaming durability "
+    "fence (scripts/stream_durability_check.py): a restarted service "
+    "must recover the standing query from its latest checkpoint plus "
+    "the WAL suffix, bit-exact, folding the interrupted delta exactly "
+    "once."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_TORN_CHECKPOINT_AT = conf(
+    "rapids.tpu.shuffle.faultInjection.tornCheckpointAt").doc(
+    "Tear the Nth streaming checkpoint commit (counted from 1; 0 "
+    "disables): only the first half of the checkpoint bytes reach the "
+    "final file name, modeling a crash mid-write that beat the atomic "
+    "rename. Recovery must reject it on CRC (torn_rejected counter), "
+    "fall back to an older checkpoint or — with "
+    "faultInjection.consecutive large enough to tear EVERY checkpoint "
+    "— to a full WAL-only refold, still bit-exact."
+).int_conf.create_with_default(0)
+
+SHUFFLE_FI_TRUNCATE_WAL_AT = conf(
+    "rapids.tpu.shuffle.faultInjection.truncateWalAt").doc(
+    "Write only half of the Nth WAL record's bytes (counted from 1; 0 "
+    "disables), modeling a crash mid-append. Replay must tolerate the "
+    "torn TAIL record — truncate it, count it in torn_rejected, and "
+    "recover every record before it; mid-log corruption (valid "
+    "records AFTER a bad CRC) is a loud WalCorruptionError instead, "
+    "never silent data loss."
 ).int_conf.create_with_default(0)
 
 SHUFFLE_IN_PROGRAM = conf("rapids.tpu.shuffle.inProgram.enabled").doc(
@@ -1184,6 +1244,74 @@ STREAMING_LATE_POLICY = conf("rapids.tpu.streaming.lateData.policy").doc(
     "the update launch. Per-registration override: the late_policy "
     "argument of register_standing."
 ).string_conf.create_with_default("merge")
+
+STREAMING_CHECKPOINT_DIR = conf(
+    "rapids.tpu.streaming.checkpoint.dir").doc(
+    "Root directory of the streaming durability layer "
+    "(service/streaming/durability.py). Set, every "
+    "StreamTableSource.append persists its validated delta to a "
+    "CRC-framed per-table write-ahead log BEFORE any standing query "
+    "folds it, and every standing query checkpoints its running "
+    "(keys..., partials...) state + watermark + sequence cursor at "
+    "fold boundaries into atomically-renamed, CRC'd checkpoint files "
+    "under the same root. A restarted service recovers through "
+    "StreamingManager.recover(): latest valid checkpoint + WAL-suffix "
+    "replay past its cursor = fold-exactly-once; no valid checkpoint "
+    "falls back to a full refold from the WAL. Empty (default) "
+    "disables durability — streaming state is process-memory only, "
+    "as before PR 19."
+).string_conf.create_with_default("")
+
+STREAMING_CHECKPOINT_INTERVAL = conf(
+    "rapids.tpu.streaming.checkpoint.intervalFolds").doc(
+    "Checkpoint a standing query's state every N folds (counted per "
+    "query). 1 (default) checkpoints at every fold boundary — the "
+    "tightest recovery point; larger values trade restart replay "
+    "length (up to N-1 WAL deltas refold) for less checkpoint I/O. "
+    "Values < 1 clamp to 1."
+).int_conf.create_with_default(1)
+
+STREAMING_CHECKPOINT_RETAIN = conf(
+    "rapids.tpu.streaming.checkpoint.retain").doc(
+    "Checkpoint files kept per standing query; older ones are pruned "
+    "after each successful write. Keeping >= 2 means a checkpoint torn "
+    "by a crash mid-write still leaves the previous valid one to "
+    "recover from (recovery tries newest to oldest, counting rejects "
+    "in the torn_rejected streaming counter). Values < 1 clamp to 1."
+).int_conf.create_with_default(2)
+
+STREAMING_CHECKPOINT_WAL_SYNC = conf(
+    "rapids.tpu.streaming.checkpoint.walSyncEvery").doc(
+    "fsync the ingest write-ahead log every N appended records. 1 "
+    "(default) syncs every append — an acknowledged ingest is durable "
+    "before any fold sees it; larger values batch the fsync cost "
+    "across appends at the price of the unsynced tail being lost on "
+    "power failure (process crash alone loses nothing: the bytes are "
+    "already in the page cache). Unsynced WAL bytes are charged to "
+    "admission via the service's extra_bytes_fn."
+).int_conf.create_with_default(1)
+
+STREAMING_CHECKPOINT_ASYNC = conf(
+    "rapids.tpu.streaming.checkpoint.asyncWrite.enabled").doc(
+    "Write checkpoint files on the shared async batch-writer template "
+    "(memory/catalog.py AsyncBatchWriter — the PR 6 double-buffered "
+    "spill writer generalized): the fold returns while the serialized "
+    "snapshot commits in the background, with the bounded queue as "
+    "backpressure and pending bytes charged to admission. Disabled, "
+    "checkpoints commit inline at the fold boundary (deterministic — "
+    "what the durability unit tests use)."
+).boolean_conf.create_with_default(True)
+
+STREAMING_CHECKPOINT_ON_SIGTERM = conf(
+    "rapids.tpu.streaming.checkpoint.onSigterm").doc(
+    "With durability enabled, install a SIGTERM handler (main thread "
+    "only) that checkpoint-then-drains the service instead of letting "
+    "the default handler kill standing queries mid-fold: every live "
+    "standing query writes a final checkpoint and suspends, then the "
+    "previously-installed handler (if any) runs. SIGKILL needs no "
+    "handler — that is what the WAL + checkpoint recovery path is "
+    "for."
+).boolean_conf.create_with_default(True)
 
 SERVICE_CACHE_TTL = conf("rapids.tpu.service.cache.ttlSec").doc(
     "Time-to-live in seconds for cache entries: an entry older than "

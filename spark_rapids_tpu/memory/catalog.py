@@ -101,34 +101,53 @@ def current_buffer_owner():
     return getattr(_owner_tls, "owner", None)
 
 
-class _AsyncSpillWriter:
-    """Double-buffered host->disk eviction (mirrors PR 1's upload
-    pipeline, inverted): the caller keeps computing while a single
-    writer thread serializes+compresses+commits victims. The bounded
-    queue (depth 2) is the double buffer — one victim in flight, one
-    staged — and doubles as backpressure: a spill storm blocks the
-    submitter instead of queueing unbounded host batches."""
+class AsyncBatchWriter:
+    """Bounded-queue single-thread async commit template (the PR 6
+    double-buffered spill writer, generalized): the caller keeps
+    computing while one writer thread processes submitted items. The
+    bounded queue (depth 2 by default) is the double buffer — one item
+    in flight, one staged — and doubles as backpressure: a storm of
+    submissions blocks the submitter instead of queueing unbounded
+    host memory. Subclasses implement ``_process`` (writer-thread
+    body) and may override ``_on_error`` (must not raise); the
+    host->disk spill path and the streaming checkpoint writer
+    (service/streaming/durability.py) are the two instantiations."""
 
     _STOP = object()
 
-    def __init__(self, catalog: "BufferCatalog", depth: int = 2):
-        self._catalog = catalog
+    def __init__(self, cv: "threading.Condition", thread_name: str,
+                 depth: int = 2):
+        # the subclass makes the condition with a LITERAL lockorder
+        # name at its own site, so the hierarchy stays statically
+        # checkable (tpulint TPU303)
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._pending = 0
-        self._cv = lockorder.make_condition("memory.catalog.spillWriter")
+        self._cv = cv
         self._thread: Optional[threading.Thread] = None
+        self._thread_name = thread_name
+
+    def _process(self, item) -> None:
+        raise NotImplementedError
+
+    def _on_error(self, item, exc: BaseException) -> None:
+        log.exception("async writer %s failed processing %r",
+                      self._thread_name, item)
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
-                target=self._loop, name="srt-spill-writer", daemon=True)
+                target=self._loop, name=self._thread_name, daemon=True)
             self._thread.start()
 
-    def submit(self, entry: "_Entry") -> None:
+    def submit(self, item) -> None:
         with self._cv:
             self._pending += 1
             self._ensure_thread()
-        self._q.put(entry)  # blocks at depth: the backpressure point
+        self._q.put(item)  # blocks at depth: the backpressure point
+
+    def pending(self) -> int:
+        with self._cv:
+            return self._pending
 
     def _loop(self) -> None:
         while True:
@@ -136,26 +155,24 @@ class _AsyncSpillWriter:
             if e is self._STOP:
                 return
             try:
-                self._catalog._finish_async_spill(e)
-            except Exception:  # noqa: BLE001 - must not kill the writer
-                log.exception("async host->disk spill of buffer %d "
-                              "failed; entry stays on the host tier",
-                              e.buffer_id)
+                self._process(e)
+            except Exception as exc:  # noqa: BLE001 - must not kill the writer
+                self._on_error(e, exc)
             finally:
                 with self._cv:
                     self._pending -= 1
                     self._cv.notify_all()
 
     def drain(self) -> None:
-        """Block until every submitted spill committed (or aborted)."""
+        """Block until every submitted item committed (or aborted)."""
         with self._cv:
             while self._pending:
                 self._cv.wait()
 
     def stop(self) -> None:
         """Drain, then end the writer thread — without this the parked
-        queue.get() would pin the thread (and its catalog reference)
-        for the life of the process."""
+        queue.get() would pin the thread (and whatever the subclass
+        references) for the life of the process."""
         self.drain()
         with self._cv:
             t = self._thread
@@ -163,6 +180,25 @@ class _AsyncSpillWriter:
             return
         self._q.put(self._STOP)
         t.join(timeout=5.0)
+
+
+class _AsyncSpillWriter(AsyncBatchWriter):
+    """Double-buffered host->disk eviction (mirrors PR 1's upload
+    pipeline, inverted): victims are catalog entries; processing is
+    the same serialize+compress+commit as the inline spill path."""
+
+    def __init__(self, catalog: "BufferCatalog", depth: int = 2):
+        super().__init__(
+            lockorder.make_condition("memory.catalog.spillWriter"),
+            "srt-spill-writer", depth)
+        self._catalog = catalog
+
+    def _process(self, entry: "_Entry") -> None:
+        self._catalog._finish_async_spill(entry)
+
+    def _on_error(self, entry: "_Entry", exc: BaseException) -> None:
+        log.exception("async host->disk spill of buffer %d failed; "
+                      "entry stays on the host tier", entry.buffer_id)
 
 
 class BufferCatalog:

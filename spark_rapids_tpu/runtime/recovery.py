@@ -27,7 +27,12 @@ _KEYS = ("fetch_failures", "maps_rerun", "workers_respawned",
          # joining or leaving mid-query is a recovery event here, not
          # an outage — counted in the same block the runner/service
          # already surface
-         "hosts_added", "hosts_removed", "dcn_partitions")
+         "hosts_added", "hosts_removed", "dcn_partitions",
+         # streaming durability (PR 19): a standing query's state
+         # restored from checkpoint + WAL replay after a restart or a
+         # recoverable in-fold fault — the streaming tier's analogue
+         # of maps_rerun
+         "streaming_restores")
 
 _counters: Dict[str, int] = {k: 0 for k in _KEYS}
 

@@ -1,4 +1,4 @@
-"""Queue-pressure autoscaler: scale-up as a recovery event.
+"""Queue-pressure autoscaler: scale-up AND scale-down as recovery events.
 
 Watches the admission queue from the service's pump and, under
 sustained pressure, invokes ``ClusterRuntime.add_host`` — the SAME
@@ -8,11 +8,22 @@ transport, and the next task placement can target it. No separate
 deployment path, no stage pause; the only difference from recovery is
 who asked.
 
-The observer runs under the service lock (rank 20) and the scale-up
-takes the cluster recover lock (rank 50) — the same outer-to-inner
-direction every service-to-runtime call already follows. Spawning a
-process under the service lock is bounded by the cooldown and the
-worker ceiling, and costs far less than the queued work it unblocks.
+Scale-DOWN (PR 19) is the mirror image through the mirror seam: when
+the queue sits at-or-below ``queueDepthLow`` with nothing inflight for
+``idleSec`` straight (and the cooldown since the last scale event — in
+either direction — has passed), the newest worker is decommissioned
+through ``ClusterRuntime.remove_host``, the planned-removal path PR 18
+built: its slot generations die, its map outputs invalidate, anything
+a straggling query still needs re-runs via lineage. The floor is
+``minWorkers``; disabled entirely while ``queueDepthLow`` is negative
+(the default), so existing deployments keep today's grow-only shape.
+
+The observer runs under the service lock (rank 20) and the scale
+actions take the cluster recover lock (rank 50) — the same
+outer-to-inner direction every service-to-runtime call already
+follows. Spawning a process under the service lock is bounded by the
+cooldown and the worker ceiling, and costs far less than the queued
+work it unblocks.
 """
 from __future__ import annotations
 
@@ -23,32 +34,59 @@ from spark_rapids_tpu import config as cfg
 
 
 class ClusterAutoscaler:
-    """Decides, per admission pump, whether the cluster should grow.
+    """Decides, per admission pump, whether the cluster should grow or
+    shrink.
 
     NOT thread-safe on its own: the service calls ``observe`` under its
-    lock, which is the only writer."""
+    lock, which is the only writer. Idle time is measured across
+    observations, and observations only happen on pumps (submit and
+    release) — a fully quiescent service shrinks on its NEXT pump after
+    the idle window, not on a timer."""
 
     def __init__(self, conf):
         self.enabled = bool(conf.get(cfg.CLUSTER_AUTOSCALE_ENABLED)
                             and conf.get(cfg.CLUSTER_ENABLED))
         self.queue_high = max(
             conf.get(cfg.CLUSTER_AUTOSCALE_QUEUE_HIGH), 1)
+        #: negative = scale-down disabled (the default)
+        self.queue_low = conf.get(cfg.CLUSTER_AUTOSCALE_QUEUE_LOW)
         self.max_workers = max(
             conf.get(cfg.CLUSTER_AUTOSCALE_MAX_WORKERS), 1)
+        self.min_workers = max(
+            conf.get(cfg.CLUSTER_AUTOSCALE_MIN_WORKERS), 1)
         self.cooldown_s = max(
             conf.get(cfg.CLUSTER_AUTOSCALE_COOLDOWN_SEC), 0.0)
+        self.idle_s = max(conf.get(cfg.CLUSTER_AUTOSCALE_IDLE_SEC), 0.0)
         self.scale_ups = 0
+        self.scale_downs = 0
         self.last_reason = ""
         self.last_executor_id = ""
+        self.last_removed_executor_id = ""
         self._last_at: Optional[float] = None
+        self._idle_since: Optional[float] = None
 
     def observe(self, queue_depth: int, inflight: int) -> Optional[str]:
-        """One pressure observation; returns the new executor id when a
-        scale-up fired, else None. Grows only a cluster the session
-        already runs (runtime.cluster.active_cluster) — the autoscaler
-        never CREATES membership, it extends it."""
-        if not self.enabled or queue_depth < self.queue_high:
+        """One observation; returns the new executor id when a
+        scale-UP fired, else None (scale-downs report through
+        ``scale_downs``/``last_removed_executor_id``). Only ever
+        resizes a cluster the session already runs
+        (runtime.cluster.active_cluster) — the autoscaler never CREATES
+        membership, it extends or trims it."""
+        if not self.enabled:
             return None
+        if queue_depth >= self.queue_high:
+            self._idle_since = None
+            return self._maybe_scale_up(queue_depth, inflight)
+        if self.queue_low >= 0 and queue_depth <= self.queue_low \
+                and inflight == 0:
+            self._maybe_scale_down(queue_depth)
+        else:
+            # neither pressured nor idle: the idle window restarts
+            self._idle_since = None
+        return None
+
+    def _maybe_scale_up(self, queue_depth: int,
+                        inflight: int) -> Optional[str]:
         now = time.monotonic()
         if self._last_at is not None and \
                 now - self._last_at < self.cooldown_s:
@@ -75,11 +113,52 @@ class ClusterAutoscaler:
         self._last_at = now
         return eid
 
+    def _maybe_scale_down(self, queue_depth: int) -> None:
+        now = time.monotonic()
+        if self._idle_since is None:
+            self._idle_since = now
+            return
+        if now - self._idle_since < self.idle_s:
+            return
+        if self._last_at is not None and \
+                now - self._last_at < self.cooldown_s:
+            return
+        from spark_rapids_tpu.runtime.cluster import active_cluster
+
+        runtime = active_cluster()
+        if runtime is None:
+            return
+        slots = runtime.live_worker_slots()
+        if len(slots) <= self.min_workers:
+            return
+        victim = slots[-1]  # newest first out: LIFO keeps warm hosts
+        reason = (f"queue depth {queue_depth} <= {self.queue_low} with "
+                  f"0 inflight for {now - self._idle_since:.1f}s")
+        try:
+            runtime.remove_host(victim,
+                                reason=f"autoscaler: {reason}")
+        except (OSError, AssertionError, ValueError, KeyError):
+            # decommission refused (e.g. the slot just died on its
+            # own); stay idle-armed, the next pump re-evaluates
+            self._last_at = now
+            return
+        self.scale_downs += 1
+        self.last_reason = reason
+        self.last_removed_executor_id = victim
+        self._last_at = now
+        self._idle_since = None
+
     def stats(self) -> dict:
         return {"enabled": self.enabled,
                 "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
                 "queue_depth_high": self.queue_high,
+                "queue_depth_low": self.queue_low,
                 "max_workers": self.max_workers,
+                "min_workers": self.min_workers,
+                "idle_sec": self.idle_s,
                 "cooldown_sec": self.cooldown_s,
                 "last_reason": self.last_reason,
-                "last_executor_id": self.last_executor_id}
+                "last_executor_id": self.last_executor_id,
+                "last_removed_executor_id":
+                    self.last_removed_executor_id}

@@ -58,7 +58,7 @@ class QueryService:
                           "deadline_expired": 0,
                           "admitted_out_of_core": 0,
                           "oom_retries": 0, "oom_splits": 0,
-                          "scale_ups": 0}
+                          "scale_ups": 0, "scale_downs": 0}
         self._queue_time = Histogram()
         self._run_time = Histogram()
         self._shutdown = False
@@ -88,9 +88,19 @@ class QueryService:
             StreamingManager
 
         self.streaming = StreamingManager(self.conf)
+        # pending checkpoint/WAL host buffers charge admission too: a
+        # burst of async checkpoint blobs is real host memory, and the
+        # admission ledger is the one place that sees every subsystem
         self.admission.extra_bytes_fn = lambda: (
             self.cache.device_resident_bytes()
-            + self.streaming.device_resident_bytes())
+            + self.streaming.device_resident_bytes()
+            + self.streaming.durability_pending_bytes())
+        # restart recovery (PR 19): discover what the checkpoint dir
+        # holds; the actual WAL replays / checkpoint restores run when
+        # the caller re-creates its tables and re-registers its queries
+        self.recovery_report = self.streaming.recover()
+        self._sigterm_prev = None
+        self._install_sigterm()
         #: result-cache key -> live leader Query (single-flight)
         self._result_leaders: Dict = {}
         # cross-tenant micro-batching (service/batching): the ladder
@@ -482,6 +492,51 @@ class QueryService:
                     "max": semaphore.max_permits,
                 })
 
+    # -- graceful termination (PR 19) -------------------------------------
+
+    def _install_sigterm(self) -> None:
+        """With durability on, SIGTERM means checkpoint-then-drain, not
+        query slaughter: standing queries suspend behind a final
+        checkpoint and queued durability writes land before the process
+        exits. Main-thread only (signal API constraint); the previous
+        handler is chained and restored at shutdown."""
+        import signal
+        import threading
+
+        if not (self.streaming.durability.enabled
+                and self.streaming.durability.on_sigterm
+                and threading.current_thread()
+                is threading.main_thread()):
+            return
+
+        def _on_sigterm(signum, frame):
+            self.shutdown(cancel_running=False)
+            prev = self._sigterm_prev
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        try:
+            self._sigterm_prev = signal.signal(signal.SIGTERM,
+                                               _on_sigterm)
+        except (ValueError, OSError):
+            self._sigterm_prev = None
+
+    def _restore_sigterm(self) -> None:
+        import signal
+        import threading
+
+        if self._sigterm_prev is None or threading.current_thread() \
+                is not threading.main_thread():
+            return
+        try:
+            signal.signal(signal.SIGTERM, self._sigterm_prev)
+        except (ValueError, OSError):
+            pass
+        self._sigterm_prev = None
+
     def shutdown(self, cancel_running: bool = True) -> None:
         with self._lock:
             if self._shutdown:
@@ -504,13 +559,15 @@ class QueryService:
             for q in list(self._queries.values()):
                 if not q.terminal:
                     self._finalize_locked(q, QueryState.CANCELLED)
-        # standing queries first: their cancel teardown releases the
+        # standing queries first: their teardown (suspend-with-final-
+        # checkpoint when durable, cancel otherwise) releases the
         # owner-tagged streaming state through the catalog, and no fold
         # can be in flight once ingest starts refusing work
         self.streaming.shutdown()
         # workers joined and every query finalized: no capture or serve
         # can still be touching an entry's spillable handles
         self.cache.close()
+        self._restore_sigterm()
 
     # -- handle backends --------------------------------------------------
 
@@ -601,15 +658,18 @@ class QueryService:
             while True:
                 nxt = self.admission.next_admissible()
                 if nxt is None:
-                    # nothing admissible but work still queued: that is
-                    # admission pressure — let the autoscaler decide
-                    # whether the cluster should grow a host
-                    if self.admission.queue_depth() > 0:
-                        eid = self.autoscaler.observe(
-                            self.admission.queue_depth(),
-                            len(self.admission.inflight))
-                        if eid is not None:
-                            self._counters["scale_ups"] += 1
+                    # nothing admissible: queued work is admission
+                    # pressure (maybe grow a host), an empty queue is
+                    # idleness (maybe shrink one past the sustained-
+                    # idle window) — the autoscaler sees both
+                    pre_downs = self.autoscaler.scale_downs
+                    eid = self.autoscaler.observe(
+                        self.admission.queue_depth(),
+                        len(self.admission.inflight))
+                    if eid is not None:
+                        self._counters["scale_ups"] += 1
+                    self._counters["scale_downs"] += \
+                        self.autoscaler.scale_downs - pre_downs
                     return
                 if nxt.deadline_expired():
                     self._finalize_locked(

@@ -1,0 +1,577 @@
+"""Streaming durability: ingest WAL + state checkpoints (PR 19).
+
+The spill framework's bottom tier already makes disk a first-class
+home for columnar state; this module extends it from *spill* (bytes we
+can afford to lose — the device copy is authoritative) to
+*state-of-record* (bytes that ARE the standing query after a crash).
+Two artifact kinds live under ``rapids.tpu.streaming.checkpoint.dir``:
+
+``StreamWal`` — one append-only log per streaming table at
+``<root>/tables/<table>/wal.log``. ``StreamTableSource.append``
+persists each validated delta here, CRC-framed and sequence-numbered,
+BEFORE the delta becomes visible to any fold — so a fold interrupted
+by SIGKILL can always be replayed from the log. fsync is batched
+(``walSyncEvery``); the unsynced tail is charged to admission through
+the service's ``extra_bytes_fn``.
+
+``CheckpointStore`` — per-standing-query checkpoint files at
+``<root>/queries/<table>/<query>/ckpt-<seq>.srck``: a JSON meta block
+(sequence cursor, watermark, fold counters, plan signature) plus the
+running (keys..., partials...) state in the serde wire format — the
+SAME bytes the host->disk spill tier writes, so batch fidelity is
+already proven by the spill round-trip tests. Files commit through
+write-temp + fsync + atomic rename and carry a trailing CRC over
+everything after the magic; retention keeps the newest ``retain``.
+
+Recovery policy (exactly-once):
+
+- the latest checkpoint that parses AND passes CRC AND matches the
+  query's plan signature wins; every rejected candidate bumps the
+  ``torn_rejected`` counter and recovery falls back to the next older
+  one, bottoming out at a full refold from the WAL;
+- the WAL suffix past the checkpoint's sequence cursor is replayed
+  through the normal fold path — the cursor dedups, so each delta
+  folds exactly once across the crash;
+- a torn WAL TAIL record (crash mid-append) is truncated and counted,
+  never fatal — the append it belonged to was never acknowledged. A
+  bad record FOLLOWED by valid data is real corruption and raises a
+  loud :class:`WalCorruptionError` (a ``SpillCorruptionError``), never
+  silent data loss.
+
+Checkpoint writes ride :class:`memory.catalog.AsyncBatchWriter` (the
+PR 6 double-buffered spill-writer template) when
+``checkpoint.asyncWrite.enabled`` — the fold returns while the
+snapshot commits; pending bytes charge admission.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.memory.catalog import (AsyncBatchWriter,
+                                             SpillCorruptionError)
+from spark_rapids_tpu.utils import lockorder
+
+WAL_MAGIC = b"SRTWAL1\n"
+CKPT_MAGIC = b"SRTCKP1\n"
+#: record frame: body length + crc32(body), little-endian
+_REC_HDR = struct.Struct("<II")
+CHECKPOINT_VERSION = 1
+
+
+class WalCorruptionError(SpillCorruptionError):
+    """A WAL record failed to decode with valid data after it —
+    mid-log corruption, not a torn tail. Chains the underlying decode
+    error when there is one; raised instead of silently dropping
+    acknowledged ingest."""
+
+
+def safe_name(name: str) -> str:
+    """Filesystem-safe, collision-free directory name for a table or
+    query: sanitized human-readable prefix + crc of the exact original
+    (two names that sanitize identically must not share a WAL)."""
+    clean = "".join(c if c.isalnum() or c in "._-" else "_"
+                    for c in name)[:80] or "_"
+    return f"{clean}-{zlib.crc32(name.encode('utf-8')) & 0xffffffff:08x}"
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a rename/create durable (fsync on the directory fd);
+    best-effort on filesystems that refuse directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class StreamWal:
+    """Append-only CRC-framed delta log for ONE streaming table.
+
+    Layout: 8-byte magic, then records of ``body_len(4 LE) |
+    crc32(body)(4 LE) | body`` where body is the pickled
+    ``(seq, data, validity, num_rows)`` delta tuple (numpy-backed, the
+    exact arrays ``normalize_batch`` validated)."""
+
+    def __init__(self, directory: str, sync_every: int = 1):
+        self.directory = directory
+        self.path = os.path.join(directory, "wal.log")
+        self.sync_every = max(int(sync_every), 1)
+        self._lock = lockorder.make_lock("service.streaming.wal")
+        self._fh: Optional[io.BufferedWriter] = None
+        self._unsynced_records = 0
+        self._unsynced_bytes = 0
+        self.records_appended = 0
+        os.makedirs(directory, exist_ok=True)
+
+    # -- append --------------------------------------------------------
+
+    def _ensure_open(self) -> io.BufferedWriter:
+        if self._fh is None or self._fh.closed:
+            fresh = not os.path.exists(self.path) or \
+                os.path.getsize(self.path) == 0
+            self._fh = open(self.path, "ab")
+            if fresh:
+                self._fh.write(WAL_MAGIC)
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                _fsync_dir(self.directory)
+        return self._fh
+
+    def append(self, seq: int, data, validity, num_rows: int) -> None:
+        """Persist one delta record; returns once it is at least in
+        the page cache (fsync'd every ``sync_every`` records). Called
+        under the source lock — WAL order IS delta order."""
+        from spark_rapids_tpu.service.streaming import stats as _stats
+        from spark_rapids_tpu.shuffle.fault_injection import get_injector
+
+        body = pickle.dumps((int(seq), dict(data), dict(validity),
+                             int(num_rows)), protocol=4)
+        frame = _REC_HDR.pack(len(body), zlib.crc32(body)) + body
+        with self._lock:
+            fh = self._ensure_open()
+            if get_injector().should_truncate_wal():
+                # models a crash mid-append: half the frame reaches
+                # disk; replay tolerates (and truncates) the torn tail
+                fh.write(frame[:len(frame) // 2])
+                fh.flush()
+                return
+            fh.write(frame)
+            fh.flush()
+            self.records_appended += 1
+            self._unsynced_records += 1
+            self._unsynced_bytes += len(frame)
+            if self._unsynced_records >= self.sync_every:
+                os.fsync(fh.fileno())
+                self._unsynced_records = 0
+                self._unsynced_bytes = 0
+        _stats.bump("wal_records")
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            self._unsynced_records = 0
+            self._unsynced_bytes = 0
+
+    def pending_bytes(self) -> int:
+        """Appended-but-not-yet-fsync'd WAL bytes (admission charge)."""
+        with self._lock:
+            return self._unsynced_bytes
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+            self._fh = None
+            self._unsynced_records = 0
+            self._unsynced_bytes = 0
+
+    # -- replay --------------------------------------------------------
+
+    def replay(self) -> List[Tuple[int, dict, dict, int]]:
+        """Decode every durable record, in append order. A torn TAIL
+        (incomplete frame, or the final record's CRC failing) is
+        truncated off the file and counted in ``torn_rejected``; a bad
+        record with valid data after it raises
+        :class:`WalCorruptionError`."""
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.flush()
+            if not os.path.exists(self.path):
+                return []
+            raw = open(self.path, "rb").read()
+        if not raw:
+            return []
+        if raw[:len(WAL_MAGIC)] != WAL_MAGIC:
+            raise WalCorruptionError(
+                f"WAL {self.path} has a bad magic header "
+                f"({raw[:8]!r}); refusing to replay")
+        records: List[Tuple[int, dict, dict, int]] = []
+        off = len(WAL_MAGIC)
+        good_end = off
+        torn = None
+        while off < len(raw):
+            if off + _REC_HDR.size > len(raw):
+                torn = f"incomplete record header at offset {off}"
+                break
+            blen, crc = _REC_HDR.unpack_from(raw, off)
+            body_start = off + _REC_HDR.size
+            if body_start + blen > len(raw):
+                torn = f"incomplete record body at offset {off}"
+                break
+            body = raw[body_start:body_start + blen]
+            if zlib.crc32(body) != crc:
+                if body_start + blen == len(raw):
+                    torn = f"CRC mismatch in tail record at offset {off}"
+                    break
+                raise WalCorruptionError(
+                    f"WAL {self.path} record at offset {off} fails its "
+                    f"CRC with {len(raw) - body_start - blen} valid "
+                    "bytes after it — mid-log corruption, not a torn "
+                    "tail; refusing to silently drop acknowledged "
+                    "ingest")
+            try:
+                seq, data, validity, num_rows = pickle.loads(body)
+            except Exception as e:  # noqa: BLE001 - re-raised chained
+                raise WalCorruptionError(
+                    f"WAL {self.path} record at offset {off} passes "
+                    "CRC but fails to decode") from e
+            records.append((int(seq), data, validity, int(num_rows)))
+            off = body_start + blen
+            good_end = off
+        if torn is not None:
+            _stats.bump("torn_rejected")
+            with self._lock:
+                if self._fh is not None and not self._fh.closed:
+                    self._fh.close()
+                self._fh = None
+                self._unsynced_records = 0
+                self._unsynced_bytes = 0
+                with open(self.path, "r+b") as fh:
+                    fh.truncate(good_end)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        with self._lock:
+            self.records_appended = len(records)
+        return records
+
+
+class CheckpointStore:
+    """Atomically-committed, CRC'd, retention-pruned checkpoint files
+    for ONE standing query.
+
+    File layout: 8-byte magic | meta_len(4 LE) | meta JSON |
+    payload_len(8 LE) | payload (serde wire bytes; empty = no state
+    yet) | crc32 over meta+payload (4 LE)."""
+
+    SUFFIX = ".srck"
+
+    def __init__(self, directory: str, retain: int = 2,
+                 writer: Optional["_CheckpointWriter"] = None):
+        self.directory = directory
+        self.retain = max(int(retain), 1)
+        self._writer = writer
+        self._lock = lockorder.make_lock("service.streaming.checkpoint")
+        os.makedirs(directory, exist_ok=True)
+        self._next_seq = 1 + max(
+            (s for s, _ in self._list_files()), default=0)
+
+    # -- write ---------------------------------------------------------
+
+    @staticmethod
+    def encode(meta: dict, payload: Optional[bytes]) -> bytes:
+        mjson = json.dumps(meta, sort_keys=True).encode("utf-8")
+        payload = payload or b""
+        return b"".join((
+            CKPT_MAGIC, struct.pack("<I", len(mjson)), mjson,
+            struct.pack("<Q", len(payload)), payload,
+            struct.pack("<I", zlib.crc32(mjson + payload))))
+
+    def write(self, meta: dict, payload: Optional[bytes],
+              synchronous: bool = False) -> int:
+        """Commit one checkpoint; returns its sequence number. Async
+        (through the shared writer template) unless ``synchronous`` or
+        no writer is attached — terminal checkpoints (overflow,
+        suspend) are always synchronous: the process may be about to
+        exit and the bytes must land first."""
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+        meta = dict(meta)
+        meta["ckpt_seq"] = seq
+        meta["version"] = CHECKPOINT_VERSION
+        blob = self.encode(meta, payload)
+        if self._writer is not None and not synchronous:
+            self._writer.submit_commit(self, seq, blob)
+        else:
+            self._commit(seq, blob)
+        return seq
+
+    def _path_for(self, seq: int) -> str:
+        return os.path.join(self.directory,
+                            f"ckpt-{seq:010d}{self.SUFFIX}")
+
+    def _commit(self, seq: int, blob: bytes) -> None:
+        from spark_rapids_tpu.service.streaming import stats as _stats
+        from spark_rapids_tpu.shuffle.fault_injection import get_injector
+
+        final = self._path_for(seq)
+        if get_injector().should_tear_checkpoint():
+            # models a crash that beat the atomic rename: half the
+            # bytes under the final name. No counter bump — the
+            # process this write belonged to "died"; recovery counts
+            # the reject instead.
+            with open(final, "wb") as fh:
+                fh.write(blob[:len(blob) // 2])
+                fh.flush()
+                os.fsync(fh.fileno())
+            return
+        tmp = os.path.join(self.directory,
+                           f".ckpt-{seq:010d}{self.SUFFIX}.tmp")
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, final)
+        _fsync_dir(self.directory)
+        _stats.bump("checkpoints_written")
+        self._prune()
+
+    def _list_files(self) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for n in names:
+            if n.startswith("ckpt-") and n.endswith(self.SUFFIX):
+                try:
+                    seq = int(n[len("ckpt-"):-len(self.SUFFIX)])
+                except ValueError:
+                    continue
+                out.append((seq, os.path.join(self.directory, n)))
+        return sorted(out)
+
+    def _prune(self) -> None:
+        with self._lock:
+            files = self._list_files()
+            for _seq, path in files[:-self.retain]:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # -- read ----------------------------------------------------------
+
+    @staticmethod
+    def decode(blob: bytes) -> Tuple[dict, bytes]:
+        """Parse + CRC-verify one checkpoint blob; raises on anything
+        short, reordered, or bit-flipped."""
+        if blob[:len(CKPT_MAGIC)] != CKPT_MAGIC:
+            raise ValueError("bad checkpoint magic")
+        off = len(CKPT_MAGIC)
+        (mlen,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        mjson = blob[off:off + mlen]
+        if len(mjson) != mlen:
+            raise ValueError("truncated checkpoint meta")
+        off += mlen
+        (plen,) = struct.unpack_from("<Q", blob, off)
+        off += 8
+        payload = blob[off:off + plen]
+        if len(payload) != plen:
+            raise ValueError("truncated checkpoint payload")
+        off += plen
+        (crc,) = struct.unpack_from("<I", blob, off)
+        if zlib.crc32(mjson + payload) != crc:
+            raise ValueError("checkpoint CRC mismatch")
+        return json.loads(mjson.decode("utf-8")), payload
+
+    def load_latest(self, count_rejects: bool = True
+                    ) -> Optional[Tuple[dict, bytes]]:
+        """Newest checkpoint that parses and passes CRC, or None.
+        Invalid candidates (torn writes, bit rot) are skipped newest to
+        oldest, each counted in ``torn_rejected`` (unless peeking)."""
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        for _seq, path in reversed(self._list_files()):
+            try:
+                with open(path, "rb") as fh:
+                    return self.decode(fh.read())
+            except (ValueError, KeyError, OSError, struct.error,
+                    json.JSONDecodeError):
+                if count_rejects:
+                    _stats.bump("torn_rejected")
+        return None
+
+    def checkpoint_count(self) -> int:
+        return len(self._list_files())
+
+
+class _CheckpointWriter(AsyncBatchWriter):
+    """The checkpoint instantiation of the async batch-writer
+    template: items are (store, seq, blob) commits; in-flight blob
+    bytes are tracked for the admission charge."""
+
+    def __init__(self, depth: int = 2):
+        super().__init__(
+            lockorder.make_condition("service.streaming.checkpointWriter"),
+            "srt-stream-ckpt", depth)
+        self._bytes = 0
+
+    def submit_commit(self, store: CheckpointStore, seq: int,
+                      blob: bytes) -> None:
+        with self._cv:
+            self._bytes += len(blob)
+        self.submit((store, seq, blob))
+
+    def pending_bytes(self) -> int:
+        with self._cv:
+            return self._bytes
+
+    def _process(self, item) -> None:
+        store, seq, blob = item
+        try:
+            store._commit(seq, blob)
+        finally:
+            with self._cv:
+                self._bytes -= len(blob)
+
+    def _on_error(self, item, exc: BaseException) -> None:
+        import logging
+
+        store, seq, _blob = item
+        logging.getLogger(__name__).exception(
+            "async checkpoint commit %d under %s failed; an older "
+            "checkpoint (or the WAL) still covers recovery", seq,
+            store.directory)
+
+
+class StreamingDurability:
+    """Root handle over the checkpoint directory: hands out per-table
+    WALs and per-query checkpoint stores, owns the shared async
+    checkpoint writer, and aggregates the pending-byte admission
+    charge. One per StreamingManager; inert when the dir knob is
+    unset."""
+
+    def __init__(self, conf):
+        from spark_rapids_tpu import config as cfg
+
+        self.root = str(conf.get(cfg.STREAMING_CHECKPOINT_DIR)
+                        or "").strip()
+        self.enabled = bool(self.root)
+        self.sync_every = conf.get(cfg.STREAMING_CHECKPOINT_WAL_SYNC)
+        self.retain = conf.get(cfg.STREAMING_CHECKPOINT_RETAIN)
+        self.interval_folds = max(
+            int(conf.get(cfg.STREAMING_CHECKPOINT_INTERVAL)), 1)
+        self.async_write = bool(
+            conf.get(cfg.STREAMING_CHECKPOINT_ASYNC))
+        self.on_sigterm = bool(
+            conf.get(cfg.STREAMING_CHECKPOINT_ON_SIGTERM))
+        self._lock = lockorder.make_lock("service.streaming.checkpoint")
+        self._wals: Dict[str, StreamWal] = {}
+        self._stores: Dict[Tuple[str, str], CheckpointStore] = {}
+        self._writer: Optional[_CheckpointWriter] = None
+        if self.enabled:
+            os.makedirs(self.root, exist_ok=True)
+
+    # -- registry ------------------------------------------------------
+
+    def table_dir(self, table_name: str) -> str:
+        return os.path.join(self.root, "tables", safe_name(table_name))
+
+    def query_dir(self, table_name: str, query_name: str) -> str:
+        return os.path.join(self.root, "queries",
+                            safe_name(table_name),
+                            safe_name(query_name))
+
+    def wal_for(self, table_name: str) -> StreamWal:
+        with self._lock:
+            wal = self._wals.get(table_name)
+            if wal is None:
+                wal = StreamWal(self.table_dir(table_name),
+                                sync_every=self.sync_every)
+                self._wals[table_name] = wal
+            return wal
+
+    def store_for(self, table_name: str,
+                  query_name: str) -> CheckpointStore:
+        with self._lock:
+            key = (table_name, query_name)
+            store = self._stores.get(key)
+            if store is None:
+                if self.async_write and self._writer is None:
+                    self._writer = _CheckpointWriter()
+                store = CheckpointStore(
+                    self.query_dir(table_name, query_name),
+                    retain=self.retain, writer=self._writer)
+                self._stores[key] = store
+            return store
+
+    # -- accounting ----------------------------------------------------
+
+    def pending_bytes(self) -> int:
+        """Host bytes the durability layer holds in flight: unsynced
+        WAL tails + checkpoint blobs queued on the async writer —
+        charged next to cached fragments and streaming state so
+        durability I/O cannot stealth-OOM admission."""
+        with self._lock:
+            wals = list(self._wals.values())
+            writer = self._writer
+        n = sum(w.pending_bytes() for w in wals)
+        if writer is not None:
+            n += writer.pending_bytes()
+        return n
+
+    def drain(self) -> None:
+        """Block until every queued checkpoint committed and every WAL
+        fsync'd (graceful-shutdown barrier)."""
+        with self._lock:
+            wals = list(self._wals.values())
+            writer = self._writer
+        if writer is not None:
+            writer.drain()
+        for w in wals:
+            w.sync()
+
+    def close(self) -> None:
+        with self._lock:
+            wals = list(self._wals.values())
+            writer, self._writer = self._writer, None
+            self._wals = {}
+            self._stores = {}
+        if writer is not None:
+            writer.stop()
+        for w in wals:
+            w.close()
+
+    # -- startup discovery --------------------------------------------
+
+    def recover_report(self) -> dict:
+        """What the checkpoint dir holds, without loading any state:
+        persisted table WALs and each persisted query's latest VALID
+        checkpoint meta (invalid candidates are skipped silently here
+        — register-time recovery counts the rejects). The
+        ``StreamingManager.recover()`` return value."""
+        report: dict = {"enabled": self.enabled, "root": self.root,
+                        "tables": [], "queries": []}
+        if not self.enabled:
+            return report
+        tdir = os.path.join(self.root, "tables")
+        if os.path.isdir(tdir):
+            for name in sorted(os.listdir(tdir)):
+                wal_path = os.path.join(tdir, name, "wal.log")
+                if os.path.exists(wal_path):
+                    report["tables"].append({
+                        "dir": name,
+                        "wal_bytes": os.path.getsize(wal_path)})
+        qdir = os.path.join(self.root, "queries")
+        if os.path.isdir(qdir):
+            for tname in sorted(os.listdir(qdir)):
+                for qname in sorted(os.listdir(
+                        os.path.join(qdir, tname))):
+                    store = CheckpointStore(
+                        os.path.join(qdir, tname, qname),
+                        retain=self.retain)
+                    loaded = store.load_latest(count_rejects=False)
+                    report["queries"].append({
+                        "dir": f"{tname}/{qname}",
+                        "checkpoints": store.checkpoint_count(),
+                        "latest_meta": loaded[0] if loaded else None})
+        return report

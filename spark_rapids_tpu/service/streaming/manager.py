@@ -30,6 +30,9 @@ FINISHED_RETENTION = 64
 
 class StreamingManager:
     def __init__(self, conf):
+        from spark_rapids_tpu.service.streaming.durability import \
+            StreamingDurability
+
         self.conf = conf
         self._lock = lockorder.make_lock("service.streaming.state")
         self._standing: Dict[int, StandingQuery] = {}
@@ -37,6 +40,43 @@ class StreamingManager:
         self._by_source: Dict[int, List[StandingQuery]] = {}
         self._finished_order: List[int] = []
         self._shutdown = False
+        #: durability layer (PR 19); inert unless
+        #: rapids.tpu.streaming.checkpoint.dir is set
+        self.durability = StreamingDurability(conf)
+
+    # -- durability (PR 19) ------------------------------------------------
+
+    def attach_source(self, source) -> None:
+        """Make a streaming table durable: replay its WAL (restart
+        recovery — the rebuilt deltas are what batch queries and
+        standing-query catch-up see) and route every future append
+        through the log. No-op when durability is off; idempotent."""
+        if not self.durability.enabled:
+            return
+        wal = self.durability.wal_for(source.name)
+        if getattr(source, "_wal", None) is wal:
+            return
+        records = wal.replay()
+        if records and source.num_appends == 0:
+            source.restore_deltas(records)
+        source.attach_wal(wal)
+
+    def recover(self) -> dict:
+        """Startup discovery over the checkpoint dir: which tables have
+        WALs, which queries have checkpoints and how far they got. The
+        actual state loads happen lazily — WAL replay when the table is
+        re-created (``attach_source``), checkpoint restore when the
+        query re-registers — so recovery cost tracks what the caller
+        actually resumes. Invoked from QueryService startup and the
+        host-loss recovery path; returns the report for telemetry."""
+        return self.durability.recover_report()
+
+    def durability_pending_bytes(self) -> int:
+        """In-flight durability bytes (unsynced WAL + queued checkpoint
+        blobs) for the service admission charge."""
+        if not self.durability.enabled:
+            return 0
+        return self.durability.pending_bytes()
 
     # -- registration ------------------------------------------------------
 
@@ -83,6 +123,18 @@ class StreamingManager:
             self._standing[sq.query_id] = sq
             self._by_source.setdefault(id(sq.source), []).append(sq)
         _stats.bump("standing_registered")
+        if self.durability.enabled and \
+                getattr(sq.source, "name", None):
+            # durability wiring BEFORE the catch-up drain: a restored
+            # checkpoint advances the sequence cursor, so the drain
+            # below replays exactly the WAL suffix past it — each
+            # delta folds once across a restart (exactly-once). Note
+            # the checkpoint identity is (table, query name): pass a
+            # stable ``name`` to resume across processes.
+            sq.attach_durability(
+                self.durability.store_for(sq.source.name, sq.name),
+                self.durability.interval_folds)
+            sq.restore_from_checkpoint()
         # catch-up: deltas appended before registration fold now; any
         # append racing this call is folded exactly once — either by
         # its own ingest (the index is already published) or here (the
@@ -184,13 +236,24 @@ class StreamingManager:
     # -- teardown ----------------------------------------------------------
 
     def shutdown(self) -> None:
-        """Cancel every live standing query (releasing its catalog
-        state through the normal teardown) and refuse future work."""
+        """Stop every live standing query and refuse future work. With
+        durability on this is graceful: each query writes a final
+        checkpoint and parks as SUSPENDED (restartable), queued
+        checkpoint commits drain, WAL tails fsync. Without durability
+        it is the original cancel — state discarded through the normal
+        teardown."""
         with self._lock:
             if self._shutdown:
                 return
             self._shutdown = True
             sqs = list(self._standing.values())
+        durable = self.durability.enabled
         for sq in sqs:
             if not sq.terminal:
-                sq.cancel()
+                if durable:
+                    sq.suspend()
+                else:
+                    sq.cancel()
+        if durable:
+            self.durability.drain()
+        self.durability.close()

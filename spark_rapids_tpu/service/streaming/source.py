@@ -131,6 +131,13 @@ class StreamTableSource(DataSource):
         self._uid = next(_STREAM_UIDS)
         self._deltas: list = []
         self._total_rows = 0
+        # independent of len(_deltas): after a WAL replay that
+        # truncated a torn tail, the next append must continue the
+        # durable numbering, and restored deltas keep their logged seqs
+        self._next_seq = 0
+        #: durability hook (PR 19): when attached, every append is
+        #: persisted to this WAL before the delta becomes visible
+        self._wal = None
         self._lock = lockorder.make_lock("service.streaming.source")
 
     # -- DataSource ----------------------------------------------------
@@ -160,7 +167,13 @@ class StreamTableSource(DataSource):
         ndata, nvalidity, n = normalize_batch(data, self._schema,
                                               validity)
         with self._lock:
-            delta = _Delta(len(self._deltas), ndata, nvalidity, n)
+            delta = _Delta(self._next_seq, ndata, nvalidity, n)
+            if self._wal is not None:
+                # write-ahead: under the source lock so WAL order is
+                # delta order, BEFORE the delta is appended so no fold
+                # can ever see rows the log does not cover
+                self._wal.append(delta.seq, ndata, nvalidity, n)
+            self._next_seq += 1
             self._deltas.append(delta)
             self._total_rows += n
         snapshots.bump(self)
@@ -182,6 +195,45 @@ class StreamTableSource(DataSource):
         """Deltas with sequence >= ``seq`` (registration catch-up)."""
         with self._lock:
             return [d for d in self._deltas if d.seq >= seq]
+
+    # -- durability (PR 19) --------------------------------------------
+
+    def attach_wal(self, wal) -> None:
+        """Route every future append through ``wal`` first. Idempotent;
+        attaching a DIFFERENT wal to a live source is a wiring bug."""
+        with self._lock:
+            if self._wal is wal:
+                return
+            if self._wal is not None:
+                raise RuntimeError(
+                    f"stream table {self.name!r} already has a WAL "
+                    "attached")
+            self._wal = wal
+
+    def restore_deltas(self, records) -> int:
+        """Rebuild the delta list from replayed WAL records
+        ``(seq, data, validity, num_rows)`` — restart recovery, before
+        any standing query registers. Only valid on an empty source."""
+        from spark_rapids_tpu.service.cache import snapshots
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        rows = 0
+        with self._lock:
+            if self._deltas:
+                raise RuntimeError(
+                    f"stream table {self.name!r} already has "
+                    f"{len(self._deltas)} deltas; WAL restore must "
+                    "run before any append")
+            for seq, data, validity, n in records:
+                self._deltas.append(_Delta(int(seq), data, validity,
+                                           int(n)))
+                self._total_rows += int(n)
+                self._next_seq = max(self._next_seq, int(seq) + 1)
+                rows += int(n)
+        if records:
+            snapshots.bump(self)
+            _stats.bump("wal_replays")
+        return rows
 
     # -- semantic-cache protocol (service/cache/snapshots) -------------
 

@@ -50,7 +50,11 @@ FOLDING = "FOLDING"
 EMITTING = "EMITTING"
 CANCELLED = "CANCELLED"
 FAILED = "FAILED"
-TERMINAL = frozenset({CANCELLED, FAILED})
+#: graceful-shutdown terminal (PR 19): state checkpointed, query
+#: restartable by re-registering against the same checkpoint dir —
+#: unlike CANCELLED/FAILED the work is parked, not discarded
+SUSPENDED = "SUSPENDED"
+TERMINAL = frozenset({CANCELLED, FAILED, SUSPENDED})
 
 
 class StandingCancelled(RuntimeError):
@@ -107,6 +111,20 @@ class StandingQuery:
         self.registered_at = time.perf_counter()
         self.agg_state = StreamingAggregateState(info, conf,
                                                 self.owner_tag)
+        #: plan signature a checkpoint must match to be restored: the
+        #: stream schema plus the query's output columns — a changed
+        #: query shape silently adopting old partials would be wrong
+        #: answers, so a mismatch falls back to a full WAL refold
+        self.signature = {
+            "stream": [[n, getattr(t, "name", str(t))]
+                       for n, t in zip(stream_schema.names,
+                                       stream_schema.types)],
+            "output": list(out_names),
+        }
+        #: durability hooks (PR 19); attached by the manager when the
+        #: checkpoint dir is configured
+        self._ckpt_store = None
+        self._ckpt_interval = 1
         self.state = REGISTERED
         self.error: Optional[BaseException] = None
         self._cancel_requested = False
@@ -176,21 +194,57 @@ class StandingQuery:
 
     def _fold_one(self, delta) -> None:
         """One micro-batch: late-data handling host-side, then the
-        update+merge launches. Caller holds the lock."""
+        update+merge launches. Caller holds the lock. With durability
+        attached, a recoverable in-fold fault (fetch/transport) gets
+        ONE local retry — the running state only swaps as the fold's
+        last step, so re-driving the delta is safe — before the query
+        fails over to restart recovery."""
+        from spark_rapids_tpu.runtime import recovery as _recovery
         from spark_rapids_tpu.service.streaming import stats as _stats
+        from spark_rapids_tpu.shuffle.fault_injection import get_injector
+        from spark_rapids_tpu.shuffle.iterator import \
+            ShuffleFetchFailedError
+        from spark_rapids_tpu.shuffle.transport import TransportError
         from spark_rapids_tpu.utils import dispatch as _disp
 
         self._next_seq = delta.seq + 1
-        data, validity, n = delta.data, delta.validity, delta.num_rows
+        n = delta.num_rows
         self.state = FOLDING
+        if get_injector().should_crash_at_fold():
+            # models an unclean host death mid-fold: the WAL already
+            # holds this delta (append is write-ahead), no checkpoint
+            # holds this fold — restart recovery must refold it
+            import os
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
         t0 = time.perf_counter()
         pre = _disp.snapshot() if _disp.installed() else None
         try:
-            self._cancel_check()
-            if self.event_time_col is not None and n:
-                data, validity, n = self._handle_late(data, validity, n)
-            self.agg_state.fold(data, validity, n,
-                                cancel_check=self._cancel_check)
+            attempts = 2 if self._ckpt_store is not None else 1
+            for attempt in range(attempts):
+                wm_save = (self.watermark, self._max_event,
+                           self.late_rows_remerged,
+                           self.late_rows_dropped)
+                data, validity, n = (delta.data, delta.validity,
+                                     delta.num_rows)
+                try:
+                    self._cancel_check()
+                    if self.event_time_col is not None and n:
+                        data, validity, n = self._handle_late(
+                            data, validity, n)
+                    self.agg_state.fold(
+                        data, validity, n,
+                        cancel_check=self._cancel_check)
+                    break
+                except (ShuffleFetchFailedError, TransportError):
+                    if attempt + 1 >= attempts:
+                        raise
+                    # rewind the watermark/late accounting the failed
+                    # attempt advanced, then re-drive the same delta
+                    (self.watermark, self._max_event,
+                     self.late_rows_remerged,
+                     self.late_rows_dropped) = wm_save
+                    _recovery.bump("streaming_restores")
             if self.max_state_bytes and \
                     self.agg_state.state_bytes() > self.max_state_bytes:
                 raise StreamingStateOverflow(
@@ -201,6 +255,14 @@ class StandingQuery:
                     f"window the aggregation")
         except StandingCancelled:
             self._teardown(CANCELLED)
+            return
+        except StreamingStateOverflow as e:
+            # the fold that tripped the bound COMPLETED (the check runs
+            # after the state swap) — persist it before failing, so a
+            # restart with a raised budget resumes instead of refolding
+            # the whole stream
+            self._final_checkpoint("state-overflow")
+            self._teardown(FAILED, e)
             return
         except BaseException as e:
             # the standing query dies; the ingest that fed it must not
@@ -217,6 +279,7 @@ class StandingQuery:
         _stats.bump("folds")
         _stats.bump("rows_folded", n)
         self.state = EMITTING
+        self._maybe_checkpoint()
 
     def _handle_late(self, data, validity, n):
         """Split one arriving batch against the CURRENT watermark, then
@@ -250,6 +313,129 @@ class StandingQuery:
             self.watermark = cand if wm is None else max(wm, cand)
         return data, validity, n
 
+    # -- durability (PR 19) --------------------------------------------
+
+    def attach_durability(self, store, interval: int = 1) -> None:
+        """Wire this query to its checkpoint store; folds checkpoint
+        every ``interval`` folds and terminal transitions write final
+        checkpoints. Must run before the catch-up drain."""
+        self._ckpt_store = store
+        self._ckpt_interval = max(int(interval), 1)
+
+    def _ckpt_meta(self) -> dict:
+        return {
+            "query": self.name,
+            "tenant": self.tenant,
+            "table": getattr(self.source, "name", None),
+            "signature": self.signature,
+            "cursor": self._next_seq,
+            "watermark": self.watermark,
+            "max_event": self._max_event,
+            "late_rows_remerged": self.late_rows_remerged,
+            "late_rows_dropped": self.late_rows_dropped,
+            "folds": self.folds,
+            "rows_folded": self.rows_folded,
+        }
+
+    def checkpoint(self, synchronous: bool = False) -> Optional[int]:
+        """Snapshot (running state, sequence cursor, watermark, late
+        counters) to the checkpoint store; returns the checkpoint
+        sequence, or None when durability is off. Caller holds the
+        query lock (fold boundary) — the snapshot is consistent with
+        the cursor by construction."""
+        if self._ckpt_store is None:
+            return None
+        payload = self.agg_state.snapshot_host()
+        meta = self._ckpt_meta()
+        meta["has_state"] = payload is not None
+        return self._ckpt_store.write(meta, payload,
+                                      synchronous=synchronous)
+
+    def _maybe_checkpoint(self) -> None:
+        if self._ckpt_store is None or \
+                self.folds % self._ckpt_interval != 0:
+            return
+        try:
+            self.checkpoint()
+        except OSError:
+            import logging
+            logging.getLogger(__name__).exception(
+                "checkpoint of standing query %d failed; the query "
+                "keeps folding — recovery falls back to an older "
+                "checkpoint or the WAL", self.query_id)
+
+    def _final_checkpoint(self, why: str) -> None:
+        """Synchronous terminal-transition checkpoint (overflow,
+        suspend): the process may be about to exit, the bytes must
+        land now. Runs BEFORE teardown closes the state."""
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        if self._ckpt_store is None:
+            return
+        try:
+            self.checkpoint(synchronous=True)
+            _stats.bump("final_checkpoints")
+        except OSError:
+            import logging
+            logging.getLogger(__name__).exception(
+                "final (%s) checkpoint of standing query %d failed; "
+                "recovery falls back to the last periodic checkpoint "
+                "or the WAL", why, self.query_id)
+
+    def restore_from_checkpoint(self) -> bool:
+        """Adopt the newest valid checkpoint whose plan signature
+        matches; returns True when state+cursor were restored. Runs at
+        registration BEFORE the catch-up drain, so the drain replays
+        exactly the WAL suffix past the checkpoint cursor — each delta
+        folds exactly once across the restart. No valid or matching
+        checkpoint -> False, and the ordinary catch-up performs a full
+        refold from the (replayed) source."""
+        from spark_rapids_tpu.runtime import recovery as _recovery
+        from spark_rapids_tpu.service.streaming import stats as _stats
+
+        if self._ckpt_store is None:
+            return False
+        with self._lock:
+            loaded = self._ckpt_store.load_latest()
+            if loaded is None:
+                return False
+            meta, payload = loaded
+            if meta.get("signature") != self.signature:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "checkpoint for standing query %r has a different "
+                    "plan signature; ignoring it and refolding from "
+                    "the WAL", self.name)
+                return False
+            has_state = bool(meta.get("has_state"))
+            self.agg_state.restore_running(
+                payload if has_state else None,
+                meta.get("folds", 0), meta.get("rows_folded", 0))
+            self._next_seq = int(meta.get("cursor", 0))
+            self.watermark = meta.get("watermark")
+            self._max_event = meta.get("max_event")
+            self.late_rows_remerged = int(
+                meta.get("late_rows_remerged", 0))
+            self.late_rows_dropped = int(
+                meta.get("late_rows_dropped", 0))
+            self.state = EMITTING if has_state else REGISTERED
+        _stats.bump("recoveries")
+        _recovery.bump("streaming_restores")
+        return True
+
+    def suspend(self) -> bool:
+        """Graceful-shutdown terminal: write a final synchronous
+        checkpoint, then tear down to SUSPENDED. The query's answer
+        survives — a restart against the same checkpoint dir resumes
+        it — which is why service shutdown prefers this over
+        ``cancel()`` when durability is on."""
+        with self._lock:
+            if self.terminal:
+                return self.state == SUSPENDED
+            self._final_checkpoint("suspend")
+            self._teardown(SUSPENDED)
+            return True
+
     @property
     def watermark_lag_ms(self) -> int:
         """How far the watermark trails the newest event seen (>= the
@@ -270,6 +456,12 @@ class StandingQuery:
             if self.state == CANCELLED:
                 raise QueryCancelled(
                     f"standing query {self.query_id} was cancelled")
+            if self.state == SUSPENDED:
+                raise QueryCancelled(
+                    f"standing query {self.query_id} was suspended at "
+                    "shutdown; its state is checkpointed under "
+                    "rapids.tpu.streaming.checkpoint.dir — register "
+                    "the query again to resume and read results there")
             if self.state == FAILED:
                 raise self.error or RuntimeError(
                     f"standing query {self.query_id} failed")
@@ -314,8 +506,9 @@ class StandingQuery:
         self.error = error
         self.agg_state.close()
         self.retry = _retry.pop_owner_stats(self.owner_tag)
-        _stats.bump("standing_cancelled" if state == CANCELLED
-                    else "standing_failed")
+        _stats.bump({CANCELLED: "standing_cancelled",
+                     SUSPENDED: "standing_suspended"}.get(
+                         state, "standing_failed"))
 
     # -- observability -------------------------------------------------
 
@@ -335,4 +528,5 @@ class StandingQuery:
             "last_fold_wall_s": round(self.last_fold_wall_s, 6),
             "last_fold_dispatches": self.last_fold_dispatches,
             "fold_dispatches": self.fold_dispatches,
+            "durable": self._ckpt_store is not None,
         }

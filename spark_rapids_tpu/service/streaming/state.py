@@ -154,6 +154,44 @@ class StreamingAggregateState:
                  for name, ordinal in self.projection})
         return frame
 
+    # -- checkpoint snapshot / restore (PR 19) -------------------------
+
+    def snapshot_host(self) -> Optional[bytes]:
+        """The running (keys..., partials...) batch in the serde wire
+        format — the checkpoint payload. None when nothing has folded
+        yet. Read-only: the partials keep folding afterwards."""
+        from spark_rapids_tpu.columnar import serde
+
+        if self._running is None:
+            return None
+        prev_owner = set_buffer_owner(self.owner_tag)
+        try:
+            with self._running.acquired() as rb:
+                return serde.serialize_host_batch(serde.to_host_batch(rb))
+        finally:
+            set_buffer_owner(prev_owner)
+
+    def restore_running(self, payload: Optional[bytes], folds: int,
+                        rows_folded: int) -> None:
+        """Adopt a checkpointed running state (inverse of
+        ``snapshot_host``); only valid before the first fold."""
+        from spark_rapids_tpu.columnar import serde
+
+        if self._running is not None:
+            raise RuntimeError("restore_running on a state that has "
+                               "already folded")
+        if payload:
+            prev_owner = set_buffer_owner(self.owner_tag)
+            try:
+                db = serde.to_device_batch(
+                    serde.deserialize_host_batch(payload))
+                self._running = SpillableBatch(db,
+                                               STREAMING_STATE_PRIORITY)
+            finally:
+                set_buffer_owner(prev_owner)
+        self.folds = int(folds)
+        self.rows_folded = int(rows_folded)
+
     # -- accounting / teardown -----------------------------------------
 
     def state_bytes(self) -> int:

@@ -18,7 +18,15 @@ _lock = lockorder.make_lock("service.streaming.stats")
 _KEYS = ("standing_registered", "standing_cancelled", "standing_failed",
          "appends", "rows_appended", "folds", "rows_folded",
          "late_rows_remerged", "late_rows_dropped", "fold_dispatches",
-         "emits")
+         "emits",
+         # durability layer (PR 19): WAL records persisted, checkpoint
+         # files committed (final_checkpoints = the overflow/suspend
+         # subset written on a terminal transition), restart recoveries
+         # (checkpoint restored), WAL replays (table deltas rebuilt
+         # from the log), and torn/corrupt artifacts rejected on CRC
+         "wal_records", "wal_replays", "checkpoints_written",
+         "final_checkpoints", "recoveries", "torn_rejected",
+         "standing_suspended")
 
 _counters: Dict[str, int] = {k: 0 for k in _KEYS}
 

@@ -30,6 +30,18 @@ deterministically on CPU CI:
   ``consecutive`` past the transport retry budget this escalates into
   a fetch failure and a stage retry; each distinct partition event
   bumps the ``dcn_partitions`` recovery counter.
+- ``crash_at_fold=N``: SIGKILL the CURRENT process at the start of the
+  Nth standing-query fold — after the delta's WAL append is durable,
+  before the running state swaps. The unclean-death half of the PR 19
+  streaming durability contract: restart recovery must rebuild from
+  checkpoint + WAL replay, bit-exact.
+- ``torn_checkpoint_at=N``: the Nth streaming checkpoint commit writes
+  only the FIRST HALF of its bytes under the final file name, skipping
+  the atomic rename — a crash that beat the rename. Recovery must
+  reject it on CRC and fall back to an older checkpoint or the WAL.
+- ``truncate_wal_at=N``: the Nth WAL record append persists only half
+  its frame — a process dying mid-write. Replay must tolerate (and
+  truncate) the torn tail; corruption MID-log stays loud.
 - ``probability`` + ``seed``: seeded random connection drops for chaos
   sweeps; ``consecutive=K`` makes each firing point fail K events in a
   row (K past the transport retry budget escalates a drop into a fetch
@@ -89,6 +101,9 @@ class ShuffleFaultInjector:
             self._kill = _Trigger(0, 1)
             self._kill_host = _Trigger(0, 1)
             self._dcn = _Trigger(0, 1)
+            self._crash_fold = _Trigger(0, 1)
+            self._torn_ckpt = _Trigger(0, 1)
+            self._trunc_wal = _Trigger(0, 1)
             self._probability = 0.0
             self._rng: Optional[random.Random] = None
             self._max_injections = 0
@@ -98,12 +113,17 @@ class ShuffleFaultInjector:
             self._host_kills = 0
             self._dcn_drops = 0
             self._dcn_partitions = 0
+            self._fold_crashes = 0
+            self._torn_checkpoints = 0
+            self._wal_truncations = 0
 
     def arm(self, drop_at_request: int = 0, truncate_at_request: int = 0,
             kill_before_task: int = 0, probability: float = 0.0,
             seed: int = 0, consecutive: int = 1,
             max_injections: int = 0, kill_host_at_stage: int = 0,
-            partition_dcn_at_request: int = 0) -> None:
+            partition_dcn_at_request: int = 0, crash_at_fold: int = 0,
+            torn_checkpoint_at: int = 0,
+            truncate_wal_at: int = 0) -> None:
         """Arm (resetting all counters). Ordinals count eligible events
         from 1; 0 disables that fault kind (probability may still drop
         connections)."""
@@ -114,6 +134,9 @@ class ShuffleFaultInjector:
             self._kill = _Trigger(kill_before_task, 1)
             self._kill_host = _Trigger(kill_host_at_stage, 1)
             self._dcn = _Trigger(partition_dcn_at_request, consecutive)
+            self._crash_fold = _Trigger(crash_at_fold, 1)
+            self._torn_ckpt = _Trigger(torn_checkpoint_at, consecutive)
+            self._trunc_wal = _Trigger(truncate_wal_at, consecutive)
             self._probability = float(probability)
             self._rng = random.Random(seed) if probability > 0 else None
             self._max_injections = max(int(max_injections), 0)
@@ -123,6 +146,9 @@ class ShuffleFaultInjector:
             self._host_kills = 0
             self._dcn_drops = 0
             self._dcn_partitions = 0
+            self._fold_crashes = 0
+            self._torn_checkpoints = 0
+            self._wal_truncations = 0
 
     @property
     def armed(self) -> bool:
@@ -131,7 +157,8 @@ class ShuffleFaultInjector:
     def _capped(self) -> bool:
         return self._max_injections and \
             (self._drops + self._truncations + self._kills +
-             self._host_kills + self._dcn_drops) >= \
+             self._host_kills + self._dcn_drops + self._fold_crashes +
+             self._torn_checkpoints + self._wal_truncations) >= \
             self._max_injections
 
     def should_drop(self) -> bool:
@@ -209,6 +236,42 @@ class ShuffleFaultInjector:
             recovery.bump("dcn_partitions")
         return True
 
+    def should_crash_at_fold(self) -> bool:
+        """Count one standing-query fold start; True = the caller
+        SIGKILLs its OWN process (standing.py owns the call) — the
+        durability layer's unclean-death fault."""
+        if not self._armed:
+            return False
+        with self._lock:
+            if not self._crash_fold.fire() or self._capped():
+                return False
+            self._fold_crashes += 1
+            return True
+
+    def should_tear_checkpoint(self) -> bool:
+        """Count one streaming checkpoint commit; True = the store
+        writes half the blob under the final name with no rename (a
+        crash that beat the atomic commit)."""
+        if not self._armed:
+            return False
+        with self._lock:
+            if not self._torn_ckpt.fire() or self._capped():
+                return False
+            self._torn_checkpoints += 1
+            return True
+
+    def should_truncate_wal(self) -> bool:
+        """Count one WAL record append; True = only half the record's
+        frame reaches the log (a process dying mid-write — the torn
+        tail replay must tolerate)."""
+        if not self._armed:
+            return False
+        with self._lock:
+            if not self._trunc_wal.fire() or self._capped():
+                return False
+            self._wal_truncations += 1
+            return True
+
     def stats(self) -> dict:
         with self._lock:
             return {"armed": self._armed,
@@ -216,12 +279,18 @@ class ShuffleFaultInjector:
                     "chunk_requests": self._truncate.count,
                     "tasks": self._kill.count,
                     "stages": self._kill_host.count,
+                    "folds": self._crash_fold.count,
+                    "checkpoint_commits": self._torn_ckpt.count,
+                    "wal_appends": self._trunc_wal.count,
                     "drops": self._drops,
                     "truncations": self._truncations,
                     "kills": self._kills,
                     "host_kills": self._host_kills,
                     "dcn_drops": self._dcn_drops,
-                    "dcn_partitions": self._dcn_partitions}
+                    "dcn_partitions": self._dcn_partitions,
+                    "fold_crashes": self._fold_crashes,
+                    "torn_checkpoints": self._torn_checkpoints,
+                    "wal_truncations": self._wal_truncations}
 
 
 _injector = ShuffleFaultInjector()
@@ -249,5 +318,8 @@ def arm_from_conf(conf) -> bool:
         max_injections=conf.get(cfg.SHUFFLE_FI_MAX),
         kill_host_at_stage=conf.get(cfg.SHUFFLE_FI_KILL_HOST_AT_STAGE),
         partition_dcn_at_request=conf.get(
-            cfg.SHUFFLE_FI_PARTITION_DCN_AT))
+            cfg.SHUFFLE_FI_PARTITION_DCN_AT),
+        crash_at_fold=conf.get(cfg.SHUFFLE_FI_CRASH_AT_FOLD),
+        torn_checkpoint_at=conf.get(cfg.SHUFFLE_FI_TORN_CHECKPOINT_AT),
+        truncate_wal_at=conf.get(cfg.SHUFFLE_FI_TRUNCATE_WAL_AT))
     return True

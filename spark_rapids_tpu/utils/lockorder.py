@@ -96,6 +96,15 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # snapshot counter (158); scans take it briefly to copy the delta
     # list before concatenating outside the lock ----------------------
     "service.streaming.source": 92,
+    # -- streaming durability (service/streaming/durability): the WAL
+    # lock is taken under the source lock (append persists the record
+    # before the delta is visible); the checkpoint-store lock is taken
+    # under the standing-query fold lock (26) and must stay OUTSIDE the
+    # catalog (100) because loading a checkpoint registers state
+    # buffers; the writer CV is the async-commit pending counter ------
+    "service.streaming.wal": 94,
+    "service.streaming.checkpoint": 96,
+    "service.streaming.checkpointWriter": 98,
     # -- memory subsystem ----------------------------------------------
     "memory.catalog.state": 100,
     "memory.catalog.global": 102,

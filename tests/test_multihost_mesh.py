@@ -416,6 +416,7 @@ class _FakeRuntime:
     def __init__(self, slots=1):
         self._slots = ["exec-worker-%d" % i for i in range(slots)]
         self.added = []
+        self.removed = []
 
     def live_worker_slots(self):
         return list(self._slots)
@@ -425,6 +426,11 @@ class _FakeRuntime:
         self._slots.append(eid)
         self.added.append(reason)
         return eid
+
+    def remove_host(self, executor_id, reason=""):
+        self._slots.remove(executor_id)
+        self.removed.append((executor_id, reason))
+        return []
 
 
 def test_autoscaler_fires_on_queue_pressure(monkeypatch):
@@ -468,6 +474,102 @@ def test_autoscaler_cooldown_and_gates(monkeypatch):
     monkeypatch.setattr(rc, "active_cluster", lambda: None)
     a2 = ClusterAutoscaler(_autoscale_conf())
     assert a2.observe(queue_depth=99, inflight=0) is None
+
+
+def test_autoscaler_scale_down_after_sustained_idle(monkeypatch):
+    """queueDepthLow + zero inflight sustained past idleSec fires
+    remove_host on the NEWEST worker (LIFO), mirror of scale-up."""
+    import time as _time
+
+    from spark_rapids_tpu.runtime import cluster as rc
+    from spark_rapids_tpu.service.autoscaler import ClusterAutoscaler
+
+    fake = _FakeRuntime(slots=3)
+    monkeypatch.setattr(rc, "active_cluster", lambda: fake)
+    a = ClusterAutoscaler(_autoscale_conf(**{
+        "rapids.tpu.cluster.autoscale.queueDepthLow": 0,
+        "rapids.tpu.cluster.autoscale.idleSec": 10.0,
+    }))
+    # first idle observation only ARMS the window
+    assert a.observe(queue_depth=0, inflight=0) is None
+    assert a.scale_downs == 0
+    # window not yet elapsed -> no fire
+    a.observe(queue_depth=0, inflight=0)
+    assert a.scale_downs == 0
+    # backdate the window: sustained idle -> newest worker leaves
+    a._idle_since = _time.monotonic() - 100.0
+    a.observe(queue_depth=0, inflight=0)
+    assert a.scale_downs == 1
+    assert fake.live_worker_slots() == ["exec-worker-0",
+                                        "exec-worker-1"]
+    assert a.last_removed_executor_id == "exec-worker-2"
+    eid, reason = fake.removed[0]
+    assert eid == "exec-worker-2" and "autoscaler:" in reason
+    s = a.stats()
+    assert s["scale_downs"] == 1 and s["min_workers"] == 1
+
+
+def test_autoscaler_scale_down_gates(monkeypatch):
+    """Inflight work, queued work, cooldown, and the minWorkers floor
+    each hold a shrink back; negative queueDepthLow disables it."""
+    import time as _time
+
+    from spark_rapids_tpu.runtime import cluster as rc
+    from spark_rapids_tpu.service.autoscaler import ClusterAutoscaler
+
+    fake = _FakeRuntime(slots=2)
+    monkeypatch.setattr(rc, "active_cluster", lambda: fake)
+    a = ClusterAutoscaler(_autoscale_conf(**{
+        "rapids.tpu.cluster.autoscale.queueDepthLow": 0,
+        "rapids.tpu.cluster.autoscale.idleSec": 0.0,
+        "rapids.tpu.cluster.autoscale.minWorkers": 1,
+    }))
+    # inflight work resets the idle window entirely
+    a._idle_since = _time.monotonic() - 100.0
+    assert a.observe(queue_depth=0, inflight=1) is None
+    assert a._idle_since is None and a.scale_downs == 0
+    # idleSec=0: arm on the first idle pump, fire on the second
+    a.observe(queue_depth=0, inflight=0)
+    a.observe(queue_depth=0, inflight=0)
+    assert a.scale_downs == 1
+    # at the floor: never below minWorkers
+    a.observe(queue_depth=0, inflight=0)
+    a.observe(queue_depth=0, inflight=0)
+    assert a.scale_downs == 1
+    assert fake.live_worker_slots() == ["exec-worker-0"]
+    # default conf: queueDepthLow < 0 -> scale-down disabled outright
+    fake2 = _FakeRuntime(slots=3)
+    monkeypatch.setattr(rc, "active_cluster", lambda: fake2)
+    b = ClusterAutoscaler(_autoscale_conf(**{
+        "rapids.tpu.cluster.autoscale.idleSec": 0.0}))
+    for _ in range(4):
+        b.observe(queue_depth=0, inflight=0)
+    assert b.scale_downs == 0 and len(fake2.live_worker_slots()) == 3
+
+
+def test_autoscaler_scale_down_cooldown_spans_directions(monkeypatch):
+    """The cooldown is shared across scale directions: a fresh
+    scale-up holds the next scale-down back (flap damping)."""
+    import time as _time
+
+    from spark_rapids_tpu.runtime import cluster as rc
+    from spark_rapids_tpu.service.autoscaler import ClusterAutoscaler
+
+    fake = _FakeRuntime(slots=1)
+    monkeypatch.setattr(rc, "active_cluster", lambda: fake)
+    a = ClusterAutoscaler(_autoscale_conf(**{
+        "rapids.tpu.cluster.autoscale.cooldownSec": 3600.0,
+        "rapids.tpu.cluster.autoscale.queueDepthLow": 0,
+        "rapids.tpu.cluster.autoscale.idleSec": 0.0,
+    }))
+    assert a.observe(queue_depth=5, inflight=0) is not None  # scale up
+    a._idle_since = _time.monotonic() - 100.0
+    a.observe(queue_depth=0, inflight=0)
+    assert a.scale_downs == 0  # inside the shared cooldown
+    a._last_at = _time.monotonic() - 7200.0  # cooldown elapses
+    a._idle_since = _time.monotonic() - 100.0
+    a.observe(queue_depth=0, inflight=0)
+    assert a.scale_downs == 1
 
 
 # ---------------------------------------------------------------------------

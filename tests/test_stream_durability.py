@@ -1,0 +1,364 @@
+"""Streaming durability (PR 19): checkpoint + ingest WAL + exactly-once
+restart recovery. The load-bearing fences:
+
+- EXACTLY-ONCE: stop a durable session (suspend + final checkpoint),
+  start a fresh one against the same checkpoint dir, re-create the
+  table (WAL replay) and re-register the query (checkpoint restore):
+  every delta folds exactly once ACROSS the restart, and the answer is
+  bit-exact against the batch oracle over all appended data.
+- TORN ARTIFACTS: a checkpoint that lost its atomic rename is rejected
+  on CRC and recovery falls back — older checkpoint, then full WAL
+  refold. A WAL record torn at the TAIL is truncated and tolerated; a
+  bad record MID-log (valid data after it) raises a loud
+  WalCorruptionError — never silent data loss.
+- ACCOUNTING: in-flight durability bytes (unsynced WAL, queued async
+  checkpoint blobs) charge the service admission budget; every
+  recovery surface has a counter.
+
+The SIGKILL (kill -9 mid-fold) variant of the exactly-once fence needs
+a real process death and lives in scripts/stream_durability_check.py
+(recorded as STREAM_r02.json).
+"""
+import os
+import struct
+import threading
+import zlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.api import Session
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import Schema
+from spark_rapids_tpu.memory.catalog import SpillCorruptionError
+from spark_rapids_tpu.service.streaming import stats as sstats
+from spark_rapids_tpu.service.streaming.durability import (
+    CheckpointStore, StreamWal, WalCorruptionError, safe_name)
+from spark_rapids_tpu.service.streaming.standing import (EMITTING, FAILED,
+                                                         SUSPENDED)
+from spark_rapids_tpu.service.types import QueryCancelled
+from spark_rapids_tpu.shuffle.fault_injection import get_injector
+
+from tests.compare import assert_frames_equal
+
+SCHEMA = Schema(["k", "v"], [dt.INT64, dt.INT64])
+AGG_SQL = ("SELECT k, SUM(v) AS sv, COUNT(v) AS c "
+           "FROM events GROUP BY k")
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    get_injector().disarm()
+    yield
+    get_injector().disarm()
+
+
+def _batch(seed, n=300, nk=9):
+    rng = np.random.default_rng(seed)
+    return {"k": rng.integers(0, nk, n).astype(np.int64),
+            "v": rng.integers(0, 1000, n).astype(np.int64)}
+
+
+def _oracle(nbatches, **kw):
+    frame = pd.concat([pd.DataFrame(_batch(i, **kw))
+                       for i in range(nbatches)], ignore_index=True)
+    return frame.groupby("k").agg(
+        sv=("v", "sum"), c=("v", "count")).reset_index()
+
+
+def _durable_session(tmp_path, **extra):
+    conf = {cfg.STREAMING_CHECKPOINT_DIR.key: str(tmp_path / "ckpt")}
+    conf.update(extra)
+    s = Session(conf)
+    src = s.create_streaming_table("events", SCHEMA)
+    return s, src
+
+
+# -- WAL unit fences --------------------------------------------------------
+
+
+def test_wal_roundtrip(tmp_path):
+    wal = StreamWal(str(tmp_path))
+    for i in range(3):
+        b = _batch(i, n=50)
+        wal.append(i, b, {}, 50)
+    wal.close()
+    records = StreamWal(str(tmp_path)).replay()
+    assert [r[0] for r in records] == [0, 1, 2]
+    for i, (_seq, data, _validity, n) in enumerate(records):
+        assert n == 50
+        np.testing.assert_array_equal(data["k"], _batch(i, n=50)["k"])
+
+
+def test_wal_torn_tail_tolerated(tmp_path):
+    wal = StreamWal(str(tmp_path))
+    for i in range(3):
+        wal.append(i, _batch(i, n=40), {}, 40)
+    wal.close()
+    size = os.path.getsize(wal.path)
+    with open(wal.path, "r+b") as fh:
+        fh.truncate(size - 7)  # rip the last record mid-body
+    pre = sstats.snapshot()
+    wal2 = StreamWal(str(tmp_path))
+    records = wal2.replay()
+    assert [r[0] for r in records] == [0, 1]
+    assert sstats.delta(pre)["torn_rejected"] == 1
+    # the torn bytes are gone: appends continue cleanly after them
+    wal2.append(2, _batch(2, n=40), {}, 40)
+    wal2.close()
+    assert [r[0] for r in StreamWal(str(tmp_path)).replay()] == [0, 1, 2]
+
+
+def test_wal_midlog_corruption_is_loud(tmp_path):
+    wal = StreamWal(str(tmp_path))
+    for i in range(3):
+        wal.append(i, _batch(i, n=40), {}, 40)
+    wal.close()
+    with open(wal.path, "r+b") as fh:
+        fh.seek(30)  # inside the FIRST record's body
+        fh.write(b"\xff\xfe")
+    with pytest.raises(WalCorruptionError, match="mid-log"):
+        StreamWal(str(tmp_path)).replay()
+
+
+def test_wal_undecodable_record_chains_cause(tmp_path):
+    """A record that passes CRC but fails to decode is corruption with
+    the underlying error CHAINED — the SpillCorruptionError idiom, so
+    the log says what actually broke."""
+    wal = StreamWal(str(tmp_path))
+    wal.append(0, _batch(0, n=10), {}, 10)
+    wal.close()
+    body = b"not a pickle at all"
+    with open(wal.path, "ab") as fh:
+        fh.write(struct.pack("<II", len(body), zlib.crc32(body)) + body)
+    with pytest.raises(WalCorruptionError) as ei:
+        StreamWal(str(tmp_path)).replay()
+    assert isinstance(ei.value, SpillCorruptionError)
+    assert ei.value.__cause__ is not None
+
+
+def test_wal_truncate_injection_models_torn_tail(tmp_path):
+    """The truncateWalAt ordinal persists half a record's frame; the
+    NEXT replay truncates it off and keeps everything before it."""
+    wal = StreamWal(str(tmp_path))
+    wal.append(0, _batch(0, n=30), {}, 30)
+    get_injector().arm(truncate_wal_at=1)
+    wal.append(1, _batch(1, n=30), {}, 30)  # torn mid-write
+    get_injector().disarm()
+    wal.close()
+    assert get_injector().stats()["armed"] is False
+    records = StreamWal(str(tmp_path)).replay()
+    assert [r[0] for r in records] == [0]
+
+
+# -- checkpoint store unit fences -------------------------------------------
+
+
+def test_checkpoint_roundtrip_and_retention(tmp_path):
+    store = CheckpointStore(str(tmp_path), retain=2)
+    for i in range(5):
+        store.write({"cursor": i}, f"payload{i}".encode())
+    meta, payload = store.load_latest()
+    assert meta["cursor"] == 4 and payload == b"payload4"
+    # retention keeps the newest 2 of the 5
+    assert store.checkpoint_count() == 2
+
+
+def test_checkpoint_torn_falls_back_to_older(tmp_path):
+    store = CheckpointStore(str(tmp_path), retain=4)
+    store.write({"cursor": 1}, b"older")
+    get_injector().arm(torn_checkpoint_at=1)
+    store.write({"cursor": 2}, b"newer-but-torn")
+    get_injector().disarm()
+    pre = sstats.snapshot()
+    meta, payload = store.load_latest()
+    assert meta["cursor"] == 1 and payload == b"older"
+    assert sstats.delta(pre)["torn_rejected"] == 1
+    # seq allocation continues past the torn file
+    store.write({"cursor": 3}, b"newest")
+    assert store.load_latest()[0]["cursor"] == 3
+
+
+def test_safe_name_collision_free():
+    a, b = safe_name("ev/nts"), safe_name("ev:nts")
+    assert a != b and "/" not in a and ":" not in b
+
+
+# -- restart recovery (exactly-once) ----------------------------------------
+
+
+def test_restart_recovers_exactly_once(tmp_path):
+    """Stop -> new Session -> replay + restore -> continue: every delta
+    folds exactly once across the restart, counters tell the story."""
+    s, src = _durable_session(tmp_path)
+    sq = s.service.register_standing(s.sql(AGG_SQL), name="q")
+    for i in range(4):
+        s.append_batch("events", _batch(i))
+    assert sq.folds == 4
+    s.stop()
+    assert sq.state == SUSPENDED
+    with pytest.raises(QueryCancelled, match="suspended"):
+        sq.results()
+
+    pre = sstats.snapshot()
+    s2, src2 = _durable_session(tmp_path)
+    assert src2.num_appends == 4  # WAL replay rebuilt the table
+    assert s2.service.recovery_report["tables"]
+    sq2 = s2.service.register_standing(s2.sql(AGG_SQL), name="q")
+    assert sq2.state == EMITTING
+    assert sq2.folds == 4  # restored, NOT refolded
+    for i in range(4, 6):
+        s2.append_batch("events", _batch(i))
+    assert sq2.folds == 6
+    assert_frames_equal(_oracle(6), sq2.results())
+    # the batch engine over the replayed table is the same oracle
+    assert_frames_equal(s2.sql(AGG_SQL).to_pandas(), sq2.results())
+    d = sstats.delta(pre)
+    assert d["wal_replays"] == 1 and d["recoveries"] == 1
+    assert d["folds"] == 2  # exactly the post-restart deltas
+    s2.stop()
+
+
+def test_restart_without_checkpoint_refolds_from_wal(tmp_path):
+    """Every checkpoint torn -> recovery rejects them all and falls
+    back to a full refold of the replayed WAL — still bit-exact."""
+    s, _src = _durable_session(tmp_path)
+    get_injector().arm(torn_checkpoint_at=1, consecutive=10 ** 6)
+    sq = s.service.register_standing(s.sql(AGG_SQL), name="q")
+    for i in range(3):
+        s.append_batch("events", _batch(i))
+    s.stop()  # the final checkpoint tears too
+    get_injector().disarm()
+    assert sq.state == SUSPENDED
+
+    pre = sstats.snapshot()
+    s2, src2 = _durable_session(tmp_path)
+    sq2 = s2.service.register_standing(s2.sql(AGG_SQL), name="q")
+    d = sstats.delta(pre)
+    assert d["torn_rejected"] >= 1 and d["recoveries"] == 0
+    assert sq2.folds == 3  # full refold of the WAL deltas
+    assert_frames_equal(_oracle(3), sq2.results())
+    s2.stop()
+
+
+def test_changed_plan_signature_refolds(tmp_path):
+    """A checkpoint from a DIFFERENT query shape must not be adopted
+    under the same name — signature mismatch falls back to refold."""
+    s, _src = _durable_session(tmp_path)
+    s.service.register_standing(s.sql(AGG_SQL), name="q")
+    for i in range(2):
+        s.append_batch("events", _batch(i))
+    s.stop()
+
+    s2, _src2 = _durable_session(tmp_path)
+    other = "SELECT k, SUM(v) AS total FROM events GROUP BY k"
+    pre = sstats.snapshot()
+    sq2 = s2.service.register_standing(s2.sql(other), name="q")
+    assert sstats.delta(pre)["recoveries"] == 0
+    assert sq2.folds == 2  # refolded, not restored
+    oracle = pd.concat([pd.DataFrame(_batch(i)) for i in range(2)],
+                       ignore_index=True).groupby("k").agg(
+        total=("v", "sum")).reset_index()
+    assert_frames_equal(oracle, sq2.results())
+    s2.stop()
+
+
+def test_state_overflow_writes_final_checkpoint(tmp_path):
+    """maxStateBytes failure parks a RESTARTABLE query: the final
+    checkpoint covers the fold that tripped the bound, so a restart
+    with a raised budget resumes instead of refolding everything."""
+    s, _src = _durable_session(tmp_path)
+    pre = sstats.snapshot()
+    sq = s.service.register_standing(s.sql(AGG_SQL), name="q",
+                                     max_state_bytes=1)
+    s.append_batch("events", _batch(0))
+    assert sq.state == FAILED
+    assert isinstance(sq.error, Exception)
+    assert sstats.delta(pre)["final_checkpoints"] == 1
+    s.stop()
+
+    pre = sstats.snapshot()
+    s2, _src2 = _durable_session(tmp_path)
+    sq2 = s2.service.register_standing(s2.sql(AGG_SQL), name="q")
+    assert sstats.delta(pre)["recoveries"] == 1
+    assert sq2.folds == 1  # the overflowed fold is NOT refolded
+    s2.append_batch("events", _batch(1))
+    assert_frames_equal(_oracle(2), sq2.results())
+    s2.stop()
+
+
+def test_concurrent_ingest_during_checkpoint(tmp_path):
+    """Threaded ingest with per-fold async checkpoints: the sequence
+    cursor keeps WAL order = fold order, and a restart lands bit-exact
+    whatever interleaving the writer thread saw."""
+    s, _src = _durable_session(tmp_path)
+    s.service.register_standing(s.sql(AGG_SQL), name="q")
+    errors = []
+
+    def feed(lo, hi):
+        try:
+            for i in range(lo, hi):
+                s.append_batch("events", _batch(i, n=120))
+        except Exception as e:  # noqa: BLE001 - surfaced via errors
+            errors.append(e)
+
+    threads = [threading.Thread(target=feed, args=(lo, lo + 3))
+               for lo in (0, 3, 6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    s.stop()
+
+    s2, src2 = _durable_session(tmp_path)
+    assert src2.num_appends == 9
+    sq2 = s2.service.register_standing(s2.sql(AGG_SQL), name="q")
+    assert sq2.folds == 9
+    assert_frames_equal(_oracle(9, n=120), sq2.results())
+    s2.stop()
+
+
+def test_checkpoint_retention_prunes_files(tmp_path):
+    s, _src = _durable_session(
+        tmp_path, **{cfg.STREAMING_CHECKPOINT_RETAIN.key: "2"})
+    s.service.register_standing(s.sql(AGG_SQL), name="q")
+    for i in range(6):
+        s.append_batch("events", _batch(i, n=80))
+    dur = s.service.streaming.durability
+    dur.drain()
+    store = dur.store_for("events", "q")
+    assert store.checkpoint_count() <= 2
+    s.stop()
+
+
+def test_durability_bytes_charge_admission(tmp_path):
+    """Unsynced WAL bytes are part of the service's extra admission
+    charge (the same ledger cached fragments and streaming state
+    use)."""
+    s, _src = _durable_session(
+        tmp_path, **{cfg.STREAMING_CHECKPOINT_WAL_SYNC.key: "1000"})
+    svc = s.service
+    svc.register_standing(s.sql(AGG_SQL), name="q")
+    s.append_batch("events", _batch(0))
+    pending = svc.streaming.durability_pending_bytes()
+    assert pending > 0  # fsync batched: the tail is still in flight
+    assert svc.admission.extra_bytes_fn() >= pending
+    s.stop()
+    # drain+close fsync'd everything
+    assert svc.streaming.durability_pending_bytes() == 0
+
+
+def test_non_durable_session_unchanged(tmp_path):
+    """No checkpoint dir -> no WAL, no checkpoint files, cancel (not
+    suspend) at shutdown — the PR 14 behavior exactly."""
+    s = Session()
+    s.create_streaming_table("events", SCHEMA)
+    sq = s.service.register_standing(s.sql(AGG_SQL), name="q")
+    s.append_batch("events", _batch(0))
+    assert not s.service.streaming.durability.enabled
+    assert s.service.streaming.durability_pending_bytes() == 0
+    s.stop()
+    assert sq.state != SUSPENDED
