@@ -266,6 +266,7 @@ def bench_full_query(benchmark: str = "tpcxbb_q26", sf: float = 0.1,
         # every AQE replan the run made (skew splits/salting, strategy
         # switches, re-bucketing) with counts; empty = static plan ran
         "replan_events": res.get("replan_events"),
+        "io_scan": res.get("io_scan"),
         # generator provenance: a skewed record names its distribution
         # so the JSON alone says what data produced these numbers
         "skew_params": {

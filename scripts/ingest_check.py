@@ -1,0 +1,215 @@
+"""Ingest fence: the async scan pipeline must be a pure performance
+knob — oracle-equal answers with measured scan-compute overlap and
+footer-stat pruning that actually cuts bytes (CLI twin of
+tests/test_scan_pipeline.py, run at real scale).
+
+Four checks over TPC-H at sf >= 10:
+
+  1. **q1_oracle_overlap** : q1 through the pipelined scan matches the
+                       CPU oracle AND the measured scan-compute overlap
+                       fraction (decode busy time hidden behind the
+                       consumer, from the io.scan telemetry block) is
+                       >= 0.5 — the scan wall is paid concurrently with
+                       compute, not in front of it
+  2. **q6_pruning**    : q6's pushed-down shipdate range prunes row
+                       groups by footer stats; bytes_read with
+                       pruning.enabled=false must be >= 2x the pruned
+                       run's (the datagen writes lineitem time-ordered,
+                       so a 1-year predicate keeps a fraction of the
+                       7-year span). Both runs match the oracle.
+  3. **depth0_identity**: prefetch.depth=0 (the strict synchronous
+                       read-then-upload path) and the default pipelined
+                       depth produce byte-identical batches — same
+                       boundaries, same buffer bytes
+  4. **depth0_oracle** : q1 with depth=0 still matches the oracle (the
+                       pipeline is not load-bearing for correctness)
+
+    python scripts/ingest_check.py [--sf 10] [--data-dir DIR]
+                                   [--output INGEST_r01.json]
+
+Prints one JSON report; exit code 0 = fence holds.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# telemetry must wrap jax.jit before any compute module import
+from spark_rapids_tpu.utils import dispatch as disp  # noqa: E402
+
+disp.install()
+
+MIN_OVERLAP = 0.5
+MIN_PRUNE_RATIO = 2.0
+
+
+def _run(benchmark: str, runner, conf, compare: bool = True) -> dict:
+    """One cold-ish run: scan telemetry delta + oracle comparison."""
+    from spark_rapids_tpu.execs.base import collect
+    from spark_rapids_tpu.io import scanpipe
+    from spark_rapids_tpu.benchmarks.runner import ALL_BENCHMARKS
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    scanpipe.clear_cache()
+    pre = scanpipe.snapshot()
+    plan = ALL_BENCHMARKS[benchmark](runner.data_dir)
+    t0 = time.perf_counter()
+    df = collect(apply_overrides(plan, conf), conf)
+    wall = time.perf_counter() - t0
+    scan = scanpipe.delta(pre)
+    rec = {"benchmark": benchmark, "wall_s": round(wall, 3),
+           "io_scan": scan}
+    if compare:
+        cmp_ = runner.compare_results(benchmark, df)
+        rec["matches_cpu"] = cmp_["matches_cpu"]
+        rec["cpu_oracle_s"] = round(cmp_["cpu_time_sec"], 3)
+        rec["detail"] = cmp_.get("detail", "")
+    return rec
+
+
+def _depth0_identity(data_dir: str, conf) -> dict:
+    """Batch-by-batch byte comparison of the synchronous (depth=0) and
+    pipelined scans over the first lineitem split."""
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.io import ParquetSource, arrow_conv
+    from spark_rapids_tpu.plan import nodes as pn
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+
+    path = os.path.join(data_dir, "lineitem")
+    cols = ["l_quantity", "l_extendedprice", "l_discount", "l_shipdate",
+            "l_returnflag", "l_linestatus"]
+
+    def batches(depth):
+        c = conf.with_overrides({cfg.SCAN_PREFETCH_DEPTH.key: depth})
+        src = ParquetSource(path, columns=cols, conf=c)
+        exec_ = apply_overrides(pn.ScanNode(src), c)
+        out = []
+        for b in exec_.execute(0):   # first split is plenty of bytes
+            if b.realized_num_rows():
+                out.append(arrow_conv.batch_to_arrow(b, exec_.schema))
+        return out
+
+    sync_b, async_b = batches(0), batches(2)
+    rows = sum(t.num_rows for t in sync_b)
+    mismatch = None
+    if len(sync_b) != len(async_b):
+        mismatch = (f"batch count differs: depth0={len(sync_b)} "
+                    f"depth2={len(async_b)}")
+    else:
+        for i, (a, b) in enumerate(zip(sync_b, async_b)):
+            if a.num_rows != b.num_rows:
+                mismatch = f"batch {i} rows {a.num_rows}!={b.num_rows}"
+                break
+            for name in a.column_names:
+                ca = a.column(name).combine_chunks()
+                cb = b.column(name).combine_chunks()
+                for ba, bb in zip(ca.buffers(), cb.buffers()):
+                    if (ba is None) != (bb is None) or (
+                            ba is not None and
+                            ba.to_pybytes() != bb.to_pybytes()):
+                        mismatch = f"batch {i} column {name}: " \
+                                   f"buffer bytes differ"
+                        break
+                if mismatch:
+                    break
+            if mismatch:
+                break
+    return {"batches": len(sync_b), "rows": int(rows),
+            "mismatch": mismatch,
+            "ok": bool(mismatch is None and sync_b)}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--sf", type=float, default=10.0,
+                        help="TPC-H scale factor (fence requires >= 10)")
+    parser.add_argument("--data-dir", default="bench_data",
+                        help="where TPC-H tables live / get generated")
+    parser.add_argument("--output", default="INGEST_r01.json")
+    args = parser.parse_args(argv)
+
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.benchmarks.runner import BenchmarkRunner
+
+    r = BenchmarkRunner(args.data_dir, args.sf)
+    t0 = time.perf_counter()
+    r.ensure_data("tpch")
+    gen_s = time.perf_counter() - t0
+
+    conf = r.conf
+
+    # -- 1. q1: oracle + overlap through the default pipelined scan ----
+    q1 = _run("tpch_q1", r, conf)
+    overlap = (q1["io_scan"] or {}).get("overlap_fraction")
+    q1_ok = bool(q1["matches_cpu"] and overlap is not None and
+                 overlap >= MIN_OVERLAP)
+
+    # -- 2. q6: pruned vs unpruned bytes-read differential -------------
+    q6_pruned = _run("tpch_q6", r, conf)
+    no_prune = conf.with_overrides(
+        {cfg.SCAN_PRUNING_ENABLED.key: False})
+    q6_full = _run("tpch_q6", r, no_prune)
+    read_pruned = q6_pruned["io_scan"]["bytes_read"]
+    read_full = q6_full["io_scan"]["bytes_read"]
+    ratio = read_full / max(read_pruned, 1)
+    q6_ok = bool(q6_pruned["matches_cpu"] and q6_full["matches_cpu"] and
+                 q6_pruned["io_scan"]["chunks_pruned"] > 0 and
+                 ratio >= MIN_PRUNE_RATIO)
+
+    # -- 3. depth=0 byte-identical to the pipelined scan ---------------
+    ident = _depth0_identity(args.data_dir, conf)
+
+    # -- 4. q1 with depth=0: the synchronous path stays oracle-equal ---
+    sync_conf = conf.with_overrides({cfg.SCAN_PREFETCH_DEPTH.key: 0})
+    q1_sync = _run("tpch_q1", r, sync_conf)
+
+    checks = {
+        "q1_oracle_overlap": {
+            "matches_cpu": q1["matches_cpu"],
+            "overlap_fraction": overlap,
+            "threshold": MIN_OVERLAP,
+            "ok": q1_ok,
+        },
+        "q6_pruning": {
+            "matches_cpu": bool(q6_pruned["matches_cpu"] and
+                                q6_full["matches_cpu"]),
+            "bytes_read_pruned": int(read_pruned),
+            "bytes_read_unpruned": int(read_full),
+            "reduction_ratio": round(ratio, 3),
+            "chunks_pruned": q6_pruned["io_scan"]["chunks_pruned"],
+            "threshold": MIN_PRUNE_RATIO,
+            "ok": q6_ok,
+        },
+        "depth0_identity": ident,
+        "depth0_oracle": {
+            "matches_cpu": q1_sync["matches_cpu"],
+            "ok": bool(q1_sync["matches_cpu"]),
+        },
+    }
+    report = {
+        "benchmark": "ingest_check",
+        "sf": args.sf,
+        "datagen_s": round(gen_s, 3),
+        "runs": {"q1": q1, "q6_pruned": q6_pruned, "q6_unpruned": q6_full,
+                 "q1_depth0": q1_sync},
+        "checks": checks,
+        "ok": all(c["ok"] for c in checks.values()),
+    }
+    text = json.dumps(report, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    print(text)
+    if not report["ok"]:
+        print("INGEST FENCE FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,15 +76,23 @@ def _skewed_orderkeys(rng, orderkey: np.ndarray, skew: float
     return out
 
 
-def gen_lineitem(sf: float, seed: int = 11, skew: float = 0.0
-                 ) -> pa.Table:
-    rng = np.random.default_rng(seed)
-    n = max(int(6_000_000 * sf), 100)
+def _lineitem_chunk(rng, n: int, sf: float, skew: float,
+                    date_window=None) -> pa.Table:
+    """One lineitem block with the EXACT legacy rng draw order (the
+    whole-table generator routes through here, so small scale factors
+    stay byte-identical). ``date_window`` = (lo_day, hi_day) epoch-day
+    bounds for l_shipdate — the chunked path gives each chunk a
+    consecutive window (time-ordered ingest), which is what makes
+    row-group shipdate pruning effective on generated data."""
     orderkey = rng.integers(1, max(int(1_500_000 * sf), 25) * 4, n)
     if skew:
         # cap so the rank fractions sum below 1 (sum(1/j^2) < 1.645)
         orderkey = _skewed_orderkeys(rng, orderkey, min(skew, 0.6))
-    shipdate = _dates(rng, n)
+    if date_window is None:
+        shipdate = _dates(rng, n)
+    else:
+        lo, hi = date_window
+        shipdate = rng.integers(lo, hi + 1, n).astype("datetime64[D]")
     commit_delta = rng.integers(-30, 61, n)
     receipt_delta = rng.integers(1, 31, n)
     return pa.table({
@@ -107,6 +115,12 @@ def gen_lineitem(sf: float, seed: int = 11, skew: float = 0.0
             ["DELIVER IN PERSON", "COLLECT COD", "NONE",
              "TAKE BACK RETURN"], dtype=object)[rng.integers(0, 4, n)],
     })
+
+
+def gen_lineitem(sf: float, seed: int = 11, skew: float = 0.0
+                 ) -> pa.Table:
+    n = max(int(6_000_000 * sf), 100)
+    return _lineitem_chunk(np.random.default_rng(seed), n, sf, skew)
 
 
 def gen_orders(sf: float, seed: int = 12) -> pa.Table:
@@ -213,25 +227,197 @@ GENERATORS = {
     "partsupp": gen_partsupp,
 }
 
+#: rows one generation chunk materializes at most (~8.4M): large scale
+#: factors stream chunk-by-chunk through io/write.write_table_stream
+#: instead of building the whole table in host memory (sf100 lineitem
+#: is 600M rows — one table would OOM the driver). Tables at or under
+#: this take the legacy whole-table path, byte-identical to before.
+CHUNK_ROWS = 1 << 23
+
+_SEEDS = {"lineitem": 11, "orders": 12, "customer": 13, "supplier": 14,
+          "nation": 15, "region": 16, "part": 17, "partsupp": 18}
+
+
+def table_rows(name: str, sf: float) -> int:
+    """Row count ``name`` generates at ``sf`` (no generation)."""
+    return {
+        "lineitem": max(int(6_000_000 * sf), 100),
+        "orders": max(int(1_500_000 * sf), 25),
+        "customer": max(int(150_000 * sf), 10),
+        "supplier": max(int(10_000 * sf), 5),
+        "nation": 25,
+        "region": 5,
+        "part": max(int(200_000 * sf), 10),
+        "partsupp": max(int(200_000 * sf), 10) * 4,
+    }[name]
+
+
+def _orders_chunk(rng, start, cnt, sf) -> pa.Table:
+    return pa.table({
+        "o_orderkey": np.arange(start + 1, start + cnt + 1,
+                                dtype=np.int64) * 4,
+        "o_custkey": rng.integers(1, max(int(150_000 * sf), 10), cnt
+                                  ).astype(np.int64),
+        "o_totalprice": np.round(rng.random(cnt) * 400_000 + 800, 2),
+        "o_orderdate": _dates(rng, cnt),
+        "o_orderpriority": PRIORITIES[rng.integers(0, 5, cnt)],
+        "o_orderstatus": np.array(["F", "O", "P"], dtype=object)[
+            rng.integers(0, 3, cnt)],
+        "o_shippriority": np.zeros(cnt, dtype=np.int32),
+    })
+
+
+def _customer_chunk(rng, start, cnt, sf) -> pa.Table:
+    return pa.table({
+        "c_custkey": np.arange(start + 1, start + cnt + 1,
+                               dtype=np.int64),
+        "c_mktsegment": SEGMENTS[rng.integers(0, 5, cnt)],
+        "c_acctbal": np.round(rng.random(cnt) * 11_000 - 1_000, 2),
+        "c_nationkey": rng.integers(0, 25, cnt).astype(np.int64),
+        "c_name": np.array([f"Customer#{i:09d}"
+                            for i in range(start + 1, start + cnt + 1)],
+                           dtype=object),
+        "c_phone": np.array(
+            [f"{rng.integers(10, 35)}-{i % 900 + 100}-{i % 9000 + 1000}"
+             for i in range(start, start + cnt)], dtype=object),
+    })
+
+
+def _supplier_chunk(rng, start, cnt, sf) -> pa.Table:
+    return pa.table({
+        "s_suppkey": np.arange(start + 1, start + cnt + 1,
+                               dtype=np.int64),
+        "s_nationkey": rng.integers(0, 25, cnt).astype(np.int64),
+        "s_acctbal": np.round(rng.random(cnt) * 11_000 - 1_000, 2),
+    })
+
+
+def _part_chunk(rng, start, cnt, sf) -> pa.Table:
+    t1 = P_TYPES_1[rng.integers(0, 6, cnt)]
+    t2 = P_TYPES_2[rng.integers(0, 5, cnt)]
+    t3 = P_TYPES_3[rng.integers(0, 5, cnt)]
+    c1 = P_CONTAINERS_1[rng.integers(0, 5, cnt)]
+    c2 = P_CONTAINERS_2[rng.integers(0, 8, cnt)]
+    return pa.table({
+        "p_partkey": np.arange(start + 1, start + cnt + 1,
+                               dtype=np.int64),
+        "p_brand": np.array(
+            [f"Brand#{b}" for b in rng.integers(11, 56, cnt)],
+            dtype=object),
+        "p_type": np.array([f"{a} {b} {c}" for a, b, c in
+                            zip(t1, t2, t3)], dtype=object),
+        "p_size": rng.integers(1, 51, cnt).astype(np.int32),
+        "p_container": np.array([f"{a} {b}" for a, b in zip(c1, c2)],
+                                dtype=object),
+    })
+
+
+def _partsupp_chunk(rng, start, cnt, sf) -> pa.Table:
+    # global row r maps to partkey r//4 + 1 for ANY chunk start — no
+    # boundary alignment needed
+    n_supp = max(int(10_000 * sf), 5)
+    idx = np.arange(start, start + cnt, dtype=np.int64)
+    return pa.table({
+        "ps_partkey": idx // 4 + 1,
+        "ps_suppkey": rng.integers(1, n_supp + 1, cnt).astype(np.int64),
+        "ps_availqty": rng.integers(1, 10_000, cnt).astype(np.int32),
+        "ps_supplycost": np.round(rng.random(cnt) * 1_000 + 1, 2),
+    })
+
+
+_EPOCH = np.datetime64("1970-01-01")
+_CHUNK_FNS = {
+    "orders": _orders_chunk,
+    "customer": _customer_chunk,
+    "supplier": _supplier_chunk,
+    "part": _part_chunk,
+    "partsupp": _partsupp_chunk,
+}
+
+
+def gen_table_chunks(name: str, sf: float, skew: float = 0.0,
+                     chunk_rows: int = 0):
+    """Yield ``name``'s rows as bounded-size arrow tables. At or under
+    ``chunk_rows`` this is exactly one legacy whole-table chunk; above
+    it, per-chunk rngs seeded ``[seed, chunk_index]`` keep generation
+    deterministic without a single giant draw. Chunked lineitem gives
+    each chunk a consecutive l_shipdate window (time-ordered ingest,
+    like real fact tables land) so footer-stat pruning on shipdate has
+    real row-group locality to exploit."""
+    chunk_rows = chunk_rows or CHUNK_ROWS  # module global: patchable
+    n = table_rows(name, sf)
+    seed = _SEEDS[name]
+    if n <= chunk_rows or name not in ("lineitem", *_CHUNK_FNS):
+        if name == "lineitem" and skew:
+            yield gen_lineitem(sf, skew=skew)
+        else:
+            yield GENERATORS[name](sf)
+        return
+    nchunks = -(-n // chunk_rows)
+    lo = (np.datetime64("1992-01-01") - _EPOCH).astype(int)
+    hi = (np.datetime64("1998-12-31") - _EPOCH).astype(int)
+    span = hi - lo + 1
+    start = 0
+    for ci in range(nchunks):
+        cnt = min(chunk_rows, n - start)
+        rng = np.random.default_rng([seed, ci])
+        if name == "lineitem":
+            window = (lo + (span * ci) // nchunks,
+                      lo + (span * (ci + 1)) // nchunks - 1)
+            yield _lineitem_chunk(rng, cnt, sf, skew, window)
+        else:
+            yield _CHUNK_FNS[name](rng, start, cnt, sf)
+        start += cnt
+
 
 def write_tables(data_dir: str, sf: float, tables=None,
                  files_per_table: int = 4, skew: float = 0.0) -> None:
     """Generate and write parquet (multi-file: scan splits become TPU scan
     partitions, like the reference's multi-file parquet layout).
     ``skew`` > 0 concentrates lineitem's l_orderkey onto a few hot keys
-    (see :func:`_skewed_orderkeys`); other tables are unaffected."""
+    (see :func:`_skewed_orderkeys`); other tables are unaffected.
+
+    Tables above CHUNK_ROWS stream chunk-by-chunk through
+    io/write.write_table_stream — peak host memory is one chunk, so
+    sf100 generation cannot OOM the driver."""
+    import itertools
+
+    from spark_rapids_tpu.io.write import write_table_stream
+
     os.makedirs(data_dir, exist_ok=True)
     for name in tables or GENERATORS:
-        if name == "lineitem" and skew:
-            table = gen_lineitem(sf, skew=skew)
-        else:
-            table = GENERATORS[name](sf)
         tdir = os.path.join(data_dir, name)
         os.makedirs(tdir, exist_ok=True)
-        n = table.num_rows
+        n = table_rows(name, sf)
         per = -(-n // files_per_table)
-        for i in range(files_per_table):
-            chunk = table.slice(i * per, per)
-            if chunk.num_rows:
-                pq.write_table(chunk,
-                               os.path.join(tdir, f"part-{i:03d}.parquet"))
+        if n <= CHUNK_ROWS:
+            # legacy whole-table path, byte-identical for small sf
+            if name == "lineitem" and skew:
+                table = gen_lineitem(sf, skew=skew)
+            else:
+                table = GENERATORS[name](sf)
+            for i in range(files_per_table):
+                chunk = table.slice(i * per, per)
+                if chunk.num_rows:
+                    pq.write_table(chunk, os.path.join(
+                        tdir, f"part-{i:03d}.parquet"))
+            continue
+
+        def pieces():
+            """(file_index, sub-table) in row order: chunks are cut at
+            the same contiguous per-file boundaries the legacy slicing
+            used, without materializing the table."""
+            row = 0
+            for t in gen_table_chunks(name, sf, skew=skew):
+                off = 0
+                while off < t.num_rows:
+                    fi = row // per
+                    take = min(per - row % per, t.num_rows - off)
+                    yield fi, t.slice(off, take)
+                    off += take
+                    row += take
+
+        for fi, group in itertools.groupby(pieces(), key=lambda p: p[0]):
+            write_table_stream(
+                (t for _, t in group),
+                os.path.join(tdir, f"part-{fi:03d}.parquet"))

@@ -139,6 +139,9 @@ class BenchmarkRunner:
         # execs.adaptive; the dispatch module passes through so the
         # telemetry consumers snapshot from one place)
         run_pre_replan = disp.replan_snapshot()
+        # scan-pipeline activity over the run (io/scanpipe counters:
+        # bytes read vs pruned, decode/h2d seconds, overlap fraction)
+        run_pre_scan = disp.scan_snapshot()
         # run-relative snapshots: totals, per-site map, catalog spill
         # counters and injector counts all report DELTAS over this run
         # — a second benchmark in the same process must not inherit the
@@ -203,6 +206,9 @@ class BenchmarkRunner:
         # switches, re-bucketing), with counts — zeros/empty when the
         # static plan ran unchanged
         result["replan_events"] = disp.replan_delta(run_pre_replan)
+        # ingest telemetry: how much the scan layer read, what pruning
+        # saved, and how much of the read+pack hid behind compute
+        result["io_scan"] = disp.scan_delta(run_pre_scan)
         if telemetry and result["iterations"]:
             # the BASELINE.md-promised split: dispatch_count x RTT vs
             # time actually spent computing on the device

@@ -211,6 +211,43 @@ MAX_READER_BATCH_SIZE_BYTES = conf(
     "Soft cap on bytes per reader batch."
 ).bytes_conf.create_with_default(256 << 20)
 
+SCAN_PREFETCH_DEPTH = conf("rapids.tpu.io.scan.prefetch.depth").doc(
+    "Bounded depth of the async scan pipeline's packed-slice queue "
+    "(io/scanpipe.py): an IO thread reads and packs up to this many "
+    "slices ahead of the device upload, so decode and H2D transfer "
+    "hide behind downstream compute. 0 disables the pipeline entirely "
+    "(fully synchronous read->pack->upload on the caller thread — the "
+    "byte-identity reference path the ingest fence compares against). "
+    "Queued packed slices charge the service admission budget as "
+    "backpressure."
+).int_conf.create_with_default(2)
+
+SCAN_PRUNING_ENABLED = conf("rapids.tpu.io.scan.pruning.enabled").doc(
+    "Prune row groups (parquet) / stripes (ORC) whose footer min/max "
+    "statistics cannot match the pushed-down filters, BEFORE any data "
+    "byte is read. Pruning is conservative: chunks without statistics "
+    "are always kept, and the plan's FilterNode still applies exact "
+    "semantics. Disable to measure pruning effectiveness "
+    "(scripts/ingest_check.py does)."
+).boolean_conf.create_with_default(True)
+
+SCAN_LANDING_SPILLABLE = conf(
+    "rapids.tpu.io.scan.landing.spillable.enabled").doc(
+    "Land scan results as snapshot-versioned SpillableBatches in the "
+    "scan cache (keyed on per-file (mtime_ns, size)): a re-scan of "
+    "unchanged files hits warm device/host/disk tiers instead of the "
+    "filesystem. Cached bytes charge the service admission budget and "
+    "spill under the scan-cache priority before any query's working "
+    "batches."
+).boolean_conf.create_with_default(False)
+
+SCAN_MAX_PARTITION_BYTES = conf("rapids.tpu.io.scan.maxPartitionBytes").doc(
+    "Target on-disk bytes per scan partition (Spark's "
+    "sql.files.maxPartitionBytes): one file larger than this splits on "
+    "parquet row-group boundaries so a single giant file parallelizes "
+    "like many small ones, and small files pack together up to it."
+).bytes_conf.create_with_default(128 << 20)
+
 HBM_POOL_FRACTION = conf("rapids.tpu.memory.hbm.allocFraction").doc(
     "Fraction of HBM the framework may fill before spilling "
     "(RMM pool fraction analogue, RapidsConf.scala)."

@@ -16,7 +16,6 @@ from spark_rapids_tpu.execs.base import TpuExec, timed
 from spark_rapids_tpu.expressions.base import Expression
 from spark_rapids_tpu.expressions.compiler import (CompiledFilter,
                                                    CompiledProjection)
-from spark_rapids_tpu.memory import semaphore
 from spark_rapids_tpu.ops.concat import concat_batches
 from spark_rapids_tpu.plan.nodes import DataSource
 from spark_rapids_tpu.utils.tracing import TraceRange
@@ -29,12 +28,14 @@ class ScanExec(TpuExec):
     with multiple splits expose them as scan partitions (the reference's
     FilePartition -> task mapping).
 
-    Multi-slice scans run a two-stage async upload pipeline: a worker
-    thread does the pure-host pack (interop.pack_host) for slice k+1
-    while the consumer thread has already ISSUED slice k+1's device_put
-    before downstream programs of slice k are even pulled — so the
-    20-45 MB/s tunnel transfer of the next batch hides behind the
-    current batch's compute instead of serializing after it."""
+    The read itself runs through the bounded-depth async scan pipeline
+    (io/scanpipe.py): chunk-granular reads (row groups / stripes) are
+    re-sliced to exact batch_rows boundaries, packed on an IO thread,
+    and double-buffered through device_put — slice k+1's transfer is in
+    flight while the caller computes on slice k. Prefetch depth,
+    pruning, and spillable landing come from the source's
+    ``rapids.tpu.io.scan.*`` conf; depth 0 is the synchronous
+    byte-identical reference path."""
 
     #: planner-set (fused.py): hand packed uploads to the consuming
     #: fused chain undecoded; the chain inlines the decode in-program
@@ -52,94 +53,9 @@ class ScanExec(TpuExec):
         return self.source.num_splits()
 
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
-        def it():
-            data, validity = self.source.read_host_split(partition)
-            first = self.schema.names[0] if len(self.schema) else None
-            n = len(data[first]) if first else 0
-            if n == 0:
-                yield ColumnarBatch.empty(self.schema)
-                return
-            origin = self.source.split_origin(partition)
-            stats = self.source.split_stats(partition)
-            starts = list(range(0, n, self.batch_rows))
-            with semaphore.get():
-                if len(starts) == 1:
-                    with TraceRange("ScanExec.upload"):
-                        b = interop.host_to_batch(
-                            data, validity, self.schema, 0, n,
-                            stats=stats, pack=self.pack,
-                            defer_decode=self.defer_decode)
-                        b.origin = origin
-                        yield b
-                    return
-                # double-buffered upload pipeline: producer thread packs
-                # (host-only), consumer issues the async device_put the
-                # moment a packed slice arrives and only THEN yields the
-                # previously uploaded slice — slice k+1's transfer is in
-                # flight while the caller computes on slice k
-                import queue as _queue
-                import threading
+        from spark_rapids_tpu.io import scanpipe
 
-                q: "_queue.Queue" = _queue.Queue(maxsize=1)
-                stop = threading.Event()
-
-                def put(item) -> bool:
-                    """Bounded put that re-checks ``stop`` — a consumer
-                    that abandons the scan (limit, downstream error)
-                    must not leave this thread blocked forever pinning
-                    the host split + an encoded batch."""
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            return True
-                        except _queue.Full:
-                            continue
-                    return False
-
-                def produce():
-                    try:
-                        for start in starts:
-                            if stop.is_set():
-                                return
-                            end = min(start + self.batch_rows, n)
-                            with TraceRange("ScanExec.pack"):
-                                p = interop.pack_host(
-                                    data, validity, self.schema, start,
-                                    end, stats=stats, pack=self.pack)
-                            if not put(("packed", p)):
-                                return
-                        put(("done", None))
-                    except BaseException as e:  # surface in consumer
-                        put(("error", e))
-
-                t = threading.Thread(target=produce, daemon=True,
-                                     name="scan-pack")
-                t.start()
-                pending = None
-                try:
-                    while True:
-                        kind, val = q.get()
-                        if kind == "done":
-                            if pending is not None:
-                                yield pending
-                            return
-                        if kind == "error":
-                            raise val
-                        with TraceRange("ScanExec.upload"):
-                            b = interop.upload_packed(
-                                val, defer_decode=self.defer_decode)
-                        b.origin = origin
-                        if pending is not None:
-                            yield pending
-                        pending = b
-                finally:
-                    stop.set()
-                    while True:  # unblock a mid-put producer
-                        try:
-                            q.get_nowait()
-                        except _queue.Empty:
-                            break
-        return timed(self, it())
+        return timed(self, scanpipe.scan_iter(self, partition))
 
 
 class DeviceBatchesExec(TpuExec):

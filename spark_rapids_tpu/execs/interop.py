@@ -166,6 +166,11 @@ class PackedHost:
         self.cap = cap
         self.n = n
 
+    def nbytes(self) -> int:
+        """Host bytes staged for upload — what a queued slice charges
+        the admission budget while it waits in the scan pipeline."""
+        return int(sum(b.nbytes for b in self.host_bufs))
+
 
 class PackedBatch:
     """Device-resident but still PACKED scan batch: the upload happened

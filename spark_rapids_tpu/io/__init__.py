@@ -8,9 +8,11 @@ PartitionReader analogue) -> columnar host buffers -> device upload, with
 the same row-group pruning / predicate pushdown / column projection on the
 metadata path.
 """
+from spark_rapids_tpu.io import scanpipe
 from spark_rapids_tpu.io.csv import CsvSource
 from spark_rapids_tpu.io.orc import OrcSource
 from spark_rapids_tpu.io.parquet import ParquetSource
 from spark_rapids_tpu.io.write import WriteFilesNode
 
-__all__ = ["ParquetSource", "OrcSource", "CsvSource", "WriteFilesNode"]
+__all__ = ["ParquetSource", "OrcSource", "CsvSource", "WriteFilesNode",
+           "scanpipe"]

@@ -104,6 +104,22 @@ class CsvSource(FileSourceBase):
 
     def _build_splits(self) -> list:
         self.chunks_total += len(self.paths)
+        if self._pruning_enabled():
+            # CSV carries no footer statistics: filters were pushed down
+            # but nothing can prune — record the reason explicitly so
+            # bytes-read accounting stays honest across formats
+            import os
+
+            from spark_rapids_tpu.io import scanpipe
+
+            total = 0
+            for p in self.paths:
+                try:
+                    total += os.path.getsize(p)
+                except OSError:  # pragma: no cover - raced unlink
+                    pass
+            scanpipe.record_unprunable("csv", "no-footer-stats",
+                                       len(self.paths), total)
         return list(self.paths)
 
     def _read_split(self, desc: str):

@@ -188,8 +188,11 @@ class FileSourceBase(DataSource):
         each partition is one host read + one device upload + one trip
         through every per-batch kernel downstream — at ~100 ms fixed
         cost per dispatch, 4 splits of a 20 MB table cost 4x the
-        dispatches of 1 packed split for zero parallelism gain."""
-        target = self.conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES)
+        dispatches of 1 packed split for zero parallelism gain. The
+        pack target is additionally capped by maxPartitionBytes so
+        packing never undoes the partition-size contract."""
+        target = min(self.conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES),
+                     self.conf.get(cfg.SCAN_MAX_PARTITION_BYTES))
         per_path_count: dict = {}
         for d in raw:
             p = d if isinstance(d, str) else d.path
@@ -231,6 +234,56 @@ class FileSourceBase(DataSource):
             return arrow_conv.empty_host(self.schema())
         table = self._read_desc(descs[split])
         return arrow_conv.table_to_host(table, self.schema())
+
+    def _pruning_enabled(self) -> bool:
+        """Footer-stat pruning gate: filters pushed down AND the knob
+        on. Checked by subclasses before dropping any chunk."""
+        return bool(self.filters) and \
+            bool(self.conf.get(cfg.SCAN_PRUNING_ENABLED))
+
+    def _desc_chunks(self, desc):
+        """Yield one split descriptor's host data chunk by chunk;
+        format subclasses refine to row-group / stripe granularity so
+        the scan pipeline streams instead of materializing the split."""
+        yield arrow_conv.table_to_host(self._read_split(desc),
+                                       self.schema())
+
+    def read_host_chunks(self, split: int):
+        """Stream one split as (data, validity) host chunks — the scan
+        pipeline (io/scanpipe) re-slices these to exact batch-row
+        boundaries, so chunk granularity never changes results."""
+        descs = self.splits()
+        if not descs:
+            yield arrow_conv.empty_host(self.schema())
+            return
+        desc = descs[split]
+        members = desc.members if isinstance(desc, PackedSplit) \
+            else [desc]
+        for m in members:
+            yield from self._desc_chunks(m)
+
+    def _desc_nbytes(self, desc) -> int:
+        """On-disk bytes one split descriptor will read (whole file by
+        default; subclasses narrow to the chunks actually kept)."""
+        path = desc if isinstance(desc, str) else \
+            getattr(desc, "path", None)
+        if not path:
+            return 0
+        try:
+            return os.path.getsize(path)
+        except OSError:  # pragma: no cover - raced unlink
+            return 0
+
+    def split_nbytes(self, split: int) -> int:
+        """On-disk bytes reading this scan partition will touch
+        (telemetry: the bytes_read side of pruning accounting)."""
+        descs = self.splits()
+        if not descs:
+            return 0
+        desc = descs[split]
+        members = desc.members if isinstance(desc, PackedSplit) \
+            else [desc]
+        return sum(self._desc_nbytes(m) for m in members)
 
     def _desc_stats(self, desc) -> Optional[dict]:
         s = getattr(desc, "stats", None)

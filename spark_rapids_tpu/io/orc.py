@@ -24,6 +24,9 @@ class _StripeSplit:
     stripes: tuple  # () = whole file
     # ((col, lo, hi), ...) from stripe statistics — Column.stats feed
     stats: tuple = ()
+    # estimated on-disk bytes (file size / stripe count: the ORC tail
+    # we parse does not carry per-stripe byte lengths)
+    nbytes: int = 0
 
 
 class OrcSource(FileSourceBase):
@@ -49,17 +52,26 @@ class OrcSource(FileSourceBase):
         return None if self._est_rows < 0 else self._est_rows
 
     def _build_splits(self) -> list:
+        import os
+
         from pyarrow import orc
 
+        from spark_rapids_tpu.io import scanpipe
         from spark_rapids_tpu.io.orc_meta import stripe_statistics
 
         schema = self.schema()
         types = dict(zip(schema.names, schema.types))
+        prune = self._pruning_enabled()
         splits = []
         for path in self.paths:
             f = orc.ORCFile(path)
             n = f.nstripes
             self.chunks_total += max(n, 1)
+            try:
+                fsize = os.path.getsize(path)
+            except OSError:  # pragma: no cover - raced unlink
+                fsize = 0
+            stripe_bytes = fsize // max(n, 1)
             # statistics map by the FILE schema's field order — a column
             # projection must not shift which physical column a name's
             # stats come from (parquet resolves by name the same way)
@@ -67,16 +79,25 @@ class OrcSource(FileSourceBase):
                 if n >= 1 else None
             if per_stripe is not None and len(per_stripe) != n:
                 per_stripe = None  # tail/stripe mismatch: trust reads
+            if per_stripe is None and prune:
+                # filters were pushed down but this file's tail carries
+                # no usable stripe statistics: say so, don't silently
+                # skip pruning (bytes-read accounting stays honest)
+                scanpipe.record_unprunable("orc", "no-stripe-statistics",
+                                           max(n, 1), fsize)
             for i in range(max(n, 1)):
                 sid = () if n <= 1 else (i,)
-                if per_stripe is not None and self.filters and \
+                if per_stripe is not None and prune and \
                         not filter_may_match(self.filters,
                                              per_stripe[i]):
                     self.chunks_pruned += 1
+                    scanpipe.record_pruned("orc", 1, stripe_bytes)
                     continue
                 st = self._split_stats(per_stripe[i], types) \
                     if per_stripe else ()
-                splits.append(_StripeSplit(path, sid, st))
+                splits.append(_StripeSplit(
+                    path, sid, st,
+                    stripe_bytes if sid else fsize))
         return splits
 
     @staticmethod
@@ -106,3 +127,26 @@ class OrcSource(FileSourceBase):
             return f.read(columns=names)
         batches = [f.read_stripe(i, columns=names) for i in desc.stripes]
         return pa.Table.from_batches(batches)
+
+    def _desc_chunks(self, desc: _StripeSplit):
+        """Stripe-granular streaming read for the scan pipeline."""
+        import pyarrow as pa
+        from pyarrow import orc
+
+        self._maybe_debug_dump(desc.path)
+        f = orc.ORCFile(desc.path)
+        schema = self.schema()
+        names = list(schema.names)
+        if not desc.stripes:
+            yield arrow_conv.table_to_host(f.read(columns=names),
+                                           schema)
+            return
+        for i in desc.stripes:
+            batch = f.read_stripe(i, columns=names)
+            yield arrow_conv.table_to_host(
+                pa.Table.from_batches([batch]), schema)
+
+    def _desc_nbytes(self, desc: _StripeSplit) -> int:
+        if desc.nbytes:
+            return desc.nbytes
+        return super()._desc_nbytes(desc)

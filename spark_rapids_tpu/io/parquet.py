@@ -29,6 +29,9 @@ class _RgSplit:
     # columns where EVERY row group published min/max — free Column.stats
     # for the packed-key groupby path (no upload-time host pass)
     stats: tuple = ()
+    # on-disk (compressed, projected-columns) bytes this split reads —
+    # the bytes_read side of pruning telemetry
+    nbytes: int = 0
 
 
 def _stat_value(typ: dt.DType, v):
@@ -124,26 +127,43 @@ class ParquetSource(FileSourceBase):
     def _build_splits(self) -> list:
         import pyarrow.parquet as pq
 
+        from spark_rapids_tpu.io import scanpipe
+
         schema = self.schema()
         types = dict(zip(schema.names, schema.types))
+        # dual split targets: the reader batch target bounds the
+        # UNCOMPRESSED bytes one host read materializes; maxPartitionBytes
+        # bounds the ON-DISK bytes one partition covers, so a single
+        # file bigger than it still splits on row-group boundaries and
+        # parallelizes like many small files
         target = self.conf.get(cfg.MAX_READER_BATCH_SIZE_BYTES)
+        disk_target = self.conf.get(cfg.SCAN_MAX_PARTITION_BYTES)
+        prune = self._pruning_enabled()
         splits: List[_RgSplit] = []
         for path in self.paths:
             meta = pq.ParquetFile(path).metadata
             name_to_col = {meta.schema.column(i).name: i
                            for i in range(meta.num_columns)}
+            proj_cols = [name_to_col[c] for c in types
+                         if c in name_to_col]
             kept: List[int] = []
             kept_stats: List[dict] = []
             kept_bytes = 0
+            kept_disk = 0
 
-            def emit(kept, kept_stats):
+            def emit(kept, kept_stats, kept_disk):
                 splits.append(_RgSplit(
                     path, tuple(kept),
-                    _merge_rg_stats(kept_stats, types)))
+                    _merge_rg_stats(kept_stats, types),
+                    int(kept_disk)))
 
             for rg in range(meta.num_row_groups):
                 self.chunks_total += 1
                 rgmeta = meta.row_group(rg)
+                # on-disk cost of this row group = compressed extent of
+                # the PROJECTED columns only (pyarrow reads only those)
+                rg_disk = sum(rgmeta.column(ci).total_compressed_size
+                              for ci in proj_cols)
                 stats = {}
                 for cname, typ in types.items():
                     ci = name_to_col.get(cname)
@@ -155,19 +175,22 @@ class ParquetSource(FileSourceBase):
                     stats[cname] = (_stat_value(typ, st.min),
                                     _stat_value(typ, st.max),
                                     bool(st.null_count))
-                if self.filters and not filter_may_match(self.filters,
-                                                         stats):
+                if prune and not filter_may_match(self.filters, stats):
                     self.chunks_pruned += 1
+                    scanpipe.record_pruned("parquet", 1, rg_disk)
                     continue
                 rg_bytes = rgmeta.total_byte_size
-                if kept and kept_bytes + rg_bytes > target:
-                    emit(kept, kept_stats)
-                    kept, kept_stats, kept_bytes = [], [], 0
+                if kept and (kept_bytes + rg_bytes > target or
+                             kept_disk + rg_disk > disk_target):
+                    emit(kept, kept_stats, kept_disk)
+                    kept, kept_stats = [], []
+                    kept_bytes = kept_disk = 0
                 kept.append(rg)
                 kept_stats.append(stats)
                 kept_bytes += rg_bytes
+                kept_disk += rg_disk
             if kept:
-                emit(kept, kept_stats)
+                emit(kept, kept_stats, kept_disk)
         return splits
 
     # split_stats: FileSourceBase merges per-desc stats, incl. packed
@@ -182,6 +205,27 @@ class ParquetSource(FileSourceBase):
         return f.read_row_groups(list(desc.row_groups),
                                  columns=list(schema.names),
                                  use_threads=False)
+
+    def _desc_chunks(self, desc: _RgSplit):
+        """Row-group-granular streaming read: the scan pipeline gets
+        its first chunk after ONE row group's decode latency instead of
+        the whole split's, and never holds more than a chunk + the
+        accumulator remainder on the host."""
+        import pyarrow.parquet as pq
+
+        self._maybe_debug_dump(desc.path)
+        f = pq.ParquetFile(desc.path)
+        schema = self.schema()
+        names = list(schema.names)
+        for rg in desc.row_groups:
+            table = f.read_row_groups([rg], columns=names,
+                                      use_threads=False)
+            yield arrow_conv.table_to_host(table, schema)
+
+    def _desc_nbytes(self, desc: _RgSplit) -> int:
+        if desc.nbytes:
+            return desc.nbytes
+        return super()._desc_nbytes(desc)
 
     def split_origin(self, split: int):
         """(path, block_start, block_length) from the split's actual

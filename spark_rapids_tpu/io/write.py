@@ -85,6 +85,35 @@ def _write_table(table, path: str, format: str) -> int:
     return os.path.getsize(path)
 
 
+def write_table_stream(chunks, path: str, format: str = "parquet"
+                       ) -> int:
+    """Stream an iterator of arrow tables into ONE file without ever
+    materializing their concatenation: each chunk appends through the
+    format's incremental writer, so peak host memory is one chunk.
+    The large-scale-factor datagen path rides on this (sf100 lineitem
+    is tens of GB as a single host table). Returns the file size."""
+    it = iter(chunks)
+    try:
+        first = next(it)
+    except StopIteration:
+        raise ValueError("write_table_stream: empty chunk stream")
+    if format == "parquet":
+        import pyarrow.parquet as pq
+
+        with pq.ParquetWriter(path, first.schema) as w:
+            w.write_table(first)
+            for t in it:
+                w.write_table(t)
+    else:
+        from pyarrow import orc
+
+        with orc.ORCWriter(path) as w:
+            w.write(first)
+            for t in it:
+                w.write(t)
+    return os.path.getsize(path)
+
+
 def _partition_dir(base: str, cols: List[str], values) -> str:
     parts = []
     for c, v in zip(cols, values):

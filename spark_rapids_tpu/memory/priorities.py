@@ -13,6 +13,13 @@ OUTPUT_FOR_SHUFFLE_PRIORITY = 0
 # Buffers received from a remote shuffle, not yet handed to a task.
 INPUT_FROM_SHUFFLE_PRIORITY = 1 << 20
 
+# Scan-cache landings (io/scanpipe): scan results parked as spillable
+# batches keyed on per-file (mtime_ns, size). Re-reading the source file
+# is cheaper than recomputing a cached fragment's plan, so these spill
+# before CACHED_FRAGMENT, but they save real filesystem+decode work, so
+# they outlast shuffle residue.
+SCAN_CACHE_PRIORITY = 1 << 25
+
 # Materialized semantic-cache fragments (service/cache): re-creatable
 # from their source plan, so they spill before any query's working
 # batches, but they serve many future queries, so they outlast shuffle

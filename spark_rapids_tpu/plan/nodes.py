@@ -101,6 +101,17 @@ class DataSource:
         assert split == 0, split
         return self.read_host()
 
+    def read_host_chunks(self, split: int):
+        """Stream one split as (data, validity) host chunks for the
+        scan pipeline (io/scanpipe). Default: the whole split as one
+        chunk; file sources override with decode-granular streams."""
+        yield self.read_host_split(split)
+
+    def split_nbytes(self, split: int) -> int:
+        """On-disk bytes reading this split touches (bytes_read
+        telemetry); 0 for non-file sources."""
+        return 0
+
     def split_origin(self, split: int):
         """(file_path, block_start, block_length) for file-backed splits
         (input_file_name support); None for non-file sources."""

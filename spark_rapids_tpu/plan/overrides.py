@@ -1171,6 +1171,9 @@ def push_down_file_filters(plan: pn.PlanNode,
             filters = _extract_pushdown(plan.condition,
                                         child.output_schema())
             if filters:
+                from spark_rapids_tpu.io import scanpipe
+
+                scanpipe.record_pushdown(len(filters))
                 new_scan = pn.ScanNode(child.source.with_filters(filters))
                 return plan.with_children([new_scan])
     return plan

@@ -84,12 +84,27 @@ def source_identity(source) -> Optional[tuple]:
     return None
 
 
+def file_versions(paths) -> Optional[tuple]:
+    """Per-file ``(mtime_ns, size)`` stat vector for an explicit path
+    list, or None when any file vanished — the same no-guess contract
+    as ``source_version``, reusable for sub-source keys (the scan
+    cache versions individual splits with this)."""
+    import os
+
+    stats = []
+    for p in paths:
+        try:
+            st = os.stat(p)
+        except OSError:
+            return None  # a vanished file: never serve cached data
+        stats.append((st.st_mtime_ns, st.st_size))
+    return tuple(stats)
+
+
 def source_version(source) -> Optional[tuple]:
     """Snapshot version of a keyable DataSource as of NOW, or None when
     the version cannot be established (then nothing over this source is
     cached — staleness must never be a guess)."""
-    import os
-
     manual = int(getattr(source, "_snap_version", 0))
     fn = getattr(source, "cache_version", None)
     if callable(fn):
@@ -97,12 +112,8 @@ def source_version(source) -> Optional[tuple]:
     from spark_rapids_tpu.io.filesrc import FileSourceBase
 
     if isinstance(source, FileSourceBase):
-        stats = []
-        for p in source.paths:
-            try:
-                st = os.stat(p)
-            except OSError:
-                return None  # a vanished file: never serve cached data
-            stats.append((st.st_mtime_ns, st.st_size))
-        return ("#v", manual, tuple(stats))
+        stats = file_versions(source.paths)
+        if stats is None:
+            return None
+        return ("#v", manual, stats)
     return None

@@ -91,10 +91,15 @@ class QueryService:
         # pending checkpoint/WAL host buffers charge admission too: a
         # burst of async checkpoint blobs is real host memory, and the
         # admission ledger is the one place that sees every subsystem
+        from spark_rapids_tpu.io import scanpipe
+
         self.admission.extra_bytes_fn = lambda: (
             self.cache.device_resident_bytes()
             + self.streaming.device_resident_bytes()
-            + self.streaming.durability_pending_bytes())
+            + self.streaming.durability_pending_bytes()
+            # scan-pipeline backpressure: packed slices queued for
+            # upload + device-resident scan-cache landings
+            + scanpipe.admission_bytes())
         # restart recovery (PR 19): discover what the checkpoint dir
         # holds; the actual WAL replays / checkpoint restores run when
         # the caller re-creates its tables and re-registers its queries

@@ -351,6 +351,24 @@ def replan_delta(before: dict) -> dict:
     return adaptive.replan_delta(before)
 
 
+def scan_snapshot() -> dict:
+    """Scan-pipeline telemetry counters so far — thin passthrough to
+    io.scanpipe so telemetry consumers snapshot dispatches, replans and
+    scans from one module."""
+    from spark_rapids_tpu.io import scanpipe
+
+    return scanpipe.snapshot()
+
+
+def scan_delta(before: dict) -> dict:
+    """The ``io.scan`` block accumulated since ``before`` (a
+    scan_snapshot): bytes read/pruned, decode vs h2d seconds, measured
+    scan–compute overlap fraction, per-format unprunable reasons."""
+    from spark_rapids_tpu.io import scanpipe
+
+    return scanpipe.delta(before)
+
+
 def executable_count() -> int:
     """Distinct compiled executables across all jitted entry points
     (one jit fn compiles once per argument-shape signature)."""

@@ -92,6 +92,10 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "expressions.fusedCache": 86,
     # -- io ------------------------------------------------------------
     "io.filesrc.splits": 90,
+    # scan-cache registry (io/scanpipe): lookups/publishes hold this
+    # while closing stale SpillableBatches through the catalog (100),
+    # so it must sit OUTSIDE the memory subsystem ---------------------
+    "io.scanpipe.cache": 91,
     # -- streaming table deltas: appends hold this while bumping the
     # snapshot counter (158); scans take it briefly to copy the delta
     # list before concatenating outside the lock ----------------------
@@ -132,6 +136,7 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "execs.adaptive.replans": 174,   # replan-event + runtime-stat counters
     "parallel.spmd.fallbacks": 176,  # fallback/seam-decision counters
     "parallel.mesh.fallbacks": 177,  # mesh clamp/topology counters
+    "io.scanpipe.stats": 179,        # scan-pipeline telemetry counters
     "runtime.recovery.stats": 178,   # process-global recovery counters
     "service.streaming.stats": 180,  # process-global fold counters
     "native.kernels.config": 182,    # pallas kernel gate state

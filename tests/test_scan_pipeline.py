@@ -1,0 +1,259 @@
+"""Async scan pipeline tests (io/scanpipe): pruning differentials,
+prefetch-depth identity, failure loudness, spillable landings, and
+cluster-mode split distribution.
+
+Model: the reference's GpuParquetScan row-group filter tests plus the
+multi-threaded/coalescing reader matrix (parquet_test.py reader_opt
+dimension) — every pipeline configuration must be a pure performance
+knob: bit-identical batches, CPU-engine-as-oracle results.
+"""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.execs.base import collect
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import BoundReference, Literal
+from spark_rapids_tpu.io import ParquetSource, arrow_conv, scanpipe
+from spark_rapids_tpu.plan import nodes as pn
+from spark_rapids_tpu.plan.overrides import apply_overrides
+
+from tests.compare import assert_frames_equal
+
+ROW_GROUP = 100
+
+
+@pytest.fixture(autouse=True)
+def _clean_scanpipe():
+    scanpipe.clear_cache()
+    scanpipe.reset_stats()
+    yield
+    scanpipe.clear_cache()
+    scanpipe.reset_stats()
+
+
+def _edge_table(n=1000):
+    """Sorted key with NULLs exactly at every row-group's min/max edge
+    rows (positions 0 and ROW_GROUP-1 of each group), so footer stats
+    come from interior rows and a pruning decision that mishandled
+    nulls-at-edges would either drop live rows or keep dead groups.
+    The last group is ALL-null in ``k`` — no usable stats, must be
+    conservatively kept."""
+    k = np.arange(n, dtype=np.int64)
+    null_mask = np.zeros(n, dtype=bool)
+    null_mask[0::ROW_GROUP] = True
+    null_mask[ROW_GROUP - 1::ROW_GROUP] = True
+    null_mask[n - ROW_GROUP:] = True
+    rng = np.random.default_rng(11)
+    v = rng.random(n) * 1e3
+    s = [None if i % 17 == 0 else f"s{i % 23}" for i in range(n)]
+    return pa.table({
+        "k": pa.array(k, mask=null_mask),
+        "v": pa.array(v),
+        "s": pa.array(s, type=pa.string()),
+    })
+
+
+def _edge_file(tmp_path, n=1000):
+    path = str(tmp_path / "edges.parquet")
+    pq.write_table(_edge_table(n), path, row_group_size=ROW_GROUP)
+    return path
+
+
+def _filtered_plan(src, lo):
+    cond = P.GreaterThanOrEqual(BoundReference(0, dt.INT64),
+                                Literal(lo, dt.INT64))
+    return pn.FilterNode(cond, pn.ScanNode(src))
+
+
+def test_pruned_vs_unpruned_bitexact(tmp_path):
+    """Row-group pruning is invisible to results: the pruned scan and
+    the scan-everything scan produce bit-identical filtered frames,
+    with NULL keys sitting on every group's stat edges."""
+    path = _edge_file(tmp_path)
+    lo = 750
+
+    on = RapidsConf({cfg.SCAN_PRUNING_ENABLED.key: True})
+    off = RapidsConf({cfg.SCAN_PRUNING_ENABLED.key: False})
+    pruned_src = ParquetSource(path, filters=[("k", ">=", lo)], conf=on)
+    plain_src = ParquetSource(path, filters=[("k", ">=", lo)], conf=off)
+
+    pruned = collect(apply_overrides(_filtered_plan(pruned_src, lo), on),
+                     on)
+    # pruning really happened: groups [0, 700) have max < 750. The
+    # all-null tail group has no usable stats and must survive pruning
+    # (conservative keep). Counters are global because the planner's
+    # pushdown rebuilds the source via with_filters().
+    assert scanpipe.snapshot()["chunks_pruned"] == 7
+    assert scanpipe.snapshot()["bytes_pruned"] > 0
+
+    scanpipe.reset_stats()
+    full = collect(apply_overrides(_filtered_plan(plain_src, lo), off),
+                   off)
+    assert scanpipe.snapshot()["chunks_pruned"] == 0
+
+    assert list(pruned.columns) == list(full.columns)
+    assert len(pruned) == len(full) > 0
+    for c in pruned.columns:
+        a, b = pruned[c].to_numpy(), full[c].to_numpy()
+        assert a.dtype == b.dtype
+        if a.dtype.kind == "f":
+            # bit-exact, including NaN representation
+            assert np.array_equal(a.view(np.uint64), b.view(np.uint64))
+        else:
+            assert np.array_equal(a, b)
+
+    # NULL keys never leak through the filter despite living at the
+    # stats edges of kept groups
+    assert pruned["k"].notna().all() and (pruned["k"] >= lo).all()
+
+    # oracle agreement on top of the differential
+    from spark_rapids_tpu.cpu.engine import execute_cpu
+
+    oracle = execute_cpu(_filtered_plan(
+        ParquetSource(path, filters=[("k", ">=", lo)]), lo)).to_pandas()
+    assert_frames_equal(oracle, full)
+
+
+def _scan_tables(src, conf):
+    """Per-batch arrow tables for every partition, preserving batch
+    boundaries (collect() would hide them)."""
+    exec_ = apply_overrides(pn.ScanNode(src), conf)
+    out = []
+    for p in range(exec_.num_partitions):
+        for b in exec_.execute(p):
+            if b.realized_num_rows():
+                out.append(arrow_conv.batch_to_arrow(b, exec_.schema))
+    return out
+
+
+def test_prefetch_depth_zero_byte_identity(tmp_path):
+    """prefetch.depth=0 (strict synchronous) and depth=3 (pipelined)
+    yield the same batch boundaries and byte-identical buffers: depth
+    is a pure overlap knob, never a semantics knob."""
+    path = _edge_file(tmp_path)
+    batches = {}
+    for depth in (0, 3):
+        conf = RapidsConf({cfg.SCAN_PREFETCH_DEPTH.key: depth})
+        batches[depth] = _scan_tables(ParquetSource(path, conf=conf),
+                                      conf)
+    assert len(batches[0]) == len(batches[3]) > 0
+    for sync_t, async_t in zip(batches[0], batches[3]):
+        assert sync_t.num_rows == async_t.num_rows
+        assert sync_t.equals(async_t)
+        # byte-level: identical buffer contents, not just equal values
+        for name in sync_t.column_names:
+            ca = sync_t.column(name).combine_chunks()
+            cb = async_t.column(name).combine_chunks()
+            for ba, bb in zip(ca.buffers(), cb.buffers()):
+                assert (ba is None) == (bb is None)
+                if ba is not None:
+                    assert ba.to_pybytes() == bb.to_pybytes()
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_truncated_file_fails_loudly(tmp_path, depth):
+    """A file truncated between planning and the read raises — it must
+    never come back as a silently short result (both the synchronous
+    and the prefetching consumer propagate the producer's error)."""
+    path = _edge_file(tmp_path)
+    conf = RapidsConf({cfg.SCAN_PREFETCH_DEPTH.key: depth})
+    src = ParquetSource(path, conf=conf)
+    n_splits = src.num_splits()          # splits planned pre-truncation
+    assert n_splits >= 1
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:          # rip off the footer mid-plan
+        f.write(raw[:len(raw) // 2])
+    exec_ = apply_overrides(pn.ScanNode(src), conf)
+    with pytest.raises(Exception, match="(?i)parquet|footer|invalid"):
+        for p in range(exec_.num_partitions):
+            for _ in exec_.execute(p):
+                pass
+
+
+def test_landed_scan_spill_roundtrip(tmp_path):
+    """A landed scan survives device -> host -> disk demotion and still
+    serves bit-exact batches from the scan cache."""
+    from spark_rapids_tpu.memory.catalog import get_catalog
+
+    path = _edge_file(tmp_path)
+    conf = RapidsConf({cfg.SCAN_LANDING_SPILLABLE.key: True})
+    src = ParquetSource(path, conf=conf)
+    plan = pn.ScanNode(src)
+
+    first = collect(apply_overrides(plan, conf), conf)
+    assert scanpipe.cache_len() == 1
+    assert scanpipe.snapshot()["cache_hits"] == 0
+    assert scanpipe.cache_device_bytes() > 0
+
+    # demote every landed buffer: device -> host, then host -> disk
+    catalog = get_catalog()
+    catalog.synchronous_spill(0)
+    assert scanpipe.cache_device_bytes() == 0
+    catalog.spill_host_to_disk(0)
+
+    again = collect(apply_overrides(plan, conf), conf)
+    assert scanpipe.snapshot()["cache_hits"] == 1
+    assert list(first.columns) == list(again.columns)
+    for c in first.columns:
+        a, b = first[c].to_numpy(), again[c].to_numpy()
+        if a.dtype.kind == "f":
+            assert np.array_equal(a.view(np.uint64), b.view(np.uint64))
+        else:
+            assert np.array_equal(a, b)
+
+    # rewriting the file invalidates the landing (version key), no
+    # stale serve
+    pq.write_table(_edge_table(300), path, row_group_size=ROW_GROUP)
+    fresh_src = ParquetSource(path, conf=conf)
+    fresh = collect(apply_overrides(pn.ScanNode(fresh_src), conf), conf)
+    assert len(fresh) == 300
+    assert scanpipe.snapshot()["cache_hits"] == 1  # miss, not a hit
+
+
+def test_cluster_scan_disjoint_splits(tmp_path):
+    """Cluster mode: executors (including the separate worker process)
+    scan DISJOINT splits of the same parquet directory and the merged
+    result matches the single-process oracle."""
+    from spark_rapids_tpu.api import Session
+    from spark_rapids_tpu.runtime.cluster import shutdown_session_cluster
+
+    for k in range(6):
+        t = pa.table({
+            "g": np.array([f"g{i % 4}" for i in range(200)],
+                          dtype=object),
+            "x": np.random.default_rng(k).integers(
+                0, 1000, 200).astype(np.int64),
+        })
+        pq.write_table(t, tmp_path / f"part-{k}.parquet")
+
+    def view_source():
+        s = ParquetSource(str(tmp_path))
+        s.pack_splits = False            # 6 files -> 6 disjoint splits
+        assert s.num_splits() == 6
+        return s
+
+    query = ("SELECT g, sum(x) AS total, count(*) AS n FROM t "
+             "GROUP BY g ORDER BY g")
+    plain = Session()
+    plain.create_temp_view("t", pn.ScanNode(view_source()))
+    expected = plain.sql(query).collect()
+
+    s = Session({
+        "rapids.tpu.cluster.enabled": True,
+        "rapids.tpu.cluster.executors": 2,
+        "rapids.tpu.cluster.workers": 1,
+        "rapids.tpu.sql.shuffle.partitions": 4,
+    })
+    try:
+        s.create_temp_view("t", pn.ScanNode(view_source()))
+        got = s.sql(query).collect()
+    finally:
+        shutdown_session_cluster()
+    assert_frames_equal(expected, got, sort=False)
+    assert got["n"].sum() == 1200        # every split scanned once
